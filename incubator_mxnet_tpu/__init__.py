@@ -1,0 +1,39 @@
+"""incubator-mxnet-tpu: a TPU-native deep learning framework with the
+capabilities of Apache MXNet.
+
+Built from scratch on JAX/XLA/Pallas: eager NDArray + autograd tape, symbolic
+Symbol/Executor lowering whole graphs to single XLA programs, Gluon-style
+blocks with hybridize→jit, mesh-parallel KVStore, and a TPU-first parallelism
+layer (data/tensor/sequence/pipeline parallel over ``jax.sharding.Mesh``).
+
+Usage mirrors the reference frontend::
+
+    import incubator_mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu())
+    with mx.autograd.record():
+        y = (x * 2).sum()
+    y.backward()
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# float64/int64 are first-class dtypes in the reference (mshadow base.h);
+# enable x64 so Cast/astype honor them. All framework defaults remain
+# explicit float32, and python scalars stay weakly typed, so this does not
+# change default numerics.
+_jax.config.update("jax_enable_x64", True)
+
+from . import base
+from .base import MXNetError
+from .context import Context, cpu, cpu_pinned, current_context, gpu, num_devices, num_gpus, tpu
+from . import engine
+from . import rng as _rng_core  # noqa: F401
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import autograd
+from . import random

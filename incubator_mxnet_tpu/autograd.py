@@ -1,0 +1,307 @@
+"""Imperative autograd: tape of ``jax.vjp`` closures.
+
+Parity surface: ``python/mxnet/autograd.py`` (record/pause/train_mode/
+predict_mode scopes, mark_variables, backward, grad) backed by the C++ tape in
+``src/imperative/imperative.cc`` (RecordOp/Backward).
+
+TPU-native design: instead of re-running a gradient *graph pass* over an IR
+(reference: ``src/nnvm/gradient.cc``), every recorded op calls ``jax.vjp`` at
+forward time; the tape stores the returned pullback.  For hybridized blocks a
+single tape node covers the whole compiled program, so tape overhead is O(#
+blocks), not O(# ops) — the XLA analog of CachedOp backward
+(``src/imperative/cached_op.cc:1254``).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "set_recording",
+    "set_training",
+    "mark_variables",
+    "backward",
+    "grad",
+    "get_symbol",
+    "Function",
+]
+
+_STATE = threading.local()
+
+
+def _st():
+    if not hasattr(_STATE, "recording"):
+        _STATE.recording = False
+        _STATE.training = False
+    return _STATE
+
+
+def is_recording() -> bool:
+    return _st().recording
+
+
+def is_training() -> bool:
+    return _st().training
+
+
+def set_recording(is_record: bool) -> bool:
+    s = _st()
+    prev, s.recording = s.recording, is_record
+    return prev
+
+
+def set_training(train_mode_: bool) -> bool:
+    s = _st()
+    prev, s.training = s.training, train_mode_
+    return prev
+
+
+@contextlib.contextmanager
+def _scope(recording=None, training=None):
+    prev_r = set_recording(recording) if recording is not None else None
+    prev_t = set_training(training) if training is not None else None
+    try:
+        yield
+    finally:
+        if recording is not None:
+            set_recording(prev_r)
+        if training is not None:
+            set_training(prev_t)
+
+
+def record(train_mode=True):  # noqa: A002 - parity name
+    """Scope: record ops for autograd (autograd.py:122 parity)."""
+    return _scope(recording=True, training=train_mode)
+
+
+def pause(train_mode=False):
+    return _scope(recording=False, training=train_mode)
+
+
+def train_mode():
+    return _scope(training=True)
+
+
+def predict_mode():
+    return _scope(training=False)
+
+
+# ---------------------------------------------------------------------------
+# Tape
+# ---------------------------------------------------------------------------
+
+
+class TapeNode:
+    """One recorded op: pullback + references to input/output NDArrays."""
+
+    __slots__ = ("vjp_fn", "inputs", "outputs", "n_outputs", "name")
+
+    def __init__(self, vjp_fn, inputs, outputs, name=""):
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)  # NDArray objects
+        self.outputs = list(outputs)  # NDArray objects (weakref-free: tape owns)
+        self.n_outputs = len(outputs)
+        self.name = name
+
+
+def attach_node(arrays: Sequence[Any], node: TapeNode):
+    for i, a in enumerate(arrays):
+        a._ag_node = node
+        a._ag_out_idx = i
+
+
+def requires_grad(a) -> bool:
+    return getattr(a, "_ag_grad", None) is not None or getattr(a, "_ag_node", None) is not None
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to arrays (autograd.py:197 parity)."""
+    if not isinstance(variables, (list, tuple)):
+        variables, gradients = [variables], [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._ag_grad = g
+        v._ag_grad_req = req
+
+
+def _toposort(heads) -> List[TapeNode]:
+    seen = set()
+    order: List[TapeNode] = []
+
+    def visit(node: TapeNode):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for inp in node.inputs:
+            parent = getattr(inp, "_ag_node", None)
+            if parent is not None:
+                visit(parent)
+        order.append(node)
+
+    for h in heads:
+        n = getattr(h, "_ag_node", None)
+        if n is not None:
+            visit(n)
+    return order
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # noqa: A002
+    """Run reverse accumulation from ``heads`` into marked variables.
+
+    Reference behavior (``src/imperative/imperative.cc:280``): grads written
+    into the buffers attached by ``mark_variables``/``attach_grad`` honoring
+    grad_req write/add.
+    """
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+    order = _toposort(heads)
+    if not order:
+        raise ValueError(
+            "cannot differentiate: no recorded computation reaches the heads "
+            "(is autograd.record() active and do inputs have attach_grad()?)"
+        )
+
+    grad_map: Dict[int, Any] = {}
+    for i, h in enumerate(heads):
+        hg = None if head_grads is None else head_grads[i]
+        g = jnp.ones(h.shape, h.dtype) if hg is None else hg._data
+        oid = id(h)
+        grad_map[oid] = grad_map[oid] + g if oid in grad_map else g
+
+    for node in reversed(order):
+        out_grads = []
+        any_grad = False
+        for o in node.outputs:
+            g = grad_map.get(id(o))
+            if g is None:
+                g = jnp.zeros(o.shape, o.dtype)
+            else:
+                any_grad = True
+            out_grads.append(g)
+        if not any_grad:
+            continue
+        cot = tuple(out_grads) if node.n_outputs > 1 else out_grads[0]
+        in_grads = node.vjp_fn(cot)
+        for inp, ig in zip(node.inputs, in_grads):
+            if ig is None:
+                continue
+            oid = id(inp)
+            grad_map[oid] = grad_map[oid] + ig if oid in grad_map else ig
+
+    # commit into attached grad buffers
+    committed = set()
+    for node in order:
+        for arr in list(node.inputs) + list(node.outputs):
+            gbuf = getattr(arr, "_ag_grad", None)
+            if gbuf is None or id(arr) in committed:
+                continue
+            committed.add(id(arr))
+            g = grad_map.get(id(arr))
+            if g is None:
+                continue
+            req = getattr(arr, "_ag_grad_req", "write")
+            if req == "null":
+                continue
+            if req == "add":
+                gbuf._data = gbuf._data + g
+            else:
+                gbuf._data = jnp.asarray(g, gbuf.dtype)
+    # also heads that are themselves variables
+    for h in heads:
+        gbuf = getattr(h, "_ag_grad", None)
+        if gbuf is not None and id(h) not in committed:
+            g = grad_map.get(id(h))
+            if g is not None and getattr(h, "_ag_grad_req", "write") != "null":
+                gbuf._data = jnp.asarray(g, gbuf.dtype)
+
+    if not retain_graph:
+        for node in order:
+            for o in node.outputs:
+                o._ag_node = None
+            node.vjp_fn = None
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):  # noqa: A002
+    """Compute grads of heads wrt variables, returned (not written) —
+    autograd.py:273 parity.  ``create_graph=True`` (higher-order) is supported
+    by re-deriving through jax.grad in the functional path; imperative tape
+    higher-order is limited to ops recorded under an active record scope."""
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+    single = not isinstance(variables, (list, tuple))
+    if single:
+        variables = [variables]
+    from .ndarray import NDArray  # local import to avoid cycle
+
+    # temporarily attach fresh grad buffers
+    saved = [(getattr(v, "_ag_grad", None), getattr(v, "_ag_grad_req", None)) for v in variables]
+    bufs = [NDArray(jnp.zeros(v.shape, v.dtype)) for v in variables]
+    for v, b in zip(variables, bufs):
+        v._ag_grad = b
+        v._ag_grad_req = "write"
+    try:
+        backward(heads, head_grads, retain_graph=bool(retain_graph or create_graph),
+                 train_mode=train_mode)
+    finally:
+        for v, (g, r) in zip(variables, saved):
+            v._ag_grad = g
+            if r is not None:
+                v._ag_grad_req = r
+    return bufs[0] if single else bufs
+
+
+def get_symbol(x):
+    """Parity stub: tape → Symbol export is handled via HybridBlock tracing."""
+    raise NotImplementedError(
+        "autograd.get_symbol: use HybridBlock.export / Symbol tracing instead"
+    )
+
+
+class Function:
+    """User-defined differentiable function (autograd.py:370 parity).
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` operating on NDArrays.
+    """
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray
+
+        outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        if is_recording() and any(requires_grad(i) for i in inputs):
+            fn_self = self
+
+            def vjp_fn(cotangents):
+                cots = (cotangents,) if len(outs) == 1 else cotangents
+                from .ndarray import NDArray as ND
+
+                grads = fn_self.backward(*[ND(jnp.asarray(c)) for c in cots])
+                if not isinstance(grads, (list, tuple)):
+                    grads = [grads]
+                return [g._data if g is not None else None for g in grads]
+
+            node = TapeNode(vjp_fn, inputs, outs, name=type(self).__name__)
+            attach_node(outs, node)
+        return outputs
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError

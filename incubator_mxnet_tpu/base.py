@@ -1,0 +1,74 @@
+"""Base types, dtype tables and errors.
+
+TPU-native re-imagination of the reference's ``include/mxnet/base.h`` +
+``python/mxnet/base.py``.  There is no C ABI here: the "runtime" is JAX/XLA,
+so the base layer only needs dtype bookkeeping and error types.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = [
+    "MXNetError",
+    "DType",
+    "np_dtype",
+    "dtype_name",
+    "string_types",
+]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (parity with mxnet.base.MXNetError)."""
+
+
+string_types = (str,)
+
+# Canonical dtype table. The reference enumerates dtypes in
+# mshadow (3rdparty/mshadow/mshadow/base.h) as int flags; we key by name and
+# numpy dtype instead — XLA handles layout/typing.
+_DTYPE_ALIASES = {
+    "float32": _np.float32,
+    "float64": _np.float64,
+    "float16": _np.float16,
+    "bfloat16": "bfloat16",  # resolved lazily via ml_dtypes/jax
+    "uint8": _np.uint8,
+    "int8": _np.int8,
+    "int32": _np.int32,
+    "int64": _np.int64,
+    "bool": _np.bool_,
+}
+
+
+def np_dtype(dtype):
+    """Normalize a user-provided dtype (string/np.dtype/jnp dtype) to numpy dtype."""
+    if dtype is None:
+        return _np.dtype(_np.float32)
+    if isinstance(dtype, str):
+        if dtype == "bfloat16":
+            import ml_dtypes
+
+            return _np.dtype(ml_dtypes.bfloat16)
+        dtype = _DTYPE_ALIASES.get(dtype, dtype)
+    return _np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    return _np.dtype(dtype).name
+
+
+class DType:
+    """Namespace of supported dtypes."""
+
+    float16 = "float16"
+    float32 = "float32"
+    float64 = "float64"
+    bfloat16 = "bfloat16"
+    uint8 = "uint8"
+    int8 = "int8"
+    int32 = "int32"
+    int64 = "int64"
+
+
+def check_call(ret):  # pragma: no cover - API-compat shim
+    """Parity shim for mxnet.base.check_call; no C ABI exists in this build."""
+    return ret

@@ -1,0 +1,120 @@
+"""Device contexts.
+
+Parity surface: ``python/mxnet/context.py`` (reference), ``Context`` in
+``include/mxnet/base.h:102-128``.  TPU-native twist: ``mx.tpu()`` is the
+first-class accelerator; ``mx.gpu()`` is accepted as an alias for tpu so that
+reference scripts run unmodified.  Device placement maps to ``jax.Device``.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+__all__ = ["Context", "cpu", "tpu", "gpu", "cpu_pinned", "current_context", "num_devices"]
+
+
+class Context:
+    """A device context (cpu / tpu). Usable as a ``with`` scope like the reference."""
+
+    # device type enum kept name-compatible with include/mxnet/base.h:102
+    devtype2str = {1: "cpu", 2: "tpu", 3: "cpu_pinned", 5: "cpu_shared"}
+    devstr2type = {"cpu": 1, "tpu": 2, "gpu": 2, "cpu_pinned": 3, "cpu_shared": 5}
+    _default_ctx = threading.local()
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        if device_type not in self.devstr2type:
+            raise ValueError("unknown device type %r" % (device_type,))
+        if device_type == "gpu":
+            device_type = "tpu"  # alias: accelerator == TPU in this framework
+        self.device_type = device_type
+        self.device_id = device_id
+        self._old_ctx: Optional[Context] = None
+
+    # -- jax integration ---------------------------------------------------
+    @property
+    def device_typeid(self) -> int:
+        return self.devstr2type[self.device_type]
+
+    def jax_device(self) -> Optional[jax.Device]:
+        """Resolve to a concrete jax.Device (None => let JAX pick default)."""
+        if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
+            devs = [d for d in jax.devices() if d.platform == "cpu"]
+            if not devs:
+                try:
+                    devs = jax.devices("cpu")
+                except RuntimeError:
+                    return None
+        else:
+            devs = [d for d in jax.devices() if d.platform != "cpu"]
+            if not devs:  # CPU-only host: tpu context falls back to default device
+                return None
+        return devs[self.device_id % len(devs)]
+
+    # -- scope protocol ----------------------------------------------------
+    def __enter__(self):
+        if not hasattr(Context._default_ctx, "value"):
+            Context._default_ctx.value = Context("cpu", 0)
+        self._old_ctx = Context._default_ctx.value
+        Context._default_ctx.value = self
+        return self
+
+    def __exit__(self, *exc):
+        Context._default_ctx.value = self._old_ctx
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    def __str__(self):
+        return self.__repr__()
+
+    def empty_cache(self):  # parity: mx.Context.empty_cache
+        jax.clear_caches()
+
+    @classmethod
+    def default_ctx(cls) -> "Context":
+        if not hasattr(cls._default_ctx, "value"):
+            cls._default_ctx.value = Context("cpu", 0)
+        return cls._default_ctx.value
+
+
+def cpu(device_id: int = 0) -> Context:
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id: int = 0) -> Context:
+    return Context("cpu_pinned", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    return Context("tpu", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    """Reference-compat alias: accelerator contexts resolve to TPU devices."""
+    return Context("tpu", device_id)
+
+
+def current_context() -> Context:
+    return Context.default_ctx()
+
+
+def num_devices(device_type: str = "tpu") -> int:
+    if device_type in ("tpu", "gpu"):
+        return len([d for d in jax.devices() if d.platform != "cpu"])
+    return len([d for d in jax.devices() if d.platform == "cpu"]) or 1
+
+
+def num_gpus() -> int:  # parity: mx.context.num_gpus
+    return num_devices("tpu")

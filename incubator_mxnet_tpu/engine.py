@@ -1,0 +1,52 @@
+"""Execution engine shims.
+
+The reference runs every op through a C++ dependency engine
+(``src/engine/threaded_engine_perdevice.cc``) that toposorts ops dynamically
+over per-NDArray Vars.  On TPU, XLA + JAX's async dispatch already provide
+asynchronous execution with correct data dependencies, so this module only
+preserves the *API surface*: ``waitall`` (≡ Engine::WaitForAll), the bulk
+scope (``MXNET_EXEC_BULK_EXEC_*`` semantics — a hint that is a no-op because
+XLA fuses whole jitted programs anyway), and exception propagation happens at
+``wait_to_read`` just like the reference surfaces async errors at WaitForVar.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+__all__ = ["waitall", "bulk", "set_bulk_size"]
+
+_BULK_SIZE = 15  # parity default: MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN
+
+
+def waitall():
+    """Block until all async computations are done (Engine::WaitForAll)."""
+    try:
+        jax.effects_barrier()
+    except Exception:  # pragma: no cover
+        pass
+    # block on all live arrays is unnecessary; effects_barrier + a device sync
+    # via a tiny transfer covers ordering for timing purposes.
+    jax.device_get(jax.numpy.zeros(()))
+
+
+def set_bulk_size(size: int) -> int:
+    """Parity with mx.engine.set_bulk_size; returns previous size."""
+    global _BULK_SIZE
+    prev, _BULK_SIZE = _BULK_SIZE, size
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size: int):
+    """Parity with mx.engine.bulk scope (python/mxnet/engine.py:26-63).
+
+    Under XLA the jit boundary is the bulking unit, so this is a hint-only
+    scope retained for source compatibility.
+    """
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

@@ -1,0 +1,77 @@
+"""``mx.nd`` — imperative operator namespace.
+
+Op functions are generated from the registry the way the reference code-gens
+python wrappers from ``MXSymbolGetAtomicSymbolInfo``
+(``python/mxnet/ndarray/register.py``): here it is a module ``__getattr__``
+that resolves any registered op name to an eager invoke wrapper, so
+``mx.nd.<op>(...)`` works for every op in :mod:`..ops`.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+import sys
+
+from ..ops import registry as _reg
+from .ndarray import (NDArray, arange, array, concat, empty, from_jax, full,
+                      onehot_encode, ones, stack, waitall, zeros)
+from . import utils
+from .utils import load, save
+from . import random  # noqa: F401
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "concat", "stack", "waitall", "save", "load", "random", "from_jax"]
+
+
+def _input_names(op: "_reg.Op"):
+    """Positional no-default params of op.fn = tensor inputs (FListInputNames)."""
+    try:
+        sig = inspect.signature(op.fn)
+    except (TypeError, ValueError):
+        return None
+    names = []
+    for p in sig.parameters.values():
+        if p.kind == inspect.Parameter.VAR_POSITIONAL:
+            return None  # variadic
+        if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                      inspect.Parameter.POSITIONAL_OR_KEYWORD):
+            if p.default is inspect.Parameter.empty:
+                names.append(p.name)
+            elif p.name in ("bias", "gamma", "sequence_length", "label_lengths",
+                            "data_lengths", "r1_r2"):
+                names.append(p.name)  # optional tensor inputs
+    return names
+
+
+def _make_wrapper(name: str, op: "_reg.Op"):
+    in_names = _input_names(op)
+
+    def wrapper(*args, out=None, name=None, **kwargs):  # noqa: A002
+        inputs = []
+        for a in args:
+            inputs.append(a)
+        if in_names:
+            # allow inputs passed as kwargs by reference name
+            for n in in_names[len(inputs):]:
+                if n in kwargs:
+                    inputs.append(kwargs.pop(n))
+                else:
+                    break
+        kwargs.pop("ctx", None) if op.num_inputs not in (0, None) else None
+        return _reg.invoke(op.name, inputs, out=out, **kwargs)
+
+    wrapper.__name__ = name
+    wrapper.__doc__ = op.doc
+    return wrapper
+
+
+def __getattr__(name):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    try:
+        op = _reg.get_op(name)
+    except NotImplementedError:
+        raise AttributeError("mx.nd has no operator %r" % name) from None
+    w = _make_wrapper(name, op)
+    setattr(sys.modules[__name__], name, w)
+    return w

@@ -1,0 +1,514 @@
+"""NDArray: the imperative tensor.
+
+Parity surface: ``python/mxnet/ndarray/ndarray.py`` (5,071 LoC) over the C++
+NDArray (``include/mxnet/ndarray.h:82``).  TPU-native design: the storage is
+a ``jax.Array`` (XLA buffer).  The reference's engine-Var asynchrony maps to
+JAX async dispatch — every op returns immediately with a future-backed array;
+``wait_to_read`` ≡ ``block_until_ready`` and surfaces deferred errors exactly
+like Engine::WaitForVar rethrows captured exceptions.
+
+Mutation (``a += b``, ``a[i] = x``, optimizer in-place updates) is realized by
+swapping the underlying immutable buffer (``_data``) — the moral equivalent of
+the engine bumping the Var version on a write.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import np_dtype
+from ..context import Context, current_context
+from ..ops import registry as _reg
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "concat", "stack", "waitall", "from_jax", "onehot_encode"]
+
+
+class NDArray:
+    __slots__ = ("_data", "_ctx", "_ag_node", "_ag_out_idx", "_ag_grad",
+                 "_ag_grad_req", "__weakref__")
+
+    def __init__(self, data, ctx: Optional[Context] = None):
+        if isinstance(data, NDArray):
+            data = data._data
+        elif not isinstance(data, jax.Array):
+            data = jnp.asarray(data)
+        if ctx is not None:
+            dev = ctx.jax_device()
+            if dev is not None and getattr(data, "sharding", None) is not None:
+                try:
+                    if data.sharding.device_set != {dev}:
+                        data = jax.device_put(data, dev)
+                except Exception:
+                    data = jax.device_put(data, dev)
+        self._data = data
+        self._ctx = ctx
+        self._ag_node = None
+        self._ag_out_idx = 0
+        self._ag_grad = None
+        self._ag_grad_req = "write"
+
+    # ------------------------------------------------------------------ meta
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self) -> Context:
+        if self._ctx is not None:
+            return self._ctx
+        try:
+            dev = list(self._data.devices())[0]
+            return Context("cpu" if dev.platform == "cpu" else "tpu", dev.id)
+        except Exception:
+            return current_context()
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def grad(self):
+        return self._ag_grad
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def __repr__(self):
+        return "\n%s\n<NDArray %s @%s>" % (
+            np.asarray(self._data), "x".join(str(s) for s in self.shape), self.context)
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("The truth value of an NDArray with multiple elements "
+                             "is ambiguous.")
+        return bool(np.asarray(self._data))
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # ------------------------------------------------------------- transfers
+    def asnumpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def wait_to_read(self):
+        try:
+            self._data.block_until_ready()
+        except AttributeError:
+            pass
+        return self
+
+    def copy(self) -> "NDArray":
+        return NDArray(self._data, self._ctx)
+
+    def copyto(self, other):
+        if isinstance(other, Context):
+            return NDArray(self._data, other)
+        other._data = jnp.asarray(self._data, other.dtype)
+        return other
+
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        return NDArray(self._data, ctx)
+
+    as_in_ctx = as_in_context
+
+    def astype(self, dtype, copy=True) -> "NDArray":
+        dt = np_dtype(dtype)
+        if not copy and self.dtype == dt:
+            return self
+        return NDArray(self._data.astype(dt), self._ctx)
+
+    def asjax(self) -> jax.Array:
+        """TPU-native accessor: the underlying jax.Array (zero-copy)."""
+        return self._data
+
+    def to_dlpack_for_read(self):
+        return jax.dlpack.to_dlpack(self._data)
+
+    to_dlpack_for_write = to_dlpack_for_read
+
+    # ------------------------------------------------------------- autograd
+    def attach_grad(self, grad_req="write", stype=None):
+        from .. import autograd
+
+        g = NDArray(jnp.zeros(self.shape, self.dtype), self._ctx)
+        autograd.mark_variables([self], [g], [grad_req])
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    def detach(self) -> "NDArray":
+        return NDArray(self._data, self._ctx)
+
+    # ------------------------------------------------------------ arithmetic
+    def _binop(self, other, opname, reverse=False):
+        if isinstance(other, (int, float, bool, np.number)):
+            other = NDArray(jnp.asarray(other, self.dtype))
+        lhs, rhs = (other, self) if reverse else (self, other)
+        return _reg.invoke(opname, [lhs, rhs])
+
+    def __add__(self, o):
+        return self._binop(o, "broadcast_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, "broadcast_sub")
+
+    def __rsub__(self, o):
+        return self._binop(o, "broadcast_sub", reverse=True)
+
+    def __mul__(self, o):
+        return self._binop(o, "broadcast_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, "broadcast_div")
+
+    def __rtruediv__(self, o):
+        return self._binop(o, "broadcast_div", reverse=True)
+
+    def __mod__(self, o):
+        return self._binop(o, "broadcast_mod")
+
+    def __pow__(self, o):
+        return self._binop(o, "broadcast_power")
+
+    def __matmul__(self, o):
+        return _reg.invoke("dot", [self, o])
+
+    def __neg__(self):
+        return _reg.invoke("negative", [self])
+
+    def __abs__(self):
+        return _reg.invoke("abs", [self])
+
+    def __eq__(self, o):  # noqa: D105 - mxnet semantics: elementwise
+        if o is None:
+            return False
+        return self._binop(o, "broadcast_equal")
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._binop(o, "broadcast_not_equal")
+
+    def __gt__(self, o):
+        return self._binop(o, "broadcast_greater")
+
+    def __ge__(self, o):
+        return self._binop(o, "broadcast_greater_equal")
+
+    def __lt__(self, o):
+        return self._binop(o, "broadcast_lesser")
+
+    def __le__(self, o):
+        return self._binop(o, "broadcast_lesser_equal")
+
+    def __hash__(self):
+        return id(self)
+
+    # in-place: swap buffer (engine write-Var analog)
+    def __iadd__(self, o):
+        self._data = (self + o)._data
+        return self
+
+    def __isub__(self, o):
+        self._data = (self - o)._data
+        return self
+
+    def __imul__(self, o):
+        self._data = (self * o)._data
+        return self
+
+    def __itruediv__(self, o):
+        self._data = (self / o)._data
+        return self
+
+    # ------------------------------------------------------------- indexing
+    def __getitem__(self, key):
+        if isinstance(key, NDArray):
+            key = key._data.astype(jnp.int32)
+        elif isinstance(key, tuple):
+            key = tuple(k._data.astype(jnp.int32) if isinstance(k, NDArray) else k
+                        for k in key)
+        return NDArray(self._data[key], self._ctx)
+
+    def __setitem__(self, key, value):
+        if isinstance(key, NDArray):
+            key = key._data.astype(jnp.int32)
+        elif isinstance(key, tuple):
+            key = tuple(k._data.astype(jnp.int32) if isinstance(k, NDArray) else k
+                        for k in key)
+        if isinstance(value, NDArray):
+            value = value._data
+        if key is Ellipsis or (isinstance(key, slice) and key == slice(None)):
+            self._data = jnp.broadcast_to(jnp.asarray(value, self.dtype),
+                                          self.shape)
+        else:
+            self._data = self._data.at[key].set(jnp.asarray(value, self.dtype))
+
+    def slice_assign(self, rhs, begin, end, step=None):
+        idx = tuple(slice(b, e, s) for b, e, s in
+                    zip(begin, end, step or (None,) * len(begin)))
+        self._data = self._data.at[idx].set(rhs._data if isinstance(rhs, NDArray) else rhs)
+        return self
+
+    # ------------------------------------------------------------ op methods
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = kwargs.get("shape", shape)
+        return _reg.invoke("Reshape", [self], shape=shape,
+                           reverse=kwargs.get("reverse", False))
+
+    def reshape_like(self, other):
+        return _reg.invoke("Reshape", [self], shape=other.shape)
+
+    def transpose(self, axes=None):
+        return _reg.invoke("transpose", [self], axes=axes)
+
+    def flatten(self):
+        return _reg.invoke("Flatten", [self])
+
+    def expand_dims(self, axis):
+        return _reg.invoke("expand_dims", [self], axis=axis)
+
+    def squeeze(self, axis=None):
+        return _reg.invoke("squeeze", [self], axis=axis)
+
+    def swapaxes(self, dim1, dim2):
+        return _reg.invoke("swapaxes", [self], dim1=dim1, dim2=dim2)
+
+    def broadcast_to(self, shape):
+        return _reg.invoke("broadcast_to", [self], shape=shape)
+
+    def broadcast_like(self, other):
+        return _reg.invoke("broadcast_like", [self, other])
+
+    def slice(self, begin, end, step=None):  # noqa: A003
+        return _reg.invoke("slice", [self], begin=begin, end=end, step=step or ())
+
+    def slice_axis(self, axis, begin, end):
+        return _reg.invoke("slice_axis", [self], axis=axis, begin=begin, end=end)
+
+    def take(self, indices, axis=0, mode="clip"):
+        return _reg.invoke("take", [self, indices])
+
+    def one_hot(self, depth, **kw):
+        return _reg.invoke("one_hot", [self], depth=depth, **kw)
+
+    def tile(self, reps):
+        return _reg.invoke("tile", [self], reps=reps)
+
+    def repeat(self, repeats, axis=None):
+        return _reg.invoke("repeat", [self], repeats=repeats, axis=axis)
+
+    def flip(self, axis):
+        return _reg.invoke("reverse", [self], axis=axis)
+
+    def pad(self, mode="constant", pad_width=(), constant_value=0.0):
+        return _reg.invoke("pad", [self], mode=mode, pad_width=pad_width,
+                           constant_value=constant_value)
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return _reg.invoke("SliceChannel", [self], num_outputs=num_outputs,
+                           axis=axis, squeeze_axis=squeeze_axis)
+
+    def clip(self, a_min, a_max):
+        return _reg.invoke("clip", [self], a_min=a_min, a_max=a_max)
+
+    def abs(self):  # noqa: A003
+        return _reg.invoke("abs", [self])
+
+    def sign(self):
+        return _reg.invoke("sign", [self])
+
+    def sqrt(self):
+        return _reg.invoke("sqrt", [self])
+
+    def square(self):
+        return _reg.invoke("square", [self])
+
+    def exp(self):
+        return _reg.invoke("exp", [self])
+
+    def log(self):
+        return _reg.invoke("log", [self])
+
+    def relu(self):
+        return _reg.invoke("relu", [self])
+
+    def sigmoid(self):
+        return _reg.invoke("sigmoid", [self])
+
+    def tanh(self):
+        return _reg.invoke("tanh", [self])
+
+    def softmax(self, axis=-1):
+        return _reg.invoke("softmax", [self], axis=axis)
+
+    def log_softmax(self, axis=-1):
+        return _reg.invoke("log_softmax", [self], axis=axis)
+
+    def sum(self, axis=None, keepdims=False, exclude=False):  # noqa: A003
+        return _reg.invoke("sum", [self], axis=axis, keepdims=keepdims,
+                           exclude=exclude)
+
+    def mean(self, axis=None, keepdims=False, exclude=False):
+        return _reg.invoke("mean", [self], axis=axis, keepdims=keepdims,
+                           exclude=exclude)
+
+    def prod(self, axis=None, keepdims=False):
+        return _reg.invoke("prod", [self], axis=axis, keepdims=keepdims)
+
+    def max(self, axis=None, keepdims=False):  # noqa: A003
+        return _reg.invoke("max", [self], axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False):  # noqa: A003
+        return _reg.invoke("min", [self], axis=axis, keepdims=keepdims)
+
+    def norm(self, ord=2, axis=None, keepdims=False):  # noqa: A002
+        return _reg.invoke("norm", [self], ord=ord, axis=axis, keepdims=keepdims)
+
+    def argmax(self, axis=None, keepdims=False):
+        return _reg.invoke("argmax", [self], axis=axis, keepdims=keepdims)
+
+    def argmin(self, axis=None, keepdims=False):
+        return _reg.invoke("argmin", [self], axis=axis, keepdims=keepdims)
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return _reg.invoke("argsort", [self], axis=axis, is_ascend=is_ascend)
+
+    def sort(self, axis=-1, is_ascend=True):
+        return _reg.invoke("sort", [self], axis=axis, is_ascend=is_ascend)
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return _reg.invoke("topk", [self], axis=axis, k=k, ret_typ=ret_typ,
+                           is_ascend=is_ascend)
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return _reg.invoke("dot", [self, other], transpose_a=transpose_a,
+                           transpose_b=transpose_b)
+
+    def zeros_like(self):
+        return _reg.invoke("zeros_like", [self])
+
+    def ones_like(self):
+        return _reg.invoke("ones_like", [self])
+
+    def tostype(self, stype):
+        if stype != "default":
+            from ..sparse_nd import cast_storage
+
+            return cast_storage(self, stype)
+        return self
+
+
+# ---------------------------------------------------------------------------
+# factories
+# ---------------------------------------------------------------------------
+
+
+def array(source_array, ctx=None, dtype=None) -> NDArray:
+    if isinstance(source_array, NDArray):
+        data = source_array._data
+    else:
+        data = jnp.asarray(source_array)
+    if dtype is not None:
+        data = data.astype(np_dtype(dtype))
+    elif not isinstance(source_array, (np.ndarray, jax.Array, NDArray)):
+        if data.dtype == jnp.float64:
+            data = data.astype(jnp.float32)
+    return NDArray(data, ctx)
+
+
+def from_jax(x, ctx=None) -> NDArray:
+    return NDArray(x, ctx)
+
+
+def zeros(shape, ctx=None, dtype="float32", **kw) -> NDArray:
+    return NDArray(jnp.zeros(shape if not isinstance(shape, int) else (shape,),
+                             np_dtype(dtype)), ctx)
+
+
+def ones(shape, ctx=None, dtype="float32", **kw) -> NDArray:
+    return NDArray(jnp.ones(shape if not isinstance(shape, int) else (shape,),
+                            np_dtype(dtype)), ctx)
+
+
+def full(shape, val, ctx=None, dtype="float32", **kw) -> NDArray:
+    return NDArray(jnp.full(shape if not isinstance(shape, int) else (shape,), val,
+                            np_dtype(dtype)), ctx)
+
+
+def empty(shape, ctx=None, dtype="float32") -> NDArray:
+    return zeros(shape, ctx, dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32") -> NDArray:
+    out = jnp.arange(start, stop, step, np_dtype(dtype))
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return NDArray(out, ctx)
+
+
+def concat(*args, dim=1):
+    return _reg.invoke("Concat", list(args), dim=dim)
+
+
+def stack(*args, axis=0):
+    return _reg.invoke("stack", list(args), axis=axis)
+
+
+def onehot_encode(indices, out):
+    depth = out.shape[1]
+    res = _reg.invoke("one_hot", [indices], depth=depth)
+    out._data = res._data
+    return out
+
+
+def waitall():
+    from .. import engine
+
+    engine.waitall()

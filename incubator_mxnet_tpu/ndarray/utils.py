@@ -1,0 +1,64 @@
+"""NDArray container save/load.
+
+Parity: ``NDArray::Save/Load`` (``src/ndarray/ndarray.cc:1596,1719``) and
+``mx.nd.save/load`` — a file holding a list of arrays or a dict of named
+arrays.  Format here is a single ``.npz``-style zip with a manifest entry
+(`__mx_tpu_format__`) recording list-vs-dict; readable with plain numpy.
+"""
+from __future__ import annotations
+
+import json
+import zipfile
+from typing import Dict, List, Union
+
+import numpy as np
+
+from .ndarray import NDArray, array
+
+__all__ = ["save", "load", "load_frombuffer"]
+
+_FORMAT_KEY = "__mx_tpu_format__"
+
+
+def save(fname: str, data) -> None:
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        manifest = {"kind": "dict", "names": list(data.keys())}
+        arrays = {("v%d" % i): v.asnumpy() for i, (k, v) in enumerate(data.items())}
+    elif isinstance(data, (list, tuple)):
+        manifest = {"kind": "list", "names": None}
+        arrays = {("v%d" % i): v.asnumpy() for i, v in enumerate(data)}
+    else:
+        raise ValueError("data must be NDArray, list of NDArrays, or dict")
+    arrays[_FORMAT_KEY] = np.frombuffer(json.dumps(manifest).encode(), dtype=np.uint8)
+    np.savez(fname if fname.endswith(".npz") else fname, **arrays)
+    # np.savez appends .npz; rename back for exact-name parity
+    import os
+
+    if not fname.endswith(".npz") and os.path.exists(fname + ".npz"):
+        os.replace(fname + ".npz", fname)
+
+
+def load(fname: str) -> Union[List[NDArray], Dict[str, NDArray]]:
+    with np.load(fname, allow_pickle=False) as z:
+        files = dict(z)
+    manifest = json.loads(bytes(files.pop(_FORMAT_KEY)).decode())
+    n = len(files)
+    vals = [array(files["v%d" % i]) for i in range(n)]
+    if manifest["kind"] == "dict":
+        return dict(zip(manifest["names"], vals))
+    return vals
+
+
+def load_frombuffer(buf: bytes):
+    import io
+
+    bio = io.BytesIO(buf)
+    with np.load(bio, allow_pickle=False) as z:
+        files = dict(z)
+    manifest = json.loads(bytes(files.pop(_FORMAT_KEY)).decode())
+    vals = [array(files["v%d" % i]) for i in range(len(files))]
+    if manifest["kind"] == "dict":
+        return dict(zip(manifest["names"], vals))
+    return vals

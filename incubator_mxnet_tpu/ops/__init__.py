@@ -1,0 +1,17 @@
+"""Operator library (FCompute<tpu> registry).
+
+Importing this package registers all built-in ops; see SURVEY.md §2.4 for the
+reference inventory being covered.
+"""
+from . import registry
+from .registry import Op, get_op, invoke, invoke_raw, list_ops, register
+
+# register built-in operator families
+from . import math  # noqa: F401  (elemwise/broadcast/reduce/linalg)
+from . import tensor  # noqa: F401  (shape/index/init/sequence)
+from . import nn  # noqa: F401  (conv/pool/norm/dense/dropout)
+from . import random_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+
+__all__ = ["registry", "Op", "get_op", "invoke", "invoke_raw", "list_ops",
+           "register"]

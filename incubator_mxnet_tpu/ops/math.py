@@ -1,0 +1,394 @@
+"""Elementwise / broadcast / reduction / linalg operators.
+
+Parity: ``src/operator/tensor/elemwise_*`` , ``broadcast_reduce_op*``,
+``dot-inl.h``, ``la_op``.  Every op is one pure jnp/lax function — XLA fuses
+elementwise chains automatically (the reference needed a runtime NVRTC fusion
+pass, ``src/executor/pointwise_fusion_pass.cc``, for the same effect).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# binary broadcast + elemwise (reference: elemwise_binary_broadcast_op_basic.cc)
+# ---------------------------------------------------------------------------
+
+_BINARY = {
+    "broadcast_add": jnp.add,
+    "broadcast_sub": jnp.subtract,
+    "broadcast_mul": jnp.multiply,
+    "broadcast_div": jnp.divide,
+    "broadcast_mod": jnp.mod,
+    "broadcast_power": jnp.power,
+    "broadcast_maximum": jnp.maximum,
+    "broadcast_minimum": jnp.minimum,
+    "broadcast_hypot": jnp.hypot,
+}
+_BINARY_ALIASES = {
+    "broadcast_add": ("elemwise_add", "_add", "_plus", "_Plus"),
+    "broadcast_sub": ("elemwise_sub", "_sub", "_minus", "_Minus"),
+    "broadcast_mul": ("elemwise_mul", "_mul", "_Mul"),
+    "broadcast_div": ("elemwise_div", "_div", "_Div"),
+    "broadcast_power": ("_power", "_Power", "pow"),
+    "broadcast_mod": ("_mod",),
+    "broadcast_maximum": ("_maximum",),
+    "broadcast_minimum": ("_minimum",),
+}
+
+for _name, _f in _BINARY.items():
+    register(_name, (lambda f: lambda lhs, rhs: f(lhs, rhs))(_f),
+             num_inputs=2, aliases=_BINARY_ALIASES.get(_name, ()))
+
+_CMP = {
+    "broadcast_equal": jnp.equal,
+    "broadcast_not_equal": jnp.not_equal,
+    "broadcast_greater": jnp.greater,
+    "broadcast_greater_equal": jnp.greater_equal,
+    "broadcast_lesser": jnp.less,
+    "broadcast_lesser_equal": jnp.less_equal,
+    "broadcast_logical_and": jnp.logical_and,
+    "broadcast_logical_or": jnp.logical_or,
+    "broadcast_logical_xor": jnp.logical_xor,
+}
+for _name, _f in _CMP.items():
+    # comparisons output same-dtype-as-input in mxnet (0/1 floats)
+    register(
+        _name,
+        (lambda f: lambda lhs, rhs: f(lhs, rhs).astype(jnp.result_type(lhs, rhs)))(_f),
+        num_inputs=2,
+        differentiable=False,
+        aliases=(_name.replace("broadcast_", "_"),),
+    )
+
+
+@register("_scatter_elemwise_div", num_inputs=2)
+def _scatter_div(lhs, rhs):
+    return lhs / rhs
+
+
+# scalar ops (reference: elemwise_binary_scalar_op*.cc)
+def _scalar_op(name, fn, reverse_fn=None, differentiable=True, aliases=()):
+    register(name, (lambda f: lambda data, scalar=1.0: f(data, scalar))(fn),
+             num_inputs=1, differentiable=differentiable, aliases=aliases)
+    if reverse_fn is not None:
+        register("_r" + name.lstrip("_"),
+                 (lambda f: lambda data, scalar=1.0: f(data, scalar))(reverse_fn),
+                 num_inputs=1, differentiable=differentiable)
+
+
+_scalar_op("_plus_scalar", lambda x, s: x + s, aliases=("_PlusScalar",))
+_scalar_op("_minus_scalar", lambda x, s: x - s, lambda x, s: s - x, aliases=("_MinusScalar",))
+_scalar_op("_mul_scalar", lambda x, s: x * s, aliases=("_MulScalar",))
+_scalar_op("_div_scalar", lambda x, s: x / s, lambda x, s: s / x, aliases=("_DivScalar",))
+_scalar_op("_power_scalar", lambda x, s: jnp.power(x, s), lambda x, s: jnp.power(s, x))
+_scalar_op("_mod_scalar", lambda x, s: jnp.mod(x, s), lambda x, s: jnp.mod(s, x))
+_scalar_op("_maximum_scalar", jnp.maximum)
+_scalar_op("_minimum_scalar", jnp.minimum)
+_scalar_op("_equal_scalar", lambda x, s: (x == s).astype(x.dtype), differentiable=False)
+_scalar_op("_not_equal_scalar", lambda x, s: (x != s).astype(x.dtype), differentiable=False)
+_scalar_op("_greater_scalar", lambda x, s: (x > s).astype(x.dtype), differentiable=False)
+_scalar_op("_greater_equal_scalar", lambda x, s: (x >= s).astype(x.dtype), differentiable=False)
+_scalar_op("_lesser_scalar", lambda x, s: (x < s).astype(x.dtype), differentiable=False)
+_scalar_op("_lesser_equal_scalar", lambda x, s: (x <= s).astype(x.dtype), differentiable=False)
+
+
+# ---------------------------------------------------------------------------
+# unary (reference: elemwise_unary_op_basic.cc, _trig.cc, _logexp.cc, _pow.cc)
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "hard_sigmoid": lambda x, alpha=0.2, beta=0.5: jnp.clip(alpha * x + beta, 0, 1),
+    "softsign": jax.nn.soft_sign,
+    "tanh": jnp.tanh,
+    "exp": jnp.exp,
+    "expm1": jnp.expm1,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lax.rsqrt,
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "square": jnp.square,
+    "reciprocal": lambda x: 1.0 / x,
+    "negative": jnp.negative,
+    "abs": jnp.abs,
+    "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "identity": lambda x: x,
+    "logical_not": lambda x: jnp.logical_not(x).astype(x.dtype),
+}
+_UNARY_ALIASES = {
+    "identity": ("_copy",),
+    "abs": ("_abs",),
+    "negative": ("_neg",),
+}
+for _name, _f in _UNARY.items():
+    register(_name, (lambda f: lambda data, **kw: f(data, **kw))(_f),
+             num_inputs=1, aliases=_UNARY_ALIASES.get(_name, ()))
+
+_UNARY_NONDIFF = {
+    "sign": jnp.sign,
+    "round": jnp.round,
+    "rint": jnp.rint,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "trunc": jnp.trunc,
+    "fix": jnp.trunc,
+}
+for _name, _f in _UNARY_NONDIFF.items():
+    register(_name, (lambda f: lambda data: f(data))(_f), num_inputs=1,
+             differentiable=False)
+
+
+@register("clip", num_inputs=1)
+def _clip(data, a_min=None, a_max=None):
+    return jnp.clip(data, a_min, a_max)
+
+
+@register("BlockGrad", num_inputs=1, aliases=("stop_gradient",))
+def _block_grad(data):
+    return lax.stop_gradient(data)
+
+
+@register("make_loss", num_inputs=1, aliases=("MakeLoss",))
+def _make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    return data
+
+
+@register("smooth_l1", num_inputs=1)
+def _smooth_l1(data, scalar=1.0):
+    s2 = scalar * scalar
+    a = jnp.abs(data)
+    return jnp.where(a < 1.0 / s2, 0.5 * s2 * data * data, a - 0.5 / s2)
+
+
+@register("gelu", num_inputs=1)
+def _gelu(data, approximate=False):
+    return jax.nn.gelu(data, approximate=bool(approximate))
+
+
+@register("add_n", aliases=("ElementWiseSum", "_sum"))
+def _add_n(*args):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+# ---------------------------------------------------------------------------
+# reductions (reference: broadcast_reduce_op_value.cc)
+# ---------------------------------------------------------------------------
+
+
+def _norm_axis(axis, ndim, exclude=False):
+    if axis is None:
+        return None
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a % ndim for a in axis)
+    if exclude:
+        axis = tuple(a for a in range(ndim) if a not in axis)
+    return axis
+
+
+def _reduce(fn):
+    def impl(data, axis=None, keepdims=False, exclude=False):
+        ax = _norm_axis(axis, data.ndim, exclude)
+        return fn(data, axis=ax, keepdims=bool(keepdims))
+
+    return impl
+
+
+register("sum", _reduce(jnp.sum), num_inputs=1, aliases=("sum_axis",))
+register("mean", _reduce(jnp.mean), num_inputs=1)
+register("prod", _reduce(jnp.prod), num_inputs=1)
+register("nansum", _reduce(jnp.nansum), num_inputs=1)
+register("nanprod", _reduce(jnp.nanprod), num_inputs=1)
+register("max", _reduce(jnp.max), num_inputs=1, aliases=("max_axis",))
+register("min", _reduce(jnp.min), num_inputs=1, aliases=("min_axis",))
+
+
+@register("norm", num_inputs=1)
+def _norm(data, ord=2, axis=None, keepdims=False):  # noqa: A002
+    ax = _norm_axis(axis, data.ndim)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=ax, keepdims=bool(keepdims))
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=bool(keepdims)))
+
+
+@register("argmax", num_inputs=1, differentiable=False)
+def _argmax(data, axis=None, keepdims=False):
+    out = jnp.argmax(data, axis=axis, keepdims=bool(keepdims))
+    return out.astype(jnp.float32)  # mxnet returns float indices
+
+
+@register("argmin", num_inputs=1, differentiable=False)
+def _argmin(data, axis=None, keepdims=False):
+    return jnp.argmin(data, axis=axis, keepdims=bool(keepdims)).astype(jnp.float32)
+
+
+@register("argmax_channel", num_inputs=1, differentiable=False)
+def _argmax_channel(data):
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# sorting / topk (reference: src/operator/tensor/ordering_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("sort", num_inputs=1)
+def _sort(data, axis=-1, is_ascend=True):
+    out = jnp.sort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register("argsort", num_inputs=1, differentiable=False)
+def _argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    idx = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(dtype)
+
+
+@register("topk", num_inputs=1, differentiable=False)
+def _topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    axis = axis if axis is not None else -1
+    moved = jnp.moveaxis(data, axis, -1)  # lax.top_k works on the last axis
+    if is_ascend:
+        vals, idx = lax.top_k(-moved, k)
+        vals = -vals
+    else:
+        vals, idx = lax.top_k(moved, k)
+    if ret_typ == "mask":
+        onehot = jax.nn.one_hot(idx, moved.shape[-1], dtype=data.dtype)
+        mask = onehot.sum(axis=-2)
+        return jnp.moveaxis(mask, -1, axis)
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis).astype(dtype)
+    if ret_typ == "indices":
+        return idx
+    if ret_typ == "value":
+        return vals
+    return vals, idx  # 'both'
+
+
+# ---------------------------------------------------------------------------
+# dot / batch_dot / linalg (reference: dot-inl.h, la_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("dot", num_inputs=2)
+def _dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    a = lhs.T if transpose_a else lhs
+    b = rhs.T if transpose_b else rhs
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    # mxnet dot contracts last axis of a with first axis of b
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot", num_inputs=2)
+def _batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+@register("khatri_rao")
+def _khatri_rao(*mats):
+    out = mats[0]
+    for m in mats[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, out.shape[-1])
+    return out
+
+
+# linalg family (subset used by la_op tests; all bottom out in XLA's
+# native decompositions rather than LAPACK bindings)
+register("_linalg_gemm2", lambda a, b, transpose_a=False, transpose_b=False,
+         alpha=1.0: alpha * jnp.matmul(
+             jnp.swapaxes(a, -1, -2) if transpose_a else a,
+             jnp.swapaxes(b, -1, -2) if transpose_b else b), num_inputs=2,
+         aliases=("linalg_gemm2",))
+register("_linalg_potrf", lambda a: jnp.linalg.cholesky(a), num_inputs=1,
+         aliases=("linalg_potrf",))
+register("_linalg_trmm", lambda a, b, transpose=False, rightside=False, alpha=1.0:
+         alpha * (jnp.matmul(b, jnp.swapaxes(a, -1, -2) if transpose else a)
+                  if rightside else
+                  jnp.matmul(jnp.swapaxes(a, -1, -2) if transpose else a, b)),
+         num_inputs=2, aliases=("linalg_trmm",))
+register("_linalg_syrk", lambda a, transpose=False, alpha=1.0:
+         alpha * (jnp.matmul(jnp.swapaxes(a, -1, -2), a) if transpose
+                  else jnp.matmul(a, jnp.swapaxes(a, -1, -2))),
+         num_inputs=1, aliases=("linalg_syrk",))
+register("_linalg_sumlogdiag", lambda a: jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)), axis=-1),
+         num_inputs=1, aliases=("linalg_sumlogdiag",))
+register("_linalg_extractdiag", lambda a, offset=0: jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1),
+         num_inputs=1, aliases=("linalg_extractdiag",))
+register("_linalg_inverse", lambda a: jnp.linalg.inv(a), num_inputs=1,
+         aliases=("linalg_inverse",))
+register("_linalg_det", lambda a: jnp.linalg.det(a), num_inputs=1, aliases=("linalg_det",))
+register("_linalg_slogdet", lambda a: jnp.linalg.slogdet(a), num_outputs=2,
+         num_inputs=1, aliases=("linalg_slogdet",))
+
+
+@register("log_softmax", num_inputs=1)
+def _log_softmax(data, axis=-1, temperature=None):
+    if temperature is not None and temperature != 1.0:
+        data = data / temperature
+    return jax.nn.log_softmax(data, axis=axis)
+
+
+@register("softmax", num_inputs=1)
+def _softmax(data, axis=-1, temperature=None, length=None):
+    if temperature is not None and temperature != 1.0:
+        data = data / temperature
+    return jax.nn.softmax(data, axis=axis)
+
+
+@register("softmin", num_inputs=1)
+def _softmin(data, axis=-1, temperature=None):
+    if temperature is not None and temperature != 1.0:
+        data = data / temperature
+    return jax.nn.softmax(-data, axis=axis)
+
+
+@register("cumsum", num_inputs=1)
+def _cumsum(a, axis=None, dtype=None):
+    if axis is None:
+        a = a.reshape(-1)
+        axis = 0
+    out = jnp.cumsum(a, axis=axis)
+    return out.astype(dtype) if dtype is not None else out
+
+
+@register("diag", num_inputs=1)
+def _diag(data, k=0, axis1=0, axis2=1):
+    if data.ndim == 1:
+        return jnp.diag(data, k=k)
+    return jnp.diagonal(data, offset=k, axis1=axis1, axis2=axis2)

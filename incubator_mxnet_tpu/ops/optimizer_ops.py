@@ -1,0 +1,234 @@
+"""Optimizer update operators.
+
+Parity: ``src/operator/optimizer_op.cc`` (sgd/sgd_mom/adam/rmsprop/ftrl/
+signsgd/signum/nag/ftml/lamb/adagrad + mp_* master-weight and multi_* fused
+variants) and ``contrib/adamw.cc``.  Each update is a pure function returning
+the new weight (and new states); the Updater/Trainer commits them in place.
+On TPU the multi-tensor variants just vmap/loop inside one jit — XLA fuses
+them into a single fused update program, which is what the hand-written
+multi_sgd CUDA kernels were for.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _apply_wd(weight, grad, wd, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight
+
+
+@register("sgd_update", num_inputs=2, differentiable=False, mutate_idx=(0,))
+def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, lazy_update=True):
+    g = _apply_wd(weight, grad, wd, rescale_grad, clip_gradient)
+    return weight - lr * g
+
+
+@register("sgd_mom_update", num_inputs=3, differentiable=False, mutate_idx=(0, 2))
+def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _apply_wd(weight, grad, wd, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * g
+    return weight + new_mom, new_mom
+
+
+@register("mp_sgd_update", num_inputs=3, differentiable=False, mutate_idx=(0, 2))
+def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, lazy_update=True):
+    g = _apply_wd(weight32, grad.astype(jnp.float32), wd, rescale_grad, clip_gradient)
+    w32 = weight32 - lr * g
+    return w32.astype(weight.dtype), w32
+
+
+@register("mp_sgd_mom_update", num_inputs=4, differentiable=False, mutate_idx=(0, 2, 3))
+def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _apply_wd(weight32, grad.astype(jnp.float32), wd, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * g
+    w32 = weight32 + new_mom
+    return w32.astype(weight.dtype), new_mom, w32
+
+
+@register("nag_mom_update", num_inputs=3, differentiable=False, mutate_idx=(0, 2))
+def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd(weight, grad, wd, rescale_grad, clip_gradient)
+    new_mom = momentum * mom + g
+    return weight - lr * (g + momentum * new_mom), new_mom
+
+
+@register("signsgd_update", num_inputs=2, differentiable=False, mutate_idx=(0,))
+def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0):
+    g = _apply_wd(weight, grad, wd, rescale_grad, clip_gradient)
+    return weight - lr * jnp.sign(g)
+
+
+@register("signum_update", num_inputs=3, differentiable=False, mutate_idx=(0, 2))
+def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mom = momentum * mom - (1 - momentum) * (g + wd * weight)
+    w = weight * (1 - lr * wd_lh) + lr * jnp.sign(new_mom)
+    return w, new_mom
+
+
+@register("adam_update", num_inputs=4, differentiable=False, mutate_idx=(0, 2, 3))
+def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                 lazy_update=True):
+    g = _apply_wd(weight, grad, wd, rescale_grad, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return w, new_mean, new_var
+
+
+@register("ftml_update", num_inputs=5, differentiable=False, mutate_idx=(0, 2, 3, 4))
+def _ftml_update(weight, grad, d, v, z, lr=0.1, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0, clip_grad=-1.0):
+    g = grad * rescale_grad + wd * weight
+    if clip_grad is not None and clip_grad >= 0:
+        g = jnp.clip(g, -clip_grad, clip_grad)
+    new_v = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_t = (1 - beta1 ** t) / lr * (jnp.sqrt(new_v / (1 - beta2 ** t)) + epsilon)
+    sigma = d_t - beta1 * d
+    new_z = beta1 * z + (1 - beta1) * g - sigma * weight
+    w = -new_z / d_t
+    return w, d_t, new_v, new_z
+
+
+@register("rmsprop_update", num_inputs=3, differentiable=False, mutate_idx=(0, 2))
+def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                    clip_weights=-1.0):
+    g = _apply_wd(weight, grad, wd, rescale_grad, clip_gradient)
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    w = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, new_n
+
+
+@register("rmspropalex_update", num_inputs=5, differentiable=False,
+          mutate_idx=(0, 2, 3, 4))
+def _rmspropalex_update(weight, grad, n, g_state, delta, lr=0.001, gamma1=0.95,
+                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                        clip_gradient=-1.0, clip_weights=-1.0):
+    g = _apply_wd(weight, grad, wd, rescale_grad, clip_gradient)
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    new_g = (1 - gamma1) * g + gamma1 * g_state
+    new_delta = gamma2 * delta - lr * g / jnp.sqrt(new_n - jnp.square(new_g) + epsilon)
+    w = weight + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, new_n, new_g, new_delta
+
+
+@register("ftrl_update", num_inputs=4, differentiable=False, mutate_idx=(0, 2, 3))
+def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    w = jnp.where(
+        jnp.abs(new_z) > lamda1,
+        -(new_z - jnp.sign(new_z) * lamda1) / ((beta + jnp.sqrt(new_n)) / lr + wd),
+        0.0,
+    ).astype(weight.dtype)
+    return w, new_z, new_n
+
+
+@register("_sparse_adagrad_update", num_inputs=3, differentiable=False,
+          mutate_idx=(0, 2), aliases=("adagrad_update",))
+def _adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd(weight, grad, wd, rescale_grad, clip_gradient)
+    new_h = history + jnp.square(g)
+    return weight - lr * g / (jnp.sqrt(new_h) + epsilon), new_h
+
+
+@register("lamb_update_phase1", num_inputs=4, differentiable=False)
+def _lamb_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999, epsilon=1e-6,
+                 t=1, bias_correction=True, wd=0.0, rescale_grad=1.0,
+                 clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    if bias_correction:
+        mhat = new_mean / (1 - beta1 ** t)
+        vhat = new_var / (1 - beta2 ** t)
+    else:
+        mhat, vhat = new_mean, new_var
+    gw = mhat / (jnp.sqrt(vhat) + epsilon) + wd * weight
+    return gw, new_mean, new_var
+
+
+@register("lamb_update_phase2", num_inputs=3, differentiable=False)
+def _lamb_phase2(weight, g, r1_r2=None, lr=0.01, lower_bound=-1.0, upper_bound=-1.0):
+    r1 = jnp.linalg.norm(weight.reshape(-1))
+    r2 = jnp.linalg.norm(g.reshape(-1))
+    if lower_bound is not None and lower_bound >= 0:
+        r1 = jnp.maximum(r1, lower_bound)
+    if upper_bound is not None and upper_bound >= 0:
+        r1 = jnp.minimum(r1, upper_bound)
+    ratio = jnp.where((r1 > 0) & (r2 > 0), r1 / r2, 1.0)
+    return weight - lr * ratio * g
+
+
+@register("_adamw_update", num_inputs=5, differentiable=False, aliases=("adamw_update",))
+def _adamw_update(weight, grad, mean, var, rescale_grad_arr, lr=0.001, beta1=0.9,
+                  beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad_arr
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - eta * (lr * new_mean / (jnp.sqrt(new_var) + epsilon) + wd * weight)
+    return w, new_mean, new_var
+
+
+@register("all_finite", differentiable=False)
+def _all_finite(*arrays, init_output=True):
+    ok = jnp.array(True)
+    for a in arrays:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(a.astype(jnp.float32))))
+    return ok.reshape(1).astype(jnp.float32)
+
+
+@register("multi_all_finite", differentiable=False)
+def _multi_all_finite(*arrays, num_arrays=1, init_output=True):
+    return _all_finite(*arrays)
+
+
+# multi-tensor fused updates: XLA fuses the python loop into one program
+def _multi(update_fn, n_per):
+    def impl(*arrays, lrs=(), wds=(), num_weights=None, **kw):
+        num = int(num_weights if num_weights is not None else len(arrays) // n_per)
+        outs = []
+        for i in range(num):
+            group = arrays[i * n_per:(i + 1) * n_per]
+            res = update_fn(*group, lr=lrs[i], wd=wds[i], **kw)
+            outs.extend(res if isinstance(res, tuple) else (res,))
+        return tuple(outs) if len(outs) > 1 else outs[0]
+
+    return impl
+
+
+register("multi_sgd_update", _multi(_sgd_update, 2), differentiable=False)
+register("multi_sgd_mom_update", _multi(_sgd_mom_update, 3), differentiable=False)
+register("multi_mp_sgd_update", _multi(_mp_sgd_update, 3), differentiable=False)
+register("multi_mp_sgd_mom_update", _multi(_mp_sgd_mom_update, 4), differentiable=False)

@@ -1,0 +1,135 @@
+"""Random sampling operators.
+
+Parity: ``src/operator/random/sample_op.cc`` (uniform/normal/gamma/
+exponential/poisson/negative_binomial/generalized_negative_binomial/randint),
+multisample, shuffle.  Stateful-generator semantics come from :mod:`..rng`
+(keys threaded automatically by the registry's ``needs_rng``), matching the
+reference's per-device philox resource streams.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def _dt(dtype):
+    from ..base import np_dtype
+
+    return np_dtype(dtype if dtype not in (None, "None") else "float32")
+
+
+@register("_random_uniform", num_inputs=0, needs_rng=True, differentiable=False,
+          aliases=("uniform", "random_uniform"))
+def _uniform(low=0.0, high=1.0, shape=None, ctx=None, dtype=None, key=None):
+    return jax.random.uniform(key, _shape(shape), _dt(dtype), low, high)
+
+
+@register("_random_normal", num_inputs=0, needs_rng=True, differentiable=False,
+          aliases=("normal", "random_normal"))
+def _normal(loc=0.0, scale=1.0, shape=None, ctx=None, dtype=None, key=None):
+    return loc + scale * jax.random.normal(key, _shape(shape), _dt(dtype))
+
+
+@register("_random_gamma", num_inputs=0, needs_rng=True, differentiable=False,
+          aliases=("random_gamma",))
+def _gamma(alpha=1.0, beta=1.0, shape=None, ctx=None, dtype=None, key=None):
+    return jax.random.gamma(key, alpha, _shape(shape), _dt(dtype)) * beta
+
+
+@register("_random_exponential", num_inputs=0, needs_rng=True, differentiable=False,
+          aliases=("random_exponential",))
+def _exponential(lam=1.0, shape=None, ctx=None, dtype=None, key=None):
+    return jax.random.exponential(key, _shape(shape), _dt(dtype)) / lam
+
+
+@register("_random_poisson", num_inputs=0, needs_rng=True, differentiable=False,
+          aliases=("random_poisson",))
+def _poisson(lam=1.0, shape=None, ctx=None, dtype=None, key=None):
+    return jax.random.poisson(key, lam, _shape(shape)).astype(_dt(dtype))
+
+
+@register("_random_negative_binomial", num_inputs=0, needs_rng=True,
+          differentiable=False, aliases=("random_negative_binomial",))
+def _neg_binomial(k=1, p=1.0, shape=None, ctx=None, dtype=None, key=None):
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, k, _shape(shape)) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, _shape(shape)).astype(_dt(dtype))
+
+
+@register("_random_generalized_negative_binomial", num_inputs=0, needs_rng=True,
+          differentiable=False, aliases=("random_generalized_negative_binomial",))
+def _gen_neg_binomial(mu=1.0, alpha=1.0, shape=None, ctx=None, dtype=None, key=None):
+    k1, k2 = jax.random.split(key)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, _shape(shape)) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, _shape(shape)).astype(_dt(dtype))
+
+
+@register("_random_randint", num_inputs=0, needs_rng=True, differentiable=False,
+          aliases=("random_randint", "randint"))
+def _randint(low=0, high=1, shape=None, ctx=None, dtype="int32", key=None):
+    return jax.random.randint(key, _shape(shape), int(low), int(high),
+                              _dt(dtype or "int32"))
+
+
+@register("_sample_multinomial", num_inputs=1, needs_rng=True, differentiable=False,
+          aliases=("sample_multinomial", "multinomial"))
+def _multinomial(data, shape=None, get_prob=False, dtype="int32", key=None):
+    n = 1 if shape is None else int(jnp.prod(jnp.array(_shape(shape))) or 1)
+    logits = jnp.log(jnp.maximum(data, 1e-38))
+    if data.ndim == 1:
+        out = jax.random.categorical(key, logits, shape=(n,))
+        out = out.reshape(_shape(shape)) if shape else out[0]
+    else:
+        out = jax.random.categorical(key, logits[:, None, :].repeat(n, axis=1), axis=-1)
+        out = out.reshape((data.shape[0],) + _shape(shape)) if shape else out[:, 0]
+    out = out.astype(_dt(dtype))
+    if get_prob:
+        prob = jnp.take_along_axis(
+            jnp.log(jnp.maximum(data, 1e-38)).reshape(-1, data.shape[-1]),
+            out.reshape(-1, 1).astype(jnp.int32), axis=-1).reshape(out.shape)
+        return out, prob
+    return out
+
+
+# per-element distributions (sample_*: parameters given as arrays)
+@register("_sample_uniform", num_inputs=2, needs_rng=True, differentiable=False,
+          aliases=("sample_uniform",))
+def _sample_uniform(low, high, shape=None, dtype=None, key=None):
+    s = _shape(shape)
+    out_shape = low.shape + s
+    u = jax.random.uniform(key, out_shape, _dt(dtype))
+    return low.reshape(low.shape + (1,) * len(s)) + u * (high - low).reshape(
+        low.shape + (1,) * len(s))
+
+
+@register("_sample_normal", num_inputs=2, needs_rng=True, differentiable=False,
+          aliases=("sample_normal",))
+def _sample_normal(mu, sigma, shape=None, dtype=None, key=None):
+    s = _shape(shape)
+    out_shape = mu.shape + s
+    z = jax.random.normal(key, out_shape, _dt(dtype))
+    return mu.reshape(mu.shape + (1,) * len(s)) + z * sigma.reshape(
+        sigma.shape + (1,) * len(s))
+
+
+@register("_shuffle", num_inputs=1, needs_rng=True, differentiable=False,
+          aliases=("shuffle",))
+def _shuffle_op(data, key=None):
+    return jax.random.permutation(key, data, axis=0)
+
+
+@register("bernoulli", num_inputs=0, needs_rng=True, differentiable=False)
+def _bernoulli(prob=0.5, shape=None, dtype="float32", key=None):
+    return jax.random.bernoulli(key, prob, _shape(shape)).astype(_dt(dtype))

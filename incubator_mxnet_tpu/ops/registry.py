@@ -1,0 +1,170 @@
+"""Operator registry.
+
+Reference model: ``NNVM_REGISTER_OP`` + typed attributes (FCompute<cpu/gpu>,
+FInferShape, FGradient, ... — see ``include/mxnet/op_attr_types.h:217-315``
+and SURVEY.md Appendix A).  TPU-native model: every op registers ONE
+implementation — a pure JAX function (``fn``) that XLA compiles for TPU *and*
+CPU — and gradients come from ``jax.vjp`` at record time instead of a
+registered FGradient pass.  Shape/dtype inference is ``jax.eval_shape`` over
+the same fn, so there is no separate inference code to keep in sync.
+
+The registry drives three frontends:
+- ``mx.nd.*``    eager execution (+ autograd tape)       [Imperative::Invoke]
+- ``mx.sym.*``   graph node creation                      [nnvm::Symbol]
+- direct raw-array calls inside traced programs           [FCompute<tpu>]
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Op", "register", "get_op", "list_ops", "invoke", "invoke_raw", "OPS"]
+
+OPS: Dict[str, "Op"] = {}
+
+
+class Op:
+    """A registered operator.
+
+    Attributes
+    ----------
+    fn : callable(*arrays, **attrs) -> array or tuple of arrays
+        Pure JAX implementation (the FCompute<tpu> equivalent).
+    num_inputs : int or None (variadic)
+    num_outputs : int
+    differentiable : bool — False skips tape recording (e.g. argmax, shape ops
+        with int outputs).
+    needs_rng : bool — fn takes a ``key`` kwarg supplied from the stateful
+        PRNG (eager) or trace key (compiled); mirrors ResourceRequest::kRandom.
+    mutate_idx : tuple — indices of inputs the reference op mutates
+        (FMutateInputs); kept as metadata for executor aliasing/donation.
+    """
+
+    def __init__(self, name, fn, num_inputs=None, num_outputs=1,
+                 differentiable=True, needs_rng=False, mutate_idx=(),
+                 aliases=(), doc=""):
+        self.name = name
+        self.fn = fn
+        self.num_inputs = num_inputs
+        self.num_outputs = num_outputs
+        self.differentiable = differentiable
+        self.needs_rng = needs_rng
+        self.mutate_idx = tuple(mutate_idx)
+        self.aliases = tuple(aliases)
+        self.doc = doc or (fn.__doc__ or "")
+
+    def __repr__(self):
+        return "Op(%s)" % self.name
+
+    # -- inference ---------------------------------------------------------
+    def infer(self, in_avals: Sequence[jax.ShapeDtypeStruct], **attrs):
+        """Infer output shapes/dtypes via abstract evaluation."""
+        out = jax.eval_shape(functools.partial(self.fn, **attrs), *in_avals)
+        return out if isinstance(out, (tuple, list)) else (out,)
+
+
+def register(name, fn=None, **kwargs):
+    """Register an op (decorator or direct). ``aliases`` adds extra names."""
+    def _do(f):
+        op = Op(name, f, **kwargs)
+        OPS[name] = op
+        for a in op.aliases:
+            OPS[a] = op
+        return f
+
+    if fn is not None:
+        return _do(fn)
+    return _do
+
+
+def get_op(name: str) -> Op:
+    try:
+        return OPS[name]
+    except KeyError:
+        raise NotImplementedError(
+            "operator %r is not registered in this framework (reference parity "
+            "gap — see SURVEY.md §2.4)" % name
+        ) from None
+
+
+def list_ops() -> List[str]:
+    return sorted(OPS.keys())
+
+
+# ---------------------------------------------------------------------------
+# Invocation
+# ---------------------------------------------------------------------------
+
+
+def invoke_raw(op: Op, arrays: Sequence[Any], **attrs):
+    """Run op.fn on raw jax arrays (trace-safe path)."""
+    if op.needs_rng and "key" not in attrs:
+        from .. import rng
+
+        attrs["key"] = rng.next_key()
+    return op.fn(*arrays, **attrs)
+
+
+def invoke(name: str, inputs: Sequence[Any], out=None, **attrs):
+    """Imperative invoke on NDArrays, with autograd recording.
+
+    Mirrors Imperative::Invoke (``src/imperative/imperative.cc:89``): infer +
+    execute + (if recording) tape.  Returns NDArray or list of NDArrays.
+    """
+    from .. import autograd
+    from ..ndarray import NDArray
+
+    op = OPS[name] if name in OPS else get_op(name)
+    datas = [
+        None if i is None else (i._data if isinstance(i, NDArray) else jnp.asarray(i))
+        for i in inputs
+    ]
+
+    if op.needs_rng:
+        from .. import rng
+
+        attrs.setdefault("key", rng.next_key())
+
+    recording = (
+        autograd.is_recording()
+        and op.differentiable
+        and any(autograd.requires_grad(i) for i in inputs if isinstance(i, NDArray))
+    )
+    if recording:
+        # differentiate only wrt non-None tensor inputs
+        live = [j for j, d in enumerate(datas) if d is not None]
+
+        def fn(*xs, _datas=tuple(datas), _live=tuple(live)):
+            full = list(_datas)
+            for j, x in zip(_live, xs):
+                full[j] = x
+            return op.fn(*full, **attrs)
+
+        out_datas, vjp_fn = jax.vjp(fn, *[datas[j] for j in live])
+        live_inputs = [inputs[j] for j in live]
+    else:
+        out_datas = op.fn(*datas, **attrs)
+
+    multi = isinstance(out_datas, (tuple, list))
+    outs_list = list(out_datas) if multi else [out_datas]
+    nd_outs = [NDArray(o) for o in outs_list]
+
+    if recording:
+        node = autograd.TapeNode(vjp_fn, live_inputs, nd_outs, name=name)
+        autograd.attach_node(nd_outs, node)
+
+    if out is not None:
+        # write into provided output buffer(s) — reference kWriteTo semantics
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        for dst, src in zip(outs, nd_outs):
+            dst._data = src._data
+            dst._ag_node = getattr(src, "_ag_node", None)
+            dst._ag_out_idx = getattr(src, "_ag_out_idx", 0)
+        return out
+    if multi:
+        return nd_outs
+    return nd_outs[0]

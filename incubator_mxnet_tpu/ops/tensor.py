@@ -1,0 +1,460 @@
+"""Shape manipulation / indexing / initialization operators.
+
+Parity: ``src/operator/tensor/matrix_op.cc``, ``indexing_op.cc``,
+``init_op.cc``, ``control_flow_op.cc`` (where), cast/one_hot/sequence ops.
+All static-shape-friendly for XLA (dynamic-output ops like boolean_mask get
+bounded-shape formulations in :mod:`.contrib`).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# reshape family (matrix_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def _mx_reshape(data, shape):
+    """Implement MXNet Reshape's special codes 0, -1, -2, -3, -4.
+
+    Reference semantics: src/operator/tensor/matrix_op-inl.h (ReshapeParam).
+    0=copy dim, -1=infer, -2=copy all remaining, -3=merge two dims,
+    -4=split dim (followed by two sizes, -1 allowed in one).
+    """
+    src = list(data.shape)
+    out = []
+    i = 0  # index into src
+    j = 0  # index into shape spec
+    shape = list(shape)
+    while j < len(shape):
+        s = shape[j]
+        if s == 0:
+            out.append(src[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(src[i:]); i = len(src)
+        elif s == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif s == -4:
+            d1, d2 = shape[j + 1], shape[j + 2]
+            if d1 == -1:
+                d1 = src[i] // d2
+            if d2 == -1:
+                d2 = src[i] // d1
+            out.extend([d1, d2]); i += 1; j += 2
+        else:
+            out.append(s)
+            if i < len(src):
+                i += 1
+        j += 1
+    return jnp.reshape(data, tuple(out))
+
+
+@register("Reshape", num_inputs=1, aliases=("reshape",))
+def _reshape(data, shape=None, reverse=False, **ignored):
+    if reverse:
+        rs = _mx_reshape(jnp.reshape(data, data.shape[::-1]), list(shape)[::-1])
+        return jnp.reshape(rs, rs.shape[::-1])
+    return _mx_reshape(data, shape)
+
+
+@register("Flatten", num_inputs=1, aliases=("flatten",))
+def _flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("transpose", num_inputs=1)
+def _transpose(data, axes=None):
+    if axes is None or (isinstance(axes, (tuple, list)) and len(axes) == 0):
+        axes = tuple(reversed(range(data.ndim)))
+    return jnp.transpose(data, axes)
+
+
+@register("expand_dims", num_inputs=1)
+def _expand_dims(data, axis=0):
+    return jnp.expand_dims(data, axis)
+
+
+@register("squeeze", num_inputs=1)
+def _squeeze(data, axis=None):
+    return jnp.squeeze(data, axis=axis)
+
+
+@register("swapaxes", num_inputs=1, aliases=("SwapAxis",))
+def _swapaxes(data, dim1=0, dim2=0):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register("depth_to_space", num_inputs=1)
+def _depth_to_space(data, block_size):
+    b, c, h, w = data.shape
+    bs = block_size
+    x = data.reshape(b, bs, bs, c // (bs * bs), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(b, c // (bs * bs), h * bs, w * bs)
+
+
+@register("space_to_depth", num_inputs=1)
+def _space_to_depth(data, block_size):
+    b, c, h, w = data.shape
+    bs = block_size
+    x = data.reshape(b, c, h // bs, bs, w // bs, bs)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(b, c * bs * bs, h // bs, w // bs)
+
+
+def _canon_slice(begin, end, step, shape):
+    slices = []
+    for i, dim in enumerate(shape):
+        b = begin[i] if i < len(begin) else None
+        e = end[i] if i < len(end) else None
+        s = (step[i] if i < len(step) else None) if step else None
+        slices.append(slice(b, e, s))
+    return tuple(slices)
+
+
+@register("slice", num_inputs=1, aliases=("crop",))
+def _slice(data, begin=(), end=(), step=()):
+    return data[_canon_slice(list(begin), list(end), list(step or ()), data.shape)]
+
+
+@register("slice_axis", num_inputs=1)
+def _slice_axis(data, axis=0, begin=0, end=None):
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register("slice_like", num_inputs=2)
+def _slice_like(data, shape_like, axes=()):
+    axes = list(axes) if axes else list(range(min(data.ndim, shape_like.ndim)))
+    idx = [slice(None)] * data.ndim
+    for a in axes:
+        idx[a] = slice(0, shape_like.shape[a])
+    return data[tuple(idx)]
+
+
+@register("broadcast_to", num_inputs=1)
+def _broadcast_to(data, shape=()):
+    tgt = tuple(d if s == 0 else s for s, d in zip(shape, data.shape)) \
+        if len(shape) == data.ndim else tuple(shape)
+    return jnp.broadcast_to(data, tgt)
+
+
+@register("broadcast_like", num_inputs=2)
+def _broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    if lhs_axes is None:
+        return jnp.broadcast_to(lhs, rhs.shape)
+    tgt = list(lhs.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        tgt[la] = rhs.shape[ra]
+    return jnp.broadcast_to(lhs, tuple(tgt))
+
+
+@register("broadcast_axis", num_inputs=1, aliases=("broadcast_axes",))
+def _broadcast_axis(data, axis=(), size=()):
+    axis = (axis,) if isinstance(axis, int) else axis
+    size = (size,) if isinstance(size, int) else size
+    tgt = list(data.shape)
+    for a, s in zip(axis, size):
+        tgt[a] = s
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+@register("tile", num_inputs=1)
+def _tile(data, reps=()):
+    return jnp.tile(data, tuple(reps))
+
+
+@register("repeat", num_inputs=1)
+def _repeat(data, repeats=1, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register("pad", num_inputs=1, aliases=("Pad",))
+def _pad(data, mode="constant", pad_width=(), constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    if mode == "constant":
+        return jnp.pad(data, pw, constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(data, pw, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(data, pw, mode="reflect")
+    raise ValueError("unknown pad mode %r" % mode)
+
+
+@register("reverse", num_inputs=1, aliases=("flip",))
+def _reverse(data, axis=()):
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(data, axis=axis)
+
+
+@register("Concat", aliases=("concat",))
+def _concat(*args, dim=1, num_args=None):
+    return jnp.concatenate(args, axis=dim)
+
+
+@register("stack")
+def _stack(*args, axis=0, num_args=None):
+    return jnp.stack(args, axis=axis)
+
+
+@register("SliceChannel", aliases=("split",), num_inputs=1,
+          num_outputs=None)
+def _split(data, num_outputs=2, axis=1, squeeze_axis=False):
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("split_v2", num_inputs=1, num_outputs=None)
+def _split_v2(data, indices=(), axis=0, squeeze_axis=False, sections=0):
+    if sections:
+        parts = jnp.split(data, sections, axis=axis)
+    else:
+        parts = jnp.split(data, list(indices), axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+# ---------------------------------------------------------------------------
+# indexing (indexing_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("take", num_inputs=2)
+def _take(a, indices, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    if mode == "wrap":
+        idx = jnp.mod(idx, a.shape[axis])
+    else:
+        idx = jnp.clip(idx, 0, a.shape[axis] - 1)
+    return jnp.take(a, idx, axis=axis)
+
+
+@register("batch_take", num_inputs=2)
+def _batch_take(a, indices):
+    return jnp.take_along_axis(a, indices.astype(jnp.int32)[:, None], axis=1)[:, 0]
+
+
+@register("pick", num_inputs=2)
+def _pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    idx_exp = jnp.expand_dims(idx, axis=axis)
+    out = jnp.take_along_axis(data, idx_exp, axis=axis)
+    return out if keepdims else jnp.squeeze(out, axis=axis)
+
+
+@register("gather_nd", num_inputs=2)
+def _gather_nd(data, indices):
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return data[idx]
+
+
+@register("scatter_nd", num_inputs=2, differentiable=False)
+def _scatter_nd(data, indices, shape=()):
+    out = jnp.zeros(tuple(shape), dtype=data.dtype)
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return out.at[idx].set(data)
+
+
+@register("_scatter_set_nd", num_inputs=3, differentiable=False)
+def _scatter_set_nd(lhs, rhs, indices, shape=()):
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return lhs.at[idx].set(rhs)
+
+
+@register("one_hot", num_inputs=1, differentiable=False)
+def _one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=dtype)
+    return oh * (on_value - off_value) + off_value
+
+
+@register("where", num_inputs=3)
+def _where(condition, x, y):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@register("Embedding", num_inputs=2)
+def _embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
+               sparse_grad=False):
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# casting
+# ---------------------------------------------------------------------------
+
+
+@register("Cast", num_inputs=1, aliases=("cast",))
+def _cast(data, dtype="float32"):
+    from ..base import np_dtype
+
+    return data.astype(np_dtype(dtype))
+
+
+@register("amp_cast", num_inputs=1)
+def _amp_cast(data, dtype="float16"):
+    from ..base import np_dtype
+
+    return data.astype(np_dtype(dtype))
+
+
+@register("amp_multicast")
+def _amp_multicast(*args, num_outputs=None, cast_narrow=False):
+    dtypes = [a.dtype for a in args]
+    widest = jnp.result_type(*dtypes) if not cast_narrow else min(
+        dtypes, key=lambda d: jnp.finfo(d).bits if jnp.issubdtype(d, jnp.floating) else 64)
+    return tuple(a.astype(widest) for a in args)
+
+
+# ---------------------------------------------------------------------------
+# init ops (init_op.cc) — zero-input operators
+# ---------------------------------------------------------------------------
+
+
+def _to_dt(dtype):
+    from ..base import np_dtype
+
+    return np_dtype(dtype)
+
+
+@register("_zeros", num_inputs=0, differentiable=False, aliases=("zeros",))
+def _zeros(shape=(), ctx=None, dtype="float32"):
+    return jnp.zeros(tuple(shape) if not isinstance(shape, int) else (shape,), _to_dt(dtype))
+
+
+@register("_ones", num_inputs=0, differentiable=False, aliases=("ones",))
+def _ones(shape=(), ctx=None, dtype="float32"):
+    return jnp.ones(tuple(shape) if not isinstance(shape, int) else (shape,), _to_dt(dtype))
+
+
+@register("_full", num_inputs=0, differentiable=False, aliases=("full",))
+def _full(shape=(), value=0.0, ctx=None, dtype="float32"):
+    return jnp.full(tuple(shape) if not isinstance(shape, int) else (shape,), value, _to_dt(dtype))
+
+
+@register("_arange", num_inputs=0, differentiable=False, aliases=("arange",))
+def _arange(start=0, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32",
+            infer_range=False):
+    out = jnp.arange(start, stop, step, dtype=_to_dt(dtype))
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_linspace", num_inputs=0, differentiable=False, aliases=("linspace",))
+def _linspace(start=0, stop=1, num=50, endpoint=True, ctx=None, dtype="float32"):
+    return jnp.linspace(start, stop, int(num), endpoint=endpoint, dtype=_to_dt(dtype))
+
+
+@register("zeros_like", num_inputs=1, differentiable=False)
+def _zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like", num_inputs=1, differentiable=False)
+def _ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register("_eye", num_inputs=0, differentiable=False, aliases=("eye",))
+def _eye(N=1, M=0, k=0, ctx=None, dtype="float32"):
+    return jnp.eye(int(N), int(M) if M else None, k=int(k), dtype=_to_dt(dtype))
+
+
+@register("shape_array", num_inputs=1, differentiable=False)
+def _shape_array(data):
+    return jnp.array(data.shape, dtype=jnp.int64)
+
+
+@register("size_array", num_inputs=1, differentiable=False)
+def _size_array(data):
+    return jnp.array([data.size], dtype=jnp.int64)
+
+
+# ---------------------------------------------------------------------------
+# sequence ops (sequence_mask/last/reverse.cc) — SP/ring-attention building
+# blocks; static-shape via masking
+# ---------------------------------------------------------------------------
+
+
+def _seq_len_mask(sequence_length, maxlen, batch, use_sequence_length):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.full((batch, maxlen), True)
+    steps = jnp.arange(maxlen)[None, :]
+    return steps < sequence_length.astype(jnp.int32)[:, None]
+
+
+@register("SequenceMask", aliases=("sequence_mask",))
+def _sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0,
+                   axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    # data layout: (seq, batch, ...) for axis=0 or (batch, seq, ...) for axis=1
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    if axis == 0:
+        mask = steps[:, None] < sequence_length.astype(jnp.int32)[None, :]
+    else:
+        mask = steps[None, :] < sequence_length.astype(jnp.int32)[:, None]
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@register("SequenceLast", aliases=("sequence_last",))
+def _sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.take(data, -1, axis=axis)
+    idx = (sequence_length.astype(jnp.int32) - 1)
+    if axis == 0:
+        return jnp.take_along_axis(
+            data, idx.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0)[0]
+    return jnp.take_along_axis(
+        data, idx.reshape((-1, 1) + (1,) * (data.ndim - 2)), axis=1)[:, 0]
+
+
+@register("SequenceReverse", aliases=("sequence_reverse",))
+def _sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    maxlen = data.shape[0]
+    steps = jnp.arange(maxlen)[:, None]
+    lens = sequence_length.astype(jnp.int32)[None, :]
+    rev_idx = jnp.where(steps < lens, lens - 1 - steps, steps)
+    return jnp.take_along_axis(data, rev_idx.reshape(rev_idx.shape + (1,) * (data.ndim - 2)),
+                               axis=0)
+
+
+@register("_np_nonzero", num_inputs=1, differentiable=False)
+def _nonzero(data, size=None):
+    return jnp.stack(jnp.nonzero(data, size=size or data.size, fill_value=-1), axis=-1)
+
+
+@register("tril", num_inputs=1)
+def _tril(data, k=0):
+    return jnp.tril(data, k=k)
+
+
+@register("LinearRegressionOutput", num_inputs=2, aliases=("linear_regression_output",))
+def _linreg_out(data, label, grad_scale=1.0):
+    # forward = identity; special grad (data-label) handled by SoftmaxOutput-style
+    # training wrappers in module/model code
+    return data
+
+
+@register("LogisticRegressionOutput", num_inputs=2, aliases=("logistic_regression_output",))
+def _logreg_out(data, label, grad_scale=1.0):
+    return jax.nn.sigmoid(data)
+
+
+@register("MAERegressionOutput", num_inputs=2, aliases=("mae_regression_output",))
+def _maereg_out(data, label, grad_scale=1.0):
+    return data

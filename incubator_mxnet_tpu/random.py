@@ -1,0 +1,17 @@
+"""``mx.random`` — top-level random API (python/mxnet/random.py parity)."""
+from __future__ import annotations
+
+from . import rng
+from .ndarray.random import (bernoulli, exponential, gamma,
+                             generalized_negative_binomial, multinomial,
+                             negative_binomial, normal, poisson, randint,
+                             randn, shuffle, uniform)
+
+__all__ = ["seed", "uniform", "normal", "randn", "gamma", "exponential",
+           "poisson", "negative_binomial", "generalized_negative_binomial",
+           "randint", "multinomial", "shuffle", "bernoulli"]
+
+
+def seed(seed_state, ctx="all"):
+    """Seed the global PRNG (mx.random.seed parity)."""
+    rng.seed(seed_state)

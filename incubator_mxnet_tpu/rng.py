@@ -1,0 +1,54 @@
+"""Stateful PRNG over JAX's counter-based philox.
+
+Parity: the reference keeps per-device stateful generators
+(``include/mxnet/random_generator.h``, ``src/resource.cc`` kRandom resource)
+seeded by ``mx.random.seed``.  Here a process-global philox key is advanced by
+splitting on every draw (eager), while traced programs get deterministic
+per-trace keys from :mod:`.tracing` so compiled steps stay pure.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+from . import tracing
+
+__all__ = ["seed", "next_key", "get_state", "set_state"]
+
+_LOCK = threading.Lock()
+_KEY = jax.random.PRNGKey(0)
+_SEEDED = False
+
+
+def seed(seed_state: int, ctx=None):  # ctx accepted for API parity
+    """Seed the global generator (mx.random.seed parity)."""
+    global _KEY, _SEEDED
+    with _LOCK:
+        _KEY = jax.random.PRNGKey(int(seed_state) & 0x7FFFFFFF)
+        _SEEDED = True
+
+
+def next_key() -> jax.Array:
+    """Draw a fresh PRNG key.
+
+    Inside a trace (CachedOp/Executor jit), keys derive from the trace's key
+    operand so the compiled program is pure and cacheable; eagerly, the global
+    state advances like the reference's mt19937/philox resource streams.
+    """
+    tc = tracing.current_trace()
+    if tc is not None and tc.key is not None:
+        return tc.next_key()
+    global _KEY
+    with _LOCK:
+        _KEY, sub = jax.random.split(_KEY)
+    return sub
+
+
+def get_state():
+    return _KEY
+
+
+def set_state(key):
+    global _KEY
+    _KEY = key

@@ -1,0 +1,82 @@
+"""Trace context: the functionalization bridge between eager NDArray semantics
+and whole-graph jit.
+
+MXNet semantics are stateful (in-place NDArray writes, BatchNorm aux-state
+mutation, a global stateful PRNG).  XLA programs are pure.  When a CachedOp /
+Executor traces a whole graph into one jitted function, stateful actions are
+redirected here:
+
+- ``next_key()``  — PRNG: eager mode advances the global philox state;
+  inside a trace it derives a fresh key from the trace's key operand via
+  ``fold_in`` on a Python-level counter (deterministic per trace).
+- ``write_aux(param, value)`` — aux-state writes (e.g. BN running stats)
+  are collected and returned as extra outputs of the jitted program, then
+  committed to the real buffers by the caller.
+
+This replaces the reference's engine-mediated mutation model
+(``src/engine/threaded_engine.h`` versioned Vars) with a functional one.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["TraceContext", "current_trace", "push_trace", "pop_trace"]
+
+_STATE = threading.local()
+
+
+class TraceContext:
+    def __init__(self, key: Optional[jax.Array], training: bool = True):
+        self.key = key
+        self.training = training
+        self._counter = 0
+        # aux writes keyed by object id, value = (holder, new_value)
+        self.aux_writes: Dict[int, Any] = {}
+        self.aux_order: List[int] = []
+
+    def next_key(self) -> jax.Array:
+        if self.key is None:
+            raise RuntimeError(
+                "random op used inside a trace that was not given an rng key"
+            )
+        self._counter += 1
+        return jax.random.fold_in(self.key, self._counter)
+
+    def write_aux(self, holder, value):
+        oid = id(holder)
+        if oid not in self.aux_writes:
+            self.aux_order.append(oid)
+        self.aux_writes[oid] = (holder, value)
+
+    def collect_aux(self):
+        """Return ([holders], [values]) in deterministic write order."""
+        holders, values = [], []
+        for oid in self.aux_order:
+            h, v = self.aux_writes[oid]
+            holders.append(h)
+            values.append(v)
+        return holders, values
+
+
+def _stack() -> List[TraceContext]:
+    if not hasattr(_STATE, "stack"):
+        _STATE.stack = []
+    return _STATE.stack
+
+
+def current_trace() -> Optional[TraceContext]:
+    s = _stack()
+    return s[-1] if s else None
+
+
+def push_trace(ctx: TraceContext) -> TraceContext:
+    _stack().append(ctx)
+    return ctx
+
+
+def pop_trace() -> TraceContext:
+    return _stack().pop()

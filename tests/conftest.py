@@ -1,0 +1,35 @@
+"""Test harness config.
+
+Mirrors the reference strategy (SURVEY.md §4): run the suite on the XLA-CPU
+backend with a virtual 8-device mesh so multi-chip sharding tests run without
+TPU hardware (the reference's analog: fake-ctx consistency checks +
+multi-process kvstore tests on one host).
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pytest
+
+import jax
+
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _seed_rng():
+    """with_seed() analog: deterministic seeds per test (common.py:161)."""
+    import incubator_mxnet_tpu as mx
+
+    mx.random.seed(42)
+    np.random.seed(42)
+    yield

@@ -1,0 +1,151 @@
+"""Autograd tape tests (reference: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd
+
+
+def test_simple_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 4.0, 6.0])
+
+
+def test_chain():
+    x = nd.array([0.5, 1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x) * 2
+        z = y.sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * np.exp([0.5, 1.0]), rtol=1e-5)
+
+
+def test_multi_var():
+    a = nd.array([2.0])
+    b = nd.array([3.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = a * b + a
+    c.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [4.0])
+    np.testing.assert_allclose(b.grad.asnumpy(), [2.0])
+
+
+def test_head_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+    y.backward(nd.array([10.0, 100.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [20.0, 200.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = x * 2
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_grad_req_null():
+    x = nd.array([1.0])
+    w = nd.array([2.0])
+    x.attach_grad(grad_req="null")
+    w.attach_grad()
+    with autograd.record():
+        y = x * w
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [0.0])
+    np.testing.assert_allclose(w.grad.asnumpy(), [1.0])
+
+
+def test_detach_blocks_grad():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = nd.BlockGrad(y) * x
+    z.backward()
+    # d/dx [stop(x^2) * x] = x^2 = 9
+    np.testing.assert_allclose(x.grad.asnumpy(), [9.0])
+
+
+def test_autograd_grad_api():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    (g,) = autograd.grad([y], [x])
+    np.testing.assert_allclose(g.asnumpy(), [12.0])
+
+
+def test_training_states():
+    assert not autograd.is_recording()
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+    with autograd.predict_mode():
+        assert not autograd.is_training()
+
+
+def test_dropout_respects_mode():
+    x = nd.ones((100, 100))
+    # outside autograd.record → inference → identity
+    out = nd.Dropout(x, p=0.5)
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy())
+    with autograd.record():
+        out = nd.Dropout(x, p=0.5)
+    frac = (out.asnumpy() == 0).mean()
+    assert 0.3 < frac < 0.7
+
+
+def test_mark_variables():
+    x = nd.array([1.0, 2.0])
+    g = nd.zeros((2,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = (x * x).sum()
+    autograd.backward([y])
+    np.testing.assert_allclose(g.asnumpy(), [2.0, 4.0])
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            self.out = nd.sigmoid(x)
+            return self.out
+
+        def backward(self, dy):
+            y = self.out
+            return dy * y * (1 - y)
+
+    x = nd.array([0.0, 1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-np.array([0.0, 1.0])))
+    np.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_retain_graph():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])

@@ -1,0 +1,184 @@
+"""NDArray core tests (reference: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def test_creation():
+    a = nd.zeros((2, 3))
+    assert a.shape == (2, 3)
+    assert a.dtype == np.float32
+    b = nd.ones((4,), dtype="int32")
+    assert b.dtype == np.int32
+    c = nd.array([[1, 2], [3, 4]])
+    assert c.asnumpy().tolist() == [[1.0, 2.0], [3.0, 4.0]]
+    d = nd.full((2, 2), 7.0)
+    assert d.asnumpy()[0, 0] == 7.0
+    e = nd.arange(0, 10, 2)
+    np.testing.assert_allclose(e.asnumpy(), [0, 2, 4, 6, 8])
+
+
+def test_arithmetic():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[10.0, 20.0], [30.0, 40.0]])
+    np.testing.assert_allclose((a + b).asnumpy(), [[11, 22], [33, 44]])
+    np.testing.assert_allclose((b - a).asnumpy(), [[9, 18], [27, 36]])
+    np.testing.assert_allclose((a * b).asnumpy(), [[10, 40], [90, 160]])
+    np.testing.assert_allclose((b / a).asnumpy(), [[10, 10], [10, 10]])
+    np.testing.assert_allclose((a + 1).asnumpy(), [[2, 3], [4, 5]])
+    np.testing.assert_allclose((2 * a).asnumpy(), [[2, 4], [6, 8]])
+    np.testing.assert_allclose((1 - a).asnumpy(), [[0, -1], [-2, -3]])
+    np.testing.assert_allclose((a ** 2).asnumpy(), [[1, 4], [9, 16]], rtol=1e-5)
+    np.testing.assert_allclose((-a).asnumpy(), [[-1, -2], [-3, -4]])
+
+
+def test_inplace():
+    a = nd.ones((2, 2))
+    a += 1
+    np.testing.assert_allclose(a.asnumpy(), 2 * np.ones((2, 2)))
+    a *= 3
+    np.testing.assert_allclose(a.asnumpy(), 6 * np.ones((2, 2)))
+
+
+def test_indexing():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    np.testing.assert_allclose(a[1].asnumpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(a[1:3, 2].asnumpy(), [6, 10])
+    a[0, 0] = 99.0
+    assert a.asnumpy()[0, 0] == 99.0
+    a[1] = 0.0
+    np.testing.assert_allclose(a.asnumpy()[1], np.zeros(4))
+
+
+def test_broadcast_comparisons():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    np.testing.assert_allclose((a > b).asnumpy(), [0, 0, 1])
+    np.testing.assert_allclose((a == b).asnumpy(), [0, 1, 0])
+
+
+def test_reshape_transpose():
+    a = nd.array(np.arange(6).reshape(2, 3))
+    assert a.reshape(3, 2).shape == (3, 2)
+    assert a.reshape((-1,)).shape == (6,)
+    assert a.T.shape == (3, 2)
+    assert a.reshape(0, -1).shape == (2, 3)
+    assert a.expand_dims(0).shape == (1, 2, 3)
+    assert a.flatten().shape == (2, 3)
+
+
+def test_mx_reshape_codes():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((0, -3)).shape == (2, 12)
+    assert a.reshape((-4, 1, 2, 0, 0)).shape == (1, 2, 3, 4)
+    assert a.reshape((6, 1, -1)).shape == (6, 1, 4)
+
+
+def test_reductions():
+    a = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert a.sum().asscalar() == 66.0
+    np.testing.assert_allclose(a.sum(axis=0).asnumpy(), [12, 15, 18, 21])
+    np.testing.assert_allclose(a.mean(axis=1).asnumpy(), [1.5, 5.5, 9.5])
+    assert a.max().asscalar() == 11.0
+    assert a.min().asscalar() == 0.0
+    # exclude semantics
+    np.testing.assert_allclose(
+        nd.sum(a, axis=0, exclude=True).asnumpy(), a.asnumpy().sum(axis=1))
+
+
+def test_dot():
+    a = nd.array(np.random.rand(3, 4).astype(np.float32))
+    b = nd.array(np.random.rand(4, 5).astype(np.float32))
+    np.testing.assert_allclose(nd.dot(a, b).asnumpy(),
+                               a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.dot(a, b.T, transpose_b=True).asnumpy()[0, 0],
+        (a.asnumpy() @ b.asnumpy())[0, 0], rtol=1e-5)
+
+
+def test_concat_stack_split():
+    a, b = nd.ones((2, 3)), nd.zeros((2, 3))
+    assert nd.concat(a, b, dim=0).shape == (4, 3)
+    assert nd.concat(a, b, dim=1).shape == (2, 6)
+    assert nd.stack(a, b, axis=0).shape == (2, 2, 3)
+    parts = nd.split(nd.ones((4, 6)), num_outputs=2, axis=1)
+    assert len(parts) == 2 and parts[0].shape == (4, 3)
+
+
+def test_take_one_hot_where():
+    w = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = nd.array([0, 2])
+    np.testing.assert_allclose(nd.take(w, idx).asnumpy(), [[0, 1, 2], [6, 7, 8]])
+    oh = nd.one_hot(nd.array([0, 2]), depth=3)
+    np.testing.assert_allclose(oh.asnumpy(), [[1, 0, 0], [0, 0, 1]])
+    cond = nd.array([1.0, 0.0])
+    np.testing.assert_allclose(
+        nd.where(cond, nd.array([1.0, 2.0]), nd.array([3.0, 4.0])).asnumpy(),
+        [1.0, 4.0])
+
+
+def test_topk_sort():
+    a = nd.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    np.testing.assert_allclose(nd.topk(a, k=2, ret_typ="value").asnumpy(),
+                               [[3, 2], [5, 4]])
+    np.testing.assert_allclose(nd.sort(a, is_ascend=True).asnumpy(),
+                               [[1, 2, 3], [0, 4, 5]])
+    np.testing.assert_allclose(a.argmax(axis=1).asnumpy(), [0, 1])
+
+
+def test_cast_astype():
+    a = nd.array([1.5, 2.5])
+    assert a.astype("int32").dtype == np.int32
+    assert nd.cast(a, dtype="float64").dtype == np.float64
+
+
+def test_save_load(tmp_path):
+    f = str(tmp_path / "arrs")
+    a, b = nd.ones((2, 2)), nd.zeros((3,))
+    nd.save(f, [a, b])
+    out = nd.load(f)
+    assert isinstance(out, list) and len(out) == 2
+    np.testing.assert_allclose(out[0].asnumpy(), a.asnumpy())
+    nd.save(f, {"w": a, "b": b})
+    d = nd.load(f)
+    assert set(d.keys()) == {"w", "b"}
+    np.testing.assert_allclose(d["b"].asnumpy(), b.asnumpy())
+
+
+def test_random():
+    mx.random.seed(7)
+    a = nd.random.uniform(0, 1, shape=(100,))
+    mx.random.seed(7)
+    b = nd.random.uniform(0, 1, shape=(100,))
+    np.testing.assert_allclose(a.asnumpy(), b.asnumpy())
+    c = nd.random.normal(0, 1, shape=(1000,))
+    assert abs(float(c.mean().asscalar())) < 0.2
+    r = nd.random.randint(0, 10, shape=(50,))
+    assert r.asnumpy().min() >= 0 and r.asnumpy().max() < 10
+
+
+def test_wait_to_read_and_context():
+    a = nd.ones((2, 2), ctx=mx.cpu())
+    a.wait_to_read()
+    assert a.context.device_type == "cpu"
+    b = a.as_in_context(mx.cpu(0))
+    assert b.shape == (2, 2)
+
+
+def test_norm_clip():
+    a = nd.array([3.0, 4.0])
+    assert abs(a.norm().asscalar() - 5.0) < 1e-5
+    np.testing.assert_allclose(a.clip(0, 3.5).asnumpy(), [3.0, 3.5])
+
+
+def test_sequence_ops():
+    data = nd.array(np.arange(24, dtype=np.float32).reshape(4, 3, 2))
+    lens = nd.array([2, 3, 1])
+    masked = nd.SequenceMask(data, lens, use_sequence_length=True, value=-1.0)
+    out = masked.asnumpy()
+    assert (out[2:, 0] == -1).all() and (out[3:, 1] == -1).all()
+    last = nd.SequenceLast(data, lens, use_sequence_length=True)
+    np.testing.assert_allclose(last.asnumpy()[0], data.asnumpy()[1, 0])

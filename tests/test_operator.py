@@ -1,0 +1,217 @@
+"""Operator math tests (reference: tests/python/unittest/test_operator.py).
+
+Gradient correctness is checked against finite differences
+(check_numeric_gradient analog, test_utils.py:981 in the reference).
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd
+
+
+def numeric_grad(f, x, eps=1e-3):
+    x = x.astype(np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x.copy(); xp[idx] += eps
+        xm = x.copy(); xm[idx] -= eps
+        g[idx] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def check_grad(op_fn, x_np, rtol=1e-2, atol=1e-3):
+    x = nd.array(x_np.astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = op_fn(x).sum()
+    y.backward()
+    num = numeric_grad(lambda z: float(op_fn(nd.array(z.astype(np.float32))).sum().asscalar()), x_np)
+    np.testing.assert_allclose(x.grad.asnumpy(), num, rtol=rtol, atol=atol)
+
+
+def test_fully_connected():
+    x = nd.array(np.random.rand(4, 10).astype(np.float32))
+    w = nd.array(np.random.rand(5, 10).astype(np.float32))
+    b = nd.array(np.random.rand(5).astype(np.float32))
+    out = nd.FullyConnected(x, w, b, num_hidden=5)
+    expected = x.asnumpy() @ w.asnumpy().T + b.asnumpy()
+    np.testing.assert_allclose(out.asnumpy(), expected, rtol=1e-5)
+    out2 = nd.FullyConnected(data=x, weight=w, num_hidden=5, no_bias=True)
+    np.testing.assert_allclose(out2.asnumpy(), x.asnumpy() @ w.asnumpy().T, rtol=1e-5)
+
+
+def test_fully_connected_grad():
+    x_np = np.random.rand(3, 4).astype(np.float32)
+    w = nd.array(np.random.rand(2, 4).astype(np.float32))
+    b = nd.array(np.zeros(2, dtype=np.float32))
+    check_grad(lambda x: nd.FullyConnected(x, w, b, num_hidden=2), x_np)
+
+
+def test_convolution_shapes():
+    x = nd.random.uniform(shape=(2, 3, 8, 8))
+    w = nd.random.uniform(shape=(4, 3, 3, 3))
+    b = nd.zeros((4,))
+    out = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4)
+    assert out.shape == (2, 4, 6, 6)
+    out = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4, pad=(1, 1))
+    assert out.shape == (2, 4, 8, 8)
+    out = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4, stride=(2, 2), pad=(1, 1))
+    assert out.shape == (2, 4, 4, 4)
+
+
+def test_convolution_vs_numpy():
+    # 1x1 conv == matmul over channels
+    x = nd.random.uniform(shape=(2, 3, 5, 5))
+    w = nd.random.uniform(shape=(4, 3, 1, 1))
+    b = nd.zeros((4,))
+    out = nd.Convolution(x, w, b, kernel=(1, 1), num_filter=4)
+    xn = x.asnumpy(); wn = w.asnumpy()[:, :, 0, 0]
+    expected = np.einsum("nchw,oc->nohw", xn, wn)
+    np.testing.assert_allclose(out.asnumpy(), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_grouped_and_depthwise_conv():
+    x = nd.random.uniform(shape=(1, 4, 6, 6))
+    w = nd.random.uniform(shape=(4, 1, 3, 3))
+    out = nd.Convolution(x, w, None, kernel=(3, 3), num_filter=4, num_group=4,
+                         no_bias=True)
+    assert out.shape == (1, 4, 4, 4)
+
+
+def test_conv_grad():
+    x_np = np.random.rand(1, 2, 5, 5).astype(np.float32)
+    w = nd.array(np.random.rand(3, 2, 3, 3).astype(np.float32))
+    check_grad(lambda x: nd.Convolution(x, w, None, kernel=(3, 3), num_filter=3,
+                                        no_bias=True), x_np, rtol=2e-2, atol=2e-3)
+
+
+def test_pooling():
+    x = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    out = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    np.testing.assert_allclose(out.asnumpy()[0, 0], [[5, 7], [13, 15]])
+    out = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    np.testing.assert_allclose(out.asnumpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+    out = nd.Pooling(x, global_pool=True, pool_type="max")
+    assert out.shape == (1, 1, 1, 1)
+    assert out.asscalar() == 15.0
+
+
+def test_batchnorm_inference_and_training():
+    x = nd.random.normal(0, 1, shape=(8, 3, 4, 4))
+    gamma, beta = nd.ones((3,)), nd.zeros((3,))
+    mean, var = nd.zeros((3,)), nd.ones((3,))
+    out = nd.BatchNorm(x, gamma, beta, mean, var, fix_gamma=False)
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy(), rtol=1e-2, atol=1e-2)
+    with autograd.record():
+        out_t = nd.BatchNorm(x, gamma, beta, mean, var, fix_gamma=False)
+    o = out_t.asnumpy()
+    np.testing.assert_allclose(o.mean(axis=(0, 2, 3)), np.zeros(3), atol=1e-5)
+    np.testing.assert_allclose(o.std(axis=(0, 2, 3)), np.ones(3), atol=1e-2)
+
+
+def test_layernorm():
+    x = nd.random.normal(0, 1, shape=(4, 10))
+    g, b = nd.ones((10,)), nd.zeros((10,))
+    out = nd.LayerNorm(x, g, b).asnumpy()
+    np.testing.assert_allclose(out.mean(axis=-1), np.zeros(4), atol=1e-5)
+    np.testing.assert_allclose(out.std(axis=-1), np.ones(4), atol=1e-2)
+
+
+def test_activation_ops():
+    x = nd.array([-2.0, 0.0, 2.0])
+    np.testing.assert_allclose(nd.Activation(x, act_type="relu").asnumpy(), [0, 0, 2])
+    np.testing.assert_allclose(nd.relu(x).asnumpy(), [0, 0, 2])
+    np.testing.assert_allclose(nd.LeakyReLU(x, act_type="leaky", slope=0.1).asnumpy(),
+                               [-0.2, 0, 2], rtol=1e-5)
+    np.testing.assert_allclose(nd.sigmoid(x).asnumpy(), 1 / (1 + np.exp([2., 0., -2.])),
+                               rtol=1e-5)
+
+
+def test_softmax():
+    x = nd.array([[1.0, 2.0, 3.0]])
+    out = nd.softmax(x).asnumpy()
+    e = np.exp([1.0, 2.0, 3.0]); e /= e.sum()
+    np.testing.assert_allclose(out[0], e, rtol=1e-5)
+    np.testing.assert_allclose(nd.log_softmax(x).asnumpy()[0], np.log(e),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_softmax_output_grad():
+    """SoftmaxOutput backward = (p - onehot(y)) — softmax_output.cc semantics."""
+    x = nd.array(np.random.rand(4, 5).astype(np.float32))
+    y = nd.array([0, 1, 2, 3])
+    x.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(x, y)
+    out.backward()
+    p = out.asnumpy()
+    oh = np.eye(5, dtype=np.float32)[[0, 1, 2, 3]]
+    np.testing.assert_allclose(x.grad.asnumpy(), p - oh, rtol=1e-5, atol=1e-6)
+
+
+def test_embedding():
+    w = nd.array(np.random.rand(10, 4).astype(np.float32))
+    idx = nd.array([1, 3, 5])
+    out = nd.Embedding(idx, w, input_dim=10, output_dim=4)
+    np.testing.assert_allclose(out.asnumpy(), w.asnumpy()[[1, 3, 5]])
+
+
+def test_elemwise_grads():
+    for fn in [nd.exp, nd.log, nd.sqrt, nd.tanh, nd.sigmoid]:
+        x_np = np.random.rand(3, 3).astype(np.float32) + 0.5
+        check_grad(fn, x_np)
+
+
+def test_broadcast_grad():
+    a = nd.array(np.random.rand(3, 1).astype(np.float32))
+    b = nd.array(np.random.rand(1, 4).astype(np.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = (a * b).sum()
+    c.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), b.asnumpy().sum(1, keepdims=True).repeat(3, 0),
+                               rtol=1e-5)
+
+
+def test_optimizer_update_ops():
+    w = nd.array([1.0, 2.0])
+    g = nd.array([0.1, 0.1])
+    out = nd.sgd_update(w, g, lr=0.1)
+    np.testing.assert_allclose(out.asnumpy(), [0.99, 1.99], rtol=1e-6)
+    mom = nd.zeros((2,))
+    w2, m2 = nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9)
+    np.testing.assert_allclose(w2.asnumpy(), [0.99, 1.99], rtol=1e-6)
+    mean, var = nd.zeros((2,)), nd.zeros((2,))
+    w3, m3, v3 = nd.adam_update(w, g, mean, var, lr=0.01)
+    assert w3.shape == (2,)
+
+
+def test_upsampling():
+    x = nd.array(np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2))
+    out = nd.UpSampling(x, scale=2, sample_type="nearest")
+    assert out.shape == (1, 1, 4, 4)
+    np.testing.assert_allclose(out.asnumpy()[0, 0, :2, :2], [[0, 0], [0, 1]])
+
+
+def test_pick_gather():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    idx = nd.array([0, 1])
+    np.testing.assert_allclose(nd.pick(x, idx, axis=1).asnumpy(), [1.0, 4.0])
+    data = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    indices = nd.array([[0, 1], [1, 0]])
+    np.testing.assert_allclose(nd.gather_nd(data, indices).asnumpy(), [2.0, 3.0])
+
+
+def test_slice_ops():
+    x = nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    out = nd.slice(x, begin=(0, 1), end=(2, 3))
+    assert out.shape == (2, 2, 4)
+    out = nd.slice_axis(x, axis=2, begin=1, end=3)
+    assert out.shape == (2, 3, 2)
+    out = nd.slice_like(x, nd.zeros((2, 2, 2)))
+    assert out.shape == (2, 2, 2)
