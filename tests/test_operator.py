@@ -195,7 +195,8 @@ def test_upsampling():
     x = nd.array(np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2))
     out = nd.UpSampling(x, scale=2, sample_type="nearest")
     assert out.shape == (1, 1, 4, 4)
-    np.testing.assert_allclose(out.asnumpy()[0, 0, :2, :2], [[0, 0], [0, 1]])
+    np.testing.assert_allclose(out.asnumpy()[0, 0], np.repeat(np.repeat(
+        np.arange(4, dtype=np.float32).reshape(2, 2), 2, 0), 2, 1))
 
 
 def test_pick_gather():
