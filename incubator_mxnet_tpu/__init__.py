@@ -37,3 +37,9 @@ from . import ndarray as nd
 from .ndarray import NDArray
 from . import autograd
 from . import random
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from .optimizer import Optimizer
+from . import lr_scheduler
+from . import gluon

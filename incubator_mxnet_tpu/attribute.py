@@ -1,0 +1,45 @@
+"""Attribute scoping for symbol composition (python/mxnet/attribute.py)."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "current"]
+
+
+class AttrScope:
+    _state = threading.local()
+
+    def __init__(self, **kwargs):
+        self._attr = kwargs
+        self._old_scope = None
+
+    def get(self, attr=None):
+        if self._attr:
+            ret = self._attr.copy()
+            if attr:
+                ret.update(attr)
+            return ret
+        return attr if attr else {}
+
+    def __enter__(self):
+        if not hasattr(AttrScope._state, "value"):
+            AttrScope._state.value = AttrScope()
+        self._old_scope = AttrScope._state.value
+        attr = AttrScope._state.value._attr.copy()
+        attr.update(self._attr)
+        self._attr = attr
+        AttrScope._state.value = self
+        return self
+
+    def __exit__(self, *exc):
+        AttrScope._state.value = self._old_scope
+
+    @staticmethod
+    def current():
+        if not hasattr(AttrScope._state, "value"):
+            AttrScope._state.value = AttrScope()
+        return AttrScope._state.value
+
+
+def current():
+    return AttrScope.current()

@@ -1,0 +1,12 @@
+"""``mx.gluon`` — imperative/hybrid neural network API (gluon parity)."""
+from .parameter import Constant, DeferredInitializationError, Parameter, ParameterDict
+from .block import Block, CachedOp, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import utils
+from .utils import split_and_load
+
+__all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",
+           "SymbolBlock", "CachedOp", "Trainer", "nn", "loss", "utils",
+           "split_and_load"]

@@ -1,0 +1,116 @@
+"""Gluon Trainer (python/mxnet/gluon/trainer.py parity).
+
+Applies an Optimizer over a ParameterDict.  Distributed modes: on a sharded
+mesh, gradients produced by a pjit-compiled step are already reduced by XLA
+collectives, so the kvstore veneer only changes *semantics bookkeeping*
+(update_on_kvstore etc.), matching SURVEY.md §5.8's mapping of
+local/device/dist_sync_device onto mesh psum.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .. import optimizer as opt_mod
+from ..ndarray import NDArray
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError("params must be a ParameterDict / list of Parameters")
+        self._params: List[Parameter] = []
+        self._param2idx = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise ValueError("invalid parameter %r" % (p,))
+            self._param2idx[p.name] = i
+            self._params.append(p)
+        optimizer_params = optimizer_params or {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        param_dict = {p.name: p for p in self._params}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            optimizer_params = dict(optimizer_params)
+            optimizer_params.setdefault("param_dict", param_dict)
+            self._optimizer = opt_mod.create(optimizer, **optimizer_params)
+        self._updaters = [opt_mod.get_updater(self._optimizer)]
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._update_on_kvstore = update_on_kvstore
+        self._optimizer_set_on_kv = False
+
+    # ------------------------------------------------------------------
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def _init_kvstore(self):
+        if self._kv_initialized:
+            return
+        if self._kvstore_type is None or self._kvstore_type == "None":
+            self._kvstore = None
+        else:
+            try:
+                from .. import kvstore as kv_mod
+
+                self._kvstore = kv_mod.create(self._kvstore_type) \
+                    if isinstance(self._kvstore_type, str) else self._kvstore_type
+            except Exception:
+                self._kvstore = None
+        self._kv_initialized = True
+
+    # ------------------------------------------------------------------
+    def step(self, batch_size, ignore_stale_grad=False):
+        """All-reduce grads (mesh/kvstore) then update (trainer.py:320)."""
+        self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self.allreduce_grads()
+        self.update(batch_size, ignore_stale_grad)
+
+    def allreduce_grads(self):
+        """Cross-replica gradient reduction.
+
+        Single-process XLA already returns reduced grads from sharded steps;
+        with an attached dist kvstore, pushpull runs the mesh psum.
+        """
+        if self._kvstore is not None and getattr(self._kvstore, "num_workers", 1) > 1:
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    self._kvstore.pushpull(i, p.grad(), out=p.grad())
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        self._optimizer.rescale_grad = self._scale / batch_size
+        updater = self._updaters[0]
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            updater(i, p.grad(), p.data())
+
+    def zero_grad(self):
+        for p in self._params:
+            p.zero_grad()
+
+    # ------------------------------------------------------------------
+    def save_states(self, fname):
+        with open(fname, "wb") as f:
+            f.write(self._updaters[0].get_states(dump_optimizer=False))
+
+    def load_states(self, fname):
+        with open(fname, "rb") as f:
+            self._updaters[0].set_states(f.read())

@@ -1,0 +1,78 @@
+"""Gluon utilities (python/mxnet/gluon/utils.py parity)."""
+from __future__ import annotations
+
+import os
+from typing import List
+
+import numpy as np
+
+from ..context import Context, cpu
+from ..ndarray import NDArray
+from ..ndarray import ndarray as _nd
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data: NDArray, num_slice: int, batch_axis=0, even_split=True):
+    """Split along batch axis into num_slice chunks (utils.py:31)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices"
+            % (str(data.shape), num_slice))
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split batch across contexts (utils.py:100).
+
+    TPU-native note: on a sharded mesh the split is logical — XLA places the
+    shards; here we return per-ctx NDArrays for API parity.
+    """
+    if not isinstance(data, NDArray):
+        data = _nd.array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays: List[NDArray], max_norm, check_isfinite=True):
+    """Rescale arrays so total L2 norm ≤ max_norm (utils.py clip_global_norm)."""
+    import jax.numpy as jnp
+
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(a._data.astype(jnp.float32)))
+                         for a in arrays))
+    total_np = float(total)
+    scale = max_norm / (total_np + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._data = (a._data * scale).astype(a._data.dtype)
+    return total_np
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    raise RuntimeError(
+        "download() requires network egress which is unavailable in this "
+        "environment; place files locally instead")

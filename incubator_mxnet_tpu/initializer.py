@@ -1,0 +1,254 @@
+"""Weight initializers.
+
+Parity: ``python/mxnet/initializer.py`` (Zero :409, Uniform :482, Normal :516,
+Orthogonal :550, Xavier :587, MSRAPrelu :655, Bilinear :679, LSTMBias :697,
+Constant, One, Mixed :366) with the same name-pattern dispatch (weight/bias/
+gamma/beta/...).
+"""
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import rng
+from .ndarray import NDArray
+
+__all__ = ["InitDesc", "Initializer", "Zero", "One", "Constant", "Uniform",
+           "Normal", "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear",
+           "LSTMBias", "Mixed", "register", "registry_create"]
+
+_REGISTRY = {}
+
+
+def register(klass):
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def registry_create(name, **kwargs):
+    name = name.lower()
+    if name in _REGISTRY:
+        return _REGISTRY[name](**kwargs)
+    raise ValueError("Unknown initializer %r (known: %s)" % (name, sorted(_REGISTRY)))
+
+
+class InitDesc(str):
+    """Name + attrs descriptor passed to initializers (initializer.py:28)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+class Initializer:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, desc, arr: NDArray):
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(str(desc))
+        init = desc.attrs.get("__init__", "")
+        if init:
+            registry_create(init)._init_weight(desc, arr)
+            return
+        name = str(desc)
+        if name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif name.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(desc, arr)
+        elif name.endswith("min") or name.endswith("max"):
+            self._init_zero(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    def _init_weight(self, desc, arr):
+        raise NotImplementedError
+
+    def _init_bias(self, desc, arr):
+        arr._data = jnp.zeros_like(arr._data)
+
+    def _init_gamma(self, desc, arr):
+        arr._data = jnp.ones_like(arr._data)
+
+    def _init_beta(self, desc, arr):
+        arr._data = jnp.zeros_like(arr._data)
+
+    def _init_zero(self, desc, arr):
+        arr._data = jnp.zeros_like(arr._data)
+
+    def _init_one(self, desc, arr):
+        arr._data = jnp.ones_like(arr._data)
+
+    def _init_default(self, desc, arr):
+        self._init_weight(desc, arr)
+
+    def __repr__(self):
+        return "%s(%s)" % (type(self).__name__, self._kwargs)
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, desc, arr):
+        arr._data = jnp.zeros_like(arr._data)
+
+
+Zeros = Zero
+_REGISTRY["zeros"] = Zero
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, desc, arr):
+        arr._data = jnp.ones_like(arr._data)
+
+
+Ones = One
+_REGISTRY["ones"] = One
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, desc, arr):
+        if isinstance(self.value, NDArray):
+            arr._data = jnp.asarray(self.value._data, arr.dtype)
+        else:
+            arr._data = jnp.full_like(arr._data, self.value)
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, desc, arr):
+        arr._data = jax.random.uniform(rng.next_key(), arr.shape,
+                                       jnp.float32, -self.scale,
+                                       self.scale).astype(arr.dtype)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, desc, arr):
+        arr._data = (self.sigma * jax.random.normal(
+            rng.next_key(), arr.shape, jnp.float32)).astype(arr.dtype)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, desc, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr._data = jnp.asarray(self.scale * q.reshape(arr.shape), arr.dtype)
+
+
+@register
+class Xavier(Initializer):
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, desc, arr):
+        shape = arr.shape
+        hw_scale = float(np.prod(shape[2:])) if len(shape) > 2 else 1.0
+        fan_in = (shape[1] if len(shape) > 1 else shape[0]) * hw_scale
+        fan_out = shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        else:
+            factor = fan_out
+        scale = float(np.sqrt(self.magnitude / factor))
+        if self.rnd_type == "uniform":
+            arr._data = jax.random.uniform(rng.next_key(), shape, jnp.float32,
+                                           -scale, scale).astype(arr.dtype)
+        else:
+            arr._data = (scale * jax.random.normal(rng.next_key(), shape,
+                                                   jnp.float32)).astype(arr.dtype)
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, desc, arr):
+        shape = arr.shape
+        weight = np.zeros(int(np.prod(shape)), dtype=np.float32)
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr._data = jnp.asarray(weight.reshape(shape), arr.dtype)
+
+
+@register
+class LSTMBias(Initializer):
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        b = np.zeros(arr.shape, dtype=np.float32)
+        num_hidden = arr.shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        arr._data = jnp.asarray(b, arr.dtype)
+
+
+class Mixed:
+    """Name-pattern → initializer dispatch (initializer.py:366)."""
+
+    def __init__(self, patterns, initializers):
+        assert len(patterns) == len(initializers)
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, desc, arr):
+        for prog, init in self.map:
+            if prog.match(str(desc)):
+                init(desc, arr)
+                return
+        raise ValueError("Parameter name %s did not match any pattern" % desc)

@@ -1,0 +1,49 @@
+"""Automatic symbol naming (python/mxnet/name.py NameManager parity)."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix"]
+
+
+class NameManager:
+    _state = threading.local()
+
+    def __init__(self):
+        self._counter = {}
+        self._old_manager = None
+
+    def get(self, name, hint):
+        if name:
+            return name
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = "%s%d" % (hint, self._counter[hint])
+        self._counter[hint] += 1
+        return name
+
+    def __enter__(self):
+        if not hasattr(NameManager._state, "value"):
+            NameManager._state.value = NameManager()
+        self._old_manager = NameManager._state.value
+        NameManager._state.value = self
+        return self
+
+    def __exit__(self, *exc):
+        NameManager._state.value = self._old_manager
+
+    @staticmethod
+    def current():
+        if not hasattr(NameManager._state, "value"):
+            NameManager._state.value = NameManager()
+        return NameManager._state.value
+
+
+class Prefix(NameManager):
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._prefix + name

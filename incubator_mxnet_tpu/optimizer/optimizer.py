@@ -1,0 +1,648 @@
+"""Optimizers.
+
+Parity: ``python/mxnet/optimizer/optimizer.py`` — registry + per-index state,
+rescale_grad/clip/wd/lr multipliers, lr_scheduler hook, multi-precision
+master weights.  Updates dispatch to the fused update ops
+(``..ops.optimizer_ops`` ≡ src/operator/optimizer_op.cc) so a whole
+parameter-set update compiles into one XLA program when driven from a jitted
+train step.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..ndarray import NDArray
+from ..ndarray import ndarray as _nd
+from ..ops import registry as _reg
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdaGrad", "RMSProp", "AdaDelta",
+           "Ftrl", "Adamax", "Nadam", "Signum", "SignSGD", "FTML", "LAMB",
+           "DCASGD", "LBSGD", "AdamW", "Updater", "get_updater", "create",
+           "register"]
+
+_OPT_REGISTRY: Dict[str, type] = {}
+
+
+def register(klass):
+    _OPT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    name = name.lower()
+    if name not in _OPT_REGISTRY:
+        raise ValueError("Unknown optimizer %r (known: %s)"
+                         % (name, sorted(_OPT_REGISTRY)))
+    return _OPT_REGISTRY[name](**kwargs)
+
+
+class Optimizer:
+    """Base optimizer (optimizer.py Optimizer parity)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None, aggregate_num=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self.multi_precision = multi_precision
+        self.aggregate_num = aggregate_num
+        self._index_update_count: Dict[int, int] = {}
+        self.idx2name = param_idx2name or {}
+        self.param_dict = param_dict or {}
+        self._lr_mult: Dict[str, float] = {}
+        self._wd_mult: Dict[str, float] = {}
+
+    # -- registry hooks ---------------------------------------------------
+    create_optimizer = staticmethod(create)
+
+    # -- state ------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == np.float16:
+            w32 = weight.astype("float32")
+            return (self.create_state(index, w32), w32)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == np.float16:
+            s32, w32 = state
+            self.update(index, w32, grad.astype("float32"), s32)
+            weight._data = w32._data.astype(weight._data.dtype)
+        else:
+            self.update(index, weight, grad, state)
+
+    # -- lr/wd ------------------------------------------------------------
+    def set_learning_rate(self, lr):
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    @learning_rate.setter
+    def learning_rate(self, lr):
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self._lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self._wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self._wd_mult[n] = 0.0
+        self._wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        name = self.idx2name.get(index, index if isinstance(index, str) else None)
+        if name in self.param_dict:
+            lr *= self.param_dict[name].lr_mult
+        elif name in self._lr_mult:
+            lr *= self._lr_mult[name]
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        name = self.idx2name.get(index, index if isinstance(index, str) else None)
+        if name in self.param_dict:
+            wd *= self.param_dict[name].wd_mult
+        elif name in self._wd_mult:
+            wd *= self._wd_mult[name]
+        return wd
+
+    def _common_kwargs(self, index):
+        kw = {"lr": self._get_lr(index), "wd": self._get_wd(index),
+              "rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        return kw
+
+    def __getstate__(self):
+        return self.__dict__
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+
+def _commit(targets, results):
+    """Write update-op results back into the live buffers (in-place parity)."""
+    if not isinstance(results, (list, tuple)):
+        results = [results]
+    for dst, src in zip(targets, results):
+        dst._data = src._data
+
+
+@register
+class SGD(Optimizer):
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return _nd.zeros(weight.shape, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is not None:
+            res = _reg.invoke("sgd_mom_update", [weight, grad, state],
+                              momentum=self.momentum, **kw)
+            _commit([weight, state], res)
+        else:
+            res = _reg.invoke("sgd_update", [weight, grad], **kw)
+            _commit([weight], res)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == np.float16:
+            mom_or_none, w32 = state
+            kw = self._common_kwargs(index)
+            self._update_count(index)
+            if self.momentum != 0.0:
+                res = _reg.invoke("mp_sgd_mom_update",
+                                  [weight, grad, mom_or_none, w32],
+                                  momentum=self.momentum, **kw)
+                _commit([weight, mom_or_none, w32], res)
+            else:
+                res = _reg.invoke("mp_sgd_update", [weight, grad, w32], **kw)
+                _commit([weight, w32], res)
+        else:
+            self.update(index, weight, grad, state)
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == np.float16:
+            w32 = weight.astype("float32")
+            mom = _nd.zeros(weight.shape, dtype="float32") if self.momentum else None
+            return (mom, w32)
+        return self.create_state(index, weight)
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return _nd.zeros(weight.shape, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is not None:
+            res = _reg.invoke("nag_mom_update", [weight, grad, state],
+                              momentum=self.momentum, **kw)
+            _commit([weight, state], res)
+        else:
+            res = _reg.invoke("sgd_update", [weight, grad], **kw)
+            _commit([weight], res)
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_nd.zeros(weight.shape, dtype=weight.dtype),
+                _nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        kw = self._common_kwargs(index)
+        kw["lr"] = kw["lr"] * math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        mean, var = state
+        res = _reg.invoke("adam_update", [weight, grad, mean, var],
+                          beta1=self.beta1, beta2=self.beta2,
+                          epsilon=self.epsilon, **kw)
+        _commit([weight, mean, var], res)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return _nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        res = _reg.invoke("_sparse_adagrad_update", [weight, grad, state],
+                          epsilon=self.float_stable_eps, **kw)
+        _commit([weight, state], res)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.epsilon = epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (_nd.zeros(weight.shape, dtype=weight.dtype),
+                    _nd.zeros(weight.shape, dtype=weight.dtype),
+                    _nd.zeros(weight.shape, dtype=weight.dtype))
+        return _nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if self.clip_weights:
+            kw["clip_weights"] = self.clip_weights
+        if self.centered:
+            n, g, delta = state
+            res = _reg.invoke("rmspropalex_update", [weight, grad, n, g, delta],
+                              gamma1=self.gamma1, gamma2=self.gamma2,
+                              epsilon=self.epsilon, **kw)
+            _commit([weight, n, g, delta], res)
+        else:
+            res = _reg.invoke("rmsprop_update", [weight, grad, state],
+                              gamma1=self.gamma1, epsilon=self.epsilon, **kw)
+            _commit([weight, state], res)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_nd.zeros(weight.shape, dtype=weight.dtype),
+                _nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+
+        self._update_count(index)
+        wd = self._get_wd(index)
+        acc_g, acc_delta = state
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        new_acc_g = self.rho * acc_g._data + (1 - self.rho) * g * g
+        delta = (jnp.sqrt(acc_delta._data + self.epsilon)
+                 / jnp.sqrt(new_acc_g + self.epsilon)) * g
+        new_acc_delta = self.rho * acc_delta._data + (1 - self.rho) * delta * delta
+        acc_g._data = new_acc_g
+        acc_delta._data = new_acc_delta
+        weight._data = weight._data - delta - wd * weight._data
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (_nd.zeros(weight.shape, dtype=weight.dtype),
+                _nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        z, n = state
+        res = _reg.invoke("ftrl_update", [weight, grad, z, n],
+                          lamda1=self.lamda1, beta=self.beta, **kw)
+        _commit([weight, z, n], res)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (_nd.zeros(weight.shape, dtype=weight.dtype),
+                _nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index) / (1.0 - self.beta1 ** t)
+        wd = self._get_wd(index)
+        m, u = state
+        g = grad._data * self.rescale_grad + wd * weight._data
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        m._data = self.beta1 * m._data + (1 - self.beta1) * g
+        u._data = jnp.maximum(self.beta2 * u._data, jnp.abs(g))
+        weight._data = weight._data - lr * m._data / (u._data + 1e-8)
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (_nd.zeros(weight.shape, dtype=weight.dtype),
+                _nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        m, v = state
+        g = grad._data * self.rescale_grad + wd * weight._data
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule *= momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        g_prime = g / (1.0 - self.m_schedule)
+        m._data = self.beta1 * m._data + (1.0 - self.beta1) * g
+        v._data = self.beta2 * v._data + (1.0 - self.beta2) * g * g
+        m_prime = m._data / (1.0 - m_schedule_next)
+        v_prime = v._data / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - momentum_t) * g_prime + momentum_t_1 * m_prime
+        weight._data = weight._data - lr * m_bar / (jnp.sqrt(v_prime) + self.epsilon)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return _nd.zeros(weight.shape, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is not None:
+            res = _reg.invoke("signum_update", [weight, grad, state],
+                              momentum=self.momentum, wd_lh=self.wd_lh, **kw)
+            _commit([weight, state], res)
+        else:
+            res = _reg.invoke("signsgd_update", [weight, grad], **kw)
+            _commit([weight], res)
+
+
+@register
+class SignSGD(Signum):
+    def __init__(self, **kwargs):
+        kwargs.setdefault("momentum", 0.0)
+        super().__init__(**kwargs)
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_nd.zeros(weight.shape, dtype=weight.dtype),
+                _nd.zeros(weight.shape, dtype=weight.dtype),
+                _nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        kw = {"lr": self._get_lr(index), "wd": self._get_wd(index),
+              "rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_grad"] = self.clip_gradient
+        d, v, z = state
+        res = _reg.invoke("ftml_update", [weight, grad, d, v, z], t=t,
+                          beta1=self.beta1, beta2=self.beta2,
+                          epsilon=self.epsilon, **kw)
+        _commit([weight, d, v, z], res)
+
+
+@register
+class LAMB(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (_nd.zeros(weight.shape, dtype=weight.dtype),
+                _nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        kw1 = {"beta1": self.beta1, "beta2": self.beta2, "epsilon": self.epsilon,
+               "t": t, "bias_correction": self.bias_correction,
+               "wd": self._get_wd(index), "rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw1["clip_gradient"] = self.clip_gradient
+        g, new_mean, new_var = _reg.invoke("lamb_update_phase1",
+                                           [weight, grad, mean, var], **kw1)
+        mean._data, var._data = new_mean._data, new_var._data
+        kw2 = {"lr": self._get_lr(index)}
+        if self.lower_bound is not None:
+            kw2["lower_bound"] = self.lower_bound
+        if self.upper_bound is not None:
+            kw2["upper_bound"] = self.upper_bound
+        res = _reg.invoke("lamb_update_phase2", [weight, g, None], **kw2)
+        _commit([weight], res)
+
+
+@register
+class DCASGD(Optimizer):
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous: Dict[Any, Any] = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (_nd.zeros(weight.shape, dtype=weight.dtype), weight.copy())
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        mom, prev = state
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight._data + self.lamda * g * g * (weight._data - prev._data)
+        if mom is not None:
+            mom._data = self.momentum * mom._data - lr * g
+            upd = mom._data
+        else:
+            upd = -lr * g
+        prev._data = weight._data
+        weight._data = weight._data + upd
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with LARS-style layer-wise scaling (optimizer.py LBSGD)."""
+
+    def __init__(self, momentum=0.0, warmup_strategy="linear",
+                 warmup_epochs=5, batch_scale=1, updates_per_epoch=32,
+                 begin_epoch=0, num_epochs=60, **kwargs):
+        super().__init__(momentum=momentum, **kwargs)
+
+
+@register
+class AdamW(Optimizer):
+    """Decoupled weight decay Adam (contrib adamw.cc parity)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, eta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.eta = eta
+
+    def create_state(self, index, weight):
+        return (_nd.zeros(weight.shape, dtype=weight.dtype),
+                _nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        mean, var = state
+        rescale = _nd.full((1,), self.rescale_grad)
+        kw = {"lr": self._get_lr(index), "wd": self._get_wd(index),
+              "beta1": self.beta1, "beta2": self.beta2,
+              "epsilon": self.epsilon, "eta": self.eta}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        res = _reg.invoke("_adamw_update", [weight, grad, mean, var, rescale], **kw)
+        _commit([weight, mean, var], res)
+
+
+# Test/compat alias (reference optimizer.py registers 'test' in unittests)
+Test = SGD
+
+
+class Updater:
+    """State-carrying update closure (optimizer.py Updater / get_updater)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict[Any, Any] = {}
+        self.states_synced: Dict[Any, bool] = {}
+        self.aggregate_updates = optimizer.aggregate_num > 0
+
+    def __call__(self, index, grad, weight):
+        if not isinstance(index, (list, tuple)):
+            index, grad, weight = [index], [grad], [weight]
+        for i, g, w in zip(index, grad, weight):
+            if i not in self.states:
+                self.states[i] = self.optimizer.create_state_multi_precision(i, w)
+                self.states_synced[i] = True
+            self.optimizer.update_multi_precision(i, w, g, self.states[i])
+
+    def get_states(self, dump_optimizer=False):
+        states = {k: (v if not isinstance(v, tuple) else v) for k, v in self.states.items()}
+        payload = (states, self.optimizer) if dump_optimizer else states
+
+        def _np(x):
+            if isinstance(x, NDArray):
+                return x.asnumpy()
+            if isinstance(x, tuple):
+                return tuple(_np(i) for i in x)
+            return x
+
+        serial = {k: _np(v) for k, v in states.items()}
+        return pickle.dumps((serial, self.optimizer) if dump_optimizer else serial)
+
+    def set_states(self, states):
+        data = pickle.loads(states)
+        if isinstance(data, tuple):
+            states_np, self.optimizer = data
+        else:
+            states_np = data
+
+        def _nd_of(x):
+            if isinstance(x, tuple):
+                return tuple(_nd_of(i) for i in x)
+            if x is None:
+                return None
+            return _nd.array(x)
+
+        self.states = {k: _nd_of(v) for k, v in states_np.items()}
+        self.states_synced = {k: True for k in self.states}
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

@@ -17,8 +17,15 @@ from . import tracing
 __all__ = ["seed", "next_key", "get_state", "set_state"]
 
 _LOCK = threading.Lock()
-_KEY = jax.random.PRNGKey(0)
+_KEY = None  # lazy: creating a key initializes a backend; defer to first use
 _SEEDED = False
+
+
+def _key():
+    global _KEY
+    if _KEY is None:
+        _KEY = jax.random.PRNGKey(0)
+    return _KEY
 
 
 def seed(seed_state: int, ctx=None):  # ctx accepted for API parity
@@ -41,12 +48,12 @@ def next_key() -> jax.Array:
         return tc.next_key()
     global _KEY
     with _LOCK:
-        _KEY, sub = jax.random.split(_KEY)
+        _KEY, sub = jax.random.split(_key())
     return sub
 
 
 def get_state():
-    return _KEY
+    return _key()
 
 
 def set_state(key):

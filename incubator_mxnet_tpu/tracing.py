@@ -37,6 +37,9 @@ class TraceContext:
         # aux writes keyed by object id, value = (holder, new_value)
         self.aux_writes: Dict[int, Any] = {}
         self.aux_order: List[int] = []
+        # parameter bindings: id(Parameter) -> traced array standing in for
+        # the parameter's buffer inside this trace
+        self.bindings: Dict[int, Any] = {}
 
     def next_key(self) -> jax.Array:
         if self.key is None:

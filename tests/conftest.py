@@ -4,11 +4,16 @@ Mirrors the reference strategy (SURVEY.md §4): run the suite on the XLA-CPU
 backend with a virtual 8-device mesh so multi-chip sharding tests run without
 TPU hardware (the reference's analog: fake-ctx consistency checks +
 multi-process kvstore tests on one host).
+
+NOTE: the terminal environment force-selects the axon TPU backend via
+sitecustomize + JAX_PLATFORMS=axon.  Tests must NOT touch the (single,
+shared) TPU tunnel, so we re-pin jax_platforms to cpu via jax.config before
+any backend is initialized.
 """
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,12 +22,15 @@ if "--xla_force_host_platform_device_count" not in _flags:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as np
-import pytest
-
 import jax
 
+# sitecustomize's axon register() already stamped jax_platforms="axon,cpu";
+# re-pin to cpu-only so backends() never dials the TPU tunnel from tests.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np
+import pytest
 
 
 @pytest.fixture(autouse=True)
