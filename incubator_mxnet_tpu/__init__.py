@@ -43,3 +43,17 @@ from . import optimizer
 from .optimizer import Optimizer
 from . import lr_scheduler
 from . import gluon
+from . import metric
+from . import callback
+from . import util
+from .util import is_np_array, set_np, reset_np
+from .attribute import AttrScope
+from .name import NameManager
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from . import executor
+from . import io
+from . import module
+from . import module as mod
+from . import model

@@ -1,0 +1,6 @@
+"""``mx.io`` — data iterators (python/mxnet/io/io.py parity)."""
+from .io import (DataBatch, DataDesc, DataIter, MXDataIter, NDArrayIter,
+                 PrefetchingIter, ResizeIter, CSVIter)
+
+__all__ = ["DataBatch", "DataDesc", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "MXDataIter"]

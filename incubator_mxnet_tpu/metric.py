@@ -10,7 +10,7 @@ from __future__ import annotations
 import math
 from typing import Dict, List, Optional
 
-import numpy as np
+import numpy as _numpy
 
 from .ndarray import NDArray
 
@@ -50,7 +50,7 @@ def create(metric, *args, **kwargs):
 def _as_np(x):
     if isinstance(x, NDArray):
         return x.asnumpy()
-    return np.asarray(x)
+    return _numpy.asarray(x)
 
 
 class EvalMetric:
@@ -155,15 +155,15 @@ class Accuracy(EvalMetric):
         self.axis = axis
 
     def update(self, labels, preds):
-        if isinstance(labels, (NDArray, np.ndarray)):
+        if isinstance(labels, (NDArray, _numpy.ndarray)):
             labels, preds = [labels], [preds]
         for label, pred in zip(labels, preds):
             pred = _as_np(pred)
             label = _as_np(label)
             if pred.ndim > label.ndim:
                 pred = pred.argmax(axis=self.axis)
-            pred = pred.astype(np.int64).reshape(-1)
-            label = label.astype(np.int64).reshape(-1)
+            pred = pred.astype(_numpy.int64).reshape(-1)
+            label = label.astype(_numpy.int64).reshape(-1)
             correct = (pred == label).sum()
             self._update(float(correct), len(label))
 
@@ -181,8 +181,8 @@ class TopKAccuracy(EvalMetric):
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
             pred = _as_np(pred)
-            label = _as_np(label).astype(np.int64)
-            topk = np.argsort(-pred, axis=-1)[..., :self.top_k]
+            label = _as_np(label).astype(_numpy.int64)
+            topk = _numpy.argsort(-pred, axis=-1)[..., :self.top_k]
             correct = (topk == label.reshape(-1, 1)).any(axis=-1).sum()
             self._update(float(correct), len(label))
 
@@ -206,10 +206,10 @@ class F1(EvalMetric):
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
             pred = _as_np(pred)
-            label = _as_np(label).reshape(-1).astype(np.int64)
+            label = _as_np(label).reshape(-1).astype(_numpy.int64)
             if pred.ndim > 1:
                 pred = pred.argmax(axis=-1)
-            pred = pred.reshape(-1).astype(np.int64)
+            pred = pred.reshape(-1).astype(_numpy.int64)
             self._tp += float(((pred == 1) & (label == 1)).sum())
             self._fp += float(((pred == 1) & (label == 0)).sum())
             self._fn += float(((pred == 0) & (label == 1)).sum())
@@ -229,19 +229,19 @@ class MCC(EvalMetric):
     def __init__(self, name="mcc", output_names=None, label_names=None,
                  average="macro"):
         super().__init__(name, output_names, label_names)
-        self._counts = np.zeros(4)  # tp, fp, fn, tn
+        self._counts = _numpy.zeros(4)  # tp, fp, fn, tn
 
     def reset(self):
         super().reset()
-        self._counts = np.zeros(4)
+        self._counts = _numpy.zeros(4)
 
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
             pred = _as_np(pred)
-            label = _as_np(label).reshape(-1).astype(np.int64)
+            label = _as_np(label).reshape(-1).astype(_numpy.int64)
             if pred.ndim > 1:
                 pred = pred.argmax(axis=-1)
-            pred = pred.reshape(-1).astype(np.int64)
+            pred = pred.reshape(-1).astype(_numpy.int64)
             tp = float(((pred == 1) & (label == 1)).sum())
             fp = float(((pred == 1) & (label == 0)).sum())
             fn = float(((pred == 0) & (label == 1)).sum())
@@ -269,14 +269,14 @@ class Perplexity(EvalMetric):
         num = 0
         for label, pred in zip(labels, preds):
             pred = _as_np(pred)
-            label = _as_np(label).reshape(-1).astype(np.int64)
+            label = _as_np(label).reshape(-1).astype(_numpy.int64)
             pred = pred.reshape(-1, pred.shape[-1])
-            probs = pred[np.arange(len(label)), label]
+            probs = pred[_numpy.arange(len(label)), label]
             if self.ignore_label is not None:
                 ignore = (label == self.ignore_label)
-                probs = np.where(ignore, 1.0, probs)
+                probs = _numpy.where(ignore, 1.0, probs)
                 num -= int(ignore.sum())
-            loss -= float(np.log(np.maximum(probs, 1e-10)).sum())
+            loss -= float(_numpy.log(_numpy.maximum(probs, 1e-10)).sum())
             num += len(label)
         self._update(loss, num)
 
@@ -297,7 +297,7 @@ class MAE(EvalMetric):
             pred = _as_np(pred)
             if label.ndim == 1 and pred.ndim != 1:
                 label = label.reshape(pred.shape)
-            self._update(float(np.abs(label - pred).mean()), 1)
+            self._update(float(_numpy.abs(label - pred).mean()), 1)
 
 
 @register
@@ -325,7 +325,7 @@ class RMSE(EvalMetric):
             pred = _as_np(pred)
             if label.ndim == 1 and pred.ndim != 1:
                 label = label.reshape(pred.shape)
-            self._update(float(np.sqrt(((label - pred) ** 2).mean())), 1)
+            self._update(float(_numpy.sqrt(((label - pred) ** 2).mean())), 1)
 
 
 @register
@@ -337,10 +337,10 @@ class CrossEntropy(EvalMetric):
 
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
-            label = _as_np(label).ravel().astype(np.int64)
+            label = _as_np(label).ravel().astype(_numpy.int64)
             pred = _as_np(pred)
-            prob = pred[np.arange(label.shape[0]), label]
-            ce = (-np.log(prob + self.eps)).sum()
+            prob = pred[_numpy.arange(label.shape[0]), label]
+            ce = (-_numpy.log(prob + self.eps)).sum()
             self._update(float(ce), label.shape[0])
 
 
@@ -363,7 +363,7 @@ class PearsonCorrelation(EvalMetric):
         for label, pred in zip(labels, preds):
             label = _as_np(label).ravel()
             pred = _as_np(pred).ravel()
-            r = np.corrcoef(label, pred)[0, 1]
+            r = _numpy.corrcoef(label, pred)[0, 1]
             self._update(float(r), 1)
 
 
@@ -377,7 +377,7 @@ class Loss(EvalMetric):
             preds = [preds]
         for pred in preds:
             loss = float(_as_np(pred).sum())
-            self._update(loss, int(np.prod(_as_np(pred).shape)))
+            self._update(loss, int(_numpy.prod(_as_np(pred).shape)))
 
 
 @register

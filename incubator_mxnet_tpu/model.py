@@ -1,0 +1,40 @@
+"""Checkpoint helpers (python/mxnet/model.py parity: save_checkpoint :407,
+load_checkpoint :456)."""
+from __future__ import annotations
+
+from collections import namedtuple
+
+from .ndarray import load as nd_load
+from .ndarray import save as nd_save
+
+__all__ = ["save_checkpoint", "load_checkpoint", "BatchEndParam"]
+
+BatchEndParam = namedtuple("BatchEndParams",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """Write ``prefix-symbol.json`` + ``prefix-%04d.params`` (model.py:407)."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd_save(param_name, save_dict)
+
+
+def load_checkpoint(prefix, epoch):
+    """Load symbol + params saved by save_checkpoint (model.py:456)."""
+    from . import symbol as sym_mod
+
+    symbol = sym_mod.load("%s-symbol.json" % prefix)
+    save_dict = nd_load("%s-%04d.params" % (prefix, epoch))
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return symbol, arg_params, aux_params

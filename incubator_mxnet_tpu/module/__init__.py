@@ -1,0 +1,6 @@
+"""``mx.mod`` — Module API (python/mxnet/module parity)."""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule
+
+__all__ = ["BaseModule", "Module", "BucketingModule"]

@@ -1,0 +1,280 @@
+"""Module: symbolic training over an Executor.
+
+Parity: ``python/mxnet/module/module.py`` — bind :422 (executor group),
+forward :575, backward :629, update :646.
+
+TPU-native: one Executor per module (the whole graph is one XLA program);
+the reference's DataParallelExecutorGroup batch-slicing across devices is
+subsumed by XLA GSPMD batch sharding (see ..parallel), so multi-context
+binds keep the API but execute as a single sharded program.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import initializer as init_mod
+from .. import optimizer as opt_mod
+from ..base import MXNetError
+from ..context import Context, cpu
+from ..ndarray import NDArray
+from ..ndarray import ndarray as _nd
+from .base_module import BaseModule, _as_list
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger)
+        self._symbol = symbol
+        if context is None:
+            context = [cpu()]
+        if isinstance(context, Context):
+            context = [context]
+        self._context = context
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        self._param_names = [n for n in arg_names
+                             if n not in self._data_names
+                             and n not in self._label_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._exec = None
+        self._optimizer = None
+        self._updater = None
+        self._kvstore = None
+        self._preload_opt_states = None
+        self._grad_req = "write"
+
+    # ------------------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        return [(n, o.shape) for n, o in zip(self.output_names,
+                                             self._exec.outputs)]
+
+    # ------------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._grad_req = grad_req
+        self._data_shapes = [d if hasattr(d, "name") else
+                             __import__("incubator_mxnet_tpu").io.DataDesc(*d)
+                             for d in data_shapes]
+        self._label_shapes = [d if hasattr(d, "name") else
+                              __import__("incubator_mxnet_tpu").io.DataDesc(*d)
+                              for d in (label_shapes or [])]
+
+        shape_kwargs = {d.name: d.shape for d in self._data_shapes}
+        shape_kwargs.update({d.name: d.shape for d in self._label_shapes})
+        arg_shapes, out_shapes, aux_shapes = self._symbol.infer_shape(
+            **shape_kwargs)
+        arg_names = self._symbol.list_arguments()
+
+        args, grads = {}, {}
+        req = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            args[name] = _nd.zeros(shape)
+            is_data = name in self._data_names or name in self._label_names
+            r = "null" if (is_data and not inputs_need_grad) or \
+                name in self._fixed_param_names or not for_training else (
+                grad_req if isinstance(grad_req, str) else grad_req.get(name, "write"))
+            if name in self._label_names:
+                r = "null"
+            req[name] = r
+            if r != "null":
+                grads[name] = _nd.zeros(shape)
+        aux = {n: _nd.zeros(s) for n, s in zip(self._aux_names, aux_shapes)}
+        from ..executor import Executor
+
+        self._exec = Executor(self._symbol, self._context[0], args, grads,
+                              req, aux)
+        self.binded = True
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        if not self.binded:
+            raise MXNetError("call bind before init_params")
+        if initializer is None:
+            initializer = init_mod.Uniform(0.01)
+        attrs = self._symbol.attr_dict()
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params is not None and name in arg_params:
+                arr._data = arg_params[name]._data if isinstance(
+                    arg_params[name], NDArray) else _nd.array(arg_params[name])._data
+            else:
+                desc = init_mod.InitDesc(name, attrs.get(name, {}))
+                initializer(desc, arr)
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            if aux_params is not None and name in aux_params:
+                arr._data = aux_params[name]._data
+            else:
+                desc = init_mod.InitDesc(name, attrs.get(name, {}))
+                initializer(desc, arr)
+        self.params_initialized = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            optimizer_params = dict(optimizer_params)
+            if "rescale_grad" not in optimizer_params:
+                # reference Module defaults rescale_grad to 1/batch
+                # (module.py init_optimizer)
+                batch = self._data_shapes[0].shape[0] if self._data_shapes else 1
+                optimizer_params["rescale_grad"] = 1.0 / max(batch, 1)
+            optimizer = opt_mod.create(optimizer, param_idx2name=idx2name,
+                                       **optimizer_params)
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+        if self._preload_opt_states is not None:
+            self.load_optimizer_states(self._preload_opt_states)
+            self._preload_opt_states = None
+        self.optimizer_initialized = True
+
+    # ------------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training
+        inputs = {}
+        for name, arr in zip(self._data_names, _as_list(data_batch.data)):
+            inputs[name] = arr
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names, _as_list(data_batch.label)):
+                inputs[name] = arr
+        self._exec.forward(is_train=is_train, **inputs)
+
+    def backward(self, out_grads=None):
+        self._exec.backward(out_grads)
+
+    def update(self):
+        for i, name in enumerate(self._param_names):
+            if self._exec.grad_req.get(name, "null") == "null":
+                continue
+            w = self._exec.arg_dict[name]
+            g = self._exec.grad_dict[name]
+            self._updater(i, g, w)
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        if not self.inputs_need_grad:
+            raise MXNetError("bind with inputs_need_grad=True first")
+        return [self._exec.grad_dict[n] for n in self._data_names]
+
+    def get_params(self):
+        arg = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
+        aux = {n: self._exec.aux_dict[n].copy() for n in self._aux_names}
+        return arg, aux
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        if not allow_missing:
+            for name in self._param_names:
+                if arg_params is None or name not in arg_params:
+                    raise MXNetError("missing parameter %r" % name)
+        if arg_params:
+            for name, v in arg_params.items():
+                if name in self._exec.arg_dict:
+                    self._exec.arg_dict[name]._data = v._data
+                elif not allow_extra:
+                    raise MXNetError("unknown parameter %r" % name)
+        if aux_params:
+            for name, v in aux_params.items():
+                if name in self._exec.aux_dict:
+                    self._exec.aux_dict[name]._data = v._data
+                elif not allow_extra:
+                    raise MXNetError("unknown aux state %r" % name)
+        self.params_initialized = True
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update(_as_list(labels), self._exec.outputs)
+
+    def install_monitor(self, mon):
+        mon.install(self._exec)
+
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        from ..model import save_checkpoint
+
+        arg, aux = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg, aux)
+        if save_optimizer_states:
+            self.save_optimizer_states("%s-%04d.states" % (prefix, epoch))
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        from ..model import load_checkpoint
+
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._preloaded = (args, auxs)
+        if load_optimizer_states:
+            mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
+
+        orig_init = mod.init_params
+
+        def init_with_loaded(initializer=None, arg_params=None, aux_params=None,
+                             **kw):
+            orig_init(initializer=initializer,
+                      arg_params=arg_params or args,
+                      aux_params=aux_params or auxs, **kw)
+
+        mod.init_params = init_with_loaded
+        return mod
+
+    def save_optimizer_states(self, fname):
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def reshape(self, data_shapes, label_shapes=None):
+        self._data_shapes = data_shapes
+        self._label_shapes = label_shapes or []
+        self._exec = self._exec.reshape(
+            **{d.name if hasattr(d, "name") else d[0]:
+               d.shape if hasattr(d, "shape") else d[1]
+               for d in list(data_shapes) + list(label_shapes or [])})

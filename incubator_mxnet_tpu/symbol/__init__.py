@@ -1,0 +1,144 @@
+"""``mx.sym`` — symbolic operator namespace.
+
+Generated from the same op registry as ``mx.nd`` (the reference code-gens
+both from MXSymbolGetAtomicSymbolInfo; see python/mxnet/symbol/register.py).
+Composing creates graph nodes; missing parameter inputs auto-create variables
+named ``{opname}_{arg}`` exactly like nnvm symbol composition.
+"""
+from __future__ import annotations
+
+import inspect
+import sys
+from typing import Dict, List, Optional
+
+from ..name import NameManager
+from ..ops import registry as _reg
+from .symbol import (AUX_SUFFIXES, PARAM_INPUT_NAMES, Group, Symbol, Variable,
+                     _Node, _input_arg_names, _required_arg_names, load,
+                     load_json, var)
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json", "zeros",
+           "ones", "arange"]
+
+__is_symbol__ = True
+
+# singleton node standing in for an absent optional input (e.g. bias with
+# no_bias=True); excluded from list_arguments and bound to None at eval
+_NULL_NODE = _Node(None, "__null__")
+
+
+def _compose_num_outputs(opname, attrs):
+    if opname in ("SliceChannel", "split"):
+        return int(attrs.get("num_outputs", 2))
+    if opname == "split_v2":
+        sections = int(attrs.get("sections", 0))
+        return sections if sections else len(attrs.get("indices", ())) + 1
+    if opname == "topk" and attrs.get("ret_typ") == "both":
+        return 2
+    if opname in ("BatchNorm", "batch_norm") and attrs.get("output_mean_var"):
+        return 3
+    if opname in ("LayerNorm", "layer_norm") and attrs.get("output_mean_var"):
+        return 3
+    if opname == "GroupNorm" and attrs.get("output_mean_var"):
+        return 3
+    if opname == "RNN":
+        return 3 if attrs.get("mode", "lstm") == "lstm" and attrs.get(
+            "state_outputs") else (2 if attrs.get("state_outputs") else 1)
+    if opname == "amp_multicast":
+        return int(attrs.get("num_outputs", 1))
+    if opname in ("_linalg_slogdet", "linalg_slogdet", "batch_norm_stats"):
+        return 2
+    if opname == "moments":
+        return 2
+    return 1
+
+
+def _invoke_symbol(opname, inputs: List[Optional[Symbol]], attrs, name=None):
+    op = _reg.get_op(opname)
+    attrs = {k: v for k, v in attrs.items() if v is not None}
+    hint = opname.lower().strip("_")
+    name = NameManager.current().get(name, hint)
+    arg_names = _input_arg_names(op)
+
+    entries = []
+    if arg_names is None:
+        # variadic op: all inputs positional symbols
+        for s in inputs:
+            entries.append(s._outputs[0])
+    else:
+        no_bias = attrs.get("no_bias", False)
+        required = _required_arg_names(op)
+        for pos, argname in enumerate(arg_names):
+            if pos < len(inputs) and inputs[pos] is not None:
+                entries.append(inputs[pos]._outputs[0])
+            elif argname in attrs and isinstance(attrs.get(argname), Symbol):
+                entries.append(attrs.pop(argname)._outputs[0])
+            elif argname in PARAM_INPUT_NAMES or argname in required:
+                if argname == "bias" and no_bias:
+                    entries.append((_NULL_NODE, 0))
+                else:
+                    # auto-create free variable (nnvm compose semantics):
+                    # e.g. fc1_weight, softmax_label
+                    v = var("%s_%s" % (name, argname))
+                    entries.append(v._outputs[0])
+            else:
+                if pos < len(inputs):
+                    entries.append((_NULL_NODE, 0))
+                else:
+                    break  # trailing optional inputs omitted
+    node = _Node(opname, name, attrs, entries,
+                 num_outputs=_compose_num_outputs(opname, attrs))
+    return Symbol([(node, i) for i in range(node.num_outputs)]) \
+        if node.num_outputs > 1 else Symbol([(node, 0)])
+
+
+def _make_wrapper(public_name, op):
+    def wrapper(*args, name=None, attr=None, **kwargs):
+        inputs = []
+        for a in args:
+            if isinstance(a, Symbol) or a is None:
+                inputs.append(a)
+            else:
+                raise TypeError(
+                    "mx.sym.%s expects Symbol inputs, got %r" % (public_name, a))
+        # pull Symbol-valued kwargs as named inputs
+        arg_names = _input_arg_names(op) or []
+        for n in arg_names[len(inputs):]:
+            if n in kwargs and isinstance(kwargs[n], Symbol):
+                inputs.append(kwargs.pop(n))
+            elif n in kwargs and kwargs[n] is None:
+                kwargs.pop(n)
+                inputs.append(None)
+            else:
+                break
+        return _invoke_symbol(op.name, inputs, kwargs, name=name)
+
+    wrapper.__name__ = public_name
+    wrapper.__doc__ = op.doc
+    return wrapper
+
+
+def __getattr__(attr_name):
+    if attr_name.startswith("__"):
+        raise AttributeError(attr_name)
+    try:
+        op = _reg.get_op(attr_name)
+    except NotImplementedError:
+        raise AttributeError("mx.sym has no operator %r" % attr_name) from None
+    w = _make_wrapper(attr_name, op)
+    setattr(sys.modules[__name__], attr_name, w)
+    return w
+
+
+def zeros(shape, dtype="float32", **kwargs):
+    return _invoke_symbol("_zeros", [], {"shape": shape, "dtype": dtype}, **kwargs)
+
+
+def ones(shape, dtype="float32", **kwargs):
+    return _invoke_symbol("_ones", [], {"shape": shape, "dtype": dtype}, **kwargs)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, dtype="float32", **kwargs):
+    return _invoke_symbol("_arange", [], {"start": start, "stop": stop,
+                                          "step": step, "repeat": repeat,
+                                          "dtype": dtype}, **kwargs)

@@ -1,0 +1,712 @@
+"""Symbol: the symbolic graph IR.
+
+Parity: ``python/mxnet/symbol/symbol.py`` + the nnvm Graph the reference
+builds through the C API (``src/c_api/c_api_symbolic.cc``).  This is a
+from-scratch Python graph IR whose *execution* lowers the whole graph to one
+XLA computation (via :mod:`..executor`) instead of binding per-node engine
+ops like the reference's GraphExecutor.
+
+Key behaviors reproduced:
+- compose with auto-created variables for missing op inputs
+  (``sym.FullyConnected(data, num_hidden=10, name='fc1')`` creates
+  ``fc1_weight``/``fc1_bias`` vars),
+- ``list_arguments`` / ``list_auxiliary_states`` / ``list_outputs``,
+- shape/dtype inference, incl. backward inference of parameter shapes from
+  data shapes (the reference's InferShape fixed-point pass,
+  ``src/executor/infer_graph_attr_pass.cc``),
+- JSON save/load (nodes / arg_nodes / heads layout like nnvm's JSON),
+- ``bind`` / ``simple_bind`` / ``eval`` and gradient via the executor.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..base import MXNetError, np_dtype
+from ..name import NameManager
+from ..attribute import AttrScope
+from ..ops import registry as _reg
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "AUX_SUFFIXES", "PARAM_INPUT_NAMES"]
+
+# input-arg names that denote auxiliary state (not gradient targets) —
+# reference: mutable inputs listed via FMutateInputs (BatchNorm aux)
+AUX_SUFFIXES = ("moving_mean", "moving_var", "running_mean", "running_var")
+
+# op input names that are parameters (auto-var names use these suffixes)
+PARAM_INPUT_NAMES = {"weight", "bias", "gamma", "beta", "moving_mean",
+                     "moving_var", "alpha", "parameters", "state", "state_cell"}
+
+
+class _Node:
+    """One graph node: an op application or a variable."""
+
+    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs", "_attr_dict")
+
+    def __init__(self, op: Optional[str], name: str, attrs=None, inputs=None,
+                 num_outputs=1):
+        self.op = op  # None => variable
+        self.name = name
+        self.attrs = dict(attrs or {})
+        self.inputs: List[Tuple["_Node", int]] = list(inputs or [])
+        self.num_outputs = num_outputs
+        self._attr_dict = {}
+
+    @property
+    def is_var(self):
+        return self.op is None
+
+
+def _toposort(heads: Sequence[_Node]) -> List[_Node]:
+    seen = {}
+    order: List[_Node] = []
+    stack = [(h, False) for h in reversed(heads)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen[id(node)] = True
+        stack.append((node, True))
+        for parent, _ in reversed(node.inputs):
+            if id(parent) not in seen:
+                stack.append((parent, False))
+    return order
+
+
+class Symbol:
+    """Handle to one-or-more outputs of a graph (symbol.py Symbol parity)."""
+
+    __is_symbol__ = True
+
+    def __init__(self, outputs: List[Tuple[_Node, int]]):
+        self._outputs = list(outputs)
+
+    # ------------------------------------------------------------ meta
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def attr(self, key):
+        node = self._outputs[0][0]
+        return node._attr_dict.get(key)
+
+    def list_attr(self):
+        return dict(self._outputs[0][0]._attr_dict)
+
+    def attr_dict(self):
+        out = {}
+        for node in _toposort([n for n, _ in self._outputs]):
+            if node._attr_dict:
+                out[node.name] = dict(node._attr_dict)
+        return out
+
+    def _set_attr(self, **kwargs):
+        self._outputs[0][0]._attr_dict.update(kwargs)
+
+    def __repr__(self):
+        if len(self._outputs) == 1:
+            return "<Symbol %s>" % self.name
+        return "<Symbol group [%s]>" % ", ".join(n.name for n, _ in self._outputs)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __len__(self):
+        return len(self.list_outputs())
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            index = names.index(index)
+        if isinstance(index, slice):
+            return Symbol(self._outputs[index])
+        node, idx = self._outputs[index] if len(self._outputs) > 1 else (
+            self._outputs[0][0], index)
+        if len(self._outputs) == 1 and self._outputs[0][0].num_outputs > 1:
+            return Symbol([(self._outputs[0][0], index)])
+        return Symbol([self._outputs[index]])
+
+    def __copy__(self):
+        return Symbol(list(self._outputs))
+
+    def __deepcopy__(self, memo):
+        # graph nodes are immutable-by-convention; shallow copy suffices
+        return Symbol(list(self._outputs))
+
+    # ------------------------------------------------------------ listing
+    def _all_nodes(self):
+        return _toposort([n for n, _ in self._outputs])
+
+    def list_arguments(self) -> List[str]:
+        return [n.name for n in self._all_nodes()
+                if n.is_var and n.name != "__null__"
+                and not n.name.endswith(AUX_SUFFIXES)]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return [n.name for n in self._all_nodes()
+                if n.is_var and n.name.endswith(AUX_SUFFIXES)]
+
+    def list_inputs(self) -> List[str]:
+        return [n.name for n in self._all_nodes()
+                if n.is_var and n.name != "__null__"]
+
+    def list_outputs(self) -> List[str]:
+        names = []
+        for node, idx in self._outputs:
+            if node.num_outputs > 1:
+                names.append("%s_output%d" % (node.name, idx))
+            else:
+                names.append("%s_output" % node.name)
+        return names
+
+    def get_internals(self) -> "Symbol":
+        outs = []
+        for node in self._all_nodes():
+            for i in range(node.num_outputs):
+                outs.append((node, i))
+        return Symbol(outs)
+
+    def get_children(self) -> Optional["Symbol"]:
+        node = self._outputs[0][0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    # ------------------------------------------------------------ compose ops
+    def _binop(self, other, opname, reverse=False):
+        if isinstance(other, (int, float)):
+            name = NameManager.current().get(None, opname.strip("_").lower())
+            scalar_op = {"broadcast_add": "_plus_scalar",
+                         "broadcast_sub": "_rminus_scalar" if reverse else "_minus_scalar",
+                         "broadcast_mul": "_mul_scalar",
+                         "broadcast_div": "_rdiv_scalar" if reverse else "_div_scalar",
+                         "broadcast_power": "_rpower_scalar" if reverse else "_power_scalar",
+                         "broadcast_mod": "_rmod_scalar" if reverse else "_mod_scalar"}[opname]
+            node = _Node(scalar_op, name, {"scalar": float(other)},
+                         [self._outputs[0]])
+            return Symbol([(node, 0)])
+        lhs, rhs = (other, self) if reverse else (self, other)
+        name = NameManager.current().get(None, opname.strip("_").lower())
+        node = _Node(opname, name, {}, [lhs._outputs[0], rhs._outputs[0]])
+        return Symbol([(node, 0)])
+
+    def __add__(self, other):
+        return self._binop(other, "broadcast_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binop(other, "broadcast_sub")
+
+    def __rsub__(self, other):
+        return self._binop(other, "broadcast_sub", reverse=True)
+
+    def __mul__(self, other):
+        return self._binop(other, "broadcast_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binop(other, "broadcast_div")
+
+    def __rtruediv__(self, other):
+        return self._binop(other, "broadcast_div", reverse=True)
+
+    def __pow__(self, other):
+        return self._binop(other, "broadcast_power")
+
+    def __neg__(self):
+        return self.__mul__(-1.0)
+
+    def __eq__(self, other):
+        return self._binop(other, "broadcast_equal") if isinstance(
+            other, (Symbol, int, float)) else NotImplemented
+
+    def __ne__(self, other):
+        return self._binop(other, "broadcast_not_equal") if isinstance(
+            other, (Symbol, int, float)) else NotImplemented
+
+    def __gt__(self, other):
+        return self._binop(other, "broadcast_greater")
+
+    def __ge__(self, other):
+        return self._binop(other, "broadcast_greater_equal")
+
+    def __lt__(self, other):
+        return self._binop(other, "broadcast_lesser")
+
+    def __le__(self, other):
+        return self._binop(other, "broadcast_lesser_equal")
+
+    def __hash__(self):
+        return id(self)
+
+    # generated-op methods (subset commonly used as methods)
+    def _method_op(self, opname, **kwargs):
+        from . import _invoke_symbol
+
+        return _invoke_symbol(opname, [self], kwargs)
+
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = kwargs.pop("shape", shape)
+        return self._method_op("Reshape", shape=shape, **kwargs)
+
+    def transpose(self, axes=None):
+        return self._method_op("transpose", axes=axes)
+
+    def flatten(self):
+        return self._method_op("Flatten")
+
+    def sum(self, axis=None, keepdims=False):  # noqa: A003
+        return self._method_op("sum", axis=axis, keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return self._method_op("mean", axis=axis, keepdims=keepdims)
+
+    def astype(self, dtype):
+        return self._method_op("Cast", dtype=dtype)
+
+    def slice_axis(self, axis, begin, end):
+        return self._method_op("slice_axis", axis=axis, begin=begin, end=end)
+
+    def expand_dims(self, axis):
+        return self._method_op("expand_dims", axis=axis)
+
+    def softmax(self, axis=-1):
+        return self._method_op("softmax", axis=axis)
+
+    # ------------------------------------------------------------ inference
+    def infer_shape(self, *args, **kwargs):
+        """Return (arg_shapes, out_shapes, aux_shapes) — symbol.py:1045."""
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except Exception:
+            raise
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        known: Dict[str, Tuple[int, ...]] = {}
+        if args:
+            for name, shape in zip(self.list_arguments(), args):
+                if shape is not None:
+                    known[name] = tuple(shape)
+        known.update({k: tuple(v) for k, v in kwargs.items() if v is not None})
+        shapes, dtypes = _infer_graph(self, known, {}, partial=partial)
+        arg_shapes = [shapes.get(n) for n in self.list_arguments()]
+        aux_shapes = [shapes.get(n) for n in self.list_auxiliary_states()]
+        out_shapes = [shapes.get(_entry_key(node, i)) for node, i in self._outputs]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        known: Dict[str, Any] = {}
+        if args:
+            for name, dt in zip(self.list_arguments(), args):
+                if dt is not None:
+                    known[name] = np_dtype(dt)
+        known.update({k: np_dtype(v) for k, v in kwargs.items() if v is not None})
+        # dtype inference: run shape inference with dummy shapes where needed
+        shapes, dtypes = _infer_graph(self, {}, known, partial=True)
+        arg_types = [dtypes.get(n, np.dtype(np.float32)) for n in self.list_arguments()]
+        aux_types = [dtypes.get(n, np.dtype(np.float32)) for n in self.list_auxiliary_states()]
+        out_types = [dtypes.get(_entry_key(node, i), np.dtype(np.float32))
+                     for node, i in self._outputs]
+        return arg_types, out_types, aux_types
+
+    # ------------------------------------------------------------ execution
+    def eval_with(self, bindings):
+        """Evaluate eagerly given {var_name: NDArray} (SymbolBlock path)."""
+        from ..ndarray import NDArray
+
+        vals = {k: (v._data if isinstance(v, NDArray) else v)
+                for k, v in bindings.items()}
+        outs = _eval_graph(self, vals)
+        res = [NDArray(o) for o in outs]
+        return res[0] if len(res) == 1 else res
+
+    def eval(self, ctx=None, **kwargs):  # noqa: A003
+        return self.eval_with(kwargs)
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
+             group2ctx=None, shared_exec=None):
+        from ..executor import Executor
+
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+
+    def simple_bind(self, ctx, grad_req="write", type_dict=None,
+                    stype_dict=None, group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        from ..executor import Executor
+        from ..ndarray import ndarray as _nd
+
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        type_dict = type_dict or {}
+        args = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            if shape is None:
+                raise MXNetError("simple_bind could not infer shape of %r" % name)
+            args[name] = _nd.zeros(shape, ctx=ctx,
+                                   dtype=type_dict.get(name, "float32"))
+        args_grad = {}
+        req = grad_req if isinstance(grad_req, dict) else {
+            n: grad_req for n in arg_names}
+        for name, shape in zip(arg_names, arg_shapes):
+            if req.get(name, "write") != "null":
+                args_grad[name] = _nd.zeros(shape, ctx=ctx,
+                                            dtype=type_dict.get(name, "float32"))
+        aux_states = {}
+        for name, shape in zip(aux_names, aux_shapes):
+            aux_states[name] = _nd.zeros(shape, ctx=ctx)
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+
+    # gradient (symbolic): handled through executor vjp; this returns a
+    # placeholder symbol list for API parity
+    def gradient(self, wrt):
+        raise NotImplementedError(
+            "symbolic gradient symbols: use Executor.backward (vjp-based)")
+
+    # ------------------------------------------------------------ serialization
+    def tojson(self) -> str:
+        nodes = self._all_nodes()
+        node_ids = {id(n): i for i, n in enumerate(nodes)}
+        out_nodes = []
+        arg_nodes = []
+        for i, node in enumerate(nodes):
+            if node.is_var:
+                arg_nodes.append(i)
+            entry = {
+                "op": node.op if node.op else "null",
+                "name": node.name,
+                "inputs": [[node_ids[id(p)], idx, 0] for p, idx in node.inputs],
+            }
+            if node.attrs:
+                entry["attrs"] = {k: _attr_to_str(v) for k, v in node.attrs.items()}
+            if node.num_outputs != 1:
+                entry["num_outputs"] = node.num_outputs
+            out_nodes.append(entry)
+        heads = [[node_ids[id(n)], idx, 0] for n, idx in self._outputs]
+        return json.dumps({
+            "nodes": out_nodes,
+            "arg_nodes": arg_nodes,
+            "node_row_ptr": list(range(len(nodes) + 1)),
+            "heads": heads,
+            "attrs": {"mxnet_version": ["int", 10600],
+                      "framework": ["str", "incubator-mxnet-tpu"]},
+        }, indent=2)
+
+    def save(self, fname: str):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    def get_backend_symbol(self, backend):
+        """Subgraph-backend hook (subgraph_property.h parity). The XLA
+        lowering is the built-in 'backend'; returns self."""
+        return self
+
+    def optimize_for(self, backend, args=None, aux=None, ctx=None, **kwargs):
+        return self
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _attr_to_str(v):
+    if isinstance(v, str):
+        return v
+    return repr(v)
+
+
+def _parse_attr(s):
+    if not isinstance(s, str):
+        return s
+    try:
+        return ast.literal_eval(s)
+    except (ValueError, SyntaxError):
+        return s
+
+
+def _entry_key(node: _Node, idx: int) -> str:
+    return "%s#%d" % (node.name, idx)
+
+
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+        init=None, stype=None, **kwargs) -> Symbol:
+    """Create a variable symbol (sym.var / sym.Variable parity)."""
+    if not isinstance(name, str):
+        raise TypeError("Expect a string for variable name")
+    node = _Node(None, name)
+    attrs = AttrScope.current().get(attr)
+    node._attr_dict.update(attrs or {})
+    if shape is not None:
+        node.attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        node.attrs["__dtype__"] = str(np_dtype(dtype))
+    return Symbol([(node, 0)])
+
+
+Variable = var
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:  # noqa: N802 - parity name
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs)
+    return Symbol(outs)
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str: str) -> Symbol:
+    data = json.loads(json_str)
+    nodes: List[_Node] = []
+    for entry in data["nodes"]:
+        op = entry.get("op")
+        op = None if op in (None, "null") else op
+        attrs = {k: _parse_attr(v) for k, v in (entry.get("attrs")
+                                                or entry.get("param") or {}).items()}
+        shape_attr = attrs.pop("__shape__", None)
+        dtype_attr = attrs.pop("__dtype__", None)
+        node = _Node(op, entry["name"], attrs,
+                     num_outputs=entry.get("num_outputs", 1))
+        if shape_attr is not None:
+            node.attrs["__shape__"] = tuple(shape_attr)
+        if dtype_attr is not None:
+            node.attrs["__dtype__"] = dtype_attr
+        for inp in entry.get("inputs", []):
+            node.inputs.append((nodes[inp[0]], inp[1]))
+        nodes.append(node)
+    heads = data.get("heads", [[len(nodes) - 1, 0, 0]])
+    return Symbol([(nodes[h[0]], h[1]) for h in heads])
+
+
+# ---------------------------------------------------------------------------
+# graph evaluation + inference
+# ---------------------------------------------------------------------------
+
+
+def _node_outputs_count(node: _Node) -> int:
+    return node.num_outputs
+
+
+def _eval_node(node: _Node, in_vals: List[Any]):
+    op = _reg.get_op(node.op)
+    attrs = {k: v for k, v in node.attrs.items()
+             if not k.startswith("__")}
+    out = _reg.invoke_raw(op, in_vals, **attrs)
+    return list(out) if isinstance(out, (tuple, list)) else [out]
+
+
+def _eval_graph(symbol: Symbol, bindings: Dict[str, Any]) -> List[Any]:
+    """Evaluate the graph on raw arrays; used inside Executor's jit."""
+    cache: Dict[Tuple[int, int], Any] = {}
+    for node in _toposort([n for n, _ in symbol._outputs]):
+        if node.is_var:
+            if node.name == "__null__":
+                cache[(id(node), 0)] = None
+                continue
+            if node.name not in bindings:
+                raise MXNetError("unbound variable %r" % node.name)
+            cache[(id(node), 0)] = bindings[node.name]
+        else:
+            in_vals = [cache[(id(p), i)] for p, i in node.inputs]
+            outs = _eval_node(node, in_vals)
+            for i, o in enumerate(outs):
+                cache[(id(node), i)] = o
+    return [cache[(id(n), i)] for n, i in symbol._outputs]
+
+
+def _param_shape_rules(node: _Node, data_shape, known):
+    """Backward shape inference for parameter inputs (reference:
+    per-op FInferShape filling unknown args — infer_graph_attr_pass.cc)."""
+    op = node.op
+    a = node.attrs
+    out = {}
+    if op == "FullyConnected":
+        num_hidden = int(a.get("num_hidden"))
+        flatten = a.get("flatten", True)
+        in_units = int(np.prod(data_shape[1:])) if flatten else data_shape[-1]
+        out["weight"] = (num_hidden, in_units)
+        out["bias"] = (num_hidden,)
+    elif op == "Convolution":
+        nf = int(a.get("num_filter"))
+        ng = int(a.get("num_group", 1))
+        kernel = tuple(a.get("kernel"))
+        out["weight"] = (nf, data_shape[1] // ng) + kernel
+        out["bias"] = (nf,)
+    elif op == "Deconvolution":
+        nf = int(a.get("num_filter"))
+        ng = int(a.get("num_group", 1))
+        kernel = tuple(a.get("kernel"))
+        out["weight"] = (data_shape[1], nf // ng) + kernel
+        out["bias"] = (nf,)
+    elif op in ("BatchNorm", "InstanceNorm"):
+        axis = int(a.get("axis", 1))
+        c = data_shape[axis]
+        out["gamma"] = out["beta"] = (c,)
+        out["moving_mean"] = out["moving_var"] = (c,)
+    elif op == "LayerNorm":
+        axis = int(a.get("axis", -1))
+        out["gamma"] = out["beta"] = (data_shape[axis],)
+    elif op == "GroupNorm":
+        out["gamma"] = out["beta"] = (data_shape[1],)
+    elif op == "Embedding":
+        out["weight"] = (int(a.get("input_dim")), int(a.get("output_dim")))
+    elif op in ("SoftmaxOutput", "Softmax", "softmax_output"):
+        if a.get("multi_output"):
+            out["label"] = (data_shape[0],) + tuple(data_shape[2:])
+        elif a.get("preserve_shape"):
+            out["label"] = tuple(data_shape[:-1])
+        else:
+            out["label"] = (data_shape[0],)
+    elif op in ("LinearRegressionOutput", "MAERegressionOutput",
+                "LogisticRegressionOutput", "linear_regression_output",
+                "mae_regression_output", "logistic_regression_output"):
+        out["label"] = tuple(data_shape)
+    elif op == "LeakyReLU" and a.get("act_type") == "prelu":
+        out["gamma"] = (data_shape[1] if len(data_shape) > 1 else 1,)
+    elif op == "RNN":
+        from ..ops import rnn as _rnn_ops
+
+        out["parameters"] = (_rnn_ops.rnn_param_size(
+            int(a.get("num_layers", 1)), data_shape[-1],
+            int(a.get("state_size")), a.get("mode", "lstm"),
+            bool(a.get("bidirectional", False))),)
+        ndir = 2 if a.get("bidirectional") else 1
+        out["state"] = (int(a.get("num_layers", 1)) * ndir, data_shape[1],
+                        int(a.get("state_size")))
+        out["state_cell"] = out["state"]
+    return out
+
+
+def _input_arg_names(op: _reg.Op):
+    import inspect
+
+    try:
+        sig = inspect.signature(op.fn)
+    except (TypeError, ValueError):
+        return []
+    names = []
+    for p in sig.parameters.values():
+        if p.kind == inspect.Parameter.VAR_POSITIONAL:
+            return None
+        if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                      inspect.Parameter.POSITIONAL_OR_KEYWORD):
+            if p.default is inspect.Parameter.empty or p.name in PARAM_INPUT_NAMES \
+                    or p.name in ("sequence_length", "label_lengths",
+                                  "data_lengths", "r1_r2"):
+                names.append(p.name)
+    return names
+
+
+def _required_arg_names(op: _reg.Op):
+    """Input args with no default — must be bound or auto-var'd at compose."""
+    import inspect
+
+    try:
+        sig = inspect.signature(op.fn)
+    except (TypeError, ValueError):
+        return set()
+    out = set()
+    for p in sig.parameters.values():
+        if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                      inspect.Parameter.POSITIONAL_OR_KEYWORD) \
+                and p.default is inspect.Parameter.empty:
+            out.add(p.name)
+    return out
+
+
+def _infer_graph(symbol: Symbol, known_shapes, known_dtypes, partial=False):
+    """Abstract-evaluate the graph, solving unknown parameter-var shapes via
+    per-op rules; returns ({name/entry: shape}, {name/entry: dtype})."""
+    shapes: Dict[str, Any] = dict(known_shapes)
+    dtypes: Dict[str, Any] = dict(known_dtypes)
+    avals: Dict[Tuple[int, int], jax.ShapeDtypeStruct] = {}
+    null_entries = set()
+    nodes = _toposort([n for n, _ in symbol._outputs])
+    for node in nodes:
+        if node.is_var:
+            if node.name == "__null__":
+                null_entries.add((id(node), 0))
+                continue
+            shape = shapes.get(node.name, node.attrs.get("__shape__"))
+            if shape is not None and all(s > 0 for s in shape):
+                dt = dtypes.get(node.name, node.attrs.get("__dtype__", "float32"))
+                avals[(id(node), 0)] = jax.ShapeDtypeStruct(tuple(shape),
+                                                            np_dtype(dt))
+                shapes[node.name] = tuple(shape)
+                dtypes[node.name] = np_dtype(dt)
+            continue
+        op = _reg.get_op(node.op)
+        # resolve unknown param-var inputs via data-shape rules
+        if node.inputs and (id(node.inputs[0][0]), node.inputs[0][1]) in avals:
+            data_aval = avals[(id(node.inputs[0][0]), node.inputs[0][1])]
+            rules = _param_shape_rules(node, data_aval.shape, shapes)
+            arg_names = _input_arg_names(op) or []
+            for pos, (parent, pidx) in enumerate(node.inputs):
+                if parent.is_var and (id(parent), pidx) not in avals:
+                    argname = arg_names[pos] if pos < len(arg_names) else None
+                    if argname in rules:
+                        shapes[parent.name] = rules[argname]
+                        dt = dtypes.get(parent.name, data_aval.dtype)
+                        avals[(id(parent), 0)] = jax.ShapeDtypeStruct(
+                            rules[argname], np_dtype(dt))
+                        dtypes[parent.name] = np_dtype(dt)
+        in_avals = []
+        missing = False
+        for parent, pidx in node.inputs:
+            if (id(parent), pidx) in null_entries:
+                in_avals.append(None)
+                continue
+            av = avals.get((id(parent), pidx))
+            if av is None:
+                missing = True
+                break
+            in_avals.append(av)
+        if missing:
+            if partial:
+                continue
+            unresolved = [p.name for p, i in node.inputs
+                          if (id(p), i) not in avals and (id(p), i) not in null_entries]
+            raise MXNetError(
+                "infer_shape: cannot resolve inputs %s of node %s(%s)"
+                % (unresolved, node.op, node.name))
+        attrs = {k: v for k, v in node.attrs.items() if not k.startswith("__")}
+        if op.needs_rng:
+            attrs.setdefault("key", jax.ShapeDtypeStruct((2,), np.uint32))
+            try:
+                outs = op.infer(in_avals, **attrs)
+            except Exception:
+                attrs.pop("key")
+                key = jax.random.PRNGKey(0)
+                attrs["key"] = key
+                outs = op.infer(in_avals, **attrs)
+        else:
+            outs = op.infer(in_avals, **attrs)
+        node.num_outputs = len(outs)
+        for i, o in enumerate(outs):
+            avals[(id(node), i)] = o
+            shapes[_entry_key(node, i)] = tuple(o.shape)
+            dtypes[_entry_key(node, i)] = np.dtype(o.dtype)
+    return shapes, dtypes

@@ -1,0 +1,121 @@
+"""Module API tests (reference: tests/python/unittest/test_module.py +
+tests/python/train/test_mlp.py — the train_mnist.py workload shape)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import io, nd, sym
+
+
+def _mlp_symbol(num_classes=4):
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, num_hidden=32, name="fc1")
+    act1 = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act1, num_hidden=num_classes, name="fc2")
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _synthetic_iter(n=256, dim=8, classes=4, batch_size=32, seed=0):
+    # class centers fixed; `seed` only varies the sampled points
+    centers = np.random.RandomState(123).uniform(
+        -1, 1, (classes, dim)).astype(np.float32) * 2
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, classes, n)
+    data = centers[labels] + rng.normal(0, 0.3, (n, dim)).astype(np.float32)
+    return io.NDArrayIter(data.astype(np.float32),
+                          labels.astype(np.float32),
+                          batch_size=batch_size, shuffle=True)
+
+
+def test_module_bind_forward():
+    net = _mlp_symbol()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (32, 8))],
+             label_shapes=[("softmax_label", (32,))])
+    mod.init_params()
+    batch = io.DataBatch(data=[nd.ones((32, 8))], label=[nd.zeros((32,))])
+    mod.forward(batch, is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (32, 4)
+    np.testing.assert_allclose(out.asnumpy().sum(axis=1), np.ones(32), rtol=1e-5)
+
+
+def test_module_fit_convergence():
+    """Module.fit learns separable synthetic data (train_mnist.py analog)."""
+    mx.random.seed(0)
+    net = _mlp_symbol()
+    train = _synthetic_iter(seed=1)
+    val = _synthetic_iter(seed=2)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier(),
+            num_epoch=6, eval_metric="acc")
+    score = mod.score(val, "acc")
+    acc = dict(score)["accuracy"]
+    assert acc > 0.85, "Module.fit failed to converge: acc=%.3f" % acc
+
+
+def test_module_save_load_checkpoint(tmp_path):
+    net = _mlp_symbol()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 8))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 3)
+    sym2, args, auxs = mx.model.load_checkpoint(prefix, 3)
+    assert "fc1_weight" in args
+    mod2 = mx.mod.Module.load(prefix, 3, context=mx.cpu())
+    mod2.bind(data_shapes=[("data", (8, 8))],
+              label_shapes=[("softmax_label", (8,))])
+    mod2.init_params()
+    w1 = mod.get_params()[0]["fc1_weight"].asnumpy()
+    w2 = mod2.get_params()[0]["fc1_weight"].asnumpy()
+    np.testing.assert_allclose(w1, w2)
+
+
+def test_module_predict():
+    net = _mlp_symbol()
+    data_iter = _synthetic_iter(n=64, batch_size=16)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=data_iter.provide_data,
+             label_shapes=data_iter.provide_label)
+    mod.init_params()
+    out = mod.predict(data_iter)
+    assert out.shape == (64, 4)
+
+
+def test_ndarray_iter():
+    data = np.arange(40).reshape(10, 4).astype(np.float32)
+    label = np.arange(10).astype(np.float32)
+    it = io.NDArrayIter(data, label, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[-1].pad == 2
+    it2 = io.NDArrayIter(data, label, batch_size=3, last_batch_handle="discard")
+    assert len(list(it2)) == 3
+    it.reset()
+    first = it.next()
+    assert first.data[0].shape == (3, 4)
+
+
+def test_bucketing_module():
+    def sym_gen(seq_len):
+        data = sym.var("data")
+        fc = sym.FullyConnected(data, num_hidden=4, name="fc_shared")
+        return sym.SoftmaxOutput(fc, name="softmax"), ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10)
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd")
+    b10 = io.DataBatch(data=[nd.ones((4, 10))], label=[nd.zeros((4,))],
+                       bucket_key=10,
+                       provide_data=[io.DataDesc("data", (4, 10))],
+                       provide_label=[io.DataDesc("softmax_label", (4,))])
+    mod.forward(b10, is_train=True)
+    mod.backward()
+    mod.update()
+    out10 = mod.get_outputs()[0]
+    assert out10.shape == (4, 4)

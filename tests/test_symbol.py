@@ -1,0 +1,127 @@
+"""Symbol graph IR tests (reference: tests/python/unittest/test_symbol.py)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, sym
+
+
+def _mlp():
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_compose_auto_vars():
+    net = _mlp()
+    args = net.list_arguments()
+    assert args == ["data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+                    "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(32, 8))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (16, 8)
+    assert d["fc1_bias"] == (16,)
+    assert d["fc2_weight"] == (4, 16)
+    assert out_shapes[0] == (32, 4)
+
+
+def test_conv_infer_shape():
+    data = sym.var("data")
+    conv = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                           name="conv1")
+    bn = sym.BatchNorm(conv, name="bn1")
+    pool = sym.Pooling(bn, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    arg_shapes, out_shapes, aux_shapes = pool.infer_shape(data=(2, 3, 8, 8))
+    d = dict(zip(pool.list_arguments(), arg_shapes))
+    assert d["conv1_weight"] == (8, 3, 3, 3)
+    assert d["bn1_gamma"] == (8,)
+    assert out_shapes[0] == (2, 8, 4, 4)
+    assert dict(zip(pool.list_auxiliary_states(), aux_shapes))[
+        "bn1_moving_mean"] == (8,)
+
+
+def test_symbol_arithmetic_eval():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = (a + b) * 2 - a
+    out = c.eval_with({"a": nd.array([1.0, 2.0]), "b": nd.array([3.0, 4.0])})
+    np.testing.assert_allclose(out.asnumpy(), [7.0, 10.0])
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    arg_shapes, out_shapes, _ = net2.infer_shape(data=(4, 8))
+    assert out_shapes[0] == (4, 4)
+
+
+def test_save_load(tmp_path):
+    net = _mlp()
+    f = str(tmp_path / "net-symbol.json")
+    net.save(f)
+    net2 = sym.load(f)
+    assert net2.list_arguments() == net.list_arguments()
+
+
+def test_get_internals():
+    net = _mlp()
+    internals = net.get_internals()
+    names = internals.list_outputs()
+    assert "fc1_output" in names
+    fc1_out = internals["fc1_output"]
+    assert fc1_out.name == "fc1"
+
+
+def test_group():
+    a = sym.var("a")
+    b = sym.var("b")
+    g = sym.Group([a + b, a * b])
+    assert len(g.list_outputs()) == 2
+    outs = g.eval_with({"a": nd.array([2.0]), "b": nd.array([3.0])})
+    np.testing.assert_allclose(outs[0].asnumpy(), [5.0])
+    np.testing.assert_allclose(outs[1].asnumpy(), [6.0])
+
+
+def test_infer_type():
+    net = _mlp()
+    arg_types, out_types, _ = net.infer_type(data="float32")
+    assert all(t == np.float32 for t in out_types)
+
+
+def test_simple_bind_forward_backward():
+    net = _mlp()
+    exe = net.simple_bind(mx.cpu(), data=(8, 8), softmax_label=(8,))
+    for name in ("fc1_weight", "fc2_weight"):
+        exe.arg_dict[name][:] = np.random.uniform(
+            -0.1, 0.1, exe.arg_dict[name].shape).astype(np.float32)
+    exe.arg_dict["data"][:] = np.random.rand(8, 8).astype(np.float32)
+    exe.arg_dict["softmax_label"][:] = np.arange(8) % 4
+    outs = exe.forward(is_train=True)
+    assert outs[0].shape == (8, 4)
+    np.testing.assert_allclose(outs[0].asnumpy().sum(axis=1), np.ones(8),
+                               rtol=1e-5)
+    exe.backward()
+    g = exe.grad_dict["fc1_weight"].asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_bind_with_arrays():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = a * b
+    exe = c.bind(mx.cpu(), {"a": nd.array([1.0, 2.0]), "b": nd.array([3.0, 4.0])},
+                 args_grad={"a": nd.zeros((2,)), "b": nd.zeros((2,))})
+    outs = exe.forward(is_train=True)
+    np.testing.assert_allclose(outs[0].asnumpy(), [3.0, 8.0])
+    exe.backward(nd.array([1.0, 1.0]))
+    np.testing.assert_allclose(exe.grad_dict["a"].asnumpy(), [3.0, 4.0])
+    np.testing.assert_allclose(exe.grad_dict["b"].asnumpy(), [1.0, 2.0])
