@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 ImageNet-shape training throughput.
+
+Reference baseline (BASELINE.md): MXNet-CUDA on V100, batch 128 fp32 —
+363.69 img/s (docs perf.md:254).  This runs the same workload shape
+(ResNet-50, 224x224, SGD+momentum, batch 128) as ONE fused XLA program per
+step (fwd+bwd+update, bf16 compute / f32 state) on the local TPU chip.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+"""
+import json
+import sys
+import time
+
+BASELINE_IMG_S = 363.69  # V100 fp32 batch-128 training (perf.md:254)
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def run(batch_size=128, image_size=224, warmup=3, iters=20):
+    import jax
+    import numpy as np
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, nd
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    from incubator_mxnet_tpu.parallel import make_train_step
+
+    log("devices:", jax.devices())
+    mx.random.seed(0)
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(init=mx.init.Xavier())
+    # finish deferred init with a tiny eager pass
+    net(nd.random.uniform(shape=(1, 3, image_size, image_size)))
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = make_train_step(net, loss_fn, optimizer="sgd", learning_rate=0.1,
+                           momentum=0.9, wd=1e-4, compute_dtype="bfloat16")
+
+    x = nd.random.uniform(shape=(batch_size, 3, image_size, image_size))
+    y = nd.array(np.random.randint(0, 1000, batch_size).astype(np.float32))
+
+    log("compiling + warmup...")
+    t0 = time.time()
+    for _ in range(warmup):
+        loss = step(x, y)
+    loss.wait_to_read()
+    log("warmup done in %.1fs (loss=%.3f)" % (time.time() - t0,
+                                              float(loss.asscalar())))
+
+    t0 = time.time()
+    for _ in range(iters):
+        loss = step(x, y)
+    loss.wait_to_read()
+    dt = time.time() - t0
+    img_s = iters * batch_size / dt
+    log("%d iters in %.3fs -> %.1f img/s" % (iters, dt, img_s))
+    return img_s
+
+
+def main():
+    value = None
+    err = None
+    for batch in (128, 64, 32):
+        try:
+            value = run(batch_size=batch)
+            break
+        except Exception as e:  # noqa: BLE001 - report best-effort
+            err = e
+            log("batch %d failed: %r" % (batch, e))
+    if value is None:
+        print(json.dumps({
+            "metric": "resnet50_train_img_per_sec",
+            "value": 0.0,
+            "unit": "img/s",
+            "vs_baseline": 0.0,
+            "error": str(err),
+        }))
+        return
+    print(json.dumps({
+        "metric": "resnet50_train_img_per_sec",
+        "value": round(value, 2),
+        "unit": "img/s",
+        "vs_baseline": round(value / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -57,3 +57,7 @@ from . import io
 from . import module
 from . import module as mod
 from . import model
+from . import parallel
+from . import kvstore
+from . import kvstore as kv
+from .kvstore import KVStore

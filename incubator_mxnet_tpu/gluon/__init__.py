@@ -5,6 +5,8 @@ from .trainer import Trainer
 from . import nn
 from . import loss
 from . import utils
+from . import model_zoo
+from . import rnn
 from .utils import split_and_load
 
 __all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",

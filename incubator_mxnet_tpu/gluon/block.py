@@ -274,26 +274,30 @@ class CachedOp:
 
     @staticmethod
     def _split_inputs(args):
-        """Partition call args into traced NDArray leaves + static skeleton."""
+        """Partition call args (arbitrary pytrees of NDArrays + literals)
+        into traced leaves + a hashable static skeleton."""
+        leaves, treedef = jax.tree.flatten(
+            list(args), is_leaf=lambda x: isinstance(x, NDArray))
         in_vals, statics = [], []
-        for a in args:
-            if isinstance(a, NDArray):
+        for leaf in leaves:
+            if isinstance(leaf, NDArray):
                 statics.append(None)
-                in_vals.append(a._data)
+                in_vals.append(leaf._data)
             else:
-                statics.append(("lit", a))
-        return in_vals, tuple(statics)
+                statics.append(("lit", leaf))
+        return in_vals, (treedef, tuple(statics))
 
     @staticmethod
     def _unflatten_inputs(in_vals, statics):
-        args, i = [], 0
-        for s in statics:
+        treedef, leaf_statics = statics
+        leaves, i = [], 0
+        for s in leaf_statics:
             if s is None:
-                args.append(NDArray(in_vals[i]))
+                leaves.append(NDArray(in_vals[i]))
                 i += 1
             else:
-                args.append(s[1])
-        return args
+                leaves.append(s[1])
+        return jax.tree.unflatten(treedef, leaves)
 
     def __call__(self, *args):
         block = self._block
@@ -329,8 +333,10 @@ class CachedOp:
         out_nds = [NDArray(v) for v in out_vals]
 
         if recording:
-            nd_inputs = [p._data for p in self._gp] + [
-                a for a in args if isinstance(a, NDArray)]
+            arg_leaves = [a for a in jax.tree.leaves(
+                list(args), is_leaf=lambda x: isinstance(x, NDArray))
+                if isinstance(a, NDArray)]
+            nd_inputs = [p._data for p in self._gp] + arg_leaves
 
             def tape_vjp(cot, _vjp=vjp_fn, _n=len(out_vals),
                          _nw=len(writes)):

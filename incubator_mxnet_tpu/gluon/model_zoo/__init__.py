@@ -1,0 +1,5 @@
+"""``mx.gluon.model_zoo`` (gluon/model_zoo parity)."""
+from . import vision
+from .vision import get_model
+
+__all__ = ["vision", "get_model"]

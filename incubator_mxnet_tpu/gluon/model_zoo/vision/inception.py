@@ -1,0 +1,151 @@
+"""Inception v3 (Szegedy et al. 1512.00567).  Parity surface:
+gluon/model_zoo/vision/inception.py."""
+from ...block import HybridBlock
+from ... import nn
+
+__all__ = ["Inception3", "inception_v3"]
+
+
+def _make_basic_conv(**kwargs):
+    out = nn.HybridSequential()
+    out.add(nn.Conv2D(use_bias=False, **kwargs))
+    out.add(nn.BatchNorm(epsilon=0.001))
+    out.add(nn.Activation("relu"))
+    return out
+
+
+def _make_branch(use_pool, *conv_settings):
+    out = nn.HybridSequential()
+    if use_pool == "avg":
+        out.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
+    elif use_pool == "max":
+        out.add(nn.MaxPool2D(pool_size=3, strides=2))
+    for setting in conv_settings:
+        kwargs = {}
+        channels, kernel_size, strides, padding = setting
+        kwargs["channels"] = channels
+        kwargs["kernel_size"] = kernel_size
+        if strides is not None:
+            kwargs["strides"] = strides
+        if padding is not None:
+            kwargs["padding"] = padding
+        out.add(_make_basic_conv(**kwargs))
+    return out
+
+
+class _Concurrent(HybridBlock):
+    """Parallel branches concatenated on channels (gluon contrib HybridConcurrent)."""
+
+    def __init__(self, axis=1, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+
+    def add(self, block):
+        self.register_child(block)
+
+    def hybrid_forward(self, F, x):  # noqa: N803
+        outs = [child(x) for child in self._children.values()]
+        return F.Concat(*outs, dim=self._axis)
+
+
+def _make_A(pool_features):  # noqa: N802
+    out = _Concurrent()
+    out.add(_make_branch(None, (64, 1, None, None)))
+    out.add(_make_branch(None, (48, 1, None, None), (64, 5, None, 2)))
+    out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1),
+                         (96, 3, None, 1)))
+    out.add(_make_branch("avg", (pool_features, 1, None, None)))
+    return out
+
+
+def _make_B():  # noqa: N802
+    out = _Concurrent()
+    out.add(_make_branch(None, (384, 3, 2, None)))
+    out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1),
+                         (96, 3, 2, None)))
+    out.add(_make_branch("max"))
+    return out
+
+
+def _make_C(channels_7x7):  # noqa: N802
+    out = _Concurrent()
+    out.add(_make_branch(None, (192, 1, None, None)))
+    out.add(_make_branch(None, (channels_7x7, 1, None, None),
+                         (channels_7x7, (1, 7), None, (0, 3)),
+                         (192, (7, 1), None, (3, 0))))
+    out.add(_make_branch(None, (channels_7x7, 1, None, None),
+                         (channels_7x7, (7, 1), None, (3, 0)),
+                         (channels_7x7, (1, 7), None, (0, 3)),
+                         (channels_7x7, (7, 1), None, (3, 0)),
+                         (192, (1, 7), None, (0, 3))))
+    out.add(_make_branch("avg", (192, 1, None, None)))
+    return out
+
+
+def _make_D():  # noqa: N802
+    out = _Concurrent()
+    out.add(_make_branch(None, (192, 1, None, None), (320, 3, 2, None)))
+    out.add(_make_branch(None, (192, 1, None, None), (192, (1, 7), None, (0, 3)),
+                         (192, (7, 1), None, (3, 0)), (192, 3, 2, None)))
+    out.add(_make_branch("max"))
+    return out
+
+
+class _InceptionE(HybridBlock):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.branch1 = _make_branch(None, (320, 1, None, None))
+        self.branch2_stem = _make_branch(None, (384, 1, None, None))
+        self.branch2_a = _make_branch(None, (384, (1, 3), None, (0, 1)))
+        self.branch2_b = _make_branch(None, (384, (3, 1), None, (1, 0)))
+        self.branch3_stem = _make_branch(None, (448, 1, None, None),
+                                         (384, 3, None, 1))
+        self.branch3_a = _make_branch(None, (384, (1, 3), None, (0, 1)))
+        self.branch3_b = _make_branch(None, (384, (3, 1), None, (1, 0)))
+        self.branch4 = _make_branch("avg", (192, 1, None, None))
+
+    def hybrid_forward(self, F, x):  # noqa: N803
+        b1 = self.branch1(x)
+        s2 = self.branch2_stem(x)
+        b2 = F.Concat(self.branch2_a(s2), self.branch2_b(s2), dim=1)
+        s3 = self.branch3_stem(x)
+        b3 = F.Concat(self.branch3_a(s3), self.branch3_b(s3), dim=1)
+        b4 = self.branch4(x)
+        return F.Concat(b1, b2, b3, b4, dim=1)
+
+
+class Inception3(HybridBlock):
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential()
+        self.features.add(_make_basic_conv(channels=32, kernel_size=3, strides=2))
+        self.features.add(_make_basic_conv(channels=32, kernel_size=3))
+        self.features.add(_make_basic_conv(channels=64, kernel_size=3, padding=1))
+        self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+        self.features.add(_make_basic_conv(channels=80, kernel_size=1))
+        self.features.add(_make_basic_conv(channels=192, kernel_size=3))
+        self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+        self.features.add(_make_A(32))
+        self.features.add(_make_A(64))
+        self.features.add(_make_A(64))
+        self.features.add(_make_B())
+        self.features.add(_make_C(128))
+        self.features.add(_make_C(160))
+        self.features.add(_make_C(160))
+        self.features.add(_make_C(192))
+        self.features.add(_make_D())
+        self.features.add(_InceptionE())
+        self.features.add(_InceptionE())
+        self.features.add(nn.AvgPool2D(pool_size=8))
+        self.features.add(nn.Dropout(0.5))
+        self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):  # noqa: N803
+        x = self.features(x)
+        return self.output(F.Flatten(x))
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (zero-egress env)")
+    return Inception3(**kwargs)

@@ -1,0 +1,9 @@
+"""``mx.gluon.rnn`` (gluon/rnn parity)."""
+from .rnn_layer import GRU, LSTM, RNN
+from .rnn_cell import (BidirectionalCell, DropoutCell, GRUCell, LSTMCell,
+                       RecurrentCell, ResidualCell, RNNCell,
+                       SequentialRNNCell, ZoneoutCell)
+
+__all__ = ["RNN", "LSTM", "GRU", "RecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "DropoutCell", "BidirectionalCell",
+           "ResidualCell", "ZoneoutCell"]

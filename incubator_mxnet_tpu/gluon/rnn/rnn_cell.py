@@ -1,0 +1,293 @@
+"""Gluon RNN cells (gluon/rnn/rnn_cell.py parity: RNNCell/LSTMCell/GRUCell/
+SequentialRNNCell/DropoutCell/Bidirectional/Residual + unroll)."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..block import HybridBlock
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "BidirectionalCell",
+           "ResidualCell", "ZoneoutCell"]
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ...ndarray import ndarray as _nd
+
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            states.append(_nd.zeros(info["shape"], **kwargs))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unroll over time eagerly; under hybridize/jit the python loop is
+        unrolled into the one compiled program (graph-expansion like the
+        reference's FusedRNNCell.unfuse path)."""
+        from ... import ndarray as F  # noqa: N812
+
+        axis = layout.find("T")
+        batch = inputs.shape[layout.find("N")]
+        if begin_state is None:
+            begin_state = self.begin_state(batch)
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            step = F.squeeze(F.slice_axis(inputs, axis=axis, begin=i, end=i + 1),
+                             axis=axis)
+            out, states = self(step, states)
+            outputs.append(out)
+        if valid_length is not None:
+            outputs = [F.where(F.broadcast_lesser(
+                F.full((batch, 1), i), valid_length.reshape((-1, 1))), o,
+                F.zeros_like(o)) for i, o in enumerate(outputs)]
+        if merge_outputs is False:
+            return outputs, states
+        stacked = F.stack(*outputs, axis=axis)
+        return stacked, states
+
+    def _alias(self):
+        return "rnn_cell"
+
+
+class _BaseCell(RecurrentCell):
+    def __init__(self, hidden_size, ngates, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(ngates * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(ngates * hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(ngates * hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(ngates * hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+        self._ngates = ngates
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (self._ngates * self._hidden_size, x.shape[-1])
+
+    def forward(self, x, states):
+        return super().forward(x, states)
+
+    def __call__(self, x, states):
+        self._counter += 1
+        return self.forward(x, states)
+
+
+class RNNCell(_BaseCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0, **kwargs):
+        super().__init__(hidden_size, 1, input_size, **kwargs)
+        self._activation = activation
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight,  # noqa: N803
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(x, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        out = F.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(_BaseCell):
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, 4, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight,  # noqa: N803
+                       i2h_bias, h2h_bias):
+        nh = self._hidden_size
+        i2h = F.FullyConnected(x, i2h_weight, i2h_bias, num_hidden=4 * nh)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * nh)
+        gates = i2h + h2h
+        in_gate = F.sigmoid(F.slice_axis(gates, axis=-1, begin=0, end=nh))
+        forget = F.sigmoid(F.slice_axis(gates, axis=-1, begin=nh, end=2 * nh))
+        in_trans = F.tanh(F.slice_axis(gates, axis=-1, begin=2 * nh, end=3 * nh))
+        out_gate = F.sigmoid(F.slice_axis(gates, axis=-1, begin=3 * nh, end=4 * nh))
+        c = forget * states[1] + in_gate * in_trans
+        h = out_gate * F.tanh(c)
+        return h, [h, c]
+
+
+class GRUCell(_BaseCell):
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, 3, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight,  # noqa: N803
+                       i2h_bias, h2h_bias):
+        nh = self._hidden_size
+        prev = states[0]
+        i2h = F.FullyConnected(x, i2h_weight, i2h_bias, num_hidden=3 * nh)
+        h2h = F.FullyConnected(prev, h2h_weight, h2h_bias, num_hidden=3 * nh)
+        i2h_r = F.slice_axis(i2h, axis=-1, begin=0, end=nh)
+        i2h_z = F.slice_axis(i2h, axis=-1, begin=nh, end=2 * nh)
+        i2h_n = F.slice_axis(i2h, axis=-1, begin=2 * nh, end=3 * nh)
+        h2h_r = F.slice_axis(h2h, axis=-1, begin=0, end=nh)
+        h2h_z = F.slice_axis(h2h, axis=-1, begin=nh, end=2 * nh)
+        h2h_n = F.slice_axis(h2h, axis=-1, begin=2 * nh, end=3 * nh)
+        reset = F.sigmoid(i2h_r + h2h_r)
+        update = F.sigmoid(i2h_z + h2h_z)
+        next_h_tmp = F.tanh(i2h_n + reset * h2h_n)
+        h = (1.0 - update) * next_h_tmp + update * prev
+        return h, [h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        out = []
+        for cell in self._children.values():
+            out.extend(cell.state_info(batch_size))
+        return out
+
+    def begin_state(self, batch_size=0, **kwargs):
+        states = []
+        for cell in self._children.values():
+            states.extend(cell.begin_state(batch_size, **kwargs))
+        return states
+
+    def __call__(self, x, states):
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            x, new = cell(x, states[p:p + n])
+            next_states.extend(new)
+            p += n
+        return x, next_states
+
+    def __len__(self):
+        return len(self._children)
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def __call__(self, x, states):
+        from ... import ndarray as F  # noqa: N812
+
+        if self._rate > 0:
+            x = F.Dropout(x, p=self._rate)
+        return x, states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+
+    def state_info(self, batch_size=0):
+        return (self._children["l_cell"].state_info(batch_size)
+                + self._children["r_cell"].state_info(batch_size))
+
+    def __call__(self, x, states):
+        raise NotImplementedError(
+            "BidirectionalCell supports unroll() only (reference parity)")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import ndarray as F  # noqa: N812
+
+        l_cell = self._children["l_cell"]
+        r_cell = self._children["r_cell"]
+        batch = inputs.shape[layout.find("N")]
+        axis = layout.find("T")
+        if begin_state is None:
+            begin_state = self.begin_state(batch)
+        nl = len(l_cell.state_info())
+        l_out, l_states = l_cell.unroll(length, inputs, begin_state[:nl],
+                                        layout, merge_outputs=True,
+                                        valid_length=valid_length)
+        rev = F.reverse(inputs, axis=axis)
+        r_out, r_states = r_cell.unroll(length, rev, begin_state[nl:], layout,
+                                        merge_outputs=True,
+                                        valid_length=valid_length)
+        r_out = F.reverse(r_out, axis=axis)
+        out = F.Concat(l_out, r_out, dim=2 if layout == "NTC" else 2)
+        return out, l_states + r_states
+
+
+class ResidualCell(RecurrentCell):
+    def __init__(self, base_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.register_child(base_cell, "base_cell")
+
+    def state_info(self, batch_size=0):
+        return self._children["base_cell"].state_info(batch_size)
+
+    def __call__(self, x, states):
+        out, states = self._children["base_cell"](x, states)
+        return out + x, states
+
+
+class ZoneoutCell(RecurrentCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.register_child(base_cell, "base_cell")
+        self._zo, self._zs = zoneout_outputs, zoneout_states
+        self._prev_output = None
+
+    def state_info(self, batch_size=0):
+        return self._children["base_cell"].state_info(batch_size)
+
+    def __call__(self, x, states):
+        from ... import ndarray as F  # noqa: N812
+
+        out, new_states = self._children["base_cell"](x, states)
+        if self._zo > 0:
+            mask = F.bernoulli(prob=1 - self._zo, shape=out.shape)
+            prev = self._prev_output if self._prev_output is not None \
+                else F.zeros_like(out)
+            out = mask * out + (1 - mask) * prev
+        self._prev_output = out
+        if self._zs > 0:
+            new_states = [F.bernoulli(prob=1 - self._zs, shape=s.shape) * s
+                          + F.bernoulli(prob=self._zs, shape=s.shape) * olds
+                          for s, olds in zip(new_states, states)]
+        return out, new_states

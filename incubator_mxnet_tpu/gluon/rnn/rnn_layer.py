@@ -1,0 +1,156 @@
+"""Gluon recurrent layers (gluon/rnn/rnn_layer.py parity: RNN/LSTM/GRU over
+the fused RNN op)."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ...ops.rnn import _GATES
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout, bidirectional,
+                 input_size, mode, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC")
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._mode = mode
+        ngates = _GATES[mode]
+        ng, nh = ngates, hidden_size
+        with self.name_scope():
+            for i in range(num_layers):
+                for d in ["l", "r"][:self._dir]:
+                    in_sz = input_size if i == 0 else hidden_size * self._dir
+                    setattr(self, "%s%d_i2h_weight" % (d, i), self.params.get(
+                        "%s%d_i2h_weight" % (d, i), shape=(ng * nh, in_sz),
+                        init=i2h_weight_initializer, allow_deferred_init=True))
+                    setattr(self, "%s%d_h2h_weight" % (d, i), self.params.get(
+                        "%s%d_h2h_weight" % (d, i), shape=(ng * nh, nh),
+                        init=h2h_weight_initializer, allow_deferred_init=True))
+                    setattr(self, "%s%d_i2h_bias" % (d, i), self.params.get(
+                        "%s%d_i2h_bias" % (d, i), shape=(ng * nh,),
+                        init=i2h_bias_initializer, allow_deferred_init=True))
+                    setattr(self, "%s%d_h2h_bias" % (d, i), self.params.get(
+                        "%s%d_h2h_bias" % (d, i), shape=(ng * nh,),
+                        init=h2h_bias_initializer, allow_deferred_init=True))
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ...ndarray import ndarray as _nd
+
+        states = []
+        for info in self.state_info(batch_size):
+            states.append(_nd.zeros(info["shape"], **kwargs))
+        return states
+
+    def infer_shape(self, x, *args):
+        in_sz = x.shape[-1]
+        ng, nh = _GATES[self._mode], self._hidden_size
+        for i in range(self._num_layers):
+            for d in ["l", "r"][:self._dir]:
+                p = getattr(self, "%s%d_i2h_weight" % (d, i))
+                p.shape = (ng * nh, in_sz if i == 0 else nh * self._dir)
+
+    def _flat_params(self):
+        """Pack per-layer params into the fused-op flat vector."""
+        from ... import ndarray as F  # noqa: N812
+
+        weights, biases = [], []
+        for i in range(self._num_layers):
+            for d in ["l", "r"][:self._dir]:
+                weights.append(getattr(self, "%s%d_i2h_weight" % (d, i)).data()
+                               .reshape(-1))
+                weights.append(getattr(self, "%s%d_h2h_weight" % (d, i)).data()
+                               .reshape(-1))
+        for i in range(self._num_layers):
+            for d in ["l", "r"][:self._dir]:
+                biases.append(getattr(self, "%s%d_i2h_bias" % (d, i)).data())
+                biases.append(getattr(self, "%s%d_h2h_bias" % (d, i)).data())
+        return F.Concat(*(weights + biases), dim=0)
+
+    def forward(self, x, states=None):
+        from ... import ndarray as F  # noqa: N812
+        from ...gluon.parameter import DeferredInitializationError
+
+        try:
+            flat = self._flat_params()
+        except (DeferredInitializationError, RuntimeError):
+            self.infer_shape(x)
+            for p in self._reg_params.values():
+                if p._data is None and p._deferred_init is not None:
+                    p._finish_deferred_init(p.shape)
+            flat = self._flat_params()
+
+        ret_states = states is not None
+        batch = x.shape[0] if self._layout == "NTC" else x.shape[1]
+        if states is None:
+            states = self.begin_state(batch)
+        if self._layout == "NTC":
+            x = F.swapaxes(x, 0, 1)
+        args = dict(state_size=self._hidden_size, num_layers=self._num_layers,
+                    mode=self._mode, bidirectional=self._dir == 2,
+                    p=self._dropout, state_outputs=True)
+        if self._mode == "lstm":
+            out = F.RNN(x, flat, states[0], states[1], **args)
+            out, h, c = out
+            new_states = [h, c]
+        else:
+            out, h = F.RNN(x, flat, states[0], **args)
+            new_states = [h]
+        if self._layout == "NTC":
+            out = F.swapaxes(out, 0, 1)
+        if ret_states:
+            return out, new_states
+        return out
+
+    def __call__(self, x, states=None):
+        return self.forward(x, states)
+
+
+class RNN(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False, input_size=0,
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"},
+                {"shape": shape, "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

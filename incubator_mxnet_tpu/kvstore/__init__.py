@@ -1,0 +1,4 @@
+"""``mx.kv`` — KVStore (python/mxnet/kvstore parity)."""
+from .kvstore import KVStore, KVStoreBase, create
+
+__all__ = ["KVStore", "KVStoreBase", "create"]

@@ -1,0 +1,229 @@
+"""KVStore: the multi-device / distributed parameter store veneer.
+
+Parity surface: ``python/mxnet/kvstore/kvstore.py`` + ``KVStore::Create``
+types (``src/kvstore/kvstore.cc:40-77``): local / device / nccl /
+dist_sync / dist_device_sync / dist_async / dist.
+
+TPU-native mapping (SURVEY.md §5.8): the heavy lifting — gradient reduction
+across devices/hosts — is done by XLA collectives inside compiled steps
+(GSPMD inserts the all-reduce the reference ran through CommDevice/NCCL/
+ps-lite).  The KVStore object therefore keeps the reference *API and
+aggregation semantics* (push merges values; optional server-side optimizer
+via set_optimizer ≡ update_on_kvstore) for source compatibility, with
+``pushpull`` on a mesh delegating to ``jax.lax.psum``-equivalent reductions
+over the device axis of sharded arrays.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import optimizer as opt_mod
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["KVStore", "KVStoreBase", "create"]
+
+_KV_TYPES = ("local", "local_allreduce_cpu", "local_allreduce_device",
+             "device", "nccl", "dist", "dist_sync", "dist_async",
+             "dist_sync_device", "dist_device_sync", "dist_async_device",
+             "horovod", "tpu")
+
+
+class KVStoreBase:
+    """Pluggable kvstore registry (python/mxnet/kvstore/base.py:75 parity)."""
+
+    _registry: Dict[str, type] = {}
+
+    @staticmethod
+    def register(klass):
+        KVStoreBase._registry[klass.__name__.lower()] = klass
+        return klass
+
+    @staticmethod
+    def is_capable(capability: str) -> bool:
+        return capability in ("optimized_pushpull",)
+
+
+def create(name="local") -> "KVStore":
+    """Create a KVStore (kvstore.cc:40 factory parity)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a str")
+    if name not in _KV_TYPES and name.lower() not in KVStoreBase._registry:
+        raise MXNetError("unknown KVStore type %r" % name)
+    if name.lower() in KVStoreBase._registry:
+        return KVStoreBase._registry[name.lower()]()
+    return KVStore(name)
+
+
+class KVStore:
+    def __init__(self, kv_type="local"):
+        self._type = kv_type
+        self._store: Dict[Any, NDArray] = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression_params = None
+
+    # ------------------------------------------------------------------
+    @property
+    def type(self):  # noqa: A003
+        return self._type
+
+    @property
+    def rank(self) -> int:
+        if self._type.startswith("dist"):
+            try:
+                return jax.process_index()
+            except Exception:
+                return int(os.environ.get("DMLC_WORKER_ID", 0))
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        if self._type.startswith("dist"):
+            try:
+                return jax.process_count()
+            except Exception:
+                return int(os.environ.get("DMLC_NUM_WORKER", 1))
+        return 1
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _norm_keys_vals(key, value):
+        if isinstance(key, (list, tuple)):
+            if not isinstance(value, (list, tuple)) or len(key) != len(value):
+                raise MXNetError("key/value list length mismatch")
+            return list(key), list(value)
+        return [key], [value]
+
+    @staticmethod
+    def _merge(vals) -> jax.Array:
+        """Reduce a per-device value list (CommDevice::Reduce analog — on a
+        mesh the values are usually one sharded array already reduced by
+        XLA; eager lists are summed here)."""
+        if isinstance(vals, NDArray):
+            return vals._data
+        arrs = [v._data if isinstance(v, NDArray) else jnp.asarray(v)
+                for v in vals]
+        out = arrs[0]
+        for a in arrs[1:]:
+            out = out + a
+        return out
+
+    def init(self, key, value):
+        keys, values = self._norm_keys_vals(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                continue
+            v0 = v[0] if isinstance(v, (list, tuple)) else v
+            self._store[k] = NDArray(v0._data if isinstance(v0, NDArray)
+                                     else jnp.asarray(v0))
+
+    def push(self, key, value, priority=0):
+        keys, values = self._norm_keys_vals(key, value)
+        for k, v in zip(keys, values):
+            merged = self._merge(v if isinstance(v, (list, tuple)) else [v])
+            if k not in self._store:
+                self._store[k] = NDArray(jnp.zeros_like(merged))
+            if self._updater is not None:
+                self._updater(self._str_to_int_key(k),
+                              NDArray(merged), self._store[k])
+            else:
+                self._store[k]._data = merged
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = self._norm_keys_vals(key, out)
+        for k, o in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %r has not been initialized" % k)
+            val = self._store[k]._data
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                t._data = jnp.asarray(val, t.dtype)
+        return out
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused push+pull (kvstore.py:328); on sharded arrays the reduce is
+        an XLA all-reduce already done inside the compiled step."""
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+        return out
+
+    def broadcast(self, key, value, out=None, priority=0):
+        self.init(key, value)
+        if out is not None:
+            self.pull(key, out, priority)
+        return out
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull specific rows (kvstore.py:407; ZeRO-style sharded-row gather)."""
+        if out is None or row_ids is None:
+            raise MXNetError("row_sparse_pull requires out and row_ids")
+        keys, outs = self._norm_keys_vals(key, out)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        for k, o, r in zip(keys, outs, rids):
+            val = self._store[k]._data
+            idx = r._data.astype(jnp.int32) if isinstance(r, NDArray) \
+                else jnp.asarray(r, jnp.int32)
+            rows = jnp.take(val, idx, axis=0)
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                t._data = jnp.zeros_like(t._data).at[idx].set(rows)
+        return out
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _str_to_int_key(k):
+        try:
+            return int(k)
+        except (TypeError, ValueError):
+            return k
+
+    def set_updater(self, updater):
+        """Custom update fn run at push time (kvstore.h:269 set_updater)."""
+        self._updater = updater
+
+    def set_optimizer(self, optimizer):
+        """Run the optimizer inside the store (update_on_kvstore semantics —
+        the reference pickles it to the PS servers, kvstore.py:543)."""
+        self._optimizer = optimizer
+        upd = opt_mod.get_updater(optimizer)
+
+        def updater(key, grad, weight):
+            upd(key, grad, weight)
+
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params):
+        """2-bit compression config (gradient_compression.h parity). On TPU
+        gradients ride ICI inside XLA programs; stored for API compat."""
+        self._compression_params = dict(compression_params)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._optimizer is None:
+            raise MXNetError("no optimizer set")
+        with open(fname, "wb") as f:
+            f.write(pickle.dumps(self._optimizer.__getstate__()))
+
+    def load_optimizer_states(self, fname):
+        with open(fname, "rb") as f:
+            state = pickle.loads(f.read())
+        if self._optimizer is not None:
+            self._optimizer.__setstate__(state)
+
+    def barrier(self):
+        """Global barrier (dist parity): block on all local async work."""
+        from .. import engine
+
+        engine.waitall()
+
+    def _send_command_to_servers(self, head, body):  # parity stub
+        pass
+
+    def __repr__(self):
+        return "KVStore(type=%s, keys=%d)" % (self._type, len(self._store))

@@ -43,21 +43,40 @@ def _input_names(op: "_reg.Op"):
     return names
 
 
+def _attr_names(op: "_reg.Op", n_inputs: int):
+    """Keyword-param names after the tensor inputs, in signature order."""
+    try:
+        sig = inspect.signature(op.fn)
+    except (TypeError, ValueError):
+        return []
+    names = [p.name for p in sig.parameters.values()
+             if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                           inspect.Parameter.POSITIONAL_OR_KEYWORD)]
+    return names[n_inputs:]
+
+
 def _make_wrapper(name: str, op: "_reg.Op"):
     in_names = _input_names(op)
+    attr_names = _attr_names(op, len(in_names)) if in_names is not None else []
 
     def wrapper(*args, out=None, name=None, **kwargs):  # noqa: A002
-        inputs = []
-        for a in args:
-            inputs.append(a)
-        if in_names:
+        inputs = list(args)
+        if in_names is not None:
+            # trailing positional args beyond the tensor inputs are attrs
+            # (reference op-call convention: nd.swapaxes(x, 0, 1))
+            if len(inputs) > len(in_names):
+                extras = inputs[len(in_names):]
+                inputs = inputs[:len(in_names)]
+                for attr, val in zip(attr_names, extras):
+                    kwargs.setdefault(attr, val)
             # allow inputs passed as kwargs by reference name
             for n in in_names[len(inputs):]:
                 if n in kwargs:
                     inputs.append(kwargs.pop(n))
                 else:
                     break
-        kwargs.pop("ctx", None) if op.num_inputs not in (0, None) else None
+        if op.num_inputs not in (0, None):
+            kwargs.pop("ctx", None)
         return _reg.invoke(op.name, inputs, out=out, **kwargs)
 
     wrapper.__name__ = name

@@ -156,25 +156,25 @@ def _pooling(data, kernel=None, pool_type="max", global_pool=False,
     else:
         padding = [(0, 0), (0, 0)] + [(p, p) for p in pad]
 
+    # NB: scalar init values keep the reduce recognizable as the max/add
+    # monoid so XLA uses the dedicated (differentiable) pooling primitives.
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
-        return lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max,
-                                 window, strides, padding)
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \
+            else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, padding)
     if pool_type in ("avg", "sum"):
-        summed = lax.reduce_window(data, jnp.asarray(0, data.dtype), lax.add,
-                                   window, strides, padding)
+        summed = lax.reduce_window(data, 0.0 if jnp.issubdtype(
+            data.dtype, jnp.floating) else 0, lax.add, window, strides, padding)
         if pool_type == "sum":
             return summed
         if count_include_pad:
             return summed / np.prod(kernel)
         ones = jnp.ones_like(data)
-        counts = lax.reduce_window(ones, jnp.asarray(0, data.dtype), lax.add,
-                                   window, strides, padding)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
         return summed / counts
     if pool_type == "lp":
-        powed = lax.reduce_window(jnp.power(jnp.abs(data), p_value),
-                                  jnp.asarray(0, data.dtype), lax.add,
-                                  window, strides, padding)
+        powed = lax.reduce_window(jnp.power(jnp.abs(data), p_value), 0.0,
+                                  lax.add, window, strides, padding)
         return jnp.power(powed, 1.0 / p_value)
     raise ValueError("unknown pool_type %r" % pool_type)
 

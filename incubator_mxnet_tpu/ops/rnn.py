@@ -1,0 +1,168 @@
+"""Fused RNN operator (rnn_relu / rnn_tanh / lstm / gru).
+
+Parity: the reference's fused ``RNN`` op (``src/operator/rnn-inl.h:56``,
+cuDNN path ``rnn.cu``, CPU fused ``rnn_impl.h``).  TPU-native: one
+``lax.scan`` per layer/direction — XLA compiles the whole recurrence into a
+single fused loop on-device, which is this hardware's analog of the cuDNN
+fused kernel.
+
+Parameter packing (flat vector, matching the reference's layout contract:
+per layer, per direction: i2h weights, h2h weights, then at the very end all
+biases in the same order):  gate order is i,f,g,o for LSTM and r,z,n for GRU
+(reference convention, rnn-inl.h).
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = ["rnn_param_size", "rnn_cell_step", "rnn_layer_scan"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(num_layers, input_size, state_size, mode="lstm",
+                   bidirectional=False):
+    """Total flat parameter count (reference GetRnnParamSize semantics)."""
+    ngates = _GATES[mode]
+    ndir = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * ndir
+        size += ndir * ngates * state_size * (in_sz + state_size  # weights
+                                              + 2)  # two bias vectors
+    return size
+
+
+def _unpack_params(params, num_layers, input_size, state_size, mode, ndir):
+    """Split the flat vector into per-(layer,dir) (Wx, Wh, bx, bh)."""
+    ngates = _GATES[mode]
+    out = []
+    offset = 0
+    # weights first, then biases — matching the packed layout contract
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * ndir
+        for d in range(ndir):
+            wx_n = ngates * state_size * in_sz
+            wh_n = ngates * state_size * state_size
+            wx = params[offset:offset + wx_n].reshape(ngates * state_size, in_sz)
+            offset += wx_n
+            wh = params[offset:offset + wh_n].reshape(ngates * state_size,
+                                                      state_size)
+            offset += wh_n
+            out.append([wx, wh, None, None])
+    i = 0
+    for layer in range(num_layers):
+        for d in range(ndir):
+            b_n = ngates * state_size
+            out[i][2] = params[offset:offset + b_n]
+            offset += b_n
+            out[i][3] = params[offset:offset + b_n]
+            offset += b_n
+            i += 1
+    return [tuple(o) for o in out]
+
+
+def rnn_cell_step(mode, x, states, wx, wh, bx, bh):
+    """One timestep. states: (h,) or (h, c). Returns (out, new_states)."""
+    h = states[0]
+    gates = x @ wx.T + h @ wh.T + bx + bh
+    hidden = wh.shape[-1]
+    if mode == "rnn_relu":
+        h2 = jnp.maximum(gates, 0)
+        return h2, (h2,)
+    if mode == "rnn_tanh":
+        h2 = jnp.tanh(gates)
+        return h2, (h2,)
+    if mode == "lstm":
+        c = states[1]
+        i, f, g, o = (gates[..., k * hidden:(k + 1) * hidden] for k in range(4))
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c2 = f * c + i * g
+        h2 = o * jnp.tanh(c2)
+        return h2, (h2, c2)
+    if mode == "gru":
+        # gru needs separate bias application for the candidate gate
+        gx = x @ wx.T + bx
+        gh = h @ wh.T + bh
+        r = jax.nn.sigmoid(gx[..., :hidden] + gh[..., :hidden])
+        z = jax.nn.sigmoid(gx[..., hidden:2 * hidden] + gh[..., hidden:2 * hidden])
+        n = jnp.tanh(gx[..., 2 * hidden:] + r * gh[..., 2 * hidden:])
+        h2 = (1 - z) * n + z * h
+        return h2, (h2,)
+    raise ValueError(mode)
+
+
+def rnn_layer_scan(mode, data, h0, c0, wx, wh, bx, bh, reverse=False):
+    """Scan one layer/direction over time. data: (seq, batch, in)."""
+    init = (h0,) if mode != "lstm" else (h0, c0)
+
+    def step(carry, x):
+        out, new = rnn_cell_step(mode, x, carry, wx, wh, bx, bh)
+        return new, out
+
+    carry, outs = lax.scan(step, init, data, reverse=reverse)
+    return outs, carry
+
+
+@register("RNN", needs_rng=True)
+def _rnn(data, parameters, state, state_cell=None, state_size=None,
+         num_layers=1, mode="lstm", bidirectional=False, p=0.0,
+         state_outputs=False, projection_size=None, use_sequence_length=False,
+         sequence_length=None, lstm_state_clip_min=None,
+         lstm_state_clip_max=None, lstm_state_clip_nan=False, key=None):
+    """Fused multi-layer (bi)RNN.
+
+    data: (seq, batch, input).  state: (num_layers*ndir, batch, hidden).
+    Outputs: out (seq, batch, hidden*ndir) [+ final h [+ final c for lstm]]
+    when state_outputs.
+    """
+    ndir = 2 if bidirectional else 1
+    state_size = int(state_size)
+    num_layers = int(num_layers)
+    layers = _unpack_params(parameters, num_layers, data.shape[-1],
+                            state_size, mode, ndir)
+    from . import nn as _opsnn
+
+    train = _opsnn._is_train()
+
+    x = data
+    h_finals: List = []
+    c_finals: List = []
+    idx = 0
+    for layer in range(num_layers):
+        outs_dirs = []
+        for d in range(ndir):
+            wx, wh, bx, bh = layers[idx]
+            s = layer * ndir + d
+            h0 = state[s]
+            c0 = state_cell[s] if (mode == "lstm" and state_cell is not None) \
+                else jnp.zeros_like(h0)
+            outs, carry = rnn_layer_scan(mode, x, h0, c0, wx, wh, bx, bh,
+                                         reverse=(d == 1))
+            outs_dirs.append(outs)
+            h_finals.append(carry[0])
+            if mode == "lstm":
+                c_finals.append(carry[1])
+            idx += 1
+        x = outs_dirs[0] if ndir == 1 else jnp.concatenate(outs_dirs, axis=-1)
+        if train and p > 0 and layer < num_layers - 1 and key is not None:
+            mask = jax.random.bernoulli(jax.random.fold_in(key, layer),
+                                        1.0 - p, x.shape)
+            x = jnp.where(mask, x / (1.0 - p), 0.0).astype(x.dtype)
+
+    out = x
+    if not state_outputs:
+        return out
+    h_out = jnp.stack(h_finals, axis=0)
+    if mode == "lstm":
+        c_out = jnp.stack(c_finals, axis=0)
+        return out, h_out, c_out
+    return out, h_out

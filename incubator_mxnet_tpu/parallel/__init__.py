@@ -1,0 +1,11 @@
+"""``mx.parallel`` — TPU-native parallelism layer (SPMD over device meshes).
+
+Replaces the reference's KVStore comm trees / NCCL / ps-lite stack
+(SURVEY.md §2.5, §5.8) with jax.sharding + XLA collectives.
+"""
+from .mesh import Mesh, NamedSharding, P, PartitionSpec, make_mesh, replicated, shard_along
+from .train_step import FunctionalOptimizer, TrainStep, make_train_step
+
+__all__ = ["Mesh", "NamedSharding", "P", "PartitionSpec", "make_mesh",
+           "replicated", "shard_along", "FunctionalOptimizer", "TrainStep",
+           "make_train_step"]

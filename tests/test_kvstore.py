@@ -1,0 +1,111 @@
+"""KVStore tests (reference: tests/python/unittest/test_kvstore.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import kv, nd
+
+
+def test_create_types():
+    for t in ("local", "device", "dist_sync_device", "dist_async", "nccl"):
+        store = kv.create(t)
+        assert store.type == t
+    with pytest.raises(Exception):
+        kv.create("bogus_type")
+
+
+def test_init_push_pull():
+    store = kv.create("local")
+    store.init(3, nd.ones((2, 3)))
+    out = nd.zeros((2, 3))
+    store.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.ones((2, 3)))
+
+    store.push(3, nd.ones((2, 3)) * 4)
+    store.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 4 * np.ones((2, 3)))
+
+
+def test_aggregation():
+    """Push of a device-list aggregates (CommDevice::Reduce semantics)."""
+    store = kv.create("device")
+    store.init("w", nd.zeros((4,)))
+    vals = [nd.ones((4,)), nd.ones((4,)) * 2, nd.ones((4,)) * 3]
+    store.push("w", vals)
+    out = nd.zeros((4,))
+    store.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 6 * np.ones(4))
+
+
+def test_list_keys():
+    store = kv.create("local")
+    keys = [5, 7, 9]
+    store.init(keys, [nd.ones((2,))] * 3)
+    outs = [nd.zeros((2,)) for _ in keys]
+    store.pull(keys, out=outs)
+    for o in outs:
+        np.testing.assert_allclose(o.asnumpy(), np.ones(2))
+
+
+def test_pushpull():
+    store = kv.create("dist_sync_device")
+    store.init("g", nd.zeros((3,)))
+    out = nd.zeros((3,))
+    store.pushpull("g", nd.ones((3,)), out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.ones(3))
+
+
+def test_updater():
+    store = kv.create("local")
+    store.init("x", nd.ones((2,)))
+
+    def update(key, grad, weight):
+        weight += grad * 2
+
+    store.set_updater(update)
+    store.push("x", nd.ones((2,)))
+    out = nd.zeros((2,))
+    store.pull("x", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 3 * np.ones(2))
+
+
+def test_set_optimizer():
+    """update_on_kvstore: optimizer runs inside the store at push time."""
+    store = kv.create("local")
+    store.init(0, nd.ones((2,)))
+    store.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    store.push(0, nd.ones((2,)))  # w <- w - 0.1*g
+    out = nd.zeros((2,))
+    store.pull(0, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 0.9 * np.ones(2), rtol=1e-6)
+
+
+def test_row_sparse_pull():
+    store = kv.create("local")
+    store.init("emb", nd.array(np.arange(12, dtype=np.float32).reshape(4, 3)))
+    out = nd.zeros((4, 3))
+    store.row_sparse_pull("emb", out=out, row_ids=nd.array([1, 3]))
+    got = out.asnumpy()
+    np.testing.assert_allclose(got[1], [3, 4, 5])
+    np.testing.assert_allclose(got[3], [9, 10, 11])
+    np.testing.assert_allclose(got[0], np.zeros(3))
+
+
+def test_broadcast():
+    store = kv.create("device")
+    out = [nd.zeros((2,)), nd.zeros((2,))]
+    store.broadcast("b", nd.ones((2,)) * 5, out=out)
+    for o in out:
+        np.testing.assert_allclose(o.asnumpy(), 5 * np.ones(2))
+
+
+def test_rank_num_workers():
+    store = kv.create("local")
+    assert store.rank == 0
+    assert store.num_workers == 1
+
+
+def test_gradient_compression_api():
+    store = kv.create("dist_sync_device")
+    store.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    assert store._compression_params["type"] == "2bit"

@@ -1,0 +1,101 @@
+"""Model zoo + fused train step tests (reference: test_gluon_model_zoo.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.gluon.model_zoo import vision
+from incubator_mxnet_tpu.parallel import make_train_step
+
+
+def test_resnet18_forward():
+    net = vision.resnet18_v1(classes=10)
+    net.initialize(init=mx.init.Xavier())
+    out = net(nd.random.uniform(shape=(2, 3, 32, 32)))
+    assert out.shape == (2, 10)
+
+
+def test_resnet50_forward():
+    net = vision.resnet50_v1(classes=10)
+    net.initialize(init=mx.init.Xavier())
+    out = net(nd.random.uniform(shape=(1, 3, 64, 64)))
+    assert out.shape == (1, 10)
+
+
+def test_resnet_v2_forward():
+    net = vision.resnet18_v2(classes=7)
+    net.initialize(init=mx.init.Xavier())
+    out = net(nd.random.uniform(shape=(2, 3, 32, 32)))
+    assert out.shape == (2, 7)
+
+
+@pytest.mark.parametrize("name", ["mobilenet0_25", "squeezenet1_1"])
+def test_small_models_forward(name):
+    net = vision.get_model(name, classes=10)
+    net.initialize(init=mx.init.Xavier())
+    out = net(nd.random.uniform(shape=(1, 3, 224, 224)))
+    assert out.shape == (1, 10)
+
+
+def test_get_model_registry():
+    assert callable(vision.get_model)
+    with pytest.raises(ValueError):
+        vision.get_model("nonexistent_model")
+    for name in ["resnet50_v1", "vgg16", "alexnet", "densenet121",
+                 "mobilenet_v2_1_0", "inception_v3"]:
+        assert name in vision._models
+
+
+def test_fused_train_step_decreases_loss():
+    mx.random.seed(3)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(4))
+    net.initialize(init=mx.init.Xavier())
+    # run one eager forward to finish deferred init
+    net(nd.random.uniform(shape=(8, 16)))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = make_train_step(net, loss_fn, optimizer="sgd", learning_rate=0.5,
+                           momentum=0.9)
+    x = nd.random.uniform(shape=(64, 16))
+    y = nd.array(np.random.randint(0, 4, 64).astype(np.float32))
+    losses = [float(step(x, y).asscalar()) for _ in range(40)]
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_fused_train_step_resnet_smoke():
+    net = vision.resnet18_v1(classes=4)
+    net.initialize(init=mx.init.Xavier())
+    net(nd.random.uniform(shape=(2, 3, 32, 32)))  # finish deferred init
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = make_train_step(net, loss_fn, optimizer="sgd", learning_rate=0.1,
+                           momentum=0.9)
+    x = nd.random.uniform(shape=(4, 3, 32, 32))
+    y = nd.array([0.0, 1.0, 2.0, 3.0])
+    l1 = step(x, y)
+    l2 = step(x, y)
+    assert np.isfinite(l1.asscalar()) and np.isfinite(l2.asscalar())
+    # BN running stats must have moved
+    for name, p in net.collect_params().items():
+        if name.endswith("running_mean"):
+            assert np.abs(p.data().asnumpy()).sum() > 0
+            break
+
+
+def test_train_step_on_mesh():
+    """Data-parallel fused step over the virtual 8-device CPU mesh."""
+    from incubator_mxnet_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"dp": -1})
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(2))
+    net.initialize(init=mx.init.Xavier())
+    net(nd.random.uniform(shape=(8, 8)))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = make_train_step(net, loss_fn, optimizer="sgd", learning_rate=0.2,
+                           mesh=mesh, batch_axis="dp")
+    x = nd.random.uniform(shape=(16, 8))
+    y = nd.array(np.random.randint(0, 2, 16).astype(np.float32))
+    l1 = float(step(x, y).asscalar())
+    for _ in range(15):
+        loss = step(x, y)
+    assert float(loss.asscalar()) < l1

@@ -1,0 +1,155 @@
+"""RNN tests (reference: tests/python/unittest/test_gluon_rnn.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.gluon import rnn
+
+
+def test_lstm_layer_shapes():
+    layer = rnn.LSTM(16, num_layers=2)
+    layer.initialize()
+    x = nd.random.uniform(shape=(5, 3, 8))  # TNC
+    out = layer(x)
+    assert out.shape == (5, 3, 16)
+    states = layer.begin_state(3)
+    out, new_states = layer(x, states)
+    assert out.shape == (5, 3, 16)
+    assert new_states[0].shape == (2, 3, 16)
+    assert new_states[1].shape == (2, 3, 16)
+
+
+def test_gru_rnn_layers():
+    for layer in (rnn.GRU(8), rnn.RNN(8, activation="tanh")):
+        layer.initialize()
+        out = layer(nd.random.uniform(shape=(4, 2, 6)))
+        assert out.shape == (4, 2, 8)
+
+
+def test_bidirectional_lstm():
+    layer = rnn.LSTM(8, bidirectional=True)
+    layer.initialize()
+    out = layer(nd.random.uniform(shape=(4, 2, 6)))
+    assert out.shape == (4, 2, 16)
+
+
+def test_ntc_layout():
+    layer = rnn.LSTM(8, layout="NTC")
+    layer.initialize()
+    out = layer(nd.random.uniform(shape=(2, 4, 6)))
+    assert out.shape == (2, 4, 8)
+
+
+def test_lstm_grad_flows():
+    layer = rnn.LSTM(8)
+    layer.initialize()
+    x = nd.random.uniform(shape=(4, 2, 6))
+    x.attach_grad()
+    with autograd.record():
+        out = layer(x).sum()
+    out.backward()
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+    for name, p in layer.collect_params().items():
+        if p.grad_req != "null":
+            assert np.isfinite(p.grad().asnumpy()).all(), name
+
+
+def test_lstm_cell_unroll():
+    cell = rnn.LSTMCell(8)
+    cell.initialize()
+    x = nd.random.uniform(shape=(2, 5, 6))  # NTC
+    outputs, states = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 5, 8)
+    assert states[0].shape == (2, 8)
+
+
+def test_gru_rnn_cells():
+    for cell in (rnn.GRUCell(8), rnn.RNNCell(8)):
+        cell.initialize()
+        x = nd.random.uniform(shape=(3, 4))
+        states = cell.begin_state(3)
+        out, new_states = cell(x, states)
+        assert out.shape == (3, 8)
+
+
+def test_sequential_cell_stack():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(8))
+    stack.add(rnn.LSTMCell(8))
+    stack.initialize()
+    x = nd.random.uniform(shape=(2, 4))
+    states = stack.begin_state(2)
+    out, new_states = stack(x, states)
+    assert out.shape == (2, 8)
+    assert len(new_states) == 4
+
+
+def test_residual_cell():
+    cell = rnn.ResidualCell(rnn.GRUCell(6))
+    cell.initialize()
+    x = nd.random.uniform(shape=(2, 6))
+    out, _ = cell(x, cell.begin_state(2))
+    assert out.shape == (2, 6)
+
+
+def test_fused_matches_cell():
+    """Fused RNN op output == manual LSTMCell unroll with same weights."""
+    mx.random.seed(0)
+    layer = rnn.LSTM(4, num_layers=1)
+    layer.initialize()
+    x = nd.random.uniform(shape=(3, 2, 5))
+    out_fused = layer(x)
+
+    cell = rnn.LSTMCell(4)
+    cell.initialize()
+    cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+    out_cell, _ = cell.unroll(3, x.transpose((1, 0, 2)), layout="NTC",
+                              merge_outputs=True)
+    np.testing.assert_allclose(out_fused.asnumpy(),
+                               out_cell.transpose((1, 0, 2)).asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_lm_learns():
+    """Tiny LSTM language model overfits a repeated sequence (word_lm shape)."""
+    mx.random.seed(1)
+    vocab, hidden, seq, batch = 10, 32, 6, 4
+
+    class LM(gluon.Block):
+        def __init__(self):
+            super().__init__()
+            self.embed = gluon.nn.Embedding(vocab, 16)
+            self.lstm = rnn.LSTM(hidden)
+            self.out = gluon.nn.Dense(vocab)
+
+        def forward(self, x):
+            e = self.embed(x)  # (N,T,16)
+            h = self.lstm(e.transpose((1, 0, 2)))  # TNC
+            h2 = h.reshape((-1, hidden))
+            return self.out(h2)
+
+    np.random.seed(0)
+    seqs = np.tile(np.arange(seq + 1), (batch, 1)).astype(np.float32)
+    data = nd.array(seqs[:, :-1])
+    target = nd.array(seqs[:, 1:].T.reshape(-1))
+
+    net = LM()
+    net.initialize(init=mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.02})
+    first = None
+    for i in range(30):
+        with autograd.record():
+            out = net(data)
+            loss = loss_fn(out, target).mean()
+        loss.backward()
+        trainer.step(1)
+        if first is None:
+            first = float(loss.asscalar())
+    last = float(loss.asscalar())
+    assert last < first * 0.5, (first, last)
