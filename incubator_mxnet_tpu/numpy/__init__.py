@@ -1,0 +1,126 @@
+"""``mx.np`` — NumPy-compatible array API.
+
+Parity: ``python/mxnet/numpy`` (multiarray.py:141 ndarray subclass + operator
+set, SURVEY.md §2.7).  TPU-native: jax.numpy IS a NumPy-compatible array
+API, so this namespace re-exports jnp operations wrapped to consume/produce
+this framework's ``ndarray`` (which also records autograd).  ``mx.np.ndarray``
+is an alias of the framework NDArray.
+"""
+from __future__ import annotations
+
+import functools
+import sys
+
+import jax.numpy as _jnp
+import numpy as _onp
+
+from ..ndarray import NDArray
+from ..ndarray.ndarray import array as _nd_array
+
+ndarray = NDArray
+
+_DISPATCH_OPS = {
+    # mx.np name -> registered op (autograd-recorded path)
+    "add": "broadcast_add", "subtract": "broadcast_sub",
+    "multiply": "broadcast_mul", "divide": "broadcast_div",
+    "true_divide": "broadcast_div", "power": "broadcast_power",
+    "maximum": "broadcast_maximum", "minimum": "broadcast_minimum",
+    "mod": "broadcast_mod", "matmul": "batch_dot",
+}
+
+
+def _wrap_value(v):
+    if isinstance(v, (_jnp.ndarray,)) and not isinstance(v, NDArray):
+        return NDArray(v)
+    if isinstance(v, tuple):
+        return tuple(_wrap_value(x) for x in v)
+    if isinstance(v, list):
+        return [_wrap_value(x) for x in v]
+    return v
+
+
+def _unwrap(v):
+    if isinstance(v, NDArray):
+        return v._data
+    if isinstance(v, (tuple, list)):
+        return type(v)(_unwrap(x) for x in v)
+    return v
+
+
+def _make_np_fn(name, jfn):
+    @functools.wraps(jfn)
+    def fn(*args, **kwargs):
+        args = tuple(_unwrap(a) for a in args)
+        kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
+        out = jfn(*args, **kwargs)
+        return _wrap_value(out)
+
+    fn.__name__ = name
+    return fn
+
+
+def array(obj, dtype=None, ctx=None):
+    return _nd_array(obj, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, dtype=None, order="C", ctx=None):
+    return NDArray(_jnp.zeros(shape, dtype or _onp.float32))
+
+
+def ones(shape, dtype=None, order="C", ctx=None):
+    return NDArray(_jnp.ones(shape, dtype or _onp.float32))
+
+
+def full(shape, fill_value, dtype=None, order="C", ctx=None):
+    return NDArray(_jnp.full(shape, fill_value, dtype))
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None):
+    return NDArray(_jnp.arange(start, stop, step, dtype))
+
+
+def eye(N, M=None, k=0, dtype=None, ctx=None):  # noqa: N803
+    return NDArray(_jnp.eye(N, M, k, dtype or _onp.float32))
+
+
+# dtype aliases (numpy parity)
+float16 = _onp.float16
+float32 = _onp.float32
+float64 = _onp.float64
+int8 = _onp.int8
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+bool_ = _onp.bool_
+pi = _onp.pi
+e = _onp.e
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+dtype = _onp.dtype
+
+
+def __getattr__(name):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    if name in _DISPATCH_OPS:
+        from ..ops import registry as _reg
+
+        opname = _DISPATCH_OPS[name]
+
+        def fn(a, b, out=None, **kw):
+            return _reg.invoke(opname, [
+                a if isinstance(a, NDArray) else NDArray(_jnp.asarray(a)),
+                b if isinstance(b, NDArray) else NDArray(_jnp.asarray(b))],
+                out=out)
+
+        setattr(sys.modules[__name__], name, fn)
+        return fn
+    jfn = getattr(_jnp, name, None)
+    if jfn is None:
+        raise AttributeError("mx.np has no attribute %r" % name)
+    if callable(jfn):
+        wrapped = _make_np_fn(name, jfn)
+        setattr(sys.modules[__name__], name, wrapped)
+        return wrapped
+    return jfn

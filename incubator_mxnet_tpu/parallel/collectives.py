@@ -1,0 +1,60 @@
+"""Collective communication primitives.
+
+The distributed communication backend (SURVEY.md §5.8): where the reference
+routed gradients through CommDevice/NCCL/ps-lite, these are thin named
+wrappers over XLA collectives that ride ICI within a slice and DCN across
+slices.  Use inside ``shard_map`` bodies (or rely on GSPMD inserting them
+automatically from shardings).
+"""
+from __future__ import annotations
+
+from jax import lax
+
+__all__ = ["allreduce", "allgather", "reduce_scatter", "alltoall", "ppermute",
+           "axis_size", "axis_index", "pmean", "broadcast_from"]
+
+
+def allreduce(x, axis_name):
+    """Sum across the axis (ncclAllReduce / dist_sync analog)."""
+    return lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name):
+    return lax.pmean(x, axis_name)
+
+
+def allgather(x, axis_name, axis=0, tiled=True):
+    """Gather shards (ncclAllGather analog)."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, scatter_dimension=0, tiled=True):
+    """Sum then scatter (ncclReduceScatter analog; ZeRO grad sharding)."""
+    return lax.psum_scatter(x, axis_name,
+                            scatter_dimension=scatter_dimension, tiled=tiled)
+
+
+def alltoall(x, axis_name, split_axis, concat_axis, tiled=True):
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=tiled)
+
+
+def ppermute(x, axis_name, perm):
+    return lax.ppermute(x, axis_name, perm)
+
+
+def axis_size(axis_name):
+    return lax.psum(1, axis_name)
+
+
+def axis_index(axis_name):
+    return lax.axis_index(axis_name)
+
+
+def broadcast_from(x, axis_name, src=0):
+    """Broadcast src's shard to all (ncclBcast analog)."""
+    import jax.numpy as jnp
+
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name)

@@ -1,0 +1,85 @@
+"""Pipeline parallelism (GPipe-style) over a ``pp`` mesh axis.
+
+The reference's only model parallelism is manual ``group2ctx`` layer
+placement with engine-inserted copies (SURVEY.md §2.5).  TPU-native: stages
+are sharded over the ``pp`` axis inside one SPMD program; activations flow
+stage→stage via ``lax.ppermute`` (ICI neighbor hop) in a software-pipelined
+schedule of ``num_micro + num_stages - 1`` ticks.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["spmd_pipeline", "pipeline_apply"]
+
+
+def spmd_pipeline(stage_fn: Callable, stage_params, microbatches,
+                  axis_name="pp"):
+    """Run a uniform-stage pipeline inside shard_map.
+
+    stage_fn(params, x) -> y with y.shape == x.shape (uniform widths).
+    stage_params: this device's stage parameters (already sharded).
+    microbatches: (num_micro, mb, feat) — identical on every stage (stage 0
+    consumes them; later stages consume ppermuted activations).
+    Returns (num_micro, mb, feat) — the final-stage outputs (valid on every
+    device via a masked psum broadcast).
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    num_micro = microbatches.shape[0]
+    steps = num_micro + n - 1
+    perm = [(i, i + 1) for i in range(n - 1)]
+
+    buf0 = jnp.zeros_like(microbatches[0])
+    outs0 = jnp.zeros_like(microbatches)
+    try:
+        buf0 = lax.pcast(buf0, (axis_name,), to="varying")
+        outs0 = lax.pcast(outs0, (axis_name,), to="varying")
+    except AttributeError:
+        pass
+
+    def body(t, carry):
+        buf, outs = carry
+        inject = microbatches[jnp.clip(t, 0, num_micro - 1)]
+        x = jnp.where(idx == 0, inject, buf)
+        y = stage_fn(stage_params, x)
+        # stage 0 only computes for t < num_micro; stage s for s <= t < s+num_micro
+        active = (t >= idx) & (t < idx + num_micro)
+        y = jnp.where(active, y, buf)
+        out_slot = jnp.clip(t - (n - 1), 0, num_micro - 1)
+        is_out = (idx == n - 1) & (t >= n - 1)
+        outs = outs.at[out_slot].set(jnp.where(is_out, y, outs[out_slot]))
+        buf = lax.ppermute(y, axis_name, perm)
+        return buf, outs
+
+    _, outs = lax.fori_loop(0, steps, body, (buf0, outs0))
+    # broadcast final-stage outputs to all stages (masked psum)
+    outs = jnp.where(idx == n - 1, outs, jnp.zeros_like(outs))
+    return lax.psum(outs, axis_name)
+
+
+def pipeline_apply(stage_fn, all_stage_params, x, mesh: Mesh, num_micro=4,
+                   axis_name="pp"):
+    """Host-level: shard stage params over pp (leading axis) and run the
+    pipeline on batch ``x`` split into ``num_micro`` microbatches."""
+    assert x.shape[0] % num_micro == 0
+    micro = x.reshape((num_micro, x.shape[0] // num_micro) + x.shape[1:])
+
+    def inner(params, mb):
+        params = jax.tree.map(lambda p: p[0], params)  # local stage slice
+        return spmd_pipeline(stage_fn, params, mb, axis_name)
+
+    pspec = P(axis_name)
+    mapped = shard_map(inner, mesh=mesh,
+                       in_specs=(jax.tree.map(lambda _: pspec,
+                                              all_stage_params), P()),
+                       out_specs=P())
+    out = jax.jit(mapped)(all_stage_params, micro)
+    return out.reshape((-1,) + out.shape[2:])

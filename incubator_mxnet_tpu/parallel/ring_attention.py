@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 __all__ = ["attention_reference", "ring_attention", "ulysses_attention",
            "sharded_self_attention"]
@@ -79,6 +79,12 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
     m0 = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, sq), jnp.float32)
     o0 = jnp.zeros(q.shape, jnp.float32)
+    # constants must carry the 'varying over sp' type to sit in the scan carry
+    try:
+        m0, l0, o0 = (lax.pcast(x, (axis_name,), to="varying")
+                      for x in (m0, l0, o0))
+    except AttributeError:  # older jax without the VMA system
+        pass
     qf = q.astype(jnp.float32)
 
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -135,6 +141,5 @@ def sharded_self_attention(q, k, v, mesh: Mesh, seq_axis="sp", causal=False,
     spec = P(None, None, seq_axis, None)
     mapped = shard_map(
         functools.partial(fn, axis_name=seq_axis, causal=causal, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return jax.jit(mapped)(q, k, v)
