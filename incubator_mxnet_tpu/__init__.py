@@ -49,6 +49,7 @@ from . import util
 from .util import is_np_array, set_np, reset_np
 from .attribute import AttrScope
 from .name import NameManager
+from . import recordio
 from . import symbol
 from . import symbol as sym
 from .symbol import Symbol

@@ -3,6 +3,7 @@ from .parameter import Constant, DeferredInitializationError, Parameter, Paramet
 from .block import Block, CachedOp, HybridBlock, SymbolBlock
 from .trainer import Trainer
 from . import nn
+from . import data
 from . import loss
 from . import utils
 from . import model_zoo
@@ -10,5 +11,5 @@ from . import rnn
 from .utils import split_and_load
 
 __all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",
-           "SymbolBlock", "CachedOp", "Trainer", "nn", "loss", "utils",
-           "split_and_load"]
+           "SymbolBlock", "CachedOp", "Trainer", "nn", "data", "loss",
+           "utils", "split_and_load"]

@@ -1,0 +1,10 @@
+"""``mx.gluon.data`` — datasets, samplers, DataLoader (gluon/data parity)."""
+from .dataset import ArrayDataset, Dataset, RecordFileDataset, SimpleDataset
+from .sampler import (BatchSampler, RandomSampler, Sampler,
+                      SequentialSampler, SplitSampler)
+from .dataloader import DataLoader, default_batchify_fn
+from . import vision
+
+__all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset",
+           "Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",
+           "SplitSampler", "DataLoader", "default_batchify_fn", "vision"]

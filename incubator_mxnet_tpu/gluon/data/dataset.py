@@ -1,0 +1,175 @@
+"""Datasets.
+
+Parity surface: ``python/mxnet/gluon/data/dataset.py`` — Dataset,
+SimpleDataset, ArrayDataset, RecordFileDataset plus the `.transform` /
+`.transform_first` lazy-mapping combinators.
+"""
+from __future__ import annotations
+
+import os
+
+from ... import recordio as _recordio
+
+__all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset"]
+
+
+class Dataset:
+    """Abstract dataset: __getitem__ + __len__ (dataset.py:33)."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+    def filter(self, fn):
+        """Return a dataset with only samples for which fn(sample) is True."""
+        return _FilteredDataset(self, fn)
+
+    def shard(self, num_shards, index):
+        """Return the index-th of num_shards contiguous-strided shards.
+
+        The reference's distributed examples shard with SplitSampler
+        (example/distributed_training/cifar10_dist.py:58); on a TPU mesh
+        this is the per-host slice of the global batch.
+        """
+        assert 0 <= index < num_shards
+        return _ShardedDataset(self, num_shards, index)
+
+    def take(self, count):
+        return _TakenDataset(self, count)
+
+    def transform(self, fn, lazy=True):
+        """Map fn over samples (dataset.py:86)."""
+        trans = _LazyTransformDataset(self, fn)
+        if lazy:
+            return trans
+        return SimpleDataset([trans[i] for i in range(len(trans))])
+
+    def transform_first(self, fn, lazy=True):
+        """Apply fn only to the first element of each sample (dataset.py:110)."""
+        return self.transform(_TransformFirstClosure(fn), lazy)
+
+
+class _TransformFirstClosure:
+    """Picklable so DataLoader workers can ship the dataset."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, x, *args):
+        if args:
+            return (self._fn(x),) + args
+        return self._fn(x)
+
+
+class _LazyTransformDataset(Dataset):
+    def __init__(self, data, fn):
+        self._data = data
+        self._fn = fn
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        item = self._data[idx]
+        if isinstance(item, tuple):
+            return self._fn(*item)
+        return self._fn(item)
+
+
+class _FilteredDataset(Dataset):
+    def __init__(self, data, fn):
+        self._indices = [i for i in range(len(data)) if fn(data[i])]
+        self._data = data
+
+    def __len__(self):
+        return len(self._indices)
+
+    def __getitem__(self, idx):
+        return self._data[self._indices[idx]]
+
+
+class _ShardedDataset(Dataset):
+    def __init__(self, data, num_shards, index):
+        self._data = data
+        self._num = num_shards
+        self._index = index
+        length = len(data)
+        shard_len = length // num_shards
+        rest = length % num_shards
+        self._start = shard_len * index + min(index, rest)
+        self._end = self._start + shard_len + (index < rest)
+
+    def __len__(self):
+        return self._end - self._start
+
+    def __getitem__(self, idx):
+        return self._data[self._start + idx]
+
+
+class _TakenDataset(Dataset):
+    def __init__(self, data, count):
+        self._data = data
+        self._count = min(count, len(data))
+
+    def __len__(self):
+        return self._count
+
+    def __getitem__(self, idx):
+        if idx >= self._count:
+            raise IndexError
+        return self._data[idx]
+
+
+class SimpleDataset(Dataset):
+    """Wrap any sized indexable (dataset.py:219)."""
+
+    def __init__(self, data):
+        self._data = data
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        return self._data[idx]
+
+
+class ArrayDataset(Dataset):
+    """Zip of N equal-length arrays (dataset.py:159)."""
+
+    def __init__(self, *args):
+        assert len(args) > 0
+        self._length = len(args[0])
+        self._data = []
+        for i, data in enumerate(args):
+            assert len(data) == self._length, \
+                "All arrays must have the same length; got %d vs %d at arg %d" \
+                % (len(data), self._length, i)
+            if isinstance(data, (list, tuple)):
+                data = SimpleDataset(data)
+            self._data.append(data)
+
+    def __getitem__(self, idx):
+        if len(self._data) == 1:
+            return self._data[0][idx]
+        return tuple(d[idx] for d in self._data)
+
+    def __len__(self):
+        return self._length
+
+
+class RecordFileDataset(Dataset):
+    """Raw records from an indexed .rec file (dataset.py:242)."""
+
+    def __init__(self, filename):
+        self.idx_file = os.path.splitext(filename)[0] + ".idx"
+        self.filename = filename
+        self._record = _recordio.MXIndexedRecordIO(
+            self.idx_file, self.filename, "r")
+
+    def __getitem__(self, idx):
+        return self._record.read_idx(self._record.keys[idx])
+
+    def __len__(self):
+        return len(self._record.keys)

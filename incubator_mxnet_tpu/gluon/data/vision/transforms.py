@@ -1,0 +1,316 @@
+"""Vision transforms.
+
+Parity surface: ``python/mxnet/gluon/data/vision/transforms.py`` — Compose,
+Cast, ToTensor, Normalize, Resize, CenterCrop, RandomResizedCrop,
+RandomFlipLeftRight/TopBottom, color jitter family, RandomLighting.
+
+TPU-native note: transforms run on host numpy/XLA-CPU inside DataLoader
+workers (images are HWC uint8 there); the heavy device work is a single
+batched upload.  Resize uses jax.image (XLA) rather than OpenCV.
+"""
+from __future__ import annotations
+
+import random
+
+import jax.numpy as jnp
+import jax.image
+import numpy as np
+
+from ....ndarray import NDArray
+from ....ndarray import ndarray as _nd
+from ...block import Block
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
+           "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
+           "RandomSaturation", "RandomHue", "RandomColorJitter",
+           "RandomLighting"]
+
+
+def _data(x):
+    if isinstance(x, NDArray):
+        return x._data
+    return jnp.asarray(x)
+
+
+def _wrap(j):
+    return _nd.from_jax(j)
+
+
+class Compose(Block):
+    """Sequentially compose transforms (transforms.py:34)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        self._transforms = list(transforms)
+        for t in self._transforms:
+            if isinstance(t, Block):
+                self.register_child(t)
+
+    def forward(self, x):
+        for t in self._transforms:
+            x = t(x)
+        return x
+
+
+class Cast(Block):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return _wrap(_data(x).astype(self._dtype))
+
+
+class ToTensor(Block):
+    """HWC uint8 [0,255] → CHW float32 [0,1] (transforms.py:91)."""
+
+    def forward(self, x):
+        d = _data(x).astype(jnp.float32) / 255.0
+        if d.ndim == 3:
+            d = jnp.transpose(d, (2, 0, 1))
+        elif d.ndim == 4:
+            d = jnp.transpose(d, (0, 3, 1, 2))
+        return _wrap(d)
+
+
+class Normalize(Block):
+    """(x - mean) / std per channel on CHW float input (transforms.py:126)."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = np.asarray(mean, dtype=np.float32)
+        self._std = np.asarray(std, dtype=np.float32)
+
+    def forward(self, x):
+        d = _data(x)
+        mean = jnp.reshape(self._mean, (-1,) + (1,) * (d.ndim - 1)) \
+            if self._mean.ndim else self._mean
+        std = jnp.reshape(self._std, (-1,) + (1,) * (d.ndim - 1)) \
+            if self._std.ndim else self._std
+        if d.ndim == 4 and np.ndim(self._mean):
+            mean = jnp.reshape(self._mean, (1, -1, 1, 1))
+            std = jnp.reshape(self._std, (1, -1, 1, 1))
+        return _wrap((d - mean) / std)
+
+
+def _resize_hwc(d, size, interpolation=1):
+    """Resize HWC (or NHWC) image with jax.image; size=(w, h) or int."""
+    if isinstance(size, (tuple, list)):
+        w, h = size
+    else:
+        w = h = size
+    method = "nearest" if interpolation == 0 else "bilinear"
+    if d.ndim == 3:
+        shape = (h, w, d.shape[2])
+    else:
+        shape = (d.shape[0], h, w, d.shape[3])
+    return jax.image.resize(d.astype(jnp.float32), shape, method=method)
+
+
+class Resize(Block):
+    """Resize to (w, h) (transforms.py:234)."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        d = _data(x)
+        orig_dtype = d.dtype
+        size = self._size
+        if self._keep and not isinstance(size, (tuple, list)):
+            hgt, wid = (d.shape[0], d.shape[1]) if d.ndim == 3 else \
+                (d.shape[1], d.shape[2])
+            if hgt > wid:
+                size = (size, int(size * hgt / wid))
+            else:
+                size = (int(size * wid / hgt), size)
+        out = _resize_hwc(d, size, self._interpolation)
+        if orig_dtype == jnp.uint8:
+            out = jnp.clip(jnp.round(out), 0, 255).astype(jnp.uint8)
+        return _wrap(out)
+
+
+def _center_crop(d, size):
+    if isinstance(size, (tuple, list)):
+        w, h = size
+    else:
+        w = h = size
+    H, W = (d.shape[0], d.shape[1]) if d.ndim == 3 else (d.shape[1], d.shape[2])
+    y0 = max(0, (H - h) // 2)
+    x0 = max(0, (W - w) // 2)
+    if d.ndim == 3:
+        return d[y0:y0 + h, x0:x0 + w, :]
+    return d[:, y0:y0 + h, x0:x0 + w, :]
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        d = _data(x)
+        out = _center_crop(d, self._size)
+        size = self._size if isinstance(self._size, (tuple, list)) \
+            else (self._size, self._size)
+        H, W = (out.shape[0], out.shape[1]) if out.ndim == 3 \
+            else (out.shape[1], out.shape[2])
+        if (W, H) != tuple(size):
+            orig_dtype = d.dtype
+            out = _resize_hwc(out, size, self._interpolation)
+            if orig_dtype == jnp.uint8:
+                out = jnp.clip(jnp.round(out), 0, 255).astype(jnp.uint8)
+        return _wrap(out)
+
+
+class RandomResizedCrop(Block):
+    """Random area/aspect crop then resize (transforms.py:286)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = size
+        self._scale = scale
+        self._ratio = ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        d = _data(x)
+        assert d.ndim == 3, "RandomResizedCrop expects HWC image"
+        H, W = d.shape[0], d.shape[1]
+        area = H * W
+        for _ in range(10):
+            target_area = random.uniform(*self._scale) * area
+            aspect = random.uniform(*self._ratio)
+            w = int(round((target_area * aspect) ** 0.5))
+            h = int(round((target_area / aspect) ** 0.5))
+            if w <= W and h <= H:
+                x0 = random.randint(0, W - w)
+                y0 = random.randint(0, H - h)
+                crop = d[y0:y0 + h, x0:x0 + w, :]
+                break
+        else:
+            crop = _center_crop(d, min(H, W))
+        orig_dtype = d.dtype
+        out = _resize_hwc(crop, self._size, self._interpolation)
+        if orig_dtype == jnp.uint8:
+            out = jnp.clip(jnp.round(out), 0, 255).astype(jnp.uint8)
+        return _wrap(out)
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        d = _data(x)
+        if random.random() < 0.5:
+            d = d[..., ::-1, :] if d.ndim >= 2 else d
+        return _wrap(d)
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        d = _data(x)
+        if random.random() < 0.5:
+            axis = 0 if d.ndim == 3 else 1
+            d = jnp.flip(d, axis=axis)
+        return _wrap(d)
+
+
+def _to_float(d):
+    return d.astype(jnp.float32)
+
+
+class _RandomJitterBase(Block):
+    def __init__(self, amount):
+        super().__init__()
+        self._amount = amount
+
+    def _alpha(self):
+        return 1.0 + random.uniform(-self._amount, self._amount)
+
+
+class RandomBrightness(_RandomJitterBase):
+    def forward(self, x):
+        d = _to_float(_data(x))
+        return _wrap(jnp.clip(d * self._alpha(), 0, 255))
+
+
+class RandomContrast(_RandomJitterBase):
+    def forward(self, x):
+        d = _to_float(_data(x))
+        coef = jnp.asarray([[[0.299, 0.587, 0.114]]])
+        alpha = self._alpha()
+        gray = jnp.mean(d * coef)
+        return _wrap(jnp.clip(d * alpha + gray * (1.0 - alpha), 0, 255))
+
+
+class RandomSaturation(_RandomJitterBase):
+    def forward(self, x):
+        d = _to_float(_data(x))
+        coef = jnp.asarray([[[0.299, 0.587, 0.114]]])
+        alpha = self._alpha()
+        gray = jnp.sum(d * coef, axis=-1, keepdims=True)
+        return _wrap(jnp.clip(d * alpha + gray * (1.0 - alpha), 0, 255))
+
+
+class RandomHue(_RandomJitterBase):
+    def forward(self, x):
+        d = _to_float(_data(x))
+        alpha = random.uniform(-self._amount, self._amount)
+        u = np.cos(alpha * np.pi)
+        w = np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0],
+                       [0.0, u, -w],
+                       [0.0, w, u]])
+        tyiq = np.array([[0.299, 0.587, 0.114],
+                         [0.596, -0.274, -0.321],
+                         [0.211, -0.523, 0.311]])
+        ityiq = np.array([[1.0, 0.956, 0.621],
+                          [1.0, -0.272, -0.647],
+                          [1.0, -1.107, 1.705]])
+        t = ityiq @ bt @ tyiq
+        return _wrap(jnp.clip(d @ jnp.asarray(t.T, dtype=jnp.float32), 0, 255))
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
+
+    def forward(self, x):
+        ts = list(self._ts)
+        random.shuffle(ts)
+        for t in ts:
+            x = t(x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA noise (transforms.py:601)."""
+
+    _eigval = np.array([55.46, 4.794, 1.148], dtype=np.float32)
+    _eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]], dtype=np.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        d = _to_float(_data(x))
+        alpha = np.random.normal(0, self._alpha, size=(3,)).astype(np.float32)
+        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        return _wrap(jnp.clip(d + jnp.asarray(rgb), 0, 255))

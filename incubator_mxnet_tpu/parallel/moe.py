@@ -9,7 +9,6 @@ GSPMD turns the dispatch/combine einsums into all-to-alls over ICI.
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -24,7 +23,6 @@ def moe_ffn(x, gate_w, w1, b1, w2, b2, top_k=1):
     x: (tokens, d); gate_w: (d, E); w1: (E, d, hidden); w2: (E, hidden, d).
     Top-k gating with softmax-renormalized weights over the selected experts.
     """
-    tokens, d = x.shape
     num_experts = gate_w.shape[-1]
     logits = x @ gate_w  # (T, E)
     probs = jax.nn.softmax(logits, axis=-1)
@@ -48,7 +46,7 @@ def moe_ffn_sharded(x, gate_w, w1, b1, w2, b2, mesh: Mesh, top_k=1,
     repl = NamedSharding(mesh, P())
     fn = jax.jit(functools.partial(moe_ffn, top_k=top_k),
                  in_shardings=(repl, repl, NamedSharding(mesh, P(axis_name, None, None)),
-                               e_spec if b1.ndim == 2 else e_spec,
+                               e_spec,
                                NamedSharding(mesh, P(axis_name, None, None)),
                                e_spec),
                  out_shardings=repl)

@@ -8,7 +8,6 @@ schedule of ``num_micro + num_stages - 1`` ticks.
 """
 from __future__ import annotations
 
-import functools
 from typing import Callable
 
 import jax

@@ -1,0 +1,311 @@
+"""RecordIO: dmlc-compatible record file format + indexed variant.
+
+Parity surface: ``python/mxnet/recordio.py`` (MXRecordIO, MXIndexedRecordIO,
+IRHeader, pack/unpack/pack_img/unpack_img) over dmlc-core's recordio
+(``3rdparty/dmlc-core`` — format used by ``src/io/iter_image_recordio_2.cc``).
+
+The on-disk format is byte-compatible with dmlc recordio so `.rec` files made
+by the reference's ``tools/im2rec.py`` can be read here and vice versa:
+
+  [kMagic:4][lrec:4][data:len][pad to 4B]   per record
+  lrec = (cflag << 29) | length;  cflag 0=whole 1=begin 2=middle 3=end
+  records whose payload contains kMagic are split at those points.
+
+TPU-native note: this pure-python implementation is the portable path; the
+native C++ reader (``src/native`` in this repo) provides the threaded
+high-throughput pipeline for training input.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
+           "pack", "unpack", "unpack_img", "pack_img"]
+
+_kMagic = 0xCED7230A
+_MAGIC_BYTES = struct.pack("<I", _kMagic)
+
+
+class MXRecordIO:
+    """Sequential record reader/writer (recordio.py:36)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.fh = None
+        self.is_open = False
+        self.writable = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.fh = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.fh = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __getstate__(self):
+        """Override pickling behavior (so DataLoader workers can reopen)."""
+        is_open = self.is_open
+        self.close()
+        d = dict(self.__dict__)
+        d["is_open"] = is_open
+        fh = d.pop("fh", None)
+        if fh is not None:
+            d["fh"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        is_open = d.get("is_open", False)
+        self.is_open = False
+        self.fh = None
+        if is_open:
+            self.open()
+
+    def close(self):
+        if self.is_open and self.fh is not None:
+            self.fh.close()
+            self.fh = None
+            self.is_open = False
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.fh.tell()
+
+    def write(self, buf):
+        assert self.writable
+        if not isinstance(buf, (bytes, bytearray)):
+            buf = bytes(buf)
+        # split payload at embedded magics, dmlc style
+        parts = []
+        start = 0
+        n = len(buf)
+        i = buf.find(_MAGIC_BYTES)
+        while i != -1:
+            parts.append(buf[start:i])
+            start = i + 4
+            i = buf.find(_MAGIC_BYTES, start)
+        parts.append(buf[start:n])
+        for k, part in enumerate(parts):
+            if len(parts) == 1:
+                cflag = 0
+            elif k == 0:
+                cflag = 1
+            elif k == len(parts) - 1:
+                cflag = 3
+            else:
+                cflag = 2
+            lrec = (cflag << 29) | len(part)
+            self.fh.write(_MAGIC_BYTES)
+            self.fh.write(struct.pack("<I", lrec))
+            self.fh.write(part)
+            pad = (4 - (len(part) & 3)) & 3
+            if pad:
+                self.fh.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        out = bytearray()
+        expect_more = False
+        while True:
+            head = self.fh.read(8)
+            if len(head) < 8:
+                if expect_more:
+                    raise IOError("truncated multi-part record in %s" % self.uri)
+                return None
+            magic, lrec = struct.unpack("<II", head)
+            if magic != _kMagic:
+                raise IOError("invalid magic in %s" % self.uri)
+            cflag = lrec >> 29
+            length = lrec & ((1 << 29) - 1)
+            data = self.fh.read(length)
+            if len(data) < length:
+                raise IOError("truncated record in %s" % self.uri)
+            pad = (4 - (length & 3)) & 3
+            if pad:
+                self.fh.read(pad)
+            if cflag == 0:
+                return bytes(data)
+            if cflag == 1:
+                out = bytearray(data)
+                expect_more = True
+            elif cflag == 2:
+                out += _MAGIC_BYTES
+                out += data
+            elif cflag == 3:
+                out += _MAGIC_BYTES
+                out += data
+                return bytes(out)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access record file via .idx sidecar (recordio.py:160)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    line = line.strip().split("\t")
+                    key = self.key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+        if self.writable:
+            self.fidx = open(self.idx_path, "w")
+
+    def close(self):
+        if self.fidx is not None:
+            self.fidx.close()
+            self.fidx = None
+        super().close()
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        d["fidx"] = None
+        return d
+
+    def seek(self, idx):
+        assert not self.writable
+        pos = self.idx[idx]
+        self.fh.seek(pos)
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        assert self.writable
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write("%s\t%d\n" % (str(key), pos))
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+# IRHeader: flag, label, id, id2 — struct 'IfQQ' (recordio.py:259)
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack IRHeader + raw bytes into one record payload (recordio.py:276)."""
+    import numbers
+
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        ret = struct.pack(_IR_FORMAT, header.flag, float(header.label),
+                          header.id, header.id2)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        ret = struct.pack(_IR_FORMAT, header.flag, header.label,
+                          header.id, header.id2)
+        ret += label.tobytes()
+    return ret + s
+
+
+def unpack(s):
+    """Unpack a record payload into (IRHeader, bytes) (recordio.py:306)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[: header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def unpack_img(s, iscolor=1):
+    """Unpack record → (header, image ndarray HWC uint8) (recordio.py:329)."""
+    header, s = unpack(s)
+    img = _imdecode(s, iscolor)
+    return header, img
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack header + encoded image (recordio.py:355)."""
+    buf = _imencode(img, quality=quality, img_fmt=img_fmt)
+    return pack(header, buf)
+
+
+def _imdecode(buf, iscolor=1):
+    """Decode an image from bytes without OpenCV.
+
+    Supports raw .npy payloads always; JPEG/PNG when PIL or cv2 is present.
+    """
+    import io as _io
+
+    if isinstance(buf, (bytes, bytearray)) and bytes(buf[:6]) == b"\x93NUMPY":
+        return np.load(_io.BytesIO(bytes(buf)))
+    try:
+        import cv2  # noqa
+        arr = np.frombuffer(buf, dtype=np.uint8)
+        flag = 1 if iscolor else 0
+        img = cv2.imdecode(arr, flag)
+        return img[..., ::-1] if iscolor else img  # BGR→RGB
+    except ImportError:
+        pass
+    try:
+        from PIL import Image
+        img = Image.open(_io.BytesIO(bytes(buf)))
+        if iscolor:
+            img = img.convert("RGB")
+        else:
+            img = img.convert("L")
+        return np.asarray(img)
+    except ImportError as e:
+        raise ImportError(
+            "decoding compressed images requires cv2 or PIL; "
+            "raw .npy payloads are always supported") from e
+
+
+def _imencode(img, quality=95, img_fmt=".jpg"):
+    import io as _io
+
+    img = np.asarray(img)
+    if img_fmt == ".npy":
+        bio = _io.BytesIO()
+        np.save(bio, img)
+        return bio.getvalue()
+    try:
+        from PIL import Image
+        bio = _io.BytesIO()
+        fmt = {"jpg": "JPEG", "jpeg": "JPEG", "png": "PNG"}[
+            img_fmt.lstrip(".").lower()]
+        Image.fromarray(img).save(bio, format=fmt, quality=quality)
+        return bio.getvalue()
+    except ImportError:
+        # fall back to raw npy payload (decodable by _imdecode)
+        bio = _io.BytesIO()
+        np.save(bio, img)
+        return bio.getvalue()

@@ -1,0 +1,211 @@
+"""gluon.data + recordio tests (mirrors tests/python/unittest/test_gluon_data.py
+and test_recordio.py from the reference)."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import recordio
+from incubator_mxnet_tpu.gluon import data as gdata
+
+
+def test_recordio_roundtrip(tmp_path):
+    frec = str(tmp_path / "test.rec")
+    N = 10
+    writer = recordio.MXRecordIO(frec, "w")
+    for i in range(N):
+        writer.write(b"record_%d" % i)
+    writer.close()
+    reader = recordio.MXRecordIO(frec, "r")
+    for i in range(N):
+        assert reader.read() == b"record_%d" % i
+    assert reader.read() is None
+    reader.close()
+
+
+def test_recordio_embedded_magic(tmp_path):
+    # payloads containing the magic must round-trip via the split encoding
+    frec = str(tmp_path / "magic.rec")
+    import struct
+    payload = b"abc" + struct.pack("<I", 0xCED7230A) + b"def" + \
+        struct.pack("<I", 0xCED7230A)
+    w = recordio.MXRecordIO(frec, "w")
+    w.write(payload)
+    w.close()
+    r = recordio.MXRecordIO(frec, "r")
+    assert r.read() == payload
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    frec = str(tmp_path / "test.rec")
+    fidx = str(tmp_path / "test.idx")
+    N = 8
+    writer = recordio.MXIndexedRecordIO(fidx, frec, "w")
+    for i in range(N):
+        writer.write_idx(i, b"record_%d" % i)
+    writer.close()
+    reader = recordio.MXIndexedRecordIO(fidx, frec, "r")
+    for i in reversed(range(N)):
+        assert reader.read_idx(i) == b"record_%d" % i
+    reader.close()
+
+
+def test_irheader_pack_unpack():
+    s = b"\x01\x02\x03payload"
+    hdr = recordio.IRHeader(0, 3.5, 7, 0)
+    packed = recordio.pack(hdr, s)
+    hdr2, s2 = recordio.unpack(packed)
+    assert hdr2.label == 3.5 and hdr2.id == 7 and s2 == s
+    # multi-label
+    hdr = recordio.IRHeader(0, np.array([1.0, 2.0, 3.0], dtype=np.float32), 9, 0)
+    packed = recordio.pack(hdr, s)
+    hdr2, s2 = recordio.unpack(packed)
+    assert hdr2.flag == 3 and np.allclose(hdr2.label, [1, 2, 3]) and s2 == s
+
+
+def test_pack_img_npy_roundtrip():
+    img = (np.random.rand(8, 9, 3) * 255).astype(np.uint8)
+    buf = recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), img,
+                            img_fmt=".npy")
+    hdr, img2 = recordio.unpack_img(buf)
+    assert np.array_equal(img, img2)
+
+
+def test_array_dataset_and_loader():
+    X = np.random.rand(20, 3).astype(np.float32)
+    y = np.arange(20).astype(np.float32)
+    ds = gdata.ArrayDataset(X, y)
+    assert len(ds) == 20
+    x0, y0 = ds[3]
+    assert np.allclose(x0, X[3]) and y0 == 3
+    loader = gdata.DataLoader(ds, batch_size=6, last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 4
+    assert batches[0][0].shape == (6, 3)
+    assert batches[-1][0].shape == (2, 3)
+    # discard
+    loader = gdata.DataLoader(ds, batch_size=6, last_batch="discard")
+    assert len(list(loader)) == 3
+    # rollover keeps remainder for next epoch
+    loader = gdata.DataLoader(ds, batch_size=6, last_batch="rollover")
+    assert len(list(loader)) == 3
+    assert len(list(loader)) == 3
+
+
+def test_dataloader_shuffle_covers_all():
+    X = np.arange(30).astype(np.float32).reshape(30, 1)
+    ds = gdata.ArrayDataset(X)
+    loader = gdata.DataLoader(ds, batch_size=10, shuffle=True)
+    seen = np.concatenate([b.asnumpy().ravel() for b in loader])
+    assert sorted(seen.tolist()) == list(range(30))
+
+
+def test_dataloader_thread_workers():
+    X = np.random.rand(16, 4).astype(np.float32)
+    ds = gdata.ArrayDataset(X, np.arange(16).astype(np.float32))
+    loader = gdata.DataLoader(ds, batch_size=4, num_workers=2,
+                              thread_pool=True)
+    batches = list(loader)
+    assert len(batches) == 4
+    got = np.concatenate([b[1].asnumpy() for b in batches])
+    assert sorted(got.tolist()) == list(range(16))
+
+
+def test_dataset_transform_and_combinators():
+    X = np.arange(10).astype(np.float32)
+    ds = gdata.ArrayDataset(X, X * 2)
+    t = ds.transform_first(lambda x: x + 100)
+    a, b = t[4]
+    assert a == 104 and b == 8
+    sh = ds.shard(3, 0)
+    assert len(sh) == 4  # 10 = 4+3+3
+    assert len(ds.shard(3, 2)) == 3
+    tk = ds.take(3)
+    assert len(tk) == 3
+    flt = gdata.SimpleDataset(list(range(10))).filter(lambda x: x % 2 == 0)
+    assert len(flt) == 5
+
+
+def test_record_file_dataset(tmp_path):
+    frec = str(tmp_path / "img.rec")
+    fidx = str(tmp_path / "img.idx")
+    writer = recordio.MXIndexedRecordIO(fidx, frec, "w")
+    imgs = []
+    for i in range(5):
+        img = (np.random.rand(4, 4, 3) * 255).astype(np.uint8)
+        imgs.append(img)
+        writer.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, img_fmt=".npy"))
+    writer.close()
+    ds = gdata.vision.ImageRecordDataset(frec)
+    assert len(ds) == 5
+    img, label = ds[2]
+    assert label == 2.0
+    assert np.array_equal(img.asnumpy(), imgs[2])
+
+
+def test_transforms():
+    T = gdata.vision.transforms
+    img = (np.random.rand(10, 12, 3) * 255).astype(np.uint8)
+    x = mx.nd.array(img, dtype="uint8")
+    t = T.ToTensor()(x)
+    assert t.shape == (3, 10, 12)
+    assert t.asnumpy().max() <= 1.0
+    n = T.Normalize(mean=(0.5, 0.5, 0.5), std=(0.1, 0.2, 0.3))(t)
+    ref = (img.transpose(2, 0, 1) / 255.0 - np.array([0.5, 0.5, 0.5])[:, None, None]) \
+        / np.array([0.1, 0.2, 0.3])[:, None, None]
+    assert np.allclose(n.asnumpy(), ref, atol=1e-5)
+    r = T.Resize((6, 5))(x)
+    assert r.shape == (5, 6, 3)
+    c = T.CenterCrop(4)(x)
+    assert c.shape == (4, 4, 3)
+    rrc = T.RandomResizedCrop(8)(x)
+    assert rrc.shape == (8, 8, 3)
+    comp = T.Compose([T.Resize(8), T.ToTensor()])
+    out = comp(x)
+    assert out.shape == (3, 8, 8)
+    for tr in [T.RandomFlipLeftRight(), T.RandomFlipTopBottom(),
+               T.RandomBrightness(0.1), T.RandomContrast(0.1),
+               T.RandomSaturation(0.1), T.RandomHue(0.1),
+               T.RandomColorJitter(0.1, 0.1, 0.1, 0.1),
+               T.RandomLighting(0.1)]:
+        out = tr(x)
+        assert out.shape == x.shape
+
+
+def test_mnist_format_parse(tmp_path):
+    # write a tiny idx-ubyte pair and parse through the MNIST dataset class
+    import struct
+    root = tmp_path / "mnist"
+    root.mkdir()
+    imgs = (np.random.rand(7, 28, 28) * 255).astype(np.uint8)
+    labels = np.arange(7, dtype=np.uint8)
+    with open(root / "train-images-idx3-ubyte", "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 7, 28, 28))
+        f.write(imgs.tobytes())
+    with open(root / "train-labels-idx1-ubyte", "wb") as f:
+        f.write(struct.pack(">II", 2049, 7))
+        f.write(labels.tobytes())
+    ds = gdata.vision.MNIST(root=str(root), train=True)
+    assert len(ds) == 7
+    img, label = ds[3]
+    assert img.shape == (28, 28, 1)
+    assert label == 3
+    assert np.array_equal(img.asnumpy()[..., 0], imgs[3])
+
+
+def test_image_folder_dataset(tmp_path):
+    root = tmp_path / "folders"
+    for cls in ["cat", "dog"]:
+        (root / cls).mkdir(parents=True)
+    a = (np.random.rand(5, 5, 3) * 255).astype(np.uint8)
+    np.save(root / "cat" / "a.npy", a)
+    np.save(root / "dog" / "b.npy", a + 1 if a.max() < 255 else a)
+    ds = gdata.vision.ImageFolderDataset(str(root))
+    assert ds.synsets == ["cat", "dog"]
+    assert len(ds) == 2
+    img, label = ds[0]
+    assert label == 0 and img.shape == (5, 5, 3)
