@@ -107,6 +107,16 @@ class KVStore:
         XLA; eager lists are summed here)."""
         if isinstance(vals, NDArray):
             return vals._data
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if all(isinstance(v, RowSparseNDArray) for v in vals):
+            out = vals[0]
+            for v in vals[1:]:
+                out = out + v
+            return out  # stays row_sparse (CommCPU rowsparse reduce analog)
+        # mixed stypes: densify everything before reducing
+        vals = [v.todense() if isinstance(v, RowSparseNDArray) else v
+                for v in vals]
         arrs = [v._data if isinstance(v, NDArray) else jnp.asarray(v)
                 for v in vals]
         out = arrs[0]
@@ -125,8 +135,21 @@ class KVStore:
 
     def push(self, key, value, priority=0):
         keys, values = self._norm_keys_vals(key, value)
+        from ..ndarray.sparse import BaseSparseNDArray
+
         for k, v in zip(keys, values):
             merged = self._merge(v if isinstance(v, (list, tuple)) else [v])
+            if isinstance(merged, BaseSparseNDArray):
+                if k not in self._store:
+                    # match the dense path: an un-init'd key starts at zero
+                    self._store[k] = NDArray(
+                        jnp.zeros(merged.shape, merged.dtype))
+                if self._updater is not None:
+                    self._updater(self._str_to_int_key(k), merged,
+                                  self._store[k])
+                else:
+                    self._store[k]._data = merged.todense()._data
+                continue
             if k not in self._store:
                 self._store[k] = NDArray(jnp.zeros_like(merged))
             if self._updater is not None:

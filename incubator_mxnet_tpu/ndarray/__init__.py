@@ -18,9 +18,14 @@ from .ndarray import (NDArray, arange, array, concat, empty, from_jax, full,
 from . import utils
 from .utils import load, save
 from . import random  # noqa: F401
+from . import sparse
+from .sparse import (BaseSparseNDArray, CSRNDArray, RowSparseNDArray,
+                     cast_storage)
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
-           "concat", "stack", "waitall", "save", "load", "random", "from_jax"]
+           "concat", "stack", "waitall", "save", "load", "random", "from_jax",
+           "sparse", "BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray",
+           "cast_storage"]
 
 
 def _input_names(op: "_reg.Op"):

@@ -439,7 +439,7 @@ class NDArray:
 
     def tostype(self, stype):
         if stype != "default":
-            from ..sparse_nd import cast_storage
+            from .sparse import cast_storage
 
             return cast_storage(self, stype)
         return self

@@ -1,0 +1,558 @@
+"""``mx.nd.sparse`` — CSR and row-sparse tensors.
+
+Parity surface: ``python/mxnet/ndarray/sparse.py`` (BaseSparseNDArray :107,
+CSRNDArray :287, RowSparseNDArray :561) over C++ storage types
+``kCSRStorage``/``kRowSparseStorage`` (``include/mxnet/ndarray.h:61-66``)
+and the sparse kernels in ``src/operator/tensor/`` (dot CSR×dense,
+cast_storage, sparse_retain, square_sum) plus the row-sparse optimizer
+updates (``src/operator/optimizer_op.cc:895`` `_sparse_adagrad_update`
+and the lazy-update paths of sgd/adam).
+
+TPU-native design
+-----------------
+TPUs have no sparse MXU path, so (as SURVEY.md §7 "Hard parts" prescribes)
+sparse storage lives as *static-shape* coordinate arrays (``jax.Array``):
+
+- CSR:        ``data (nnz,)``, ``indices (nnz,) int64``, ``indptr (n+1,)``
+- row_sparse: ``data (k, *row_shape)``, ``indices (k,) int64``
+
+Compute that matters stays on-device and static-shaped:
+``dot(csr, dense)`` lowers to ``take`` + ``segment_sum`` (nnz is static, so
+XLA compiles it once per sparsity pattern); row-sparse optimizer updates
+lower to scatter (``at[rows].add``) touching only the live rows — the lazy
+update semantics of the reference.  Storage *conversions* (find the nonzero
+pattern) are inherently data-dependent-shape, so they run on host numpy,
+exactly like the reference runs cast_storage on CPU for most flows.
+"""
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import np_dtype
+from ..context import Context
+from ..ops import registry as _reg
+from .ndarray import NDArray
+
+__all__ = [
+    "BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray",
+    "csr_matrix", "row_sparse_array", "array", "zeros", "empty",
+    "cast_storage", "dot", "retain", "add", "subtract", "multiply",
+]
+
+_FALLBACK_VERBOSE = os.environ.get("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", "1")
+
+
+def _as_jax(x):
+    return x._data if isinstance(x, NDArray) else jnp.asarray(x)
+
+
+def _log_fallback(op, stypes):
+    """MXNET_STORAGE_FALLBACK_LOG_VERBOSE analog (src/common/utils.h)."""
+    if _FALLBACK_VERBOSE not in ("0", "false", "False"):
+        warnings.warn(
+            "%s: storage fallback to dense for stypes %s" % (op, stypes),
+            stacklevel=3)
+
+
+class BaseSparseNDArray:
+    """Common interface of CSRNDArray / RowSparseNDArray.
+
+    Deliberately NOT an NDArray subclass: like the reference, most dense
+    operators raise on sparse inputs instead of silently densifying; explicit
+    ``tostype('default')`` densifies.
+    """
+
+    stype = None  # set by subclass
+
+    def __init__(self, shape, dtype, ctx=None):
+        self._shape = tuple(int(s) for s in shape)
+        self._dtype = np.dtype(dtype)
+        self._ctx = ctx
+
+    # ------------------------------------------------------------------ meta
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self._shape)) if self._shape else 1
+
+    @property
+    def context(self):
+        from ..context import current_context
+
+        return self._ctx if self._ctx is not None else current_context()
+
+    ctx = context
+
+    @property
+    def grad(self):
+        return None
+
+    def __len__(self):
+        return self._shape[0]
+
+    def __repr__(self):
+        return "\n<%s %s @%s>" % (
+            type(self).__name__, "x".join(str(s) for s in self._shape),
+            self.context)
+
+    # ------------------------------------------------------------- transfers
+    def asnumpy(self):
+        return np.asarray(self._dense_data())
+
+    def wait_to_read(self):
+        return self
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def astype(self, dtype, copy=True):
+        raise NotImplementedError
+
+    def todense(self) -> NDArray:
+        return NDArray(self._dense_data(), self._ctx)
+
+    def tostype(self, stype):
+        if stype == self.stype:
+            return self
+        if stype == "default":
+            return self.todense()
+        return cast_storage(self, stype)
+
+    def as_in_context(self, ctx):
+        out = self.copy()
+        out._ctx = ctx
+        return out
+
+    def copyto(self, other):
+        if isinstance(other, Context):
+            return self.as_in_context(other)
+        if isinstance(other, NDArray):
+            other._data = self._dense_data()
+            return other
+        raise TypeError("copyto: unsupported target %r" % (other,))
+
+    # arithmetic — same-stype fast paths in subclasses; fallback densifies
+    def _fallback_binop(self, other, opname, reverse=False):
+        _log_fallback(opname, (self.stype, getattr(other, "stype", "scalar")))
+        lhs = self.todense()
+        rhs = other.todense() if isinstance(other, BaseSparseNDArray) else other
+        if reverse:
+            lhs, rhs = rhs, lhs
+        return _reg.invoke(opname, [lhs, rhs] if isinstance(rhs, NDArray)
+                           else [lhs, NDArray(jnp.asarray(rhs, self.dtype))])
+
+    def __add__(self, other):
+        return self._fallback_binop(other, "broadcast_add")
+
+    def __sub__(self, other):
+        return self._fallback_binop(other, "broadcast_sub")
+
+    def __mul__(self, other):
+        return self._fallback_binop(other, "broadcast_mul")
+
+    def __truediv__(self, other):
+        return self._fallback_binop(other, "broadcast_div")
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """2-D compressed-sparse-row tensor (``python/mxnet/ndarray/sparse.py:287``)."""
+
+    stype = "csr"
+
+    def __init__(self, data, indices, indptr, shape, dtype=None, ctx=None):
+        data, indices, indptr = (_as_jax(data), _as_jax(indices),
+                                 _as_jax(indptr))
+        if dtype is not None:
+            data = data.astype(np_dtype(dtype))
+        super().__init__(shape, data.dtype, ctx)
+        if len(self._shape) != 2:
+            raise ValueError("CSRNDArray is 2-D only, got shape %r" % (shape,))
+        self.data = NDArray(data, ctx)
+        self.indices = NDArray(jnp.asarray(indices, jnp.int64), ctx)
+        self.indptr = NDArray(jnp.asarray(indptr, jnp.int64), ctx)
+
+    @property
+    def nnz(self):
+        return int(self.indices.shape[0])
+
+    def _dense_data(self):
+        n, m = self._shape
+        flat = self.indptr._data  # (n+1,)
+        counts = flat[1:] - flat[:-1]
+        row_ids = jnp.repeat(jnp.arange(n, dtype=jnp.int64), counts,
+                             total_repeat_length=self.nnz)
+        out = jnp.zeros((n, m), self._dtype)
+        return out.at[row_ids, self.indices._data].add(self.data._data)
+
+    def _row_ids(self):
+        counts = self.indptr._data[1:] - self.indptr._data[:-1]
+        return jnp.repeat(jnp.arange(self._shape[0], dtype=jnp.int64), counts,
+                          total_repeat_length=self.nnz)
+
+    def copy(self):
+        return CSRNDArray(self.data._data, self.indices._data,
+                          self.indptr._data, self._shape, ctx=self._ctx)
+
+    def astype(self, dtype, copy=True):
+        return CSRNDArray(self.data._data.astype(np_dtype(dtype)),
+                          self.indices._data, self.indptr._data,
+                          self._shape, ctx=self._ctx)
+
+    def __getitem__(self, key):
+        """Row slicing returns a CSR slice (host-side repack)."""
+        if isinstance(key, int):
+            key = slice(key, key + 1)
+        if not isinstance(key, slice) or key.step not in (None, 1):
+            raise ValueError("CSRNDArray supports contiguous row slicing only")
+        start, stop, _ = key.indices(self._shape[0])
+        indptr = np.asarray(self.indptr._data)
+        lo, hi = int(indptr[start]), int(indptr[stop])
+        return CSRNDArray(self.data._data[lo:hi], self.indices._data[lo:hi],
+                          indptr[start:stop + 1] - lo,
+                          (stop - start, self._shape[1]), ctx=self._ctx)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse tensor (``python/mxnet/ndarray/sparse.py:561``): a subset of
+    rows is stored; all other rows are zero.  The canonical gradient type for
+    embeddings."""
+
+    stype = "row_sparse"
+
+    def __init__(self, data, indices, shape, dtype=None, ctx=None):
+        data, indices = _as_jax(data), _as_jax(indices)
+        if dtype is not None:
+            data = data.astype(np_dtype(dtype))
+        super().__init__(shape, data.dtype, ctx)
+        self.data = NDArray(data, ctx)          # (k, *row_shape)
+        self.indices = NDArray(jnp.asarray(indices, jnp.int64), ctx)  # (k,)
+        if self.data.shape[1:] != self._shape[1:]:
+            raise ValueError("row shape mismatch: %r vs %r"
+                             % (self.data.shape, self._shape))
+
+    def _dense_data(self):
+        out = jnp.zeros(self._shape, self._dtype)
+        # .add (not .set): tolerates duplicate indices like reference's
+        # row-sparse aggregation
+        return out.at[self.indices._data].add(self.data._data)
+
+    def copy(self):
+        return RowSparseNDArray(self.data._data, self.indices._data,
+                                self._shape, ctx=self._ctx)
+
+    def astype(self, dtype, copy=True):
+        return RowSparseNDArray(self.data._data.astype(np_dtype(dtype)),
+                                self.indices._data, self._shape, ctx=self._ctx)
+
+    def retain(self, indices):
+        return retain(self, indices)
+
+    def __add__(self, other):
+        if isinstance(other, RowSparseNDArray) and other.shape == self.shape:
+            # canonical row_sparse form (reference invariant): indices sorted
+            # and unique — merge duplicates by summation
+            idx = np.concatenate([np.asarray(self.indices._data),
+                                  np.asarray(other.indices._data)])
+            dat = jnp.concatenate([self.data._data, other.data._data])
+            uniq, inv = np.unique(idx, return_inverse=True)
+            merged = jax.ops.segment_sum(dat, jnp.asarray(inv),
+                                         num_segments=len(uniq))
+            return RowSparseNDArray(merged, uniq, self._shape, ctx=self._ctx)
+        return super().__add__(other)
+
+
+# ---------------------------------------------------------------------------
+# factories
+# ---------------------------------------------------------------------------
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None) -> CSRNDArray:
+    """``mx.nd.sparse.csr_matrix``: from (data, indices, indptr) or dense."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        if shape is None:
+            raise ValueError("shape is required for (data, indices, indptr)")
+        return CSRNDArray(data, indices, indptr, shape, dtype=dtype, ctx=ctx)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(arg1)
+    return _dense_to_csr(dense, ctx=ctx, dtype=dtype)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None) -> RowSparseNDArray:
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        if shape is None:
+            raise ValueError("shape is required for (data, indices)")
+        return RowSparseNDArray(data, indices, shape, dtype=dtype, ctx=ctx)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(arg1)
+    return _dense_to_rsp(dense, ctx=ctx, dtype=dtype)
+
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, BaseSparseNDArray):
+        out = source_array.copy() if dtype is None else source_array.astype(dtype)
+        if ctx is not None:
+            out._ctx = ctx
+        return out
+    raise ValueError("Please use mx.nd.array to create a dense array")
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    dtype = np_dtype(dtype)
+    if isinstance(shape, int):
+        shape = (shape,)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dtype), jnp.zeros((0,), jnp.int64),
+                          jnp.zeros((shape[0] + 1,), jnp.int64), shape, ctx=ctx)
+    if stype == "row_sparse":
+        return RowSparseNDArray(jnp.zeros((0,) + tuple(shape[1:]), dtype),
+                                jnp.zeros((0,), jnp.int64), shape, ctx=ctx)
+    if stype == "default":
+        from . import ndarray as _dense
+
+        return _dense.zeros(shape, ctx=ctx, dtype=dtype)
+    raise ValueError("unknown storage type %r" % stype)
+
+
+def empty(stype, shape, ctx=None, dtype="float32"):
+    return zeros(stype, shape, ctx=ctx, dtype=dtype)
+
+
+def _dense_to_csr(dense: np.ndarray, ctx=None, dtype=None) -> CSRNDArray:
+    if dtype is not None:
+        dense = dense.astype(np_dtype(dtype))
+    if dense.ndim != 2:
+        raise ValueError("csr requires 2-D input")
+    rows, cols = np.nonzero(dense)
+    indptr = np.zeros(dense.shape[0] + 1, np.int64)
+    np.add.at(indptr[1:], rows, 1)
+    indptr = np.cumsum(indptr)
+    return CSRNDArray(dense[rows, cols], cols.astype(np.int64), indptr,
+                      dense.shape, ctx=ctx)
+
+
+def _dense_to_rsp(dense: np.ndarray, ctx=None, dtype=None) -> RowSparseNDArray:
+    if dtype is not None:
+        dense = dense.astype(np_dtype(dtype))
+    flat = dense.reshape(dense.shape[0], -1)
+    live = np.nonzero(np.any(flat != 0, axis=1))[0]
+    return RowSparseNDArray(dense[live], live.astype(np.int64), dense.shape,
+                            ctx=ctx)
+
+
+# ---------------------------------------------------------------------------
+# storage conversion / structural ops
+# ---------------------------------------------------------------------------
+
+
+def cast_storage(arr, stype):
+    """``mx.nd.cast_storage`` (src/operator/tensor/cast_storage.cc).
+
+    Pattern discovery is data-dependent-shape → host numpy; the result's
+    arrays are device-resident again.
+    """
+    cur = getattr(arr, "stype", "default")
+    if cur == stype:
+        return arr
+    dense = arr.asnumpy()
+    if stype == "default":
+        return NDArray(jnp.asarray(dense), getattr(arr, "_ctx", None))
+    if stype == "csr":
+        return _dense_to_csr(dense, ctx=getattr(arr, "_ctx", None))
+    if stype == "row_sparse":
+        return _dense_to_rsp(dense, ctx=getattr(arr, "_ctx", None))
+    raise ValueError("unknown storage type %r" % stype)
+
+
+def retain(rsp: RowSparseNDArray, indices) -> RowSparseNDArray:
+    """``_sparse_retain`` (src/operator/tensor/sparse_retain.cc): keep only
+    the given rows of a row_sparse array."""
+    if not isinstance(rsp, RowSparseNDArray):
+        raise TypeError("retain expects RowSparseNDArray")
+    want = np.asarray(indices.asnumpy() if isinstance(indices, NDArray)
+                      else indices).astype(np.int64).ravel()
+    have = np.asarray(rsp.indices._data)
+    pos = {int(r): i for i, r in enumerate(have)}
+    keep_rows = [r for r in want if int(r) in pos]
+    sel = np.asarray([pos[int(r)] for r in keep_rows], np.int64)
+    return RowSparseNDArray(rsp.data._data[sel],
+                            np.asarray(keep_rows, np.int64), rsp.shape,
+                            ctx=rsp._ctx)
+
+
+# ---------------------------------------------------------------------------
+# compute: sparse dot
+# ---------------------------------------------------------------------------
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """``mx.nd.sparse.dot``: CSR × dense (src/operator/tensor/dot-inl.h).
+
+    Static-shape device compute: nnz is a compile-time constant, so the
+    gather/segment-sum program is XLA-compiled once per sparsity layout.
+    """
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray):
+        if transpose_b:
+            rhs = rhs.transpose()
+        d, col, row = lhs.data._data, lhs.indices._data, lhs._row_ids()
+        if not transpose_a:
+            # out[i,:] = Σ_{k in row i} data[k] * rhs[col[k],:]
+            contrib = d[:, None] * rhs._data[col]
+            out = jax.ops.segment_sum(contrib, row,
+                                      num_segments=lhs.shape[0])
+        else:
+            # out[j,:] = Σ_{k: col[k]==j} data[k] * rhs[row[k],:]
+            contrib = d[:, None] * rhs._data[row]
+            out = jax.ops.segment_sum(contrib, col,
+                                      num_segments=lhs.shape[1])
+        return NDArray(out, lhs._ctx)
+    if isinstance(lhs, NDArray) and isinstance(rhs, CSRNDArray):
+        # dense × csr = (csrᵀ × denseᵀ)ᵀ
+        return dot(rhs, lhs, transpose_a=not transpose_b,
+                   transpose_b=transpose_a).transpose()
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        return _reg.invoke("dot", [lhs, rhs], transpose_a=transpose_a,
+                           transpose_b=transpose_b)
+    raise TypeError("sparse.dot: unsupported combination (%s, %s)"
+                    % (getattr(lhs, "stype", "?"), getattr(rhs, "stype", "?")))
+
+
+def add(lhs, rhs):
+    if isinstance(lhs, BaseSparseNDArray):
+        return lhs + rhs
+    return rhs + lhs
+
+
+def subtract(lhs, rhs):
+    if isinstance(lhs, BaseSparseNDArray):
+        return lhs - rhs
+    return (rhs - lhs) * -1.0
+
+
+def multiply(lhs, rhs):
+    if isinstance(lhs, BaseSparseNDArray):
+        return lhs * rhs
+    return rhs * lhs
+
+
+# ---------------------------------------------------------------------------
+# row-sparse (lazy) optimizer updates
+# ---------------------------------------------------------------------------
+# Reference semantics (optimizer_op.cc lazy_update): only rows present in the
+# gradient are updated; untouched rows keep weight AND state unchanged.
+# Realized as jit-compiled scatter programs over the live rows.
+
+
+def _prep(grad: RowSparseNDArray, rescale_grad, clip_gradient):
+    g = grad.data._data * rescale_grad
+    # reference convention: clip_gradient < 0 means disabled
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g, grad.indices._data
+
+
+def _dense_update(opname, weight, grad, states, **kw):
+    """std_update path (lazy_update=False): densify and run the dense op so
+    wd decay reaches ALL rows, matching optimizer_op.cc std semantics."""
+    res = _reg.invoke(opname, [weight, grad.todense()] + list(states), **kw)
+    if not isinstance(res, (list, tuple)):
+        res = [res]
+    for dst, src in zip([weight] + list(states), res):
+        dst._data = src._data
+    return weight
+
+
+@jax.jit
+def _rsp_sgd(w, g, rows, lr, wd):
+    upd = g + wd * w[rows]
+    return w.at[rows].add(-lr * upd)
+
+
+@jax.jit
+def _rsp_sgd_mom(w, mom, g, rows, lr, wd, momentum):
+    m_rows = momentum * mom[rows] - lr * (g + wd * w[rows])
+    return w.at[rows].add(m_rows), mom.at[rows].set(m_rows)
+
+
+@jax.jit
+def _rsp_adam(w, mean, var, g, rows, lr, beta1, beta2, epsilon, wd):
+    g = g + wd * w[rows]
+    m_rows = beta1 * mean[rows] + (1 - beta1) * g
+    v_rows = beta2 * var[rows] + (1 - beta2) * g * g
+    step = lr * m_rows / (jnp.sqrt(v_rows) + epsilon)
+    return (w.at[rows].add(-step), mean.at[rows].set(m_rows),
+            var.at[rows].set(v_rows))
+
+
+@jax.jit
+def _rsp_adagrad(w, hist, g, rows, lr, epsilon, wd):
+    # matches dense _sparse_adagrad_update: wd folded into g, eps outside sqrt
+    g = g + wd * w[rows]
+    h_rows = hist[rows] + g * g
+    step = lr * g / (jnp.sqrt(h_rows) + epsilon)
+    return w.at[rows].add(-step), hist.at[rows].set(h_rows)
+
+
+def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+               clip_gradient=None, lazy_update=True):
+    if not lazy_update:
+        return _dense_update("sgd_update", weight, grad, [], lr=lr, wd=wd,
+                             rescale_grad=rescale_grad,
+                             clip_gradient=clip_gradient)
+    g, rows = _prep(grad, rescale_grad, clip_gradient)
+    weight._data = _rsp_sgd(weight._data, g, rows, lr, wd)
+    return weight
+
+
+def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=None, lazy_update=True):
+    if not lazy_update:
+        return _dense_update("sgd_mom_update", weight, grad, [mom], lr=lr,
+                             momentum=momentum, wd=wd,
+                             rescale_grad=rescale_grad,
+                             clip_gradient=clip_gradient)
+    g, rows = _prep(grad, rescale_grad, clip_gradient)
+    weight._data, mom._data = _rsp_sgd_mom(weight._data, mom._data, g, rows,
+                                           lr, wd, momentum)
+    return weight
+
+
+def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=None,
+                lazy_update=True):
+    if not lazy_update:
+        return _dense_update("adam_update", weight, grad, [mean, var], lr=lr,
+                             beta1=beta1, beta2=beta2, epsilon=epsilon, wd=wd,
+                             rescale_grad=rescale_grad,
+                             clip_gradient=clip_gradient)
+    g, rows = _prep(grad, rescale_grad, clip_gradient)
+    weight._data, mean._data, var._data = _rsp_adam(
+        weight._data, mean._data, var._data, g, rows, lr, beta1, beta2,
+        epsilon, wd)
+    return weight
+
+
+def adagrad_update(weight, grad, history, lr, epsilon=1e-7, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=None):
+    g, rows = _prep(grad, rescale_grad, clip_gradient)
+    weight._data, history._data = _rsp_adagrad(
+        weight._data, history._data, g, rows, lr, epsilon, wd)
+    return weight

@@ -152,6 +152,10 @@ class Optimizer:
         self.__dict__.update(state)
 
 
+def _is_row_sparse(grad):
+    return getattr(grad, "stype", "default") == "row_sparse"
+
+
 def _commit(targets, results):
     """Write update-op results back into the live buffers (in-place parity)."""
     if not isinstance(results, (list, tuple)):
@@ -175,6 +179,17 @@ class SGD(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         kw = self._common_kwargs(index)
+        if _is_row_sparse(grad):
+            # lazy update: scatter only the live rows (optimizer_op.cc lazy path)
+            from ..ndarray import sparse as _sp
+
+            if state is not None:
+                _sp.sgd_mom_update(weight, grad, state,
+                                   momentum=self.momentum,
+                                   lazy_update=self.lazy_update, **kw)
+            else:
+                _sp.sgd_update(weight, grad, lazy_update=self.lazy_update, **kw)
+            return
         if state is not None:
             res = _reg.invoke("sgd_mom_update", [weight, grad, state],
                               momentum=self.momentum, **kw)
@@ -238,6 +253,7 @@ class Adam(Optimizer):
         self.beta1 = beta1
         self.beta2 = beta2
         self.epsilon = epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         return (_nd.zeros(weight.shape, dtype=weight.dtype),
@@ -249,6 +265,13 @@ class Adam(Optimizer):
         kw = self._common_kwargs(index)
         kw["lr"] = kw["lr"] * math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
         mean, var = state
+        if _is_row_sparse(grad):
+            from ..ndarray import sparse as _sp
+
+            _sp.adam_update(weight, grad, mean, var, beta1=self.beta1,
+                            beta2=self.beta2, epsilon=self.epsilon,
+                            lazy_update=self.lazy_update, **kw)
+            return
         res = _reg.invoke("adam_update", [weight, grad, mean, var],
                           beta1=self.beta1, beta2=self.beta2,
                           epsilon=self.epsilon, **kw)
@@ -267,6 +290,12 @@ class AdaGrad(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         kw = self._common_kwargs(index)
+        if _is_row_sparse(grad):
+            from ..ndarray import sparse as _sp
+
+            _sp.adagrad_update(weight, grad, state,
+                               epsilon=self.float_stable_eps, **kw)
+            return
         res = _reg.invoke("_sparse_adagrad_update", [weight, grad, state],
                           epsilon=self.float_stable_eps, **kw)
         _commit([weight, state], res)

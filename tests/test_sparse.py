@@ -1,0 +1,259 @@
+"""Sparse NDArray tests (reference: tests/python/unittest/test_sparse_ndarray.py,
+test_sparse_operator.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def _rand_dense(shape, density=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    d = rng.normal(size=shape).astype(np.float32)
+    d[rng.uniform(size=shape) > density] = 0.0
+    return d
+
+
+def test_csr_roundtrip():
+    dense = _rand_dense((6, 5))
+    csr = nd.sparse.csr_matrix(dense)
+    assert csr.stype == "csr"
+    assert csr.shape == (6, 5)
+    np.testing.assert_array_equal(csr.asnumpy(), dense)
+    # (data, indices, indptr) ctor
+    csr2 = nd.sparse.csr_matrix((csr.data, csr.indices, csr.indptr),
+                                shape=(6, 5))
+    np.testing.assert_array_equal(csr2.asnumpy(), dense)
+
+
+def test_row_sparse_roundtrip():
+    dense = np.zeros((8, 3), np.float32)
+    dense[2] = 1.5
+    dense[5] = -2.0
+    rsp = nd.sparse.row_sparse_array(dense)
+    assert rsp.stype == "row_sparse"
+    assert rsp.data.shape == (2, 3)
+    np.testing.assert_array_equal(rsp.asnumpy(), dense)
+    rsp2 = nd.sparse.row_sparse_array((rsp.data, rsp.indices), shape=(8, 3))
+    np.testing.assert_array_equal(rsp2.asnumpy(), dense)
+
+
+def test_cast_storage():
+    dense = _rand_dense((5, 4))
+    arr = nd.array(dense)
+    csr = nd.cast_storage(arr, "csr")
+    assert csr.stype == "csr"
+    back = csr.tostype("default")
+    np.testing.assert_array_equal(back.asnumpy(), dense)
+    rsp = arr.tostype("row_sparse")
+    assert rsp.stype == "row_sparse"
+    np.testing.assert_array_equal(rsp.tostype("default").asnumpy(), dense)
+    # csr -> row_sparse via cast_storage
+    rsp2 = nd.cast_storage(csr, "row_sparse")
+    np.testing.assert_array_equal(rsp2.asnumpy(), dense)
+
+
+def test_sparse_zeros():
+    z = nd.sparse.zeros("csr", (3, 4))
+    assert z.nnz == 0
+    np.testing.assert_array_equal(z.asnumpy(), np.zeros((3, 4)))
+    zr = nd.sparse.zeros("row_sparse", (3, 4))
+    np.testing.assert_array_equal(zr.asnumpy(), np.zeros((3, 4)))
+
+
+@pytest.mark.parametrize("transpose_a", [False, True])
+def test_csr_dot_dense(transpose_a):
+    lhs = _rand_dense((6, 5), seed=1)
+    rhs = np.random.RandomState(2).normal(size=(6, 3) if transpose_a
+                                          else (5, 3)).astype(np.float32)
+    csr = nd.sparse.csr_matrix(lhs)
+    out = nd.sparse.dot(csr, nd.array(rhs), transpose_a=transpose_a)
+    expect = (lhs.T if transpose_a else lhs) @ rhs
+    np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-5, atol=1e-5)
+
+
+def test_csr_slice():
+    dense = _rand_dense((6, 5), seed=3)
+    csr = nd.sparse.csr_matrix(dense)
+    sl = csr[2:5]
+    assert sl.shape == (3, 5)
+    np.testing.assert_array_equal(sl.asnumpy(), dense[2:5])
+
+
+def test_retain():
+    dense = np.zeros((6, 2), np.float32)
+    dense[1] = 1
+    dense[3] = 3
+    dense[4] = 4
+    rsp = nd.sparse.row_sparse_array(dense)
+    kept = nd.sparse.retain(rsp, nd.array([1, 2, 4]))
+    expect = np.zeros_like(dense)
+    expect[1] = 1
+    expect[4] = 4
+    np.testing.assert_array_equal(kept.asnumpy(), expect)
+
+
+def test_rsp_add():
+    a = nd.sparse.row_sparse_array(_rand_dense((5, 3), seed=4))
+    b = nd.sparse.row_sparse_array(_rand_dense((5, 3), seed=5))
+    out = a + b
+    assert out.stype == "row_sparse"
+    np.testing.assert_allclose(out.asnumpy(), a.asnumpy() + b.asnumpy(),
+                               rtol=1e-6)
+
+
+def test_sparse_fallback_binop():
+    a = nd.sparse.csr_matrix(_rand_dense((4, 4), seed=6))
+    with pytest.warns(UserWarning):
+        out = a * nd.ones((4, 4))
+    np.testing.assert_allclose(out.asnumpy(), a.asnumpy(), rtol=1e-6)
+
+
+def test_sgd_lazy_update_touches_only_live_rows():
+    w0 = np.ones((6, 2), np.float32)
+    weight = nd.array(w0)
+    grad = nd.sparse.row_sparse_array(
+        (np.full((2, 2), 0.5, np.float32), np.array([1, 4])), shape=(6, 2))
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    state = opt.create_state(0, weight)
+    opt.update(0, weight, grad, state)
+    out = weight.asnumpy()
+    np.testing.assert_allclose(out[[0, 2, 3, 5]], w0[[0, 2, 3, 5]])
+    np.testing.assert_allclose(out[[1, 4]], 1.0 - 0.1 * 0.5, rtol=1e-6)
+    # momentum state only on live rows
+    st = state.asnumpy()
+    np.testing.assert_allclose(st[[0, 2, 3, 5]], 0.0)
+
+
+def test_adam_rowsparse_matches_dense_on_live_rows():
+    rng = np.random.RandomState(7)
+    w0 = rng.normal(size=(5, 3)).astype(np.float32)
+    g_dense = np.zeros_like(w0)
+    g_dense[1] = rng.normal(size=3)
+    g_dense[3] = rng.normal(size=3)
+
+    w_sparse = nd.array(w0)
+    opt1 = mx.optimizer.Adam(learning_rate=0.01, wd=0.0)
+    s1 = opt1.create_state(0, w_sparse)
+    rsp = nd.sparse.row_sparse_array(g_dense)
+    opt1.update(0, w_sparse, rsp, s1)
+
+    w_dense = nd.array(w0)
+    opt2 = mx.optimizer.Adam(learning_rate=0.01, wd=0.0)
+    s2 = opt2.create_state(0, w_dense)
+    opt2.update(0, w_dense, nd.array(g_dense), s2)
+
+    np.testing.assert_allclose(w_sparse.asnumpy()[[1, 3]],
+                               w_dense.asnumpy()[[1, 3]], rtol=1e-5, atol=1e-6)
+    # untouched rows unchanged (lazy semantics — dense update may also leave
+    # them unchanged for adam with zero grad only when states are zero)
+    np.testing.assert_allclose(w_sparse.asnumpy()[[0, 2, 4]], w0[[0, 2, 4]])
+
+
+def test_kvstore_rowsparse_push_pull():
+    kv = mx.kv.create("local")
+    kv.init("w", nd.ones((6, 2)))
+    opt = mx.optimizer.SGD(learning_rate=1.0)
+    kv.set_optimizer(opt)
+    grad = nd.sparse.row_sparse_array(
+        (np.ones((1, 2), np.float32), np.array([2])), shape=(6, 2))
+    kv.push("w", grad)
+    out = nd.zeros((6, 2))
+    kv.pull("w", out=out)
+    res = out.asnumpy()
+    np.testing.assert_allclose(res[2], 0.0, atol=1e-6)
+    np.testing.assert_allclose(res[0], 1.0)
+    # row_sparse_pull of selected rows
+    rout = nd.zeros((6, 2))
+    kv.row_sparse_pull("w", out=rout, row_ids=nd.array([0, 2]))
+    rr = rout.asnumpy()
+    np.testing.assert_allclose(rr[0], 1.0)
+    np.testing.assert_allclose(rr[2], 0.0, atol=1e-6)
+    np.testing.assert_allclose(rr[1], 0.0)
+
+
+def test_rsp_add_merges_duplicate_rows():
+    a = nd.sparse.row_sparse_array(
+        (np.ones((1, 2), np.float32), np.array([2])), shape=(5, 2))
+    b = nd.sparse.row_sparse_array(
+        (np.full((2, 2), 2.0, np.float32), np.array([2, 4])), shape=(5, 2))
+    out = a + b
+    assert out.stype == "row_sparse"
+    assert len(np.unique(np.asarray(out.indices.asnumpy()))) == out.indices.shape[0]
+    expect = np.zeros((5, 2), np.float32)
+    expect[2] = 3.0
+    expect[4] = 2.0
+    np.testing.assert_allclose(out.asnumpy(), expect)
+
+
+def test_multidevice_push_matches_dense(seed=11):
+    """Two row-sparse grads touching the same row == one dense grad."""
+    rng = np.random.RandomState(seed)
+    w0 = rng.normal(size=(6, 3)).astype(np.float32)
+    g1 = np.zeros_like(w0); g1[2] = 1.0; g1[4] = -1.0
+    g2 = np.zeros_like(w0); g2[2] = 0.5
+
+    def run(grads, sparse):
+        w = nd.array(w0)
+        opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+        st = opt.create_state(0, w)
+        for _ in range(3):
+            if sparse:
+                g = nd.sparse.row_sparse_array(grads[0]) + \
+                    nd.sparse.row_sparse_array(grads[1])
+            else:
+                g = nd.array(grads[0] + grads[1])
+            opt.update(0, w, g, st)
+        return w.asnumpy()
+
+    np.testing.assert_allclose(run((g1, g2), True)[[2, 4]],
+                               run((g1, g2), False)[[2, 4]],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lazy_update_false_decays_all_rows():
+    w = nd.array(np.ones((4, 2), np.float32))
+    grad = nd.sparse.row_sparse_array(
+        (np.ones((1, 2), np.float32), np.array([1])), shape=(4, 2))
+    opt = mx.optimizer.SGD(learning_rate=0.1, wd=0.1, lazy_update=False)
+    opt.update(0, w, grad, opt.create_state(0, w))
+    out = w.asnumpy()
+    np.testing.assert_allclose(out[0], 1.0 - 0.1 * 0.1, rtol=1e-6)  # wd only
+    np.testing.assert_allclose(out[1], 1.0 - 0.1 * 1.1, rtol=1e-6)
+
+
+def test_negative_clip_gradient_disabled():
+    w = nd.array(np.ones((3, 2), np.float32))
+    grad = nd.sparse.row_sparse_array(
+        (np.full((1, 2), 5.0, np.float32), np.array([0])), shape=(3, 2))
+    opt = mx.optimizer.SGD(learning_rate=0.1, clip_gradient=-1.0)
+    opt.update(0, w, grad, None)
+    np.testing.assert_allclose(w.asnumpy()[0], 1.0 - 0.5, rtol=1e-6)
+
+
+def test_adagrad_sparse_matches_dense():
+    rng = np.random.RandomState(13)
+    w0 = rng.normal(size=(5, 2)).astype(np.float32)
+    gd = np.zeros_like(w0); gd[1] = rng.normal(size=2); gd[3] = rng.normal(size=2)
+    ws, wd_ = nd.array(w0), nd.array(w0)
+    o1 = mx.optimizer.AdaGrad(learning_rate=0.1, wd=0.01)
+    o2 = mx.optimizer.AdaGrad(learning_rate=0.1, wd=0.01)
+    s1, s2 = o1.create_state(0, ws), o2.create_state(0, wd_)
+    o1.update(0, ws, nd.sparse.row_sparse_array(gd), s1)
+    o2.update(0, wd_, nd.array(gd), s2)
+    np.testing.assert_allclose(ws.asnumpy()[[1, 3]], wd_.asnumpy()[[1, 3]],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_kvstore_mixed_stype_push():
+    kv = mx.kv.create("local")
+    kv.init("k", nd.zeros((4, 2)))
+    dense = nd.ones((4, 2))
+    rsp = nd.sparse.row_sparse_array(
+        (np.ones((1, 2), np.float32), np.array([1])), shape=(4, 2))
+    kv.push("k", [dense, rsp])
+    out = nd.zeros((4, 2))
+    kv.pull("k", out=out)
+    expect = np.ones((4, 2), np.float32)
+    expect[1] += 1.0
+    np.testing.assert_allclose(out.asnumpy(), expect)
