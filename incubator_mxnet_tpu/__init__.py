@@ -58,6 +58,9 @@ from . import io
 from . import module
 from . import module as mod
 from . import model
+from . import test_utils
+from . import numpy as np  # noqa: A004 - mx.np NumPy-compatible namespace
+from . import numpy_extension as npx
 from . import parallel
 from . import kvstore
 from . import kvstore as kv

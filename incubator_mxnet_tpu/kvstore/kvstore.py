@@ -109,13 +109,15 @@ class KVStore:
             return vals._data
         from ..ndarray.sparse import RowSparseNDArray
 
+        from ..ndarray.sparse import BaseSparseNDArray
+
         if all(isinstance(v, RowSparseNDArray) for v in vals):
             out = vals[0]
             for v in vals[1:]:
                 out = out + v
             return out  # stays row_sparse (CommCPU rowsparse reduce analog)
-        # mixed stypes: densify everything before reducing
-        vals = [v.todense() if isinstance(v, RowSparseNDArray) else v
+        # mixed stypes / CSR: densify everything before reducing
+        vals = [v.todense() if isinstance(v, BaseSparseNDArray) else v
                 for v in vals]
         arrs = [v._data if isinstance(v, NDArray) else jnp.asarray(v)
                 for v in vals]
@@ -125,11 +127,15 @@ class KVStore:
         return out
 
     def init(self, key, value):
+        from ..ndarray.sparse import BaseSparseNDArray
+
         keys, values = self._norm_keys_vals(key, value)
         for k, v in zip(keys, values):
             if k in self._store:
                 continue
             v0 = v[0] if isinstance(v, (list, tuple)) else v
+            if isinstance(v0, BaseSparseNDArray):
+                v0 = v0.todense()
             self._store[k] = NDArray(v0._data if isinstance(v0, NDArray)
                                      else jnp.asarray(v0))
 

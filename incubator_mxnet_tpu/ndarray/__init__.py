@@ -18,6 +18,7 @@ from .ndarray import (NDArray, arange, array, concat, empty, from_jax, full,
 from . import utils
 from .utils import load, save
 from . import random  # noqa: F401
+from . import linalg  # noqa: F401
 from . import sparse
 from .sparse import (BaseSparseNDArray, CSRNDArray, RowSparseNDArray,
                      cast_storage)

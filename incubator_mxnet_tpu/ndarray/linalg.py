@@ -1,0 +1,41 @@
+"""``mx.nd.linalg`` — LAPACK-style operator namespace.
+
+Parity: ``python/mxnet/ndarray/linalg.py`` over the la_op family
+(``src/operator/tensor/la_op.cc``); implementations in ``ops/linalg.py``.
+"""
+from __future__ import annotations
+
+from ..ops import registry as _reg
+
+__all__ = ["gemm", "gemm2", "potrf", "potri", "trmm", "trsm", "syrk",
+           "gelqf", "syevd", "sumlogdiag", "extractdiag", "makediag",
+           "extracttrian", "maketrian", "inverse", "det", "slogdet"]
+
+
+def _make(opname):
+    def fn(*inputs, **attrs):
+        attrs.pop("name", None)
+        return _reg.invoke(opname, list(inputs), **attrs)
+
+    fn.__name__ = opname.replace("_linalg_", "")
+    fn.__doc__ = _reg.get_op(opname).doc
+    return fn
+
+
+gemm = _make("_linalg_gemm")
+gemm2 = _make("_linalg_gemm2")
+potrf = _make("_linalg_potrf")
+potri = _make("_linalg_potri")
+trmm = _make("_linalg_trmm")
+trsm = _make("_linalg_trsm")
+syrk = _make("_linalg_syrk")
+gelqf = _make("_linalg_gelqf")
+syevd = _make("_linalg_syevd")
+sumlogdiag = _make("_linalg_sumlogdiag")
+extractdiag = _make("_linalg_extractdiag")
+makediag = _make("_linalg_makediag")
+extracttrian = _make("_linalg_extracttrian")
+maketrian = _make("_linalg_maketrian")
+inverse = _make("_linalg_inverse")
+det = _make("_linalg_det")
+slogdet = _make("_linalg_slogdet")

@@ -266,19 +266,27 @@ class NDArray:
         return self
 
     # ------------------------------------------------------------- indexing
+    @staticmethod
+    def _index_key(k):
+        """NDArray key → jax index: bool masks stay bool (advanced boolean
+        indexing, mx.np semantics); numeric keys become int32."""
+        if k.dtype == np.bool_:
+            return k._data
+        return k._data.astype(jnp.int32)
+
     def __getitem__(self, key):
         if isinstance(key, NDArray):
-            key = key._data.astype(jnp.int32)
+            key = self._index_key(key)
         elif isinstance(key, tuple):
-            key = tuple(k._data.astype(jnp.int32) if isinstance(k, NDArray) else k
+            key = tuple(self._index_key(k) if isinstance(k, NDArray) else k
                         for k in key)
         return NDArray(self._data[key], self._ctx)
 
     def __setitem__(self, key, value):
         if isinstance(key, NDArray):
-            key = key._data.astype(jnp.int32)
+            key = self._index_key(key)
         elif isinstance(key, tuple):
-            key = tuple(k._data.astype(jnp.int32) if isinstance(k, NDArray) else k
+            key = tuple(self._index_key(k) if isinstance(k, NDArray) else k
                         for k in key)
         if isinstance(value, NDArray):
             value = value._data

@@ -220,6 +220,7 @@ class CSRNDArray(BaseSparseNDArray):
     def __getitem__(self, key):
         """Row slicing returns a CSR slice (host-side repack)."""
         if isinstance(key, int):
+            key = key % self._shape[0]
             key = slice(key, key + 1)
         if not isinstance(key, slice) or key.step not in (None, 1):
             raise ValueError("CSRNDArray supports contiguous row slicing only")
@@ -425,9 +426,9 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
                                       num_segments=lhs.shape[1])
         return NDArray(out, lhs._ctx)
     if isinstance(lhs, NDArray) and isinstance(rhs, CSRNDArray):
-        # dense × csr = (csrᵀ × denseᵀ)ᵀ
+        # op_a(A) @ op_b(B) = (op_!b(B) @ op_!a(A))ᵀ
         return dot(rhs, lhs, transpose_a=not transpose_b,
-                   transpose_b=transpose_a).transpose()
+                   transpose_b=not transpose_a).transpose()
     if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
         return _reg.invoke("dot", [lhs, rhs], transpose_a=transpose_a,
                            transpose_b=transpose_b)

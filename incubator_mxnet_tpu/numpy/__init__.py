@@ -16,6 +16,8 @@ import numpy as _onp
 
 from ..ndarray import NDArray
 from ..ndarray.ndarray import array as _nd_array
+from . import linalg  # noqa: F401
+from . import random  # noqa: F401
 
 ndarray = NDArray
 

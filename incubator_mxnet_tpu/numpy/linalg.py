@@ -1,0 +1,47 @@
+"""``mx.np.linalg`` — NumPy-compatible linalg (python/mxnet/numpy/linalg.py
+parity). Thin wrap of jnp.linalg returning framework NDArrays."""
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as _jnp
+
+from ..ndarray import NDArray
+
+_NAMES = ["norm", "svd", "cholesky", "qr", "inv", "det", "slogdet", "solve",
+          "lstsq", "pinv", "eig", "eigh", "eigvals", "eigvalsh", "matrix_rank",
+          "matrix_power", "multi_dot", "tensorinv", "tensorsolve", "cond"]
+
+__all__ = list(_NAMES)
+
+
+def _unwrap(v):
+    if isinstance(v, NDArray):
+        return v._data
+    if isinstance(v, (tuple, list)):
+        return type(v)(_unwrap(x) for x in v)
+    return v
+
+
+def _wrap(v):
+    if isinstance(v, _jnp.ndarray):
+        return NDArray(v)
+    if isinstance(v, tuple):
+        return tuple(_wrap(x) for x in v)
+    return v
+
+
+def _make(name):
+    jfn = getattr(_jnp.linalg, name)
+
+    @functools.wraps(jfn)
+    def fn(*args, **kwargs):
+        return _wrap(jfn(*[_unwrap(a) for a in args],
+                         **{k: _unwrap(v) for k, v in kwargs.items()}))
+
+    return fn
+
+
+for _n in _NAMES:
+    globals()[_n] = _make(_n)
+del _n

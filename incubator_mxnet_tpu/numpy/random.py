@@ -1,0 +1,125 @@
+"""``mx.np.random`` — NumPy-compatible random (python/mxnet/numpy/random.py
+parity), backed by the framework's stateful-over-philox PRNG (rng.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import jax.random as jrandom
+
+from .. import rng as _rng
+from ..ndarray import NDArray
+
+__all__ = ["seed", "uniform", "normal", "randint", "rand", "randn", "choice",
+           "shuffle", "multinomial", "gamma", "beta", "exponential",
+           "lognormal", "laplace", "pareto", "power", "rayleigh", "weibull"]
+
+
+def seed(s):
+    _rng.seed(s)
+
+
+def _shape(size):
+    if size is None:
+        return ()
+    return (size,) if isinstance(size, int) else tuple(size)
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None):
+    out = jrandom.uniform(_rng.next_key(), _shape(size),
+                          dtype or jnp.float32, low, high)
+    return NDArray(out, ctx)
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None):
+    out = loc + scale * jrandom.normal(_rng.next_key(), _shape(size),
+                                       dtype or jnp.float32)
+    return NDArray(out, ctx)
+
+
+def randint(low, high=None, size=None, dtype=None, ctx=None):
+    if high is None:
+        low, high = 0, low
+    out = jrandom.randint(_rng.next_key(), _shape(size), low, high)
+    return NDArray(out.astype(dtype or jnp.int64), ctx)
+
+
+def rand(*size):
+    return uniform(size=size or None)
+
+
+def randn(*size):
+    return normal(size=size or None)
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None):
+    n = int(a) if isinstance(a, (int, float)) else len(a)
+    pdat = p._data if isinstance(p, NDArray) else p
+    idx = jrandom.choice(_rng.next_key(), n, _shape(size), replace=replace,
+                         p=None if pdat is None else jnp.asarray(pdat))
+    if isinstance(a, (int, float)):
+        return NDArray(idx, ctx)
+    src = a._data if isinstance(a, NDArray) else jnp.asarray(a)
+    return NDArray(jnp.take(src, idx, axis=0), ctx)
+
+
+def shuffle(x):
+    """In-place shuffle along axis 0 (reference np.random.shuffle parity)."""
+    perm = jrandom.permutation(_rng.next_key(), x.shape[0])
+    x._data = jnp.take(x._data, perm, axis=0)
+
+
+def multinomial(n, pvals, size=None):
+    p = pvals._data if isinstance(pvals, NDArray) else jnp.asarray(pvals)
+    shape = _shape(size)
+    draws = jrandom.categorical(_rng.next_key(), jnp.log(p),
+                                shape=shape + (n,))
+    counts = (draws[..., :, None] ==
+              jnp.arange(p.shape[-1])[None, :]).sum(axis=-2)
+    return NDArray(counts.astype(jnp.int64))
+
+
+# distributions below follow numpy.random positional signatures exactly
+
+
+def gamma(shape, scale=1.0, size=None, ctx=None):
+    out = jrandom.gamma(_rng.next_key(), jnp.asarray(shape, jnp.float32),
+                        _shape(size) or None) * scale
+    return NDArray(out, ctx)
+
+
+def beta(a, b, size=None, ctx=None):
+    return NDArray(jrandom.beta(_rng.next_key(), a, b, _shape(size) or None),
+                   ctx)
+
+
+def exponential(scale=1.0, size=None, ctx=None):
+    return NDArray(jrandom.exponential(_rng.next_key(), _shape(size)) * scale,
+                   ctx)
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None, ctx=None):
+    out = jnp.exp(mean + sigma * jrandom.normal(_rng.next_key(), _shape(size)))
+    return NDArray(out, ctx)
+
+
+def laplace(loc=0.0, scale=1.0, size=None, ctx=None):
+    out = loc + scale * jrandom.laplace(_rng.next_key(), _shape(size))
+    return NDArray(out, ctx)
+
+
+def pareto(a, size=None, ctx=None):
+    return NDArray(jrandom.pareto(_rng.next_key(), a, _shape(size)) - 1.0, ctx)
+
+
+def power(a, size=None, ctx=None):
+    out = jrandom.uniform(_rng.next_key(), _shape(size)) ** (1.0 / a)
+    return NDArray(out, ctx)
+
+
+def rayleigh(scale=1.0, size=None, ctx=None):
+    u = jrandom.uniform(_rng.next_key(), _shape(size))
+    return NDArray(scale * jnp.sqrt(-2.0 * jnp.log1p(-u)), ctx)
+
+
+def weibull(a, size=None, ctx=None):
+    u = jrandom.uniform(_rng.next_key(), _shape(size))
+    return NDArray((-jnp.log1p(-u)) ** (1.0 / a), ctx)

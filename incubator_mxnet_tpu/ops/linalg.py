@@ -1,0 +1,178 @@
+"""Linear-algebra operators (the ``la_op`` family).
+
+Parity: ``src/operator/tensor/la_op.cc`` / ``la_op-inl.h`` — the LAPACK ops
+MXNet exposes as ``mx.nd.linalg.*`` (potrf, potri, gemm, gemm2, trmm, trsm,
+syrk, gelqf, syevd, sumlogdiag, extractdiag/makediag, extracttrian/maketrian,
+inverse, det, slogdet) via ``src/operator/c_lapack_api.cc``.
+
+TPU-native: every op is a jnp/lax.linalg composition — XLA lowers cholesky/
+triangular-solve/qr/eigh to its native TPU implementations, and batching over
+leading dims is free (the reference hand-loops LAPACK per matrix). Gradients
+come from JAX's builtin JVP rules for the decompositions.
+All ops operate on the last two axes with arbitrary leading batch dims.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _tr(x, do):
+    return jnp.swapaxes(x, -1, -2) if do else x
+
+
+@register("_linalg_gemm", num_inputs=3, aliases=("linalg_gemm",))
+def _gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0,
+          axis=-2):
+    """alpha * op(A) @ op(B) + beta * C  (la_op.cc GEMM); `axis` names the
+    matrix-row axis (moveaxis to -2, compute, move back)."""
+    A, B, C = (jnp.moveaxis(x, axis, -2) for x in (A, B, C))
+    out = alpha * jnp.matmul(_tr(A, transpose_a), _tr(B, transpose_b))
+    return jnp.moveaxis(out + beta * C, -2, axis)
+
+
+@register("_linalg_gemm2", num_inputs=2, aliases=("linalg_gemm2",))
+def _gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+    A, B = jnp.moveaxis(A, axis, -2), jnp.moveaxis(B, axis, -2)
+    out = alpha * jnp.matmul(_tr(A, transpose_a), _tr(B, transpose_b))
+    return jnp.moveaxis(out, -2, axis)
+
+
+@register("_linalg_potrf", num_inputs=1, aliases=("linalg_potrf",))
+def _potrf(A):
+    """Cholesky factor L (lower) of a SPD matrix: A = L Lᵀ."""
+    return jnp.linalg.cholesky(A)
+
+
+@register("_linalg_potri", num_inputs=1, aliases=("linalg_potri",))
+def _potri(A):
+    """Inverse of the SPD matrix whose Cholesky factor is the input L:
+    out = (L Lᵀ)⁻¹ (la_op.cc potri semantics — input is the factor)."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    linv = lax.linalg.triangular_solve(A, eye, left_side=True, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register("_linalg_trmm", num_inputs=2, aliases=("linalg_trmm",))
+def _trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    """Triangular matrix multiply: out = alpha * op(tri(A)) @ B (or B @ op)."""
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    tri = _tr(tri, transpose)
+    return alpha * (jnp.matmul(B, tri) if rightside else jnp.matmul(tri, B))
+
+
+@register("_linalg_trsm", num_inputs=2, aliases=("linalg_trsm",))
+def _trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    """Solve op(tri(A)) X = alpha B (or X op(tri(A)) = alpha B)."""
+    out = lax.linalg.triangular_solve(
+        A, alpha * B, left_side=not rightside, lower=lower,
+        transpose_a=transpose)
+    return out
+
+
+@register("_linalg_syrk", num_inputs=1, aliases=("linalg_syrk",))
+def _syrk(A, transpose=False, alpha=1.0):
+    """alpha * A Aᵀ (or alpha * Aᵀ A when transpose)."""
+    At = jnp.swapaxes(A, -1, -2)
+    return alpha * (jnp.matmul(At, A) if transpose else jnp.matmul(A, At))
+
+
+@register("_linalg_gelqf", num_inputs=1, num_outputs=2,
+          aliases=("linalg_gelqf",))
+def _gelqf(A):
+    """LQ factorization A = L Q with Q orthonormal rows (m <= n)."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
+    L = jnp.swapaxes(r, -1, -2)
+    Q = jnp.swapaxes(q, -1, -2)
+    # canonical form: diag(L) >= 0 (LAPACK convention used by the reference)
+    d = jnp.sign(jnp.diagonal(L, axis1=-2, axis2=-1))
+    d = jnp.where(d == 0, 1.0, d).astype(A.dtype)
+    return L * d[..., None, :], Q * d[..., :, None]
+
+
+@register("_linalg_syevd", num_inputs=1, num_outputs=2,
+          aliases=("linalg_syevd",))
+def _syevd(A):
+    """Symmetric eigendecomposition: A = Uᵀ diag(L) U (rows of U are the
+    eigenvectors, la_op.cc syevd convention)."""
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("_linalg_sumlogdiag", num_inputs=1, aliases=("linalg_sumlogdiag",))
+def _sumlogdiag(A):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("_linalg_extractdiag", num_inputs=1, aliases=("linalg_extractdiag",))
+def _extractdiag(A, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("_linalg_makediag", num_inputs=1, aliases=("linalg_makediag",))
+def _makediag(d, offset=0):
+    base = jnp.zeros(d.shape[:-1] + (d.shape[-1] + abs(offset),) * 2, d.dtype)
+    idx = jnp.arange(d.shape[-1])
+    r, c = (idx, idx + offset) if offset >= 0 else (idx - offset, idx)
+    return base.at[..., r, c].set(d)
+
+
+@register("_linalg_extracttrian", num_inputs=1, aliases=("linalg_extracttrian",))
+def _extracttrian(A, offset=0, lower=True):
+    n = A.shape[-1]
+    rows, cols = jnp.tril_indices(n, k=offset) if lower else \
+        jnp.triu_indices(n, k=offset)
+    return A[..., rows, cols]
+
+
+@register("_linalg_maketrian", num_inputs=1, aliases=("linalg_maketrian",))
+def _maketrian(d, offset=0, lower=True):
+    # infer n from packed length: len = n(n+1)/2 shifted by offset
+    ln = d.shape[-1]
+    n = 0
+    while _packed_len(n, offset, lower) < ln:
+        n += 1
+    rows, cols = jnp.tril_indices(n, k=offset) if lower else \
+        jnp.triu_indices(n, k=offset)
+    base = jnp.zeros(d.shape[:-1] + (n, n), d.dtype)
+    return base.at[..., rows, cols].set(d)
+
+
+def _packed_len(n, offset, lower):
+    import numpy as _np
+
+    r, _ = (_np.tril_indices(n, k=offset) if lower
+            else _np.triu_indices(n, k=offset))
+    return len(r)
+
+
+@register("_linalg_inverse", num_inputs=1, aliases=("linalg_inverse",))
+def _inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@register("_linalg_det", num_inputs=1, aliases=("linalg_det",))
+def _det(A):
+    return jnp.linalg.det(A)
+
+
+@register("_linalg_slogdet", num_inputs=1, num_outputs=2,
+          aliases=("linalg_slogdet",))
+def _slogdet(A):
+    sign, logabs = jnp.linalg.slogdet(A)
+    return sign, logabs
+
+
+@register("_npi_einsum", num_inputs=None, aliases=("einsum",))
+def _einsum(*operands, subscripts=""):
+    return jnp.einsum(subscripts, *operands)
+
+
+@register("_npi_tensordot", num_inputs=2, aliases=("tensordot",))
+def _tensordot(a, b, axes=2):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(x) if isinstance(x, (list, tuple)) else x
+                     for x in axes)
+    return jnp.tensordot(a, b, axes=axes)

@@ -18,7 +18,7 @@ from .symbol import (AUX_SUFFIXES, PARAM_INPUT_NAMES, Group, Symbol, Variable,
                      load_json, var)
 
 __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json", "zeros",
-           "ones", "arange"]
+           "ones", "arange", "linalg"]
 
 __is_symbol__ = True
 
@@ -46,7 +46,9 @@ def _compose_num_outputs(opname, attrs):
             "state_outputs") else (2 if attrs.get("state_outputs") else 1)
     if opname == "amp_multicast":
         return int(attrs.get("num_outputs", 1))
-    if opname in ("_linalg_slogdet", "linalg_slogdet", "batch_norm_stats"):
+    if opname in ("_linalg_slogdet", "linalg_slogdet", "batch_norm_stats",
+                  "_linalg_gelqf", "linalg_gelqf", "_linalg_syevd",
+                  "linalg_syevd"):
         return 2
     if opname == "moments":
         return 2
@@ -128,6 +130,9 @@ def __getattr__(attr_name):
     w = _make_wrapper(attr_name, op)
     setattr(sys.modules[__name__], attr_name, w)
     return w
+
+
+from . import linalg  # noqa: E402  (needs _invoke_symbol above)
 
 
 def zeros(shape, dtype="float32", **kwargs):

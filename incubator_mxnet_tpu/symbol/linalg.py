@@ -1,0 +1,36 @@
+"""``mx.sym.linalg`` — symbolic la_op namespace (python/mxnet/symbol/linalg.py
+parity); nodes resolve to ops/linalg.py implementations at bind time."""
+from __future__ import annotations
+
+from . import _invoke_symbol
+
+__all__ = ["gemm", "gemm2", "potrf", "potri", "trmm", "trsm", "syrk",
+           "gelqf", "syevd", "sumlogdiag", "extractdiag", "makediag",
+           "extracttrian", "maketrian", "inverse", "det", "slogdet"]
+
+
+def _make(opname):
+    def fn(*inputs, name=None, **attrs):
+        return _invoke_symbol(opname, list(inputs), attrs, name=name)
+
+    fn.__name__ = opname.replace("_linalg_", "")
+    return fn
+
+
+gemm = _make("_linalg_gemm")
+gemm2 = _make("_linalg_gemm2")
+potrf = _make("_linalg_potrf")
+potri = _make("_linalg_potri")
+trmm = _make("_linalg_trmm")
+trsm = _make("_linalg_trsm")
+syrk = _make("_linalg_syrk")
+gelqf = _make("_linalg_gelqf")
+syevd = _make("_linalg_syevd")
+sumlogdiag = _make("_linalg_sumlogdiag")
+extractdiag = _make("_linalg_extractdiag")
+makediag = _make("_linalg_makediag")
+extracttrian = _make("_linalg_extracttrian")
+maketrian = _make("_linalg_maketrian")
+inverse = _make("_linalg_inverse")
+det = _make("_linalg_det")
+slogdet = _make("_linalg_slogdet")

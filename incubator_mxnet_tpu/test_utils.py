@@ -1,0 +1,279 @@
+"""Test helpers — the de-facto oracle toolkit of the reference.
+
+Parity: ``python/mxnet/test_utils.py`` (2,464 LoC): ``default_context``
+(:58), ``assert_almost_equal`` (:534), ``rand_ndarray`` (:377),
+``check_numeric_gradient`` (:981), ``check_symbolic_forward/backward``
+(:1124), ``check_consistency`` (:1422).
+
+TPU analog of ``check_consistency``'s cpu-vs-gpu oracle: run the same symbol
+on the default device (TPU when present) and on XLA-CPU, cross-compare.
+"""
+from __future__ import annotations
+
+import numbers
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .context import Context, cpu, current_context
+from .ndarray import NDArray, array as nd_array
+from .symbol import Symbol
+
+__all__ = ["default_context", "set_default_context", "assert_almost_equal",
+           "almost_equal", "same", "rand_ndarray", "rand_shape_2d",
+           "rand_shape_3d", "rand_shape_nd", "check_numeric_gradient",
+           "check_symbolic_forward", "check_symbolic_backward",
+           "check_consistency", "simple_forward", "list_gpus",
+           "rand_sparse_ndarray"]
+
+_rng = np.random.RandomState(1234)
+
+
+def default_context() -> Context:
+    return current_context()
+
+
+def set_default_context(ctx: Context):
+    Context._default_ctx.value = ctx
+
+
+def list_gpus():
+    """Reference returns CUDA device ids; here: accelerator (TPU) ids."""
+    import jax
+
+    try:
+        return [d.id for d in jax.devices() if d.platform != "cpu"]
+    except Exception:
+        return []
+
+
+def same(a, b):
+    return np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+    rtol = 1e-5 if rtol is None else rtol
+    atol = 1e-20 if atol is None else atol
+    return np.allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol,
+                       equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False):
+    a = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, NDArray) else np.asarray(b)
+    rtol = 1e-5 if rtol is None else rtol
+    atol = 1e-20 if atol is None else atol
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol,
+                               equal_nan=equal_nan,
+                               err_msg="%s vs %s" % names)
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return _rng.randint(1, dim0 + 1), _rng.randint(1, dim1 + 1)
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (_rng.randint(1, dim0 + 1), _rng.randint(1, dim1 + 1),
+            _rng.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(_rng.randint(1, dim + 1, size=num_dim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None,
+                 ctx=None, modifier_func=None, shuffle_csr_indices=False,
+                 distribution="uniform"):
+    dtype = np.float32 if dtype is None else np.dtype(dtype)
+    if distribution == "powerlaw":
+        data = _rng.pareto(2.0, size=shape).astype(dtype)
+    else:
+        data = _rng.uniform(-1.0, 1.0, size=shape).astype(dtype)
+    if modifier_func is not None:
+        data = np.vectorize(modifier_func)(data).astype(dtype)
+    if stype in ("default", None):
+        return nd_array(data, ctx=ctx)
+    density = 0.1 if density is None else density
+    mask = _rng.uniform(size=shape) < density
+    data = data * mask
+    from .ndarray import sparse as _sp
+
+    if stype == "csr":
+        return _sp.csr_matrix(data, ctx=ctx)
+    if stype == "row_sparse":
+        return _sp.row_sparse_array(data, ctx=ctx)
+    raise ValueError("unknown stype %r" % stype)
+
+
+def rand_sparse_ndarray(shape, stype, density=None, dtype=None, **kw):
+    arr = rand_ndarray(shape, stype=stype, density=density, dtype=dtype)
+    return arr, (arr.asnumpy(),)
+
+
+def _norm_location(sym: Symbol, location):
+    names = sym.list_arguments()
+    if isinstance(location, dict):
+        return {k: (v.asnumpy() if isinstance(v, NDArray) else np.asarray(v))
+                for k, v in location.items()}
+    return {n: (v.asnumpy() if isinstance(v, NDArray) else np.asarray(v))
+            for n, v in zip(names, location)}
+
+
+def _bind(sym: Symbol, location: Dict[str, np.ndarray], ctx, grad_req="write",
+          aux_states=None):
+    args = {k: nd_array(v, ctx=ctx) for k, v in location.items()}
+    grads = {k: nd_array(np.zeros_like(v), ctx=ctx)
+             for k, v in location.items()} if grad_req != "null" else None
+    aux = None
+    if aux_states:
+        aux = {k: nd_array(v.asnumpy() if isinstance(v, NDArray)
+                           else np.asarray(v), ctx=ctx)
+               for k, v in aux_states.items()}
+    return sym.bind(ctx, args=args, args_grad=grads, grad_req=grad_req,
+                    aux_states=aux)
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    outputs = _bind(sym, {k: np.asarray(v) for k, v in inputs.items()},
+                    ctx or default_context(), grad_req="null").forward(
+                        is_train=is_train)
+    outs = [o.asnumpy() for o in outputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-5, atol=None,
+                           ctx=None, aux_states=None, equal_nan=False):
+    """Forward the symbol on `location`, compare against `expected`."""
+    ctx = ctx or default_context()
+    loc = _norm_location(sym, location)
+    exe = _bind(sym, loc, ctx, grad_req="null", aux_states=aux_states)
+    outputs = exe.forward(is_train=False)
+    for out, exp in zip(outputs, expected):
+        assert_almost_equal(out.asnumpy(), exp, rtol=rtol, atol=atol,
+                            equal_nan=equal_nan)
+    return [o.asnumpy() for o in outputs]
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
+                            atol=None, ctx=None, aux_states=None,
+                            grad_req="write", equal_nan=False):
+    """Backward the symbol with `out_grads`, compare input grads."""
+    ctx = ctx or default_context()
+    loc = _norm_location(sym, location)
+    exe = _bind(sym, loc, ctx, grad_req="write", aux_states=aux_states)
+    exe.forward(is_train=True)
+    exe.backward([nd_array(np.asarray(g), ctx=ctx) for g in out_grads])
+    expected = expected if isinstance(expected, dict) else \
+        dict(zip(sym.list_arguments(), expected))
+    grads = dict(zip(sym.list_arguments(), exe.grad_arrays))
+    for name, exp in expected.items():
+        assert_almost_equal(grads[name].asnumpy(), exp, rtol=rtol, atol=atol,
+                            equal_nan=equal_nan, names=("grad_" + name, "exp"))
+    return {k: (v.asnumpy() if v is not None else None)
+            for k, v in grads.items()}
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None, ctx=None,
+                           dtype=np.float64):
+    """Finite-difference gradient check (test_utils.py:981).
+
+    Projects multi-output symbols to a scalar via a fixed random projection
+    (the reference composes with MakeLoss the same way), then compares
+    d(proj·out)/dx from the executor backward pass against central
+    differences.
+    """
+    ctx = ctx or default_context()
+    loc = {k: v.astype(np.float64) for k, v in _norm_location(sym, location).items()}
+    names = sym.list_arguments()
+    grad_nodes = grad_nodes or [n for n in names if n in loc]
+
+    proj_rng = np.random.RandomState(42)
+    projs = None
+
+    def eval_scalar(loc_now):
+        nonlocal projs
+        exe = _bind(sym, loc_now, ctx, grad_req="null", aux_states=aux_states)
+        outs = [o.asnumpy() for o in exe.forward(is_train=True)]
+        if projs is None:
+            projs = [proj_rng.normal(size=o.shape) for o in outs]
+        return sum(float(np.sum(o * p)) for o, p in zip(outs, projs))
+
+    # symbolic gradient of the projected scalar
+    exe = _bind(sym, loc, ctx, grad_req="write", aux_states=aux_states)
+    outs = exe.forward(is_train=True)
+    if projs is None:
+        projs = [proj_rng.normal(size=o.shape) for o in outs]
+    exe.backward([nd_array(p.astype(np.float64), ctx=ctx) for p in projs])
+    sym_grads = dict(zip(names, exe.grad_arrays))
+
+    for name in grad_nodes:
+        base = loc[name]
+        num_grad = np.zeros_like(base, dtype=np.float64)
+        flat = base.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + numeric_eps / 2
+            fplus = eval_scalar(loc)
+            flat[i] = orig - numeric_eps / 2
+            fminus = eval_scalar(loc)
+            flat[i] = orig
+            num_grad.reshape(-1)[i] = (fplus - fminus) / numeric_eps
+        assert_almost_equal(sym_grads[name].asnumpy(), num_grad, rtol=rtol,
+                            atol=1e-4 if atol is None else atol,
+                            names=("symbolic_grad_" + name, "numeric_grad"))
+
+
+def check_consistency(sym, ctx_list=None, scale=1.0, grad_req="write",
+                      arg_params=None, rtol=None, atol=None,
+                      raise_on_err=True):
+    """Cross-device/dtype oracle (test_utils.py:1422).
+
+    ctx_list entries: dict(ctx=Context, <arg_name>=shape..., type_dict={...}).
+    Defaults to [accelerator, XLA-CPU] at float32 — the TPU analog of the
+    reference's gpu-vs-cpu comparison.
+    """
+    if ctx_list is None:
+        shapes = {}
+        ctx_list = [{"ctx": default_context(), **shapes},
+                    {"ctx": cpu(), **shapes}]
+    results = []
+    arg_names = sym.list_arguments()
+    base_shapes = {k: v for k, v in ctx_list[0].items()
+                   if k not in ("ctx", "type_dict")}
+    # infer the shapes of auto-created parameter variables (fc_weight, ...)
+    arg_shapes, _, _ = sym.infer_shape(**base_shapes)
+    full_shapes = dict(zip(arg_names, arg_shapes))
+    full_shapes.update(base_shapes)
+    init = {n: _rng.normal(size=full_shapes[n], scale=scale)
+            for n in arg_names if full_shapes.get(n) is not None}
+    if arg_params:
+        init.update({k: np.asarray(v) for k, v in arg_params.items()})
+    for spec in ctx_list:
+        ctx = spec.get("ctx", default_context())
+        tdict = spec.get("type_dict", {})
+        loc = {k: v.astype(tdict.get(k, np.float32)) for k, v in init.items()}
+        exe = _bind(sym, loc, ctx, grad_req=grad_req)
+        outs = [o.asnumpy() for o in exe.forward(is_train=grad_req != "null")]
+        grads = None
+        if grad_req != "null":
+            exe.backward([nd_array(np.ones(o.shape, o.dtype), ctx=ctx)
+                          for o in exe.outputs])
+            grads = [g.asnumpy() if g is not None else None
+                     for g in exe.grad_arrays]
+        results.append((outs, grads, spec))
+    ref_outs, ref_grads, _ = results[0]
+    for outs, grads, spec in results[1:]:
+        dt = list(spec.get("type_dict", {}).values())
+        tol = (2e-2 if np.float16 in dt else 1e-3) if rtol is None else rtol
+        for o, r in zip(outs, ref_outs):
+            assert_almost_equal(o.astype(np.float64), r.astype(np.float64),
+                                rtol=tol, atol=tol if atol is None else atol)
+        if grads is not None and ref_grads is not None:
+            for g, r in zip(grads, ref_grads):
+                if g is not None and r is not None:
+                    assert_almost_equal(g.astype(np.float64),
+                                        r.astype(np.float64), rtol=tol,
+                                        atol=tol if atol is None else atol)
+    return results

@@ -1,0 +1,284 @@
+"""la_op family, mx.np surface, and test_utils oracles.
+
+Reference models: tests/python/unittest/test_operator.py (test_laop*),
+test_numpy_op.py, and the test_utils.check_* helpers themselves.
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.test_utils import (assert_almost_equal,
+                                            check_consistency,
+                                            check_numeric_gradient,
+                                            check_symbolic_forward,
+                                            rand_ndarray)
+
+
+def _spd(n, batch=(), seed=0):
+    rng = np.random.RandomState(seed)
+    a = rng.normal(size=batch + (n, n)).astype(np.float64)
+    return (a @ np.swapaxes(a, -1, -2) + n * np.eye(n)).astype(np.float32)
+
+
+def test_potrf_potri():
+    A = _spd(4)
+    L = nd.linalg.potrf(nd.array(A))
+    np.testing.assert_allclose(L.asnumpy() @ L.asnumpy().T, A, rtol=1e-4,
+                               atol=1e-4)
+    Ainv = nd.linalg.potri(L)
+    np.testing.assert_allclose(Ainv.asnumpy() @ A, np.eye(4), rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_gemm_gemm2_batched():
+    rng = np.random.RandomState(1)
+    A = rng.normal(size=(2, 3, 4)).astype(np.float32)
+    B = rng.normal(size=(2, 4, 5)).astype(np.float32)
+    C = rng.normal(size=(2, 3, 5)).astype(np.float32)
+    out = nd.linalg.gemm(nd.array(A), nd.array(B), nd.array(C), alpha=2.0,
+                         beta=0.5)
+    np.testing.assert_allclose(out.asnumpy(), 2.0 * A @ B + 0.5 * C,
+                               rtol=1e-5)
+    out2 = nd.linalg.gemm2(nd.array(A), nd.array(B))
+    np.testing.assert_allclose(out2.asnumpy(), A @ B, rtol=1e-5)
+    # transpose flags
+    out3 = nd.linalg.gemm2(nd.array(A), nd.array(A), transpose_b=True)
+    np.testing.assert_allclose(out3.asnumpy(), A @ np.swapaxes(A, -1, -2),
+                               rtol=1e-5)
+
+
+def test_trmm_trsm():
+    rng = np.random.RandomState(2)
+    A = np.tril(rng.normal(size=(3, 3)) + 3 * np.eye(3)).astype(np.float32)
+    B = rng.normal(size=(3, 4)).astype(np.float32)
+    out = nd.linalg.trmm(nd.array(A), nd.array(B))
+    np.testing.assert_allclose(out.asnumpy(), A @ B, rtol=1e-5)
+    X = nd.linalg.trsm(nd.array(A), nd.array(A @ B))
+    np.testing.assert_allclose(X.asnumpy(), B, rtol=1e-4, atol=1e-4)
+
+
+def test_syrk_sumlogdiag_diagops():
+    rng = np.random.RandomState(3)
+    A = rng.normal(size=(3, 4)).astype(np.float32)
+    np.testing.assert_allclose(nd.linalg.syrk(nd.array(A)).asnumpy(),
+                               A @ A.T, rtol=1e-5)
+    S = _spd(4, seed=5)
+    L = np.linalg.cholesky(S).astype(np.float32)
+    sld = nd.linalg.sumlogdiag(nd.array(L)).asscalar()
+    np.testing.assert_allclose(sld, np.sum(np.log(np.diag(L))), rtol=1e-5)
+    d = nd.linalg.extractdiag(nd.array(S))
+    np.testing.assert_allclose(d.asnumpy(), np.diag(S), rtol=1e-6)
+    D = nd.linalg.makediag(d)
+    np.testing.assert_allclose(D.asnumpy(), np.diag(np.diag(S)), rtol=1e-6)
+    packed = nd.linalg.extracttrian(nd.array(S))
+    trian = nd.linalg.maketrian(packed)
+    np.testing.assert_allclose(trian.asnumpy(), np.tril(S), rtol=1e-6)
+
+
+def test_gelqf_syevd():
+    rng = np.random.RandomState(4)
+    A = rng.normal(size=(3, 5)).astype(np.float32)
+    L, Q = nd.linalg.gelqf(nd.array(A))
+    np.testing.assert_allclose(L.asnumpy() @ Q.asnumpy(), A, rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(Q.asnumpy() @ Q.asnumpy().T, np.eye(3),
+                               rtol=1e-4, atol=1e-4)
+    assert np.all(np.diag(L.asnumpy()) >= 0)
+    S = _spd(4, seed=6)
+    U, lam = nd.linalg.syevd(nd.array(S))
+    recon = U.asnumpy().T @ np.diag(lam.asnumpy()) @ U.asnumpy()
+    np.testing.assert_allclose(recon, S, rtol=1e-3, atol=1e-3)
+
+
+def test_det_inverse_slogdet():
+    S = _spd(3, seed=7)
+    np.testing.assert_allclose(nd.linalg.det(nd.array(S)).asscalar(),
+                               np.linalg.det(S), rtol=1e-4)
+    np.testing.assert_allclose(
+        nd.linalg.inverse(nd.array(S)).asnumpy() @ S, np.eye(3), atol=1e-3)
+    sign, logabs = nd.linalg.slogdet(nd.array(S))
+    np.testing.assert_allclose(sign.asscalar(), 1.0)
+    np.testing.assert_allclose(logabs.asscalar(), np.log(np.linalg.det(S)),
+                               rtol=1e-4)
+
+
+def test_potrf_gradient_flows():
+    """Cholesky has a JVP — autograd through potrf."""
+    S = _spd(3, seed=8)
+    x = nd.array(S)
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.linalg.sumlogdiag(nd.linalg.potrf(x))
+    y.backward()
+    # d/dA sum(log(diag(chol(A)))) = 0.5 * A^{-1}
+    expect = 0.5 * np.linalg.inv(S)
+    np.testing.assert_allclose(x.grad.asnumpy(), expect, rtol=1e-2, atol=1e-3)
+
+
+def test_sym_linalg_namespace():
+    a = mx.sym.var("a")
+    out = mx.sym.linalg.potrf(a)
+    S = _spd(3, seed=9)
+    exe = out.bind(mx.cpu(), args={"a": nd.array(S)})
+    res = exe.forward()[0].asnumpy()
+    np.testing.assert_allclose(res @ res.T, S, rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------- mx.np
+
+
+def test_np_basics():
+    a = mx.np.array([[1.0, 2.0], [3.0, 4.0]])
+    b = mx.np.ones((2, 2))
+    out = mx.np.add(a, b)
+    assert isinstance(out, mx.np.ndarray)
+    np.testing.assert_allclose(out.asnumpy(), a.asnumpy() + 1)
+    # generic jnp dispatch through __getattr__
+    np.testing.assert_allclose(mx.np.tanh(a).asnumpy(), np.tanh(a.asnumpy()),
+                               rtol=1e-6)
+    np.testing.assert_allclose(mx.np.cumsum(a, axis=1).asnumpy(),
+                               np.cumsum(a.asnumpy(), axis=1))
+
+
+def test_np_einsum_tensordot():
+    rng = np.random.RandomState(10)
+    a = rng.normal(size=(3, 4)).astype(np.float32)
+    b = rng.normal(size=(4, 5)).astype(np.float32)
+    out = mx.np.einsum("ij,jk->ik", mx.np.array(a), mx.np.array(b))
+    np.testing.assert_allclose(out.asnumpy(), a @ b, rtol=1e-5)
+    td = mx.np.tensordot(mx.np.array(a), mx.np.array(b), axes=([1], [0]))
+    np.testing.assert_allclose(td.asnumpy(), a @ b, rtol=1e-5)
+    # einsum as a registered op (gradient path)
+    x = nd.array(a)
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.einsum(x, nd.array(b), subscripts="ij,jk->ik").sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), b.sum(1)[None, :].repeat(3, 0),
+                               rtol=1e-5)
+
+
+def test_np_linalg():
+    S = _spd(4, seed=11)
+    np.testing.assert_allclose(mx.np.linalg.inv(mx.np.array(S)).asnumpy(),
+                               np.linalg.inv(S), rtol=1e-3, atol=1e-4)
+    w = mx.np.linalg.eigvalsh(mx.np.array(S))
+    np.testing.assert_allclose(w.asnumpy(), np.linalg.eigvalsh(S), rtol=1e-4)
+    n = mx.np.linalg.norm(mx.np.array(S))
+    np.testing.assert_allclose(float(n.asscalar()), np.linalg.norm(S),
+                               rtol=1e-5)
+
+
+def test_np_random():
+    mx.np.random.seed(0)
+    u = mx.np.random.uniform(0, 1, size=(1000,))
+    assert 0.0 <= float(u.asnumpy().min()) and float(u.asnumpy().max()) <= 1.0
+    n = mx.np.random.normal(2.0, 0.5, size=(4000,))
+    assert abs(float(n.asnumpy().mean()) - 2.0) < 0.1
+    r = mx.np.random.randint(0, 10, size=(100,))
+    assert r.asnumpy().min() >= 0 and r.asnumpy().max() < 10
+    g = mx.np.random.gamma(2.0, 2.0, size=(2000,))
+    assert abs(float(g.asnumpy().mean()) - 4.0) < 0.5
+    x = mx.np.arange(10)
+    mx.np.random.shuffle(x)
+    np.testing.assert_array_equal(np.sort(x.asnumpy()), np.arange(10))
+
+
+def test_boolean_mask_indexing():
+    # mx.nd comparisons return float (reference semantics); boolean masks
+    # must be bool dtype — the mx.np path
+    a = nd.array([[1.0, -2.0], [-3.0, 4.0]])
+    mask = (a > 0).astype("bool")
+    picked = a[mask]
+    np.testing.assert_allclose(np.sort(picked.asnumpy()), [1.0, 4.0])
+    a[(a < 0).astype("bool")] = 0.0
+    np.testing.assert_allclose(a.asnumpy(), [[1.0, 0.0], [0.0, 4.0]])
+
+
+# ------------------------------------------------------------- test_utils
+
+
+def test_check_symbolic_forward():
+    x = mx.sym.var("x")
+    y = mx.sym.sqrt(x)
+    data = np.array([[1.0, 4.0], [9.0, 16.0]], np.float32)
+    check_symbolic_forward(y, [data], [np.sqrt(data)])
+
+
+def test_check_numeric_gradient():
+    x = mx.sym.var("x")
+    y = mx.sym.tanh(x)
+    data = np.random.RandomState(12).normal(size=(2, 3)).astype(np.float64)
+    check_numeric_gradient(y, [data], numeric_eps=1e-4, rtol=1e-2)
+
+
+def test_check_consistency_cpu_vs_default():
+    x = mx.sym.var("data")
+    sym = mx.sym.FullyConnected(x, num_hidden=4, name="fc")
+    ctx_list = [{"ctx": mx.cpu(), "data": (2, 3)},
+                {"ctx": mx.context.current_context(), "data": (2, 3)}]
+    check_consistency(sym, ctx_list)
+
+
+def test_rand_ndarray_sparse():
+    arr = rand_ndarray((10, 5), stype="csr", density=0.3)
+    assert arr.stype == "csr"
+    arr2 = rand_ndarray((10, 5))
+    assert arr2.shape == (10, 5)
+    assert_almost_equal(arr2, arr2)
+
+
+def test_dense_csr_dot():
+    rng = np.random.RandomState(20)
+    A = rng.normal(size=(2, 3)).astype(np.float32)
+    B = rng.normal(size=(3, 4)).astype(np.float32)
+    B[rng.uniform(size=B.shape) > 0.5] = 0
+    csr = nd.sparse.csr_matrix(B)
+    out = nd.sparse.dot(nd.array(A), csr)
+    np.testing.assert_allclose(out.asnumpy(), A @ B, rtol=1e-5, atol=1e-6)
+    out2 = nd.sparse.dot(nd.array(A.T), csr, transpose_a=True)
+    np.testing.assert_allclose(out2.asnumpy(), A @ B, rtol=1e-5, atol=1e-6)
+    out3 = nd.sparse.dot(nd.array(rng.normal(size=(2, 4)).astype(np.float32)),
+                         csr, transpose_b=True)
+
+
+def test_csr_negative_index():
+    dense = np.zeros((4, 3), np.float32)
+    dense[3] = 7.0
+    csr = nd.sparse.csr_matrix(dense)
+    row = csr[-1]
+    assert row.shape == (1, 3)
+    np.testing.assert_allclose(row.asnumpy()[0], 7.0)
+
+
+def test_kvstore_init_and_push_csr():
+    kv = mx.kv.create("local")
+    dense = np.zeros((4, 3), np.float32); dense[1] = 2.0
+    kv.init("s", nd.sparse.row_sparse_array(dense))
+    kv.push("s", nd.sparse.csr_matrix(dense))
+    out = nd.zeros((4, 3))
+    kv.pull("s", out=out)
+    np.testing.assert_allclose(out.asnumpy(), dense)
+
+
+def test_gemm_axis_param():
+    rng = np.random.RandomState(21)
+    A = rng.normal(size=(4, 2, 3)).astype(np.float32)  # row axis = 0
+    B = rng.normal(size=(3, 2, 5)).astype(np.float32)
+    out = nd.linalg.gemm2(nd.array(A), nd.array(B), axis=0)
+    expect = np.einsum("rbk,kbc->rbc", A.transpose(0, 1, 2), B)
+    # moveaxis semantics: A -> (2,4,3), B -> (2,3,5), matmul -> (2,4,5), back -> (4,2,5)
+    expect = np.moveaxis(np.matmul(np.moveaxis(A, 0, -2), np.moveaxis(B, 0, -2)), -2, 0)
+    np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-5)
+
+
+def test_np_random_positional_size():
+    g = mx.np.random.gamma(2.0, 1.0, 100)
+    assert g.shape == (100,)
+    e = mx.np.random.exponential(1.0, (50,))
+    assert e.shape == (50,)
+    w = mx.np.random.weibull(1.5, 30)
+    assert w.shape == (30,)
+    lp = mx.np.random.laplace(0.0, 1.0, 40)
+    assert lp.shape == (40,)
