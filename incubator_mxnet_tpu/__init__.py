@@ -65,3 +65,4 @@ from . import parallel
 from . import kvstore
 from . import kvstore as kv
 from .kvstore import KVStore
+from . import rnn

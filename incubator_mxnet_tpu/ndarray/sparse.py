@@ -220,7 +220,13 @@ class CSRNDArray(BaseSparseNDArray):
     def __getitem__(self, key):
         """Row slicing returns a CSR slice (host-side repack)."""
         if isinstance(key, int):
-            key = key % self._shape[0]
+            nrows = self._shape[0]
+            if not -nrows <= key < nrows:
+                raise IndexError(
+                    "index %d is out of bounds for axis 0 with size %d"
+                    % (key, nrows))
+            if key < 0:
+                key += nrows
             key = slice(key, key + 1)
         if not isinstance(key, slice) or key.step not in (None, 1):
             raise ValueError("CSRNDArray supports contiguous row slicing only")

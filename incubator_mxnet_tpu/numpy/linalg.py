@@ -6,8 +6,6 @@ import functools
 
 import jax.numpy as _jnp
 
-from ..ndarray import NDArray
-
 _NAMES = ["norm", "svd", "cholesky", "qr", "inv", "det", "slogdet", "solve",
           "lstsq", "pinv", "eig", "eigh", "eigvals", "eigvalsh", "matrix_rank",
           "matrix_power", "multi_dot", "tensorinv", "tensorsolve", "cond"]
@@ -15,27 +13,13 @@ _NAMES = ["norm", "svd", "cholesky", "qr", "inv", "det", "slogdet", "solve",
 __all__ = list(_NAMES)
 
 
-def _unwrap(v):
-    if isinstance(v, NDArray):
-        return v._data
-    if isinstance(v, (tuple, list)):
-        return type(v)(_unwrap(x) for x in v)
-    return v
-
-
-def _wrap(v):
-    if isinstance(v, _jnp.ndarray):
-        return NDArray(v)
-    if isinstance(v, tuple):
-        return tuple(_wrap(x) for x in v)
-    return v
-
-
 def _make(name):
     jfn = getattr(_jnp.linalg, name)
 
     @functools.wraps(jfn)
     def fn(*args, **kwargs):
+        # deferred import: mx.np package imports this module at init time
+        from . import _unwrap, _wrap_value as _wrap
         return _wrap(jfn(*[_unwrap(a) for a in args],
                          **{k: _unwrap(v) for k, v in kwargs.items()}))
 

@@ -142,9 +142,15 @@ def _rnn(data, parameters, state, state_cell=None, state_size=None,
         for d in range(ndir):
             wx, wh, bx, bh = layers[idx]
             s = layer * ndir + d
-            h0 = state[s]
-            c0 = state_cell[s] if (mode == "lstm" and state_cell is not None) \
+            batch = data.shape[1]
+            h0 = state[s].astype(data.dtype)
+            if h0.shape[0] != batch:  # batch-1 begin_state (legacy mx.rnn)
+                h0 = jnp.broadcast_to(h0, (batch,) + h0.shape[1:])
+            c0 = state_cell[s].astype(data.dtype) \
+                if (mode == "lstm" and state_cell is not None) \
                 else jnp.zeros_like(h0)
+            if c0.shape[0] != batch:
+                c0 = jnp.broadcast_to(c0, (batch,) + c0.shape[1:])
             outs, carry = rnn_layer_scan(mode, x, h0, c0, wx, wh, bx, bh,
                                          reverse=(d == 1))
             outs_dirs.append(outs)

@@ -1,0 +1,12 @@
+"""Legacy symbolic RNN cell API (reference: python/mxnet/rnn/)."""
+from .rnn_cell import (BaseRNNCell, RNNParams, RNNCell, LSTMCell, GRUCell,
+                       FusedRNNCell, SequentialRNNCell, BidirectionalCell,
+                       ModifierCell, DropoutCell, ZoneoutCell, ResidualCell)
+from .rnn import save_rnn_checkpoint, load_rnn_checkpoint, do_rnn_checkpoint
+from .io import BucketSentenceIter, encode_sentences
+
+__all__ = ["BaseRNNCell", "RNNParams", "RNNCell", "LSTMCell", "GRUCell",
+           "FusedRNNCell", "SequentialRNNCell", "BidirectionalCell",
+           "ModifierCell", "DropoutCell", "ZoneoutCell", "ResidualCell",
+           "save_rnn_checkpoint", "load_rnn_checkpoint", "do_rnn_checkpoint",
+           "BucketSentenceIter", "encode_sentences"]

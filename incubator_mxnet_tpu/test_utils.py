@@ -185,7 +185,7 @@ def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
     differences.
     """
     ctx = ctx or default_context()
-    loc = {k: v.astype(np.float64) for k, v in _norm_location(sym, location).items()}
+    loc = {k: v.astype(dtype) for k, v in _norm_location(sym, location).items()}
     names = sym.list_arguments()
     grad_nodes = grad_nodes or [n for n in names if n in loc]
 
@@ -205,7 +205,7 @@ def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
     outs = exe.forward(is_train=True)
     if projs is None:
         projs = [proj_rng.normal(size=o.shape) for o in outs]
-    exe.backward([nd_array(p.astype(np.float64), ctx=ctx) for p in projs])
+    exe.backward([nd_array(p.astype(dtype), ctx=ctx) for p in projs])
     sym_grads = dict(zip(names, exe.grad_arrays))
 
     for name in grad_nodes:
@@ -227,15 +227,19 @@ def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
 
 def check_consistency(sym, ctx_list=None, scale=1.0, grad_req="write",
                       arg_params=None, rtol=None, atol=None,
-                      raise_on_err=True):
+                      raise_on_err=True, shapes=None):
     """Cross-device/dtype oracle (test_utils.py:1422).
 
     ctx_list entries: dict(ctx=Context, <arg_name>=shape..., type_dict={...}).
-    Defaults to [accelerator, XLA-CPU] at float32 — the TPU analog of the
-    reference's gpu-vs-cpu comparison.
+    With ``ctx_list=None``, pass ``shapes={arg_name: shape}`` to compare
+    [accelerator, XLA-CPU] at float32 — the TPU analog of the reference's
+    gpu-vs-cpu comparison.
     """
     if ctx_list is None:
-        shapes = {}
+        if not shapes:
+            raise ValueError(
+                "check_consistency needs input shapes: pass ctx_list "
+                "entries or shapes={arg_name: shape}")
         ctx_list = [{"ctx": default_context(), **shapes},
                     {"ctx": cpu(), **shapes}]
     results = []
