@@ -19,6 +19,7 @@ from . import utils
 from .utils import load, save
 from . import random  # noqa: F401
 from . import linalg  # noqa: F401
+from . import contrib  # noqa: F401
 from . import sparse
 from .sparse import (BaseSparseNDArray, CSRNDArray, RowSparseNDArray,
                      cast_storage)

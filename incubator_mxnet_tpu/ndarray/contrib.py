@@ -1,0 +1,21 @@
+"""``mx.nd.contrib`` — resolves ``name`` to the ``_contrib_name`` op
+(reference: python/mxnet/ndarray/contrib.py + generated op wrappers)."""
+from __future__ import annotations
+
+import sys
+
+from ..ops import registry as _reg
+
+__all__ = []
+
+
+def __getattr__(name):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    from . import _make_wrapper
+    for cand in ("_contrib_" + name, name):
+        if cand in _reg.OPS:
+            w = _make_wrapper(name, _reg.OPS[cand])
+            setattr(sys.modules[__name__], name, w)
+            return w
+    raise AttributeError("mx.nd.contrib has no operator %r" % name)

@@ -14,6 +14,7 @@ from . import random_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import rnn  # noqa: F401  (fused RNN via lax.scan)
 from . import linalg  # noqa: F401  (la_op family)
+from . import contrib  # noqa: F401  (detection/bounding-box ops)
 
 __all__ = ["registry", "Op", "get_op", "invoke", "invoke_raw", "list_ops",
            "register"]

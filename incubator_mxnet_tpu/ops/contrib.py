@@ -1,0 +1,529 @@
+"""Detection / bounding-box contrib ops.
+
+Reference semantics: ``src/operator/contrib/multibox_prior.cc:40-70``,
+``multibox_target.cc:80-280``, ``multibox_detection.cc:46-195``,
+``bounding_box.cc`` (box_nms/box_iou/bipartite_matching),
+``src/operator/roi_pooling.cc``, ``src/operator/contrib/roi_align.cc``.
+
+All ops are static-shape XLA formulations: NMS and bipartite matching are
+bounded ``fori_loop``s over masks instead of data-dependent compaction, so
+the whole SSD graph (priors → targets → loss, or priors → detection) stays
+inside one compiled program.
+"""
+from __future__ import annotations
+
+import ast
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = []
+
+
+def _tuple(v, n=None, typ=float):
+    if isinstance(v, str):
+        v = ast.literal_eval(v)
+    if not isinstance(v, (tuple, list)):
+        v = (v,) * (n or 1)
+    return tuple(typ(x) for x in v)
+
+
+# ---------------------------------------------------------------------------
+# IoU helpers
+# ---------------------------------------------------------------------------
+
+def _corner_iou(lhs, rhs):
+    """IoU between corner boxes lhs (..., 4) and rhs (..., 4), broadcast
+    over leading dims (multibox_target.cc CalculateOverlap)."""
+    il = jnp.maximum(lhs[..., 0], rhs[..., 0])
+    it = jnp.maximum(lhs[..., 1], rhs[..., 1])
+    ir = jnp.minimum(lhs[..., 2], rhs[..., 2])
+    ib = jnp.minimum(lhs[..., 3], rhs[..., 3])
+    iw = jnp.maximum(ir - il, 0)
+    ih = jnp.maximum(ib - it, 0)
+    inter = iw * ih
+    area_l = jnp.maximum(lhs[..., 2] - lhs[..., 0], 0) * \
+        jnp.maximum(lhs[..., 3] - lhs[..., 1], 0)
+    area_r = jnp.maximum(rhs[..., 2] - rhs[..., 0], 0) * \
+        jnp.maximum(rhs[..., 3] - rhs[..., 1], 0)
+    union = area_l + area_r - inter
+    # double-where keeps the zero-union branch out of the gradient (the
+    # 0 * NaN = NaN trap) — box_iou is differentiable
+    safe_union = jnp.where(union > 0, union, 1.0)
+    return jnp.where(union > 0, inter / safe_union, 0.0)
+
+
+def _to_corner(boxes, fmt):
+    if fmt == "corner":
+        return boxes
+    # center: (x, y, w, h) → corners
+    x, y, w, h = (boxes[..., i] for i in range(4))
+    return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2], axis=-1)
+
+
+def _from_corner(boxes, fmt):
+    if fmt == "corner":
+        return boxes
+    l, t, r, b = (boxes[..., i] for i in range(4))
+    return jnp.stack([(l + r) / 2, (t + b) / 2, r - l, b - t], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxPrior
+# ---------------------------------------------------------------------------
+
+@register("_contrib_MultiBoxPrior", num_inputs=1, differentiable=False,
+          aliases=("MultiBoxPrior",))
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                    steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor boxes per feature-map pixel (multibox_prior.cc:40-70):
+    num_sizes + num_ratios - 1 anchors, corner format, normalized coords."""
+    sizes = _tuple(sizes)
+    ratios = _tuple(ratios)
+    steps = _tuple(steps, 2)
+    offsets = _tuple(offsets, 2)
+    in_h, in_w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / in_h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / in_w
+    cy = (jnp.arange(in_h, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(in_w, dtype=jnp.float32) + offsets[1]) * step_x
+
+    ws, hs = [], []
+    r0 = math.sqrt(ratios[0])
+    for s in sizes:
+        ws.append(s * in_h / in_w * r0 / 2)
+        hs.append(s / r0 / 2)
+    for r in ratios[1:]:
+        rr = math.sqrt(r)
+        ws.append(sizes[0] * in_h / in_w * rr / 2)
+        hs.append(sizes[0] / rr / 2)
+    k = len(ws)
+    ws = jnp.asarray(ws, jnp.float32)
+    hs = jnp.asarray(hs, jnp.float32)
+
+    cxg = jnp.broadcast_to(cx[None, :, None], (in_h, in_w, k))
+    cyg = jnp.broadcast_to(cy[:, None, None], (in_h, in_w, k))
+    out = jnp.stack([cxg - ws, cyg - hs, cxg + ws, cyg + hs], axis=-1)
+    out = out.reshape(1, in_h * in_w * k, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxTarget
+# ---------------------------------------------------------------------------
+
+def _encode_loc(anchors, gt, variances):
+    """(gx-ax)/aw/vx, (gy-ay)/ah/vy, log(gw/aw)/vw, log(gh/ah)/vh
+    (multibox_target.cc:32-54 AssignLocTargets)."""
+    aw = anchors[..., 2] - anchors[..., 0]
+    ah = anchors[..., 3] - anchors[..., 1]
+    ax = (anchors[..., 0] + anchors[..., 2]) * 0.5
+    ay = (anchors[..., 1] + anchors[..., 3]) * 0.5
+    gw = gt[..., 2] - gt[..., 0]
+    gh = gt[..., 3] - gt[..., 1]
+    gx = (gt[..., 0] + gt[..., 2]) * 0.5
+    gy = (gt[..., 1] + gt[..., 3]) * 0.5
+    safe = lambda v: jnp.maximum(v, 1e-12)  # noqa: E731
+    return jnp.stack([
+        (gx - ax) / safe(aw) / variances[0],
+        (gy - ay) / safe(ah) / variances[1],
+        jnp.log(safe(gw) / safe(aw)) / variances[2],
+        jnp.log(safe(gh) / safe(ah)) / variances[3]], axis=-1)
+
+
+def _target_one(anchors, label, cls_pred, overlap_threshold, ignore_label,
+                negative_mining_ratio, negative_mining_thresh,
+                minimum_negative_samples, variances):
+    """Single-sample target assignment (multibox_target.cc:91-280)."""
+    num_anchors = anchors.shape[0]
+    num_labels = label.shape[0]
+    gt_valid = label[:, 0] != -1.0                      # (L,)
+    has_gt = jnp.any(gt_valid)
+    overlaps = _corner_iou(anchors[:, None, :], label[None, :, 1:5])  # (A,L)
+    overlaps = jnp.where(gt_valid[None, :], overlaps, -1.0)
+
+    # --- stage 1: greedy bipartite matching (multibox_target.cc:112-148)
+    def bip_step(_, carry):
+        m_iou, m_gt, a_matched, g_matched = carry
+        masked = jnp.where(a_matched[:, None] | g_matched[None, :],
+                           -1.0, overlaps)
+        flat = jnp.argmax(masked)
+        bi = (flat // num_labels).astype(jnp.int32)
+        bj = (flat % num_labels).astype(jnp.int32)
+        val = masked[bi, bj]
+        ok = val > 1e-6
+        m_iou = m_iou.at[bi].set(jnp.where(ok, val, m_iou[bi]))
+        m_gt = m_gt.at[bi].set(jnp.where(ok, bj, m_gt[bi]))
+        a_matched = a_matched.at[bi].set(a_matched[bi] | ok)
+        g_matched = g_matched.at[bj].set(g_matched[bj] | ok)
+        return m_iou, m_gt, a_matched, g_matched
+
+    m_iou = jnp.full((num_anchors,), -1.0)
+    m_gt = jnp.full((num_anchors,), -1, jnp.int32)
+    a_matched = jnp.zeros((num_anchors,), bool)
+    g_matched = jnp.zeros((num_labels,), bool)
+    m_iou, m_gt, a_matched, _ = lax.fori_loop(
+        0, num_labels, bip_step, (m_iou, m_gt, a_matched, g_matched))
+
+    # --- stage 2: per-anchor threshold matching (:150-179)
+    best_gt = jnp.argmax(overlaps, axis=1)
+    best_iou = jnp.max(overlaps, axis=1)
+    thr_pos = (~a_matched) & (best_iou > overlap_threshold) \
+        & (overlap_threshold > 0) & has_gt
+    m_iou = jnp.where(a_matched, m_iou, best_iou)
+    m_gt = jnp.where(a_matched, m_gt, best_gt.astype(jnp.int32))
+    positive = a_matched | thr_pos
+
+    # --- stage 3: negatives (:181-248)
+    if negative_mining_ratio > 0:
+        num_pos = jnp.sum(positive)
+        num_neg = jnp.minimum(
+            (num_pos * negative_mining_ratio).astype(jnp.int32),
+            num_anchors - num_pos)
+        num_neg = jnp.maximum(num_neg, int(minimum_negative_samples))
+        eligible = (~positive) & (m_iou < negative_mining_thresh)
+        # hardest negatives = lowest background-class probability
+        bg_prob = jax.nn.softmax(cls_pred, axis=0)[0]        # (A,)
+        key = jnp.where(eligible, bg_prob, jnp.inf)
+        order = jnp.argsort(key, stable=True)
+        rank = jnp.argsort(order, stable=True)
+        negative = eligible & (rank < num_neg)
+    else:
+        negative = ~positive
+
+    # --- assign targets (:250-277)
+    gt_cls = label[:, 0]                                  # (L,)
+    cls_of_match = jnp.take(gt_cls, jnp.maximum(m_gt, 0)) + 1.0
+    cls_target = jnp.where(positive, cls_of_match,
+                           jnp.where(negative, 0.0, float(ignore_label)))
+    gt_box_of_match = jnp.take(label[:, 1:5], jnp.maximum(m_gt, 0), axis=0)
+    loc = _encode_loc(anchors, gt_box_of_match, variances)  # (A,4)
+    loc_target = jnp.where(positive[:, None], loc, 0.0)
+    loc_mask = jnp.where(positive[:, None], jnp.ones_like(loc), 0.0)
+
+    # no valid gt → all-init outputs (:106 guard)
+    cls_target = jnp.where(has_gt, cls_target, float(ignore_label))
+    loc_target = jnp.where(has_gt, loc_target, 0.0)
+    loc_mask = jnp.where(has_gt, loc_mask, 0.0)
+    return (loc_target.reshape(-1), loc_mask.reshape(-1),
+            cls_target.astype(anchors.dtype))
+
+
+@register("_contrib_MultiBoxTarget", num_inputs=3, num_outputs=3,
+          differentiable=False, aliases=("MultiBoxTarget",))
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5, minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD training targets → (loc_target (N,A*4), loc_mask (N,A*4),
+    cls_target (N,A)) (multibox_target.cc:80)."""
+    variances = _tuple(variances, 4)
+    anchors = anchor.reshape(-1, 4)
+    f = partial(_target_one, overlap_threshold=float(overlap_threshold),
+                ignore_label=float(ignore_label),
+                negative_mining_ratio=float(negative_mining_ratio),
+                negative_mining_thresh=float(negative_mining_thresh),
+                minimum_negative_samples=int(minimum_negative_samples),
+                variances=variances)
+    return jax.vmap(lambda lab, cp: f(anchors, lab, cp))(label, cls_pred)
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxDetection
+# ---------------------------------------------------------------------------
+
+def _decode_loc(anchors, pred, variances, clip):
+    """Inverse of _encode_loc (multibox_detection.cc:46-80
+    TransformLocations)."""
+    aw = anchors[..., 2] - anchors[..., 0]
+    ah = anchors[..., 3] - anchors[..., 1]
+    ax = (anchors[..., 0] + anchors[..., 2]) * 0.5
+    ay = (anchors[..., 1] + anchors[..., 3]) * 0.5
+    ox = pred[..., 0] * variances[0] * aw + ax
+    oy = pred[..., 1] * variances[1] * ah + ay
+    ow = jnp.exp(pred[..., 2] * variances[2]) * aw / 2
+    oh = jnp.exp(pred[..., 3] * variances[3]) * ah / 2
+    out = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=-1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+def _greedy_nms(ids, boxes, nkeep, nms_threshold, force_suppress):
+    """Greedy suppression over score-sorted rows: row j dies if an earlier
+    surviving row i (same class unless force_suppress) has IoU ≥ thresh
+    (multibox_detection.cc:176-193).  O(A) fori_loop with vector body."""
+    num = ids.shape[0]
+
+    def step(i, ids_):
+        alive_i = (ids_[i] >= 0) & (i < nkeep)
+        iou = _corner_iou(boxes[i], boxes)                 # (A,)
+        same = jnp.where(force_suppress, True, ids_ == ids_[i])
+        j = jnp.arange(num)
+        kill = alive_i & (j > i) & (j < nkeep) & (ids_ >= 0) & same \
+            & (iou >= nms_threshold)
+        return jnp.where(kill, -1.0, ids_)
+
+    return lax.fori_loop(0, num, step, ids)
+
+
+def _detect_one(anchors, cls_prob, loc_pred, threshold, clip, variances,
+                nms_threshold, force_suppress, nms_topk, background_id):
+    num_classes, num_anchors = cls_prob.shape
+    # foreground = every class row except background_id
+    cls_idx = [j for j in range(num_classes) if j != background_id]
+    fg = cls_prob[jnp.asarray(cls_idx), :]
+    score = jnp.max(fg, axis=0)
+    best = jnp.argmax(fg, axis=0)
+    # map back to original class index, then to contiguous 0-based fg id
+    # (reference emits id-1 with background first; general background_id
+    # keeps the same contiguous numbering over non-background classes)
+    row_id = jnp.where(score < threshold, -1.0, best.astype(jnp.float32))
+    boxes = _decode_loc(anchors, loc_pred.reshape(-1, 4), variances, clip)
+
+    # sort by (valid, score) desc — replaces the compaction in :132-146
+    key = jnp.where(row_id >= 0, score, -jnp.inf)
+    order = jnp.argsort(-key, stable=True)
+    row_id = jnp.take(row_id, order)
+    score = jnp.take(score, order)
+    boxes = jnp.take(boxes, order, axis=0)
+    valid_count = jnp.sum(row_id >= 0)
+    nkeep = valid_count if nms_topk <= 0 else jnp.minimum(
+        jnp.int32(nms_topk), valid_count)
+    # beyond-topk valid rows are dropped (:162-168)
+    row_id = jnp.where(jnp.arange(num_anchors) < nkeep, row_id, -1.0)
+
+    if 0 < nms_threshold <= 1:
+        row_id = _greedy_nms(row_id, boxes, nkeep, nms_threshold,
+                             force_suppress)
+    out = jnp.concatenate([row_id[:, None], score[:, None], boxes], axis=1)
+    return jnp.where(row_id[:, None] >= 0, out, -1.0)
+
+
+@register("_contrib_MultiBoxDetection", num_inputs=3, differentiable=False,
+          aliases=("MultiBoxDetection",))
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                        threshold=0.01, background_id=0, nms_threshold=0.5,
+                        force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """SSD decode+NMS → (N, A, 6) rows [cls_id, score, xmin, ymin, xmax,
+    ymax]; suppressed rows are -1 (multibox_detection.cc:85)."""
+    variances = _tuple(variances, 4)
+    anchors = anchor.reshape(-1, 4)
+    f = partial(_detect_one, threshold=float(threshold), clip=bool(clip),
+                variances=variances, nms_threshold=float(nms_threshold),
+                force_suppress=bool(force_suppress), nms_topk=int(nms_topk),
+                background_id=int(background_id))
+    return jax.vmap(lambda cp, lp: f(anchors, cp, lp))(cls_prob, loc_pred)
+
+
+# ---------------------------------------------------------------------------
+# bounding_box.cc ops
+# ---------------------------------------------------------------------------
+
+@register("_contrib_box_iou", num_inputs=2, aliases=("box_iou",))
+def _box_iou(lhs, rhs, format="corner"):  # noqa: A002
+    """Pairwise IoU: out shape lhs.shape[:-1] + rhs.shape[:-1]
+    (bounding_box.cc:121)."""
+    lhs = _to_corner(lhs, format)
+    rhs = _to_corner(rhs, format)
+    ls = lhs.shape[:-1]
+    rs = rhs.shape[:-1]
+    lhs = lhs.reshape((-1, 4))
+    rhs = rhs.reshape((-1, 4))
+    out = _corner_iou(lhs[:, None, :], rhs[None, :, :])
+    return out.reshape(ls + rs)
+
+
+@register("_contrib_box_nms", num_inputs=1, differentiable=False,
+          aliases=("box_nms",))
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+             coord_start=2, score_index=1, id_index=-1, background_id=-1,
+             force_suppress=False, in_format="corner", out_format="corner"):
+    """NMS over (..., num_box, k) rows; surviving rows sorted by score
+    descending, suppressed rows all -1 (bounding_box.cc:40)."""
+    shape = data.shape
+    num_box, width = shape[-2], shape[-1]
+    flat = data.reshape((-1, num_box, width))
+
+    def one(rows):
+        score = rows[:, score_index]
+        if id_index >= 0:
+            ids = rows[:, id_index]
+            bg_ok = (ids != background_id) if background_id >= 0 else True
+        else:
+            ids = jnp.zeros((num_box,))
+            bg_ok = True
+        valid = (score > valid_thresh) & bg_ok
+        key = jnp.where(valid, score, -jnp.inf)
+        order = jnp.argsort(-key, stable=True)
+        rows_s = jnp.take(rows, order, axis=0)
+        valid_s = jnp.take(valid, order)
+        nkeep = jnp.sum(valid_s)
+        if topk > 0:
+            nkeep = jnp.minimum(nkeep, jnp.int32(topk))
+        boxes = _to_corner(
+            rows_s[:, coord_start:coord_start + 4], in_format)
+        ids_s = jnp.take(ids, order)
+        marker = jnp.where(valid_s & (jnp.arange(num_box) < nkeep),
+                           ids_s if id_index >= 0 else 0.0, -jnp.inf)
+
+        def step(i, mk):
+            alive_i = mk[i] > -jnp.inf
+            iou = _corner_iou(boxes[i], boxes)
+            same = jnp.where(bool(force_suppress) or id_index < 0,
+                             True, mk == mk[i])
+            j = jnp.arange(num_box)
+            kill = alive_i & (j > i) & (mk > -jnp.inf) & same \
+                & (iou >= overlap_thresh)
+            return jnp.where(kill, -jnp.inf, mk)
+
+        marker = lax.fori_loop(0, num_box, step, marker)
+        keep = marker > -jnp.inf
+        out_rows = rows_s
+        if out_format != in_format:
+            out_rows = out_rows.at[:, coord_start:coord_start + 4].set(
+                _from_corner(boxes, out_format))
+        return jnp.where(keep[:, None], out_rows, -1.0)
+
+    return jax.vmap(one)(flat).reshape(shape)
+
+
+@register("_contrib_bipartite_matching", num_inputs=1, num_outputs=2,
+          differentiable=False, aliases=("bipartite_matching",))
+def _bipartite_matching(dist, is_ascend=False, threshold=None):
+    """Greedy bipartite matching over (..., M, N) scores → (row_match,
+    col_match) index arrays, -1 = unmatched (bounding_box.cc:162)."""
+    shape = dist.shape
+    m, n = shape[-2], shape[-1]
+    flat = dist.reshape((-1, m, n))
+    sign = 1.0 if is_ascend else -1.0
+    thr = threshold
+
+    def one(d):
+        def step(_, carry):
+            rmatch, cmatch = carry
+            masked = jnp.where((rmatch[:, None] >= 0) | (cmatch[None, :] >= 0),
+                               jnp.inf * 1.0, sign * d)
+            idx = jnp.argmin(masked)
+            bi, bj = idx // n, idx % n
+            val = d[bi, bj]
+            ok = jnp.isfinite(masked[bi, bj])
+            if thr is not None:
+                ok = ok & ((val <= thr) if is_ascend else (val >= thr))
+            rmatch = rmatch.at[bi].set(jnp.where(ok, bj, rmatch[bi]))
+            cmatch = cmatch.at[bj].set(jnp.where(ok, bi, cmatch[bj]))
+            return rmatch, cmatch
+
+        rmatch = jnp.full((m,), -1.0)
+        cmatch = jnp.full((n,), -1.0)
+        rmatch, cmatch = lax.fori_loop(0, min(m, n), step, (rmatch, cmatch))
+        return rmatch, cmatch
+
+    r, c = jax.vmap(one)(flat)
+    return r.reshape(shape[:-1]), c.reshape(shape[:-2] + (n,))
+
+
+# ---------------------------------------------------------------------------
+# ROI ops
+# ---------------------------------------------------------------------------
+
+@register("ROIPooling", num_inputs=2)
+def _roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
+    """Max pooling over quantized ROI bins (src/operator/roi_pooling.cc).
+    data (N,C,H,W); rois (R,5) rows [batch_idx, x1, y1, x2, y2]."""
+    ph, pw = _tuple(pooled_size, 2, int)
+    n, c, h, w = data.shape
+    scale = float(spatial_scale)
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        img = jnp.take(data, b, axis=0)                   # (C,H,W)
+        x1 = jnp.round(roi[1] * scale)
+        y1 = jnp.round(roi[2] * scale)
+        x2 = jnp.round(roi[3] * scale)
+        y2 = jnp.round(roi[4] * scale)
+        roi_w = jnp.maximum(x2 - x1 + 1, 1.0)
+        roi_h = jnp.maximum(y2 - y1 + 1, 1.0)
+        bin_h = roi_h / ph
+        bin_w = roi_w / pw
+        i = jnp.arange(ph, dtype=jnp.float32)
+        j = jnp.arange(pw, dtype=jnp.float32)
+        hstart = jnp.clip(jnp.floor(i * bin_h) + y1, 0, h)
+        hend = jnp.clip(jnp.ceil((i + 1) * bin_h) + y1, 0, h)
+        wstart = jnp.clip(jnp.floor(j * bin_w) + x1, 0, w)
+        wend = jnp.clip(jnp.ceil((j + 1) * bin_w) + x1, 0, w)
+        rr = jnp.arange(h, dtype=jnp.float32)
+        cc = jnp.arange(w, dtype=jnp.float32)
+        mrow = (rr[None, :] >= hstart[:, None]) & (rr[None, :] < hend[:, None])
+        mcol = (cc[None, :] >= wstart[:, None]) & (cc[None, :] < wend[:, None])
+        mask = mrow[:, None, :, None] & mcol[None, :, None, :]  # (ph,pw,H,W)
+        vals = jnp.where(mask[None], img[:, None, None, :, :], -jnp.inf)
+        pooled = jnp.max(vals, axis=(-2, -1))             # (C,ph,pw)
+        return jnp.where(jnp.isfinite(pooled), pooled, 0.0)
+
+    return jax.vmap(one)(rois.astype(jnp.float32)).astype(data.dtype)
+
+
+@register("_contrib_ROIAlign", num_inputs=2, aliases=("ROIAlign",))
+def _roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0, sample_ratio=-1,
+               position_sensitive=False, aligned=False):
+    """Bilinear ROI align (src/operator/contrib/roi_align.cc).  With
+    sample_ratio<=0 a fixed 2×2 sample grid per bin is used (the reference
+    picks ceil(roi/bin) adaptively, which is data-dependent — a fixed grid
+    keeps shapes static for XLA)."""
+    ph, pw = _tuple(pooled_size, 2, int)
+    n, c, h, w = data.shape
+    scale = float(spatial_scale)
+    sr = int(sample_ratio) if int(sample_ratio) > 0 else 2
+    off = 0.5 if aligned else 0.0
+
+    def bilinear(img, y, x):
+        """img (C,H,W); sample at continuous (y, x)."""
+        y = jnp.clip(y, 0.0, h - 1.0)
+        x = jnp.clip(x, 0.0, w - 1.0)
+        y0 = jnp.floor(y).astype(jnp.int32)
+        x0 = jnp.floor(x).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, h - 1)
+        x1 = jnp.minimum(x0 + 1, w - 1)
+        ly, lx = y - y0, x - x0
+        v00 = img[:, y0, x0]
+        v01 = img[:, y0, x1]
+        v10 = img[:, y1, x0]
+        v11 = img[:, y1, x1]
+        return (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx +
+                v10 * ly * (1 - lx) + v11 * ly * lx)
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        img = jnp.take(data, b, axis=0)
+        x1 = roi[1] * scale - off
+        y1 = roi[2] * scale - off
+        x2 = roi[3] * scale - off
+        y2 = roi[4] * scale - off
+        roi_w = jnp.maximum(x2 - x1, 1.0) if not aligned else (x2 - x1)
+        roi_h = jnp.maximum(y2 - y1, 1.0) if not aligned else (y2 - y1)
+        bin_h = roi_h / ph
+        bin_w = roi_w / pw
+        iy = jnp.arange(sr, dtype=jnp.float32)
+        # sample offsets inside a bin: (k+0.5)/sr
+        offs = (iy + 0.5) / sr
+        gy = y1 + (jnp.arange(ph, dtype=jnp.float32)[:, None] +
+                   offs[None, :]) * bin_h          # (ph, sr)
+        gx = x1 + (jnp.arange(pw, dtype=jnp.float32)[:, None] +
+                   offs[None, :]) * bin_w          # (pw, sr)
+        yy = gy.reshape(-1)                         # (ph*sr,)
+        xx = gx.reshape(-1)                         # (pw*sr,)
+        samp = jax.vmap(lambda y: jax.vmap(
+            lambda x: bilinear(img, y, x))(xx))(yy)  # (ph*sr, pw*sr, C)
+        samp = samp.reshape(ph, sr, pw, sr, c)
+        return jnp.mean(samp, axis=(1, 3)).transpose(2, 0, 1)  # (C,ph,pw)
+
+    return jax.vmap(one)(rois.astype(jnp.float32)).astype(data.dtype)

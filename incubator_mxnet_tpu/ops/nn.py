@@ -392,6 +392,16 @@ def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
             if use_ignore:
                 mask = (l != ignore_label).astype(out.dtype)
                 grad = grad * jnp.expand_dims(mask, 1)
+        elif preserve_shape:
+            # out (..., C), label (...): per-position softmax grad
+            k = out.shape[-1]
+            oh = jax.nn.one_hot(l.astype(jnp.int32), k, dtype=out.dtype)
+            if smooth_alpha:
+                oh = oh * (1.0 - smooth_alpha) + smooth_alpha / (k - 1) * (1.0 - oh)
+            grad = out - oh
+            if use_ignore:
+                mask = (l != ignore_label).astype(out.dtype)
+                grad = grad * mask[..., None]
         else:
             flat = out.reshape(out.shape[0], -1)
             oh = jax.nn.one_hot(l.reshape(-1).astype(jnp.int32), flat.shape[-1],

@@ -16,6 +16,7 @@ from ..ops import registry as _reg
 from .symbol import (AUX_SUFFIXES, PARAM_INPUT_NAMES, Group, Symbol, Variable,
                      _Node, _input_arg_names, _required_arg_names, load,
                      load_json, var)
+from . import contrib  # noqa: F401
 
 __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json", "zeros",
            "ones", "arange", "linalg"]
@@ -28,6 +29,9 @@ _NULL_NODE = _Node(None, "__null__")
 
 
 def _compose_num_outputs(opname, attrs):
+    reg_op = _reg.OPS.get(opname)
+    if reg_op is not None and (reg_op.num_outputs or 1) > 1:
+        return reg_op.num_outputs
     if opname in ("SliceChannel", "split"):
         return int(attrs.get("num_outputs", 2))
     if opname == "split_v2":

@@ -1,0 +1,210 @@
+"""Detection contrib op tests vs numpy oracles (model:
+tests/python/unittest/test_contrib_operator.py in the reference)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def np_iou(a, b):
+    il = max(a[0], b[0]); it = max(a[1], b[1])
+    ir = min(a[2], b[2]); ib = min(a[3], b[3])
+    iw = max(ir - il, 0); ih = max(ib - it, 0)
+    inter = iw * ih
+    ua = max(a[2] - a[0], 0) * max(a[3] - a[1], 0)
+    ub = max(b[2] - b[0], 0) * max(b[3] - b[1], 0)
+    u = ua + ub - inter
+    return inter / u if u > 0 else 0.0
+
+
+def test_multibox_prior_formula():
+    H, W = 3, 5
+    sizes, ratios = (0.4, 0.8), (1.0, 2.0)
+    data = nd.zeros((1, 2, H, W))
+    out = nd.contrib.MultiBoxPrior(data, sizes=sizes, ratios=ratios)
+    k = len(sizes) + len(ratios) - 1
+    assert out.shape == (1, H * W * k, 4)
+    a = out.asnumpy().reshape(H, W, k, 4)
+    # manual first pixel (r=0,c=0): centers
+    cy, cx = 0.5 / H, 0.5 / W
+    exp = []
+    r0 = np.sqrt(ratios[0])
+    for s in sizes:
+        w = s * H / W * r0 / 2; h = s / r0 / 2
+        exp.append([cx - w, cy - h, cx + w, cy + h])
+    rr = np.sqrt(ratios[1])
+    w = sizes[0] * H / W * rr / 2; h = sizes[0] / rr / 2
+    exp.append([cx - w, cy - h, cx + w, cy + h])
+    np.testing.assert_allclose(a[0, 0], np.array(exp), rtol=1e-5, atol=1e-6)
+
+
+def test_multibox_prior_clip():
+    data = nd.zeros((1, 2, 2, 2))
+    out = nd.contrib.MultiBoxPrior(data, sizes=(1.5,), clip=True).asnumpy()
+    assert out.min() >= 0 and out.max() <= 1
+
+
+def test_box_iou():
+    rng = np.random.RandomState(0)
+    a = rng.uniform(0, 1, (4, 4)); a[:, 2:] += a[:, :2]
+    b = rng.uniform(0, 1, (3, 4)); b[:, 2:] += b[:, :2]
+    out = nd.contrib.box_iou(nd.array(a), nd.array(b)).asnumpy()
+    assert out.shape == (4, 3)
+    for i in range(4):
+        for j in range(3):
+            np.testing.assert_allclose(out[i, j], np_iou(a[i], b[j]),
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_box_nms_basic():
+    # rows: [id, score, x1, y1, x2, y2]
+    data = np.array([
+        [0, 0.9, 0.0, 0.0, 0.5, 0.5],
+        [0, 0.8, 0.05, 0.05, 0.5, 0.5],   # overlaps box0 → suppressed
+        [1, 0.7, 0.0, 0.0, 0.5, 0.5],     # other class → kept
+        [0, 0.6, 0.6, 0.6, 0.9, 0.9],     # far away → kept
+        [0, 0.05, 0.6, 0.6, 0.9, 0.9],    # below valid_thresh → invalid
+    ], dtype=np.float32)
+    out = nd.contrib.box_nms(nd.array(data[None]), overlap_thresh=0.5,
+                             valid_thresh=0.1, id_index=0,
+                             score_index=1, coord_start=2).asnumpy()[0]
+    kept = out[out[:, 0] >= 0]
+    assert len(kept) == 3
+    np.testing.assert_allclose(kept[:, 1], [0.9, 0.7, 0.6], rtol=1e-6)
+    # force_suppress removes the other-class duplicate too
+    out2 = nd.contrib.box_nms(nd.array(data[None]), overlap_thresh=0.5,
+                              valid_thresh=0.1, id_index=0, score_index=1,
+                              coord_start=2, force_suppress=True).asnumpy()[0]
+    kept2 = out2[out2[:, 0] >= 0]
+    assert len(kept2) == 2
+    np.testing.assert_allclose(kept2[:, 1], [0.9, 0.6], rtol=1e-6)
+
+
+def test_multibox_target_simple():
+    # 2 anchors, 1 gt that overlaps anchor 0 strongly
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5], [0.5, 0.5, 1.0, 1.0]]],
+                       dtype=np.float32)
+    label = np.array([[[1.0, 0.05, 0.05, 0.45, 0.45],
+                       [-1, -1, -1, -1, -1]]], dtype=np.float32)
+    cls_pred = np.zeros((1, 3, 2), dtype=np.float32)
+    loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(
+        nd.array(anchors), nd.array(label), nd.array(cls_pred))
+    cls_t = cls_t.asnumpy()[0]
+    loc_m = loc_m.asnumpy()[0].reshape(2, 4)
+    loc_t = loc_t.asnumpy()[0].reshape(2, 4)
+    assert cls_t[0] == 2.0          # class 1 → target 1+1
+    assert cls_t[1] == 0.0          # background (no mining → negative)
+    np.testing.assert_allclose(loc_m[0], 1)
+    np.testing.assert_allclose(loc_m[1], 0)
+    # loc encoding oracle
+    aw = ah = 0.5; ax = ay = 0.25
+    gx = gy = 0.25; gw = gh = 0.4
+    exp = [(gx - ax) / aw / 0.1, (gy - ay) / ah / 0.1,
+           np.log(gw / aw) / 0.2, np.log(gh / ah) / 0.2]
+    np.testing.assert_allclose(loc_t[0], exp, rtol=1e-4, atol=1e-5)
+
+
+def test_multibox_target_negative_mining():
+    rng = np.random.RandomState(0)
+    A = 8
+    anchors = rng.uniform(0, 0.4, (1, A, 4)).astype(np.float32)
+    anchors[..., 2:] += anchors[..., :2] + 0.1
+    # one gt matching anchor 0 exactly
+    label = np.full((1, 3, 5), -1.0, dtype=np.float32)
+    label[0, 0] = [0.0, *anchors[0, 0]]
+    cls_pred = rng.uniform(-1, 1, (1, 4, A)).astype(np.float32)
+    loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(
+        nd.array(anchors), nd.array(label), nd.array(cls_pred),
+        negative_mining_ratio=2.0, negative_mining_thresh=0.5,
+        ignore_label=-1)
+    cls_t = cls_t.asnumpy()[0]
+    n_pos = np.sum(cls_t > 0)
+    n_neg = np.sum(cls_t == 0)
+    n_ign = np.sum(cls_t == -1)
+    assert n_pos >= 1
+    assert n_neg <= 2 * n_pos
+    assert n_pos + n_neg + n_ign == A
+
+
+def test_multibox_target_no_gt():
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5]]], dtype=np.float32)
+    label = np.full((1, 2, 5), -1.0, dtype=np.float32)
+    cls_pred = np.zeros((1, 2, 1), dtype=np.float32)
+    loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(
+        nd.array(anchors), nd.array(label), nd.array(cls_pred))
+    assert cls_t.asnumpy()[0, 0] == -1.0
+    np.testing.assert_allclose(loc_m.asnumpy(), 0)
+
+
+def test_multibox_detection_decode_and_nms():
+    A = 3
+    anchors = np.array([[[0.1, 0.1, 0.3, 0.3],
+                         [0.11, 0.11, 0.31, 0.31],
+                         [0.6, 0.6, 0.9, 0.9]]], dtype=np.float32)
+    # cls_prob (N, C, A): background + 1 class
+    cls_prob = np.array([[[0.2, 0.3, 0.9],
+                          [0.8, 0.7, 0.1]]], dtype=np.float32)
+    loc_pred = np.zeros((1, A * 4), dtype=np.float32)
+    out = nd.contrib.MultiBoxDetection(
+        nd.array(cls_prob), nd.array(loc_pred), nd.array(anchors),
+        nms_threshold=0.5, threshold=0.2).asnumpy()[0]
+    kept = out[out[:, 0] >= 0]
+    # anchors 0,1 are near-duplicates of class 0 → one survives; anchor 2 is
+    # background (score 0.1 < threshold)
+    assert len(kept) == 1
+    assert kept[0, 0] == 0.0
+    np.testing.assert_allclose(kept[0, 1], 0.8, rtol=1e-6)
+    # zero loc_pred → decoded box == anchor box
+    np.testing.assert_allclose(kept[0, 2:], anchors[0, 0], rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_bipartite_matching():
+    dist = np.array([[[0.9, 0.1], [0.8, 0.7], [0.2, 0.3]]], dtype=np.float32)
+    rows, cols = nd.contrib.bipartite_matching(nd.array(dist))
+    rows = rows.asnumpy()[0]; cols = cols.asnumpy()[0]
+    # greedy: (0,0)=0.9 then (1,1)=0.7
+    np.testing.assert_allclose(rows, [0, 1, -1])
+    np.testing.assert_allclose(cols, [0, 1])
+
+
+def test_roi_pooling_vs_oracle():
+    data = np.arange(2 * 1 * 6 * 6, dtype=np.float32).reshape(2, 1, 6, 6)
+    rois = np.array([[0, 0, 0, 5, 5], [1, 2, 2, 5, 5]], dtype=np.float32)
+    out = nd.ROIPooling(nd.array(data), nd.array(rois),
+                        pooled_size=(2, 2), spatial_scale=1.0).asnumpy()
+    assert out.shape == (2, 1, 2, 2)
+    # roi 0 covers whole 6x6 → bins are 3x3 max pools
+    img = data[0, 0]
+    exp = np.array([[img[:3, :3].max(), img[:3, 3:].max()],
+                    [img[3:, :3].max(), img[3:, 3:].max()]])
+    np.testing.assert_allclose(out[0, 0], exp)
+    # roi 1 on image 1: rows/cols 2..5
+    img1 = data[1, 0, 2:6, 2:6]
+    exp1 = np.array([[img1[:2, :2].max(), img1[:2, 2:].max()],
+                     [img1[2:, :2].max(), img1[2:, 2:].max()]])
+    np.testing.assert_allclose(out[1, 0], exp1)
+
+
+def test_roi_align_runs_and_grads():
+    rng = np.random.RandomState(0)
+    data = nd.array(rng.uniform(size=(1, 2, 8, 8)).astype(np.float32))
+    rois = nd.array(np.array([[0, 1, 1, 6, 6]], dtype=np.float32))
+    data.attach_grad()
+    with mx.autograd.record():
+        out = nd.contrib.ROIAlign(data, rois, pooled_size=(3, 3),
+                                  spatial_scale=1.0, sample_ratio=2)
+        loss = out.sum()
+    loss.backward()
+    assert out.shape == (1, 2, 3, 3)
+    g = data.grad.asnumpy()
+    assert np.abs(g).sum() > 0  # gradients flow to sampled region
+
+
+def test_contrib_symbol_path():
+    """MultiBox ops compose symbolically (SSD symbol_builder pattern)."""
+    data = mx.sym.Variable("data")
+    anchors = mx.sym.contrib.MultiBoxPrior(data, sizes=(0.5,), ratios=(1.0,))
+    _, out_shapes, _ = anchors.infer_shape(data=(1, 3, 4, 4))
+    assert tuple(out_shapes[0]) == (1, 16, 4)
