@@ -66,3 +66,9 @@ from . import kvstore
 from . import kvstore as kv
 from .kvstore import KVStore
 from . import rnn
+from . import profiler
+from . import monitor
+from .monitor import Monitor
+from . import visualization
+from . import visualization as viz
+from . import runtime

@@ -14,7 +14,15 @@ __all__ = [
     "np_dtype",
     "dtype_name",
     "string_types",
+    "_as_list",
 ]
+
+
+def _as_list(obj):
+    """Coerce to list (python/mxnet/base.py _as_list parity)."""
+    if isinstance(obj, (list, tuple)):
+        return list(obj)
+    return [obj]
 
 
 class MXNetError(RuntimeError):

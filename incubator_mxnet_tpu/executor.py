@@ -72,6 +72,7 @@ class Executor:
         self.outputs: List[NDArray] = []
         self._vjp_fn = None
         self._monitor_callback = None
+        self._monitor_all = False
         self._jits: Dict[Any, Any] = {}
 
     # ------------------------------------------------------------------
@@ -178,10 +179,44 @@ class Executor:
             self.aux_dict[name]._data = val
 
         self.outputs = [NDArray(v) for v in out_vals]
-        if self._monitor_callback is not None:
-            for (node, i), v in zip(self._symbol._outputs, self.outputs):
-                self._monitor_callback(node.name, v)
+        if self._monitor_callback is not None and \
+                getattr(self._monitor_callback, "is_active", lambda: True)():
+            self._run_monitor(is_train, key)
         return self.outputs
+
+    def _run_monitor(self, is_train, key):
+        """Eager per-node evaluation feeding the monitor callback with every
+        intermediate output (MXExecutorSetMonitorCallback semantics —
+        src/executor/graph_executor.cc installs per-op engine callbacks; here
+        a debug re-walk of the graph outside jit).  Reuses the forward
+        pass's RNG key so stochastic intermediates (Dropout masks) match
+        what the forward actually computed."""
+        from .symbol.symbol import _eval_node, _toposort
+        tc = tracing.TraceContext(key, is_train)
+        tracing.push_trace(tc)
+        try:
+            bindings = {n: self.arg_dict[n]._data for n in self._arg_names}
+            bindings.update(
+                {n: self.aux_dict[n]._data for n in self._aux_names})
+            cache: Dict[Any, Any] = {}
+            for node in _toposort([n for n, _ in self._symbol._outputs]):
+                if node.is_var:
+                    cache[(id(node), 0)] = None if node.name == "__null__" \
+                        else bindings[node.name]
+                    continue
+                in_vals = [cache[(id(p), i)] for p, i in node.inputs]
+                if self._monitor_all:
+                    for (p, pi), v in zip(node.inputs, in_vals):
+                        if v is not None:
+                            self._monitor_callback(
+                                "%s_%s" % (node.name, p.name), NDArray(v))
+                outs = _eval_node(node, in_vals)
+                for i, o in enumerate(outs):
+                    cache[(id(node), i)] = o
+                    suffix = "_output" if i == 0 else "_output%d" % i
+                    self._monitor_callback(node.name + suffix, NDArray(o))
+        finally:
+            tracing.pop_trace()
 
     def backward(self, out_grads=None, is_train=True):
         if self._vjp_fn is None:
@@ -244,6 +279,7 @@ class Executor:
 
     def set_monitor_callback(self, callback, monitor_all=False):
         self._monitor_callback = callback
+        self._monitor_all = monitor_all
 
     @property
     def output_dict(self):

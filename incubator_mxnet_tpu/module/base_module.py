@@ -20,10 +20,7 @@ from ..ndarray import ndarray as _nd
 __all__ = ["BaseModule", "_as_list"]
 
 
-def _as_list(obj):
-    if isinstance(obj, (list, tuple)):
-        return list(obj)
-    return [obj]
+from ..base import _as_list  # noqa: F401 (re-export, legacy import site)
 
 
 class BatchEndParam:

@@ -1,0 +1,74 @@
+"""``mx.monitor`` — per-op output statistics (reference:
+python/mxnet/monitor.py; callback install MXExecutorSetMonitorCallback)."""
+from __future__ import annotations
+
+import re
+from typing import Any, List, Tuple
+
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    """Collects ``stat_func`` of every op output each ``interval`` batches
+    (monitor.py:38)."""
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def stat_func(x):  # noqa: ANN001
+                return x.abs().mean() if hasattr(x, "abs") else abs(x).mean()
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue: List[Tuple[int, str, Any]] = []
+        self.step = 0
+        self.exes: List[Any] = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+        def stat_helper(name, array):
+            if not self.activated or not self.re_prog.match(name):
+                return
+            self.queue.append((self.step, name, self.stat_func(array)))
+        # lets the executor skip the (expensive) eager monitor re-walk on
+        # batches where this monitor isn't collecting
+        stat_helper.is_active = lambda: self.activated
+        self.stat_helper = stat_helper
+
+    def install(self, exe, monitor_all=False):
+        """Install on an Executor (monitor.py:97)."""
+        exe.set_monitor_callback(self.stat_helper, monitor_all)
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting for this batch if the interval hits
+        (monitor.py:105)."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Finish the batch; return list of (step, name, stat_str)
+        (monitor.py:117)."""
+        if not self.activated:
+            return []
+        self.activated = False
+        res = []
+        queue = self.queue
+        if self.sort:
+            queue = sorted(queue, key=lambda x: x[1])
+        for n, k, v in queue:
+            if isinstance(v, NDArray):
+                v = v.asnumpy()
+            res.append((n, k, str(v)))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """toc + print (monitor.py:139)."""
+        res = self.toc()
+        for n, k, v in res:
+            print("Batch: {:7d} {:30s} {:s}".format(n, k, v))
+        return res

@@ -1,12 +1,78 @@
-"""``mx.nd.contrib`` — resolves ``name`` to the ``_contrib_name`` op
-(reference: python/mxnet/ndarray/contrib.py + generated op wrappers)."""
+"""``mx.nd.contrib`` — resolves ``name`` to the ``_contrib_name`` op, plus
+imperative control flow (reference: python/mxnet/ndarray/contrib.py —
+foreach :187, while_loop :320, cond :452)."""
 from __future__ import annotations
 
 import sys
 
 from ..ops import registry as _reg
 
-__all__ = []
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+from ..base import _as_list
+
+
+def foreach(body, data, init_states, name="foreach"):
+    """Eager scan: iterate ``body(data_t, states)`` over axis 0
+    (ndarray/contrib.py:187).  The Python loop runs on NDArrays so the
+    autograd tape records every step; under a hybridize trace the loop
+    unrolls into the compiled graph."""
+    data_list = _as_list(data)
+    states = _as_list(init_states)
+    single_state = not isinstance(init_states, (list, tuple))
+    length = data_list[0].shape[0]
+    outputs = None
+    for i in range(length):
+        eles = [d[i] for d in data_list]
+        outs, states = body(eles[0] if len(eles) == 1 else eles,
+                            states[0] if single_state else states)
+        states = _as_list(states)
+        outs = _as_list(outs)
+        if outputs is None:
+            outputs = [[] for _ in outs]
+        for buf, o in zip(outputs, outs):
+            buf.append(o)
+    from .ndarray import stack
+    stacked = [stack(*buf, axis=0) for buf in (outputs or [])]
+    out = stacked[0] if len(stacked) == 1 else stacked
+    return out, (states[0] if single_state else states)
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None, name="while_loop"):
+    """Eager bounded while loop (ndarray/contrib.py:320).  Step outputs are
+    stacked and zero-padded to ``max_iterations`` rows."""
+    from .ndarray import stack
+
+    if max_iterations is None:
+        raise ValueError("max_iterations is required")
+    single_var = not isinstance(loop_vars, (list, tuple))
+    vs = _as_list(loop_vars)
+    steps = []
+    n_iter = 0
+    while n_iter < max_iterations and bool(cond(*vs).asnumpy().item()):
+        outs, new_vs = func(*vs)
+        vs = _as_list(new_vs)
+        steps.append(_as_list(outs))
+        n_iter += 1
+    if not steps:
+        raise ValueError("while_loop made zero iterations; output shapes "
+                         "are undefined (matches the reference error)")
+    n_out = len(steps[0])
+    outputs = []
+    for j in range(n_out):
+        rows = [s[j] for s in steps]
+        pad = [rows[0] * 0] * (int(max_iterations) - len(rows))
+        outputs.append(stack(*(rows + pad), axis=0))
+    out = outputs[0] if n_out == 1 else outputs
+    return out, (vs[0] if single_var else vs)
+
+
+def cond(pred, then_func, else_func, name="cond"):
+    """Eager conditional (ndarray/contrib.py:452)."""
+    if bool(pred.asnumpy().item()):
+        return then_func()
+    return else_func()
 
 
 def __getattr__(name):

@@ -15,6 +15,7 @@ from . import optimizer_ops  # noqa: F401
 from . import rnn  # noqa: F401  (fused RNN via lax.scan)
 from . import linalg  # noqa: F401  (la_op family)
 from . import contrib  # noqa: F401  (detection/bounding-box ops)
+from . import control_flow  # noqa: F401  (foreach/while_loop/cond)
 
 __all__ = ["registry", "Op", "get_op", "invoke", "invoke_raw", "list_ops",
            "register"]

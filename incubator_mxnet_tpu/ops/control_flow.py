@@ -1,0 +1,118 @@
+"""Higher-order control-flow ops over subgraphs.
+
+Reference: ``src/operator/control_flow.cc:1089,1150,1211`` (_foreach,
+_while_loop, _cond as stateful ops executing a CachedOp subgraph per
+iteration, with hand-written gradients).
+
+TPU-native design: the subgraph (a Symbol) is stored as a node attribute;
+evaluation lowers to ``lax.scan`` / ``lax.while_loop`` / ``lax.cond``
+INSIDE the enclosing jitted program, so the loop compiles to one XLA While
+op and gradients come from ``jax.vjp`` through the scan — no hand-written
+backward graphs.
+
+Node input convention (set by symbol/contrib.py frontends):
+  [data..., states..., free-captured vars...]  with name lists in attrs.
+"""
+from __future__ import annotations
+
+from jax import lax
+
+from .registry import register
+
+__all__ = []
+
+
+def _eval_sub(subgraph, bindings):
+    from ..symbol.symbol import _eval_graph
+    return _eval_graph(subgraph, bindings)
+
+
+@register("_foreach", num_inputs=None, needs_rng=False)
+def _foreach(*arrays, subgraph=None, data_names=(), state_names=(),
+             free_names=(), num_out_data=0):
+    """scan the subgraph over axis 0 of each data input
+    (control_flow.cc:1089).  Outputs: [stacked data outputs...,
+    final states...]."""
+    nd_ = len(data_names)
+    ns = len(state_names)
+    data = arrays[:nd_]
+    states = tuple(arrays[nd_:nd_ + ns])
+    free = dict(zip(free_names, arrays[nd_ + ns:]))
+
+    def body(carry, xs):
+        bind = dict(free)
+        bind.update(zip(data_names, xs))
+        bind.update(zip(state_names, carry))
+        outs = _eval_sub(subgraph, bind)
+        return tuple(outs[num_out_data:]), tuple(outs[:num_out_data])
+
+    carry, stacked = lax.scan(body, states, tuple(data))
+    out = list(stacked) + list(carry)
+    return tuple(out) if len(out) != 1 else out[0]
+
+
+@register("_while_loop", num_inputs=None)
+def _while_loop(*arrays, cond_graph=None, body_graph=None, var_names=(),
+                free_names=(), max_iterations=0, num_out_data=0):
+    """Bounded while loop (control_flow.cc:1150).  Step outputs are written
+    into max_iterations-sized buffers (rows past the final iteration stay
+    zero); returns [out_bufs..., final loop vars...]."""
+    import jax.numpy as jnp
+
+    nv = len(var_names)
+    loop_vars = tuple(arrays[:nv])
+    free = dict(zip(free_names, arrays[nv:]))
+    max_iterations = int(max_iterations)
+
+    def run_cond(vs):
+        bind = dict(free)
+        bind.update(zip(var_names, vs))
+        (c,) = _eval_sub(cond_graph, bind)
+        return c.astype(bool).reshape(())
+
+    def run_body(vs):
+        bind = dict(free)
+        bind.update(zip(var_names, vs))
+        outs = _eval_sub(body_graph, bind)
+        return outs[:num_out_data], tuple(outs[num_out_data:])
+
+    # Bounded scan with a live-mask instead of lax.while_loop: the loop
+    # count is already bounded by max_iterations, and scan (unlike
+    # while_loop) is reverse-differentiable, so while_loop graphs train.
+    def step(carry, _):
+        alive, vs = carry
+        alive = alive & run_cond(vs)
+        outs, new_vs = run_body(vs)
+        outs = [jnp.where(alive, o, jnp.zeros_like(o)) for o in outs]
+        vs = tuple(jnp.where(alive, nv, v) for nv, v in zip(new_vs, vs))
+        return (alive, vs), tuple(outs)
+
+    (_, final_vars), bufs = lax.scan(
+        step, (jnp.bool_(True), loop_vars), None, length=max_iterations)
+    out = list(bufs) + list(final_vars)
+    return tuple(out) if len(out) != 1 else out[0]
+
+
+@register("_cond", num_inputs=None)
+def _cond(*arrays, pred_graph=None, then_graph=None, else_graph=None,
+          pred_names=(), branch_names=(), free_names=()):
+    """lax.cond over then/else subgraphs (control_flow.cc:1211)."""
+    np_ = len(pred_names)
+    nb = len(branch_names)
+    pred_in = arrays[:np_]
+    branch_in = tuple(arrays[np_:np_ + nb])
+    free = dict(zip(free_names, arrays[np_ + nb:]))
+
+    bind_p = dict(free)
+    bind_p.update(zip(pred_names, pred_in))
+    (p,) = _eval_sub(pred_graph, bind_p)
+
+    def run(graph, ins):
+        bind = dict(free)
+        bind.update(zip(branch_names, ins))
+        return tuple(_eval_sub(graph, bind))
+
+    out = lax.cond(p.astype(bool).reshape(()),
+                   lambda ins: run(then_graph, ins),
+                   lambda ins: run(else_graph, ins), branch_in)
+    return out if len(out) != 1 else out[0]

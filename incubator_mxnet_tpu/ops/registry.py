@@ -115,6 +115,19 @@ def invoke(name: str, inputs: Sequence[Any], out=None, **attrs):
     Mirrors Imperative::Invoke (``src/imperative/imperative.cc:89``): infer +
     execute + (if recording) tape.  Returns NDArray or list of NDArrays.
     """
+    from .. import profiler
+
+    if profiler.is_running():
+        import time
+        t0 = time.monotonic()
+        try:
+            return _invoke_impl(name, inputs, out, **attrs)
+        finally:
+            profiler.record_op(name, (time.monotonic() - t0) * 1e6)
+    return _invoke_impl(name, inputs, out, **attrs)
+
+
+def _invoke_impl(name: str, inputs: Sequence[Any], out=None, **attrs):
     from .. import autograd
     from ..ndarray import NDArray
 

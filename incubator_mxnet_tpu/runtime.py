@@ -1,0 +1,86 @@
+"""``mx.runtime`` — runtime feature detection (reference:
+python/mxnet/runtime.py:76,90; core src/libinfo.cc:34 FeatureSet).
+
+The reference reports compile-time flags (CUDA, CUDNN, MKLDNN, SSE...).
+The TPU build's feature matrix is determined at runtime from the JAX
+install and visible devices instead of at compile time.
+"""
+from __future__ import annotations
+
+import collections
+
+__all__ = ["Feature", "Features", "feature_list", "libinfo_features"]
+
+Feature = collections.namedtuple("Feature", ["name", "enabled"])
+
+
+def _detect():
+    import jax
+
+    feats = {}
+
+    def add(name, enabled):
+        feats[name] = Feature(name, bool(enabled))
+
+    platforms = set()
+    try:
+        platforms = {d.platform for d in jax.devices()}
+    except Exception:
+        pass
+    add("TPU", "tpu" in platforms)
+    add("GPU", "gpu" in platforms or "cuda" in platforms)
+    add("CPU", True)
+    add("XLA", True)
+    add("BF16", True)
+    add("INT64_TENSOR_SIZE", True)
+    add("SIGNAL_HANDLER", True)
+    add("OPENCV", _has("cv2"))
+    add("PALLAS", _has("jax.experimental.pallas"))
+    add("DIST_KVSTORE", True)          # mesh-collective KVStore (parallel/)
+    add("F16C", True)                  # fp16 conversions via XLA
+    add("NATIVE_ENGINE", _has_native())
+    return feats
+
+
+def _has(mod):
+    import importlib.util
+    try:
+        return importlib.util.find_spec(mod) is not None
+    except (ImportError, ModuleNotFoundError, ValueError):
+        return False
+
+
+def _has_native():
+    try:
+        from .engine import _native_lib
+        return _native_lib() is not None
+    except Exception:
+        return False
+
+
+class Features(collections.OrderedDict):
+    """Map of feature name → Feature (runtime.py:76)."""
+
+    instance = None
+
+    def __init__(self):
+        super().__init__(_detect())
+
+    def __repr__(self):
+        return str(list(self.values()))
+
+    def is_enabled(self, feature_name: str) -> bool:
+        """True if the feature is enabled (runtime.py:90)."""
+        feature_name = feature_name.upper()
+        if feature_name not in self:
+            raise RuntimeError("Feature '%s' is unknown, known features are: "
+                               "%s" % (feature_name, list(self.keys())))
+        return self[feature_name].enabled
+
+
+def feature_list():
+    """List of Feature tuples (runtime.py:107 libinfo_features)."""
+    return list(Features().values())
+
+
+libinfo_features = feature_list

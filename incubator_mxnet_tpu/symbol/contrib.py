@@ -1,12 +1,144 @@
-"""``mx.sym.contrib`` — resolves ``name`` to the ``_contrib_name`` op
-(reference: python/mxnet/symbol/contrib.py + generated op wrappers)."""
+"""``mx.sym.contrib`` — resolves ``name`` to the ``_contrib_name`` op, plus
+symbolic control flow (reference: python/mxnet/symbol/contrib.py — foreach
+:92, while_loop :272, cond :459; backing ops src/operator/control_flow.cc).
+
+The subgraph Symbol is stored as a node attribute and lowered to
+``lax.scan`` / ``lax.while_loop`` / ``lax.cond`` inside the executor's one
+jitted program (see ops/control_flow.py)."""
 from __future__ import annotations
 
 import sys
 
+from ..name import NameManager
 from ..ops import registry as _reg
 
-__all__ = []
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+from ..base import _as_list
+
+
+def _free_variables(subgraph, exclude_names):
+    """Var nodes of the subgraph that are NOT the fresh loop inputs —
+    captured outer parameters (the reference cuts the graph the same way
+    in symbol/contrib.py _get_graph_inputs)."""
+    from .symbol import Symbol, _toposort
+    seen = []
+    for node in _toposort([n for n, _ in subgraph._outputs]):
+        if node.is_var and node.name not in exclude_names \
+                and node.name != "__null__":
+            seen.append(node)
+    return [Symbol([(n, 0)]) for n in seen]
+
+
+def _make_cf_node(opname, name_hint, entries_syms, attrs, num_outputs, name):
+    from .symbol import Symbol, _Node
+    name = NameManager.current().get(name, name_hint)
+    entries = [s._outputs[0] for s in entries_syms]
+    node = _Node(opname, name, attrs, entries, num_outputs=num_outputs)
+    return Symbol([(node, i) for i in range(num_outputs)])
+
+
+def foreach(body, data, init_states, name="foreach"):
+    """Symbolic scan: ``body(data_t, states) -> (outputs, new_states)``
+    (symbol/contrib.py:92)."""
+    from . import var as _var
+
+    data_list = _as_list(data)
+    states_list = _as_list(init_states)
+    single_state = not isinstance(init_states, (list, tuple))
+
+    data_names = tuple("__foreach_data%d" % i for i in range(len(data_list)))
+    state_names = tuple("__foreach_state%d" % i
+                        for i in range(len(states_list)))
+    dvars = [_var(n) for n in data_names]
+    svars = [_var(n) for n in state_names]
+    outs, out_states = body(dvars[0] if len(dvars) == 1 else dvars,
+                            svars[0] if single_state else svars)
+    outs = _as_list(outs)
+    out_states = _as_list(out_states)
+    assert len(out_states) == len(states_list), \
+        "body must return as many states as init_states"
+    from .symbol import Group
+    subgraph = Group(outs + out_states)
+    free = _free_variables(subgraph, set(data_names) | set(state_names))
+    attrs = dict(subgraph=subgraph, data_names=data_names,
+                 state_names=state_names,
+                 free_names=tuple(s.name for s in free),
+                 num_out_data=len(outs))
+    total = len(outs) + len(out_states)
+    res = _make_cf_node("_foreach", "foreach",
+                        data_list + states_list + free, attrs, total, name)
+    res_list = list(res)
+    out = res_list[0] if len(outs) == 1 else res_list[:len(outs)]
+    st = res_list[len(outs):]
+    return out, (st[0] if single_state else st)
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None, name="while_loop"):
+    """Symbolic bounded while loop (symbol/contrib.py:272)."""
+    from . import var as _var
+    from .symbol import Group
+
+    if max_iterations is None:
+        raise ValueError("max_iterations is required")
+    single_var = not isinstance(loop_vars, (list, tuple))
+    vars_list = _as_list(loop_vars)
+    var_names = tuple("__while_var%d" % i for i in range(len(vars_list)))
+    vvars = [_var(n) for n in var_names]
+
+    cond_out = cond(*vvars)
+    cond_graph = Group([cond_out])
+    outs, new_vars = func(*vvars)
+    outs = _as_list(outs)
+    new_vars = _as_list(new_vars)
+    assert len(new_vars) == len(vars_list), \
+        "func must return as many loop_vars as it consumes"
+    body_graph = Group(outs + new_vars)
+    free_syms = {}
+    for s in _free_variables(cond_graph, set(var_names)) + \
+            _free_variables(body_graph, set(var_names)):
+        free_syms[s.name] = s
+    free = list(free_syms.values())
+    attrs = dict(cond_graph=cond_graph, body_graph=body_graph,
+                 var_names=var_names,
+                 free_names=tuple(s.name for s in free),
+                 max_iterations=int(max_iterations),
+                 num_out_data=len(outs))
+    total = len(outs) + len(new_vars)
+    res = _make_cf_node("_while_loop", "while_loop", vars_list + free,
+                        attrs, total, name)
+    res_list = list(res)
+    out = res_list[0] if len(outs) == 1 else res_list[:len(outs)]
+    vs = res_list[len(outs):]
+    return out, (vs[0] if single_var else vs)
+
+
+def cond(pred, then_func, else_func, inputs=None, name="cond"):
+    """Symbolic conditional (symbol/contrib.py:459).  ``pred``/branches are
+    zero-arg closures over outer symbols, like the reference."""
+    from .symbol import Group
+
+    pred_out = pred() if callable(pred) else pred
+    pred_graph = Group([pred_out])
+    then_out = _as_list(then_func())
+    else_out = _as_list(else_func())
+    assert len(then_out) == len(else_out), \
+        "then and else branches must produce the same number of outputs"
+    then_graph = Group(then_out)
+    else_graph = Group(else_out)
+    free_syms = {}
+    for g in (pred_graph, then_graph, else_graph):
+        for s in _free_variables(g, set()):
+            free_syms[s.name] = s
+    free = list(free_syms.values())
+    attrs = dict(pred_graph=pred_graph, then_graph=then_graph,
+                 else_graph=else_graph, pred_names=(), branch_names=(),
+                 free_names=tuple(s.name for s in free))
+    total = len(then_out)
+    res = _make_cf_node("_cond", "cond", free, attrs, total, name)
+    res_list = list(res)
+    return res_list[0] if total == 1 else res_list
 
 
 def __getattr__(name):

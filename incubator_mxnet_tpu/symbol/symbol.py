@@ -193,7 +193,13 @@ class Symbol:
                          "broadcast_mul": "_mul_scalar",
                          "broadcast_div": "_rdiv_scalar" if reverse else "_div_scalar",
                          "broadcast_power": "_rpower_scalar" if reverse else "_power_scalar",
-                         "broadcast_mod": "_rmod_scalar" if reverse else "_mod_scalar"}[opname]
+                         "broadcast_mod": "_rmod_scalar" if reverse else "_mod_scalar",
+                         "broadcast_equal": "_equal_scalar",
+                         "broadcast_not_equal": "_not_equal_scalar",
+                         "broadcast_greater": "_lesser_scalar" if reverse else "_greater_scalar",
+                         "broadcast_greater_equal": "_lesser_equal_scalar" if reverse else "_greater_equal_scalar",
+                         "broadcast_lesser": "_greater_scalar" if reverse else "_lesser_scalar",
+                         "broadcast_lesser_equal": "_greater_equal_scalar" if reverse else "_lesser_equal_scalar"}[opname]
             node = _Node(scalar_op, name, {"scalar": float(other)},
                          [self._outputs[0]])
             return Symbol([(node, 0)])
@@ -429,15 +435,23 @@ class Symbol:
 # ---------------------------------------------------------------------------
 
 
+_SUBGRAPH_PREFIX = "__subgraph_json__:"
+
+
 def _attr_to_str(v):
     if isinstance(v, str):
         return v
+    if isinstance(v, Symbol):
+        # control-flow subgraph attrs round-trip as nested JSON
+        return _SUBGRAPH_PREFIX + v.tojson()
     return repr(v)
 
 
 def _parse_attr(s):
     if not isinstance(s, str):
         return s
+    if s.startswith(_SUBGRAPH_PREFIX):
+        return load_json(s[len(_SUBGRAPH_PREFIX):])
     try:
         return ast.literal_eval(s)
     except (ValueError, SyntaxError):

@@ -1,0 +1,120 @@
+"""``mx.visualization`` — network summary/plot (reference:
+python/mxnet/visualization.py — print_summary :47, plot_network :211)."""
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape: Optional[Dict] = None, line_length=120,
+                  positions=(.44, .64, .74, 1.)):
+    """Print a Keras-style layer table with output shapes and param counts
+    (visualization.py:47)."""
+    if shape is not None:
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**shape)
+        arg_shape_dict = dict(zip(symbol.list_arguments(), arg_shapes))
+        arg_shape_dict.update(zip(symbol.list_auxiliary_states(),
+                                  aux_shapes))
+        interals = symbol.get_internals()
+        _, internal_shapes, _ = interals.infer_shape(**shape)
+        shape_dict = dict(zip(interals.list_outputs(), internal_shapes))
+        shape_dict.update(arg_shape_dict)
+    else:
+        shape_dict = {}
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    heads = {h[0] for h in conf["heads"]}
+    positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #",
+                  "Previous Layer"]
+
+    def print_row(fields, pos):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:pos[i]]
+            line += " " * (pos[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+    total_params = 0
+
+    def shape_of(name):
+        for suffix in ("_output", "_output0", ""):
+            s = shape_dict.get(name + suffix)
+            if s is not None:
+                return s
+        return None
+
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null" and i not in heads:
+            continue
+        out_shape = shape_of(name)
+        cur_param = 0
+        for inp in node.get("inputs", []):
+            in_node = nodes[inp[0]]
+            if in_node["op"] == "null" and \
+                    (in_node["name"].endswith("weight")
+                     or in_node["name"].endswith("bias")
+                     or in_node["name"].endswith("gamma")
+                     or in_node["name"].endswith("beta")):
+                s = shape_of(in_node["name"])
+                if s:
+                    p = 1
+                    for d in s:
+                        p *= d
+                    cur_param += p
+        prev = ",".join(nodes[inp[0]]["name"]
+                        for inp in node.get("inputs", []))[:40]
+        print_row(["%s (%s)" % (name, op), out_shape or "", cur_param, prev],
+                  positions)
+        total_params += cur_param
+    print("=" * line_length)
+    print("Total params: %d" % total_params)
+    print("_" * line_length)
+    return total_params
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 dtype=None, node_attrs=None, hide_weights=True):
+    """Graphviz plot (visualization.py:211).  Needs the optional graphviz
+    package; raises ImportError otherwise like the reference."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("Draw network requires graphviz library")
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    dot = Digraph(name=title, format=save_format)
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            if hide_weights and (name.endswith("weight")
+                                 or name.endswith("bias")
+                                 or name.endswith("gamma")
+                                 or name.endswith("beta")):
+                continue
+            dot.node(name=name, label=name, shape="oval")
+        else:
+            dot.node(name=name, label="%s\n%s" % (name, op), shape="box")
+    known = {n["name"] for n in nodes
+             if not (hide_weights and n["op"] == "null"
+                     and (n["name"].endswith("weight")
+                          or n["name"].endswith("bias")
+                          or n["name"].endswith("gamma")
+                          or n["name"].endswith("beta")))}
+    for node in nodes:
+        if node["op"] == "null":
+            continue
+        for inp in node.get("inputs", []):
+            src = nodes[inp[0]]["name"]
+            if src in known:
+                dot.edge(tail_name=src, head_name=node["name"])
+    return dot

@@ -1,0 +1,176 @@
+"""Control-flow op tests (model: tests/python/unittest/test_contrib_control_flow.py)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+# --------------------------------------------------------------- imperative
+
+def test_nd_foreach_cumsum():
+    data = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    init = nd.zeros((3,))
+
+    def body(x, s):
+        new = x + s
+        return new, new
+
+    outs, final = nd.contrib.foreach(body, data, init)
+    ref = np.cumsum(np.arange(12).reshape(4, 3), axis=0)
+    np.testing.assert_allclose(outs.asnumpy(), ref, rtol=1e-6)
+    np.testing.assert_allclose(final.asnumpy(), ref[-1], rtol=1e-6)
+
+
+def test_nd_foreach_grad():
+    data = nd.array(np.ones((3, 2), np.float32))
+    w = nd.array(np.full((2,), 2.0, np.float32))
+    w.attach_grad()
+    init = nd.zeros((2,))
+    with mx.autograd.record():
+        outs, final = nd.contrib.foreach(
+            lambda x, s: ((x * w + s), (x * w + s)), data, init)
+        loss = final.sum()
+    loss.backward()
+    # final = 3 * w elementwise per col → d final.sum()/dw = 3 per element
+    np.testing.assert_allclose(w.grad.asnumpy(), [3.0, 3.0], rtol=1e-6)
+
+
+def test_nd_while_loop():
+    def cond(i, s):
+        return i < 5
+
+    def func(i, s):
+        return s + i, [i + 1, s + i]
+
+    outs, (i_f, s_f) = nd.contrib.while_loop(
+        cond, func, [nd.array([0.0]), nd.array([0.0])], max_iterations=8)
+    assert outs.shape == (8, 1)
+    np.testing.assert_allclose(i_f.asnumpy(), [5.0])
+    np.testing.assert_allclose(s_f.asnumpy(), [0 + 1 + 2 + 3 + 4])
+    # padded rows are zero
+    np.testing.assert_allclose(outs.asnumpy()[5:], 0)
+
+
+def test_nd_cond():
+    x = nd.array([2.0])
+    out = nd.contrib.cond(x.sum() > 1, lambda: x * 10, lambda: x - 1)
+    np.testing.assert_allclose(out.asnumpy(), [20.0])
+    out = nd.contrib.cond(x.sum() > 5, lambda: x * 10, lambda: x - 1)
+    np.testing.assert_allclose(out.asnumpy(), [1.0])
+
+
+# --------------------------------------------------------------- symbolic
+
+def test_sym_foreach_rnn_like():
+    """foreach compiles to one lax.scan inside the bound program."""
+    T, N, H = 4, 2, 3
+    data = mx.sym.Variable("data")          # (T, N, H)
+    init = mx.sym.Variable("init")          # (N, H)
+    w = mx.sym.Variable("w")                # (H,) captured free var
+
+    def body(x, s):
+        new = mx.sym.broadcast_add(x * w, s)
+        return new, new
+
+    outs, final = mx.sym.contrib.foreach(body, data, init)
+    g = mx.sym.Group([outs, final])
+    args = sorted(g.list_arguments())
+    assert args == ["data", "init", "w"]
+
+    rng = np.random.RandomState(0)
+    xv = rng.uniform(size=(T, N, H)).astype(np.float32)
+    wv = rng.uniform(size=(H,)).astype(np.float32)
+    exe = g.bind(mx.current_context(),
+                 {"data": nd.array(xv), "init": nd.zeros((N, H)),
+                  "w": nd.array(wv)})
+    outs_v, final_v = exe.forward(is_train=False)
+    # oracle
+    s = np.zeros((N, H), np.float32)
+    expect = []
+    for t in range(T):
+        s = xv[t] * wv + s
+        expect.append(s)
+    np.testing.assert_allclose(outs_v.asnumpy(), np.stack(expect),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(final_v.asnumpy(), expect[-1], rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_sym_foreach_backward():
+    data = mx.sym.Variable("data")
+    init = mx.sym.Variable("init")
+
+    def body(x, s):
+        new = x + s
+        return new, new
+
+    outs, final = mx.sym.contrib.foreach(body, data, init)
+    exe = final.bind(mx.current_context(),
+                     {"data": nd.array(np.ones((3, 2), np.float32)),
+                      "init": nd.zeros((2,))},
+                     args_grad={"data": nd.zeros((3, 2)),
+                                "init": nd.zeros((2,))})
+    exe.forward(is_train=True)
+    exe.backward([nd.ones((2,))])
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(),
+                               np.ones((3, 2)), rtol=1e-6)
+    np.testing.assert_allclose(exe.grad_dict["init"].asnumpy(),
+                               np.ones((2,)), rtol=1e-6)
+
+
+def test_sym_while_loop():
+    i = mx.sym.Variable("i")
+    s = mx.sym.Variable("s")
+
+    outs, final = mx.sym.contrib.while_loop(
+        lambda i, s: i < 5,
+        lambda i, s: (s + i, [i + 1, s + i]),
+        [i, s], max_iterations=8)
+    g = mx.sym.Group([outs] + final)
+    exe = g.bind(mx.current_context(),
+                 {"i": nd.array([0.0]), "s": nd.array([0.0])})
+    outs_v, i_f, s_f = exe.forward(is_train=False)
+    assert outs_v.shape == (8, 1)
+    np.testing.assert_allclose(i_f.asnumpy(), [5.0])
+    np.testing.assert_allclose(s_f.asnumpy(), [10.0])
+
+
+def test_sym_while_loop_backward():
+    """while_loop lowers to a bounded scan, so it is reverse-differentiable
+    (the reference's _while_loop registers a backward too)."""
+    x = mx.sym.Variable("x")
+    outs, final = mx.sym.contrib.while_loop(
+        lambda v: mx.sym.sum(v) < 100,
+        lambda v: (v * 2, [v * 2]),
+        [x], max_iterations=3)
+    exe = final[0].bind(mx.current_context(), {"x": nd.array([1.0])},
+                        args_grad={"x": nd.zeros((1,))})
+    exe.forward(is_train=True)
+    exe.backward([nd.ones((1,))])
+    # v doubles 3 times → d(8x)/dx = 8
+    np.testing.assert_allclose(exe.grad_dict["x"].asnumpy(), [8.0],
+                               rtol=1e-6)
+
+
+def test_sym_cond():
+    x = mx.sym.Variable("x")
+    out = mx.sym.contrib.cond(lambda: mx.sym.sum(x) > 1,
+                              lambda: x * 10, lambda: x - 1)
+    exe = out.bind(mx.current_context(), {"x": nd.array([2.0])})
+    np.testing.assert_allclose(exe.forward()[0].asnumpy(), [20.0])
+    exe2 = out.bind(mx.current_context(), {"x": nd.array([0.5])})
+    np.testing.assert_allclose(exe2.forward()[0].asnumpy(), [-0.5])
+
+
+def test_foreach_json_roundtrip():
+    data = mx.sym.Variable("data")
+    init = mx.sym.Variable("init")
+    outs, final = mx.sym.contrib.foreach(lambda x, s: (x + s, x + s),
+                                         data, init)
+    js = final.tojson()
+    sym2 = mx.sym.load_json(js)
+    exe = sym2.bind(mx.current_context(),
+                    {"data": nd.array(np.ones((3, 2), np.float32)),
+                     "init": nd.zeros((2,))})
+    np.testing.assert_allclose(exe.forward()[0].asnumpy(),
+                               np.full((2,), 3.0), rtol=1e-6)

@@ -1,0 +1,112 @@
+"""Profiler/Monitor/visualization/runtime tests (models:
+tests/python/unittest/test_profiler.py, test_runtime.py)."""
+import json
+import os
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def test_profiler_scopes_and_dump(tmp_path):
+    fname = str(tmp_path / "profile.json")
+    mx.profiler.set_config(filename=fname)
+    mx.profiler.set_state("run")
+    with mx.profiler.Task("my_task"):
+        a = nd.ones((8, 8))
+        b = nd.dot(a, a)
+        b.wait_to_read()
+    with mx.profiler.Frame("my_frame"):
+        pass
+    c = mx.profiler.Counter("my_counter", value=1)
+    c += 5
+    mx.profiler.Marker("hello").mark()
+    mx.profiler.dump()
+    assert os.path.exists(fname)
+    trace = json.load(open(fname))
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "my_task" in names
+    assert "my_frame" in names
+    assert "my_counter" in names
+    assert "hello" in names
+    # op dispatch events recorded (dot etc.)
+    cats = {e["cat"] for e in trace["traceEvents"]}
+    assert "operator" in cats
+
+
+def test_profiler_dumps_aggregate():
+    mx.profiler.set_state("run")
+    x = nd.ones((4, 4))
+    (x + x).wait_to_read()
+    s = mx.profiler.dumps()
+    assert "Name" in s
+    mx.profiler.set_state("stop")
+
+
+def test_profiler_pause_resume():
+    mx.profiler.set_state("run")
+    mx.profiler.pause()
+    assert not mx.profiler.is_running()
+    mx.profiler.resume()
+    assert mx.profiler.is_running()
+    mx.profiler.set_state("stop")
+
+
+def test_monitor_collects_stats():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    act = mx.sym.Activation(fc, act_type="relu", name="relu")
+    exe = act.bind(mx.current_context(),
+                   {"data": nd.ones((2, 3)),
+                    "fc_weight": nd.ones((4, 3)),
+                    "fc_bias": nd.zeros((4,))})
+    mon = mx.Monitor(interval=1)
+    mon.install(exe)
+    mon.tic()
+    exe.forward(is_train=False)
+    res = mon.toc()
+    names = [k for _, k, _ in res]
+    assert any("fc" in n for n in names)
+    assert any("relu" in n for n in names)
+
+
+def test_monitor_pattern_filter():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    act = mx.sym.Activation(fc, act_type="relu", name="myact")
+    exe = act.bind(mx.current_context(),
+                   {"data": nd.ones((2, 3)),
+                    "fc_weight": nd.ones((4, 3)),
+                    "fc_bias": nd.zeros((4,))})
+    mon = mx.Monitor(interval=1, pattern="myact.*")
+    mon.install(exe)
+    mon.tic()
+    exe.forward()
+    res = mon.toc()
+    assert res and all(k.startswith("myact") for _, k, _ in res)
+
+
+def test_print_summary(capsys):
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=10, name="fc2")
+    total = mx.visualization.print_summary(fc2, shape={"data": (1, 32)})
+    out = capsys.readouterr().out
+    assert "fc1" in out and "fc2" in out
+    # params: 32*16+16 + 16*10+10
+    assert total == 32 * 16 + 16 + 16 * 10 + 10
+
+
+def test_runtime_features():
+    feats = mx.runtime.Features()
+    assert feats.is_enabled("XLA")
+    assert feats.is_enabled("CPU")
+    fl = mx.runtime.feature_list()
+    assert any(f.name == "TPU" for f in fl)
+    try:
+        feats.is_enabled("NO_SUCH_FEATURE")
+        raise AssertionError("expected RuntimeError")
+    except RuntimeError:
+        pass
