@@ -66,6 +66,9 @@ from . import kvstore
 from . import kvstore as kv
 from .kvstore import KVStore
 from . import rnn
+from . import contrib
+from . import operator
+from . import image
 from . import profiler
 from . import monitor
 from .monitor import Monitor

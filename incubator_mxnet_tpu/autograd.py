@@ -190,6 +190,10 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # no
                 g = jnp.zeros(o.shape, o.dtype)
             else:
                 any_grad = True
+                if g.dtype != o.dtype:
+                    # mixed-precision graphs (AMP) accumulate f32 cotangents
+                    # for bf16 outputs; vjp requires exact dtype match
+                    g = g.astype(o.dtype)
             out_grads.append(g)
         if not any_grad:
             continue

@@ -1,0 +1,3 @@
+"""``mx.contrib`` — experimental subpackages (reference:
+python/mxnet/contrib/)."""
+from . import amp  # noqa: F401

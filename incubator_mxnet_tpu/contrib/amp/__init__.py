@@ -1,0 +1,6 @@
+"""``mx.contrib.amp`` (reference: python/mxnet/contrib/amp/__init__.py)."""
+from .amp import (init, init_trainer, scale_loss, unscale, convert_model,
+                  convert_symbol, convert_hybrid_block, list_lp16_ops,
+                  list_fp32_ops, disable)
+from .loss_scaler import LossScaler
+from . import lists  # noqa: F401

@@ -1,0 +1,203 @@
+"""Automatic mixed precision (reference:
+python/mxnet/contrib/amp/amp.py — init :251, convert_model :389,
+convert_hybrid_block :470, scale_loss, unscale; graph pass
+src/nnvm/low_precision_pass.cc ReducePrecision).
+
+Two mechanisms, mirroring the reference:
+
+- ``init()``: a runtime cast policy on the op registry — every dispatch
+  (eager or traced) casts inputs of MXU-class ops to the target dtype and
+  of sensitive ops to float32.  Inside jit, XLA fuses these casts into the
+  surrounding ops, so this is the zero-copy path.
+- ``convert_model()/convert_symbol()``: an explicit graph rewrite that
+  inserts ``amp_cast`` nodes, for deployment without global state.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+from ...ops import registry as _reg
+from .lists import symbol_bf16 as _lists
+from .loss_scaler import LossScaler
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale", "convert_model",
+           "convert_symbol", "convert_hybrid_block", "list_lp16_ops",
+           "list_fp32_ops"]
+
+_amp_initialized = False
+_loss_scaler: Optional[LossScaler] = None
+
+
+def _expand(names):
+    """Include registry aliases of each listed op."""
+    out = set()
+    for n in names:
+        if n in _reg.OPS:
+            op = _reg.OPS[n]
+            out.add(op.name)
+            out.update(op.aliases)
+        else:
+            out.add(n)
+    return frozenset(out)
+
+
+def list_lp16_ops(target_dtype="bfloat16"):
+    return list(_lists.FP16_FUNCS)
+
+
+def list_fp32_ops(target_dtype="bfloat16"):
+    return list(_lists.FP32_FUNCS)
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Turn on the AMP cast policy (amp.py:251).  ``target_dtype`` defaults
+    to bfloat16 — the TPU-native half type (fp16 also accepted)."""
+    global _amp_initialized, _loss_scaler
+    import jax.numpy as jnp
+
+    assert str(target_dtype) in ("bfloat16", "float16"), \
+        "AMP target dtype must be bfloat16 or float16"
+    lo = set(_lists.FP16_FUNCS)
+    if target_precision_ops:
+        lo.update(target_precision_ops)
+    hi = set(_lists.FP32_FUNCS)
+    if fp32_ops:
+        hi.update(fp32_ops)
+    cond = {}
+    for name, attr, vals in (conditional_fp32_ops
+                             or _lists.CONDITIONAL_FP32_FUNCS):
+        for alias in _expand([name]):
+            cond[alias] = (attr, {str(v) for v in vals})
+    _reg.AMP_POLICY.update(
+        active=True,
+        target=jnp.bfloat16 if target_dtype == "bfloat16" else jnp.float16,
+        lo=_expand(lo), hi=_expand(hi), cond=cond)
+    _amp_initialized = True
+    _loss_scaler = LossScaler(
+        init_scale=1.0 if target_dtype == "bfloat16" else 2.**16)
+
+
+def disable():
+    """Turn the policy off (test helper; no reference equivalent — the
+    reference cannot un-init)."""
+    global _amp_initialized
+    _reg.AMP_POLICY.update(active=False, target=None, lo=frozenset(),
+                           hi=frozenset(), cond={})
+    _amp_initialized = False
+
+
+def init_trainer(optimizer_or_trainer):
+    """Attach the shared LossScaler to a Trainer (amp.py:321)."""
+    assert _amp_initialized, "call amp.init() before amp.init_trainer()"
+    optimizer_or_trainer._amp_loss_scaler = _loss_scaler
+    return optimizer_or_trainer
+
+
+@contextlib.contextmanager
+def scale_loss(loss, optimizer_or_trainer):
+    """Multiply the loss by the current scale; the paired Trainer.step
+    divides gradients back (amp.py:347)."""
+    scaler = getattr(optimizer_or_trainer, "_amp_loss_scaler", None) \
+        or _loss_scaler
+    scale = scaler.loss_scale if scaler is not None else 1.0
+    if isinstance(loss, (list, tuple)):
+        yield [l * scale for l in loss]
+    else:
+        yield loss * scale
+
+
+def unscale(optimizer_or_trainer):
+    """Divide accumulated gradients by the loss scale (amp.py:374)."""
+    scaler = getattr(optimizer_or_trainer, "_amp_loss_scaler", None) \
+        or _loss_scaler
+    if scaler is None or scaler.loss_scale == 1.0:
+        return
+    inv = 1.0 / scaler.loss_scale
+    params = getattr(optimizer_or_trainer, "_params", None)
+    if params is None:
+        return
+    for p in params:
+        if getattr(p, "grad_req", "write") != "null":
+            g = p.grad()
+            g[:] = g * inv
+
+
+# ---------------------------------------------------------------------------
+# graph rewrite (ReducePrecision pass analog)
+# ---------------------------------------------------------------------------
+
+def convert_symbol(sym, target_dtype="bfloat16", target_dtype_ops=None,
+                   fp32_ops=None, conditional_fp32_ops=None,
+                   excluded_sym_names=None, data_names=None,
+                   cast_optional_params=False):
+    """Insert amp_cast nodes on the inputs of low-precision ops and fp32
+    casts on sensitive ops (amp.py:389 convert_symbol)."""
+    from ...symbol.symbol import Symbol, _Node, _toposort
+
+    excluded = set(excluded_sym_names or ())
+    lo = _expand(set(target_dtype_ops or _lists.FP16_FUNCS))
+    hi = _expand(set(fp32_ops or _lists.FP32_FUNCS))
+
+    old_nodes = _toposort([n for n, _ in sym._outputs])
+    mapping = {}
+    counter = [0]
+
+    def cast_entry(entry, dtype):
+        p, i = entry
+        if p.is_var and p.name == "__null__":
+            return entry  # omitted optional input (no_bias etc.)
+        counter[0] += 1
+        node = _Node("amp_cast", "amp_cast%d" % counter[0],
+                     {"dtype": dtype}, [(p, i)])
+        return (node, 0)
+
+    cond_rules = {name: (attr, set(vals)) for name, attr, vals in
+                  (conditional_fp32_ops or _lists.CONDITIONAL_FP32_FUNCS)}
+
+    for node in old_nodes:
+        if node.is_var:
+            mapping[id(node)] = node
+            continue
+        new_inputs = [(mapping[id(p)], i) for p, i in node.inputs]
+        if node.name not in excluded:
+            cond = cond_rules.get(node.op)
+            cond_hit = cond is not None and \
+                str(node.attrs.get(cond[0])) in cond[1]
+            if cond_hit or node.op in hi:
+                new_inputs = [cast_entry(e, "float32") for e in new_inputs]
+            elif node.op in lo:
+                new_inputs = [cast_entry(e, target_dtype)
+                              for e in new_inputs]
+        nn = _Node(node.op, node.name, dict(node.attrs), new_inputs,
+                   num_outputs=node.num_outputs)
+        mapping[id(node)] = nn
+
+    return Symbol([(mapping[id(n)], i) for n, i in sym._outputs])
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16",
+                  target_dtype_ops=None, fp32_ops=None,
+                  conditional_fp32_ops=None, excluded_sym_names=None,
+                  cast_optional_params=False):
+    """convert_symbol + (optionally) cast params (amp.py:470)."""
+    new_sym = convert_symbol(sym, target_dtype, target_dtype_ops, fp32_ops,
+                             conditional_fp32_ops, excluded_sym_names,
+                             cast_optional_params=cast_optional_params)
+    if cast_optional_params:
+        arg_params = {k: v.astype(target_dtype)
+                      for k, v in arg_params.items()}
+        aux_params = {k: v.astype(target_dtype)
+                      for k, v in aux_params.items()}
+    return new_sym, dict(arg_params), dict(aux_params)
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16", **kwargs):
+    """Gluon path: with the runtime policy active the CachedOp trace already
+    dispatches through the cast policy, so this just ensures init()
+    (amp.py:470 convert_hybrid_block)."""
+    if not _amp_initialized:
+        init(target_dtype=target_dtype)
+    return block
+

@@ -1,0 +1,91 @@
+"""AMP op lists (reference: python/mxnet/contrib/amp/lists/symbol_fp16.py).
+
+On TPU the low-precision type is bfloat16: the MXU consumes bf16 natively
+and bf16 has fp32's exponent range, so the FP16_FUNCS list (reference
+naming kept for compat) holds the MXU-bound ops, FP32_FUNCS the
+numerically sensitive ones, and WIDEST_TYPE_CASTS the multi-input
+elementwise ops cast to their widest operand type.
+"""
+
+# ops that run in low precision (matmul/conv class — MXU-bound)
+FP16_FUNCS = [
+    "Convolution",
+    "Deconvolution",
+    "FullyConnected",
+    "RNN",
+    "dot",
+    "batch_dot",
+    "_contrib_interleaved_matmul_selfatt_qk",
+    "_contrib_interleaved_matmul_selfatt_valatt",
+    "_contrib_interleaved_matmul_encdec_qk",
+    "_contrib_interleaved_matmul_encdec_valatt",
+]
+
+# ops forced to float32 (reductions / exponentials / losses / norms)
+FP32_FUNCS = [
+    "softmax",
+    "log_softmax",
+    "SoftmaxOutput",
+    "softmax_cross_entropy",
+    "SoftmaxActivation",
+    "LinearRegressionOutput",
+    "LogisticRegressionOutput",
+    "MAERegressionOutput",
+    "BatchNorm",
+    "LayerNorm",
+    "InstanceNorm",
+    "GroupNorm",
+    "L2Normalization",
+    "LRN",
+    "norm",
+    "exp",
+    "log",
+    "log2",
+    "log10",
+    "log1p",
+    "expm1",
+    "square",
+    "sqrt",
+    "rsqrt",
+    "cbrt",
+    "rcbrt",
+    "pow",
+    "broadcast_power",
+    "mean",
+    "sum",
+    "nansum",
+    "prod",
+    "nanprod",
+    "CTCLoss",
+    "smooth_l1",
+    "MakeLoss",
+]
+
+# multi-input elementwise ops cast to the widest input type.  Under this
+# framework that behavior needs no pass: the ops are jnp functions, and
+# NumPy promotion rules already compute bf16+f32 in f32.  The list is kept
+# for API parity / documentation of which ops rely on promotion.
+WIDEST_TYPE_CASTS = [
+    "elemwise_add",
+    "elemwise_sub",
+    "elemwise_mul",
+    "elemwise_div",
+    "broadcast_add",
+    "broadcast_sub",
+    "broadcast_mul",
+    "broadcast_div",
+    "broadcast_maximum",
+    "broadcast_minimum",
+    "Concat",
+    "concat",
+    "where",
+]
+
+# everything else runs in whatever dtype its inputs carry
+CONDITIONAL_FP32_FUNCS = [
+    ("Activation", "act_type", ["softrelu"]),
+    ("LeakyReLU", "act_type", ["elu", "selu"]),
+]
+
+LOSS_OUTPUT_FUNCS = ["SoftmaxOutput", "LinearRegressionOutput",
+                     "LogisticRegressionOutput", "MAERegressionOutput"]

@@ -1,0 +1,44 @@
+"""Dynamic loss scaling (reference:
+python/mxnet/contrib/amp/loss_scaler.py).
+
+Needed for float16; bfloat16 shares float32's exponent range so the scaler
+degenerates to scale=1 there, but the API is kept for parity and for
+explicit fp16 experiments.
+"""
+from __future__ import annotations
+
+__all__ = ["LossScaler"]
+
+
+class LossScaler:
+    def __init__(self, init_scale=2.**16, scale_factor=2., scale_window=2000,
+                 tolerance=0.05):
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        """True if any gradient is non-finite (all_finite op —
+        src/operator/contrib/all_finite.cc)."""
+        from ...ndarray import NDArray
+        from ...ops.registry import invoke
+        for p in params:
+            grad = p.grad() if callable(getattr(p, "grad", None)) else p
+            if isinstance(grad, NDArray):
+                ok = invoke("all_finite", [grad])
+                if not bool(ok.asnumpy().item()):
+                    return True
+        return False
+
+    def update_scale(self, overflow):
+        """Halve on overflow; double every scale_window clean steps
+        (loss_scaler.py:48)."""
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped == self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0

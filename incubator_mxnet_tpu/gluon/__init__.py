@@ -8,6 +8,7 @@ from . import loss
 from . import utils
 from . import model_zoo
 from . import rnn
+from . import contrib
 from .utils import split_and_load
 
 __all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",

@@ -187,8 +187,8 @@ class Block:
 
         loaded = nd_load(filename)
         params = self._collect_params_with_prefix()
-        if loaded and params and all("." not in k for k in loaded):
-            # fall back: file saved with full parameter names
+        if loaded and params and not any(k in params for k in loaded):
+            # fall back: file saved with full (prefixed) parameter names
             by_name = {p.name: p for p in params.values()}
             params = {k: by_name.get(k) for k in loaded if by_name.get(k)}
         for name, p in params.items():

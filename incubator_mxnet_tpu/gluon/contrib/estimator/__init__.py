@@ -1,0 +1,4 @@
+"""Estimator API (reference: python/mxnet/gluon/contrib/estimator/)."""
+from .estimator import Estimator
+from .event_handler import *  # noqa: F401,F403
+from . import event_handler

@@ -1,0 +1,132 @@
+"""Gluon Estimator (reference:
+python/mxnet/gluon/contrib/estimator/estimator.py — Estimator :42,
+fit :326)."""
+from __future__ import annotations
+
+import copy
+import warnings
+from typing import List, Optional
+
+from .... import autograd, initializer as init_mod, metric as metric_mod
+from ....base import _as_list
+from ... import Trainer
+from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
+                            LoggingHandler, MetricHandler, StoppingHandler,
+                            TrainBegin, TrainEnd, ValidationHandler)
+
+__all__ = ["Estimator"]
+
+
+class Estimator:
+    """Keras-like fit/evaluate driver over a gluon net (estimator.py:42)."""
+
+    def __init__(self, net, loss, metrics=None, initializer=None,
+                 trainer=None, context=None):
+        self.net = net
+        self.loss = loss
+        self.train_metrics = _as_list(metrics) if metrics else []
+        self.context = context
+        self.stop_training = False
+        self.resumed_epoch = 0
+
+        if initializer is not None:
+            self.net.initialize(init=initializer, force_reinit=True)
+        elif any(p._data is None and p._deferred_init is None
+                 for p in self.net.collect_params().values()):
+            # only touch genuinely uninitialized params; a real init error
+            # must propagate, not be swallowed as "already initialized"
+            self.net.initialize()
+        if trainer is None:
+            trainer = Trainer(self.net.collect_params(), "adam",
+                              {"learning_rate": 1e-3})
+        self.trainer = trainer
+
+        # loss metric always tracked (estimator.py prepare_loss_and_metrics)
+        self.train_loss_metric = metric_mod.Loss(
+            name="train loss") if hasattr(metric_mod, "Loss") else None
+        self.val_metrics = [copy.deepcopy(m) for m in self.train_metrics]
+
+    # ------------------------------------------------------------------
+    def evaluate(self, val_data, batch_axis=0):
+        """Run validation metrics over val_data (estimator.py:228)."""
+        for metric in self.val_metrics:
+            metric.reset()
+        for batch in val_data:
+            data, label = self._unpack(batch)
+            pred = self.net(data)
+            for metric in self.val_metrics:
+                metric.update([label], [pred])
+        return {m.get()[0]: m.get()[1] for m in self.val_metrics}
+
+    @staticmethod
+    def _unpack(batch):
+        if isinstance(batch, (list, tuple)):
+            return batch[0], batch[1]
+        return batch.data[0], batch.label[0]
+
+    # ------------------------------------------------------------------
+    def fit(self, train_data, val_data=None, epochs=None,
+            event_handlers=None, batches=None, batch_axis=0):
+        """Training loop with event dispatch (estimator.py:326)."""
+        self.stop_training = False
+        if epochs is None and batches is None:
+            epochs = 1
+        handlers = self._prepare_handlers(val_data, event_handlers,
+                                          epochs, batches)
+        train_begin = [h for h in handlers if isinstance(h, TrainBegin)]
+        epoch_begin = [h for h in handlers if isinstance(h, EpochBegin)]
+        batch_begin = [h for h in handlers if isinstance(h, BatchBegin)]
+        batch_end = [h for h in handlers if isinstance(h, BatchEnd)]
+        epoch_end = [h for h in handlers if isinstance(h, EpochEnd)]
+        train_end = [h for h in handlers if isinstance(h, TrainEnd)]
+
+        for h in train_begin:
+            h.train_begin(self)
+        while not self.stop_training:
+            for h in epoch_begin:
+                h.epoch_begin(self)
+            if self.train_loss_metric is not None:
+                self.train_loss_metric.reset()
+            for batch in train_data:
+                if self.stop_training:
+                    break
+                data, label = self._unpack(batch)
+                for h in batch_begin:
+                    h.batch_begin(self, batch=batch)
+                with autograd.record():
+                    pred = self.net(data)
+                    loss = self.loss(pred, label)
+                loss.backward()
+                bsz = data.shape[batch_axis]
+                self.trainer.step(bsz)
+                if self.train_loss_metric is not None:
+                    self.train_loss_metric.update(0, [loss])
+                for h in batch_end:
+                    h.batch_end(self, batch=batch, pred=[pred],
+                                label=[label], loss=[loss])
+            for h in epoch_end:
+                h.epoch_end(self)
+        for h in train_end:
+            h.train_end(self)
+        return self
+
+    def _prepare_handlers(self, val_data, event_handlers, epochs, batches):
+        handlers = list(event_handlers or [])
+        added_default = not any(isinstance(h, (StoppingHandler,))
+                                for h in handlers)
+        if added_default:
+            handlers.append(StoppingHandler(max_epoch=epochs,
+                                            max_batch=batches))
+        if not any(isinstance(h, MetricHandler) for h in handlers):
+            handlers.append(MetricHandler(self.train_metrics))
+        if val_data is not None and not any(
+                isinstance(h, ValidationHandler) for h in handlers):
+            handlers.append(ValidationHandler(val_data, self.evaluate))
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            metrics = list(self.train_metrics)
+            if self.train_loss_metric is not None:
+                metrics.append(self.train_loss_metric)
+            handlers.append(LoggingHandler(metrics=metrics))
+        # sort by priority where present (reference sorts the same way)
+        handlers.sort(key=lambda h: getattr(h, "priority", 0))
+        return handlers

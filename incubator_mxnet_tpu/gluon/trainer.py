@@ -77,10 +77,22 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def step(self, batch_size, ignore_stale_grad=False):
-        """All-reduce grads (mesh/kvstore) then update (trainer.py:320)."""
+        """All-reduce grads (mesh/kvstore) then update (trainer.py:320).
+
+        With an attached AMP LossScaler (contrib.amp.init_trainer), the
+        scaled loss's gradients are divided back via rescale_grad, the
+        update is skipped on non-finite gradients, and the dynamic scale
+        is adjusted (amp.py scale_loss/LossScaler contract)."""
         self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        loss_scale = scaler.loss_scale if scaler is not None else 1.0
+        self._optimizer.rescale_grad = self._scale / batch_size / loss_scale
         self.allreduce_grads()
+        if scaler is not None and loss_scale != 1.0:
+            if scaler.has_overflow(self._params):
+                scaler.update_scale(True)
+                return  # skip update on overflow
+            scaler.update_scale(False)
         self.update(batch_size, ignore_stale_grad)
 
     def allreduce_grads(self):
@@ -95,7 +107,9 @@ class Trainer:
                     self._kvstore.pushpull(i, p.grad(), out=p.grad())
 
     def update(self, batch_size, ignore_stale_grad=False):
-        self._optimizer.rescale_grad = self._scale / batch_size
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        loss_scale = scaler.loss_scale if scaler is not None else 1.0
+        self._optimizer.rescale_grad = self._scale / batch_size / loss_scale
         updater = self._updaters[0]
         for i, p in enumerate(self._params):
             if p.grad_req == "null":

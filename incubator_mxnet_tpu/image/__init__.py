@@ -1,0 +1,7 @@
+"""``mx.image`` (reference: python/mxnet/image/__init__.py)."""
+from .image import *  # noqa: F401,F403
+from .image import __all__ as _img_all
+from .detection import *  # noqa: F401,F403
+from .detection import __all__ as _det_all
+
+__all__ = list(_img_all) + list(_det_all)

@@ -1,0 +1,218 @@
+"""``mx.operator`` — Python custom operators (reference:
+python/mxnet/operator.py — CustomOp :155, CustomOpProp :225,
+register :597; C++ side src/operator/custom/custom.cc:70-119).
+
+The reference executes Python callbacks on dedicated engine threads.  Here
+the eager path calls the Python ``CustomOp`` directly, and inside traced
+programs the call lowers to ``jax.pure_callback`` — a host callback with
+static output shapes from ``CustomOpProp.infer_shape`` — with gradients
+wired through ``jax.custom_vjp`` into the CustomOp's ``backward``.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ops.registry import register as _register_op
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered",
+           "NDArrayOp"]
+
+_CUSTOM_PROPS: Dict[str, type] = {}
+
+
+class CustomOp:
+    """Base class for custom imperative kernels (operator.py:155)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write src into dst honoring the req mode (operator.py:180)."""
+        if req in ("null", 0):
+            return
+        if req in ("write", "inplace", 1, 2):
+            dst[:] = src
+        elif req in ("add", 3):
+            dst[:] = dst + src
+
+
+class CustomOpProp:
+    """Describes a custom op's signature (operator.py:225)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Register a CustomOpProp subclass under ``op_type=reg_name``
+    (operator.py:597)."""
+    def do_register(prop_cls):
+        _CUSTOM_PROPS[reg_name] = prop_cls
+        return prop_cls
+    return do_register
+
+
+def get_all_registered():
+    return dict(_CUSTOM_PROPS)
+
+
+def _make_prop(op_type, attrs):
+    if op_type not in _CUSTOM_PROPS:
+        raise ValueError(
+            "custom op type %r not registered via mx.operator.register"
+            % op_type)
+    # reference passes attrs as strings to the prop ctor
+    kwargs = {k: v if isinstance(v, str) else str(v)
+              for k, v in attrs.items()}
+    return _CUSTOM_PROPS[op_type](**kwargs)
+
+
+class _HostArray:
+    """Mutable NDArray-like view handed to CustomOp callbacks."""
+
+    def __init__(self, arr):
+        self._np = np.asarray(arr)
+
+    def __getitem__(self, key):
+        return self._np[key]
+
+    def __setitem__(self, key, value):
+        self._np[key] = np.asarray(
+            value._np if isinstance(value, _HostArray) else value)
+
+    @property
+    def shape(self):
+        return self._np.shape
+
+    @property
+    def dtype(self):
+        return self._np.dtype
+
+    def asnumpy(self):
+        return self._np
+
+    def __array__(self, dtype=None, copy=None):
+        return self._np if dtype is None else self._np.astype(dtype)
+
+    def __add__(self, other):
+        return self._np + (other._np if isinstance(other, _HostArray)
+                           else other)
+
+    __radd__ = __add__
+
+    def __mul__(self, other):
+        return self._np * (other._np if isinstance(other, _HostArray)
+                           else other)
+
+    __rmul__ = __mul__
+
+
+@_register_op("Custom", num_inputs=None)
+def _custom(*arrays, op_type=None, **attrs):
+    """The Custom op (custom.cc:70): host-callback execution of a
+    registered CustomOpProp."""
+    prop = _make_prop(op_type, attrs)
+    n_out = len(prop.list_outputs())
+    in_shapes = [tuple(a.shape) for a in arrays]
+    shape_ret = prop.infer_shape([list(s) for s in in_shapes])
+    out_shapes = [tuple(s) for s in shape_ret[1]]
+    in_dtypes = [np.dtype(a.dtype) for a in arrays] or [np.dtype("float32")]
+    type_ret = prop.infer_type(list(in_dtypes))
+    out_dtypes = [np.dtype(t) for t in type_ret[1]]
+    in_dtypes = [np.dtype(t) for t in type_ret[0]]
+    out_struct = tuple(jax.ShapeDtypeStruct(s, d)
+                       for s, d in zip(out_shapes, out_dtypes))
+
+    @jax.custom_vjp
+    def f(*xs):
+        return _run_forward(*xs)
+
+    def _run_forward(*xs):
+        def host(*np_in):
+            op = prop.create_operator(None, in_shapes, in_dtypes)
+            ins = [_HostArray(a) for a in np_in]
+            outs = [_HostArray(np.zeros(s, d))
+                    for s, d in zip(out_shapes, out_dtypes)]
+            op.forward(is_train=True, req=["write"] * n_out,
+                       in_data=ins, out_data=outs, aux=[])
+            return tuple(o._np for o in outs)
+        return jax.pure_callback(host, out_struct, *xs)
+
+    def fwd(*xs):
+        outs = _run_forward(*xs)
+        return outs, (xs, outs)
+
+    def bwd(res, gs):
+        xs, outs = res
+
+        def host(*np_all):
+            n_in = len(xs)
+            np_in = np_all[:n_in]
+            np_out = np_all[n_in:n_in + n_out]
+            np_g = np_all[n_in + n_out:]
+            op = prop.create_operator(None, in_shapes, in_dtypes)
+            ins = [_HostArray(a) for a in np_in]
+            outs_h = [_HostArray(a) for a in np_out]
+            grads_out = [_HostArray(a) for a in np_g]
+            in_grads = [_HostArray(np.zeros(s, d))
+                        for s, d in zip(in_shapes, in_dtypes)]
+            op.backward(req=["write"] * n_in, out_grad=grads_out,
+                        in_data=ins, out_data=outs_h, in_grad=in_grads,
+                        aux=[])
+            return tuple(g._np for g in in_grads)
+
+        in_struct = tuple(jax.ShapeDtypeStruct(s, d)
+                          for s, d in zip(in_shapes, in_dtypes))
+        return jax.pure_callback(host, in_struct, *xs, *outs, *gs)
+
+    f.defvjp(fwd, bwd)
+    out = f(*arrays)
+    return out if n_out != 1 else out[0]
+
+
+def custom_num_outputs(op_type, attrs):
+    """Arity hook for symbolic composition (MXSymbolCreateAtomicSymbol
+    path for Custom)."""
+    return len(_make_prop(op_type, attrs).list_outputs())
+
+
+class NDArrayOp:  # pragma: no cover - deprecated alias in the reference
+    def __init__(self, *a, **k):
+        raise NotImplementedError("NDArrayOp is deprecated; use CustomOp")

@@ -100,13 +100,42 @@ def list_ops() -> List[str]:
 # ---------------------------------------------------------------------------
 
 
+# AMP cast policy (contrib/amp): when active, inputs of ops in `lo` are
+# cast to the low-precision target and inputs of ops in `hi` to float32
+# before dispatch — the runtime analog of the reference's ReducePrecision
+# graph pass (src/nnvm/low_precision_pass.cc).
+AMP_POLICY: Dict[str, Any] = {"active": False, "target": None,
+                              "lo": frozenset(), "hi": frozenset(),
+                              "cond": {}}
+
+
+def _amp_cast_inputs(op: Op, arrays, attrs=None):
+    if not AMP_POLICY["active"]:
+        return arrays
+    name = op.name
+    cond = AMP_POLICY["cond"].get(name)
+    if cond is not None and attrs is not None \
+            and str(attrs.get(cond[0])) in cond[1]:
+        tgt = jnp.float32      # conditional fp32 (e.g. softrelu Activation)
+    elif name in AMP_POLICY["lo"]:
+        tgt = AMP_POLICY["target"]
+    elif name in AMP_POLICY["hi"]:
+        tgt = jnp.float32
+    else:
+        return arrays
+    return [a.astype(tgt)
+            if a is not None and hasattr(a, "dtype")
+            and jnp.issubdtype(a.dtype, jnp.floating) and a.dtype != tgt
+            else a for a in arrays]
+
+
 def invoke_raw(op: Op, arrays: Sequence[Any], **attrs):
     """Run op.fn on raw jax arrays (trace-safe path)."""
     if op.needs_rng and "key" not in attrs:
         from .. import rng
 
         attrs["key"] = rng.next_key()
-    return op.fn(*arrays, **attrs)
+    return op.fn(*_amp_cast_inputs(op, list(arrays), attrs), **attrs)
 
 
 def invoke(name: str, inputs: Sequence[Any], out=None, **attrs):
@@ -136,6 +165,7 @@ def _invoke_impl(name: str, inputs: Sequence[Any], out=None, **attrs):
         None if i is None else (i._data if isinstance(i, NDArray) else jnp.asarray(i))
         for i in inputs
     ]
+    datas = _amp_cast_inputs(op, datas, attrs)
 
     if op.needs_rng:
         from .. import rng

@@ -304,6 +304,11 @@ def _cast(data, dtype="float32"):
 def _amp_cast(data, dtype="float16"):
     from ..base import np_dtype
 
+    # AMP casts only retype floating data; integer/bool edges (indices,
+    # masks) pass through so convert_model can insert casts on every input
+    # edge without dtype inference (amp.py _get_fun_to_wrap semantics)
+    if not jnp.issubdtype(data.dtype, jnp.floating):
+        return data
     return data.astype(np_dtype(dtype))
 
 

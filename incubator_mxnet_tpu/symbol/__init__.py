@@ -29,6 +29,10 @@ _NULL_NODE = _Node(None, "__null__")
 
 
 def _compose_num_outputs(opname, attrs):
+    if opname == "Custom":
+        from ..operator import custom_num_outputs
+        a = {k: v for k, v in attrs.items() if k != "op_type"}
+        return custom_num_outputs(attrs.get("op_type"), a)
     reg_op = _reg.OPS.get(opname)
     if reg_op is not None and (reg_op.num_outputs or 1) > 1:
         return reg_op.num_outputs

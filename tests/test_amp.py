@@ -1,0 +1,129 @@
+"""AMP tests (model: tests/python/unittest/test_amp.py /
+tests/python/gpu/test_contrib_amp.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.contrib import amp
+
+
+@pytest.fixture(autouse=True)
+def _amp_off():
+    yield
+    amp.disable()
+
+
+def test_amp_init_casts_matmul_to_bf16():
+    amp.init()
+    a = nd.ones((4, 8))
+    b = nd.ones((8, 4))
+    out = nd.dot(a, b)
+    assert out.dtype == np.dtype("bfloat16") or str(out.dtype) == "bfloat16"
+    # fp32-list op keeps float32
+    s = nd.softmax(out.astype("float32"), axis=-1)
+    assert str(s.dtype) == "float32"
+
+
+def test_amp_fp32_ops_upcast():
+    amp.init()
+    x = nd.ones((2, 3)).astype("bfloat16")
+    out = nd.exp(x)
+    assert str(out.dtype) == "float32"
+
+
+def test_amp_integer_inputs_untouched():
+    amp.init()
+    w = nd.ones((10, 4))
+    idx = nd.array(np.array([1, 2, 3], np.float32))
+    out = nd.Embedding(idx, w, input_dim=10, output_dim=4)
+    assert out.shape == (3, 4)
+
+
+def test_loss_scaler_dynamics():
+    ls = amp.LossScaler(init_scale=16.0, scale_factor=2.0, scale_window=2)
+    ls.update_scale(overflow=True)
+    assert ls.loss_scale == 8.0
+    ls.update_scale(False)
+    ls.update_scale(False)
+    assert ls.loss_scale == 16.0
+
+
+def test_all_finite_op():
+    ok = nd.all_finite(nd.ones((3, 3)))
+    assert bool(ok.asnumpy().item())
+    bad = nd.array(np.array([1.0, np.inf], np.float32))
+    assert not bool(nd.all_finite(bad).asnumpy().item())
+
+
+def test_convert_symbol_inserts_casts():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=8, name="fc")
+    sm = mx.sym.softmax(fc, name="sm")
+    conv = amp.convert_symbol(sm, target_dtype="bfloat16")
+    js = conv.tojson()
+    assert "amp_cast" in js
+    # executes and yields float32 after softmax (fp32 list)
+    exe = conv.bind(mx.current_context(),
+                    {"data": nd.ones((2, 4)),
+                     "fc_weight": nd.ones((8, 4)),
+                     "fc_bias": nd.zeros((8,))})
+    out = exe.forward()[0]
+    assert str(out.dtype) == "float32"
+    np.testing.assert_allclose(out.asnumpy().sum(), 2.0, rtol=1e-2)
+
+
+def test_convert_model_roundtrip():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    arg = {"fc_weight": nd.ones((4, 3)), "fc_bias": nd.zeros((4,))}
+    new_sym, new_arg, new_aux = amp.convert_model(fc, arg, {},
+                                                  target_dtype="bfloat16")
+    assert set(new_arg) == set(arg)
+    exe = new_sym.bind(mx.current_context(),
+                       {"data": nd.ones((2, 3)), **new_arg})
+    out = exe.forward()[0]
+    assert out.shape == (2, 4)
+
+
+def test_fp16_scaled_gradients_divided_back():
+    """Trainer.step divides the loss-scaled gradients back and skips the
+    update on overflow (amp scale_loss/LossScaler contract)."""
+    from incubator_mxnet_tpu import gluon
+    amp.init(target_dtype="float16")
+    net = gluon.nn.Dense(1, use_bias=False)
+    net.initialize(init=mx.init.Constant(1.0))
+    net(nd.ones((1, 2)))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 1.0})
+    amp.init_trainer(trainer)
+    trainer._amp_loss_scaler.loss_scale = 4.0  # avoid fp16 overflow in test
+    w0 = list(net.collect_params().values())[0].data().asnumpy().copy()
+    x = nd.ones((1, 2))
+    with mx.autograd.record():
+        y = net(x).sum()
+        with amp.scale_loss(y, trainer) as scaled:
+            pass
+        scaled.backward()
+    trainer.step(1)
+    w1 = list(net.collect_params().values())[0].data().asnumpy()
+    # d(sum(w·x))/dw = x = 1; scaled by 4 then divided back → update = lr*1
+    np.testing.assert_allclose(w0 - w1, 1.0, rtol=1e-2)
+
+
+def test_scale_loss_and_trainer():
+    from incubator_mxnet_tpu import gluon
+    amp.init(target_dtype="float16")
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    amp.init_trainer(trainer)
+    x = nd.ones((3, 4))
+    with mx.autograd.record():
+        y = net(x)
+        loss = y.sum()
+        with amp.scale_loss(loss, trainer) as scaled:
+            pass
+    assert scaled.asnumpy().item() == loss.asnumpy().item() * \
+        trainer._amp_loss_scaler.loss_scale

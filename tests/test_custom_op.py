@@ -1,0 +1,89 @@
+"""Custom op tests (model: tests/python/unittest/test_operator.py
+test_custom_op — the 'sqr' quadratic example from the reference docs)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+class Sqr(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], 2 * in_data[0] * out_grad[0])
+
+
+@mx.operator.register("sqr_test_op")
+class SqrProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Sqr()
+
+
+def test_custom_eager_forward():
+    x = nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    y = nd.Custom(x, op_type="sqr_test_op")
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy() ** 2, rtol=1e-6)
+
+
+def test_custom_eager_backward():
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.Custom(x, op_type="sqr_test_op")
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy(), rtol=1e-6)
+
+
+def test_custom_symbolic():
+    data = mx.sym.Variable("data")
+    y = mx.sym.Custom(data, op_type="sqr_test_op", name="sqr")
+    exe = y.bind(mx.current_context(),
+                 {"data": nd.array(np.array([2.0, 3.0], np.float32))},
+                 args_grad={"data": nd.zeros((2,))})
+    out = exe.forward(is_train=True)[0]
+    np.testing.assert_allclose(out.asnumpy(), [4.0, 9.0], rtol=1e-6)
+    exe.backward([nd.ones((2,))])
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(), [4.0, 6.0],
+                               rtol=1e-6)
+
+
+class TwoOut(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] + 1)
+        self.assign(out_data[1], req[1], in_data[0] * 2)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], out_grad[0] + 2 * out_grad[1])
+
+
+@mx.operator.register("twoout_test_op")
+class TwoOutProp(mx.operator.CustomOpProp):
+    def list_outputs(self):
+        return ["plus", "times"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return TwoOut()
+
+
+def test_custom_multi_output():
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    a, b = nd.Custom(x, op_type="twoout_test_op")
+    np.testing.assert_allclose(a.asnumpy(), [2.0, 3.0])
+    np.testing.assert_allclose(b.asnumpy(), [2.0, 4.0])

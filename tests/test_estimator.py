@@ -1,0 +1,96 @@
+"""Estimator tests (model: tests/python/unittest/test_gluon_estimator.py,
+test_gluon_event_handler.py)."""
+import os
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, nd
+from incubator_mxnet_tpu.gluon.contrib.estimator import (
+    CheckpointHandler, EarlyStoppingHandler, Estimator, StoppingHandler)
+
+
+def _toy_data(n=32, d=8, classes=3, batch=8, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.uniform(size=(n, d)).astype(np.float32)
+    y = rng.randint(0, classes, n).astype(np.float32)
+    ds = gluon.data.ArrayDataset(nd.array(x), nd.array(y))
+    return gluon.data.DataLoader(ds, batch_size=batch)
+
+
+def _net(classes=3):
+    net = gluon.nn.Dense(classes)
+    net.initialize()
+    return net
+
+
+def test_estimator_fit_runs():
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=mx.metric.Accuracy(),
+                    trainer=gluon.Trainer(net.collect_params(), "sgd",
+                                          {"learning_rate": 0.1}))
+    est.fit(_toy_data(), epochs=2)
+    name, acc = est.train_metrics[0].get()
+    assert 0.0 <= acc <= 1.0
+
+
+def test_estimator_validation():
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=mx.metric.Accuracy())
+    est.fit(_toy_data(), val_data=_toy_data(seed=1), epochs=1)
+    res = est.evaluate(_toy_data(seed=2))
+    assert "accuracy" in res
+
+
+def test_stopping_handler_max_batch():
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss())
+    est.fit(_toy_data(), batches=3)
+    # should stop after 3 batches without error
+
+
+def test_checkpoint_handler(tmp_path):
+    model_dir = str(tmp_path / "ckpt")
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=mx.metric.Accuracy())
+    ckpt = CheckpointHandler(model_dir, model_prefix="test", epoch_period=1)
+    est.fit(_toy_data(), epochs=2, event_handlers=[ckpt])
+    files = os.listdir(model_dir)
+    assert "test-epoch0.params" in files
+    assert "test-epoch1.params" in files
+
+    # resume path: new estimator picks up epoch count
+    net2 = _net()
+    est2 = Estimator(net2, gluon.loss.SoftmaxCrossEntropyLoss())
+    ckpt2 = CheckpointHandler(model_dir, model_prefix="test",
+                              resume_from_checkpoint=True)
+    est2.fit(_toy_data(), epochs=1, event_handlers=[ckpt2])
+    assert est2.resumed_epoch == 2
+
+
+def test_early_stopping_handler():
+    class FakeMetric:
+        name = "val accuracy"
+
+        def __init__(self):
+            self.vals = iter([0.5, 0.5, 0.5, 0.5, 0.5])
+
+        def get(self):
+            return self.name, next(self.vals)
+
+        def reset(self):
+            pass
+
+        def update(self, *a):
+            pass
+
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss())
+    m = FakeMetric()
+    early = EarlyStoppingHandler(monitor=m, patience=1)
+    est.fit(_toy_data(), epochs=10, event_handlers=[early])
+    # metric never improves after first epoch → stops well before 10
+    assert early.current_epoch < 10

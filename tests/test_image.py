@@ -1,0 +1,120 @@
+"""mx.image tests (model: tests/python/unittest/test_image.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, recordio
+
+
+def _make_jpeg(path, w=32, h=24, color=(255, 0, 0)):
+    from PIL import Image
+    img = Image.new("RGB", (w, h), color)
+    img.save(path, "JPEG")
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def test_imdecode_imread(tmp_path):
+    p = str(tmp_path / "a.jpg")
+    buf = _make_jpeg(p, 32, 24)
+    img = mx.image.imdecode(buf)
+    assert img.shape == (24, 32, 3)
+    assert str(img.dtype) == "uint8"
+    img2 = mx.image.imread(p)
+    np.testing.assert_allclose(img.asnumpy(), img2.asnumpy())
+    gray = mx.image.imdecode(buf, flag=0)
+    assert gray.shape == (24, 32, 1)
+
+
+def test_imresize_and_resize_short(tmp_path):
+    p = str(tmp_path / "a.jpg")
+    _make_jpeg(p, 40, 20)
+    img = mx.image.imread(p)
+    out = mx.image.imresize(img, 10, 8)
+    assert out.shape == (8, 10, 3)
+    short = mx.image.resize_short(img, 10)
+    assert short.shape == (10, 20, 3)   # shorter edge (h=20→10), w 40→20
+
+
+def test_crops(tmp_path):
+    p = str(tmp_path / "a.jpg")
+    _make_jpeg(p, 30, 30)
+    img = mx.image.imread(p)
+    c, region = mx.image.center_crop(img, (10, 12))
+    assert c.shape == (12, 10, 3)
+    assert region == (10, 9, 10, 12)
+    rc, reg = mx.image.random_crop(img, (8, 8))
+    assert rc.shape == (8, 8, 3)
+    f = mx.image.fixed_crop(img, 2, 3, 5, 6)
+    assert f.shape == (6, 5, 3)
+
+
+def test_color_normalize():
+    src = nd.ones((4, 4, 3)) * 100
+    out = mx.image.color_normalize(src, mean=nd.ones((3,)) * 50,
+                                   std=nd.ones((3,)) * 25)
+    np.testing.assert_allclose(out.asnumpy(), 2.0)
+
+
+def test_augmenter_chain():
+    auglist = mx.image.CreateAugmenter((3, 16, 16), resize=20,
+                                       rand_mirror=True, brightness=0.1,
+                                       mean=True, std=True)
+    img = nd.array(np.random.uniform(0, 255, (24, 32, 3)).astype(np.uint8))
+    for aug in auglist:
+        img = aug(img)
+    assert img.shape == (16, 16, 3)
+    assert str(img.dtype) == "float32"
+
+
+def test_image_iter_imglist(tmp_path):
+    files = []
+    for i in range(6):
+        p = str(tmp_path / ("img%d.jpg" % i))
+        _make_jpeg(p, 20 + i, 20, color=(i * 40, 0, 0))
+        files.append((float(i % 3), p))
+    it = mx.image.ImageIter(batch_size=2, data_shape=(3, 16, 16),
+                            imglist=files, path_root="")
+    batches = list(it)
+    assert len(batches) == 3
+    for b in batches:
+        assert b.data[0].shape == (2, 3, 16, 16)
+        assert b.label[0].shape == (2,)
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_image_iter_imgrec(tmp_path):
+    rec_path = str(tmp_path / "data.rec")
+    idx_path = str(tmp_path / "data.idx")
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(4):
+        jpg = _make_jpeg(str(tmp_path / "t.jpg"), 20, 20, (0, i * 60, 0))
+        header = recordio.IRHeader(0, float(i), i, 0)
+        rec.write_idx(i, recordio.pack(header, jpg))
+    rec.close()
+    it = mx.image.ImageIter(batch_size=2, data_shape=(3, 14, 14),
+                            path_imgrec=rec_path, path_imgidx=idx_path)
+    batches = list(it)
+    assert len(batches) == 2
+    labels = sorted(sum([b.label[0].asnumpy().tolist() for b in batches],
+                        []))
+    assert labels == [0.0, 1.0, 2.0, 3.0]
+
+
+def test_image_det_iter(tmp_path):
+    files = []
+    for i in range(4):
+        p = str(tmp_path / ("d%d.jpg" % i))
+        _make_jpeg(p, 24, 24)
+        # one object per image: [cls, x1, y1, x2, y2]
+        files.append(([float(i % 2), 0.1, 0.1, 0.6, 0.7], p))
+    it = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 16, 16),
+                               imglist=files, path_root="")
+    b = next(iter(it))
+    assert b.data[0].shape == (2, 3, 16, 16)
+    lab = b.label[0].asnumpy()
+    assert lab.shape == (2, 1, 5)
+    assert set(lab[:, 0, 0].tolist()) <= {0.0, 1.0}
