@@ -99,9 +99,14 @@ def init_trainer(optimizer_or_trainer):
 def scale_loss(loss, optimizer_or_trainer):
     """Multiply the loss by the current scale; the paired Trainer.step
     divides gradients back (amp.py:347)."""
-    scaler = getattr(optimizer_or_trainer, "_amp_loss_scaler", None) \
-        or _loss_scaler
-    scale = scaler.loss_scale if scaler is not None else 1.0
+    scaler = getattr(optimizer_or_trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        # scaling the loss without the trainer knowing would apply
+        # gradients loss_scale× too large (reference raises the same way)
+        raise ValueError(
+            "trainer has no attached loss scaler: call "
+            "amp.init_trainer(trainer) before amp.scale_loss")
+    scale = scaler.loss_scale
     if isinstance(loss, (list, tuple)):
         yield [l * scale for l in loss]
     else:

@@ -24,6 +24,8 @@ class LossScaler:
         from ...ndarray import NDArray
         from ...ops.registry import invoke
         for p in params:
+            if getattr(p, "grad_req", "write") == "null":
+                continue  # frozen params have no gradient buffer
             grad = p.grad() if callable(getattr(p, "grad", None)) else p
             if isinstance(grad, NDArray):
                 ok = invoke("all_finite", [grad])

@@ -88,7 +88,9 @@ class Trainer:
         loss_scale = scaler.loss_scale if scaler is not None else 1.0
         self._optimizer.rescale_grad = self._scale / batch_size / loss_scale
         self.allreduce_grads()
-        if scaler is not None and loss_scale != 1.0:
+        if scaler is not None:
+            # check even at loss_scale == 1.0 (the dynamic floor): an
+            # overflowing gradient must skip the update, not poison weights
             if scaler.has_overflow(self._params):
                 scaler.update_scale(True)
                 return  # skip update on overflow

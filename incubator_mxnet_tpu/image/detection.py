@@ -95,13 +95,16 @@ class ImageDetIter(ImageIter):
             label_name, (batch_size, self.max_objects, 5), "float32")]
 
     def _parse_label(self, label):
-        """Flat list label → (N_obj, 5) [cls, x1, y1, x2, y2]
-        (detection.py:772)."""
+        """Flat list label → (N_obj, obj_width) [cls, x1, y1, x2, y2, ...]
+        (detection.py:772: header = [header_width, obj_width, extras...],
+        stripped for any header width)."""
         raw = np.asarray(label, np.float32).reshape(-1)
-        if raw.size >= 2 and raw[0] == 2 and raw[1] == 5:
-            # packed header format: [2, 5, extra..., obj fields...]
-            body = raw[int(raw[0]):]
-            return body.reshape(-1, 5)
+        if raw.size >= 2:
+            header_width = int(raw[0])
+            obj_width = int(raw[1])
+            if 2 <= header_width < raw.size and obj_width >= 5 and \
+                    (raw.size - header_width) % obj_width == 0:
+                return raw[header_width:].reshape(-1, obj_width)
         return raw.reshape(-1, 5)
 
     def _iter_labels(self):

@@ -5,7 +5,8 @@ Replaces the reference's KVStore comm trees / NCCL / ps-lite stack
 """
 from .mesh import Mesh, NamedSharding, P, PartitionSpec, make_mesh, replicated, shard_along
 from .train_step import FunctionalOptimizer, TrainStep, make_train_step
+from .flash_attention import flash_attention
 
 __all__ = ["Mesh", "NamedSharding", "P", "PartitionSpec", "make_mesh",
            "replicated", "shard_along", "FunctionalOptimizer", "TrainStep",
-           "make_train_step"]
+           "make_train_step", "flash_attention"]

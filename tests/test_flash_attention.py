@@ -1,0 +1,104 @@
+"""Pallas flash-attention tests (interpret mode on CPU; same code path
+compiles on TPU)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.parallel import flash_attention
+from incubator_mxnet_tpu.parallel.ring_attention import attention_reference
+
+
+def _qkv(b=2, h=2, s=64, d=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return [jnp.asarray(rng.uniform(-1, 1, (b, h, s, d)).astype(np.float32))
+            for _ in range(3)]
+
+
+def test_flash_forward_matches_dense():
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v)
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_flash_causal():
+    q, k, v = _qkv(s=32)
+    out = flash_attention(q, k, v, causal=True)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_flash_blocking_invariance():
+    """Different block sizes give identical results (streaming softmax)."""
+    q, k, v = _qkv(s=48)
+    a = flash_attention(q, k, v, block_q=16, block_k=16)
+    b = flash_attention(q, k, v, block_q=48, block_k=48)
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_flash_non_pow2_seq():
+    q, k, v = _qkv(s=40)   # 40 % 128 != 0 → block shrinks to a divisor
+    out = flash_attention(q, k, v)
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_flash_causal_cross_length():
+    """kv_len != q_len: causal mask right-aligns (KV-cache decode
+    convention, tril(klen-qlen)) matching attention_reference."""
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.uniform(-1, 1, (1, 2, 4, 8)).astype(np.float32))
+    k = jnp.asarray(rng.uniform(-1, 1, (1, 2, 12, 8)).astype(np.float32))
+    v = jnp.asarray(rng.uniform(-1, 1, (1, 2, 12, 8)).astype(np.float32))
+    out = flash_attention(q, k, v, causal=True, block_q=2, block_k=4)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grads_match_dense(causal):
+    q, k, v = _qkv(s=32)
+
+    def loss(f):
+        return lambda q, k, v: jnp.sum(f(q, k, v, causal=causal) ** 2)
+
+    g_flash = jax.grad(loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss(attention_reference), argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, n in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(gf, gr, rtol=1e-4, atol=1e-5,
+                                   err_msg="d%s mismatch" % n)
+
+
+def test_flash_bf16_runs():
+    q, k, v = [x.astype(jnp.bfloat16) for x in _qkv()]
+    out = flash_attention(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    ref = attention_reference(q.astype(jnp.float32), k.astype(jnp.float32),
+                              v.astype(jnp.float32))
+    np.testing.assert_allclose(out.astype(jnp.float32), ref, rtol=5e-2,
+                               atol=5e-2)
+
+
+def test_flash_op_registry_path():
+    q, k, v = _qkv(s=32)
+    out = nd.contrib.flash_attention(nd.from_jax(q), nd.from_jax(k),
+                                     nd.from_jax(v))
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(out.asnumpy(), np.asarray(ref), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_flash_inside_jit():
+    """The kernel composes under jit (one compiled program)."""
+    q, k, v = _qkv(s=32)
+
+    @jax.jit
+    def f(q, k, v):
+        return flash_attention(q, k, v).sum()
+
+    val = f(q, k, v)
+    ref = attention_reference(q, k, v).sum()
+    np.testing.assert_allclose(val, ref, rtol=1e-5)
