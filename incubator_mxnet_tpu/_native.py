@@ -1,0 +1,108 @@
+"""ctypes loader for the native runtime library (src/native/).
+
+The reference ships one libmxnet.so with a flat C ABI
+(include/mxnet/c_api.h); here the native side covers the host runtime —
+dependency engine, pooled/shm storage, recordio — while device compute is
+JAX/XLA.  The library is built on demand with ``make`` (g++) and cached;
+everything has a pure-Python fallback, so absence of a toolchain only
+costs speed, never functionality.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_LIB = None
+_TRIED = False
+_LOCK = threading.Lock()
+
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "src", "native")
+_LIB_NAME = "libmxtpu_native.so"
+
+
+def _declare(lib):
+    p = ctypes.POINTER
+    lib.MXTEngineCreate.restype = ctypes.c_void_p
+    lib.MXTEngineCreate.argtypes = [ctypes.c_int]
+    lib.MXTEngineFree.argtypes = [ctypes.c_void_p]
+    lib.MXTEngineNewVar.restype = ctypes.c_void_p
+    lib.MXTEngineNewVar.argtypes = [ctypes.c_void_p]
+    lib.MXTEngineDeleteVar.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.MXTEnginePushAsync.restype = ctypes.c_int
+    lib.MXTEnginePushAsync.argtypes = [
+        ctypes.c_void_p, OPR_FN, ctypes.c_void_p,
+        p(ctypes.c_void_p), ctypes.c_int,
+        p(ctypes.c_void_p), ctypes.c_int, ctypes.c_char_p]
+    lib.MXTEngineWaitForVar.restype = ctypes.c_int
+    lib.MXTEngineWaitForVar.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                        ctypes.c_char_p, ctypes.c_int]
+    lib.MXTEngineWaitForAll.restype = ctypes.c_int
+    lib.MXTEngineWaitForAll.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_int]
+
+    lib.MXTStorageAlloc.restype = ctypes.c_void_p
+    lib.MXTStorageAlloc.argtypes = [ctypes.c_size_t]
+    lib.MXTStorageFree.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+    lib.MXTStorageEmptyCache.argtypes = []
+    lib.MXTStoragePooledBytes.restype = ctypes.c_size_t
+
+    lib.MXTShmCreate.restype = ctypes.c_void_p
+    lib.MXTShmCreate.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.MXTShmAttach.restype = ctypes.c_void_p
+    lib.MXTShmAttach.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.MXTShmDetach.restype = ctypes.c_int
+    lib.MXTShmDetach.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+    lib.MXTShmUnlink.restype = ctypes.c_int
+    lib.MXTShmUnlink.argtypes = [ctypes.c_char_p]
+
+    lib.MXTRecordIOWriterCreate.restype = ctypes.c_void_p
+    lib.MXTRecordIOWriterCreate.argtypes = [ctypes.c_char_p]
+    lib.MXTRecordIOWriterWrite.restype = ctypes.c_int
+    lib.MXTRecordIOWriterWrite.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                           ctypes.c_size_t]
+    lib.MXTRecordIOWriterTell.restype = ctypes.c_long
+    lib.MXTRecordIOWriterTell.argtypes = [ctypes.c_void_p]
+    lib.MXTRecordIOWriterFree.argtypes = [ctypes.c_void_p]
+    lib.MXTRecordIOReaderCreate.restype = ctypes.c_void_p
+    lib.MXTRecordIOReaderCreate.argtypes = [ctypes.c_char_p]
+    lib.MXTRecordIOReaderRead.restype = ctypes.c_int
+    lib.MXTRecordIOReaderRead.argtypes = [
+        ctypes.c_void_p, p(ctypes.c_char_p), p(ctypes.c_size_t)]
+    lib.MXTRecordIOReaderSeek.restype = ctypes.c_int
+    lib.MXTRecordIOReaderSeek.argtypes = [ctypes.c_void_p, ctypes.c_long]
+    lib.MXTRecordIOReaderTell.restype = ctypes.c_long
+    lib.MXTRecordIOReaderTell.argtypes = [ctypes.c_void_p]
+    lib.MXTRecordIOReaderFree.argtypes = [ctypes.c_void_p]
+
+
+OPR_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)
+
+
+def get_lib():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        path = os.path.join(_SRC_DIR, _LIB_NAME)
+        if not os.path.exists(path) and os.path.isdir(_SRC_DIR):
+            try:
+                subprocess.run(["make", "-C", _SRC_DIR],
+                               capture_output=True, timeout=120, check=True)
+            except Exception:
+                return None
+        if not os.path.exists(path):
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+            _declare(lib)
+            _LIB = lib
+        except OSError:
+            return None
+    return _LIB

@@ -12,10 +12,117 @@ XLA fuses whole jitted programs anyway), and exception propagation happens at
 from __future__ import annotations
 
 import contextlib
+import ctypes
+import threading
 
 import jax
 
-__all__ = ["waitall", "bulk", "set_bulk_size"]
+__all__ = ["waitall", "bulk", "set_bulk_size", "NativeEngine"]
+
+
+def _native_lib():
+    from ._native import get_lib
+    return get_lib()
+
+
+class NativeEngine:
+    """Host-side dependency engine over the C++ scheduler
+    (src/native/engine.cc; reference: include/mxnet/engine.h:117).
+
+    Ops are Python callables with declared read (``const_vars``) / write
+    (``mutable_vars``) sets over opaque Vars; the C++ side toposorts
+    dynamically — writes serialize per var, reads run concurrently,
+    exceptions surface at :meth:`wait_for_var` / :meth:`wait_for_all`
+    exactly like the reference's WaitToRead rethrow.  Use it for host
+    pipelines (prefetch, decode, checkpoint IO) around the XLA compute.
+    """
+
+    def __init__(self, num_workers=4):
+        from ._native import OPR_FN, get_lib
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError(
+                "native engine unavailable (src/native build failed); "
+                "host pipelining falls back to synchronous Python")
+        self._lib = lib
+        self._fn_type = OPR_FN
+        self._handle = lib.MXTEngineCreate(int(num_workers))
+        self._live = {}          # token -> CFUNCTYPE, kept until safe
+        self._done = set()       # tokens whose callback has returned
+        self._live_lock = threading.Lock()
+        self._counter = 0
+
+    def new_var(self):
+        return self._lib.MXTEngineNewVar(self._handle)
+
+    def delete_var(self, var):
+        self._lib.MXTEngineDeleteVar(self._handle, var)
+
+    def push(self, fn, const_vars=(), mutable_vars=(), name="pyop"):
+        """Schedule ``fn()`` once all its var dependencies resolve
+        (Engine::PushAsync, engine.h:204)."""
+        with self._live_lock:
+            self._counter += 1
+            token = self._counter
+
+        def trampoline(_ctx, _token=token):
+            try:
+                fn()
+                rc = 0
+            except Exception:
+                rc = 1
+            # only MARK done — dropping the CFUNCTYPE here would free the
+            # ffi closure while the C worker is still returning through it
+            with self._live_lock:
+                self._done.add(_token)
+            return rc
+
+        cb = self._fn_type(trampoline)
+        with self._live_lock:
+            self._live[token] = cb
+        n_c, n_m = len(const_vars), len(mutable_vars)
+        c_arr = (ctypes.c_void_p * max(n_c, 1))(*const_vars)
+        m_arr = (ctypes.c_void_p * max(n_m, 1))(*mutable_vars)
+        self._lib.MXTEnginePushAsync(
+            self._handle, cb, None, c_arr, n_c, m_arr, n_m,
+            name.encode())
+
+    def wait_for_var(self, var):
+        buf = ctypes.create_string_buffer(512)
+        rc = self._lib.MXTEngineWaitForVar(self._handle, var, buf, 512)
+        if rc != 0:
+            from .base import MXNetError
+            raise MXNetError(buf.value.decode() or "engine op failed")
+
+    def _prune(self):
+        # safe point: tokens in _done finished their C call long ago
+        # (wait_for_all barrier passed since), so their closures can go
+        with self._live_lock:
+            for t in self._done:
+                self._live.pop(t, None)
+            self._done.clear()
+
+    def wait_for_all(self):
+        buf = ctypes.create_string_buffer(512)
+        rc = self._lib.MXTEngineWaitForAll(self._handle, buf, 512)
+        self._prune()
+        if rc != 0:
+            from .base import MXNetError
+            raise MXNetError(buf.value.decode() or "engine op failed")
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.MXTEngineFree(self._handle)  # joins all workers
+            self._handle = None
+            with self._live_lock:
+                self._live.clear()
+                self._done.clear()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 _BULK_SIZE = 15  # parity default: MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN
 

@@ -88,6 +88,21 @@ class ImageDetIter(ImageIter):
                          shuffle=shuffle, aug_list=[],
                          data_name=data_name, label_name=label_name,
                          **kwargs)
+        if self.imglist is not None:
+            # imglist labels arrive flat [cls, x1, y1, x2, y2]*N —
+            # synthesize the packed [2, 5] header so _parse_label has one
+            # uniform format (reference builds it in _parse_label too)
+            for key, (lab, fname) in list(self.imglist.items()):
+                flat = np.asarray(lab, np.float32).reshape(-1)
+                if flat.size >= 2 and int(flat[0]) >= 2 and \
+                        int(flat[1]) >= 5 and \
+                        (flat.size - int(flat[0])) % int(flat[1]) == 0:
+                    continue  # already packed
+                assert flat.size % 5 == 0, \
+                    "imglist detection label must be [cls,x1,y1,x2,y2]*N"
+                self.imglist[key] = (
+                    np.concatenate([[2.0, 5.0], flat]).astype(np.float32),
+                    fname)
         self.det_auglist = aug_list
         # probe max objects to fix the label pad shape
         self.max_objects = self._estimate_label_shape()
@@ -95,9 +110,12 @@ class ImageDetIter(ImageIter):
             label_name, (batch_size, self.max_objects, 5), "float32")]
 
     def _parse_label(self, label):
-        """Flat list label → (N_obj, obj_width) [cls, x1, y1, x2, y2, ...]
-        (detection.py:772: header = [header_width, obj_width, extras...],
-        stripped for any header width)."""
+        """Packed label → (N_obj, obj_width) [cls, x1, y1, x2, y2, ...]
+        (detection.py:772: header = [header_width, obj_width, extras...]).
+
+        Every label must carry the header (imglist entries get one
+        synthesized at construction); malformed labels raise instead of
+        being silently reinterpreted."""
         raw = np.asarray(label, np.float32).reshape(-1)
         if raw.size >= 2:
             header_width = int(raw[0])
@@ -105,7 +123,10 @@ class ImageDetIter(ImageIter):
             if 2 <= header_width < raw.size and obj_width >= 5 and \
                     (raw.size - header_width) % obj_width == 0:
                 return raw[header_width:].reshape(-1, obj_width)
-        return raw.reshape(-1, 5)
+        raise ValueError(
+            "invalid detection label of size %d: expected packed header "
+            "[header_width, obj_width, ...] followed by objects "
+            "(detection.py pack_label format)" % raw.size)
 
     def _iter_labels(self):
         """Yield labels only — record headers are unpacked without JPEG

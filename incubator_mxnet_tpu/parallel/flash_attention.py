@@ -290,6 +290,29 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, g):
 # public entry
 # ---------------------------------------------------------------------------
 
+@functools.lru_cache(maxsize=128)
+def _make_attn(scale, causal, block_q, block_k, interpret):
+    """One custom_vjp function per static-param tuple — cached so eager
+    callers hit JAX's trace cache instead of re-tracing the kernels every
+    invocation."""
+    @jax.custom_vjp
+    def _attn(qf, kf, vf):
+        out, _ = _fwd(qf, kf, vf, scale, causal, block_q, block_k,
+                      interpret)
+        return out
+
+    def _attn_fwd(qf, kf, vf):
+        out, lse = _fwd(qf, kf, vf, scale, causal, block_q, block_k,
+                        interpret)
+        return out, (qf, kf, vf, out, lse)
+
+    def _attn_bwd(res, g):
+        return _bwd(scale, causal, block_q, block_k, interpret, res, g)
+
+    _attn.defvjp(_attn_fwd, _attn_bwd)
+    return _attn
+
+
 def flash_attention(q, k, v, causal=False, scale: Optional[float] = None,
                     block_q=128, block_k=128, interpret=None):
     """Flash attention over (B, H, S, D) tensors.
@@ -307,22 +330,8 @@ def flash_attention(q, k, v, causal=False, scale: Optional[float] = None,
     qf = q.reshape(b * h, s, d)
     kf = k.reshape(b * h, sk, d)
     vf = v.reshape(b * h, sk, d)
-
-    @functools.partial(jax.custom_vjp)
-    def _attn(qf, kf, vf):
-        out, _ = _fwd(qf, kf, vf, scale, causal, block_q, block_k,
-                      interpret)
-        return out
-
-    def _attn_fwd(qf, kf, vf):
-        out, lse = _fwd(qf, kf, vf, scale, causal, block_q, block_k,
-                        interpret)
-        return out, (qf, kf, vf, out, lse)
-
-    def _attn_bwd(res, g):
-        return _bwd(scale, causal, block_q, block_k, interpret, res, g)
-
-    _attn.defvjp(_attn_fwd, _attn_bwd)
+    _attn = _make_attn(float(scale), bool(causal), int(block_q),
+                       int(block_k), bool(interpret))
     return _attn(qf, kf, vf).reshape(b, h, s, d)
 
 

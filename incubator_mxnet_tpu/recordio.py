@@ -43,13 +43,27 @@ class MXRecordIO:
 
     def open(self):
         if self.flag == "w":
-            self.fh = open(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.fh = open(self.uri, "rb")
             self.writable = False
         else:
             raise ValueError("Invalid flag %s" % self.flag)
+        # native fast path (src/native/recordio.cc) — byte-identical format
+        self._nh = None
+        self._nlib = None
+        from ._native import get_lib
+        lib = get_lib()
+        if lib is not None:
+            h = (lib.MXTRecordIOWriterCreate(self.uri.encode())
+                 if self.writable
+                 else lib.MXTRecordIOReaderCreate(self.uri.encode()))
+            if h:
+                self._nh = h
+                self._nlib = lib
+                self.fh = None
+                self.is_open = True
+                return
+        self.fh = open(self.uri, "wb" if self.writable else "rb")
         self.is_open = True
 
     def __del__(self):
@@ -64,9 +78,9 @@ class MXRecordIO:
         self.close()
         d = dict(self.__dict__)
         d["is_open"] = is_open
-        fh = d.pop("fh", None)
-        if fh is not None:
-            d["fh"] = None
+        d["fh"] = None
+        d["_nh"] = None
+        d["_nlib"] = None
         return d
 
     def __setstate__(self, d):
@@ -78,7 +92,17 @@ class MXRecordIO:
             self.open()
 
     def close(self):
-        if self.is_open and self.fh is not None:
+        if not self.is_open:
+            return
+        if getattr(self, "_nh", None):
+            if self.writable:
+                self._nlib.MXTRecordIOWriterFree(self._nh)
+            else:
+                self._nlib.MXTRecordIOReaderFree(self._nh)
+            self._nh = None
+            self._nlib = None
+            self.is_open = False
+        if self.fh is not None:
             self.fh.close()
             self.fh = None
             self.is_open = False
@@ -88,12 +112,22 @@ class MXRecordIO:
         self.open()
 
     def tell(self):
+        if getattr(self, "_nh", None):
+            if self.writable:
+                return self._nlib.MXTRecordIOWriterTell(self._nh)
+            return self._nlib.MXTRecordIOReaderTell(self._nh)
         return self.fh.tell()
 
     def write(self, buf):
         assert self.writable
         if not isinstance(buf, (bytes, bytearray)):
             buf = bytes(buf)
+        if getattr(self, "_nh", None):
+            rc = self._nlib.MXTRecordIOWriterWrite(self._nh, bytes(buf),
+                                                   len(buf))
+            if rc != 0:
+                raise IOError("native recordio write failed (%d)" % rc)
+            return
         # split payload at embedded magics, dmlc style
         parts = []
         start = 0
@@ -123,6 +157,18 @@ class MXRecordIO:
 
     def read(self):
         assert not self.writable
+        if getattr(self, "_nh", None):
+            import ctypes
+            out = ctypes.c_char_p()
+            out_len = ctypes.c_size_t()
+            rc = self._nlib.MXTRecordIOReaderRead(
+                self._nh, ctypes.byref(out), ctypes.byref(out_len))
+            if rc == 0:
+                return None
+            if rc < 0:
+                raise IOError("native recordio read failed (%d) in %s"
+                              % (rc, self.uri))
+            return ctypes.string_at(out, out_len.value)
         out = bytearray()
         expect_more = False
         while True:
@@ -195,7 +241,10 @@ class MXIndexedRecordIO(MXRecordIO):
     def seek(self, idx):
         assert not self.writable
         pos = self.idx[idx]
-        self.fh.seek(pos)
+        if getattr(self, "_nh", None):
+            self._nlib.MXTRecordIOReaderSeek(self._nh, pos)
+        else:
+            self.fh.seek(pos)
 
     def read_idx(self, idx):
         self.seek(idx)

@@ -1,0 +1,114 @@
+"""Host storage managers (reference: include/mxnet/storage.h,
+src/storage/pooled_storage_manager.h:52,215,
+src/storage/cpu_shared_storage_manager.h).
+
+Device memory belongs to XLA; this covers pooled host staging buffers and
+POSIX shared-memory segments (DataLoader worker IPC).  Backed by
+src/native/storage.cc when built, with a numpy fallback.
+"""
+from __future__ import annotations
+
+import ctypes
+import mmap as _mmap
+import os
+from typing import Optional
+
+import numpy as np
+
+from ._native import get_lib
+
+__all__ = ["alloc", "free", "empty_cache", "pooled_bytes", "SharedMemory"]
+
+
+class _Handle:
+    __slots__ = ("ptr", "size", "array")
+
+    def __init__(self, ptr, size, array):
+        self.ptr = ptr
+        self.size = size
+        self.array = array
+
+
+def alloc(size: int) -> _Handle:
+    """Pooled 64-byte-aligned host buffer (Storage::Get()->Alloc)."""
+    lib = get_lib()
+    if lib is None:
+        arr = np.empty(size, np.uint8)
+        return _Handle(arr.ctypes.data, size, arr)
+    ptr = lib.MXTStorageAlloc(size)
+    if not ptr:
+        raise MemoryError("MXTStorageAlloc(%d) failed" % size)
+    buf = (ctypes.c_uint8 * size).from_address(ptr)
+    arr = np.frombuffer(buf, dtype=np.uint8)
+    return _Handle(ptr, size, arr)
+
+
+def free(handle: _Handle) -> None:
+    lib = get_lib()
+    if lib is not None and handle.ptr:
+        lib.MXTStorageFree(handle.ptr, handle.size)
+        handle.ptr = None
+
+
+def empty_cache() -> None:
+    """Release pooled buffers (MXStorageEmptyCache)."""
+    lib = get_lib()
+    if lib is not None:
+        lib.MXTStorageEmptyCache()
+
+
+def pooled_bytes() -> int:
+    lib = get_lib()
+    return int(lib.MXTStoragePooledBytes()) if lib is not None else 0
+
+
+class SharedMemory:
+    """Named POSIX shm segment — the DataLoader IPC transport
+    (cpu_shared_storage_manager.h semantics)."""
+
+    def __init__(self, name: str, size: int, create: bool = True):
+        self.name = name if name.startswith("/") else "/" + name
+        self.size = size
+        self._owner = create
+        lib = get_lib()
+        self._lib = lib
+        if lib is not None:
+            fn = lib.MXTShmCreate if create else lib.MXTShmAttach
+            ptr = fn(self.name.encode(), size)
+            if not ptr:
+                raise OSError("shm %s failed for %s"
+                              % ("create" if create else "attach", name))
+            self._ptr = ptr
+            buf = (ctypes.c_uint8 * size).from_address(ptr)
+            self.array = np.frombuffer(buf, dtype=np.uint8)
+        else:  # pure-python fallback via /dev/shm files
+            path = "/dev/shm" + self.name
+            if create:
+                with open(path, "wb") as f:
+                    f.truncate(size)
+            self._file = open(path, "r+b")
+            self._mm = _mmap.mmap(self._file.fileno(), size)
+            self._ptr = None
+            self.array = np.frombuffer(memoryview(self._mm), dtype=np.uint8)
+
+    def close(self):
+        if self._lib is not None:
+            if getattr(self, "_ptr", None):
+                self._lib.MXTShmDetach(self._ptr, self.size)
+                self._ptr = None
+        else:
+            self.array = None
+            self._mm.close()
+            self._file.close()
+        if self._owner:
+            self.unlink()
+
+    def unlink(self):
+        if self._lib is not None:
+            self._lib.MXTShmUnlink(self.name.encode())
+        else:
+            try:
+                os.unlink("/dev/shm" + self.name)
+            except OSError:
+                pass
+        self._owner = False

@@ -1,0 +1,302 @@
+// Host-side dependency engine: versioned-Var async scheduler.
+//
+// Reference semantics: include/mxnet/engine.h:117 (Engine API),
+// src/engine/threaded_engine.h:71-574 (ThreadedVar read/write queues,
+// exception capture per var, WaitForVar/WaitForAll).
+//
+// TPU-native role: XLA handles device-side async; this engine schedules
+// HOST work — IO pipelines, checkpoint writes, record decoding — with the
+// same read/write-var dependency discipline, so Python-level pipelines
+// keep the reference's ordering guarantees (writes serialize per var,
+// reads run concurrently, errors surface at WaitForVar).
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace mxtpu {
+
+typedef int (*OprFn)(void*);  // user callback: 0 = ok, nonzero = error
+
+struct Opr;
+
+struct VarQueueEntry {
+  Opr* opr;
+  bool is_write;
+};
+
+struct Var {
+  std::mutex mu;
+  std::deque<VarQueueEntry> queue;  // FIFO of not-yet-granted accesses
+  int running_reads = 0;
+  bool writer_running = false;
+  bool has_error = false;
+  std::string error;
+};
+
+struct Opr {
+  OprFn fn;
+  void* ctx;
+  std::vector<Var*> const_vars;
+  std::vector<Var*> mutable_vars;
+  std::atomic<int> pending{0};
+  std::string name;
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_workers) : shutdown_(false) {
+    if (num_workers < 1) num_workers = 1;
+    for (int i = 0; i < num_workers; ++i) {
+      workers_.emplace_back([this] { WorkerLoop(); });
+    }
+  }
+
+  ~Engine() {
+    WaitForAll(nullptr);
+    {
+      std::lock_guard<std::mutex> lk(task_mu_);
+      shutdown_ = true;
+    }
+    task_cv_.notify_all();
+    for (auto& t : workers_) t.join();
+  }
+
+  Var* NewVar() { return new Var(); }
+
+  void DeleteVar(Var* v) { delete v; }  // caller ensures quiescence
+
+  void Push(OprFn fn, void* ctx, Var** cvars, int nc, Var** mvars, int nm,
+            const char* name) {
+    Opr* op = new Opr();
+    op->fn = fn;
+    op->ctx = ctx;
+    op->name = name ? name : "";
+    op->const_vars.assign(cvars, cvars + nc);
+    op->mutable_vars.assign(mvars, mvars + nm);
+    outstanding_.fetch_add(1);
+    // +1 sentinel so the op can't dispatch while we are still appending
+    op->pending.store(nc + nm + 1);
+    for (Var* v : op->const_vars) AppendRead(v, op);
+    for (Var* v : op->mutable_vars) AppendWrite(v, op);
+    DecPending(op);  // drop sentinel
+  }
+
+  // Block until every queued op before this call has finished.
+  int WaitForAll(std::string* err) {
+    std::unique_lock<std::mutex> lk(wait_mu_);
+    wait_cv_.wait(lk, [this] { return outstanding_.load() == 0; });
+    std::lock_guard<std::mutex> el(err_mu_);
+    if (!first_error_.empty()) {
+      if (err) *err = first_error_;
+      first_error_.clear();  // reported once, like MXNet's on-wait rethrow
+      return -1;
+    }
+    return 0;
+  }
+
+  // Block until all current writers/readers of var complete; rethrow the
+  // var's sticky error like WaitToRead (threaded_engine.h:495).
+  int WaitForVar(Var* var, std::string* err) {
+    struct WaitCtx {
+      std::mutex mu;
+      std::condition_variable cv;
+      bool done = false;
+    } wc;
+    auto fn = [](void* p) -> int {
+      WaitCtx* w = static_cast<WaitCtx*>(p);
+      std::lock_guard<std::mutex> lk(w->mu);
+      w->done = true;
+      w->cv.notify_all();
+      return 0;
+    };
+    Var* cv[1] = {var};
+    Push(fn, &wc, cv, 1, nullptr, 0, "__wait__");
+    std::unique_lock<std::mutex> lk(wc.mu);
+    wc.cv.wait(lk, [&wc] { return wc.done; });
+    std::lock_guard<std::mutex> vl(var->mu);
+    if (var->has_error) {
+      if (err) *err = var->error;
+      return -1;
+    }
+    return 0;
+  }
+
+ private:
+  void AppendRead(Var* v, Opr* op) {
+    std::lock_guard<std::mutex> lk(v->mu);
+    if (v->queue.empty() && !v->writer_running) {
+      ++v->running_reads;
+      DecPending(op);
+    } else {
+      v->queue.push_back({op, false});
+    }
+  }
+
+  void AppendWrite(Var* v, Opr* op) {
+    std::lock_guard<std::mutex> lk(v->mu);
+    if (v->queue.empty() && !v->writer_running && v->running_reads == 0) {
+      v->writer_running = true;
+      DecPending(op);
+    } else {
+      v->queue.push_back({op, true});
+    }
+  }
+
+  void DecPending(Opr* op) {
+    if (op->pending.fetch_sub(1) == 1) {
+      {
+        std::lock_guard<std::mutex> lk(task_mu_);
+        ready_.push(op);
+      }
+      task_cv_.notify_one();
+    }
+  }
+
+  void CompleteVarAccess(Var* v, bool was_write, bool op_failed,
+                         const std::string& msg,
+                         std::vector<Opr*>* newly_ready) {
+    std::lock_guard<std::mutex> lk(v->mu);
+    if (was_write) {
+      v->writer_running = false;
+      if (op_failed) {
+        v->has_error = true;
+        v->error = msg;
+      } else {
+        v->has_error = false;  // successful write clears the sticky error
+        v->error.clear();
+      }
+    } else {
+      --v->running_reads;
+    }
+    // grant from queue head, preserving FIFO: a run of reads, or one write
+    while (!v->queue.empty()) {
+      VarQueueEntry& e = v->queue.front();
+      if (e.is_write) {
+        if (v->running_reads == 0 && !v->writer_running) {
+          v->writer_running = true;
+          Opr* op = e.opr;
+          v->queue.pop_front();
+          if (op->pending.fetch_sub(1) == 1) newly_ready->push_back(op);
+        }
+        break;
+      }
+      if (v->writer_running) break;
+      ++v->running_reads;
+      Opr* op = e.opr;
+      v->queue.pop_front();
+      if (op->pending.fetch_sub(1) == 1) newly_ready->push_back(op);
+    }
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      Opr* op = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(task_mu_);
+        task_cv_.wait(lk, [this] { return shutdown_ || !ready_.empty(); });
+        if (shutdown_ && ready_.empty()) return;
+        op = ready_.front();
+        ready_.pop();
+      }
+      int rc = 0;
+      std::string msg;
+      rc = op->fn(op->ctx);
+      if (rc != 0) {
+        msg = "operation '" + op->name + "' failed with code " +
+              std::to_string(rc);
+        std::lock_guard<std::mutex> el(err_mu_);
+        if (first_error_.empty()) first_error_ = msg;
+      }
+      std::vector<Opr*> newly_ready;
+      for (Var* v : op->const_vars)
+        CompleteVarAccess(v, false, false, msg, &newly_ready);
+      for (Var* v : op->mutable_vars)
+        CompleteVarAccess(v, true, rc != 0, msg, &newly_ready);
+      delete op;
+      if (!newly_ready.empty()) {
+        {
+          std::lock_guard<std::mutex> lk(task_mu_);
+          for (Opr* r : newly_ready) ready_.push(r);
+        }
+        task_cv_.notify_all();
+      }
+      if (outstanding_.fetch_sub(1) == 1) {
+        std::lock_guard<std::mutex> lk(wait_mu_);
+        wait_cv_.notify_all();
+      }
+    }
+  }
+
+  std::vector<std::thread> workers_;
+  std::mutex task_mu_;
+  std::condition_variable task_cv_;
+  std::queue<Opr*> ready_;
+  bool shutdown_;
+
+  std::atomic<long> outstanding_{0};
+  std::mutex wait_mu_;
+  std::condition_variable wait_cv_;
+
+  std::mutex err_mu_;
+  std::string first_error_;
+};
+
+}  // namespace mxtpu
+
+// ---------------------------------------------------------------------------
+// flat C ABI (the include/mxnet/c_api.h MXEngine* analog)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* MXTEngineCreate(int num_workers) {
+  return new mxtpu::Engine(num_workers);
+}
+
+void MXTEngineFree(void* h) { delete static_cast<mxtpu::Engine*>(h); }
+
+void* MXTEngineNewVar(void* h) {
+  return static_cast<mxtpu::Engine*>(h)->NewVar();
+}
+
+void MXTEngineDeleteVar(void* h, void* v) {
+  static_cast<mxtpu::Engine*>(h)->DeleteVar(static_cast<mxtpu::Var*>(v));
+}
+
+int MXTEnginePushAsync(void* h, int (*fn)(void*), void* ctx,
+                       void** const_vars, int n_const, void** mutable_vars,
+                       int n_mutable, const char* name) {
+  static_cast<mxtpu::Engine*>(h)->Push(
+      fn, ctx, reinterpret_cast<mxtpu::Var**>(const_vars), n_const,
+      reinterpret_cast<mxtpu::Var**>(mutable_vars), n_mutable, name);
+  return 0;
+}
+
+int MXTEngineWaitForVar(void* h, void* v, char* err_buf, int buf_len) {
+  std::string err;
+  int rc = static_cast<mxtpu::Engine*>(h)->WaitForVar(
+      static_cast<mxtpu::Var*>(v), &err);
+  if (rc != 0 && err_buf && buf_len > 0) {
+    std::strncpy(err_buf, err.c_str(), buf_len - 1);
+    err_buf[buf_len - 1] = '\0';
+  }
+  return rc;
+}
+
+int MXTEngineWaitForAll(void* h, char* err_buf, int buf_len) {
+  std::string err;
+  int rc = static_cast<mxtpu::Engine*>(h)->WaitForAll(&err);
+  if (rc != 0 && err_buf && buf_len > 0) {
+    std::strncpy(err_buf, err.c_str(), buf_len - 1);
+    err_buf[buf_len - 1] = '\0';
+  }
+  return rc;
+}
+
+}  // extern "C"

@@ -1,0 +1,146 @@
+// RecordIO reader/writer — dmlc-core recordio format.
+//
+// Reference: dmlc-core recordio (used via src/io/, python recordio.py):
+//   [kMagic:u32][lrec:u32][data...][pad to 4B]
+// lrec = (cflag << 29) | length.  Payloads embedding the magic are split
+// into multi-part records (cflag 1=first, 2=middle, 3=last) with the
+// magic removed at split points and re-inserted on read — identical to
+// dmlc-core and to incubator_mxnet_tpu/recordio.py, so files are
+// byte-interchangeable between the native and pure-Python paths and with
+// reference-written .rec files.
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace mxtpu {
+
+static constexpr uint32_t kMagic = 0xced7230a;
+
+struct Writer {
+  FILE* f;
+};
+
+struct Reader {
+  FILE* f;
+  std::vector<char> buf;
+};
+
+}  // namespace mxtpu
+
+extern "C" {
+
+void* MXTRecordIOWriterCreate(const char* path) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  return new mxtpu::Writer{f};
+}
+
+long MXTRecordIOWriterTell(void* h) {
+  return ftell(static_cast<mxtpu::Writer*>(h)->f);
+}
+
+static int WritePart(FILE* f, const char* data, size_t len,
+                     uint32_t cflag) {
+  if (len >= (1u << 29)) return -2;
+  uint32_t magic = mxtpu::kMagic;
+  uint32_t lrec = (cflag << 29) | static_cast<uint32_t>(len);
+  if (fwrite(&magic, 4, 1, f) != 1) return -1;
+  if (fwrite(&lrec, 4, 1, f) != 1) return -1;
+  if (len && fwrite(data, 1, len, f) != len) return -1;
+  size_t pad = (4 - (len & 3)) & 3;
+  static const char zeros[4] = {0, 0, 0, 0};
+  if (pad && fwrite(zeros, 1, pad, f) != pad) return -1;
+  return 0;
+}
+
+int MXTRecordIOWriterWrite(void* h, const char* data, size_t len) {
+  FILE* f = static_cast<mxtpu::Writer*>(h)->f;
+  // split the payload at embedded magic words (dmlc recordio.cc)
+  uint32_t magic = mxtpu::kMagic;
+  std::vector<std::pair<size_t, size_t>> parts;  // (offset, len)
+  size_t start = 0;
+  for (size_t i = 0; len >= 4 && i + 4 <= len; ++i) {
+    uint32_t w;
+    std::memcpy(&w, data + i, 4);
+    if (w == magic) {
+      parts.emplace_back(start, i - start);
+      start = i + 4;
+      i += 3;
+    }
+  }
+  parts.emplace_back(start, len - start);
+  if (parts.size() == 1)
+    return WritePart(f, data + parts[0].first, parts[0].second, 0);
+  for (size_t k = 0; k < parts.size(); ++k) {
+    uint32_t cflag = (k == 0) ? 1 : (k + 1 == parts.size() ? 3 : 2);
+    int rc = WritePart(f, data + parts[k].first, parts[k].second, cflag);
+    if (rc != 0) return rc;
+  }
+  return 0;
+}
+
+void MXTRecordIOWriterFree(void* h) {
+  mxtpu::Writer* w = static_cast<mxtpu::Writer*>(h);
+  fclose(w->f);
+  delete w;
+}
+
+void* MXTRecordIOReaderCreate(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  return new mxtpu::Reader{f, {}};
+}
+
+int MXTRecordIOReaderSeek(void* h, long pos) {
+  return fseek(static_cast<mxtpu::Reader*>(h)->f, pos, SEEK_SET);
+}
+
+long MXTRecordIOReaderTell(void* h) {
+  return ftell(static_cast<mxtpu::Reader*>(h)->f);
+}
+
+// Returns 1 and sets (*out, *out_len) on success, 0 on clean EOF,
+// negative on corruption.  The buffer stays valid until the next read.
+int MXTRecordIOReaderRead(void* h, const char** out, size_t* out_len) {
+  mxtpu::Reader* r = static_cast<mxtpu::Reader*>(h);
+  r->buf.clear();
+  bool expect_more = false;
+  for (;;) {
+    uint32_t magic = 0;
+    size_t n = fread(&magic, 1, 4, r->f);
+    if (n == 0) return expect_more ? -2 : 0;  // EOF (truncated if mid-rec)
+    if (n != 4 || magic != mxtpu::kMagic) return -1;
+    uint32_t lrec = 0;
+    if (fread(&lrec, 1, 4, r->f) != 4) return -1;
+    uint32_t cflag = lrec >> 29;
+    size_t len = lrec & ((1u << 29) - 1);
+    size_t off = r->buf.size();
+    if (cflag == 2 || cflag == 3) {
+      // re-insert the magic removed at the split point
+      uint32_t m = mxtpu::kMagic;
+      r->buf.resize(off + 4);
+      std::memcpy(r->buf.data() + off, &m, 4);
+      off += 4;
+    }
+    r->buf.resize(off + len);
+    if (len && fread(r->buf.data() + off, 1, len, r->f) != len) return -1;
+    size_t pad = (4 - (len & 3)) & 3;
+    if (pad) fseek(r->f, static_cast<long>(pad), SEEK_CUR);
+    if (cflag == 0 || cflag == 3) {
+      *out = r->buf.data();
+      *out_len = r->buf.size();
+      return 1;
+    }
+    expect_more = true;
+  }
+}
+
+void MXTRecordIOReaderFree(void* h) {
+  mxtpu::Reader* rd = static_cast<mxtpu::Reader*>(h);
+  fclose(rd->f);
+  delete rd;
+}
+
+}  // extern "C"

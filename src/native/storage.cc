@@ -1,0 +1,125 @@
+// Host storage managers: pooled aligned allocator + POSIX shm.
+//
+// Reference: src/storage/pooled_storage_manager.h:52 (size-bucketed pool
+// with round-up), src/storage/cpu_shared_storage_manager.h (shm segments
+// for DataLoader worker IPC).  Device memory is XLA's; these cover the
+// HOST side: staging buffers for input pipelines and shared-memory
+// transport between data-loading processes.
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
+#include <mutex>
+#include <string>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <unordered_map>
+#include <vector>
+
+namespace mxtpu {
+
+static constexpr size_t kAlign = 64;
+
+static size_t RoundSize(size_t size) {
+  // round to the next power of two ≥ 4096 (pooled_storage_manager.h
+  // GPUPooledRoundedStorageManager semantics, host-adapted)
+  size_t r = 4096;
+  while (r < size) r <<= 1;
+  return r;
+}
+
+class PooledStorage {
+ public:
+  void* Alloc(size_t size) {
+    size_t bucket = RoundSize(size);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = pool_.find(bucket);
+      if (it != pool_.end() && !it->second.empty()) {
+        void* p = it->second.back();
+        it->second.pop_back();
+        pooled_bytes_ -= bucket;
+        return p;
+      }
+    }
+    void* p = nullptr;
+    if (posix_memalign(&p, kAlign, bucket) != 0) return nullptr;
+    return p;
+  }
+
+  void Free(void* ptr, size_t size) {
+    size_t bucket = RoundSize(size);
+    std::lock_guard<std::mutex> lk(mu_);
+    pool_[bucket].push_back(ptr);
+    pooled_bytes_ += bucket;
+  }
+
+  void EmptyCache() {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& kv : pool_)
+      for (void* p : kv.second) free(p);
+    pool_.clear();
+    pooled_bytes_ = 0;
+  }
+
+  size_t PooledBytes() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return pooled_bytes_;
+  }
+
+ private:
+  std::mutex mu_;
+  std::unordered_map<size_t, std::vector<void*>> pool_;
+  size_t pooled_bytes_ = 0;
+};
+
+static PooledStorage* GlobalPool() {
+  static PooledStorage pool;
+  return &pool;
+}
+
+}  // namespace mxtpu
+
+extern "C" {
+
+void* MXTStorageAlloc(size_t size) {
+  return mxtpu::GlobalPool()->Alloc(size);
+}
+
+void MXTStorageFree(void* ptr, size_t size) {
+  mxtpu::GlobalPool()->Free(ptr, size);
+}
+
+void MXTStorageEmptyCache() { mxtpu::GlobalPool()->EmptyCache(); }
+
+size_t MXTStoragePooledBytes() { return mxtpu::GlobalPool()->PooledBytes(); }
+
+// ---- POSIX shared memory (cpu_shared_storage_manager.h analog) ----------
+
+void* MXTShmCreate(const char* name, size_t size) {
+  int fd = shm_open(name, O_CREAT | O_RDWR | O_EXCL, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, static_cast<off_t>(size)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* p = mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  return p == MAP_FAILED ? nullptr : p;
+}
+
+void* MXTShmAttach(const char* name, size_t size) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  void* p = mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  return p == MAP_FAILED ? nullptr : p;
+}
+
+int MXTShmDetach(void* ptr, size_t size) { return munmap(ptr, size); }
+
+int MXTShmUnlink(const char* name) { return shm_unlink(name); }
+
+}  // extern "C"
