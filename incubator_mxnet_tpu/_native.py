@@ -42,6 +42,8 @@ def _declare(lib):
     lib.MXTEngineWaitForAll.restype = ctypes.c_int
     lib.MXTEngineWaitForAll.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                         ctypes.c_int]
+    lib.MXTEngineOutstanding.restype = ctypes.c_long
+    lib.MXTEngineOutstanding.argtypes = [ctypes.c_void_p]
 
     lib.MXTStorageAlloc.restype = ctypes.c_void_p
     lib.MXTStorageAlloc.argtypes = [ctypes.c_size_t]

@@ -1,0 +1,8 @@
+"""``mx.contrib.onnx`` (reference: python/mxnet/contrib/onnx/__init__.py).
+
+Self-contained: encodes/decodes the ONNX protobuf wire format directly
+(no onnx package needed in this environment)."""
+from .mx2onnx import export_model
+from .onnx2mx import import_model
+
+__all__ = ["export_model", "import_model"]

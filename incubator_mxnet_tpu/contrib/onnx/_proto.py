@@ -1,0 +1,321 @@
+"""Minimal protobuf wire codec for the ONNX subset we emit/consume.
+
+The environment has no onnx/protobuf package, so this encodes/decodes the
+protobuf wire format directly (varint + length-delimited fields).  Field
+numbers follow onnx.proto3 (ModelProto/GraphProto/NodeProto/
+AttributeProto/TensorProto/ValueInfoProto); files produced here load in
+stock onnx/onnxruntime and vice versa for the supported ops.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Tuple
+
+# ---------------------------------------------------------------------------
+# wire primitives
+# ---------------------------------------------------------------------------
+
+
+def _varint(value: int) -> bytes:
+    out = bytearray()
+    v = value & 0xFFFFFFFFFFFFFFFF
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def emit_varint(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(int(value))
+
+
+def emit_bytes(field: int, data: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(data)) + data
+
+
+def emit_str(field: int, s: str) -> bytes:
+    return emit_bytes(field, s.encode("utf-8"))
+
+
+def emit_float(field: int, v: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", v)
+
+
+def read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def parse_message(buf: bytes) -> Dict[int, List[Any]]:
+    """Parse one message into {field_number: [raw values]}; nested
+    messages stay as bytes for the caller to parse further."""
+    fields: Dict[int, List[Any]] = {}
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = read_varint(buf, pos)
+        elif wire == 2:
+            ln, pos = read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            val = struct.unpack("<f", buf[pos:pos + 4])[0]
+            pos += 4
+        elif wire == 1:
+            val = struct.unpack("<d", buf[pos:pos + 8])[0]
+            pos += 8
+        else:
+            raise ValueError("unsupported wire type %d" % wire)
+        fields.setdefault(field, []).append(val)
+    return fields
+
+
+# ---------------------------------------------------------------------------
+# ONNX message builders (field numbers from onnx.proto3)
+# ---------------------------------------------------------------------------
+
+# TensorProto.DataType
+FLOAT = 1
+INT64 = 7
+INT32 = 6
+
+# AttributeProto.AttributeType
+ATTR_FLOAT = 1
+ATTR_INT = 2
+ATTR_STRING = 3
+ATTR_TENSOR = 4
+ATTR_FLOATS = 6
+ATTR_INTS = 7
+
+
+def tensor_proto(name: str, arr) -> bytes:
+    import numpy as np
+    arr = np.asarray(arr)
+    out = b""
+    for d in arr.shape:
+        out += emit_varint(1, d)                      # dims
+    if arr.dtype == np.int64:
+        dtype = INT64
+    elif arr.dtype == np.int32:
+        dtype = INT32
+    else:
+        arr = arr.astype(np.float32)
+        dtype = FLOAT
+    out += emit_varint(2, dtype)                      # data_type
+    out += emit_str(8, name)                          # name
+    out += emit_bytes(9, arr.tobytes())               # raw_data
+    return out
+
+
+def attribute_proto(name: str, value) -> bytes:
+    import numpy as np
+    out = emit_str(1, name)
+    if isinstance(value, bool):
+        out += emit_varint(3, int(value)) + emit_varint(20, ATTR_INT)
+    elif isinstance(value, int):
+        out += emit_varint(3, value) + emit_varint(20, ATTR_INT)
+    elif isinstance(value, float):
+        out += emit_float(2, value) + emit_varint(20, ATTR_FLOAT)
+    elif isinstance(value, str):
+        out += emit_bytes(4, value.encode()) + emit_varint(20, ATTR_STRING)
+    elif isinstance(value, np.ndarray):
+        out += emit_bytes(5, tensor_proto(name + "_t", value))
+        out += emit_varint(20, ATTR_TENSOR)
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            for v in value:
+                out += emit_float(7, v)               # floats
+            out += emit_varint(20, ATTR_FLOATS)
+        else:
+            for v in value:
+                out += emit_varint(8, int(v))         # ints
+            out += emit_varint(20, ATTR_INTS)
+    else:
+        raise TypeError("unsupported attribute %r" % (value,))
+    return out
+
+
+def node_proto(op_type: str, inputs, outputs, name="", **attrs) -> bytes:
+    out = b""
+    for i in inputs:
+        out += emit_str(1, i)
+    for o in outputs:
+        out += emit_str(2, o)
+    if name:
+        out += emit_str(3, name)
+    out += emit_str(4, op_type)
+    for k, v in attrs.items():
+        if v is None:
+            continue
+        out += emit_bytes(5, attribute_proto(k, v))
+    return out
+
+
+def value_info(name: str, shape, elem_type=FLOAT) -> bytes:
+    dims = b""
+    for d in shape:
+        dims += emit_bytes(1, emit_varint(1, int(d)))     # dim.dim_value
+    shape_proto = dims
+    tensor_type = emit_varint(1, elem_type) + emit_bytes(2, shape_proto)
+    type_proto = emit_bytes(1, tensor_type)
+    return emit_str(1, name) + emit_bytes(2, type_proto)
+
+
+def graph_proto(nodes, name, initializers, inputs, outputs) -> bytes:
+    out = b""
+    for nd_ in nodes:
+        out += emit_bytes(1, nd_)
+    out += emit_str(2, name)
+    for t in initializers:
+        out += emit_bytes(5, t)
+    for i in inputs:
+        out += emit_bytes(11, i)
+    for o in outputs:
+        out += emit_bytes(12, o)
+    return out
+
+
+def model_proto(graph: bytes, opset=13, producer="incubator-mxnet-tpu") -> bytes:
+    opset_id = emit_str(1, "") + emit_varint(2, opset)
+    out = emit_varint(1, 8)                           # ir_version
+    out += emit_str(2, producer)
+    out += emit_bytes(7, graph)
+    out += emit_bytes(8, opset_id)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# decoders
+# ---------------------------------------------------------------------------
+
+
+def decode_tensor(buf: bytes):
+    import numpy as np
+    f = parse_message(buf)
+    dims = _packed_ints(f.get(1, []))
+    dtype = int(f.get(2, [FLOAT])[0])
+    name = f.get(8, [b""])[0].decode()
+    np_dtype = {FLOAT: np.float32, INT64: np.int64,
+                INT32: np.int32}.get(dtype, np.float32)
+    if 9 in f:
+        arr = np.frombuffer(f[9][0], dtype=np_dtype)
+    elif dtype == FLOAT and 4 in f:
+        arr = np.asarray(_packed_floats(f[4]), np.float32)
+    elif 7 in f:
+        arr = np.asarray(_packed_ints(f[7]), np.int64)
+    else:
+        arr = np.zeros(0, np_dtype)
+    return name, arr.reshape(dims) if dims else arr
+
+
+def _signed(v: int) -> int:
+    """protobuf int64: negative values ride as 64-bit two's complement."""
+    v = int(v)
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+
+def _packed_ints(values):
+    """Flatten repeated int64: unpacked varints and/or packed byte blobs
+    (proto3 packs repeated scalars by default — stock onnx emits packed)."""
+    out = []
+    for v in values:
+        if isinstance(v, (bytes, bytearray)):
+            pos = 0
+            while pos < len(v):
+                x, pos = read_varint(v, pos)
+                out.append(_signed(x))
+        else:
+            out.append(_signed(v))
+    return out
+
+
+def _packed_floats(values):
+    out = []
+    for v in values:
+        if isinstance(v, (bytes, bytearray)):
+            out.extend(struct.unpack("<%df" % (len(v) // 4), v))
+        else:
+            out.append(float(v))
+    return out
+
+
+def decode_attribute(buf: bytes):
+    f = parse_message(buf)
+    name = f[1][0].decode()
+    atype = int(f.get(20, [0])[0])
+    if atype == ATTR_FLOAT:
+        return name, float(f[2][0])
+    if atype == ATTR_INT:
+        return name, _signed(f[3][0])
+    if atype == ATTR_STRING:
+        return name, f[4][0].decode()
+    if atype == ATTR_TENSOR:
+        return name, decode_tensor(f[5][0])[1]
+    if atype == ATTR_FLOATS:
+        return name, _packed_floats(f.get(7, []))
+    if atype == ATTR_INTS:
+        return name, _packed_ints(f.get(8, []))
+    # fall back on populated field
+    if 3 in f:
+        return name, _signed(f[3][0])
+    if 2 in f:
+        return name, float(f[2][0])
+    return name, None
+
+
+def decode_node(buf: bytes):
+    f = parse_message(buf)
+    return {
+        "inputs": [v.decode() for v in f.get(1, [])],
+        "outputs": [v.decode() for v in f.get(2, [])],
+        "name": f.get(3, [b""])[0].decode(),
+        "op_type": f.get(4, [b""])[0].decode(),
+        "attrs": dict(decode_attribute(a) for a in f.get(5, [])),
+    }
+
+
+def decode_value_info(buf: bytes):
+    f = parse_message(buf)
+    name = f[1][0].decode()
+    shape = []
+    if 2 in f:
+        tp = parse_message(f[2][0])
+        if 1 in tp:
+            tt = parse_message(tp[1][0])
+            if 2 in tt:
+                sp = parse_message(tt[2][0])
+                for dim_buf in sp.get(1, []):
+                    dm = parse_message(dim_buf)
+                    shape.append(int(dm.get(1, [0])[0]))
+    return name, tuple(shape)
+
+
+def decode_model(buf: bytes):
+    f = parse_message(buf)
+    graph = parse_message(f[7][0])
+    return {
+        "nodes": [decode_node(n) for n in graph.get(1, [])],
+        "name": graph.get(2, [b""])[0].decode(),
+        "initializers": dict(decode_tensor(t) for t in graph.get(5, [])),
+        "inputs": [decode_value_info(v) for v in graph.get(11, [])],
+        "outputs": [decode_value_info(v) for v in graph.get(12, [])],
+    }

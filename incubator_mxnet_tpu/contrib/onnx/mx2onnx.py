@@ -1,0 +1,166 @@
+"""Symbol → ONNX export (reference:
+python/mxnet/contrib/onnx/mx2onnx/export_model.py, export_onnx.py,
+_op_translations.py)."""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+import numpy as np
+
+from . import _proto as P
+
+__all__ = ["export_model"]
+
+
+def _t(v, n=None, typ=int):
+    if isinstance(v, str):
+        v = ast.literal_eval(v)
+    if not isinstance(v, (tuple, list)):
+        v = (v,) * (n or 1)
+    return [typ(x) for x in v]
+
+
+def _conv_attrs(attrs):
+    kernel = _t(attrs.get("kernel", (1, 1)))
+    stride = _t(attrs.get("stride", (1,) * len(kernel)))
+    pad = _t(attrs.get("pad", (0,) * len(kernel)))
+    dilate = _t(attrs.get("dilate", (1,) * len(kernel)))
+    return dict(kernel_shape=kernel, strides=stride,
+                pads=pad + pad, dilations=dilate,
+                group=int(attrs.get("num_group", 1)))
+
+
+def export_model(sym, params, input_shapes, input_types=None,
+                 onnx_file_path="model.onnx", opset_version=13,
+                 verbose=False):
+    """Export (Symbol, params) to an .onnx file
+    (export_model.py:56).  Returns the path."""
+    from ...symbol.symbol import _toposort
+
+    params = {k: (v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v))
+              for k, v in params.items()}
+    nodes_out: List[bytes] = []
+    initializers: List[bytes] = []
+    graph_inputs: List[bytes] = []
+    name_of: Dict[int, str] = {}      # (node entry) -> onnx tensor name
+    input_shapes = list(input_shapes)
+    in_idx = [0]
+
+    def entry_name(entry):
+        node, i = entry
+        if node.is_var:
+            return node.name
+        return node.name if i == 0 else "%s_out%d" % (node.name, i)
+
+    old_nodes = _toposort([n for n, _ in sym._outputs])
+    for node in old_nodes:
+        if node.is_var:
+            if node.name == "__null__":
+                continue
+            if node.name in params:
+                initializers.append(
+                    P.tensor_proto(node.name, params[node.name]))
+            else:
+                shape = input_shapes[min(in_idx[0],
+                                         len(input_shapes) - 1)]
+                in_idx[0] += 1
+                graph_inputs.append(P.value_info(node.name, shape))
+            continue
+        ins = [entry_name(e) for e in node.inputs
+               if not (e[0].is_var and e[0].name == "__null__")]
+        out = entry_name((node, 0))
+        op = node.op
+        a = node.attrs
+
+        if op == "FullyConnected":
+            flat_in = ins[0]
+            if not a.get("flatten") in (False, "False", "false", 0):
+                nodes_out.append(P.node_proto(
+                    "Flatten", [ins[0]], [out + "_flat"],
+                    name=node.name + "_flatten", axis=1))
+                flat_in = out + "_flat"
+            gemm_in = [flat_in, ins[1]] + (ins[2:3] if len(ins) > 2 else [])
+            nodes_out.append(P.node_proto(
+                "Gemm", gemm_in, [out], name=node.name, alpha=1.0,
+                beta=1.0, transA=0, transB=1))
+        elif op == "Convolution":
+            nodes_out.append(P.node_proto(
+                "Conv", ins, [out], name=node.name, **_conv_attrs(a)))
+        elif op == "Activation":
+            act = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+                   "softsign": "Softsign"}[a.get("act_type", "relu")]
+            nodes_out.append(P.node_proto(act, ins, [out], name=node.name))
+        elif op in ("softmax", "log_softmax"):
+            onnx_op = "Softmax" if op == "softmax" else "LogSoftmax"
+            nodes_out.append(P.node_proto(
+                onnx_op, ins, [out], name=node.name,
+                axis=int(a.get("axis", -1))))
+        elif op in ("BatchNorm", "batch_norm"):
+            nodes_out.append(P.node_proto(
+                "BatchNormalization", ins, [out], name=node.name,
+                epsilon=float(a.get("eps", 1e-5)),
+                momentum=float(a.get("momentum", 0.9))))
+        elif op == "Pooling":
+            ptype = a.get("pool_type", "max")
+            glob = a.get("global_pool") in (True, "True", "true", 1)
+            if glob:
+                onnx_op = "GlobalMaxPool" if ptype == "max" \
+                    else "GlobalAveragePool"
+                nodes_out.append(P.node_proto(onnx_op, ins, [out],
+                                              name=node.name))
+            else:
+                onnx_op = "MaxPool" if ptype == "max" else "AveragePool"
+                kernel = _t(a.get("kernel", (1, 1)))
+                stride = _t(a.get("stride", (1,) * len(kernel)))
+                pad = _t(a.get("pad", (0,) * len(kernel)))
+                nodes_out.append(P.node_proto(
+                    onnx_op, ins, [out], name=node.name,
+                    kernel_shape=kernel, strides=stride, pads=pad + pad))
+        elif op in ("elemwise_add", "broadcast_add", "_plus"):
+            nodes_out.append(P.node_proto("Add", ins, [out],
+                                          name=node.name))
+        elif op in ("elemwise_sub", "broadcast_sub"):
+            nodes_out.append(P.node_proto("Sub", ins, [out],
+                                          name=node.name))
+        elif op in ("elemwise_mul", "broadcast_mul"):
+            nodes_out.append(P.node_proto("Mul", ins, [out],
+                                          name=node.name))
+        elif op in ("elemwise_div", "broadcast_div"):
+            nodes_out.append(P.node_proto("Div", ins, [out],
+                                          name=node.name))
+        elif op in ("Concat", "concat"):
+            nodes_out.append(P.node_proto(
+                "Concat", ins, [out], name=node.name,
+                axis=int(a.get("dim", 1))))
+        elif op == "Flatten":
+            nodes_out.append(P.node_proto("Flatten", ins, [out],
+                                          name=node.name, axis=1))
+        elif op in ("Reshape", "reshape"):
+            shape = np.asarray(_t(a.get("shape", (-1,))), np.int64)
+            sname = node.name + "_shape"
+            initializers.append(P.tensor_proto(sname, shape))
+            nodes_out.append(P.node_proto("Reshape", ins + [sname], [out],
+                                          name=node.name))
+        elif op == "transpose":
+            nodes_out.append(P.node_proto(
+                "Transpose", ins, [out], name=node.name,
+                perm=_t(a.get("axes", ()))))
+        elif op == "Dropout":
+            # inference export: identity (reference exports Dropout with
+            # ratio; runtimes ignore it at inference — Identity is exact)
+            nodes_out.append(P.node_proto("Identity", ins, [out],
+                                          name=node.name))
+        else:
+            raise NotImplementedError(
+                "ONNX export for op %r not implemented" % op)
+
+    graph_outputs = []
+    for n, i in sym._outputs:
+        graph_outputs.append(P.value_info(entry_name((n, i)), ()))
+    graph = P.graph_proto(nodes_out, "mxtpu_graph", initializers,
+                          graph_inputs, graph_outputs)
+    model = P.model_proto(graph, opset=opset_version)
+    with open(onnx_file_path, "wb") as f:
+        f.write(model)
+    return onnx_file_path

@@ -1,0 +1,154 @@
+"""ONNX → Symbol import (reference:
+python/mxnet/contrib/onnx/onnx2mx/import_model.py, import_onnx.py,
+_op_translations.py)."""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from . import _proto as P
+
+__all__ = ["import_model"]
+
+
+def import_model(model_file):
+    """Import an .onnx file → (sym, arg_params, aux_params)
+    (import_model.py:34)."""
+    from ... import symbol as sym_mod
+    from ...ndarray import ndarray as nd
+
+    with open(model_file, "rb") as f:
+        model = P.decode_model(f.read())
+
+    tensors: Dict[str, object] = {}
+    arg_params = {}
+    aux_params = {}
+    for name, arr in model["initializers"].items():
+        arg_params[name] = nd.array(np.ascontiguousarray(arr))
+        tensors[name] = sym_mod.var(name)
+    for name, shape in model["inputs"]:
+        if name not in tensors:
+            tensors[name] = sym_mod.var(name)
+
+    def get(name):
+        return tensors[name]
+
+    for node in model["nodes"]:
+        op = node["op_type"]
+        a = node["attrs"]
+        ins = node["inputs"]
+        out = node["outputs"][0]
+        name = node["name"] or out
+
+        if op == "Gemm":
+            assert a.get("transB", 0) == 1 and a.get("transA", 0) == 0, \
+                "only Gemm(transB=1) imports to FullyConnected"
+            alpha = float(a.get("alpha", 1.0))
+            beta = float(a.get("beta", 1.0))
+            w = model["initializers"].get(ins[1])
+            num_hidden = int(w.shape[0]) if w is not None else 0
+            kwargs = dict(num_hidden=num_hidden, name=name)
+            use_bias = len(ins) > 2 and beta != 0.0
+            if use_bias and beta != 1.0:
+                raise NotImplementedError(
+                    "Gemm beta=%g with bias has no FullyConnected "
+                    "equivalent" % beta)
+            if use_bias and alpha == 1.0:
+                res = sym_mod.FullyConnected(
+                    get(ins[0]), weight=get(ins[1]), bias=get(ins[2]),
+                    **kwargs)
+            else:
+                res = sym_mod.FullyConnected(
+                    get(ins[0]), weight=get(ins[1]), no_bias=True, **kwargs)
+                if alpha != 1.0:
+                    res = res * alpha
+                if use_bias:
+                    res = sym_mod.broadcast_add(res, get(ins[2]))
+        elif op == "Conv":
+            w = model["initializers"].get(ins[1])
+            pads = a.get("pads", [0, 0, 0, 0])
+            kwargs = dict(
+                kernel=tuple(a.get("kernel_shape", (1, 1))),
+                stride=tuple(a.get("strides", (1, 1))),
+                pad=tuple(pads[:len(pads) // 2]),
+                dilate=tuple(a.get("dilations", (1, 1))),
+                num_group=int(a.get("group", 1)),
+                num_filter=int(w.shape[0]) if w is not None else 0,
+                name=name)
+            if len(ins) > 2:
+                res = sym_mod.Convolution(get(ins[0]), weight=get(ins[1]),
+                                          bias=get(ins[2]), **kwargs)
+            else:
+                res = sym_mod.Convolution(get(ins[0]), weight=get(ins[1]),
+                                          no_bias=True, **kwargs)
+        elif op in ("Relu", "Sigmoid", "Tanh", "Softsign"):
+            res = sym_mod.Activation(get(ins[0]), act_type=op.lower(),
+                                     name=name)
+        elif op == "Softmax":
+            res = sym_mod.softmax(get(ins[0]), axis=int(a.get("axis", -1)),
+                                  name=name)
+        elif op == "LogSoftmax":
+            res = sym_mod.log_softmax(get(ins[0]),
+                                      axis=int(a.get("axis", -1)),
+                                      name=name)
+        elif op == "BatchNormalization":
+            res = sym_mod.BatchNorm(
+                get(ins[0]), gamma=get(ins[1]), beta=get(ins[2]),
+                moving_mean=get(ins[3]), moving_var=get(ins[4]),
+                eps=float(a.get("epsilon", 1e-5)),
+                momentum=float(a.get("momentum", 0.9)),
+                use_global_stats=True, name=name)
+            # running stats are aux, not args
+            for aux_name in (ins[3], ins[4]):
+                if aux_name in arg_params:
+                    aux_params[aux_name] = arg_params.pop(aux_name)
+        elif op in ("MaxPool", "AveragePool"):
+            pads = a.get("pads", [0, 0, 0, 0])
+            res = sym_mod.Pooling(
+                get(ins[0]),
+                pool_type="max" if op == "MaxPool" else "avg",
+                kernel=tuple(a.get("kernel_shape", (1, 1))),
+                stride=tuple(a.get("strides", (1, 1))),
+                pad=tuple(pads[:len(pads) // 2]), name=name)
+        elif op in ("GlobalMaxPool", "GlobalAveragePool"):
+            res = sym_mod.Pooling(
+                get(ins[0]),
+                pool_type="max" if "Max" in op else "avg",
+                kernel=(1, 1), global_pool=True, name=name)
+        elif op in ("Add", "Sub", "Mul", "Div"):
+            fn = {"Add": sym_mod.broadcast_add,
+                  "Sub": sym_mod.broadcast_sub,
+                  "Mul": sym_mod.broadcast_mul,
+                  "Div": sym_mod.broadcast_div}[op]
+            res = fn(get(ins[0]), get(ins[1]), name=name)
+        elif op == "Concat":
+            res = sym_mod.concat(*[get(i) for i in ins],
+                                 dim=int(a.get("axis", 1)), name=name)
+        elif op == "Flatten":
+            res = sym_mod.Flatten(get(ins[0]), name=name)
+        elif op == "Reshape":
+            shape = model["initializers"].get(ins[1])
+            assert shape is not None, "dynamic Reshape shape unsupported"
+            arg_params.pop(ins[1], None)
+            res = sym_mod.reshape(get(ins[0]),
+                                  shape=tuple(int(s) for s in shape),
+                                  name=name)
+        elif op == "Transpose":
+            res = sym_mod.transpose(get(ins[0]),
+                                    axes=tuple(a.get("perm", ())),
+                                    name=name)
+        elif op in ("Identity", "Dropout"):
+            res = sym_mod.identity(get(ins[0]), name=name)
+        else:
+            raise NotImplementedError(
+                "ONNX import for op %r not implemented" % op)
+        tensors[out] = res
+
+    outs = [tensors[name] for name, _ in model["outputs"]]
+    sym = outs[0] if len(outs) == 1 else sym_mod.Group(outs)
+    # drop params consumed as attrs
+    used = set(sym.list_arguments()) | set(sym.list_auxiliary_states())
+    arg_params = {k: v for k, v in arg_params.items() if k in used}
+    aux_params = {k: v for k, v in aux_params.items() if k in used}
+    return sym, arg_params, aux_params

@@ -1,0 +1,200 @@
+"""INT8 model quantization (reference:
+python/mxnet/contrib/quantization.py — quantize_model :430,
+_calibrate_quantized_sym, calibration src/operator/quantization/
+calibrate.cc minmax/entropy(KL)).
+
+Flow: rewrite FullyConnected/Convolution nodes into
+quantize_v2 → quantized_op (int8 MXU matmul/conv, int32 accumulate) →
+dequantize; calibrate per-tensor ranges over a calibration set either by
+min/max ('naive') or KL-divergence-optimal thresholds ('entropy')."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["quantize_model", "quantize_graph", "_get_optimal_threshold"]
+
+_QUANTIZABLE = {"FullyConnected": "_contrib_quantized_fully_connected",
+                "Convolution": "_contrib_quantized_conv"}
+
+
+def _get_optimal_threshold(arr, num_bins=1001, num_quantized_bins=255):
+    """KL-divergence-optimal |threshold| (calibrate.cc entropy mode).
+
+    Builds a histogram of |x| and picks the clip threshold whose clipped+
+    re-quantized distribution minimizes KL(P||Q) against the original."""
+    arr = np.abs(np.asarray(arr, np.float64).ravel())
+    amax = arr.max() if arr.size else 0.0
+    if amax == 0.0:
+        return 0.0
+    hist, edges = np.histogram(arr, bins=num_bins, range=(0, amax))
+    total = hist.sum()
+    if total == 0:
+        return float(amax)
+
+    best_kl = np.inf
+    best_thr = amax
+    # candidates start at num_quantized_bins: below that, re-quantizing
+    # into 255 levels is lossless and KL≈0 regardless of clipping error,
+    # which would always select a (wrong) tiny threshold
+    for i in range(num_quantized_bins, num_bins + 1,
+                   max(1, num_bins // 64)):
+        p = hist[:i].astype(np.float64).copy()
+        p[i - 1] += hist[i:].sum()        # clip outliers into last bin
+        thr = edges[i]
+        # quantize p into num_quantized_bins then expand back
+        chunks = np.array_split(p, num_quantized_bins)
+        q = np.concatenate([
+            np.full(len(c), c.sum() / max((c > 0).sum(), 1))
+            * (c > 0) for c in chunks])
+        p_n = p / p.sum()
+        q_n = q / q.sum() if q.sum() > 0 else q
+        mask = (p_n > 0) & (q_n > 0)
+        if not mask.any():
+            continue
+        kl = float(np.sum(p_n[mask] * np.log(p_n[mask] / q_n[mask])))
+        if kl < best_kl:
+            best_kl = kl
+            best_thr = thr
+    return float(best_thr)
+
+
+def _collect_layer_stats(sym, arg_params, aux_params, calib_data,
+                         data_names, ctx, max_batches, mode):
+    """Run calibration batches, recording per-node-output ranges via the
+    executor monitor re-walk (the MXNet CalibrationCollector analog)."""
+    from .. import current_context
+    from ..ndarray import ndarray as nd
+
+    collected: Dict[str, List[np.ndarray]] = {}
+
+    def callback(name, array):
+        collected.setdefault(name, []).append(array.asnumpy())
+
+    exe = None
+    n = 0
+    for batch in calib_data:
+        data = batch.data[0] if hasattr(batch, "data") else batch[0]
+        if exe is None:
+            feed = {data_names[0]: data}
+            feed.update(arg_params)
+            exe = sym.bind(ctx or current_context(), feed,
+                           aux_states=dict(aux_params))
+            exe.set_monitor_callback(callback)
+        else:
+            exe.arg_dict[data_names[0]][:] = data
+        exe.forward(is_train=False)
+        n += 1
+        if max_batches is not None and n >= max_batches:
+            break
+    if hasattr(calib_data, "reset"):
+        calib_data.reset()
+
+    ranges = {}
+    for name, chunks in collected.items():
+        flat = np.concatenate([c.ravel() for c in chunks])
+        if mode == "entropy":
+            thr = _get_optimal_threshold(flat)
+            ranges[name] = (-thr, thr)
+        else:
+            ranges[name] = (float(flat.min()), float(flat.max()))
+    return ranges
+
+
+def quantize_graph(sym, excluded_sym_names=(), calib_ranges=None,
+                   weight_ranges=None):
+    """Symbol rewrite: FC/Conv → quantize_v2 + quantized op + dequantize
+    (quantize_graph_pass.cc)."""
+    from ..symbol.symbol import Symbol, _Node, _toposort
+
+    calib_ranges = calib_ranges or {}
+    excluded = set(excluded_sym_names)
+    old_nodes = _toposort([n for n, _ in sym._outputs])
+    mapping = {}
+    uid = [0]
+
+    def new_node(op, hint, attrs, entries, num_outputs=1):
+        uid[0] += 1
+        return _Node(op, "%s_q%d" % (hint, uid[0]), attrs, entries,
+                     num_outputs=num_outputs)
+
+    for node in old_nodes:
+        if node.is_var:
+            mapping[id(node)] = node
+            continue
+        new_inputs = [(mapping[id(p)], i) for p, i in node.inputs]
+        if node.op in _QUANTIZABLE and node.name not in excluded \
+                and len(new_inputs) >= 2:
+            qop = _QUANTIZABLE[node.op]
+            # quantize data input (calibrated range if known)
+            data_entry = new_inputs[0]
+            dkey = "%s_output" % data_entry[0].name
+            dattrs = {}
+            if dkey in calib_ranges:
+                dattrs = {"min_calib_range": calib_ranges[dkey][0],
+                          "max_calib_range": calib_ranges[dkey][1]}
+            elif data_entry[0].is_var and data_entry[0].name in calib_ranges:
+                lo, hi = calib_ranges[data_entry[0].name]
+                dattrs = {"min_calib_range": lo, "max_calib_range": hi}
+            qdata = new_node("_contrib_quantize_v2", "qdata", dattrs,
+                             [data_entry], num_outputs=3)
+            wattrs = {}
+            wname = new_inputs[1][0].name
+            if weight_ranges and wname in weight_ranges:
+                lo, hi = weight_ranges[wname]
+                wattrs = {"min_calib_range": lo, "max_calib_range": hi}
+            qweight = new_node("_contrib_quantize_v2", "qweight", wattrs,
+                               [new_inputs[1]], num_outputs=3)
+            has_bias = len(new_inputs) >= 3 and not (
+                new_inputs[2][0].is_var
+                and new_inputs[2][0].name == "__null__")
+            if has_bias:
+                qbias = new_node("_contrib_quantize_v2", "qbias", {},
+                                 [new_inputs[2]], num_outputs=3)
+                bias_entries = [(qbias, 0)]
+                bias_ranges = [(qbias, 1), (qbias, 2)]
+            else:
+                from ..symbol import _NULL_NODE
+                bias_entries = [(_NULL_NODE, 0)]
+                bias_ranges = [(_NULL_NODE, 0), (_NULL_NODE, 0)]
+            q_attrs = dict(node.attrs)
+            q_entries = ([(qdata, 0), (qweight, 0)] + bias_entries +
+                         [(qdata, 1), (qdata, 2), (qweight, 1),
+                          (qweight, 2)] + bias_ranges)
+            qnode = new_node(qop, node.name + "_quantized", q_attrs,
+                             q_entries, num_outputs=3)
+            deq = _Node("_contrib_dequantize", node.name,
+                        {}, [(qnode, 0), (qnode, 1), (qnode, 2)])
+            mapping[id(node)] = deq
+        else:
+            nn_ = _Node(node.op, node.name, dict(node.attrs), new_inputs,
+                        num_outputs=node.num_outputs)
+            mapping[id(node)] = nn_
+
+    return Symbol([(mapping[id(n)], i) for n, i in sym._outputs])
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   excluded_sym_names=(), ctx=None, calib_mode="none",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", logger=None):
+    """Quantize a model (contrib/quantization.py:430).
+
+    calib_mode: 'none' (dynamic ranges), 'naive' (min/max over calib
+    data), or 'entropy' (KL-optimal thresholds)."""
+    assert quantized_dtype in ("int8", "auto"), \
+        "TPU int8 path is symmetric signed"
+    calib_ranges = {}
+    if calib_mode in ("naive", "entropy"):
+        assert calib_data is not None, \
+            "calib_mode %r requires calib_data" % calib_mode
+        batches = None
+        if num_calib_examples is not None:
+            bs = getattr(calib_data, "batch_size", 1) or 1
+            batches = max(1, num_calib_examples // bs)
+        calib_ranges = _collect_layer_stats(
+            sym, arg_params, aux_params, calib_data, list(data_names), ctx,
+            batches, calib_mode)
+    qsym = quantize_graph(sym, excluded_sym_names, calib_ranges)
+    return qsym, dict(arg_params), dict(aux_params)

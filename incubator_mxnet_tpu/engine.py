@@ -61,9 +61,25 @@ class NativeEngine:
     def push(self, fn, const_vars=(), mutable_vars=(), name="pyop"):
         """Schedule ``fn()`` once all its var dependencies resolve
         (Engine::PushAsync, engine.h:204)."""
+        const_vars = list(const_vars)
+        mutable_vars = list(mutable_vars)
+        # overlapping/duplicate vars would self-deadlock the dependency
+        # queues; the reference CHECK-fails the same way (engine.h:291
+        # DeduplicateVarHandle contract)
+        if len(set(mutable_vars)) != len(mutable_vars):
+            raise ValueError("duplicate handles in mutable_vars")
+        if set(const_vars) & set(mutable_vars):
+            raise ValueError(
+                "const_vars and mutable_vars must be disjoint")
+        const_vars = list(dict.fromkeys(const_vars))  # dedupe reads
         with self._live_lock:
             self._counter += 1
             token = self._counter
+        # opportunistic safe prune: when the C++ engine reports zero
+        # outstanding ops, every past trampoline has fully returned
+        if len(self._done) > 256 and \
+                self._lib.MXTEngineOutstanding(self._handle) == 0:
+            self._prune()
 
         def trampoline(_ctx, _token=token):
             try:

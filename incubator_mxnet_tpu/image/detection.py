@@ -89,15 +89,12 @@ class ImageDetIter(ImageIter):
                          data_name=data_name, label_name=label_name,
                          **kwargs)
         if self.imglist is not None:
-            # imglist labels arrive flat [cls, x1, y1, x2, y2]*N —
-            # synthesize the packed [2, 5] header so _parse_label has one
-            # uniform format (reference builds it in _parse_label too)
+            # imglist labels are documented flat [cls, x1, y1, x2, y2]*N;
+            # ALWAYS synthesize the packed [2, 5] header (guessing whether
+            # a label is pre-packed misclassifies flat labels whose first
+            # values look like a header)
             for key, (lab, fname) in list(self.imglist.items()):
                 flat = np.asarray(lab, np.float32).reshape(-1)
-                if flat.size >= 2 and int(flat[0]) >= 2 and \
-                        int(flat[1]) >= 5 and \
-                        (flat.size - int(flat[0])) % int(flat[1]) == 0:
-                    continue  # already packed
                 assert flat.size % 5 == 0, \
                     "imglist detection label must be [cls,x1,y1,x2,y2]*N"
                 self.imglist[key] = (

@@ -1,0 +1,137 @@
+"""INT8 quantization ops.
+
+Reference: ``src/operator/quantization/`` — quantize.cc, quantize_v2.cc,
+dequantize.cc, requantize.cc, quantized_fully_connected.cc,
+quantized_conv.cc.  TPU-native: int8 matmul/conv run on the MXU via
+``lax.dot_general``/``lax.conv`` with ``preferred_element_type=int32``
+accumulation, exactly the int8 path XLA compiles natively.
+
+Quantization convention (matches the reference's signed path): symmetric
+int8 with scale = 127 / max(|min|, |max|); zero-point free, so the MXU
+kernel needs no zero-point correction terms.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = []
+
+
+def _range_scale(min_r, max_r):
+    amax = jnp.maximum(jnp.abs(min_r), jnp.abs(max_r))
+    return jnp.where(amax > 0, 127.0 / amax, 1.0)
+
+
+@register("_contrib_quantize", num_inputs=3, num_outputs=3,
+          differentiable=False)
+def _quantize(data, min_range, max_range, out_type="int8"):
+    """float → int8 with explicit range (quantize.cc)."""
+    scale = _range_scale(min_range, max_range)
+    q = jnp.clip(jnp.rint(data * scale), -127, 127).astype(jnp.int8)
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    return q, -amax, amax
+
+
+@register("_contrib_quantize_v2", num_inputs=1, num_outputs=3,
+          differentiable=False)
+def _quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                 out_type="int8"):
+    """float → int8; range from calibration attrs or the data itself
+    (quantize_v2.cc)."""
+    if min_calib_range is not None and max_calib_range is not None:
+        min_r = jnp.float32(min_calib_range)
+        max_r = jnp.float32(max_calib_range)
+    else:
+        min_r = jnp.min(data).astype(jnp.float32)
+        max_r = jnp.max(data).astype(jnp.float32)
+    amax = jnp.maximum(jnp.abs(min_r), jnp.abs(max_r))
+    scale = jnp.where(amax > 0, 127.0 / amax, 1.0)
+    q = jnp.clip(jnp.rint(data * scale), -127, 127).astype(jnp.int8)
+    return q, -amax, amax
+
+
+@register("_contrib_dequantize", num_inputs=3, differentiable=False)
+def _dequantize(data, min_range, max_range, out_type="float32"):
+    """int8 → float (dequantize.cc)."""
+    scale = _range_scale(min_range, max_range)
+    return data.astype(jnp.float32) / scale
+
+
+@register("_contrib_requantize", num_inputs=3, num_outputs=3,
+          differentiable=False)
+def _requantize(data, min_range, max_range, min_calib_range=None,
+                max_calib_range=None):
+    """int32 (accumulator) → int8 with a narrower calibrated range
+    (requantize.cc)."""
+    # same convention as dequantize: real = x * amax / 127 (dtype-free)
+    in_scale = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)) / 127.0
+    real = data.astype(jnp.float32) * in_scale
+    if min_calib_range is not None and max_calib_range is not None:
+        amax = jnp.maximum(abs(float(min_calib_range)),
+                           abs(float(max_calib_range)))
+        amax = jnp.float32(amax)
+    else:
+        amax = jnp.max(jnp.abs(real))
+    scale = jnp.where(amax > 0, 127.0 / amax, 1.0)
+    q = jnp.clip(jnp.rint(real * scale), -127, 127).astype(jnp.int8)
+    return q, -amax, amax
+
+
+@register("_contrib_quantized_fully_connected", num_inputs=9, num_outputs=3,
+          differentiable=False)
+def _quantized_fully_connected(data, weight, bias, min_data, max_data,
+                               min_weight, max_weight, min_bias=None,
+                               max_bias=None, num_hidden=0, no_bias=False,
+                               flatten=True, **ignored):
+    """int8×int8→int32 dense layer (quantized_fully_connected.cc).
+    Output is the int32 accumulator + its float range."""
+    x = data
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    acc = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    d_scale = _range_scale(min_data, max_data)
+    w_scale = _range_scale(min_weight, max_weight)
+    out_scale = d_scale * w_scale                     # int32 per 1.0 float
+    if bias is not None and not no_bias:
+        b_scale = _range_scale(min_bias, max_bias)
+        # rescale int8 bias into the accumulator's scale
+        b = jnp.rint(bias.astype(jnp.float32) / b_scale * out_scale)
+        acc = acc + b.astype(jnp.int32)
+    # declared so dequantize's x*amax/127 recovers floats: amax=127/scale
+    amax = 127.0 / out_scale
+    return acc, -amax, amax
+
+
+@register("_contrib_quantized_conv", num_inputs=9, num_outputs=3,
+          differentiable=False)
+def _quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                    max_weight, min_bias=None, max_bias=None, kernel=None,
+                    stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                    num_filter=0, num_group=1, no_bias=False,
+                    layout="NCHW", **ignored):
+    """int8 convolution with int32 accumulation (quantized_conv.cc)."""
+    stride = tuple(int(s) for s in stride)
+    pad = tuple(int(p) for p in pad)
+    dilate = tuple(int(d) for d in dilate)
+    acc = lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=stride,
+        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        rhs_dilation=dilate,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=int(num_group),
+        preferred_element_type=jnp.int32)
+    d_scale = _range_scale(min_data, max_data)
+    w_scale = _range_scale(min_weight, max_weight)
+    out_scale = d_scale * w_scale
+    if bias is not None and not no_bias:
+        b_scale = _range_scale(min_bias, max_bias)
+        b = jnp.rint(bias.astype(jnp.float32) / b_scale * out_scale)
+        acc = acc + b.astype(jnp.int32).reshape(1, -1, 1, 1)
+    amax = 127.0 / out_scale
+    return acc, -amax, amax

@@ -629,7 +629,8 @@ def _input_arg_names(op: _reg.Op):
                       inspect.Parameter.POSITIONAL_OR_KEYWORD):
             if p.default is inspect.Parameter.empty or p.name in PARAM_INPUT_NAMES \
                     or p.name in ("sequence_length", "label_lengths",
-                                  "data_lengths", "r1_r2"):
+                                  "data_lengths", "r1_r2", "min_bias",
+                                  "max_bias"):
                 names.append(p.name)
     return names
 

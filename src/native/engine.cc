@@ -87,6 +87,8 @@ class Engine {
     DecPending(op);  // drop sentinel
   }
 
+  long Outstanding() const { return outstanding_.load(); }
+
   // Block until every queued op before this call has finished.
   int WaitForAll(std::string* err) {
     std::unique_lock<std::mutex> lk(wait_mu_);
@@ -287,6 +289,10 @@ int MXTEngineWaitForVar(void* h, void* v, char* err_buf, int buf_len) {
     err_buf[buf_len - 1] = '\0';
   }
   return rc;
+}
+
+long MXTEngineOutstanding(void* h) {
+  return static_cast<mxtpu::Engine*>(h)->Outstanding();
 }
 
 int MXTEngineWaitForAll(void* h, char* err_buf, int buf_len) {

@@ -89,6 +89,17 @@ def test_engine_dependency_chain():
     eng.close()
 
 
+def test_engine_rejects_overlapping_vars():
+    """const/mutable overlap would self-deadlock; must raise instead."""
+    eng = NativeEngine(num_workers=2)
+    v = eng.new_var()
+    with pytest.raises(ValueError):
+        eng.push(lambda: None, const_vars=[v], mutable_vars=[v])
+    with pytest.raises(ValueError):
+        eng.push(lambda: None, mutable_vars=[v, v])
+    eng.close()
+
+
 def test_engine_exception_at_wait():
     """Errors in async ops surface at wait_for_var, like WaitToRead
     (threaded_engine.h:495 exception capture)."""

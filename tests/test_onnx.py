@@ -1,0 +1,105 @@
+"""ONNX export/import roundtrip tests (model:
+tests/python-pytest/onnx/ in the reference)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.contrib import onnx as onnx_mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    out = mx.sym.softmax(fc2, name="prob")
+    rng = np.random.RandomState(0)
+    params = {"fc1_weight": nd.array(rng.uniform(-1, 1, (16, 8))),
+              "fc1_bias": nd.array(rng.uniform(-1, 1, (16,))),
+              "fc2_weight": nd.array(rng.uniform(-1, 1, (4, 16))),
+              "fc2_bias": nd.array(rng.uniform(-1, 1, (4,)))}
+    return out, params
+
+
+def test_mlp_roundtrip(tmp_path):
+    sym, params = _mlp()
+    path = str(tmp_path / "mlp.onnx")
+    onnx_mx.export_model(sym, params, [(2, 8)], onnx_file_path=path)
+
+    sym2, arg2, aux2 = onnx_mx.import_model(path)
+    rng = np.random.RandomState(1)
+    x = nd.array(rng.uniform(-1, 1, (2, 8)).astype(np.float32))
+
+    exe1 = sym.bind(mx.current_context(), {"data": x, **params})
+    ref = exe1.forward()[0].asnumpy()
+    exe2 = sym2.bind(mx.current_context(), {"data": x, **arg2})
+    out = exe2.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_conv_pool_bn_roundtrip(tmp_path):
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, num_filter=4, kernel=(3, 3),
+                              pad=(1, 1), name="conv1")
+    bn = mx.sym.BatchNorm(conv, name="bn1")
+    act = mx.sym.Activation(bn, act_type="relu", name="relu1")
+    pool = mx.sym.Pooling(act, kernel=(2, 2), stride=(2, 2),
+                          pool_type="max", name="pool1")
+    flat = mx.sym.Flatten(pool, name="flat")
+    rng = np.random.RandomState(0)
+    params = {
+        "conv1_weight": nd.array(
+            rng.uniform(-1, 1, (4, 3, 3, 3)).astype(np.float32)),
+        "conv1_bias": nd.zeros((4,)),
+        "bn1_gamma": nd.ones((4,)),
+        "bn1_beta": nd.zeros((4,)),
+        "bn1_moving_mean": nd.zeros((4,)),
+        "bn1_moving_var": nd.ones((4,)),
+    }
+    path = str(tmp_path / "conv.onnx")
+    onnx_mx.export_model(flat, params, [(1, 3, 8, 8)],
+                         onnx_file_path=path)
+    sym2, arg2, aux2 = onnx_mx.import_model(path)
+
+    x = nd.array(rng.uniform(-1, 1, (1, 3, 8, 8)).astype(np.float32))
+    args1 = {k: v for k, v in params.items() if "moving" not in k}
+    auxs1 = {k: v for k, v in params.items() if "moving" in k}
+    exe1 = flat.bind(mx.current_context(), {"data": x, **args1},
+                     aux_states=auxs1)
+    ref = exe1.forward(is_train=False)[0].asnumpy()
+    exe2 = sym2.bind(mx.current_context(), {"data": x, **arg2},
+                     aux_states=aux2)
+    out = exe2.forward(is_train=False)[0].asnumpy()
+    # float32 proto roundtrip + BN rsqrt gives ~1e-4 relative noise
+    np.testing.assert_allclose(out, ref, rtol=2e-3, atol=1e-3)
+
+
+def test_elemwise_and_reshape_roundtrip(tmp_path):
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    out = mx.sym.reshape(mx.sym.broadcast_add(a, b) * a, shape=(-1,),
+                         name="out")
+    path = str(tmp_path / "ew.onnx")
+    onnx_mx.export_model(out, {}, [(2, 3), (2, 3)], onnx_file_path=path)
+    sym2, arg2, _ = onnx_mx.import_model(path)
+    rng = np.random.RandomState(0)
+    av = nd.array(rng.uniform(size=(2, 3)).astype(np.float32))
+    bv = nd.array(rng.uniform(size=(2, 3)).astype(np.float32))
+    exe1 = out.bind(mx.current_context(), {"a": av, "b": bv})
+    exe2 = sym2.bind(mx.current_context(), {"a": av, "b": bv})
+    np.testing.assert_allclose(exe2.forward()[0].asnumpy(),
+                               exe1.forward()[0].asnumpy(), rtol=1e-6)
+
+
+def test_onnx_file_is_wellformed_proto(tmp_path):
+    """The emitted bytes parse back with our own decoder and contain the
+    expected structure (ir_version, opset, graph nodes)."""
+    from incubator_mxnet_tpu.contrib.onnx import _proto as P
+    sym, params = _mlp()
+    path = str(tmp_path / "wf.onnx")
+    onnx_mx.export_model(sym, params, [(2, 8)], onnx_file_path=path)
+    model = P.decode_model(open(path, "rb").read())
+    ops = [n["op_type"] for n in model["nodes"]]
+    assert "Gemm" in ops and "Relu" in ops and "Softmax" in ops
+    assert set(model["initializers"]) == set(params)
+    assert model["inputs"][0][0] == "data"

@@ -1,0 +1,90 @@
+"""Quantization tests (model: tests/python/quantization/test_quantization.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.contrib import quantization as qz
+
+
+def test_quantize_dequantize_roundtrip():
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.uniform(-3, 5, (4, 8)).astype(np.float32))
+    q, mn, mx_ = nd._contrib_quantize_v2(x)
+    assert str(q.dtype) == "int8"
+    back = nd._contrib_dequantize(q, mn, mx_)
+    # max quantization error = amax/127
+    amax = max(abs(x.asnumpy().min()), abs(x.asnumpy().max()))
+    np.testing.assert_allclose(back.asnumpy(), x.asnumpy(),
+                               atol=amax / 127 + 1e-6)
+
+
+def test_quantize_with_calib_range():
+    x = nd.array(np.array([[-10.0, 0.5, 1.0, 10.0]], np.float32))
+    q, mn, mx_ = nd._contrib_quantize_v2(x, min_calib_range=-2.0,
+                                         max_calib_range=2.0)
+    qv = q.asnumpy()
+    assert qv[0, 0] == -127 and qv[0, 3] == 127   # clipped at calib range
+    np.testing.assert_allclose(mn.asnumpy(), -2.0)
+
+
+def test_quantized_fully_connected_matches_float():
+    rng = np.random.RandomState(1)
+    x = rng.uniform(-1, 1, (4, 16)).astype(np.float32)
+    w = rng.uniform(-1, 1, (8, 16)).astype(np.float32)
+    b = rng.uniform(-1, 1, (8,)).astype(np.float32)
+    qx, xmn, xmx = nd._contrib_quantize_v2(nd.array(x))
+    qw, wmn, wmx = nd._contrib_quantize_v2(nd.array(w))
+    qb, bmn, bmx = nd._contrib_quantize_v2(nd.array(b))
+    acc, omn, omx = nd._contrib_quantized_fully_connected(
+        qx, qw, qb, xmn, xmx, wmn, wmx, bmn, bmx, num_hidden=8)
+    out = nd._contrib_dequantize(acc, omn, omx).asnumpy()
+    ref = x @ w.T + b
+    np.testing.assert_allclose(out, ref, atol=0.15, rtol=0.1)
+
+
+def test_entropy_threshold_reasonable():
+    rng = np.random.RandomState(0)
+    # gaussian bulk + one extreme outlier: KL threshold should clip the
+    # outlier rather than stretch the range to it
+    x = np.concatenate([rng.normal(0, 1, 100000), [50.0]])
+    thr = qz._get_optimal_threshold(x)
+    assert 2.0 < thr < 25.0
+
+
+def test_quantize_model_naive_end_to_end():
+    rng = np.random.RandomState(2)
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+
+    arg = {"fc1_weight": nd.array(rng.uniform(-1, 1, (16, 8))),
+           "fc1_bias": nd.zeros((16,)),
+           "fc2_weight": nd.array(rng.uniform(-1, 1, (4, 16))),
+           "fc2_bias": nd.zeros((4,))}
+    x = rng.uniform(-1, 1, (32, 8)).astype(np.float32)
+    calib = mx.io.NDArrayIter(data={"data": x}, batch_size=8)
+
+    qsym, qarg, qaux = qz.quantize_model(
+        fc2, arg, {}, data_names=("data",), calib_mode="naive",
+        calib_data=calib)
+    assert "_contrib_quantized_fully_connected" in qsym.tojson()
+
+    # float reference
+    exe_f = fc2.bind(mx.current_context(), {"data": nd.array(x), **arg})
+    ref = exe_f.forward()[0].asnumpy()
+    exe_q = qsym.bind(mx.current_context(), {"data": nd.array(x), **qarg})
+    out = exe_q.forward()[0].asnumpy()
+    # int8 end-to-end: relative agreement on the output scale
+    denom = max(1e-3, np.abs(ref).max())
+    assert np.abs(out - ref).max() / denom < 0.1
+
+
+def test_quantize_model_excluded_layers():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=4, name="fc1")
+    qsym, _, _ = qz.quantize_model(fc1, {}, {},
+                                   excluded_sym_names=["fc1"],
+                                   calib_mode="none")
+    assert "_contrib_quantized_fully_connected" not in qsym.tojson()
