@@ -1,0 +1,126 @@
+"""SVRG optimization (reference:
+python/mxnet/contrib/svrg_optimization/svrg_module.py — SVRGModule :30;
+svrg_optimizer.py).
+
+Stochastic Variance-Reduced Gradient: every ``update_freq`` epochs a full
+snapshot of the parameters (w~) and the full-dataset gradient at w~ are
+taken; each minibatch update then uses g_i(w) - g_i(w~) + g_full(w~),
+whose variance vanishes as w → w*."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ...module.module import Module
+
+__all__ = ["SVRGModule"]
+
+
+class SVRGModule(Module):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), update_freq=2, **kwargs):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, **kwargs)
+        self.update_freq = int(update_freq)
+        self._snapshot_params: Optional[Dict] = None
+        self._full_grads: Optional[Dict] = None
+        self._mod_aux = None
+
+    # ------------------------------------------------------------------
+    def update_full_grads(self, train_data):
+        """Snapshot w~ and accumulate the full gradient at w~
+        (svrg_module.py:258)."""
+        import numpy as np
+
+        from ...ndarray import ndarray as nd
+
+        arg_params, aux_params = self.get_params()
+        self._snapshot_params = {k: v.asnumpy().copy()
+                                 for k, v in arg_params.items()}
+        accum = {k: np.zeros_like(v) for k, v in
+                 self._snapshot_params.items()}
+        nbatch = 0
+        train_data.reset()
+        for batch in train_data:
+            self.forward_backward(batch)
+            for name, grad in zip(self._exec._arg_names,
+                                  self._exec.grad_arrays):
+                if grad is not None and name in accum:
+                    accum[name] += grad.asnumpy()
+            nbatch += 1
+        train_data.reset()
+        self._full_grads = {k: nd.array(v / max(nbatch, 1))
+                            for k, v in accum.items()}
+
+    def _apply_svrg_correction(self):
+        """grad ← grad - g(w~) + g_full(w~), with g(w~) recomputed on the
+        current batch at the snapshot params (svrg_optimizer.py)."""
+        import numpy as np
+
+        from ...ndarray import ndarray as nd
+
+        if self._full_grads is None:
+            return
+        # recompute this batch's gradient at the snapshot params
+        current = {k: v.asnumpy().copy()
+                   for k, v in self.get_params()[0].items()}
+        self.set_params({k: nd.array(v) for k, v in
+                         self._snapshot_params.items()}, None,
+                        allow_missing=True, allow_extra=True)
+        self._exec.forward(is_train=True)
+        self._exec.backward()
+        snap_grads = {name: (g.asnumpy().copy() if g is not None else None)
+                      for name, g in zip(self._exec._arg_names,
+                                         self._exec.grad_arrays)}
+        # restore and correct
+        self.set_params({k: nd.array(v) for k, v in current.items()}, None,
+                        allow_missing=True, allow_extra=True)
+        self._exec.forward(is_train=True)
+        self._exec.backward()
+        for name, grad in zip(self._exec._arg_names,
+                              self._exec.grad_arrays):
+            if grad is None or name not in self._full_grads:
+                continue
+            sg = snap_grads.get(name)
+            if sg is None:
+                continue
+            corrected = grad.asnumpy() - sg + \
+                self._full_grads[name].asnumpy()
+            grad._data = nd.array(corrected)._data
+
+    def update_svrg(self):
+        """One variance-reduced update for the current batch
+        (svrg_module.py:302)."""
+        self._apply_svrg_correction()
+        self.update()
+
+    # ------------------------------------------------------------------
+    def fit(self, train_data, eval_metric="acc", optimizer="sgd",
+            optimizer_params=None, num_epoch=1, initializer=None,
+            **kwargs):
+        """SVRG training loop: full-grad snapshot every update_freq epochs
+        (svrg_module.py:83)."""
+        from ... import metric as metric_mod
+
+        if not self.binded:
+            self.bind(data_shapes=train_data.provide_data,
+                      label_shapes=train_data.provide_label,
+                      for_training=True)
+        if not self.params_initialized:
+            from ... import initializer as init_mod
+            self.init_params(initializer or init_mod.Uniform(0.01))
+        self.init_optimizer(optimizer=optimizer,
+                            optimizer_params=optimizer_params or
+                            {"learning_rate": 0.01})
+        if isinstance(eval_metric, str):
+            eval_metric = metric_mod.create(eval_metric)
+
+        for epoch in range(num_epoch):
+            if epoch % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            eval_metric.reset()
+            train_data.reset()
+            for batch in train_data:
+                self.forward_backward(batch)
+                self.update_svrg()
+                self.update_metric(eval_metric, batch.label)
+        return self

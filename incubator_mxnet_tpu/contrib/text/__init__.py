@@ -1,0 +1,5 @@
+"""``mx.contrib.text`` (reference: python/mxnet/contrib/text/)."""
+from . import vocab
+from . import embedding
+from . import utils
+from .vocab import Vocabulary

@@ -1,0 +1,137 @@
+"""Token embeddings (reference: python/mxnet/contrib/text/embedding.py —
+_TokenEmbedding :39, CustomEmbedding :522, CompositeEmbedding).
+
+Pretrained-download registries (GloVe/fastText) need egress; the
+file-backed CustomEmbedding covers the same mechanics (load, lookup,
+update_token_vectors) from local files."""
+from __future__ import annotations
+
+import io
+from typing import List, Optional
+
+import numpy as np
+
+from .vocab import Vocabulary
+
+__all__ = ["TokenEmbedding", "CustomEmbedding", "CompositeEmbedding",
+           "get_pretrained_file_names"]
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Pretrained registries need network egress — none in this
+    environment (embedding.py:113)."""
+    return {}
+
+
+class TokenEmbedding(Vocabulary):
+    """Base: vocabulary + vector table (embedding.py:39)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Token(s) → vector(s) (embedding.py:276)."""
+        from ...ndarray import ndarray as nd
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        if lower_case_backup:
+            toks = [t if t in self._token_to_idx else t.lower()
+                    for t in toks]
+        idxs = self.to_indices(toks)
+        vecs = self._idx_to_vec[np.asarray(idxs)]
+        return nd.array(vecs[0] if single else vecs)
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite vectors for known tokens (embedding.py:309)."""
+        if isinstance(tokens, str):
+            tokens = [tokens]
+        new_vectors = np.asarray(
+            new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy")
+            else new_vectors, np.float32).reshape(len(tokens), -1)
+        for t, v in zip(tokens, new_vectors):
+            if t not in self._token_to_idx:
+                raise ValueError("token %r is unknown" % t)
+            self._idx_to_vec[self._token_to_idx[t]] = v
+
+    def _load_embedding_txt(self, file_path, elem_delim=" ",
+                            encoding="utf8"):
+        tokens, vecs = [], []
+        with io.open(file_path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) <= 2:
+                    continue  # header line
+                token, elems = parts[0], parts[1:]
+                try:
+                    vec = [float(x) for x in elems]
+                except ValueError:
+                    continue
+                if self._vec_len == 0:
+                    self._vec_len = len(vec)
+                if len(vec) != self._vec_len:
+                    continue  # malformed line
+                tokens.append(token)
+                vecs.append(vec)
+        return tokens, vecs
+
+
+class CustomEmbedding(TokenEmbedding):
+    """Embedding loaded from a local ``token<delim>v1<delim>v2...`` file
+    (embedding.py:522)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", vocabulary: Optional[Vocabulary] = None,
+                 init_unknown_vec=None, **kwargs):
+        super().__init__(**kwargs)
+        tokens, vecs = self._load_embedding_txt(pretrained_file_path,
+                                                elem_delim, encoding)
+        table = dict(zip(tokens, vecs))
+        if vocabulary is None:
+            for t in tokens:
+                if t not in self._token_to_idx:
+                    self._token_to_idx[t] = len(self._idx_to_token)
+                    self._idx_to_token.append(t)
+        else:
+            self._idx_to_token = list(vocabulary.idx_to_token)
+            self._token_to_idx = dict(vocabulary.token_to_idx)
+            self._unknown_token = vocabulary.unknown_token
+        n = len(self._idx_to_token)
+        init = init_unknown_vec or (lambda shape: np.zeros(shape,
+                                                           np.float32))
+        self._idx_to_vec = np.stack(
+            [np.asarray(table[t], np.float32) if t in table
+             else np.asarray(init((self._vec_len,)), np.float32)
+             for t in self._idx_to_token]) if n else None
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenates several embeddings over one vocabulary
+    (embedding.py:602)."""
+
+    def __init__(self, vocabulary: Vocabulary, token_embeddings):
+        if not isinstance(token_embeddings, (list, tuple)):
+            token_embeddings = [token_embeddings]
+        super().__init__()
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._unknown_token = vocabulary.unknown_token
+        parts = []
+        for emb in token_embeddings:
+            vecs = np.stack([
+                emb.idx_to_vec[emb.token_to_idx[t]]
+                if t in emb.token_to_idx
+                else np.zeros(emb.vec_len, np.float32)
+                for t in self._idx_to_token])
+            parts.append(vecs)
+        self._idx_to_vec = np.concatenate(parts, axis=1)
+        self._vec_len = self._idx_to_vec.shape[1]

@@ -1,0 +1,99 @@
+"""Vocabulary (reference: python/mxnet/contrib/text/vocab.py —
+Vocabulary :33)."""
+from __future__ import annotations
+
+import collections
+from typing import List, Optional
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary:
+    """Indexes tokens by frequency (vocab.py:33).  Index 0 is the unknown
+    token when ``unknown_token`` is set; reserved tokens follow."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        assert min_freq > 0, "min_freq must be positive"
+        self._unknown_token = unknown_token
+        reserved_tokens = list(reserved_tokens or [])
+        if reserved_tokens:
+            assert len(set(reserved_tokens)) == len(reserved_tokens), \
+                "reserved_tokens must not contain duplicates"
+            assert unknown_token not in reserved_tokens, \
+                "unknown_token must not be in reserved_tokens"
+        self._reserved_tokens = reserved_tokens or None
+
+        self._idx_to_token = []
+        if unknown_token is not None:
+            self._idx_to_token.append(unknown_token)
+        self._idx_to_token.extend(reserved_tokens)
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+
+        if counter is not None:
+            self._index_counter_keys(counter, most_freq_count, min_freq)
+
+    def _index_counter_keys(self, counter, most_freq_count, min_freq):
+        assert isinstance(counter, collections.Counter), \
+            "counter must be a collections.Counter"
+        unknown_and_reserved = set(self._idx_to_token)
+        pairs = sorted(counter.items(), key=lambda x: (-x[1], x[0]))
+        count = 0
+        for token, freq in pairs:
+            if freq < min_freq:
+                break
+            if most_freq_count is not None and count >= most_freq_count:
+                break
+            if token in unknown_and_reserved:
+                continue
+            self._token_to_idx[token] = len(self._idx_to_token)
+            self._idx_to_token.append(token)
+            count += 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token(s) → index/indices; unknowns map to index 0
+        (vocab.py:161)."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        unk = self._token_to_idx.get(self._unknown_token, 0) \
+            if self._unknown_token is not None else None
+        out = []
+        for t in toks:
+            if t in self._token_to_idx:
+                out.append(self._token_to_idx[t])
+            elif unk is not None:
+                out.append(unk)
+            else:
+                raise KeyError("token %r not in vocabulary (no unknown "
+                               "token configured)" % t)
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        """Index/indices → token(s) (vocab.py:192)."""
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        out = []
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise ValueError("index %d out of vocabulary range" % i)
+            out.append(self._idx_to_token[i])
+        return out[0] if single else out

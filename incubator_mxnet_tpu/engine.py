@@ -75,11 +75,21 @@ class NativeEngine:
         with self._live_lock:
             self._counter += 1
             token = self._counter
-        # opportunistic safe prune: when the C++ engine reports zero
-        # outstanding ops, every past trampoline has fully returned
-        if len(self._done) > 256 and \
-                self._lib.MXTEngineOutstanding(self._handle) == 0:
-            self._prune()
+        # opportunistic safe prune.  Order matters: snapshot the done-set
+        # FIRST, then read the outstanding count — a token done before an
+        # observed count of zero has necessarily finished its C call
+        # (done.add precedes the worker's outstanding decrement, so
+        # reading 0 happens-after that op's frame unwound).  Tokens marked
+        # done after the snapshot are left for next time, closing the
+        # check-then-prune race with concurrent pushes.
+        if len(self._done) > 256:
+            with self._live_lock:
+                snapshot = set(self._done)
+            if self._lib.MXTEngineOutstanding(self._handle) == 0:
+                with self._live_lock:
+                    for t in snapshot:
+                        self._live.pop(t, None)
+                    self._done -= snapshot
 
         def trampoline(_ctx, _token=token):
             try:

@@ -1,0 +1,41 @@
+"""2-bit gradient compression with error feedback (reference:
+src/kvstore/gradient_compression.h:52,79 + .cu kernels).
+
+Semantics: each gradient element compresses to one of
+{-threshold, 0, +threshold}; the quantization residual is accumulated
+into the next step's gradient (error feedback), so the compression is
+unbiased over time.  On TPU the wire format is moot (gradients ride ICI
+inside XLA collectives) but the numerics are the contract the reference
+tests (tests/nightly/dist_sync_kvstore.py 2-bit checks), and int8
+all-reduce can reuse this path.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["GradientCompression"]
+
+
+class GradientCompression:
+    def __init__(self, type="2bit", threshold=0.5):  # noqa: A002
+        if str(type) != "2bit":
+            raise ValueError("only 2bit compression is supported "
+                             "(gradient_compression.h kGradientCompression2Bit)")
+        self.type = str(type)
+        self.threshold = float(threshold)
+        self._residual = {}
+
+    def compress(self, key, grad):
+        """grad (+ residual) → ternary {-t, 0, +t}; residual updated
+        (gradient_compression.h Quantize2Bit)."""
+        t = self.threshold
+        r = self._residual.get(key)
+        g = grad + r if r is not None else grad
+        q = jnp.where(g >= t, t, jnp.where(g <= -t, -t, 0.0))
+        q = q.astype(grad.dtype)
+        self._residual[key] = g - q
+        return q
+
+    def decompress(self, key, q):
+        """Identity — q already carries the ternary values."""
+        return q

@@ -145,6 +145,9 @@ class KVStore:
 
         for k, v in zip(keys, values):
             merged = self._merge(v if isinstance(v, (list, tuple)) else [v])
+            if getattr(self, "_compressor", None) is not None \
+                    and not isinstance(merged, BaseSparseNDArray):
+                merged = self._compressor.compress(k, merged)
             if isinstance(merged, BaseSparseNDArray):
                 if k not in self._store:
                     # match the dense path: an un-init'd key starts at zero
@@ -229,9 +232,11 @@ class KVStore:
         self._updater = updater
 
     def set_gradient_compression(self, compression_params):
-        """2-bit compression config (gradient_compression.h parity). On TPU
-        gradients ride ICI inside XLA programs; stored for API compat."""
+        """Enable 2-bit compression with error feedback
+        (gradient_compression.h:52; kvstore.py:487)."""
+        from .gradient_compression import GradientCompression
         self._compression_params = dict(compression_params)
+        self._compressor = GradientCompression(**compression_params)
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._optimizer is None:

@@ -448,18 +448,43 @@ def _tril(data, k=0):
     return jnp.tril(data, k=k)
 
 
+def _regression_output(data, label, grad_scale, fwd_fn, grad_fn):
+    """Regression heads are loss layers: forward transforms data, backward
+    ignores the incoming cotangent and emits grad_fn(pred, label) *
+    grad_scale / features-per-sample (src/operator/regression_output-inl.h
+    backward Assign)."""
+    lab = label.reshape(data.shape) if label.shape != data.shape else label
+    num_output = data.size // data.shape[0] if data.ndim > 0 else 1
+
+    @jax.custom_vjp
+    def f(d, l):
+        return fwd_fn(d)
+
+    def fwd(d, l):
+        return fwd_fn(d), (d, l)
+
+    def bwd(res, g):
+        d, l = res
+        grad = grad_fn(fwd_fn(d), l) * (grad_scale / num_output)
+        return grad.astype(d.dtype), jnp.zeros_like(l)
+
+    f.defvjp(fwd, bwd)
+    return f(data, lab)
+
+
 @register("LinearRegressionOutput", num_inputs=2, aliases=("linear_regression_output",))
 def _linreg_out(data, label, grad_scale=1.0):
-    # forward = identity; special grad (data-label) handled by SoftmaxOutput-style
-    # training wrappers in module/model code
-    return data
+    return _regression_output(data, label, grad_scale,
+                              lambda d: d, lambda p, l: p - l)
 
 
 @register("LogisticRegressionOutput", num_inputs=2, aliases=("logistic_regression_output",))
 def _logreg_out(data, label, grad_scale=1.0):
-    return jax.nn.sigmoid(data)
+    return _regression_output(data, label, grad_scale,
+                              jax.nn.sigmoid, lambda p, l: p - l)
 
 
 @register("MAERegressionOutput", num_inputs=2, aliases=("mae_regression_output",))
 def _maereg_out(data, label, grad_scale=1.0):
-    return data
+    return _regression_output(data, label, grad_scale,
+                              lambda d: d, lambda p, l: jnp.sign(p - l))

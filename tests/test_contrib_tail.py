@@ -1,0 +1,120 @@
+"""Gradient compression, contrib.text, SVRG tests (models:
+tests/nightly/dist_sync_kvstore.py 2-bit checks,
+tests/python/unittest/test_contrib_text.py, test_contrib_svrg_module.py)."""
+import collections
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.contrib import text
+from incubator_mxnet_tpu.contrib.svrg_optimization import SVRGModule
+from incubator_mxnet_tpu.kvstore.gradient_compression import \
+    GradientCompression
+
+
+# -------------------------------------------------------- 2-bit compression
+
+def test_two_bit_ternary_values():
+    import jax.numpy as jnp
+    gc = GradientCompression(threshold=0.5)
+    g = jnp.asarray([0.3, 0.7, -0.9, 0.0, -0.2])
+    q = gc.compress("k", g)
+    np.testing.assert_allclose(np.asarray(q), [0.0, 0.5, -0.5, 0.0, 0.0])
+    # residual = g - q
+    np.testing.assert_allclose(np.asarray(gc._residual["k"]),
+                               [0.3, 0.2, -0.4, 0.0, -0.2], atol=1e-6)
+
+
+def test_two_bit_error_feedback_converges():
+    """Repeated compression of a constant gradient transmits the full
+    magnitude over time (unbiasedness via residual accumulation)."""
+    import jax.numpy as jnp
+    gc = GradientCompression(threshold=0.5)
+    g = jnp.asarray([0.2, -0.3])
+    total = np.zeros(2)
+    for _ in range(10):
+        total += np.asarray(gc.compress("k", g))
+    np.testing.assert_allclose(total, [2.0, -3.0], atol=0.51)
+
+
+def test_kvstore_compression_integration():
+    kv = mx.kv.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 1.0})
+    kv.init("w", nd.zeros((3,)))
+    kv.push("w", nd.array(np.array([2.0, 0.3, -1.5], np.float32)))
+    out = nd.zeros((3,))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), [1.0, 0.0, -1.0])
+
+
+# -------------------------------------------------------------- contrib.text
+
+def test_vocabulary_basic():
+    counter = collections.Counter(
+        ["the", "the", "the", "cat", "cat", "dog"])
+    vocab = text.Vocabulary(counter, min_freq=1, unknown_token="<unk>",
+                            reserved_tokens=["<pad>"])
+    assert vocab.to_indices("the") == vocab.token_to_idx["the"]
+    assert vocab.to_indices(["the", "cat"]) == [
+        vocab.token_to_idx["the"], vocab.token_to_idx["cat"]]
+    # unknown maps to index of <unk> (0)
+    assert vocab.to_indices("unicorn") == vocab.token_to_idx["<unk>"]
+    assert vocab.to_tokens(vocab.to_indices("dog")) == "dog"
+    assert len(vocab) == 5  # unk, pad, the, cat, dog
+
+
+def test_vocabulary_most_freq_and_min_freq():
+    counter = collections.Counter(
+        {"a": 5, "b": 4, "c": 3, "d": 2, "e": 1})
+    vocab = text.Vocabulary(counter, most_freq_count=2, min_freq=2)
+    assert "a" in vocab.token_to_idx and "b" in vocab.token_to_idx
+    assert "c" not in vocab.token_to_idx
+
+
+def test_custom_embedding(tmp_path):
+    path = str(tmp_path / "emb.txt")
+    with open(path, "w") as f:
+        f.write("hello 1.0 2.0 3.0\n")
+        f.write("world 4.0 5.0 6.0\n")
+    emb = text.embedding.CustomEmbedding(path)
+    assert emb.vec_len == 3
+    v = emb.get_vecs_by_tokens("world")
+    np.testing.assert_allclose(v.asnumpy(), [4.0, 5.0, 6.0])
+    vs = emb.get_vecs_by_tokens(["hello", "nope"])
+    np.testing.assert_allclose(vs.asnumpy()[1], 0.0)  # unknown → zeros
+    emb.update_token_vectors("hello", nd.array(np.array([9., 9., 9.])))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), 9.0)
+
+
+def test_count_tokens():
+    counter = text.utils.count_tokens_from_str("a b b\nc a  a", to_lower=True)
+    assert counter["a"] == 3 and counter["b"] == 2 and counter["c"] == 1
+
+
+# --------------------------------------------------------------------- SVRG
+
+def test_svrg_module_convergence():
+    """SVRG on least squares converges (model:
+    test_contrib_svrg_module.py test_svrg_with_sgd)."""
+    rng = np.random.RandomState(0)
+    n, d = 64, 4
+    w_true = rng.uniform(-1, 1, (1, d)).astype(np.float32)
+    x = rng.uniform(-1, 1, (n, d)).astype(np.float32)
+    y = (x @ w_true.T).reshape(-1)
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("lin_label")
+    fc = mx.sym.FullyConnected(data, num_hidden=1, no_bias=True, name="fc")
+    out = mx.sym.LinearRegressionOutput(fc, label, name="lin")
+
+    it = mx.io.NDArrayIter(data={"data": x}, label={"lin_label": y},
+                           batch_size=16, label_name="lin_label")
+    mod = SVRGModule(out, data_names=("data",), label_names=("lin_label",),
+                     update_freq=2)
+    mod.fit(it, eval_metric="mse", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2}, num_epoch=16)
+    w = mod.get_params()[0]["fc_weight"].asnumpy()
+    np.testing.assert_allclose(w, w_true, atol=0.1)
