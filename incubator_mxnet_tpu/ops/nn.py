@@ -82,10 +82,7 @@ def _convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=int(num_group),
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
     )
-    if out.dtype != data.dtype:
-        out = out.astype(data.dtype)
     if bias is not None and not no_bias:
         if layout in ("NWC", "NHWC", "NDHWC"):
             out = out + bias

@@ -103,11 +103,12 @@ class TrainStep:
         self.param_shardings = param_shardings or {}
         self._gp = None
         self._aux = None
-        self._aux_holders = []
         self._opt_state = None
         self._step_count = 0
         self._jit = None
         self._donate = donate
+        self._placed = False
+        self._shardings = None
 
     # ------------------------------------------------------------------
     def _collect(self):
@@ -119,7 +120,6 @@ class TrainStep:
         gp_list, aux_list = self._gp, self._aux
         net, loss_fn, opt = self.net, self.loss_fn, self.opt
         compute_dtype = self.compute_dtype
-        self_ref = self
 
         def step(p_vals, aux_vals, opt_state, x, y, key, step_count):
             def loss_of(pv):
@@ -144,16 +144,22 @@ class TrainStep:
                         loss = loss.mean()
                 finally:
                     tracing.pop_trace()
-                holders, writes = tc.collect_aux()
-                self_ref._aux_holders = holders
-                return loss._data.astype(jnp.float32), writes
+                # align aux writes to aux_list positions (functional update:
+                # unwritten aux flow through unchanged) — no trace-order
+                # side channel between tracing and the caller
+                new_aux = []
+                for p, bound in zip(aux_list, aux_vals):
+                    w = tc.aux_writes.get(id(p))
+                    new_aux.append(bound if w is None
+                                   else w[1].astype(bound.dtype))
+                return loss._data.astype(jnp.float32), new_aux
 
-            (loss_val, writes), grads = jax.value_and_grad(
+            (loss_val, new_aux), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(p_vals)
             new_p, new_s = opt.apply(p_vals, grads, opt_state, step_count)
-            return loss_val, new_p, list(writes), new_s
+            return loss_val, new_p, list(new_aux), new_s
 
-        donate = (0, 2) if self._donate else ()
+        donate = (0, 1, 2) if self._donate else ()
         if self.mesh is None:
             return jax.jit(step, donate_argnums=donate)
 
@@ -167,8 +173,6 @@ class TrainStep:
         p_sh = [p_shard(p) for p in gp_list]
         aux_sh = [repl for _ in aux_list]
         batch_sh = NamedSharding(mesh, P(self.batch_axis))
-        state_sh = jax.tree.map(lambda _: None, self.opt.init(
-            [jnp.zeros((1,), jnp.float32) for _ in gp_list]))
         # opt state shards like its parameter
         if self.opt.name == "sgd" and self.opt.momentum:
             state_sh = list(p_sh)
@@ -176,6 +180,7 @@ class TrainStep:
             state_sh = [(s, s) for s in p_sh]
         else:
             state_sh = []
+        self._shardings = (p_sh, aux_sh, state_sh, batch_sh, repl)
         return jax.jit(step, donate_argnums=donate,
                        in_shardings=(p_sh, aux_sh, state_sh, batch_sh,
                                      batch_sh, repl, None),
@@ -198,16 +203,28 @@ class TrainStep:
         self._step_count += 1
         p_vals = [p._data._data for p in self._gp]
         aux_vals = [p._data._data for p in self._aux]
-        loss, new_p, writes, new_s = self._jit(
+        if self.mesh is not None:
+            p_sh, aux_sh, state_sh, batch_sh, _ = self._shardings
+            if not self._placed:
+                # place params/opt-state on their target shardings up front:
+                # donation then updates buffers in place every step and
+                # committed single-device arrays never conflict with
+                # in_shardings
+                p_vals = [jax.device_put(v, s) for v, s in zip(p_vals, p_sh)]
+                aux_vals = [jax.device_put(v, s)
+                            for v, s in zip(aux_vals, aux_sh)]
+                self._opt_state = jax.tree.map(
+                    jax.device_put, self._opt_state, state_sh)
+                self._placed = True
+            xv = jax.device_put(xv, batch_sh)
+            yv = jax.device_put(yv, batch_sh)
+        loss, new_p, new_aux, new_s = self._jit(
             p_vals, aux_vals, self._opt_state, xv, yv, key,
             self._step_count)
         for p, v in zip(self._gp, new_p):
             p._data._data = v
-        for holder, v in zip(self._aux_holders, writes):
-            if hasattr(holder, "_data") and isinstance(holder._data, NDArray):
-                holder._data._data = v
-            elif isinstance(holder, NDArray):
-                holder._data = v
+        for p, v in zip(self._aux, new_aux):
+            p._data._data = v
         self._opt_state = new_s
         return NDArray(loss)
 

@@ -13,20 +13,11 @@ any backend is initialized.
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
+from _platform_pin import pin_cpu
 
-# sitecustomize's axon register() already stamped jax_platforms="axon,cpu";
-# re-pin to cpu-only so backends() never dials the TPU tunnel from tests.
-jax.config.update("jax_platforms", "cpu")
+jax = pin_cpu(8)
 jax.config.update("jax_default_matmul_precision", "highest")
 
 import numpy as np

@@ -1,0 +1,105 @@
+"""Regression tests for the two driver-graded paths.
+
+Round-1 postmortem (VERDICT.md Weak #1-#3): bench.py crashed on a bf16
+dtype bug and dryrun_multichip had never been executed — because no test
+ran either exact configuration.  These tests pin both:
+
+- the bench config: ``make_train_step(..., compute_dtype="bfloat16")``
+  on a model-zoo ResNet (conv+BN+pool+FC mix), several steps, finite loss,
+  aux (BN running stats) actually updated;
+- the dryrun config: ``__graft_entry__.dryrun_multichip(8)`` invoked
+  in-process on the 8-device virtual CPU mesh.
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, nd
+from incubator_mxnet_tpu.gluon.model_zoo import vision
+from incubator_mxnet_tpu.parallel import P, make_mesh, make_train_step
+
+
+def _train_steps(compute_dtype, n=5, net_fn=vision.resnet18_v1, **kw):
+    mx.random.seed(0)
+    net = net_fn(classes=10)
+    net.initialize(init=mx.init.Xavier())
+    net(nd.random.uniform(shape=(1, 3, 32, 32)))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = make_train_step(net, loss_fn, optimizer="sgd", learning_rate=0.01,
+                           momentum=0.9, wd=1e-4, compute_dtype=compute_dtype,
+                           **kw)
+    x = nd.random.uniform(shape=(4, 3, 32, 32))
+    y = nd.array(np.random.randint(0, 10, 4).astype(np.float32))
+    losses = [float(step(x, y).asscalar()) for _ in range(n)]
+    return net, losses
+
+
+def test_bf16_train_step_bench_config():
+    """The exact bench.py configuration (bf16 compute, f32 state)."""
+    net, losses = _train_steps("bfloat16")
+    assert all(np.isfinite(l) for l in losses), losses
+    # training on one repeated batch must reduce loss
+    assert losses[-1] < losses[0]
+    # all parameters stay f32 master copies
+    for p in net.collect_params().values():
+        assert p._data.dtype == np.float32, p.name
+
+
+def test_bf16_train_step_updates_bn_aux():
+    mx.random.seed(0)
+    net = vision.resnet18_v1(classes=10)
+    net.initialize(init=mx.init.Xavier())
+    net(nd.random.uniform(shape=(1, 3, 32, 32)))
+    aux = [p for p in net.collect_params().values() if p.grad_req == "null"]
+    assert aux, "resnet BN must expose running stats as aux"
+    before = [np.asarray(p._data._data).copy() for p in aux]
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = make_train_step(net, loss_fn, optimizer="sgd", learning_rate=0.1,
+                           momentum=0.9, compute_dtype="bfloat16")
+    x = nd.random.uniform(shape=(4, 3, 32, 32))
+    y = nd.array(np.random.randint(0, 10, 4).astype(np.float32))
+    step(x, y)
+    after = [np.asarray(p._data._data) for p in aux]
+    changed = sum(not np.allclose(b, a) for b, a in zip(before, after))
+    assert changed >= len(aux) // 2, "BN running stats did not update"
+    for p, a in zip(aux, after):
+        assert a.dtype == np.float32, p.name
+
+
+def test_bf16_matches_f32_direction():
+    """bf16 step must track the f32 step loss (same data, same seed)."""
+    _, l32 = _train_steps(None)
+    _, l16 = _train_steps("bfloat16")
+    assert abs(l32[0] - l16[0]) / abs(l32[0]) < 0.05, (l32, l16)
+
+
+def test_dryrun_multichip_in_process():
+    """The exact driver-graded multichip dryrun, on the virtual CPU mesh."""
+    import __graft_entry__
+
+    __graft_entry__.dryrun_multichip(8)
+
+
+def test_dp_tp_bias_1d_sharding():
+    """1-D P('tp') bias sharding — the round-1 dryrun failure mode."""
+    import jax
+
+    devices = jax.devices("cpu")[:4]
+    mesh = make_mesh({"dp": 2, "tp": 2}, devices=devices)
+    mx.random.seed(0)
+    net = vision.resnet18_v1(classes=16)
+    net.initialize(init=mx.init.Xavier())
+    net(nd.random.uniform(shape=(1, 3, 32, 32)))
+    shardings = {
+        net.output.weight.name: P("tp", None),
+        net.output.bias.name: P("tp"),
+    }
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = make_train_step(net, loss_fn, optimizer="sgd", learning_rate=0.1,
+                           momentum=0.9, mesh=mesh, batch_axis="dp",
+                           param_shardings=shardings)
+    x = nd.random.uniform(shape=(4, 3, 32, 32))
+    y = nd.array(np.random.randint(0, 16, 4).astype(np.float32))
+    for _ in range(2):
+        loss = step(x, y)
+    assert np.isfinite(float(loss.asscalar()))
