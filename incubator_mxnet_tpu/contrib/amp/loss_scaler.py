@@ -12,10 +12,11 @@ __all__ = ["LossScaler"]
 
 class LossScaler:
     def __init__(self, init_scale=2.**16, scale_factor=2., scale_window=2000,
-                 tolerance=0.05):
+                 tolerance=0.05, max_loss_scale=2.**24):
         self.loss_scale = init_scale
         self._scale_factor = scale_factor
         self._scale_window = scale_window
+        self._max_loss_scale = max_loss_scale
         self._unskipped = 0
 
     def has_overflow(self, params):
@@ -42,5 +43,8 @@ class LossScaler:
         else:
             self._unskipped += 1
             if self._unskipped == self._scale_window:
-                self.loss_scale *= self._scale_factor
+                # cap growth (reference max_loss_scale) so the scaler does
+                # not walk into guaranteed periodic overflow-skip steps
+                self.loss_scale = min(self.loss_scale * self._scale_factor,
+                                      self._max_loss_scale)
                 self._unskipped = 0

@@ -86,7 +86,6 @@ class Trainer:
         self._init_kvstore()
         scaler = getattr(self, "_amp_loss_scaler", None)
         loss_scale = scaler.loss_scale if scaler is not None else 1.0
-        self._optimizer.rescale_grad = self._scale / batch_size / loss_scale
         self.allreduce_grads()
         if scaler is not None:
             # check even at loss_scale == 1.0 (the dynamic floor): an
@@ -95,7 +94,10 @@ class Trainer:
                 scaler.update_scale(True)
                 return  # skip update on overflow
             scaler.update_scale(False)
-        self.update(batch_size, ignore_stale_grad)
+        # pass the scale the loss was actually multiplied by: update_scale
+        # may have just doubled scaler.loss_scale for the NEXT step, and
+        # re-reading it here would silently halve this step's update
+        self.update(batch_size, ignore_stale_grad, _loss_scale=loss_scale)
 
     def allreduce_grads(self):
         """Cross-replica gradient reduction.
@@ -108,10 +110,11 @@ class Trainer:
                 if p.grad_req != "null":
                     self._kvstore.pushpull(i, p.grad(), out=p.grad())
 
-    def update(self, batch_size, ignore_stale_grad=False):
-        scaler = getattr(self, "_amp_loss_scaler", None)
-        loss_scale = scaler.loss_scale if scaler is not None else 1.0
-        self._optimizer.rescale_grad = self._scale / batch_size / loss_scale
+    def update(self, batch_size, ignore_stale_grad=False, _loss_scale=None):
+        if _loss_scale is None:
+            scaler = getattr(self, "_amp_loss_scaler", None)
+            _loss_scale = scaler.loss_scale if scaler is not None else 1.0
+        self._optimizer.rescale_grad = self._scale / batch_size / _loss_scale
         updater = self._updaters[0]
         for i, p in enumerate(self._params):
             if p.grad_req == "null":

@@ -26,10 +26,18 @@ def _range_scale(min_r, max_r):
     return jnp.where(amax > 0, 127.0 / amax, 1.0)
 
 
+def _check_out_type(out_type):
+    if str(out_type) not in ("int8", "auto"):
+        raise NotImplementedError(
+            "out_type=%r: only symmetric int8 quantization is implemented "
+            "(the reference's affine uint8 encoding is not)" % (out_type,))
+
+
 @register("_contrib_quantize", num_inputs=3, num_outputs=3,
           differentiable=False)
 def _quantize(data, min_range, max_range, out_type="int8"):
     """float → int8 with explicit range (quantize.cc)."""
+    _check_out_type(out_type)
     scale = _range_scale(min_range, max_range)
     q = jnp.clip(jnp.rint(data * scale), -127, 127).astype(jnp.int8)
     amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
@@ -42,6 +50,7 @@ def _quantize_v2(data, min_calib_range=None, max_calib_range=None,
                  out_type="int8"):
     """float → int8; range from calibration attrs or the data itself
     (quantize_v2.cc)."""
+    _check_out_type(out_type)
     if min_calib_range is not None and max_calib_range is not None:
         min_r = jnp.float32(min_calib_range)
         max_r = jnp.float32(max_calib_range)
@@ -115,6 +124,9 @@ def _quantized_conv(data, weight, bias, min_data, max_data, min_weight,
                     num_filter=0, num_group=1, no_bias=False,
                     layout="NCHW", **ignored):
     """int8 convolution with int32 accumulation (quantized_conv.cc)."""
+    if layout != "NCHW":
+        raise NotImplementedError(
+            "quantized_conv only supports layout='NCHW', got %r" % (layout,))
     stride = tuple(int(s) for s in stride)
     pad = tuple(int(p) for p in pad)
     dilate = tuple(int(d) for d in dilate)

@@ -98,6 +98,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_prev = l_scr[:]
         m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
+        # rows with zero unmasked keys (causal, kv_len < q_len): every score
+        # is _NEG_INF, so exp(s - m_new) would be 1 everywhere and emit
+        # mean(V); force those rows to contribute nothing (output 0)
+        p = jnp.where(m_new > _NEG_INF / 2, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_prev + p.sum(axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
@@ -177,6 +181,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k, offset)
         p = jnp.exp(s - lse[:, None])
+        # rows with zero unmasked keys have lse ~= _NEG_INF, which would
+        # blow exp() up instead of zeroing it; mask on the raw scores
+        p = jnp.where(s > _NEG_INF / 2, p, 0.0)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
         dq_scr[:] = dq_scr[:] + jnp.dot(ds, k,
@@ -214,6 +221,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             s = _causal_mask(s, qi, kj, block_q, block_k, offset)
         p = jnp.exp(s - lse[:, None])                   # (bq, bk)
+        p = jnp.where(s > _NEG_INF / 2, p, 0.0)
         dv_scr[:] = dv_scr[:] + jnp.dot(p.T, do,
                                         preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)

@@ -127,3 +127,44 @@ def test_scale_loss_and_trainer():
             pass
     assert scaled.asnumpy().item() == loss.asnumpy().item() * \
         trainer._amp_loss_scaler.loss_scale
+
+
+def test_loss_scaler_growth_cap():
+    """Scale doubles every scale_window clean steps but caps at 2**24."""
+    from incubator_mxnet_tpu.contrib.amp import LossScaler
+
+    s = LossScaler(init_scale=2.**23, scale_window=1)
+    s.update_scale(False)
+    assert s.loss_scale == 2.**24
+    s.update_scale(False)
+    assert s.loss_scale == 2.**24  # capped, not 2**25
+
+
+def test_scale_window_step_not_halved():
+    """The update on the scale_window-th clean step must divide grads by
+    the scale the loss was multiplied by, not the newly doubled one."""
+    import numpy as np
+
+    from incubator_mxnet_tpu import autograd, gluon, nd
+    from incubator_mxnet_tpu.contrib import amp
+
+    def run(scale_window):
+        mx.random.seed(0)
+        net = gluon.nn.Dense(1, use_bias=False, in_units=2)
+        net.initialize(init=mx.init.One())
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1})
+        scaler = amp.LossScaler(init_scale=4.0, scale_window=scale_window)
+        trainer._amp_loss_scaler = scaler
+        x = nd.array(np.ones((1, 2), np.float32))
+        with autograd.record():
+            loss = (net(x).sum()) * scaler.loss_scale
+        loss.backward()
+        trainer.step(1)
+        return np.asarray(net.weight.data().asnumpy())
+
+    # window=1: scale doubles right after this step; weights must still
+    # match a huge-window run where the scale stays put
+    w_doubling = run(scale_window=1)
+    w_stable = run(scale_window=1000)
+    np.testing.assert_allclose(w_doubling, w_stable, rtol=1e-6)

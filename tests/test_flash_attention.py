@@ -102,3 +102,28 @@ def test_flash_inside_jit():
     val = f(q, k, v)
     ref = attention_reference(q, k, v).sum()
     np.testing.assert_allclose(val, ref, rtol=1e-5)
+
+
+def test_flash_causal_empty_rows():
+    """kv_len < q_len (causal): leading q rows have ZERO unmasked keys.
+    Output must be 0 there (not mean(V)) and gradients must stay finite."""
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.uniform(-1, 1, (1, 2, 8, 8)).astype(np.float32))
+    k = jnp.asarray(rng.uniform(-1, 1, (1, 2, 4, 8)).astype(np.float32))
+    v = jnp.asarray(rng.uniform(-1, 1, (1, 2, 4, 8)).astype(np.float32))
+    out = flash_attention(q, k, v, causal=True, block_q=4, block_k=4)
+    # offset = klen - qlen = -4: rows 0..3 see no keys at all
+    np.testing.assert_allclose(np.asarray(out[:, :, :4]), 0.0, atol=1e-6)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out[:, :, 4:]),
+                               np.asarray(ref[:, :, 4:]), rtol=1e-5, atol=1e-5)
+
+    def f(q, k, v):
+        return (flash_attention(q, k, v, causal=True,
+                                block_q=4, block_k=4) ** 2).sum()
+
+    dq, dk, dv = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    for g in (dq, dk, dv):
+        assert np.isfinite(np.asarray(g)).all()
+    # empty rows contribute nothing to dq
+    np.testing.assert_allclose(np.asarray(dq[:, :, :4]), 0.0, atol=1e-6)
