@@ -38,16 +38,24 @@ class Context:
         return self.devstr2type[self.device_type]
 
     def jax_device(self) -> Optional[jax.Device]:
-        """Resolve to a concrete jax.Device (None => let JAX pick default)."""
+        """Resolve to a concrete jax.Device (None => let JAX pick default).
+
+        Uses *local* (process-addressable) devices: under multi-process
+        distributed training ``jax.devices()`` includes peers' devices,
+        which this process cannot place data on.
+        """
         if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
-            devs = [d for d in jax.devices() if d.platform == "cpu"]
+            devs = [d for d in jax.local_devices() if d.platform == "cpu"]
             if not devs:
                 try:
-                    devs = jax.devices("cpu")
+                    devs = [d for d in jax.devices("cpu")
+                            if d.process_index == jax.process_index()]
                 except RuntimeError:
                     return None
+                if not devs:
+                    return None
         else:
-            devs = [d for d in jax.devices() if d.platform != "cpu"]
+            devs = [d for d in jax.local_devices() if d.platform != "cpu"]
             if not devs:  # CPU-only host: tpu context falls back to default device
                 return None
         return devs[self.device_id % len(devs)]
@@ -111,9 +119,10 @@ def current_context() -> Context:
 
 
 def num_devices(device_type: str = "tpu") -> int:
+    """Count of process-local devices (reference num_gpus counts local)."""
     if device_type in ("tpu", "gpu"):
-        return len([d for d in jax.devices() if d.platform != "cpu"])
-    return len([d for d in jax.devices() if d.platform == "cpu"]) or 1
+        return len([d for d in jax.local_devices() if d.platform != "cpu"])
+    return len([d for d in jax.local_devices() if d.platform == "cpu"]) or 1
 
 
 def num_gpus() -> int:  # parity: mx.context.num_gpus

@@ -1,4 +1,6 @@
 """``mx.kv`` — KVStore (python/mxnet/kvstore parity)."""
+from .dist import DistKVStore, init_process_group, is_initialized
 from .kvstore import KVStore, KVStoreBase, create
 
-__all__ = ["KVStore", "KVStoreBase", "create"]
+__all__ = ["KVStore", "KVStoreBase", "DistKVStore", "create",
+           "init_process_group", "is_initialized"]

@@ -1,0 +1,165 @@
+"""Multi-process distributed KVStore (the ps-lite backend analog).
+
+Reference model: ``src/kvstore/kvstore_dist.h:44`` (worker push/pull via
+ps-lite) + ``kvstore_dist_server.h:155`` (server aggregates worker pushes
+and optionally applies the optimizer — ``ApplyUpdates:346``), launched by
+``tools/launch.py`` which sets the ``DMLC_*`` rendezvous environment.
+
+TPU-native model: there are no parameter servers.  Workers rendezvous via
+``jax.distributed.initialize`` (coordinator = the reference's
+``DMLC_PS_ROOT_URI:PORT``), and the "server state" is a replica kept
+bitwise-identical in every process: each push cross-process-sums the
+(optionally 2-bit-compressed) gradient with a deterministic rank-ordered
+reduction, then every process applies the identical update to its replica.
+Collectives ride XLA's distributed runtime (Gloo on CPU hosts, ICI/DCN
+collectives on TPU pods) instead of ps-lite ZMQ.
+
+Env contract (same names the reference launcher exports):
+  DMLC_PS_ROOT_URI   coordinator host
+  DMLC_PS_ROOT_PORT  coordinator port
+  DMLC_NUM_WORKER    world size
+  DMLC_WORKER_ID     this process's rank
+"""
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .kvstore import KVStore
+
+__all__ = ["DistKVStore", "init_process_group", "is_initialized"]
+
+_INITIALIZED = False
+
+
+def _env_world() -> int:
+    return int(os.environ.get("DMLC_NUM_WORKER", "1"))
+
+
+def is_initialized() -> bool:
+    return _INITIALIZED
+
+
+def init_process_group(coordinator: Optional[str] = None,
+                       num_workers: Optional[int] = None,
+                       rank: Optional[int] = None) -> int:
+    """Rendezvous this process with its peers (idempotent).
+
+    Arguments default to the ``DMLC_*`` environment exported by
+    ``tools/launch.py`` (reference ``tools/launch.py:71-113`` contract).
+    Returns the world size.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return jax.process_count()
+    num_workers = num_workers if num_workers is not None else _env_world()
+    if num_workers <= 1:
+        # no rendezvous needed/possible — deliberately do NOT latch
+        # _INITIALIZED, so a later call with a real world size still works
+        return 1
+    if coordinator is None:
+        uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        port = os.environ.get("DMLC_PS_ROOT_PORT", "9091")
+        coordinator = "%s:%s" % (uri, port)
+    rank = rank if rank is not None else int(
+        os.environ.get("DMLC_WORKER_ID", "0"))
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_workers, process_id=rank)
+    _INITIALIZED = True
+    return num_workers
+
+
+class DistKVStore(KVStore):
+    """dist_sync / dist_sync_device / dist_async over jax.distributed.
+
+    With a launcher environment (``DMLC_NUM_WORKER`` > 1) every push is a
+    cross-process sum and every replica stays bitwise identical; without
+    one it degrades to a single-worker store with a loud warning (the
+    reference would hang waiting for a scheduler instead).
+
+    NOTE on ``dist_async``: there is no parameter server to absorb
+    asynchronous pushes, so async types run with *synchronous* collective
+    semantics here — every rank must make the same sequence of push/init
+    calls.  Workers taking different numbers of steps would block in the
+    collective; pad or truncate epochs to equal length (the same
+    requirement jax/pmap-style SPMD training always has).
+    """
+
+    def __init__(self, kv_type="dist_sync"):
+        super().__init__(kv_type)
+        if _env_world() > 1 or is_initialized():
+            init_process_group()
+        else:
+            warnings.warn(
+                "KVStore type %r created without a launcher environment "
+                "(DMLC_NUM_WORKER unset or 1) — running single-worker. "
+                "Launch with tools/launch.py -n <N> for real distributed "
+                "training." % kv_type, stacklevel=3)
+
+    # ------------------------------------------------------------------
+    @property
+    def rank(self) -> int:
+        return jax.process_index() if jax.process_count() > 1 else 0
+
+    @property
+    def num_workers(self) -> int:
+        return jax.process_count()
+
+    # ------------------------------------------------------------------
+    def _cross_process_sum(self, arr: jax.Array) -> jax.Array:
+        """Deterministic rank-ordered sum across all workers."""
+        if self.num_workers == 1:
+            return arr
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(arr)
+        out = jnp.asarray(gathered[0])
+        for i in range(1, gathered.shape[0]):
+            out = out + gathered[i]
+        return out.astype(arr.dtype)
+
+    def _reduce_after_compress(self, key, arr):
+        """Hook consumed by KVStore.push between (local merge + compress)
+        and the store/updater — the worker→server wire of kvstore_dist.h.
+        Decompression is identity for 2-bit (values are already ternary
+        floats), so summing the compressed payloads matches the reference
+        server's decompress-then-accumulate.  Sparse gradients are
+        densified first: every rank must see the identical global sum."""
+        from ..ndarray.sparse import BaseSparseNDArray
+
+        if isinstance(arr, BaseSparseNDArray):
+            arr = arr.todense()._data
+        return self._cross_process_sum(arr)
+
+    def init(self, key, value):
+        """Rank 0's initial value wins everywhere (the reference worker-0
+        push-init to the server, kvstore_dist.h:126)."""
+        super().init(key, value)
+        if self.num_workers == 1:
+            return
+        from jax.experimental import multihost_utils
+
+        keys, _ = self._norm_keys_vals(key, value)
+        for k in keys:
+            self._store[k]._data = jnp.asarray(
+                multihost_utils.broadcast_one_to_all(self._store[k]._data))
+
+    def barrier(self):
+        """Real global barrier across workers (kvstore_dist.h Barrier)."""
+        super().barrier()  # drain local async work first
+        if self.num_workers > 1:
+            from jax.experimental import multihost_utils
+
+            self._barrier_count = getattr(self, "_barrier_count", 0) + 1
+            multihost_utils.sync_global_devices(
+                "kvstore_barrier_%d" % self._barrier_count)
+
+    def _send_command_to_servers(self, head, body):
+        """No servers exist; commands are meaningless. Barrier for parity
+        with the reference's synchronous command round-trip."""
+        self.barrier()

@@ -57,6 +57,10 @@ def create(name="local") -> "KVStore":
         raise MXNetError("unknown KVStore type %r" % name)
     if name.lower() in KVStoreBase._registry:
         return KVStoreBase._registry[name.lower()]()
+    if name.startswith("dist"):
+        from .dist import DistKVStore
+
+        return DistKVStore(name)
     return KVStore(name)
 
 
@@ -148,6 +152,7 @@ class KVStore:
             if getattr(self, "_compressor", None) is not None \
                     and not isinstance(merged, BaseSparseNDArray):
                 merged = self._compressor.compress(k, merged)
+            merged = self._reduce_after_compress(k, merged)
             if isinstance(merged, BaseSparseNDArray):
                 if k not in self._store:
                     # match the dense path: an un-init'd key starts at zero
@@ -207,6 +212,12 @@ class KVStore:
             for t in targets:
                 t._data = jnp.zeros_like(t._data).at[idx].set(rows)
         return out
+
+    def _reduce_after_compress(self, key, arr):
+        """Cross-worker reduction hook; identity for local stores (the
+        dist subclass sums across processes here). ``arr`` may be a raw
+        jax array or a sparse NDArray (dist densifies the latter)."""
+        return arr
 
     # ------------------------------------------------------------------
     @staticmethod

@@ -1,0 +1,92 @@
+"""Worker body for the multi-process dist-kvstore tests.
+
+Ports the reference's exact-equality sync checks
+(tests/nightly/dist_sync_kvstore.py:30-40) to the jax.distributed
+backend: each rank runs this script under tools/launch.py, does
+rank-dependent pushes, and dumps what it observed to <outdir>/rank<r>.npz
+for the parent test to assert on (cross-rank bitwise equality included).
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np
+
+
+def main():
+    outdir = sys.argv[1]
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import kv, nd
+
+    store = kv.create("dist_sync")
+    rank, nw = store.rank, store.num_workers
+    assert nw == int(os.environ["DMLC_NUM_WORKER"]), (nw, os.environ)
+
+    # --- init: rank 0's value wins everywhere --------------------------
+    store.init("w", nd.full((4, 3), rank + 7.0))
+    got_init = nd.zeros((4, 3))
+    store.pull("w", out=got_init)
+
+    # --- push: cross-worker exact sum (dist_sync_kvstore.py check) -----
+    store.push("w", nd.full((4, 3), float(rank + 1)))
+    got_sum = nd.zeros((4, 3))
+    store.pull("w", out=got_sum)
+
+    # --- update_on_kvstore: identical sgd updates everywhere -----------
+    opt_store = kv.create("dist_sync")
+    opt_store.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    opt_store.init(3, nd.ones((5, 2)))
+    grad = nd.full((5, 2), float(rank + 1))
+    opt_store.push(3, grad)
+    got_opt = nd.zeros((5, 2))
+    opt_store.pull(3, out=got_opt)
+
+    # --- 2-bit compression with error feedback -------------------------
+    c_store = kv.create("dist_sync")
+    c_store.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    c_store.init("c", nd.zeros((6,)))
+    # push 1: rank r sends 0.3*(r+1) → ternary {0, 0.5}; residual kept
+    c_store.push("c", nd.full((6,), 0.3 * (rank + 1)))
+    got_c1 = nd.zeros((6,))
+    c_store.pull("c", out=got_c1)
+    # push 2: same raw grad + residual crosses threshold differently
+    c_store.push("c", nd.full((6,), 0.3 * (rank + 1)))
+    got_c2 = nd.zeros((6,))
+    c_store.pull("c", out=got_c2)
+
+    # --- end-to-end: gluon Trainer over the dist store -----------------
+    from incubator_mxnet_tpu import autograd, gluon
+
+    mx.random.seed(0)  # same init everywhere; data differs per rank
+    net = gluon.nn.Dense(2, in_units=3)
+    net.initialize(init=mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05},
+                            kvstore=kv.create("dist_sync"))
+    loss_fn = gluon.loss.L2Loss()
+    rs = np.random.RandomState(100 + rank)
+    for _ in range(3):
+        x = nd.array(rs.uniform(-1, 1, (4, 3)).astype(np.float32))
+        y = nd.array(rs.uniform(-1, 1, (4, 2)).astype(np.float32))
+        with autograd.record():
+            loss = loss_fn(net(x), y).mean()
+        loss.backward()
+        trainer.step(4)
+    trained_w = net.weight.data().asnumpy()
+
+    store.barrier()
+    np.savez(os.path.join(outdir, "rank%d.npz" % rank),
+             init=got_init.asnumpy(), sum=got_sum.asnumpy(),
+             opt=got_opt.asnumpy(), c1=got_c1.asnumpy(),
+             c2=got_c2.asnumpy(), trained_w=trained_w,
+             rank=np.int32(rank), nw=np.int32(nw))
+    print("worker %d/%d ok" % (rank, nw), flush=True)
+
+
+if __name__ == "__main__":
+    main()

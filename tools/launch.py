@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Local distributed-training launcher (reference ``tools/launch.py``).
+
+Spawns N worker processes on this host with the ``DMLC_*`` rendezvous
+environment the dist KVStore consumes (reference contract:
+``tools/launch.py:71-113``; there are no separate scheduler/server roles —
+workers rendezvous directly via jax.distributed, so ``-s`` is accepted for
+CLI parity and ignored).
+
+Usage::
+
+    python tools/launch.py -n 4 python train.py --kv-store dist_sync
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch_local(num_workers: int, command, port: int | None = None,
+                 extra_env=None, grace: float = 20.0) -> int:
+    """Spawn ``command`` num_workers times; return first nonzero exit.
+
+    If any worker dies with a nonzero code, the survivors (likely blocked
+    in a collective waiting for the dead peer) are terminated after
+    ``grace`` seconds instead of hanging the launcher forever.
+    """
+    import time
+
+    port = port or _free_port()
+    procs = []
+    for rank in range(num_workers):
+        env = dict(os.environ)
+        env.update({
+            "DMLC_ROLE": "worker",
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+            "DMLC_NUM_WORKER": str(num_workers),
+            "DMLC_NUM_SERVER": "0",
+            "DMLC_WORKER_ID": str(rank),
+        })
+        if extra_env:
+            env.update(extra_env)
+        procs.append(subprocess.Popen(command, env=env))
+
+    rc = 0
+    failed_at = None
+    while True:
+        live = [p for p in procs if p.poll() is None]
+        rc = rc or next((p.returncode for p in procs
+                         if p.returncode not in (None, 0)), 0)
+        if not live:
+            break
+        if rc and failed_at is None:
+            failed_at = time.monotonic()
+        if failed_at is not None and time.monotonic() - failed_at > grace:
+            for p in live:
+                p.terminate()
+            for p in live:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            break
+        time.sleep(0.2)
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Launch a distributed job on this host.")
+    ap.add_argument("-n", "--num-workers", type=int, required=True,
+                    help="number of worker processes")
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="accepted for reference-CLI parity; ignored "
+                         "(no parameter servers)")
+    ap.add_argument("--launcher", default="local",
+                    choices=["local"],
+                    help="only local (single-host multi-process) here; "
+                         "multi-host uses your cluster scheduler + "
+                         "DMLC_* env directly")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="training command to run")
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("no command given")
+    return launch_local(args.num_workers, args.command)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
