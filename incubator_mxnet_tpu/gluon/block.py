@@ -419,6 +419,37 @@ class HybridBlock(Block):
     def hybrid_forward(self, F, x, *args, **kwargs):  # noqa: N803
         raise NotImplementedError
 
+    def shape_init(self, *input_shapes, dtype="float32"):
+        """Finish deferred parameter init by tracing the forward abstractly.
+
+        Runs one forward under ``jax.eval_shape`` — no FLOPs and no per-op
+        compilation — which triggers each layer's deferred-shape resolution
+        exactly like the reference's first-real-batch deferred init
+        (``python/mxnet/gluon/block.py:688``) but in milliseconds instead of
+        a full eager device pass.  Initializers still run eagerly on the
+        resolved concrete shapes.  Inference mode: no aux state (BN running
+        stats) is touched.
+        """
+        from .parameter import shape_only_init
+
+        specs = [jax.ShapeDtypeStruct(tuple(s), jnp.dtype(dtype))
+                 for s in input_shapes]
+
+        def probe(*vals):
+            with autograd.pause():
+                out = self._forward_impl(*[NDArray(v) for v in vals])
+            flat, _ = jax.tree.flatten(
+                out, is_leaf=lambda o: isinstance(o, NDArray))
+            return [o._data if isinstance(o, NDArray) else o for o in flat]
+
+        with shape_only_init():
+            jax.eval_shape(probe, *specs)
+        # shapes are now resolved; run all real initializers in one program
+        from .parameter import _bulk_materialize
+
+        _bulk_materialize(list(self.collect_params().values()))
+        return self
+
     def export(self, path, epoch=0):
         """Export to symbol-json + params files (block.py:1080 parity)."""
         from .. import symbol as sym_mod

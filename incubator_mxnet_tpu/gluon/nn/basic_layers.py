@@ -165,11 +165,15 @@ class BatchNorm(HybridBlock):
                           axis=self._axis)
         if _opsnn._is_train() and not self._use_global_stats:
             with autograd.pause():
-                mean, var = F.batch_norm_stats(x, axis=self._axis)
-                m = self._momentum
-                new_mean = m * running_mean + (1 - m) * mean
-                new_var = m * running_var + (1 - m) * var
-                self._commit_running(new_mean, new_var)
+                # shared running-stat formula (ops.nn._batch_norm_aux_update)
+                # — identical math on the Gluon, TrainStep and Executor paths
+                upd = _opsnn._batch_norm_aux_update(
+                    [x._data, None, None, running_mean._data,
+                     running_var._data], None,
+                    momentum=self._momentum, axis=self._axis)
+                from ...ndarray import NDArray as _ND
+
+                self._commit_running(_ND(upd[3]), _ND(upd[4]))
         return out
 
     def _commit_running(self, new_mean, new_var):

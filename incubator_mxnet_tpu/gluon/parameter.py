@@ -25,6 +25,31 @@ class DeferredInitializationError(Exception):
     """Parameter accessed before shape is known (parameter.py parity)."""
 
 
+import contextlib
+import threading
+
+_SHAPE_ONLY = threading.local()
+
+
+def _shape_only_mode() -> bool:
+    return getattr(_SHAPE_ONLY, "on", False)
+
+
+@contextlib.contextmanager
+def shape_only_init():
+    """Within this scope, deferred init only RESOLVES shapes: ``data()``
+    returns an abstract zeros placeholder and the real initializer is NOT
+    run.  Used by ``HybridBlock.shape_init`` to finish deferred shapes under
+    ``jax.eval_shape`` without leaking tracers into parameter storage or the
+    global PRNG (initializers run eagerly afterwards)."""
+    prev = getattr(_SHAPE_ONLY, "on", False)
+    _SHAPE_ONLY.on = True
+    try:
+        yield
+    finally:
+        _SHAPE_ONLY.on = prev
+
+
 class Parameter:
     def __init__(self, name, grad_req="write", shape=None, dtype="float32",
                  lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
@@ -96,6 +121,8 @@ class Parameter:
         self.shape = tuple(shape)
         if self._deferred_init is None:
             raise DeferredInitializationError(self.name)
+        if _shape_only_mode():
+            return  # shape resolved; real init deferred to after the trace
         init, ctx, default_init = self._deferred_init
         self._finish_init(init, ctx, default_init)
 
@@ -113,6 +140,9 @@ class Parameter:
             return NDArray(tc.bindings[id(self)])
         if self._data is None:
             if self._deferred_init is not None:
+                if _shape_only_mode() and self._shape_known():
+                    # abstract placeholder — only valid inside eval_shape
+                    return NDArray(jnp.zeros(self.shape, np_dtype(self.dtype)))
                 raise DeferredInitializationError(
                     "Parameter %s has not been initialized yet (deferred)"
                     % self.name)
@@ -169,6 +199,59 @@ class Parameter:
 
     def __repr__(self):
         return "Parameter %s (shape=%s, dtype=%s)" % (self.name, self.shape, self.dtype)
+
+
+def _bulk_materialize(params) -> None:
+    """Materialize many pending parameters in ONE jitted program.
+
+    Per-param eager init costs one small XLA compile per (op, shape) pair —
+    ~60s for ResNet-50's ~160 parameters.  Tracing every initializer (and
+    grad-buffer zeros) into a single program pays one compile total, and the
+    persistent compilation cache carries it across processes.  Falls back to
+    the per-param eager path if an initializer is not traceable (e.g. one
+    that computes with raw numpy).
+    """
+    import jax
+
+    from .. import initializer as _initmod
+
+    pending = [p for p in params
+               if p._data is None and p._deferred_init is not None
+               and p._shape_known()]
+    if not pending:
+        return
+    recipes = []
+    for p in pending:
+        init, ctx, default_init = p._deferred_init
+        init = init or p.init or default_init or _initmod.Uniform()
+        if isinstance(init, str):
+            init = _initmod.registry_create(init)
+        recipes.append((p, init))
+
+    def make():
+        outs = []
+        for p, init in recipes:
+            data = _nd_mod.zeros(p.shape, dtype=np_dtype(p.dtype))
+            init(_initmod.InitDesc(p.name, attrs={}), data)
+            g = (jnp.zeros(p.shape, np_dtype(p.dtype))
+                 if p._grad_req != "null" else None)
+            outs.append((data._data, g))
+        return outs
+
+    try:
+        outs = jax.jit(make)()
+    except Exception:
+        for p in pending:
+            p._finish_deferred_init(p.shape)
+        return
+    for (p, _init), (v, g) in zip(recipes, outs):
+        p._data = NDArray(v)
+        p._deferred_init = None
+        if p._grad_req != "null":
+            p._grad = NDArray(g)
+            autograd.mark_variables([p._data], [p._grad], [p._grad_req])
+        else:
+            p._grad = None
 
 
 class Constant(Parameter):
@@ -255,9 +338,22 @@ class ParameterDict:
             self._params[k] = v
 
     def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        # batch all known-shape inits into one compiled program; params with
+        # unknown shapes defer exactly as before
+        bulk = []
         for p in self.values():
-            p.initialize(init=None, ctx=ctx, default_init=init,
-                         force_reinit=force_reinit)
+            if p._data is not None:
+                if not force_reinit:
+                    continue
+                p._data = None
+                p._grad = None
+            if p._shape_known():
+                p._deferred_init = (None, ctx, init)
+                bulk.append(p)
+            else:
+                p.initialize(init=None, ctx=ctx, default_init=init,
+                             force_reinit=force_reinit)
+        _bulk_materialize(bulk)
 
     def zero_grad(self):
         for p in self.values():

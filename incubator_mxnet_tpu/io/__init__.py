@@ -1,6 +1,8 @@
 """``mx.io`` — data iterators (python/mxnet/io/io.py parity)."""
 from .io import (DataBatch, DataDesc, DataIter, MXDataIter, NDArrayIter,
                  PrefetchingIter, ResizeIter, CSVIter)
+from .record_iter import ImageRecordIter, LibSVMIter, MNISTIter
 
 __all__ = ["DataBatch", "DataDesc", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "MXDataIter"]
+           "PrefetchingIter", "CSVIter", "MXDataIter", "ImageRecordIter",
+           "MNISTIter", "LibSVMIter"]

@@ -1,0 +1,242 @@
+"""Reference-compatible NDArray binary container (.params files).
+
+Byte-compatible implementation of the reference's named-NDArray blob format
+so checkpoints interoperate with stock MXNet in both directions:
+
+  file   := uint64 kMXAPINDArrayListMagic(0x112) | uint64 reserved(0)
+            | vec<ndarray> | vec<string names>          (ndarray.cc:1831-1857)
+  vec<T> := uint64 count | T...                         (dmlc serializer)
+  string := uint64 len | bytes
+  ndarray(V2/V3) := uint32 magic(0xF993fac9/a) | int32 stype
+            | [storage_shape if sparse] | shape | int32 dev_type
+            | int32 dev_id | int32 type_flag
+            | [int32 aux_type, aux_shape]*nad | raw data | raw aux data
+                                                        (ndarray.cc:1596-1669)
+  shape  := int32 ndim | int64 dim...                   (tuple.h:703-713)
+  legacy V1 (0xF993fac8): shape | ctx | type_flag | data; pre-V1: the
+  "magic" word is ndim followed by uint32 dims          (ndarray.cc:1672-1717)
+
+Storage types: dense=0, row_sparse=1 (aux: int64 row idx), csr=2
+(aux: int64 indptr, int64 indices) — ``include/mxnet/ndarray.h:61``.
+Type flags: f32=0 f64=1 f16=2 u8=3 i32=4 i8=5 i64=6 bool=7
+(``mshadow/base.h:307-314``).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Tuple, Union
+
+import numpy as np
+
+__all__ = ["MAGIC_LIST", "is_legacy_container", "save_legacy", "load_legacy",
+           "load_legacy_buffer"]
+
+MAGIC_LIST = 0x112
+_V1 = 0xF993FAC8
+_V2 = 0xF993FAC9
+_V3 = 0xF993FACA
+
+_FLAG_OF = {np.dtype(np.float32): 0, np.dtype(np.float64): 1,
+            np.dtype(np.float16): 2, np.dtype(np.uint8): 3,
+            np.dtype(np.int32): 4, np.dtype(np.int8): 5,
+            np.dtype(np.int64): 6, np.dtype(np.bool_): 7}
+_DTYPE_OF = {v: k for k, v in _FLAG_OF.items()}
+
+_KCPU = 1
+
+
+def is_legacy_container(head: bytes) -> bool:
+    return len(head) >= 8 and struct.unpack("<Q", head[:8])[0] == MAGIC_LIST
+
+
+class _Writer:
+    def __init__(self):
+        self.parts: List[bytes] = []
+
+    def u32(self, v):
+        self.parts.append(struct.pack("<I", v))
+
+    def i32(self, v):
+        self.parts.append(struct.pack("<i", v))
+
+    def u64(self, v):
+        self.parts.append(struct.pack("<Q", v))
+
+    def shape(self, shp):
+        self.parts.append(struct.pack("<i", len(shp)))
+        self.parts.append(np.asarray(shp, "<i8").tobytes())
+
+    def raw(self, b):
+        self.parts.append(b)
+
+    def getvalue(self):
+        return b"".join(self.parts)
+
+
+class _Reader:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def _take(self, n):
+        if self.pos + n > len(self.buf):
+            raise ValueError("truncated NDArray container")
+        b = self.buf[self.pos: self.pos + n]
+        self.pos += n
+        return b
+
+    def u32(self):
+        return struct.unpack("<I", self._take(4))[0]
+
+    def i32(self):
+        return struct.unpack("<i", self._take(4))[0]
+
+    def u64(self):
+        return struct.unpack("<Q", self._take(8))[0]
+
+    def shape(self, ndim=None, dim_dtype="<i8"):
+        if ndim is None:
+            ndim = self.i32()
+        itemsize = np.dtype(dim_dtype).itemsize
+        return tuple(int(x) for x in
+                     np.frombuffer(self._take(itemsize * ndim), dim_dtype))
+
+    def raw(self, n):
+        return self._take(n)
+
+
+def _write_one(w: _Writer, arr) -> None:
+    """Serialize one array (dense NDArray or CSR/RowSparse) as V2."""
+    from .ndarray import NDArray
+    from .sparse import CSRNDArray, RowSparseNDArray
+
+    w.u32(_V2)
+    if isinstance(arr, CSRNDArray):
+        data = np.asarray(arr.data.asnumpy())
+        indices = np.asarray(arr.indices.asnumpy(), np.int64)
+        indptr = np.asarray(arr.indptr.asnumpy(), np.int64)
+        w.i32(2)  # kCSRStorage
+        w.shape(data.shape)              # storage shape
+        w.shape(arr.shape)
+        w.i32(_KCPU)
+        w.i32(0)
+        w.i32(_FLAG_OF[np.dtype(data.dtype)])
+        w.i32(6)                          # aux 0: indptr int64
+        w.shape(indptr.shape)
+        w.i32(6)                          # aux 1: indices int64
+        w.shape(indices.shape)
+        w.raw(np.ascontiguousarray(data).tobytes())
+        w.raw(indptr.tobytes())
+        w.raw(indices.tobytes())
+        return
+    if isinstance(arr, RowSparseNDArray):
+        data = np.asarray(arr.data.asnumpy())
+        indices = np.asarray(arr.indices.asnumpy(), np.int64)
+        w.i32(1)  # kRowSparseStorage
+        w.shape(data.shape)
+        w.shape(arr.shape)
+        w.i32(_KCPU)
+        w.i32(0)
+        w.i32(_FLAG_OF[np.dtype(data.dtype)])
+        w.i32(6)
+        w.shape(indices.shape)
+        w.raw(np.ascontiguousarray(data).tobytes())
+        w.raw(indices.tobytes())
+        return
+    npv = arr.asnumpy() if isinstance(arr, NDArray) else np.asarray(arr)
+    if np.dtype(npv.dtype) not in _FLAG_OF:
+        npv = npv.astype(np.float32)
+    w.i32(0)  # kDefaultStorage
+    w.shape(npv.shape)
+    w.i32(_KCPU)
+    w.i32(0)
+    w.i32(_FLAG_OF[np.dtype(npv.dtype)])
+    w.raw(np.ascontiguousarray(npv).tobytes())
+
+
+def _read_one(r: _Reader):
+    from .ndarray import array as nd_array
+    from .sparse import csr_matrix, row_sparse_array
+
+    magic = r.u32()
+    if magic in (_V2, _V3):
+        stype = r.i32()
+        nad = {0: 0, 1: 1, 2: 2}.get(stype)
+        if nad is None:
+            raise ValueError("unknown storage type %d" % stype)
+        sshape = r.shape() if nad else None
+        shape = r.shape()
+        if len(shape) == 0:
+            return nd_array(np.zeros((0,), np.float32))
+        r.i32()  # dev_type
+        r.i32()  # dev_id
+        flag = r.i32()
+        dt = _DTYPE_OF[flag]
+        aux = []
+        for _ in range(nad):
+            aflag = r.i32()
+            ashape = r.shape()
+            aux.append((_DTYPE_OF[aflag], ashape))
+        n = int(np.prod(sshape if nad else shape)) if (sshape or shape) else 0
+        data = np.frombuffer(r.raw(n * dt.itemsize), dt).reshape(
+            sshape if nad else shape)
+        aux_vals = []
+        for adt, ashape in aux:
+            cnt = int(np.prod(ashape)) if ashape else 0
+            aux_vals.append(np.frombuffer(
+                r.raw(cnt * adt.itemsize), adt).reshape(ashape))
+        if stype == 0:
+            return nd_array(data)
+        if stype == 1:
+            return row_sparse_array((data, aux_vals[0]), shape=shape)
+        return csr_matrix((data.reshape(-1), aux_vals[1], aux_vals[0]),
+                          shape=shape)
+    # legacy paths (ndarray.cc:1672 LegacyLoad)
+    if magic == _V1:
+        shape = r.shape()
+    else:
+        shape = r.shape(ndim=magic, dim_dtype="<u4")
+    if len(shape) == 0:
+        return nd_array(np.zeros((0,), np.float32))
+    r.i32()
+    r.i32()
+    flag = r.i32()
+    dt = _DTYPE_OF[flag]
+    n = int(np.prod(shape))
+    data = np.frombuffer(r.raw(n * dt.itemsize), dt).reshape(shape)
+    return nd_array(data)
+
+
+def save_legacy(data, names=None) -> bytes:
+    w = _Writer()
+    w.u64(MAGIC_LIST)
+    w.u64(0)
+    w.u64(len(data))
+    for arr in data:
+        _write_one(w, arr)
+    names = names or []
+    w.u64(len(names))
+    for n in names:
+        b = n.encode()
+        w.u64(len(b))
+        w.raw(b)
+    return w.getvalue()
+
+
+def load_legacy_buffer(buf: bytes):
+    r = _Reader(buf)
+    if r.u64() != MAGIC_LIST:
+        raise ValueError("not an NDArray container (bad magic)")
+    r.u64()  # reserved
+    n = r.u64()
+    arrays = [_read_one(r) for _ in range(n)]
+    n_names = r.u64()
+    names = [r.raw(r.u64()).decode() for _ in range(n_names)]
+    if names:
+        return dict(zip(names, arrays))
+    return arrays
+
+
+def load_legacy(fname: str):
+    with open(fname, "rb") as f:
+        return load_legacy_buffer(f.read())

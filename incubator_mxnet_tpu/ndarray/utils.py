@@ -2,17 +2,25 @@
 
 Parity: ``NDArray::Save/Load`` (``src/ndarray/ndarray.cc:1596,1719``) and
 ``mx.nd.save/load`` — a file holding a list of arrays or a dict of named
-arrays.  Format here is a single ``.npz``-style zip with a manifest entry
-(`__mx_tpu_format__`) recording list-vs-dict; readable with plain numpy.
+arrays.
+
+Two on-disk formats:
+- **reference format** (default for ``save``): the stock MXNet versioned-
+  magic named-NDArray blob (``legacy_io.py``; magic 0x112 + NDARRAY_V2) —
+  checkpoints interoperate with stock MXNet in both directions;
+- **npz**: an ``.npz`` zip with a manifest entry (rounds 1-2 format);
+  ``load`` sniffs the first bytes and accepts both.
 """
 from __future__ import annotations
 
 import json
+import struct
 import zipfile
 from typing import Dict, List, Union
 
 import numpy as np
 
+from . import legacy_io
 from .ndarray import NDArray, array
 
 __all__ = ["save", "load", "load_frombuffer"]
@@ -20,18 +28,38 @@ __all__ = ["save", "load", "load_frombuffer"]
 _FORMAT_KEY = "__mx_tpu_format__"
 
 
-def save(fname: str, data) -> None:
+def save(fname: str, data, format="params") -> None:  # noqa: A002
+    """Save arrays; ``format='params'`` (default) writes the reference
+    binary container, ``format='npz'`` the numpy container."""
     if isinstance(data, NDArray):
         data = [data]
+    if format == "npz":
+        return _save_npz(fname, data)
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = list(data.values())
+    elif isinstance(data, (list, tuple)):
+        names = None
+        arrays = list(data)
+    else:
+        raise ValueError("data must be NDArray, list of NDArrays, or dict")
+    buf = legacy_io.save_legacy(arrays, names)
+    with open(fname, "wb") as f:
+        f.write(buf)
+
+
+def _save_npz(fname: str, data) -> None:
     if isinstance(data, dict):
         manifest = {"kind": "dict", "names": list(data.keys())}
-        arrays = {("v%d" % i): v.asnumpy() for i, (k, v) in enumerate(data.items())}
+        arrays = {("v%d" % i): v.asnumpy()
+                  for i, (k, v) in enumerate(data.items())}
     elif isinstance(data, (list, tuple)):
         manifest = {"kind": "list", "names": None}
         arrays = {("v%d" % i): v.asnumpy() for i, v in enumerate(data)}
     else:
         raise ValueError("data must be NDArray, list of NDArrays, or dict")
-    arrays[_FORMAT_KEY] = np.frombuffer(json.dumps(manifest).encode(), dtype=np.uint8)
+    arrays[_FORMAT_KEY] = np.frombuffer(json.dumps(manifest).encode(),
+                                        dtype=np.uint8)
     np.savez(fname if fname.endswith(".npz") else fname, **arrays)
     # np.savez appends .npz; rename back for exact-name parity
     import os
@@ -41,8 +69,16 @@ def save(fname: str, data) -> None:
 
 
 def load(fname: str) -> Union[List[NDArray], Dict[str, NDArray]]:
+    with open(fname, "rb") as f:
+        head = f.read(8)
+    if legacy_io.is_legacy_container(head):
+        return legacy_io.load_legacy(fname)
     with np.load(fname, allow_pickle=False) as z:
         files = dict(z)
+    return _from_npz_files(files)
+
+
+def _from_npz_files(files):
     manifest = json.loads(bytes(files.pop(_FORMAT_KEY)).decode())
     n = len(files)
     vals = [array(files["v%d" % i]) for i in range(n)]
@@ -54,11 +90,9 @@ def load(fname: str) -> Union[List[NDArray], Dict[str, NDArray]]:
 def load_frombuffer(buf: bytes):
     import io
 
+    if legacy_io.is_legacy_container(bytes(buf[:8])):
+        return legacy_io.load_legacy_buffer(bytes(buf))
     bio = io.BytesIO(buf)
     with np.load(bio, allow_pickle=False) as z:
         files = dict(z)
-    manifest = json.loads(bytes(files.pop(_FORMAT_KEY)).decode())
-    vals = [array(files["v%d" % i]) for i in range(len(files))]
-    if manifest["kind"] == "dict":
-        return dict(zip(manifest["names"], vals))
-    return vals
+    return _from_npz_files(files)

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from .registry import register
+from .registry import OPS, register
 
 
 def _is_train():
@@ -216,6 +216,27 @@ def _batch_norm_stats(data, axis=1):
     red_axes = tuple(i for i in range(data.ndim) if i != axis)
     x = data.astype(jnp.float32)
     return jnp.mean(x, axis=red_axes), jnp.var(x, axis=red_axes)
+
+
+def _batch_norm_aux_update(in_vals, out_vals, momentum=0.9, axis=1,
+                           use_global_stats=False, **_):
+    """Running-stat update for BatchNorm's mutated inputs (moving_mean=3,
+    moving_var=4) — the single source of the momentum math shared by the
+    gluon layer, TrainStep and the symbolic Executor
+    (``src/operator/nn/batch_norm.cc`` stateful forward)."""
+    if use_global_stats and str(use_global_stats).lower() != "false":
+        return {}
+    mean, var = _batch_norm_stats(in_vals[0], axis=int(axis))
+    m = float(momentum)
+    old_m, old_v = in_vals[3], in_vals[4]
+    return {3: (m * old_m.astype(jnp.float32)
+                + (1 - m) * mean).astype(old_m.dtype),
+            4: (m * old_v.astype(jnp.float32)
+                + (1 - m) * var).astype(old_v.dtype)}
+
+
+OPS["BatchNorm"].aux_update = _batch_norm_aux_update
+OPS["BatchNorm"].mutate_idx = (3, 4)
 
 
 @register("LayerNorm", aliases=("layer_norm",))

@@ -42,11 +42,17 @@ class Op:
         PRNG (eager) or trace key (compiled); mirrors ResourceRequest::kRandom.
     mutate_idx : tuple — indices of inputs the reference op mutates
         (FMutateInputs); kept as metadata for executor aliasing/donation.
+    aux_update : callable(in_vals, out_vals, **attrs) -> {input_idx: new_val}
+        or None — functional form of the reference's FMutateInputs side
+        effects: given the op's traced inputs/outputs, returns replacement
+        values for the mutated inputs (e.g. BatchNorm running stats).  The
+        symbolic Executor and any whole-graph trace commit these through the
+        generic aux-write channel; eager frontends commit them directly.
     """
 
     def __init__(self, name, fn, num_inputs=None, num_outputs=1,
                  differentiable=True, needs_rng=False, mutate_idx=(),
-                 aliases=(), doc=""):
+                 aliases=(), doc="", aux_update=None):
         self.name = name
         self.fn = fn
         self.num_inputs = num_inputs
@@ -56,6 +62,7 @@ class Op:
         self.mutate_idx = tuple(mutate_idx)
         self.aliases = tuple(aliases)
         self.doc = doc or (fn.__doc__ or "")
+        self.aux_update = aux_update
 
     def __repr__(self):
         return "Op(%s)" % self.name

@@ -48,7 +48,11 @@ def next_key() -> jax.Array:
         return tc.next_key()
     global _KEY
     with _LOCK:
-        _KEY, sub = jax.random.split(_key())
+        # split eagerly even if called inside a jax trace (e.g. eval_shape
+        # during HybridBlock.shape_init) so the global state never captures
+        # a tracer; the drawn key enters the trace as a constant.
+        with jax.ensure_compile_time_eval():
+            _KEY, sub = jax.random.split(_key())
     return sub
 
 

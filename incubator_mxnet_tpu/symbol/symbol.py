@@ -493,8 +493,19 @@ def load(fname: str) -> Symbol:
 
 
 def load_json(json_str: str) -> Symbol:
+    """Load symbol JSON, including stock/legacy MXNet files.
+
+    Upgrade handling (``src/nnvm/legacy_json_util.cc`` analog): op params
+    live under modern ``attrs`` or legacy ``param``; per-node non-op
+    attributes (``lr_mult``, ``ctx_group``, ...) under legacy ``attr`` are
+    preserved separately; ``backward_source_id`` is ignored; ``heads``
+    entries of length 2 or 3 are accepted; multi-output node arity is
+    recovered from the highest referenced output index when the file does
+    not record ``num_outputs``.
+    """
     data = json.loads(json_str)
     nodes: List[_Node] = []
+    max_ref: Dict[int, int] = {}
     for entry in data["nodes"]:
         op = entry.get("op")
         op = None if op in (None, "null") else op
@@ -504,14 +515,25 @@ def load_json(json_str: str) -> Symbol:
         dtype_attr = attrs.pop("__dtype__", None)
         node = _Node(op, entry["name"], attrs,
                      num_outputs=entry.get("num_outputs", 1))
+        node_attr = entry.get("attr")
+        if isinstance(node_attr, dict):
+            node._attr_dict.update(node_attr)
         if shape_attr is not None:
             node.attrs["__shape__"] = tuple(shape_attr)
         if dtype_attr is not None:
             node.attrs["__dtype__"] = dtype_attr
         for inp in entry.get("inputs", []):
             node.inputs.append((nodes[inp[0]], inp[1]))
+            max_ref[inp[0]] = max(max_ref.get(inp[0], 0), inp[1])
         nodes.append(node)
-    heads = data.get("heads", [[len(nodes) - 1, 0, 0]])
+    heads = data.get("heads")
+    if not heads:
+        heads = [[len(nodes) - 1, 0]]
+    for h in heads:
+        max_ref[h[0]] = max(max_ref.get(h[0], 0), h[1])
+    for i, node in enumerate(nodes):
+        if not node.is_var and max_ref.get(i, 0) + 1 > node.num_outputs:
+            node.num_outputs = max_ref[i] + 1
     return Symbol([(nodes[h[0]], h[1]) for h in heads])
 
 

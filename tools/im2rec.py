@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""im2rec: build .lst / .rec(.idx) record files from an image directory.
+
+Capability parity with the reference's ``tools/im2rec.py`` / ``im2rec.cc``:
+  * ``--list`` mode walks an image root, assigns integer labels per
+    subdirectory, and writes ``prefix.lst`` (TSV: index, label..., relpath);
+  * record mode reads a ``.lst`` and packs (optionally re-encoded/resized)
+    images into ``prefix.rec`` + ``prefix.idx`` readable by
+    ``mx.io.ImageRecordIter`` and by stock dmlc-recordio readers
+    (byte-compatible wire format, see ``incubator_mxnet_tpu/recordio.py``).
+
+Multiprocess packing: a worker pool encodes images; the writer thread
+appends in index order.
+"""
+import argparse
+import os
+import random
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".npy")
+
+
+def make_list(args):
+    root = args.root
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    label_of = {c: i for i, c in enumerate(classes)}
+    items = []
+    if classes:
+        for c in classes:
+            for dirpath, _dirs, files in os.walk(os.path.join(root, c)):
+                for f in sorted(files):
+                    if f.lower().endswith(EXTS):
+                        rel = os.path.relpath(os.path.join(dirpath, f), root)
+                        items.append((rel, label_of[c]))
+    else:
+        for dirpath, _dirs, files in os.walk(root):
+            for f in sorted(files):
+                if f.lower().endswith(EXTS):
+                    rel = os.path.relpath(os.path.join(dirpath, f), root)
+                    items.append((rel, 0))
+    if args.shuffle:
+        random.Random(args.seed).shuffle(items)
+    n_test = int(len(items) * args.test_ratio)
+    n_train = int(len(items) * args.train_ratio)
+    chunks = {"": items}
+    if args.test_ratio > 0 or args.train_ratio < 1:
+        chunks = {"_train": items[:n_train],
+                  "_test": items[n_train:n_train + n_test]}
+        if n_train + n_test < len(items):
+            chunks["_val"] = items[n_train + n_test:]
+    for suffix, chunk in chunks.items():
+        path = args.prefix + suffix + ".lst"
+        with open(path, "w") as f:
+            for i, (rel, lab) in enumerate(chunk):
+                f.write("%d\t%f\t%s\n" % (i, float(lab), rel))
+        print("wrote %s (%d items)" % (path, len(chunk)))
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            labels = [float(x) for x in parts[1:-1]]
+            yield idx, labels, parts[-1]
+
+
+def _encode_one(args, root, rel):
+    import numpy as np
+
+    from incubator_mxnet_tpu.recordio import _imencode
+
+    path = os.path.join(root, rel)
+    if path.lower().endswith(".npy"):
+        img = np.load(path)
+    else:
+        from PIL import Image
+
+        img = np.asarray(Image.open(path).convert("RGB"))
+    if args.resize > 0:
+        from PIL import Image
+
+        h, w = img.shape[:2]
+        s = args.resize / min(h, w)
+        img = np.asarray(Image.fromarray(img.astype(np.uint8)).resize(
+            (max(int(round(w * s)), args.resize),
+             max(int(round(h * s)), args.resize)), Image.BILINEAR))
+    fmt = ".npy" if args.pack_npy else (args.encoding or ".jpg")
+    return _imencode(img, quality=args.quality, img_fmt=fmt)
+
+
+def make_record(args):
+    from incubator_mxnet_tpu.recordio import IRHeader, MXIndexedRecordIO, pack
+
+    lst = args.prefix + ".lst" if os.path.isdir(args.root) and \
+        not args.lst else (args.lst or args.prefix + ".lst")
+    rec = MXIndexedRecordIO(args.prefix + ".idx", args.prefix + ".rec", "w")
+    items = list(read_list(lst))
+    pool = ThreadPoolExecutor(max_workers=args.num_thread)
+    bufs = pool.map(lambda it: _encode_one(args, args.root, it[2]), items)
+    n = 0
+    for (idx, labels, _rel), buf in zip(items, bufs):
+        label = labels[0] if len(labels) == 1 else labels
+        header = IRHeader(0, label, idx, 0)
+        rec.write_idx(idx, pack(header, buf))
+        n += 1
+        if n % 1000 == 0:
+            print("packed %d" % n)
+    rec.close()
+    print("wrote %s.rec / %s.idx (%d records)" % (args.prefix, args.prefix, n))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix", help="output prefix (prefix.lst/rec/idx)")
+    ap.add_argument("root", help="image root directory")
+    ap.add_argument("--list", action="store_true",
+                    help="make .lst instead of .rec")
+    ap.add_argument("--lst", default=None, help="existing .lst to pack")
+    ap.add_argument("--resize", type=int, default=-1)
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--encoding", default=".jpg")
+    ap.add_argument("--pack-npy", action="store_true",
+                    help="store raw npy payloads (no PIL needed to read)")
+    ap.add_argument("--num-thread", type=int, default=4)
+    ap.add_argument("--shuffle", type=int, default=1)
+    ap.add_argument("--train-ratio", type=float, default=1.0)
+    ap.add_argument("--test-ratio", type=float, default=0.0)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    if args.list:
+        make_list(args)
+    else:
+        make_record(args)
+
+
+if __name__ == "__main__":
+    main()
