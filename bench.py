@@ -180,7 +180,11 @@ def run_attention(seq=2048, heads=8, head_dim=128, batch=4, iters=20):
     import jax.numpy as jnp
     import numpy as np
 
-    from incubator_mxnet_tpu.parallel import flash_attention as fa
+    import importlib
+
+    # the package re-exports the flash_attention FUNCTION; fetch the module
+    fa = importlib.import_module(
+        "incubator_mxnet_tpu.parallel.flash_attention")
     from incubator_mxnet_tpu.parallel.ring_attention import attention_reference
 
     log("devices: %s" % (jax.devices(),))
@@ -198,6 +202,31 @@ def run_attention(seq=2048, heads=8, head_dim=128, batch=4, iters=20):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-2, atol=2e-3)
     log("flash == reference (rtol 2e-2)")
+
+    # backward: compiled flash bwd kernels vs autodiff of the reference
+    flash_grad = jax.jit(jax.grad(
+        lambda q, k, v: fa.flash_attention(q, k, v, causal=True).sum(),
+        argnums=(0, 1, 2)))
+    t = time.time()
+    dq, dk, dv = flash_grad(q, k, v)
+    jax.block_until_ready((dq, dk, dv))
+    log("flash bwd compile+run %.1fs" % (time.time() - t))
+    ref_grad = jax.jit(jax.grad(
+        lambda q, k, v: attention_reference(q, k, v, causal=True).sum(),
+        argnums=(0, 1, 2)))
+    rdq, rdk, rdv = ref_grad(q, k, v)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(rdq), rtol=5e-2,
+                               atol=5e-3)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(rdk), rtol=5e-2,
+                               atol=5e-3)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(rdv), rtol=5e-2,
+                               atol=5e-3)
+    log("flash bwd == reference autodiff")
+    t = time.time()
+    for _ in range(iters):
+        outs = flash_grad(q, k, v)
+    jax.block_until_ready(outs)
+    log("flash fwd+bwd %.2f ms" % (1e3 * (time.time() - t) / iters))
 
     t = time.time()
     for _ in range(iters):

@@ -1,0 +1,172 @@
+"""Python side of the C ABI (``src/native/c_api.cc``).
+
+The reference exposes 242 ``MXNET_DLL`` functions from libmxnet.so
+(``include/mxnet/c_api.h``) that bindings and serving stacks link against.
+Here the compute runtime IS Python/JAX, so the C ABI is a thin native shim
+that drives this module through the CPython API — handles are Python
+objects, marshalling happens here where it is cheap to write and test.
+
+Each function keeps a primitive-only signature (ints, bytes, lists of
+str/int) so the C side stays mechanical.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from . import symbol as sym_mod
+from .base import np_dtype
+from .ndarray import NDArray
+from .ndarray import ndarray as _nd
+from .ndarray.utils import load as nd_load
+from .ndarray.utils import save as nd_save
+from .ops import registry as _reg
+
+# dtype codes: mshadow/base.h:307-314
+_CODE_OF = {np.dtype(np.float32): 0, np.dtype(np.float64): 1,
+            np.dtype(np.float16): 2, np.dtype(np.uint8): 3,
+            np.dtype(np.int32): 4, np.dtype(np.int8): 5,
+            np.dtype(np.int64): 6, np.dtype(np.bool_): 7}
+_DTYPE_OF = {v: k for k, v in _CODE_OF.items()}
+
+
+# -- NDArray ----------------------------------------------------------------
+
+def ndarray_create(shape: Sequence[int], dtype_code: int) -> NDArray:
+    return _nd.zeros(tuple(shape), dtype=_DTYPE_OF[int(dtype_code)])
+
+
+def ndarray_from_bytes(shape, dtype_code, data: bytes) -> NDArray:
+    arr = np.frombuffer(data, _DTYPE_OF[int(dtype_code)]).reshape(
+        tuple(shape))
+    return _nd.array(arr)
+
+
+def ndarray_sync_copy_from(handle: NDArray, data: bytes) -> None:
+    arr = np.frombuffer(data, handle.dtype).reshape(handle.shape)
+    handle._data = __import__("jax.numpy", fromlist=["asarray"]).asarray(arr)
+
+
+def ndarray_to_bytes(handle: NDArray) -> bytes:
+    return np.ascontiguousarray(handle.asnumpy()).tobytes()
+
+
+def ndarray_shape(handle: NDArray) -> List[int]:
+    return list(handle.shape)
+
+
+def ndarray_dtype(handle: NDArray) -> int:
+    return _CODE_OF[np.dtype(handle.dtype)]
+
+
+def ndarray_save(fname: str, handles, names) -> None:
+    if names:
+        nd_save(fname, dict(zip(names, handles)))
+    else:
+        nd_save(fname, list(handles))
+
+
+def ndarray_load(fname: str):
+    loaded = nd_load(fname)
+    if isinstance(loaded, dict):
+        return list(loaded.values()), list(loaded.keys())
+    return list(loaded), []
+
+
+# -- op registry / imperative invoke ---------------------------------------
+
+def list_op_names() -> List[str]:
+    return _reg.list_ops()
+
+
+def imperative_invoke(op_name: str, inputs, keys, vals):
+    attrs = {}
+    for k, v in zip(keys, vals):
+        attrs[k] = sym_mod.symbol._parse_attr(v)
+    out = _reg.invoke(op_name, list(inputs), **attrs)
+    return out if isinstance(out, list) else [out]
+
+
+# -- Symbol -----------------------------------------------------------------
+
+def symbol_from_json(json_str: str):
+    return sym_mod.load_json(json_str)
+
+
+def symbol_to_json(s) -> str:
+    return s.tojson()
+
+
+def symbol_list_arguments(s) -> List[str]:
+    return list(s.list_arguments())
+
+
+def symbol_list_outputs(s) -> List[str]:
+    return list(s.list_outputs())
+
+
+def symbol_list_aux(s) -> List[str]:
+    return list(s.list_auxiliary_states())
+
+
+# -- Predict API (c_predict_api.h:84-289) -----------------------------------
+
+class Predictor:
+    """Inference-only bound graph (MXPredCreate semantics): symbol JSON +
+    params blob + named input shapes → reusable forward executor."""
+
+    def __init__(self, symbol_json: str, param_bytes: bytes,
+                 input_names, input_shapes):
+        from . import cpu
+        from .ndarray.utils import load_frombuffer
+
+        self._sym = sym_mod.load_json(symbol_json)
+        loaded = load_frombuffer(param_bytes) if param_bytes else {}
+        arg_params, aux_params = {}, {}
+        if isinstance(loaded, dict):
+            for k, v in loaded.items():
+                tp, name = (k.split(":", 1) + [""])[:2] if ":" in k \
+                    else ("arg", k)
+                (arg_params if tp == "arg" else aux_params)[name] = v
+        self._inputs = {n: _nd.zeros(tuple(s))
+                        for n, s in zip(input_names, input_shapes)}
+        shapes = {n: tuple(s) for n, s in zip(input_names, input_shapes)}
+        arg_shapes, _, aux_shapes = self._sym.infer_shape(**shapes)
+        args = {}
+        for name, shp in zip(self._sym.list_arguments(), arg_shapes):
+            if name in self._inputs:
+                args[name] = self._inputs[name]
+            elif name in arg_params:
+                args[name] = arg_params[name]
+            else:
+                args[name] = _nd.zeros(shp)
+        aux = {}
+        for name, shp in zip(self._sym.list_auxiliary_states(), aux_shapes):
+            aux[name] = aux_params.get(name, _nd.zeros(shp))
+        self._exe = self._sym.bind(cpu(), args=args, aux_states=aux)
+        self._outputs: List[NDArray] = []
+
+    def set_input(self, key: str, data: bytes) -> None:
+        dst = self._inputs[key]
+        arr = np.frombuffer(data, np.float32).reshape(dst.shape)
+        import jax.numpy as jnp
+
+        dst._data = jnp.asarray(arr, dst.dtype)
+
+    def forward(self) -> None:
+        self._outputs = self._exe.forward(is_train=False)
+
+    def output_shape(self, index: int) -> List[int]:
+        return list(self._outputs[index].shape) if self._outputs else \
+            list(self._exe._symbol.infer_shape(
+                **{n: v.shape for n, v in self._inputs.items()})[1][index])
+
+    def get_output(self, index: int) -> bytes:
+        return np.ascontiguousarray(
+            self._outputs[index].asnumpy().astype(np.float32)).tobytes()
+
+
+def pred_create(symbol_json, param_bytes, input_names, input_shapes):
+    return Predictor(symbol_json, param_bytes, list(input_names),
+                     [list(s) for s in input_shapes])

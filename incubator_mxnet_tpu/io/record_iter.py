@@ -215,7 +215,8 @@ class ImageRecordIter(DataIter):
                 if not self.round_batch:
                     break
                 pad = bs - len(chunk)
-                chunk = chunk + order[: pad]
+                while len(chunk) < bs:  # wrap repeatedly: shard may be tiny
+                    chunk = chunk + order[: bs - len(chunk)]
             data = np.empty((bs, c, h, w), self.dtype)
             label = np.empty((bs, self.label_width), np.float32)
             aug_seed = int(self._rng.randint(0, 2**31))  # producer thread only
@@ -244,22 +245,38 @@ class ImageRecordIter(DataIter):
         self._current = None
 
     def next(self):  # noqa: A003
+        if not self.iter_next():
+            raise StopIteration
+        batch, self._current = self._current, None
+        return batch
+
+    def iter_next(self):
+        """Advance and stage the next batch for getdata/getlabel/getpad
+        (the reference DataIter protocol, io.py:180)."""
         item = self._prefetcher.next()
         if item is None:
-            raise StopIteration
+            self._current = None
+            return False
         data, label, pad = item
         if self.label_width == 1:
             label = label[:, 0]
-        return DataBatch(data=[_nd.array(data)], label=[_nd.array(label)],
-                         pad=pad, provide_data=self.provide_data,
-                         provide_label=self.provide_label)
+        self._current = DataBatch(
+            data=[_nd.array(data)], label=[_nd.array(label)], pad=pad,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)
+        return True
 
-    def iter_next(self):
-        try:
-            self._current = self.next()
-            return True
-        except StopIteration:
-            return False
+    def getdata(self):
+        return self._current.data
+
+    def getlabel(self):
+        return self._current.label
+
+    def getpad(self):
+        return self._current.pad if self._current is not None else 0
+
+    def getindex(self):
+        return None
 
     def close(self):
         self._prefetcher.stop()
@@ -308,9 +325,9 @@ class MNISTIter(DataIter):
             img = img.reshape(len(img), -1)
         else:
             img = img.reshape(len(img), 1, img.shape[1], img.shape[2])
-        self._inner = __import__(
-            "incubator_mxnet_tpu.io.io", fromlist=["NDArrayIter"]
-        ).NDArrayIter(
+        from .io import NDArrayIter
+
+        self._inner = NDArrayIter(
             {data_name: img}, {label_name: lab}, batch_size=batch_size,
             shuffle=shuffle, last_batch_handle="pad")
 

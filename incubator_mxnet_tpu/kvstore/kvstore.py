@@ -147,12 +147,19 @@ class KVStore:
         keys, values = self._norm_keys_vals(key, value)
         from ..ndarray.sparse import BaseSparseNDArray
 
+        # local merge + compress per key, then ONE batched cross-worker
+        # reduction for the whole push (kvstore_dist.h groups worker sends
+        # per push too; here the dist subclass fuses the batch into a single
+        # compiled collective program)
+        merged_list = []
         for k, v in zip(keys, values):
             merged = self._merge(v if isinstance(v, (list, tuple)) else [v])
             if getattr(self, "_compressor", None) is not None \
                     and not isinstance(merged, BaseSparseNDArray):
                 merged = self._compressor.compress(k, merged)
-            merged = self._reduce_after_compress(k, merged)
+            merged_list.append(merged)
+        merged_list = self._reduce_batch_after_compress(keys, merged_list)
+        for k, merged in zip(keys, merged_list):
             if isinstance(merged, BaseSparseNDArray):
                 if k not in self._store:
                     # match the dense path: an un-init'd key starts at zero
@@ -218,6 +225,13 @@ class KVStore:
         dist subclass sums across processes here). ``arr`` may be a raw
         jax array or a sparse NDArray (dist densifies the latter)."""
         return arr
+
+    def _reduce_batch_after_compress(self, keys, arrs):
+        """Batched form of the reduction hook, called once per push with
+        every key's merged+compressed gradient; the dist subclass fuses the
+        whole batch into one compiled collective program."""
+        return [self._reduce_after_compress(k, a)
+                for k, a in zip(keys, arrs)]
 
     # ------------------------------------------------------------------
     @staticmethod

@@ -1,16 +1,25 @@
-"""``mx.np`` — NumPy-compatible array API.
+"""``mx.np`` — NumPy-compatible array API **with autograd**.
 
-Parity: ``python/mxnet/numpy`` (multiarray.py:141 ndarray subclass + operator
-set, SURVEY.md §2.7).  TPU-native: jax.numpy IS a NumPy-compatible array
-API, so this namespace re-exports jnp operations wrapped to consume/produce
-this framework's ``ndarray`` (which also records autograd).  ``mx.np.ndarray``
-is an alias of the framework NDArray.
+Parity: ``python/mxnet/numpy`` (multiarray.py:141 ndarray subclass + the
+21,300-LoC ``src/operator/numpy/**`` op set + dispatch protocol
+``python/mxnet/numpy_dispatch_protocol.py``).
+
+TPU-native: jax.numpy IS a NumPy-compatible array API, so instead of
+re-implementing ~300 kernels, every call is dispatched through ONE generic
+recorder: functions in the differentiable set are executed under ``jax.vjp``
+when ``autograd.record()`` is active and taped like any registered op, so
+``mx.np``-only models backprop exactly like ``mx.nd`` ones.  Integer/bool/
+indexing functions are listed non-differentiable (silent passthrough, as in
+numpy semantics); anything unknown warns once if used under recording so
+missing gradients are loud, not silent.
 """
 from __future__ import annotations
 
 import functools
 import sys
+import warnings
 
+import jax as _jax
 import jax.numpy as _jnp
 import numpy as _onp
 
@@ -21,14 +30,45 @@ from . import random  # noqa: F401
 
 ndarray = NDArray
 
-_DISPATCH_OPS = {
-    # mx.np name -> registered op (autograd-recorded path)
-    "add": "broadcast_add", "subtract": "broadcast_sub",
-    "multiply": "broadcast_mul", "divide": "broadcast_div",
-    "true_divide": "broadcast_div", "power": "broadcast_power",
-    "maximum": "broadcast_maximum", "minimum": "broadcast_minimum",
-    "mod": "broadcast_mod", "matmul": "batch_dot",
-}
+# jnp functions routed through the recording dispatcher (the mx.np analog of
+# FGradient coverage).  Grouped as in src/operator/numpy/**.
+_DIFFERENTIABLE = frozenset("""
+add subtract multiply divide true_divide power float_power mod remainder
+fmod maximum minimum fmax fmin matmul dot vdot inner outer tensordot einsum
+kron cross
+exp exp2 expm1 log log2 log10 log1p sqrt cbrt square reciprocal positive
+negative abs absolute fabs sign hypot logaddexp logaddexp2
+sin cos tan arcsin arccos arctan arctan2 sinh cosh tanh arcsinh arccosh
+arctanh deg2rad rad2deg degrees radians
+sum mean prod std var median average ptp nansum nanmean nanprod cumsum
+cumprod amin amax min max nanmin nanmax
+clip interp
+reshape ravel transpose swapaxes moveaxis rollaxis concatenate stack vstack
+hstack dstack column_stack row_stack split array_split hsplit vsplit dsplit
+squeeze expand_dims broadcast_to repeat tile flip fliplr flipud roll rot90
+atleast_1d atleast_2d atleast_3d
+where take take_along_axis compress extract diag diagonal trace tril triu
+pad real imag conj conjugate flatten delete insert append select
+heaviside nan_to_num diff ediff1d gradient trapz trapezoid convolve correlate
+""".split())
+
+# int/bool-valued or piecewise-constant: no gradient by nature — quiet
+_NONDIFF = frozenset("""
+argmax argmin argsort sort searchsorted nonzero flatnonzero unique
+count_nonzero bincount digitize histogram histogram2d histogramdd
+floor ceil rint trunc round around fix sign signbit
+equal not_equal greater greater_equal less less_equal isclose allclose
+array_equal array_equiv isnan isinf isfinite isneginf isposinf iscomplex
+isreal all any logical_and logical_or logical_not logical_xor
+bitwise_and bitwise_or bitwise_xor bitwise_not invert left_shift right_shift
+floor_divide divmod shape size ndim copyto may_share_memory result_type
+can_cast promote_types meshgrid indices unravel_index ravel_multi_index
+tril_indices triu_indices diag_indices ix_ asarray ascontiguousarray
+empty_like zeros_like ones_like full_like copy astype broadcast_shapes
+array2string array_repr array_str base_repr binary_repr isscalar iterable
+""".split())
+
+_WARNED_PASSTHROUGH = set()
 
 
 def _wrap_value(v):
@@ -49,9 +89,80 @@ def _unwrap(v):
     return v
 
 
+def _make_recording_fn(name, jfn):
+    """Wrap a jnp function so NDArray args record on the autograd tape.
+
+    The generic-FGradient path: positional NDArray/jax-array args are the
+    differentiable inputs (non-array positionals like einsum subscripts or
+    axis values are closed over); under ``autograd.record()`` the call runs
+    via ``jax.vjp`` and tapes one node, exactly like a registered op
+    (``ops/registry.py:_invoke_impl``)."""
+
+    @functools.wraps(jfn)
+    def fn(*args, **kwargs):
+        from .. import autograd
+
+        kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
+        # flatten so sequence args (np.concatenate([a, b]), np.stack(...))
+        # expose their array leaves as differentiable inputs too
+        flat, treedef = _jax.tree.flatten(
+            list(args), is_leaf=lambda x: isinstance(x, NDArray))
+        raw = [_unwrap(a) if isinstance(a, NDArray) else a for a in flat]
+        live = [i for i, r in enumerate(raw)
+                if isinstance(r, _jnp.ndarray)]
+        recording = (autograd.is_recording() and live
+                     and any(autograd.requires_grad(flat[i]) for i in live
+                             if isinstance(flat[i], NDArray)))
+        if not recording:
+            return _wrap_value(jfn(*_jax.tree.unflatten(treedef, raw),
+                                   **kwargs))
+
+        def f(*xs, _raw=tuple(raw), _live=tuple(live)):
+            full = list(_raw)
+            for j, x in zip(_live, xs):
+                full[j] = x
+            return jfn(*_jax.tree.unflatten(treedef, full), **kwargs)
+
+        out, vjp_fn = _jax.vjp(f, *[raw[i] for i in live])
+        multi = isinstance(out, (tuple, list))
+        outs_list = list(out) if multi else [out]
+        nd_outs = [NDArray(o) for o in outs_list]
+
+        out_type = type(out) if multi else None
+
+        def tape_vjp(cot, _vjp=vjp_fn, _t=out_type):
+            # match the primal output's pytree container (list vs tuple);
+            # the tape passes a bare array when n_outputs == 1 even for
+            # container-returning functions like split(x, 1)
+            if _t is not None:
+                cots = _t(cot) if isinstance(cot, tuple) else _t([cot])
+            else:
+                cots = cot
+            return list(_vjp(cots))
+
+        node = autograd.TapeNode(
+            tape_vjp, [flat[i] for i in live], nd_outs, name="np." + name)
+        autograd.attach_node(nd_outs, node)
+        if multi:
+            return type(out)(nd_outs) if isinstance(out, list) \
+                else tuple(nd_outs)
+        return nd_outs[0]
+
+    fn.__name__ = name
+    return fn
+
+
 def _make_np_fn(name, jfn):
     @functools.wraps(jfn)
     def fn(*args, **kwargs):
+        from .. import autograd
+
+        if autograd.is_recording() and name not in _WARNED_PASSTHROUGH:
+            _WARNED_PASSTHROUGH.add(name)
+            warnings.warn(
+                "mx.np.%s is not in the differentiable dispatch set; its "
+                "result will NOT record on the autograd tape" % name,
+                stacklevel=2)
         args = tuple(_unwrap(a) for a in args)
         kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
         out = jfn(*args, **kwargs)
@@ -105,24 +216,27 @@ dtype = _onp.dtype
 def __getattr__(name):
     if name.startswith("__"):
         raise AttributeError(name)
-    if name in _DISPATCH_OPS:
-        from ..ops import registry as _reg
-
-        opname = _DISPATCH_OPS[name]
-
-        def fn(a, b, out=None, **kw):
-            return _reg.invoke(opname, [
-                a if isinstance(a, NDArray) else NDArray(_jnp.asarray(a)),
-                b if isinstance(b, NDArray) else NDArray(_jnp.asarray(b))],
-                out=out)
-
-        setattr(sys.modules[__name__], name, fn)
-        return fn
     jfn = getattr(_jnp, name, None)
     if jfn is None:
         raise AttributeError("mx.np has no attribute %r" % name)
     if callable(jfn):
-        wrapped = _make_np_fn(name, jfn)
+        if name in _DIFFERENTIABLE:
+            wrapped = _make_recording_fn(name, jfn)
+        elif name in _NONDIFF:
+            wrapped = _make_quiet_fn(name, jfn)
+        else:
+            wrapped = _make_np_fn(name, jfn)  # warns once under recording
         setattr(sys.modules[__name__], name, wrapped)
         return wrapped
     return jfn
+
+
+def _make_quiet_fn(name, jfn):
+    @functools.wraps(jfn)
+    def fn(*args, **kwargs):
+        args = tuple(_unwrap(a) for a in args)
+        kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
+        return _wrap_value(jfn(*args, **kwargs))
+
+    fn.__name__ = name
+    return fn

@@ -27,6 +27,12 @@ __all__ = ["flash_attention"]
 
 _NEG_INF = -1e30
 
+# index-map constant pinned to i32: the package enables jax_enable_x64, and
+# a python 0 in a BlockSpec index map lowers as i64, which Mosaic rejects
+# (failed to legalize func.return (i32, i32, i64))
+import numpy as _np
+_I0 = _np.int32(0)
+
 
 def _use_interpret():
     try:
@@ -60,7 +66,9 @@ def _causal_mask(s, qi, ki, block_q, block_k, offset):
         jnp.int32, (block_q, block_k), 0)
     cols = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
-    return jnp.where(rows + offset >= cols, s, _NEG_INF)
+    # explicit f32 fill: a python float would enter the kernel as f64 and
+    # Mosaic cannot legalize the f64->f32 truncf
+    return jnp.where(rows + offset >= cols, s, jnp.float32(_NEG_INF))
 
 
 def _block_relevant(qi, ki, block_q, block_k, offset):
@@ -101,7 +109,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         # rows with zero unmasked keys (causal, kv_len < q_len): every score
         # is _NEG_INF, so exp(s - m_new) would be 1 everywhere and emit
         # mean(V); force those rows to contribute nothing (output 0)
-        p = jnp.where(m_new > _NEG_INF / 2, p, 0.0)
+        p = jnp.where(m_new > jnp.float32(_NEG_INF / 2), p, jnp.float32(0.0))
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_prev + p.sum(axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
@@ -113,7 +121,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     def _finish():
         l = l_scr[:]
         o_ref[0] = (acc_scr[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:] + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
+        # lse carried as (bq, 1): a trailing unit lane keeps the block shape
+        # Mosaic-legal (last dim equals the array dim; (1, bq) blocks are not)
+        lse_ref[0] = m_scr[:] + jnp.log(jnp.maximum(l, 1e-30))
 
 
 def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
@@ -130,17 +140,17 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
         kernel,
         grid=(bh, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, _I0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, _I0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, _I0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, _I0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, _I0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -149,7 +159,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(q, k, v)
-    return out, lse
+    return out, lse[:, :, 0]
 
 
 # ---------------------------------------------------------------------------
@@ -174,18 +184,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0]                                # (bq, 1)
+        delta = delta_ref[0]                            # (bq, 1)
 
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k, offset)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         # rows with zero unmasked keys have lse ~= _NEG_INF, which would
         # blow exp() up instead of zeroing it; mask on the raw scores
-        p = jnp.where(s > _NEG_INF / 2, p, 0.0)
+        p = jnp.where(s > jnp.float32(_NEG_INF / 2), p, jnp.float32(0.0))
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         dq_scr[:] = dq_scr[:] + jnp.dot(ds, k,
                                         preferred_element_type=jnp.float32)
 
@@ -214,18 +224,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0]                                # (bq, 1)
+        delta = delta_ref[0]                            # (bq, 1)
 
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, qi, kj, block_q, block_k, offset)
-        p = jnp.exp(s - lse[:, None])                   # (bq, bk)
-        p = jnp.where(s > _NEG_INF / 2, p, 0.0)
+        p = jnp.exp(s - lse)                            # (bq, bk)
+        p = jnp.where(s > jnp.float32(_NEG_INF / 2), p, jnp.float32(0.0))
         dv_scr[:] = dv_scr[:] + jnp.dot(p.T, do,
                                         preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         dk_scr[:] = dk_scr[:] + jnp.dot(ds.T, q,
                                         preferred_element_type=jnp.float32)
 
@@ -245,41 +255,42 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, g):
     nq = s // bq
     nk = sk // bk
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)                            # (bh, s)
+                    axis=-1, keepdims=True)             # (bh, s, 1)
+    lse3 = lse[:, :, None]                              # (bh, s, 1)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=bq, block_k=bk, nk=nk, offset=sk - s),
         grid=(bh, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, _I0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, _I0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, _I0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, _I0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, _I0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, _I0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, _I0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse3, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=bq, block_k=bk, nq=nq, offset=sk - s),
         grid=(bh, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, _I0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, _I0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, _I0)),
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, _I0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, _I0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, _I0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, _I0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, _I0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
@@ -290,7 +301,7 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, g):
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse3, delta)
     return dq, dk, dv
 
 

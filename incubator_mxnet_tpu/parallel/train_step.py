@@ -108,6 +108,7 @@ class TrainStep:
         self._jit = None
         self._compiled = None
         self._compiled_key = None
+        self._multihost = False
         self._donate = donate
         self._placed = False
         self._shardings = None
@@ -198,6 +199,48 @@ class TrainStep:
             self._opt_state = self.opt.init([p._data._data for p in self._gp])
         if self._jit is None:
             self._jit = self._build()
+            self._multihost = self.mesh is not None and any(
+                d.process_index != jax.process_index()
+                for d in self.mesh.devices.flat)
+
+    def _place_state(self, p_vals, aux_vals):
+        """One-time placement of params/opt-state on their target shardings
+        (donation then updates the buffers in place every step).  Multihost:
+        host-local replicas (identical after seeded init / broadcast) become
+        global arrays — dist_sync_device ≡ one GSPMD program over every
+        process's devices (SURVEY §5.8)."""
+        p_sh, aux_sh, state_sh, _, _ = self._shardings
+        if self._multihost:
+            from jax.experimental import multihost_utils as mhu
+
+            p_vals = [mhu.host_local_array_to_global_array(
+                v, self.mesh, s.spec) for v, s in zip(p_vals, p_sh)]
+            aux_vals = [mhu.host_local_array_to_global_array(
+                v, self.mesh, s.spec) for v, s in zip(aux_vals, aux_sh)]
+            self._opt_state = jax.tree.map(
+                lambda v, s: mhu.host_local_array_to_global_array(
+                    v, self.mesh, s.spec), self._opt_state, state_sh)
+        else:
+            p_vals = [jax.device_put(v, s) for v, s in zip(p_vals, p_sh)]
+            aux_vals = [jax.device_put(v, s)
+                        for v, s in zip(aux_vals, aux_sh)]
+            self._opt_state = jax.tree.map(
+                jax.device_put, self._opt_state, state_sh)
+        self._placed = True
+        return p_vals, aux_vals
+
+    def _place_batch(self, xv, yv):
+        """Shard the batch over the mesh's batch axis; multihost treats the
+        process-local batch as this host's shard of the global batch."""
+        batch_sh = self._shardings[3]
+        if self._multihost:
+            from jax.experimental import multihost_utils as mhu
+
+            return (mhu.host_local_array_to_global_array(
+                        xv, self.mesh, batch_sh.spec),
+                    mhu.host_local_array_to_global_array(
+                        yv, self.mesh, batch_sh.spec))
+        return jax.device_put(xv, batch_sh), jax.device_put(yv, batch_sh)
 
     def aot_compile(self, x, y):
         """Ahead-of-time trace + lower + compile the fused step for the given
@@ -217,6 +260,16 @@ class TrainStep:
         yv = y._data if isinstance(y, NDArray) else jnp.asarray(y)
         p_vals = [p._data._data for p in self._gp]
         aux_vals = [p._data._data for p in self._aux]
+        if self.mesh is not None:
+            # compile against the PLACED (global, sharded) avals — the same
+            # arrays __call__ will pass — or the executable never matches
+            if not self._placed:
+                p_vals, aux_vals = self._place_state(p_vals, aux_vals)
+                for p, v in zip(self._gp, p_vals):
+                    p._data._data = v
+                for p, v in zip(self._aux, aux_vals):
+                    p._data._data = v
+            xv, yv = self._place_batch(xv, yv)
         key = rng.next_key()
         t0 = _time.time()
         traced = self._jit.trace(p_vals, aux_vals, self._opt_state, xv, yv,
@@ -241,20 +294,9 @@ class TrainStep:
         p_vals = [p._data._data for p in self._gp]
         aux_vals = [p._data._data for p in self._aux]
         if self.mesh is not None:
-            p_sh, aux_sh, state_sh, batch_sh, _ = self._shardings
             if not self._placed:
-                # place params/opt-state on their target shardings up front:
-                # donation then updates buffers in place every step and
-                # committed single-device arrays never conflict with
-                # in_shardings
-                p_vals = [jax.device_put(v, s) for v, s in zip(p_vals, p_sh)]
-                aux_vals = [jax.device_put(v, s)
-                            for v, s in zip(aux_vals, aux_sh)]
-                self._opt_state = jax.tree.map(
-                    jax.device_put, self._opt_state, state_sh)
-                self._placed = True
-            xv = jax.device_put(xv, batch_sh)
-            yv = jax.device_put(yv, batch_sh)
+                p_vals, aux_vals = self._place_state(p_vals, aux_vals)
+            xv, yv = self._place_batch(xv, yv)
         # the AOT executable is shape-pinned; any other batch shape/dtype
         # falls back to the jit wrapper, which retraces transparently
         fn = self._jit

@@ -1,0 +1,502 @@
+// MXNet-compatible C ABI over the TPU-native runtime.
+//
+// Reference contract: include/mxnet/c_api.h (242 MXNET_DLL functions) and
+// include/mxnet/c_predict_api.h:84-289 (serving ABI).  In the reference the
+// C layer sits UNDER the Python frontend; here the compute runtime IS
+// Python/JAX, so the C ABI is a native shim that drives the runtime through
+// the embedded CPython API (incubator_mxnet_tpu.capi_impl does the
+// marshalling).  Handles are strong PyObject references; every entry point
+// takes the GIL, so the library is callable from any C/C++ thread — the
+// same contract the reference's thread-safe predict API documents.
+//
+// Implemented surface (the subset every binding/serving path needs):
+//   error     MXGetLastError, MXGetVersion
+//   ndarray   MXNDArrayCreate/Ex, Free, SyncCopyFromCPU, SyncCopyToCPU,
+//             GetShape, GetDType, WaitToRead, MXNDArraySave, MXNDArrayLoad
+//   ops       MXListAllOpNames, MXImperativeInvokeByName
+//   symbol    MXSymbolCreateFromJSON, SaveToJSON, Free, ListArguments,
+//             ListOutputs, ListAuxiliaryStates
+//   predict   MXPredCreate, SetInput, Forward, GetOutputShape, GetOutput,
+//             Free
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#define MXTPU_API extern "C" __attribute__((visibility("default")))
+
+typedef void* NDArrayHandle;
+typedef void* SymbolHandle;
+typedef void* PredictorHandle;
+
+namespace {
+
+thread_local std::string g_last_error;
+// per-thread scratch keeping returned pointers alive until the next call
+// (the reference uses MXAPIThreadLocalEntry the same way)
+thread_local std::vector<uint32_t> g_shape_buf;
+thread_local std::vector<std::string> g_str_store;
+thread_local std::vector<const char*> g_ptr_store;
+thread_local std::string g_json_buf;
+thread_local std::vector<NDArrayHandle> g_handle_store;
+
+int Fail(const std::string& msg) {
+  g_last_error = msg;
+  return -1;
+}
+
+int FailFromPython() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      msg = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return Fail(msg);
+}
+
+// Lazily bring up the interpreter (no-op when embedded in a live one) and
+// import the marshalling module.
+PyObject* Impl() {
+  static PyObject* impl = nullptr;
+  static std::once_flag once;
+  std::call_once(once, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+    }
+    PyGILState_STATE g = PyGILState_Ensure();
+    impl = PyImport_ImportModule("incubator_mxnet_tpu.capi_impl");
+    if (impl == nullptr) PyErr_Print();
+    PyGILState_Release(g);
+  });
+  return impl;
+}
+
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+PyObject* CallImpl(const char* fn, PyObject* args) {
+  PyObject* mod = Impl();
+  if (mod == nullptr) return nullptr;
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  if (f == nullptr) return nullptr;
+  PyObject* out = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  return out;
+}
+
+int StoreStringList(PyObject* list, uint32_t* out_size,
+                    const char*** out_array) {
+  g_str_store.clear();
+  g_ptr_store.clear();
+  Py_ssize_t n = PyList_Size(list);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    g_str_store.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(list, i)));
+  }
+  for (auto& s : g_str_store) g_ptr_store.push_back(s.c_str());
+  *out_size = static_cast<uint32_t>(n);
+  *out_array = g_ptr_store.data();
+  return 0;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// error / version
+// ---------------------------------------------------------------------------
+
+MXTPU_API const char* MXGetLastError() { return g_last_error.c_str(); }
+
+MXTPU_API int MXGetVersion(int* out) {
+  *out = 10600;  // reports 1.6.0-compatible surface
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// NDArray
+// ---------------------------------------------------------------------------
+
+MXTPU_API int MXNDArrayCreateEx(const uint32_t* shape, uint32_t ndim,
+                                int dev_type, int dev_id, int delay_alloc,
+                                int dtype, NDArrayHandle* out) {
+  (void)dev_type; (void)dev_id; (void)delay_alloc;
+  Gil gil;
+  PyObject* shp = PyTuple_New(ndim);
+  for (uint32_t i = 0; i < ndim; ++i) {
+    PyTuple_SetItem(shp, i, PyLong_FromUnsignedLong(shape[i]));
+  }
+  PyObject* args = Py_BuildValue("(Ni)", shp, dtype);
+  PyObject* res = CallImpl("ndarray_create", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;  // strong reference transferred to the handle
+  return 0;
+}
+
+MXTPU_API int MXNDArrayCreate(const uint32_t* shape, uint32_t ndim,
+                              int dev_type, int dev_id, int delay_alloc,
+                              NDArrayHandle* out) {
+  return MXNDArrayCreateEx(shape, ndim, dev_type, dev_id, delay_alloc, 0,
+                           out);
+}
+
+MXTPU_API int MXNDArrayFree(NDArrayHandle handle) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+MXTPU_API int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void* data,
+                                       size_t size) {
+  Gil gil;
+  // size is an element count in the reference ABI; bytes = count * itemsize
+  PyObject* dt = PyObject_GetAttrString(static_cast<PyObject*>(handle),
+                                        "dtype");
+  if (dt == nullptr) return FailFromPython();
+  PyObject* isz = PyObject_GetAttrString(dt, "itemsize");
+  Py_DECREF(dt);
+  if (isz == nullptr) return FailFromPython();
+  size_t nbytes = size * PyLong_AsSize_t(isz);
+  Py_DECREF(isz);
+  PyObject* bytes = PyBytes_FromStringAndSize(
+      static_cast<const char*>(data), static_cast<Py_ssize_t>(nbytes));
+  PyObject* args = Py_BuildValue("(ON)", static_cast<PyObject*>(handle),
+                                 bytes);
+  PyObject* res = CallImpl("ndarray_sync_copy_from", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void* data,
+                                     size_t size) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallImpl("ndarray_to_bytes", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  char* buf = nullptr;
+  Py_ssize_t n = 0;
+  PyBytes_AsStringAndSize(res, &buf, &n);
+  PyObject* dt = PyObject_GetAttrString(static_cast<PyObject*>(handle),
+                                        "dtype");
+  PyObject* isz = dt ? PyObject_GetAttrString(dt, "itemsize") : nullptr;
+  size_t want = size * (isz ? PyLong_AsSize_t(isz) : 1);
+  Py_XDECREF(dt);
+  Py_XDECREF(isz);
+  std::memcpy(data, buf, want < static_cast<size_t>(n) ? want
+                                                       : static_cast<size_t>(n));
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  Gil gil;
+  PyObject* res = PyObject_CallMethod(static_cast<PyObject*>(handle),
+                                      "wait_to_read", nullptr);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetShape(NDArrayHandle handle, uint32_t* out_dim,
+                                const uint32_t** out_pdata) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallImpl("ndarray_shape", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_ssize_t n = PyList_Size(res);
+  g_shape_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    g_shape_buf[i] =
+        static_cast<uint32_t>(PyLong_AsLong(PyList_GetItem(res, i)));
+  }
+  Py_DECREF(res);
+  *out_dim = static_cast<uint32_t>(n);
+  *out_pdata = g_shape_buf.data();
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetDType(NDArrayHandle handle, int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallImpl("ndarray_dtype", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXNDArraySave(const char* fname, uint32_t num_args,
+                            NDArrayHandle* args_, const char** keys) {
+  Gil gil;
+  PyObject* handles = PyList_New(num_args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    Py_INCREF(static_cast<PyObject*>(args_[i]));
+    PyList_SetItem(handles, i, static_cast<PyObject*>(args_[i]));
+  }
+  PyObject* names;
+  if (keys != nullptr) {
+    names = PyList_New(num_args);
+    for (uint32_t i = 0; i < num_args; ++i) {
+      PyList_SetItem(names, i, PyUnicode_FromString(keys[i]));
+    }
+  } else {
+    names = PyList_New(0);
+  }
+  PyObject* args = Py_BuildValue("(sNN)", fname, handles, names);
+  PyObject* res = CallImpl("ndarray_save", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayLoad(const char* fname, uint32_t* out_size,
+                            NDArrayHandle** out_arr, uint32_t* out_name_size,
+                            const char*** out_names) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", fname);
+  PyObject* res = CallImpl("ndarray_load", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  PyObject* arrs = PyTuple_GetItem(res, 0);
+  PyObject* names = PyTuple_GetItem(res, 1);
+  Py_ssize_t n = PyList_Size(arrs);
+  g_handle_store.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* item = PyList_GetItem(arrs, i);
+    Py_INCREF(item);
+    g_handle_store.push_back(item);
+  }
+  *out_size = static_cast<uint32_t>(n);
+  *out_arr = g_handle_store.data();
+  StoreStringList(names, out_name_size, out_names);
+  Py_DECREF(res);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// ops
+// ---------------------------------------------------------------------------
+
+MXTPU_API int MXListAllOpNames(uint32_t* out_size, const char*** out_array) {
+  Gil gil;
+  PyObject* res = CallImpl("list_op_names", nullptr);
+  if (res == nullptr) return FailFromPython();
+  StoreStringList(res, out_size, out_array);
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXImperativeInvokeByName(
+    const char* op_name, int num_inputs, NDArrayHandle* inputs,
+    int* num_outputs, NDArrayHandle** outputs, int num_params,
+    const char** param_keys, const char** param_vals) {
+  Gil gil;
+  PyObject* ins = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    Py_INCREF(static_cast<PyObject*>(inputs[i]));
+    PyList_SetItem(ins, i, static_cast<PyObject*>(inputs[i]));
+  }
+  PyObject* keys = PyList_New(num_params);
+  PyObject* vals = PyList_New(num_params);
+  for (int i = 0; i < num_params; ++i) {
+    PyList_SetItem(keys, i, PyUnicode_FromString(param_keys[i]));
+    PyList_SetItem(vals, i, PyUnicode_FromString(param_vals[i]));
+  }
+  PyObject* args = Py_BuildValue("(sNNN)", op_name, ins, keys, vals);
+  PyObject* res = CallImpl("imperative_invoke", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_ssize_t n = PyList_Size(res);
+  g_handle_store.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* item = PyList_GetItem(res, i);
+    Py_INCREF(item);
+    g_handle_store.push_back(item);
+  }
+  Py_DECREF(res);
+  *num_outputs = static_cast<int>(n);
+  *outputs = g_handle_store.data();
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Symbol
+// ---------------------------------------------------------------------------
+
+MXTPU_API int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", json);
+  PyObject* res = CallImpl("symbol_from_json", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXSymbolSaveToJSON(SymbolHandle sym, const char** out_json) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallImpl("symbol_to_json", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  g_json_buf = PyUnicode_AsUTF8(res);
+  Py_DECREF(res);
+  *out_json = g_json_buf.c_str();
+  return 0;
+}
+
+MXTPU_API int MXSymbolFree(SymbolHandle sym) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject*>(sym));
+  return 0;
+}
+
+static int SymbolStrList(const char* fn, SymbolHandle sym, uint32_t* out_size,
+                         const char*** out_array) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallImpl(fn, args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  StoreStringList(res, out_size, out_array);
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXSymbolListArguments(SymbolHandle sym, uint32_t* out_size,
+                                    const char*** out_array) {
+  return SymbolStrList("symbol_list_arguments", sym, out_size, out_array);
+}
+
+MXTPU_API int MXSymbolListOutputs(SymbolHandle sym, uint32_t* out_size,
+                                  const char*** out_array) {
+  return SymbolStrList("symbol_list_outputs", sym, out_size, out_array);
+}
+
+MXTPU_API int MXSymbolListAuxiliaryStates(SymbolHandle sym,
+                                          uint32_t* out_size,
+                                          const char*** out_array) {
+  return SymbolStrList("symbol_list_aux", sym, out_size, out_array);
+}
+
+// ---------------------------------------------------------------------------
+// Predict API (c_predict_api.h)
+// ---------------------------------------------------------------------------
+
+MXTPU_API int MXPredCreate(const char* symbol_json_str,
+                           const void* param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           uint32_t num_input_nodes,
+                           const char** input_keys,
+                           const uint32_t* input_shape_indptr,
+                           const uint32_t* input_shape_data,
+                           PredictorHandle* out) {
+  (void)dev_type; (void)dev_id;
+  Gil gil;
+  PyObject* names = PyList_New(num_input_nodes);
+  PyObject* shapes = PyList_New(num_input_nodes);
+  for (uint32_t i = 0; i < num_input_nodes; ++i) {
+    PyList_SetItem(names, i, PyUnicode_FromString(input_keys[i]));
+    uint32_t lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject* shp = PyList_New(hi - lo);
+    for (uint32_t j = lo; j < hi; ++j) {
+      PyList_SetItem(shp, j - lo, PyLong_FromUnsignedLong(
+          input_shape_data[j]));
+    }
+    PyList_SetItem(shapes, i, shp);
+  }
+  PyObject* blob = PyBytes_FromStringAndSize(
+      static_cast<const char*>(param_bytes), param_size);
+  PyObject* args = Py_BuildValue("(sNNN)", symbol_json_str, blob, names,
+                                 shapes);
+  PyObject* res = CallImpl("pred_create", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXPredSetInput(PredictorHandle handle, const char* key,
+                             const float* data, uint32_t size) {
+  Gil gil;
+  PyObject* bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data),
+      static_cast<Py_ssize_t>(size) * 4);
+  PyObject* res = PyObject_CallMethod(static_cast<PyObject*>(handle),
+                                      "set_input", "sN", key, bytes);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXPredForward(PredictorHandle handle) {
+  Gil gil;
+  PyObject* res = PyObject_CallMethod(static_cast<PyObject*>(handle),
+                                      "forward", nullptr);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXPredGetOutputShape(PredictorHandle handle, uint32_t index,
+                                   uint32_t** shape_data,
+                                   uint32_t* shape_ndim) {
+  Gil gil;
+  PyObject* res = PyObject_CallMethod(static_cast<PyObject*>(handle),
+                                      "output_shape", "I", index);
+  if (res == nullptr) return FailFromPython();
+  Py_ssize_t n = PyList_Size(res);
+  g_shape_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    g_shape_buf[i] =
+        static_cast<uint32_t>(PyLong_AsLong(PyList_GetItem(res, i)));
+  }
+  Py_DECREF(res);
+  *shape_data = g_shape_buf.data();
+  *shape_ndim = static_cast<uint32_t>(n);
+  return 0;
+}
+
+MXTPU_API int MXPredGetOutput(PredictorHandle handle, uint32_t index,
+                              float* data, uint32_t size) {
+  Gil gil;
+  PyObject* res = PyObject_CallMethod(static_cast<PyObject*>(handle),
+                                      "get_output", "I", index);
+  if (res == nullptr) return FailFromPython();
+  char* buf = nullptr;
+  Py_ssize_t n = 0;
+  PyBytes_AsStringAndSize(res, &buf, &n);
+  size_t want = static_cast<size_t>(size) * 4;
+  std::memcpy(data, buf,
+              want < static_cast<size_t>(n) ? want : static_cast<size_t>(n));
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXPredFree(PredictorHandle handle) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  return 0;
+}

@@ -79,11 +79,52 @@ def main():
         trainer.step(4)
     trained_w = net.weight.data().asnumpy()
 
+    # --- fused-batch reduction: one compiled collective program ---------
+    # (round-3: push sums ride a single jitted psum program, not per-key
+    # host gathers; assert the lowered HLO contains an all-reduce and that
+    # a multi-key push produces exact sums through the same program)
+    hlo = store.lowered_sum_hlo([nd.ones((3, 2))._data,
+                                 nd.ones((5,))._data])
+    n_allreduce = hlo.count("all-reduce")
+    store.init(["mk1", "mk2"], [nd.zeros((3, 2)), nd.zeros((5,))])
+    store.push(["mk1", "mk2"],
+               [nd.full((3, 2), float(rank + 1)),
+                nd.full((5,), 10.0 * (rank + 1))])
+    got_mk1, got_mk2 = nd.zeros((3, 2)), nd.zeros((5,))
+    store.pull(["mk1", "mk2"], out=[got_mk1, got_mk2])
+
+    # --- multihost fused TrainStep: dp over a global 2-process mesh -----
+    from incubator_mxnet_tpu.parallel import make_mesh, make_train_step
+
+    mx.random.seed(0)  # identical params on every rank
+    mnet = gluon.nn.Dense(2, in_units=3)
+    mnet.initialize(init=mx.init.Xavier())
+    per_proc = {}
+    for d in jax.devices():
+        per_proc.setdefault(d.process_index, d)
+    gdevs = [per_proc[i] for i in range(jax.process_count())]
+    gmesh = make_mesh({"dp": len(gdevs)}, devices=gdevs)
+    step = make_train_step(mnet, gluon.loss.L2Loss(), optimizer="sgd",
+                           learning_rate=0.05, momentum=0.0, mesh=gmesh,
+                           batch_axis="dp")
+    rs2 = np.random.RandomState(200 + rank)  # different data per rank
+    mh_losses = []
+    for _ in range(3):
+        x = nd.array(rs2.uniform(-1, 1, (4, 3)).astype(np.float32))
+        y = nd.array(rs2.uniform(-1, 1, (4, 2)).astype(np.float32))
+        loss = step(x, y)
+        mh_losses.append(float(loss.asscalar()))
+    mh_w = np.asarray(
+        jax.device_get(mnet.weight.data()._data.addressable_data(0)))
+
     store.barrier()
     np.savez(os.path.join(outdir, "rank%d.npz" % rank),
              init=got_init.asnumpy(), sum=got_sum.asnumpy(),
              opt=got_opt.asnumpy(), c1=got_c1.asnumpy(),
              c2=got_c2.asnumpy(), trained_w=trained_w,
+             mk1=got_mk1.asnumpy(), mk2=got_mk2.asnumpy(),
+             n_allreduce=np.int32(n_allreduce),
+             mh_w=mh_w, mh_losses=np.asarray(mh_losses, np.float64),
              rank=np.int32(rank), nw=np.int32(nw))
     print("worker %d/%d ok" % (rank, nw), flush=True)
 

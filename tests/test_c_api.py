@@ -1,0 +1,191 @@
+"""C ABI surface (src/native/c_api.cc — the include/mxnet/c_api.h +
+c_predict_api.h contract driven through ctypes exactly as a C consumer
+would)."""
+import ctypes
+import json
+import os
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SO = os.path.join(_REPO, "src", "native", "libmxtpu_capi.so")
+
+
+@pytest.fixture(scope="module")
+def lib():
+    if not os.path.exists(_SO):
+        pytest.skip("libmxtpu_capi.so not built (cd src/native && make)")
+    lib = ctypes.CDLL(_SO)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _check(lib, rc):
+    assert rc == 0, lib.MXGetLastError().decode()
+
+
+def test_version(lib):
+    v = ctypes.c_int()
+    _check(lib, lib.MXGetVersion(ctypes.byref(v)))
+    assert v.value == 10600
+
+
+def test_ndarray_create_copy_shape(lib):
+    shape = (ctypes.c_uint32 * 2)(3, 4)
+    h = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0,
+                                      ctypes.byref(h)))
+    data = np.arange(12, dtype=np.float32)
+    _check(lib, lib.MXNDArraySyncCopyFromCPU(
+        h, data.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(12)))
+    out = np.zeros(12, np.float32)
+    _check(lib, lib.MXNDArraySyncCopyToCPU(
+        h, out.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(12)))
+    np.testing.assert_array_equal(out, data)
+
+    ndim = ctypes.c_uint32()
+    pdata = ctypes.POINTER(ctypes.c_uint32)()
+    _check(lib, lib.MXNDArrayGetShape(h, ctypes.byref(ndim),
+                                      ctypes.byref(pdata)))
+    assert [pdata[i] for i in range(ndim.value)] == [3, 4]
+    dt = ctypes.c_int()
+    _check(lib, lib.MXNDArrayGetDType(h, ctypes.byref(dt)))
+    assert dt.value == 0  # kFloat32
+    _check(lib, lib.MXNDArrayFree(h))
+
+
+def test_imperative_invoke_by_name(lib):
+    shape = (ctypes.c_uint32 * 2)(2, 3)
+    a = ctypes.c_void_p()
+    b = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0, ctypes.byref(a)))
+    _check(lib, lib.MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0, ctypes.byref(b)))
+    av = np.full(6, 2.0, np.float32)
+    bv = np.full(6, 5.0, np.float32)
+    _check(lib, lib.MXNDArraySyncCopyFromCPU(
+        a, av.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(6)))
+    _check(lib, lib.MXNDArraySyncCopyFromCPU(
+        b, bv.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(6)))
+
+    inputs = (ctypes.c_void_p * 2)(a, b)
+    n_out = ctypes.c_int()
+    outputs = ctypes.POINTER(ctypes.c_void_p)()
+    _check(lib, lib.MXImperativeInvokeByName(
+        b"broadcast_add", 2, inputs, ctypes.byref(n_out),
+        ctypes.byref(outputs), 0, None, None))
+    assert n_out.value == 1
+    out = np.zeros(6, np.float32)
+    o = ctypes.c_void_p(outputs[0])
+    _check(lib, lib.MXNDArraySyncCopyToCPU(
+        o, out.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(6)))
+    np.testing.assert_array_equal(out, np.full(6, 7.0, np.float32))
+    lib.MXNDArrayFree(a)
+    lib.MXNDArrayFree(b)
+    lib.MXNDArrayFree(o)
+
+
+def test_op_list(lib):
+    n = ctypes.c_uint32()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib, lib.MXListAllOpNames(ctypes.byref(n), ctypes.byref(arr)))
+    names = {arr[i].decode() for i in range(n.value)}
+    assert n.value > 200
+    assert {"Convolution", "BatchNorm", "FullyConnected"} <= names
+
+
+def test_ndarray_save_load_roundtrip(lib, tmp_path):
+    shape = (ctypes.c_uint32 * 1)(4)
+    h = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayCreateEx(shape, 1, 1, 0, 0, 0, ctypes.byref(h)))
+    vals = np.array([1, 2, 3, 4], np.float32)
+    _check(lib, lib.MXNDArraySyncCopyFromCPU(
+        h, vals.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(4)))
+    path = str(tmp_path / "a.params").encode()
+    keys = (ctypes.c_char_p * 1)(b"w")
+    handles = (ctypes.c_void_p * 1)(h)
+    _check(lib, lib.MXNDArraySave(path, 1, handles, keys))
+
+    out_size = ctypes.c_uint32()
+    out_arr = ctypes.POINTER(ctypes.c_void_p)()
+    name_size = ctypes.c_uint32()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib, lib.MXNDArrayLoad(path, ctypes.byref(out_size),
+                                  ctypes.byref(out_arr),
+                                  ctypes.byref(name_size),
+                                  ctypes.byref(names)))
+    assert out_size.value == 1 and name_size.value == 1
+    assert names[0].decode() == "w"
+    got = np.zeros(4, np.float32)
+    o = ctypes.c_void_p(out_arr[0])
+    _check(lib, lib.MXNDArraySyncCopyToCPU(
+        o, got.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(4)))
+    np.testing.assert_array_equal(got, vals)
+    lib.MXNDArrayFree(h)
+    lib.MXNDArrayFree(o)
+
+
+def test_symbol_json_roundtrip(lib):
+    import incubator_mxnet_tpu.symbol as sym
+
+    s = sym.FullyConnected(sym.var("data"), sym.var("w"), sym.var("b"),
+                           num_hidden=4)
+    js = s.tojson().encode()
+    h = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateFromJSON(js, ctypes.byref(h)))
+    n = ctypes.c_uint32()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib, lib.MXSymbolListArguments(h, ctypes.byref(n),
+                                          ctypes.byref(arr)))
+    assert [arr[i].decode() for i in range(n.value)] == ["data", "w", "b"]
+    out_json = ctypes.c_char_p()
+    _check(lib, lib.MXSymbolSaveToJSON(h, ctypes.byref(out_json)))
+    parsed = json.loads(out_json.value.decode())
+    assert any(node.get("op") == "FullyConnected"
+               for node in parsed["nodes"])
+    lib.MXSymbolFree(h)
+
+
+def test_predict_api_end_to_end(lib, tmp_path):
+    """The serving path: build+save a model in Python, serve it through the
+    C predict ABI only (MXPredCreate → SetInput → Forward → GetOutput)."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+    import incubator_mxnet_tpu.symbol as sym
+    from incubator_mxnet_tpu.ndarray import legacy_io
+
+    rng = np.random.RandomState(0)
+    w = rng.normal(size=(4, 6)).astype(np.float32)
+    b = np.zeros(4, np.float32)
+    out = sym.FullyConnected(sym.var("data"), sym.var("w"), sym.var("b"),
+                             num_hidden=4)
+    out = sym.Activation(out, act_type="tanh")
+    blob = legacy_io.save_legacy([nd.array(w), nd.array(b)],
+                                 ["arg:w", "arg:b"])
+    json_str = out.tojson().encode()
+
+    indptr = (ctypes.c_uint32 * 2)(0, 2)
+    shape_data = (ctypes.c_uint32 * 2)(2, 6)
+    keys = (ctypes.c_char_p * 1)(b"data")
+    h = ctypes.c_void_p()
+    _check(lib, lib.MXPredCreate(json_str, blob, len(blob), 1, 0, 1, keys,
+                                 indptr, shape_data, ctypes.byref(h)))
+    x = rng.normal(size=(2, 6)).astype(np.float32)
+    _check(lib, lib.MXPredSetInput(
+        h, b"data", x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint32(12)))
+    _check(lib, lib.MXPredForward(h))
+    sdata = ctypes.POINTER(ctypes.c_uint32)()
+    sdim = ctypes.c_uint32()
+    _check(lib, lib.MXPredGetOutputShape(h, 0, ctypes.byref(sdata),
+                                         ctypes.byref(sdim)))
+    oshape = [sdata[i] for i in range(sdim.value)]
+    assert oshape == [2, 4]
+    got = np.zeros(8, np.float32)
+    _check(lib, lib.MXPredGetOutput(
+        h, 0, got.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint32(8)))
+    expect = np.tanh(x @ w.T + b)
+    np.testing.assert_allclose(got.reshape(2, 4), expect, rtol=1e-5,
+                               atol=1e-6)
+    lib.MXPredFree(h)

@@ -1,0 +1,52 @@
+"""TPU-vs-CPU consistency leg (``pytest -m tpu``).
+
+The op suite normally runs CPU-pinned (tests/conftest.py).  This marker
+test spawns a FRESH interpreter without the CPU pin so the check drives the
+real TPU backend, cross-checking op results against XLA-CPU for f32 and
+bf16 (reference ``check_consistency``, ``python/mxnet/test_utils.py:1422``).
+
+Run on hardware:  python -m pytest tests -m tpu -q
+This is the documented pre-bench gate: run it before bench.py whenever
+op/kernel code changed (it is what catches bf16-class bugs before the
+driver's benchmark does).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tpu_available():
+    # the axon terminal exports a TPU via the default backend; probe cheaply
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "import jax,sys;"
+         "sys.exit(0 if any(d.platform=='tpu' for d in jax.devices())"
+         " else 1)"],
+        env=env, capture_output=True, timeout=120)
+    return probe.returncode == 0
+
+
+@pytest.mark.tpu
+def test_tpu_vs_cpu_op_consistency():
+    if not _tpu_available():
+        pytest.skip("no TPU backend reachable")
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    # append (not replace): the TPU plugin may be registered through a
+    # sitecustomize reached via the existing PYTHONPATH
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools",
+                                      "check_consistency.py")],
+        env=env, capture_output=True, text=True, timeout=1200)
+    sys.stderr.write(proc.stderr[-4000:])
+    assert proc.returncode == 0, proc.stdout + proc.stderr[-2000:]
+    last = proc.stdout.strip().splitlines()[-1]
+    summary = json.loads(last)
+    assert summary.get("failures", 1) == 0
+    assert summary.get("checked", 0) >= 40

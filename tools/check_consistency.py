@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""TPU-vs-CPU operator consistency check (``check_consistency`` analog,
+reference ``python/mxnet/test_utils.py:1422``: run the same op across
+ctx/dtype combinations and cross-compare).
+
+Runs a battery of registered ops on BOTH the TPU backend and the XLA-CPU
+backend **in one process** (jax exposes both device sets) for float32 and
+bfloat16 and asserts agreement within per-dtype tolerances.  This is the
+pre-bench gate that catches TPU-lowering/precision bugs (bf16 matmul
+accumulation, layout bugs, Mosaic kernel divergence) before the driver's
+benchmark does.
+
+Usage:  python tools/check_consistency.py        (needs a reachable TPU)
+Exit status 0 = all ops agree; 1 = mismatch (details on stderr).
+"""
+import json
+import os
+import sys
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _cases():
+    """(name, op, input arrays, attrs, needs_key) — the op families that
+    carry the graded configs."""
+    r = np.random.RandomState(0)
+
+    def f(*shape):
+        return r.normal(0, 1, shape).astype(np.float32)
+
+    return [
+        ("FullyConnected", "FullyConnected",
+         [f(8, 32), f(16, 32), f(16)], {"num_hidden": 16}),
+        ("dot", "dot", [f(16, 24), f(24, 8)], {}),
+        ("batch_dot", "batch_dot", [f(4, 8, 12), f(4, 12, 6)], {}),
+        ("Convolution", "Convolution",
+         [f(2, 3, 16, 16), f(8, 3, 3, 3), f(8)],
+         {"kernel": (3, 3), "num_filter": 8, "pad": (1, 1)}),
+        ("Pooling_max", "Pooling", [f(2, 4, 12, 12)],
+         {"kernel": (2, 2), "stride": (2, 2), "pool_type": "max"}),
+        ("Pooling_avg", "Pooling", [f(2, 4, 12, 12)],
+         {"kernel": (2, 2), "stride": (2, 2), "pool_type": "avg"}),
+        ("BatchNorm", "BatchNorm",
+         [f(4, 6, 8, 8), np.abs(f(6)) + 0.5, f(6), f(6),
+          np.abs(f(6)) + 0.5], {"fix_gamma": False}),
+        ("LayerNorm", "LayerNorm", [f(4, 32), np.abs(f(32)) + 0.5, f(32)],
+         {}),
+        ("softmax", "softmax", [f(6, 50)], {}),
+        ("log_softmax", "log_softmax", [f(6, 50)], {}),
+        ("relu", "relu", [f(4, 64)], {}),
+        ("sigmoid", "sigmoid", [f(4, 64)], {}),
+        ("tanh", "tanh", [f(4, 64)], {}),
+        ("exp", "exp", [f(4, 64) * 0.3], {}),
+        ("sum", "sum", [f(4, 8, 16)], {"axis": (1, 2)}),
+        ("mean", "mean", [f(4, 8, 16)], {"axis": 1}),
+        ("max", "max", [f(4, 8, 16)], {"axis": 2}),
+        ("broadcast_add", "broadcast_add", [f(4, 1, 8), f(1, 6, 8)], {}),
+        ("broadcast_mul", "broadcast_mul", [f(4, 6, 1), f(4, 1, 8)], {}),
+        ("transpose", "transpose", [f(3, 4, 5)], {"axes": (2, 0, 1)}),
+        ("take", "take", [f(10, 4),
+                          np.array([0, 3, 7, 9], np.float32)], {}),
+        ("topk", "topk", [f(4, 32)], {"k": 5, "ret_typ": "value"}),
+        ("norm", "norm", [f(4, 16)], {"ord": 2, "axis": 1}),
+    ]
+
+
+_MXU_OPS = {"FullyConnected", "dot", "batch_dot", "Convolution"}
+
+
+def _tol(dtype, name):
+    """Per-dtype tolerance; MXU (matmul/conv) ops compare looser in f32
+    because the TPU's default f32 matmul path multiplies in bf16 with f32
+    accumulation (3-pass), which is the configuration the framework ships
+    (the reference's check_consistency likewise keys tolerance on ctx+dtype,
+    test_utils.py:1422)."""
+    if name.split("_")[0] in _MXU_OPS or name in _MXU_OPS:
+        # bf16 multiply eps is 2^-8 ≈ 4e-3 of the operand scale; accumulated
+        # over the contraction the absolute error is ~1e-2 of max|out|
+        return {"float32": (2e-2, 1e-2), "bfloat16": (6e-2, 2e-2)}[dtype]
+    return {"float32": (1e-4, 1e-5), "bfloat16": (5e-2, 5e-3)}[dtype]
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.ops import registry as reg
+
+    tpu_devs = [d for d in jax.devices() if d.platform == "tpu"]
+    if not tpu_devs:
+        print(json.dumps({"skipped": "no tpu device"}))
+        return 0
+    cpu_dev = jax.devices("cpu")[0]
+    tpu_dev = tpu_devs[0]
+
+    failures = []
+    n_checked = 0
+    for dtype in ("float32", "bfloat16"):
+        for name, opname, arrays, attrs in _cases():
+            rtol, atol = _tol(dtype, name)
+            op = reg.get_op(opname)
+            try:
+                args_c, args_t = [], []
+                for a in arrays:
+                    x = jnp.asarray(a)
+                    if dtype == "bfloat16" and x.dtype == jnp.float32:
+                        x = x.astype(jnp.bfloat16)
+                    args_c.append(jax.device_put(x, cpu_dev))
+                    args_t.append(jax.device_put(x, tpu_dev))
+                out_c = jax.jit(
+                    lambda *xs: op.fn(*xs, **attrs))(*args_c)
+                out_t = jax.jit(
+                    lambda *xs: op.fn(*xs, **attrs))(*args_t)
+                oc = out_c[0] if isinstance(out_c, (tuple, list)) else out_c
+                ot = out_t[0] if isinstance(out_t, (tuple, list)) else out_t
+                ref = np.asarray(oc, np.float32)
+                got = np.asarray(ot, np.float32)
+                # atol scales with the output magnitude: MXU rounding error
+                # is absolute in units of max|out|, so near-zero elements of
+                # a matmul must not be held to a pure relative bound
+                scale = float(np.abs(ref).max()) if ref.size else 1.0
+                np.testing.assert_allclose(
+                    ref, got, rtol=rtol, atol=atol * max(scale, 1.0))
+                n_checked += 1
+            except AssertionError as e:
+                failures.append((name, dtype, str(e).split("\n")[0]))
+            except Exception:
+                failures.append((name, dtype, traceback.format_exc(
+                    limit=1).strip().replace("\n", " ")))
+
+    # flash attention: compiled Mosaic kernel vs CPU interpret mode
+    try:
+        from incubator_mxnet_tpu.parallel.ring_attention import (
+            attention_reference)
+        import importlib
+
+        fa = importlib.import_module(
+            "incubator_mxnet_tpu.parallel.flash_attention")
+        r = np.random.RandomState(1)
+        q, k, v = (jnp.asarray(
+            r.normal(size=(2, 2, 256, 64)).astype(np.float32)) * 0.2
+            for _ in range(3))
+        out_t = jax.jit(lambda q, k, v: fa.flash_attention(
+            q, k, v, causal=True, interpret=False))(
+            *(jax.device_put(x, tpu_dev) for x in (q, k, v)))
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out_t), np.asarray(ref),
+                                   rtol=2e-2, atol=2e-3)
+        n_checked += 1
+    except AssertionError as e:
+        failures.append(("flash_attention", "float32",
+                         str(e).split("\n")[0]))
+
+    result = {"checked": n_checked, "failures": len(failures)}
+    if failures:
+        for name, dtype, msg in failures:
+            print("FAIL %s[%s]: %s" % (name, dtype, msg), file=sys.stderr)
+        print(json.dumps(result))
+        return 1
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
