@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Gluon imperative/hybrid training loop (reference:
+example/gluon/image_classification.py:195-228 — model_zoo network,
+hybridize→CachedOp, autograd.record, Trainer + kvstore device).
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.gluon.model_zoo import vision
+from incubator_mxnet_tpu.io import NDArrayIter
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet18_v1")
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--num-classes", type=int, default=10)
+    ap.add_argument("--image-size", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--samples", type=int, default=256)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--no-hybridize", action="store_true")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    mx.random.seed(0)
+    net = getattr(vision, args.model)(classes=args.num_classes)
+    net.initialize(init=mx.init.Xavier())
+    net.shape_init((1, 3, args.image_size, args.image_size))
+    if not args.no_hybridize:
+        net.hybridize()
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(args.samples, 3, args.image_size,
+                 args.image_size).astype(np.float32)
+    Y = rng.randint(0, args.num_classes, args.samples).astype(np.float32)
+    it = NDArrayIter(X, Y, args.batch_size, shuffle=True)
+
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9},
+                            kvstore=mx.kv.create("device"))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        it.reset()
+        metric.reset()
+        total, nb = 0.0, 0
+        for batch in it:
+            x, y = batch.data[0], batch.label[0]
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y).mean()
+            loss.backward()
+            trainer.step(args.batch_size)
+            metric.update([y], [out])
+            total += float(loss.asscalar())
+            nb += 1
+        logging.info("epoch %d  loss %.4f  %s", epoch, total / nb,
+                     metric.get())
+
+
+if __name__ == "__main__":
+    main()

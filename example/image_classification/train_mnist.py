@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Graded config 1: LeNet/MLP on MNIST through the Module API
+(reference: example/image-classification/train_mnist.py:99 +
+common/fit.py:150 — symbolic compose, MNISTIter/NDArrayIter, Module.fit,
+SoftmaxOutput, SGD, kvstore).
+
+Runs on real MNIST idx files when --data-dir has them, otherwise on a
+synthetic stand-in so the script is runnable anywhere.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import io as mio
+from incubator_mxnet_tpu import symbol as sym
+
+
+def mlp_symbol(num_classes=10):
+    # example/image-classification/symbols/mlp.py structure
+    data = sym.var("data")
+    h = sym.FullyConnected(data, sym.var("fc1_weight"), sym.var("fc1_bias"),
+                           num_hidden=128)
+    h = sym.Activation(h, act_type="relu")
+    h = sym.FullyConnected(h, sym.var("fc2_weight"), sym.var("fc2_bias"),
+                           num_hidden=64)
+    h = sym.Activation(h, act_type="relu")
+    h = sym.FullyConnected(h, sym.var("fc3_weight"), sym.var("fc3_bias"),
+                           num_hidden=num_classes)
+    return sym.SoftmaxOutput(h, sym.var("softmax_label"), name="softmax")
+
+
+def lenet_symbol(num_classes=10):
+    # example/image-classification/symbols/lenet.py structure
+    data = sym.var("data")
+    c1 = sym.Convolution(data, sym.var("c1_weight"), sym.var("c1_bias"),
+                         kernel=(5, 5), num_filter=20)
+    a1 = sym.Activation(c1, act_type="tanh")
+    p1 = sym.Pooling(a1, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    c2 = sym.Convolution(p1, sym.var("c2_weight"), sym.var("c2_bias"),
+                         kernel=(5, 5), num_filter=50)
+    a2 = sym.Activation(c2, act_type="tanh")
+    p2 = sym.Pooling(a2, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    f = sym.Flatten(p2)
+    h = sym.FullyConnected(f, sym.var("fc1_weight"), sym.var("fc1_bias"),
+                           num_hidden=500)
+    h = sym.Activation(h, act_type="tanh")
+    h = sym.FullyConnected(h, sym.var("fc2_weight"), sym.var("fc2_bias"),
+                           num_hidden=num_classes)
+    return sym.SoftmaxOutput(h, sym.var("softmax_label"), name="softmax")
+
+
+def get_iters(args, flat):
+    imgs = os.path.join(args.data_dir, "train-images-idx3-ubyte.gz")
+    labs = os.path.join(args.data_dir, "train-labels-idx1-ubyte.gz")
+    if os.path.exists(imgs):
+        train = mio.MNISTIter(image=imgs, label=labs,
+                              batch_size=args.batch_size, flat=flat)
+        return train, None
+    logging.warning("no MNIST files in %s — synthetic data", args.data_dir)
+    rng = np.random.RandomState(0)
+    n = 2048
+    x = rng.rand(n, 784).astype(np.float32) if flat else \
+        rng.rand(n, 1, 28, 28).astype(np.float32)
+    y = rng.randint(0, 10, n).astype(np.float32)
+    split = n - 512
+    return (mio.NDArrayIter(x[:split], y[:split], args.batch_size,
+                            shuffle=True),
+            mio.NDArrayIter(x[split:], y[split:], args.batch_size))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="mlp", choices=["mlp", "lenet"])
+    ap.add_argument("--data-dir", default="data/mnist")
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--kv-store", default="local")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    net = mlp_symbol() if args.network == "mlp" else lenet_symbol()
+    train, val = get_iters(args, flat=args.network == "mlp")
+    kv = mx.kv.create(args.kv_store)
+    mod = mx.mod.Module(net, data_names=["data"],
+                        label_names=["softmax_label"])
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            kvstore=kv, eval_metric="acc",
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))
+
+
+if __name__ == "__main__":
+    main()

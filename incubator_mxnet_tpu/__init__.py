@@ -18,6 +18,8 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+import os as _os
+
 import jax as _jax
 
 # float64/int64 are first-class dtypes in the reference (mshadow base.h);
@@ -25,6 +27,17 @@ import jax as _jax
 # explicit float32, and python scalars stay weakly typed, so this does not
 # change default numerics.
 _jax.config.update("jax_enable_x64", True)
+
+# Make $JAX_PLATFORMS authoritative: some environments (e.g. the axon
+# terminal's sitecustomize) force-select a platform after the user's env is
+# read, so `JAX_PLATFORMS=cpu python script.py` would still dial the TPU
+# tunnel (and hang if it is down). Re-pin at config level — harmless when
+# they already agree — unless a backend was initialized by earlier imports.
+if _os.environ.get("JAX_PLATFORMS"):
+    try:
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    except Exception:  # backend already up — leave it alone
+        pass
 
 from . import base
 from .base import MXNetError
@@ -75,3 +88,5 @@ from .monitor import Monitor
 from . import visualization
 from . import visualization as viz
 from . import runtime
+from . import rtc
+from . import subgraph

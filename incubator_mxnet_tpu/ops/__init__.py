@@ -17,6 +17,8 @@ from . import linalg  # noqa: F401  (la_op family)
 from . import contrib  # noqa: F401  (detection/bounding-box ops)
 from . import control_flow  # noqa: F401  (foreach/while_loop/cond)
 from . import quantization  # noqa: F401  (int8 ops)
+from . import contrib_tail  # noqa: F401  (warping/deformable/proposal/
+#                                          transformer-matmul/fft tail)
 
 __all__ = ["registry", "Op", "get_op", "invoke", "invoke_raw", "list_ops",
            "register"]

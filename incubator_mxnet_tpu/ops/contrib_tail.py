@@ -1,0 +1,608 @@
+"""Operator long tail: spatial warping, deformable ops, RPN proposals,
+fused transformer matmuls, fft/count_sketch, masking/index utilities.
+
+Reference parity targets (``/root/reference``):
+- SpatialTransformer/GridGenerator (``src/operator/spatial_transformer.cc``,
+  ``grid_generator.cc``), BilinearSampler (``bilinear_sampler.cc``),
+  Correlation (``correlation.cc``), Crop (``crop.cc``)
+- DeformableConvolution / DeformablePSROIPooling
+  (``src/operator/contrib/deformable_convolution.cc``,
+  ``deformable_psroi_pooling.cc``)
+- Proposal / MultiProposal (``src/operator/contrib/proposal.cc``,
+  ``multi_proposal.cc``)
+- SyncBatchNorm (``src/operator/contrib/sync_batch_norm.cc``)
+- interleaved_matmul_* + div_sqrt_dim
+  (``src/operator/contrib/transformer.cc:125-255``)
+- fft / ifft / count_sketch (``src/operator/contrib/fft.cc``, ``ifft.cc``,
+  ``count_sketch.cc``)
+- boolean_mask / index_copy / index_array
+  (``src/operator/contrib/boolean_mask.cc``, ``index_copy.cc``,
+  ``index_array.cc``)
+
+TPU-native notes: everything is a pure jnp/lax function with static output
+shapes except ``boolean_mask`` (inherently dynamic — eager-only, like the
+reference's CPU-sync path).  Bilinear sampling is the shared primitive for
+the whole warping family, expressed as gathers so XLA vectorizes it;
+displacement/tap enumerations are static Python loops that unroll into the
+program (K*K taps, D*D displacements — small constants the MXU pipeline
+eats).  SyncBatchNorm under GSPMD needs no special comm: a batch-sharded
+global array's mean IS the cross-device mean (the all-reduce is inserted by
+the partitioner), which is exactly what the reference's cross-GPU reduction
+emulates.
+"""
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .nn import _batch_norm, _batch_norm_aux_update
+from .registry import OPS, register
+
+__all__ = []
+
+
+# ---------------------------------------------------------------------------
+# bilinear sampling primitive
+# ---------------------------------------------------------------------------
+
+def _bilinear_gather(data, xs, ys):
+    """Sample data (N,C,H,W) at float pixel coords xs/ys (N, ...) with
+    zero padding outside; differentiable in data and coords."""
+    n, c, h, w = data.shape
+    out_shape = xs.shape[1:]
+    xs = xs.reshape(n, -1)
+    ys = ys.reshape(n, -1)
+    x0 = jnp.floor(xs)
+    y0 = jnp.floor(ys)
+    wx = xs - x0
+    wy = ys - y0
+
+    def tap(yi, xi):
+        valid = ((xi >= 0) & (xi <= w - 1) & (yi >= 0)
+                 & (yi <= h - 1)).astype(data.dtype)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        # gather per batch: (N, C, P)
+        flat = data.reshape(n, c, h * w)
+        idx = yc * w + xc  # (N, P)
+        vals = jnp.take_along_axis(flat, idx[:, None, :].repeat(c, 1),
+                                   axis=2)
+        return vals * valid[:, None, :]
+
+    v00 = tap(y0, x0)
+    v01 = tap(y0, x0 + 1)
+    v10 = tap(y0 + 1, x0)
+    v11 = tap(y0 + 1, x0 + 1)
+    wx = wx[:, None, :]
+    wy = wy[:, None, :]
+    out = (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+           + v10 * (1 - wx) * wy + v11 * wx * wy)
+    return out.reshape((n, c) + out_shape)
+
+
+# ---------------------------------------------------------------------------
+# GridGenerator / BilinearSampler / SpatialTransformer / Crop / Correlation
+# ---------------------------------------------------------------------------
+
+@register("GridGenerator", num_inputs=1)
+def _grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """Affine: (N,6) params -> (N,2,H,W) sampling grid in [-1,1]; warp:
+    (N,2,H,W) flow -> normalized identity+flow grid
+    (grid_generator.cc semantics)."""
+    if transform_type == "affine":
+        h, w = int(target_shape[0]), int(target_shape[1])
+        theta = data.reshape(-1, 2, 3)
+        xs = jnp.linspace(-1.0, 1.0, w)
+        ys = jnp.linspace(-1.0, 1.0, h)
+        gx, gy = jnp.meshgrid(xs, ys)  # (H, W)
+        ones = jnp.ones_like(gx)
+        src = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3, HW)
+        out = jnp.einsum("nij,jp->nip", theta.astype(jnp.float32),
+                         src.astype(jnp.float32))
+        return out.reshape(-1, 2, h, w).astype(data.dtype)
+    # warp: flow field added to the identity pixel grid, then normalized
+    n, _two, h, w = data.shape
+    gx, gy = jnp.meshgrid(jnp.arange(w, dtype=jnp.float32),
+                          jnp.arange(h, dtype=jnp.float32))
+    fx = data[:, 0].astype(jnp.float32) + gx
+    fy = data[:, 1].astype(jnp.float32) + gy
+    nx = 2.0 * fx / max(w - 1, 1) - 1.0
+    ny = 2.0 * fy / max(h - 1, 1) - 1.0
+    return jnp.stack([nx, ny], axis=1).astype(data.dtype)
+
+
+@register("BilinearSampler", num_inputs=2)
+def _bilinear_sampler(data, grid, cudnn_off=False):
+    """Sample data (N,C,H,W) at grid (N,2,Ho,Wo) of normalized (x,y) in
+    [-1,1]; zero padding outside (bilinear_sampler.cc)."""
+    n, c, h, w = data.shape
+    xs = (grid[:, 0].astype(jnp.float32) + 1.0) * (w - 1) / 2.0
+    ys = (grid[:, 1].astype(jnp.float32) + 1.0) * (h - 1) / 2.0
+    return _bilinear_gather(data.astype(jnp.float32), xs, ys).astype(
+        data.dtype)
+
+
+@register("SpatialTransformer", num_inputs=2)
+def _spatial_transformer(data, loc, target_shape=(0, 0),
+                         transform_type="affine", sampler_type="bilinear",
+                         cudnn_off=False):
+    """Affine grid from loc (N,6) + bilinear sampling
+    (spatial_transformer.cc)."""
+    grid = _grid_generator(loc, transform_type="affine",
+                           target_shape=target_shape)
+    return _bilinear_sampler(data, grid)
+
+
+@register("Crop", num_inputs=None, differentiable=True)
+def _crop(*args, offset=(0, 0), h_w=(0, 0), num_args=0, center_crop=False):
+    """v1 Crop (crop.cc): crop args[0] to h_w or to args[1]'s spatial
+    shape, at offset or centered."""
+    data = args[0]
+    if len(args) > 1 and args[1] is not None:
+        th, tw = args[1].shape[2], args[1].shape[3]
+    else:
+        th, tw = int(h_w[0]), int(h_w[1])
+    h, w = data.shape[2], data.shape[3]
+    if center_crop:
+        y0, x0 = (h - th) // 2, (w - tw) // 2
+    else:
+        y0, x0 = int(offset[0]), int(offset[1])
+    return data[:, :, y0:y0 + th, x0:x0 + tw]
+
+
+@register("Correlation", num_inputs=2)
+def _correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                 stride2=1, pad_size=0, is_multiply=True):
+    """FlowNet correlation volume (correlation.cc): for each displacement
+    (dy,dx) on the stride2 grid, the patchwise product (or abs-diff) of
+    data1 and shifted data2, averaged over the kernel window and channels."""
+    k = int(kernel_size)
+    md = int(max_displacement)
+    s1, s2, pad = int(stride1), int(stride2), int(pad_size)
+    n, c, h, w = data1.shape
+    d1 = jnp.pad(data1.astype(jnp.float32),
+                 ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    d2 = jnp.pad(data2.astype(jnp.float32),
+                 ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    grid_radius = md // s2
+    disps = [(dy * s2, dx * s2)
+             for dy in range(-grid_radius, grid_radius + 1)
+             for dx in range(-grid_radius, grid_radius + 1)]
+    hp, wp = h + 2 * pad, w + 2 * pad
+    planes = []
+    for dy, dx in disps:
+        shifted = jnp.roll(d2, shift=(-dy, -dx), axis=(2, 3))
+        prod = d1 * shifted if is_multiply else -jnp.abs(d1 - shifted)
+        summed = prod.mean(axis=1)  # over channels -> (N, Hp, Wp)
+        if k > 1:
+            summed = lax.reduce_window(
+                summed, 0.0, lax.add, (1, k, k), (1, 1, 1),
+                [(0, 0), (k // 2, k // 2), (k // 2, k // 2)]) / (k * k)
+        planes.append(summed)
+    out = jnp.stack(planes, axis=1)  # (N, D*D, Hp, Wp)
+    out = out[:, :, ::s1, ::s1]
+    return out.astype(data1.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Deformable ops
+# ---------------------------------------------------------------------------
+
+@register("_contrib_DeformableConvolution", num_inputs=None,
+          aliases=("DeformableConvolution",))
+def _deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                            num_filter=1, stride=(1, 1), pad=(0, 0),
+                            dilate=(1, 1), num_deformable_group=1,
+                            num_group=1, no_bias=False, workspace=1024,
+                            layout=None):
+    """Deformable conv v1 (deformable_convolution.cc): each kernel tap
+    samples the input at its integer position plus a learned fractional
+    offset (bilinear), then the taps contract with the weights — expressed
+    here as K*K bilinear gathers + one matmul per tap (MXU-friendly; no
+    im2col scratch)."""
+    kh, kw = int(kernel[0]), int(kernel[1])
+    sh, sw = int(stride[0]), int(stride[1])
+    ph, pw = int(pad[0]), int(pad[1])
+    dh, dw = int(dilate[0]), int(dilate[1])
+    g = int(num_deformable_group)
+    n, c, h, w = data.shape
+    f = int(num_filter)
+    ho = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    wo = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+
+    base_y = jnp.arange(ho, dtype=jnp.float32) * sh - ph
+    base_x = jnp.arange(wo, dtype=jnp.float32) * sw - pw
+    gy, gx = jnp.meshgrid(base_y, base_x, indexing="ij")  # (Ho, Wo)
+
+    dataf = data.astype(jnp.float32)
+    off = offset.astype(jnp.float32).reshape(n, g, kh * kw, 2, ho, wo)
+    cg = c // g
+    out = jnp.zeros((n, f, ho, wo), jnp.float32)
+    wmat = weight.astype(jnp.float32)
+    for i in range(kh):
+        for j in range(kw):
+            tapi = i * kw + j
+            sampled_groups = []
+            for gi in range(g):
+                dy = off[:, gi, tapi, 0]          # (N, Ho, Wo)
+                dx = off[:, gi, tapi, 1]
+                ys = gy[None] + i * dh + dy
+                xs = gx[None] + j * dw + dx
+                part = _bilinear_gather(
+                    dataf[:, gi * cg:(gi + 1) * cg], xs, ys)
+                sampled_groups.append(part)
+            sampled = jnp.concatenate(sampled_groups, axis=1)  # (N,C,Ho,Wo)
+            out = out + jnp.einsum("nchw,fc->nfhw", sampled, wmat[:, :, i, j])
+    if bias is not None and not no_bias:
+        out = out + bias.astype(jnp.float32).reshape(1, -1, 1, 1)
+    return out.astype(data.dtype)
+
+
+@register("_contrib_DeformablePSROIPooling", num_inputs=None,
+          aliases=("DeformablePSROIPooling",))
+def _deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
+                              output_dim=1, group_size=1, pooled_size=1,
+                              part_size=0, sample_per_part=1, trans_std=0.0,
+                              no_trans=False):
+    """Position-sensitive ROI pooling with learned part offsets
+    (deformable_psroi_pooling.cc).  data channels = output_dim * group^2;
+    each pooled cell averages sample_per_part^2 bilinear samples from its
+    position-sensitive channel group, optionally displaced by trans."""
+    ps = int(pooled_size)
+    gs = int(group_size)
+    spp = int(sample_per_part)
+    od = int(output_dim)
+    part = int(part_size) or ps
+    n, c, h, w = data.shape
+    r = rois.shape[0]
+    dataf = data.astype(jnp.float32)
+    roisf = rois.astype(jnp.float32)
+
+    batch_idx = roisf[:, 0].astype(jnp.int32)
+    x1 = roisf[:, 1] * spatial_scale - 0.5
+    y1 = roisf[:, 2] * spatial_scale - 0.5
+    x2 = (roisf[:, 3] + 1.0) * spatial_scale - 0.5
+    y2 = (roisf[:, 4] + 1.0) * spatial_scale - 0.5
+    rw = jnp.maximum(x2 - x1, 0.1)
+    rh = jnp.maximum(y2 - y1, 0.1)
+    bin_w = rw / ps
+    bin_h = rh / ps
+
+    data_per_roi = dataf[batch_idx]  # (R, C, H, W)
+    outs = []
+    for py in range(ps):
+        for px in range(ps):
+            if no_trans or trans is None:
+                ty = jnp.zeros((r,), jnp.float32)
+                tx = jnp.zeros((r,), jnp.float32)
+            else:
+                tpy = min(py * part // ps, part - 1)
+                tpx = min(px * part // ps, part - 1)
+                transf = trans.astype(jnp.float32)
+                cls = jnp.zeros((r,), jnp.int32)  # class-agnostic offsets
+                ty = transf[jnp.arange(r) % transf.shape[0], 0, tpy,
+                            tpx] * trans_std * rh
+                tx = transf[jnp.arange(r) % transf.shape[0], 1, tpy,
+                            tpx] * trans_std * rw
+                del cls
+            acc = 0.0
+            for sy in range(spp):
+                for sx in range(spp):
+                    ys = (y1 + py * bin_h + (sy + 0.5) * bin_h / spp
+                          + ty)[:, None, None]
+                    xs = (x1 + px * bin_w + (sx + 0.5) * bin_w / spp
+                          + tx)[:, None, None]
+                    acc = acc + _bilinear_gather(data_per_roi, xs, ys)
+            acc = acc / (spp * spp)  # (R, C, 1, 1)
+            gy = min(py * gs // ps, gs - 1)
+            gx = min(px * gs // ps, gs - 1)
+            chan = acc[:, (gy * gs + gx) * od:(gy * gs + gx + 1) * od, 0, 0]
+            outs.append(chan)  # (R, output_dim)
+    out = jnp.stack(outs, axis=-1).reshape(r, od, ps, ps)
+    return out.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# RPN Proposal / MultiProposal
+# ---------------------------------------------------------------------------
+
+def _make_anchors(feat_h, feat_w, stride, scales, ratios):
+    base = float(stride)
+    px, py = (base - 1) / 2.0, (base - 1) / 2.0
+    anchors = []
+    for ratio in ratios:
+        size = base * base
+        size_r = size / ratio
+        ws = round(_math.sqrt(size_r))
+        hs = round(ws * ratio)
+        for scale in scales:
+            w_s, h_s = ws * scale, hs * scale
+            anchors.append([px - (w_s - 1) / 2, py - (h_s - 1) / 2,
+                            px + (w_s - 1) / 2, py + (h_s - 1) / 2])
+    a = jnp.asarray(anchors, jnp.float32)  # (A, 4)
+    sx = jnp.arange(feat_w, dtype=jnp.float32) * stride
+    sy = jnp.arange(feat_h, dtype=jnp.float32) * stride
+    gy, gx = jnp.meshgrid(sy, sx, indexing="ij")
+    shifts = jnp.stack([gx.ravel(), gy.ravel(), gx.ravel(), gy.ravel()],
+                       axis=1)  # (HW, 4)
+    return (shifts[:, None, :] + a[None]).reshape(-1, 4)  # (HW*A, 4)
+
+
+def _proposal_one(scores, deltas, im_info, anchors, pre_n, post_n,
+                  nms_thresh, min_size, iou_loss):
+    """scores (K,), deltas (K,4), anchors (K,4) -> (post_n, 5) [score,box]"""
+    widths = anchors[:, 2] - anchors[:, 0] + 1.0
+    heights = anchors[:, 3] - anchors[:, 1] + 1.0
+    ctr_x = anchors[:, 0] + 0.5 * (widths - 1)
+    ctr_y = anchors[:, 1] + 0.5 * (heights - 1)
+    if iou_loss:
+        x1 = anchors[:, 0] + deltas[:, 0]
+        y1 = anchors[:, 1] + deltas[:, 1]
+        x2 = anchors[:, 2] + deltas[:, 2]
+        y2 = anchors[:, 3] + deltas[:, 3]
+    else:
+        px = deltas[:, 0] * widths + ctr_x
+        py = deltas[:, 1] * heights + ctr_y
+        pw = jnp.exp(jnp.clip(deltas[:, 2], -10, 10)) * widths
+        ph = jnp.exp(jnp.clip(deltas[:, 3], -10, 10)) * heights
+        x1 = px - 0.5 * (pw - 1)
+        y1 = py - 0.5 * (ph - 1)
+        x2 = px + 0.5 * (pw - 1)
+        y2 = py + 0.5 * (ph - 1)
+    imh, imw = im_info[0], im_info[1]
+    x1 = jnp.clip(x1, 0, imw - 1.0)
+    y1 = jnp.clip(y1, 0, imh - 1.0)
+    x2 = jnp.clip(x2, 0, imw - 1.0)
+    y2 = jnp.clip(y2, 0, imh - 1.0)
+    ms = min_size * im_info[2]
+    keep = ((x2 - x1 + 1) >= ms) & ((y2 - y1 + 1) >= ms)
+    scores = jnp.where(keep, scores, -1.0)
+
+    pre_n = min(pre_n, scores.shape[0])
+    top_scores, order = lax.top_k(scores, pre_n)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=1)[order]  # (pre_n, 4)
+
+    # greedy NMS over the static pre_n set (proposal.cc NonMaximumSuppress)
+    def area(b):
+        return (b[:, 2] - b[:, 0] + 1) * (b[:, 3] - b[:, 1] + 1)
+
+    areas = area(boxes)
+
+    def body(i, state):
+        alive, picked_boxes, picked_scores, count = state
+        # highest-scoring alive candidate
+        masked = jnp.where(alive, top_scores, -jnp.inf)
+        j = jnp.argmax(masked)
+        ok = (masked[j] > -jnp.inf) & (count < post_n)
+        bj = boxes[j]
+        xx1 = jnp.maximum(boxes[:, 0], bj[0])
+        yy1 = jnp.maximum(boxes[:, 1], bj[1])
+        xx2 = jnp.minimum(boxes[:, 2], bj[2])
+        yy2 = jnp.minimum(boxes[:, 3], bj[3])
+        inter = jnp.maximum(xx2 - xx1 + 1, 0) * jnp.maximum(yy2 - yy1 + 1, 0)
+        iou = inter / (areas + areas[j] - inter)
+        suppress = iou > nms_thresh
+        new_alive = alive & ~suppress & (jnp.arange(alive.shape[0]) != j)
+        picked_boxes = lax.cond(
+            ok, lambda pb: pb.at[count].set(bj), lambda pb: pb, picked_boxes)
+        picked_scores = lax.cond(
+            ok, lambda s: s.at[count].set(top_scores[j]), lambda s: s,
+            picked_scores)
+        return (jnp.where(ok, new_alive, alive), picked_boxes, picked_scores,
+                count + ok.astype(jnp.int32))
+
+    alive0 = top_scores > -1.0
+    pb0 = jnp.zeros((post_n, 4), jnp.float32)
+    ps0 = jnp.zeros((post_n,), jnp.float32)
+    _alive, pboxes, pscores, cnt = lax.fori_loop(
+        0, pre_n, body, (alive0, pb0, ps0, jnp.int32(0)))
+    # pad empty slots with the first proposal (proposal.cc pads similarly)
+    has = jnp.arange(post_n) < cnt
+    pboxes = jnp.where(has[:, None], pboxes, pboxes[0])
+    pscores = jnp.where(has, pscores, pscores[0])
+    return pboxes, pscores
+
+
+@register("_contrib_Proposal", num_inputs=3, differentiable=False,
+          aliases=("Proposal",))
+def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+              rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+              scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16,
+              output_score=False, iou_loss=False):
+    """RPN proposal layer (proposal.cc): anchors + deltas -> clipped,
+    min-size-filtered, NMS-pruned (batch_idx, x1, y1, x2, y2) rois."""
+    n, two_a, fh, fw = cls_prob.shape
+    a = two_a // 2
+    anchors = _make_anchors(fh, fw, int(feature_stride),
+                            [float(s) for s in scales],
+                            [float(r) for r in ratios])
+    outs, scores_out = [], []
+    for b in range(n):
+        fg = cls_prob[b, a:].astype(jnp.float32)          # (A, H, W)
+        scores = fg.transpose(1, 2, 0).reshape(-1)         # HW*A order
+        deltas = bbox_pred[b].astype(jnp.float32).reshape(
+            a, 4, fh, fw).transpose(2, 3, 0, 1).reshape(-1, 4)
+        boxes, sc = _proposal_one(
+            scores, deltas, im_info[b].astype(jnp.float32), anchors,
+            int(rpn_pre_nms_top_n), int(rpn_post_nms_top_n),
+            float(threshold), float(rpn_min_size), bool(iou_loss))
+        rois = jnp.concatenate(
+            [jnp.full((boxes.shape[0], 1), float(b), jnp.float32), boxes],
+            axis=1)
+        outs.append(rois)
+        scores_out.append(sc[:, None])
+    rois = jnp.concatenate(outs, axis=0)
+    if output_score:
+        return rois, jnp.concatenate(scores_out, axis=0)
+    return rois
+
+
+OPS["_contrib_MultiProposal"] = OPS["_contrib_Proposal"]
+OPS["MultiProposal"] = OPS["_contrib_Proposal"]
+
+
+# ---------------------------------------------------------------------------
+# SyncBatchNorm
+# ---------------------------------------------------------------------------
+
+@register("_contrib_SyncBatchNorm", aliases=("SyncBatchNorm",))
+def _sync_batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                     momentum=0.9, fix_gamma=True, use_global_stats=False,
+                     output_mean_var=False, ndev=1, key=None, axis=1):
+    """Cross-device BatchNorm (sync_batch_norm.cc).  Under GSPMD the batch
+    axis is sharded over the mesh and jnp.mean over it already reduces
+    across devices (the partitioner inserts the all-reduce), so the
+    single-program BatchNorm IS synchronized — ndev/key are accepted for
+    API parity and unused."""
+    return _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=eps,
+                       momentum=momentum, fix_gamma=fix_gamma,
+                       use_global_stats=use_global_stats,
+                       output_mean_var=output_mean_var, axis=axis)
+
+
+OPS["_contrib_SyncBatchNorm"].aux_update = _batch_norm_aux_update
+OPS["_contrib_SyncBatchNorm"].mutate_idx = (3, 4)
+
+
+# ---------------------------------------------------------------------------
+# fused transformer matmuls (transformer.cc:125-255)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_div_sqrt_dim", num_inputs=1)
+def _div_sqrt_dim(data):
+    return data / jnp.sqrt(jnp.float32(data.shape[-1])).astype(data.dtype)
+
+
+def _split_qkv(qkv, heads, n_parts):
+    """(S, B, heads*hd*n) -> tuple of (B*heads, S, hd)"""
+    s, b, proj = qkv.shape
+    hd = proj // (heads * n_parts)
+    tmp = qkv.reshape(s, b, heads, n_parts, hd)
+    outs = []
+    for i in range(n_parts):
+        p = tmp[:, :, :, i, :].transpose(1, 2, 0, 3)  # (B, heads, S, hd)
+        outs.append(p.reshape(b * heads, s, hd))
+    return outs
+
+
+@register("_contrib_interleaved_matmul_selfatt_qk", num_inputs=1)
+def _interleaved_matmul_selfatt_qk(queries_keys_values, heads=1):
+    """(S, B, H*hd*3) -> scaled QK^T scores (B*H, S, S)."""
+    q, k, _v = _split_qkv(queries_keys_values, int(heads), 3)
+    q = q / jnp.sqrt(jnp.float32(q.shape[-1])).astype(q.dtype)
+    return jnp.einsum("bqd,bkd->bqk", q, k)
+
+
+@register("_contrib_interleaved_matmul_selfatt_valatt", num_inputs=2)
+def _interleaved_matmul_selfatt_valatt(queries_keys_values, attention,
+                                       heads=1):
+    """attention (B*H, S, S) @ V -> (S, B, H*hd)."""
+    s, b, proj3 = queries_keys_values.shape
+    h = int(heads)
+    _q, _k, v = _split_qkv(queries_keys_values, h, 3)
+    out = jnp.einsum("bqk,bkd->bqd", attention, v)  # (B*H, S, hd)
+    hd = out.shape[-1]
+    return out.reshape(b, h, s, hd).transpose(2, 0, 1, 3).reshape(s, b,
+                                                                  h * hd)
+
+
+@register("_contrib_interleaved_matmul_encdec_qk", num_inputs=2)
+def _interleaved_matmul_encdec_qk(queries, keys_values, heads=1):
+    """queries (Sq, B, H*hd), keys_values (Sk, B, H*hd*2) ->
+    (B*H, Sq, Sk)."""
+    h = int(heads)
+    (q,) = _split_qkv(queries, h, 1)
+    k, _v = _split_qkv(keys_values, h, 2)
+    q = q / jnp.sqrt(jnp.float32(q.shape[-1])).astype(q.dtype)
+    return jnp.einsum("bqd,bkd->bqk", q, k)
+
+
+@register("_contrib_interleaved_matmul_encdec_valatt", num_inputs=2)
+def _interleaved_matmul_encdec_valatt(keys_values, attention, heads=1):
+    sk, b, proj2 = keys_values.shape
+    h = int(heads)
+    _k, v = _split_qkv(keys_values, h, 2)
+    out = jnp.einsum("bqk,bkd->bqd", attention, v)
+    hd = out.shape[-1]
+    sq = attention.shape[1]
+    return out.reshape(b, h, sq, hd).transpose(2, 0, 1, 3).reshape(sq, b,
+                                                                   h * hd)
+
+
+# ---------------------------------------------------------------------------
+# fft / ifft / count_sketch
+# ---------------------------------------------------------------------------
+
+@register("_contrib_fft", num_inputs=1)
+def _fft(data, compute_size=128):
+    """1-D FFT over the last dim; output interleaves [re, im, re, im, ...]
+    (fft.cc: (N, d) -> (N, 2d))."""
+    spec = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    out = jnp.stack([spec.real, spec.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],)).astype(
+        jnp.float32)
+
+
+@register("_contrib_ifft", num_inputs=1)
+def _ifft(data, compute_size=128):
+    """Inverse of _contrib_fft: interleaved complex (N, 2d) -> real (N, d),
+    unnormalized like cuFFT (ifft(fft(x)) == x * d — ifft.cc)."""
+    d = data.shape[-1] // 2
+    pairs = data.astype(jnp.float32).reshape(data.shape[:-1] + (d, 2))
+    spec = pairs[..., 0] + 1j * pairs[..., 1]
+    out = jnp.fft.ifft(spec, axis=-1).real * d
+    return out.astype(jnp.float32)
+
+
+@register("_contrib_count_sketch", num_inputs=3, differentiable=False)
+def _count_sketch(data, h, s, out_dim=1, processing_batch_size=32):
+    """Count sketch projection (count_sketch.cc): out[:, h[j]] +=
+    s[j] * data[:, j]."""
+    k = int(out_dim)
+    n, d = data.shape
+    hv = jnp.broadcast_to(h.astype(jnp.int32).reshape(-1, d), (n, d))
+    sv = jnp.broadcast_to(s.astype(data.dtype).reshape(-1, d), (n, d))
+    out = jnp.zeros((n, k), data.dtype)
+    rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, d))
+    return out.at[rows, hv].add(data * sv)
+
+
+# ---------------------------------------------------------------------------
+# boolean_mask / index_copy / index_array
+# ---------------------------------------------------------------------------
+
+@register("_contrib_boolean_mask", num_inputs=2, aliases=("boolean_mask",),
+          no_trace=True)
+def _boolean_mask(data, index, axis=0):
+    """Select slices where index != 0 (boolean_mask.cc).  Output shape is
+    data-dependent → eager-only, like the reference's CPU-sync kernel; use
+    masking idioms inside compiled code."""
+    import numpy as onp
+
+    idx = onp.nonzero(onp.asarray(index) != 0)[0]
+    return jnp.take(data, jnp.asarray(idx), axis=int(axis))
+
+
+@register("_contrib_index_copy", num_inputs=3)
+def _index_copy(old, index, new):
+    """Functional index_copy (index_copy.cc): rows of ``new`` written into
+    ``old`` at ``index``."""
+    return old.at[index.astype(jnp.int32)].set(new.astype(old.dtype))
+
+
+@register("_contrib_index_array", num_inputs=1, differentiable=False)
+def _index_array(data, axes=None):
+    """Per-element N-D indices (index_array.cc): output shape
+    data.shape + (len(axes),)."""
+    shape = data.shape
+    if axes is None:
+        axes = tuple(range(len(shape)))
+    else:
+        axes = tuple(int(a) for a in axes)
+    grids = jnp.meshgrid(*[jnp.arange(s, dtype=jnp.int64) for s in shape],
+                         indexing="ij")
+    return jnp.stack([grids[a] for a in axes], axis=-1)

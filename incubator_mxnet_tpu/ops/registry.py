@@ -52,7 +52,7 @@ class Op:
 
     def __init__(self, name, fn, num_inputs=None, num_outputs=1,
                  differentiable=True, needs_rng=False, mutate_idx=(),
-                 aliases=(), doc="", aux_update=None):
+                 aliases=(), doc="", aux_update=None, no_trace=False):
         self.name = name
         self.fn = fn
         self.num_inputs = num_inputs
@@ -63,6 +63,9 @@ class Op:
         self.aliases = tuple(aliases)
         self.doc = doc or (fn.__doc__ or "")
         self.aux_update = aux_update
+        # no_trace: fn must run on concrete arrays only (data-dependent
+        # output shapes, host callbacks) — excluded from jit wrapping
+        self.no_trace = no_trace
 
     def __repr__(self):
         return "Op(%s)" % self.name
@@ -163,6 +166,50 @@ def invoke(name: str, inputs: Sequence[Any], out=None, **attrs):
     return _invoke_impl(name, inputs, out, **attrs)
 
 
+# eager-dispatch jit cache: one compiled executable per (op, static attrs)
+# — the Imperative::Invoke fast path.  Without it each eager op executes
+# primitive-by-primitive (one tiny dispatch per jnp call); with it the whole
+# op body is a single cached XLA computation, which is what makes
+# non-hybridized Gluon usable (the reference's imperative path is its fast
+# path for the same reason: one fused engine push per op).
+_EAGER_JIT: Dict[Any, Any] = {}
+
+
+def _attr_key(v):
+    if isinstance(v, (list,)):
+        return tuple(_attr_key(x) for x in v)
+    hash(v)
+    return v
+
+
+def _eager_fn(op: Op, attrs):
+    """Jitted op body with attrs baked static, or None when not cacheable
+    (unhashable attrs like subgraph Symbols, rng key operands, or ops
+    flagged no_trace e.g. data-dependent-shape kernels)."""
+    if op.no_trace or op.needs_rng:
+        return None
+    from .. import autograd, tracing
+
+    if tracing.current_trace() is not None:
+        # inside a whole-graph trace (CachedOp/Executor) the op body is
+        # being traced into the outer program — a nested jit is pure
+        # overhead AND would poison the cache with the trace's train mode
+        return None
+    try:
+        # ambient train mode is baked into the traced program (BatchNorm /
+        # Dropout read it at trace time), so it must be part of the key
+        key = (op.name, autograd.is_training(), tuple(sorted(
+            (k, _attr_key(v)) for k, v in attrs.items())))
+        hash(key)
+    except TypeError:
+        return None
+    fn = _EAGER_JIT.get(key)
+    if fn is None:
+        fn = jax.jit(functools.partial(op.fn, **attrs))
+        _EAGER_JIT[key] = fn
+    return fn
+
+
 def _invoke_impl(name: str, inputs: Sequence[Any], out=None, **attrs):
     from .. import autograd
     from ..ndarray import NDArray
@@ -184,20 +231,25 @@ def _invoke_impl(name: str, inputs: Sequence[Any], out=None, **attrs):
         and op.differentiable
         and any(autograd.requires_grad(i) for i in inputs if isinstance(i, NDArray))
     )
+    jfn = _eager_fn(op, attrs)
+
     if recording:
         # differentiate only wrt non-None tensor inputs
         live = [j for j, d in enumerate(datas) if d is not None]
+        body = (lambda *a: jfn(*a)) if jfn is not None \
+            else (lambda *a: op.fn(*a, **attrs))
 
         def fn(*xs, _datas=tuple(datas), _live=tuple(live)):
             full = list(_datas)
             for j, x in zip(_live, xs):
                 full[j] = x
-            return op.fn(*full, **attrs)
+            return body(*full)
 
         out_datas, vjp_fn = jax.vjp(fn, *[datas[j] for j in live])
         live_inputs = [inputs[j] for j in live]
     else:
-        out_datas = op.fn(*datas, **attrs)
+        out_datas = jfn(*datas) if jfn is not None \
+            else op.fn(*datas, **attrs)
 
     multi = isinstance(out_datas, (tuple, list))
     outs_list = list(out_datas) if multi else [out_datas]

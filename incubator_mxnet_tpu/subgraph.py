@@ -1,0 +1,268 @@
+"""Subgraph partitioning API (accelerator extension point).
+
+Reference contract: ``src/operator/subgraph/subgraph_property.h`` —
+``SubgraphSelector`` (:86, seed + grow via SelectInput/SelectOutput +
+Filter), ``SubgraphProperty`` (:252, CreateSubgraphSelector /
+CreateSubgraphNode), backend registry ``MXNET_REGISTER_SUBGRAPH_BACKEND``
+(:542-548), driven by ``build_subgraph.cc`` and activated with
+``MXNET_SUBGRAPH_BACKEND``.
+
+TPU-native realization (SURVEY §7): the subgraph mechanism IS the XLA
+lowering hook.  A property walks the Symbol graph, greedily groups matched
+nodes, and replaces each group with ONE node whose op executes the captured
+sub-symbol as a single jitted program.  The built-in ``xla`` backend
+captures maximal static subgraphs — on a graph containing non-traceable
+ops (e.g. Python CustomOp), partitioning isolates them and fuses everything
+else, which is exactly what the reference's MKLDNN/TensorRT properties do
+for their engines.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import jax
+
+from .ops import registry as _reg
+from .symbol.symbol import Symbol, _Node, _toposort
+
+__all__ = ["SubgraphSelector", "SubgraphProperty",
+           "register_subgraph_backend", "get_subgraph_backend",
+           "list_subgraph_backends", "build_subgraph", "partition"]
+
+
+class SubgraphSelector:
+    """Grow-from-seed selection policy (subgraph_property.h:86)."""
+
+    def select(self, node: _Node) -> bool:  # seed
+        return False
+
+    def select_input(self, cur: _Node, input_node: _Node) -> bool:
+        return False
+
+    def select_output(self, cur: _Node, output_node: _Node) -> bool:
+        return False
+
+    def filter(self, candidates: List[_Node]) -> List[_Node]:
+        return candidates
+
+    def reset(self) -> None:
+        pass
+
+
+class SubgraphProperty:
+    """Backend property: selector factory + subgraph-node construction."""
+
+    name = "base"
+
+    def create_subgraph_selector(self) -> SubgraphSelector:
+        raise NotImplementedError
+
+    def create_subgraph_node(self, sub_sym: Symbol, subgraph_id: int,
+                             input_names: List[str]) -> _Node:
+        """Default: a node running the sub-symbol as ONE jit program."""
+        op_name = "_%s_subgraph_op" % self.name
+        if op_name not in _reg.OPS:
+            _reg.register(op_name, _make_subgraph_fn(), num_inputs=None,
+                          doc="fused subgraph super-op (%s)" % self.name)
+        node = _Node(op_name, "%s_subgraph%d" % (self.name, subgraph_id),
+                     {"subgraph": sub_sym,
+                      "input_names": tuple(input_names)},
+                     num_outputs=len(sub_sym.list_outputs()))
+        return node
+
+
+def _make_subgraph_fn():
+    def subgraph_fn(*in_vals, subgraph=None, input_names=(), **_ignored):
+        from .symbol.symbol import _eval_graph
+
+        bindings = dict(zip(input_names, in_vals))
+        outs = _eval_graph(subgraph, bindings)
+        return tuple(outs) if len(outs) > 1 else outs[0]
+
+    return subgraph_fn
+
+
+_BACKENDS: Dict[str, SubgraphProperty] = {}
+
+
+def register_subgraph_backend(prop):
+    """MXNET_REGISTER_SUBGRAPH_BACKEND analog (class or instance)."""
+    inst = prop() if isinstance(prop, type) else prop
+    _BACKENDS[inst.name] = inst
+    return prop
+
+
+def get_subgraph_backend(name: str) -> SubgraphProperty:
+    return _BACKENDS[name]
+
+
+def list_subgraph_backends() -> List[str]:
+    return sorted(_BACKENDS)
+
+
+# ---------------------------------------------------------------------------
+# partitioner (build_subgraph.cc)
+# ---------------------------------------------------------------------------
+
+def _grow(seed: _Node, selector: SubgraphSelector, consumers) -> List[_Node]:
+    group = {id(seed): seed}
+    frontier = [seed]
+    while frontier:
+        cur = frontier.pop()
+        for parent, _idx in cur.inputs:
+            if not parent.is_var and id(parent) not in group \
+                    and selector.select_input(cur, parent):
+                group[id(parent)] = parent
+                frontier.append(parent)
+        for child in consumers.get(id(cur), ()):
+            if id(child) not in group and selector.select_output(cur, child):
+                group[id(child)] = child
+                frontier.append(child)
+    return list(group.values())
+
+
+def build_subgraph(symbol: Symbol, prop: SubgraphProperty) -> Symbol:
+    """Partition ``symbol``: matched node groups become super-ops."""
+    nodes = _toposort([n for n, _ in symbol._outputs])
+    consumers: Dict[int, List[_Node]] = {}
+    for n in nodes:
+        for p, _i in n.inputs:
+            consumers.setdefault(id(p), []).append(n)
+
+    order = {id(n): i for i, n in enumerate(nodes)}
+    assigned: Dict[int, int] = {}
+    groups: List[List[_Node]] = []
+    for n in nodes:
+        if n.is_var or id(n) in assigned:
+            continue
+        selector = prop.create_subgraph_selector()
+        selector.reset()
+        if not selector.select(n):
+            continue
+        group = [g for g in _grow(n, selector, consumers)
+                 if id(g) not in assigned]
+        group = selector.filter(group)
+        if not group:
+            continue
+        gid = len(groups)
+        for g in group:
+            assigned[id(g)] = gid
+        groups.append(sorted(group, key=lambda g: order[id(g)]))
+
+    if not groups:
+        return symbol
+
+    # rebuild the graph bottom-up, splicing in one super-node per group
+    from .symbol.symbol import var as sym_var
+
+    new_of: Dict[int, tuple] = {}     # old node id -> (new_node, base_idx)
+    built_group: Dict[int, _Node] = {}
+
+    def entry(old_node, idx):
+        if old_node.is_var:
+            return (old_node, idx)
+        nn, out_map = new_of[id(old_node)]
+        return (nn, out_map[idx] if out_map is not None else idx)
+
+    for n in nodes:
+        if n.is_var:
+            continue
+        gid = assigned.get(id(n))
+        if gid is None:
+            clone = _Node(n.op, n.name, dict(n.attrs),
+                          num_outputs=n.num_outputs)
+            clone._attr_dict.update(n._attr_dict)
+            clone.inputs = [entry(p, i) for p, i in n.inputs]
+            new_of[id(n)] = (clone, None)
+            continue
+        if gid in built_group:
+            continue
+        group = groups[gid]
+        gset = {id(g) for g in group}
+        # cut edges entering the group become subgraph var inputs
+        ext_inputs: List[tuple] = []
+        input_names: List[str] = []
+        sub_vars: Dict[tuple, object] = {}
+        for g in group:
+            for p, i in g.inputs:
+                key = (id(p), i)
+                if (p.is_var or id(p) not in gset) and key not in sub_vars:
+                    name = "sg%d_in%d" % (gid, len(input_names))
+                    sub_vars[key] = sym_var(name)._outputs[0][0]
+                    input_names.append(name)
+                    ext_inputs.append(entry(p, i))
+        # clone group nodes against the subgraph vars
+        sub_clone: Dict[int, _Node] = {}
+        for g in group:
+            c = _Node(g.op, g.name, dict(g.attrs),
+                      num_outputs=g.num_outputs)
+            c._attr_dict.update(g._attr_dict)
+            for p, i in g.inputs:
+                if (id(p), i) in sub_vars and (p.is_var
+                                               or id(p) not in gset):
+                    c.inputs.append((sub_vars[(id(p), i)], 0))
+                else:
+                    c.inputs.append((sub_clone[id(p)], i))
+            sub_clone[id(g)] = c
+        # group outputs = entries consumed outside the group (or graph heads)
+        head_set = {(id(h), i) for h, i in symbol._outputs}
+        out_entries: List[tuple] = []
+        out_map: Dict[int, Dict[int, int]] = {}
+        for g in group:
+            outside = [c for c in consumers.get(id(g), ())
+                       if id(c) not in gset]
+            for i in range(g.num_outputs):
+                used_outside = any((p is g and pi == i)
+                                   for c in outside for p, pi in c.inputs)
+                if used_outside or (id(g), i) in head_set:
+                    out_map.setdefault(id(g), {})[i] = len(out_entries)
+                    out_entries.append((sub_clone[id(g)], i))
+        sub_sym = Symbol(out_entries)
+        super_node = prop.create_subgraph_node(sub_sym, gid, input_names)
+        super_node.inputs = list(ext_inputs)
+        super_node.num_outputs = max(len(out_entries), 1)
+        built_group[gid] = super_node
+        for g in group:
+            new_of[id(g)] = (super_node, out_map.get(id(g), {}))
+
+    new_outputs = [entry(n, i) for n, i in symbol._outputs]
+    return Symbol(new_outputs)
+
+
+# ---------------------------------------------------------------------------
+# built-in backends
+# ---------------------------------------------------------------------------
+
+class _XlaSelector(SubgraphSelector):
+    """Capture every traceable registered op; leave unknown/custom nodes
+    outside (they run eagerly between fused programs)."""
+
+    def _ok(self, node: _Node) -> bool:
+        op = _reg.OPS.get(node.op)
+        return op is not None and not getattr(op, "no_trace", False)
+
+    def select(self, node):
+        return self._ok(node)
+
+    def select_input(self, cur, input_node):
+        return self._ok(input_node)
+
+    def select_output(self, cur, output_node):
+        return self._ok(output_node)
+
+
+@register_subgraph_backend
+class _XlaProperty(SubgraphProperty):
+    name = "xla"
+
+    def create_subgraph_selector(self):
+        return _XlaSelector()
+
+
+def partition(symbol: Symbol, backend: Optional[str] = None) -> Symbol:
+    """Apply a registered backend (default: $MXNET_SUBGRAPH_BACKEND)."""
+    backend = backend or os.environ.get("MXNET_SUBGRAPH_BACKEND", "")
+    if not backend:
+        return symbol
+    return build_subgraph(symbol, get_subgraph_backend(backend))

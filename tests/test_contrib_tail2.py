@@ -1,0 +1,213 @@
+"""Round-3 operator long tail (ops/contrib_tail.py): spatial warping,
+deformable conv, proposals, fused transformer matmuls, fft/count_sketch,
+masking/index ops."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.ops import registry as reg
+
+
+def _inv(name, arrays, **attrs):
+    import jax.numpy as jnp
+
+    op = reg.get_op(name)
+    return op.fn(*[None if a is None else jnp.asarray(a) for a in arrays],
+                 **attrs)
+
+
+def test_grid_generator_affine_identity():
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    grid = np.asarray(_inv("GridGenerator", [theta],
+                           transform_type="affine", target_shape=(4, 5)))
+    assert grid.shape == (2, 2, 4, 5)
+    np.testing.assert_allclose(grid[0, 0, 0], np.linspace(-1, 1, 5),
+                               atol=1e-6)
+    np.testing.assert_allclose(grid[0, 1, :, 0], np.linspace(-1, 1, 4),
+                               atol=1e-6)
+
+
+def test_bilinear_sampler_identity():
+    rng = np.random.RandomState(0)
+    data = rng.rand(2, 3, 6, 7).astype(np.float32)
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    grid = _inv("GridGenerator", [theta], transform_type="affine",
+                target_shape=(6, 7))
+    out = np.asarray(_inv("BilinearSampler", [data, np.asarray(grid)]))
+    np.testing.assert_allclose(out, data, rtol=1e-4, atol=1e-5)
+
+
+def test_spatial_transformer_shift():
+    data = np.zeros((1, 1, 5, 5), np.float32)
+    data[0, 0, 2, 2] = 1.0
+    # translate by +2/(W-1)*2... affine tx shifts sampling grid right
+    theta = np.array([[1, 0, 0.5, 0, 1, 0]], np.float32)
+    out = np.asarray(_inv("SpatialTransformer", [data, theta],
+                          target_shape=(5, 5)))
+    # sampling coords shifted right → peak appears shifted LEFT
+    assert out.shape == (1, 1, 5, 5)
+    assert out[0, 0, 2, 1] == pytest.approx(1.0, abs=1e-4)
+
+
+def test_grid_generator_warp_zero_flow_is_identity_sampling():
+    rng = np.random.RandomState(1)
+    data = rng.rand(1, 2, 4, 6).astype(np.float32)
+    flow = np.zeros((1, 2, 4, 6), np.float32)
+    grid = _inv("GridGenerator", [flow], transform_type="warp")
+    out = np.asarray(_inv("BilinearSampler", [data, np.asarray(grid)]))
+    np.testing.assert_allclose(out, data, rtol=1e-4, atol=1e-5)
+
+
+def test_correlation_zero_displacement_matches_product_mean():
+    rng = np.random.RandomState(2)
+    a = rng.rand(1, 4, 6, 6).astype(np.float32)
+    out = np.asarray(_inv("Correlation", [a, a], kernel_size=1,
+                          max_displacement=0, stride1=1, stride2=1,
+                          pad_size=0))
+    assert out.shape == (1, 1, 6, 6)
+    np.testing.assert_allclose(out[0, 0], (a * a).mean(axis=1)[0],
+                               rtol=1e-5)
+
+
+def test_crop():
+    data = np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6)
+    out = np.asarray(_inv("Crop", [data], h_w=(2, 2), center_crop=True))
+    np.testing.assert_array_equal(out[0, 0], data[0, 0, 2:4, 2:4])
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    rng = np.random.RandomState(3)
+    x = rng.rand(2, 3, 8, 8).astype(np.float32)
+    w = rng.rand(4, 3, 3, 3).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 8, 8), np.float32)
+    got = np.asarray(_inv("_contrib_DeformableConvolution", [x, off, w],
+                          kernel=(3, 3), num_filter=4, pad=(1, 1),
+                          no_bias=True))
+    ref = np.asarray(_inv("Convolution", [x, w, None], kernel=(3, 3),
+                          num_filter=4, pad=(1, 1), no_bias=True))
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_deformable_conv_fractional_offset_interpolates():
+    x = np.zeros((1, 1, 4, 4), np.float32)
+    x[0, 0, 1, 1] = 1.0
+    x[0, 0, 1, 2] = 3.0
+    w = np.ones((1, 1, 1, 1), np.float32)
+    off = np.full((1, 2, 4, 4), 0.0, np.float32)
+    off[0, 1] = 0.5  # dx = +0.5
+    got = np.asarray(_inv("_contrib_DeformableConvolution", [x, off, w],
+                          kernel=(1, 1), num_filter=1, no_bias=True))
+    assert got[0, 0, 1, 1] == pytest.approx(2.0, abs=1e-5)  # halfway 1→3
+
+
+def test_proposal_shapes_and_validity():
+    rng = np.random.RandomState(4)
+    n, a, fh, fw = 1, 3, 4, 4
+    cls = rng.rand(n, 2 * a, fh, fw).astype(np.float32)
+    bbox = (rng.rand(n, 4 * a, fh, fw).astype(np.float32) - 0.5) * 0.1
+    im_info = np.array([[64, 64, 1.0]], np.float32)
+    rois = np.asarray(_inv("_contrib_Proposal", [cls, bbox, im_info],
+                           rpn_pre_nms_top_n=12, rpn_post_nms_top_n=5,
+                           threshold=0.7, rpn_min_size=4,
+                           scales=(4, 8, 16), ratios=(1.0,),
+                           feature_stride=16))
+    assert rois.shape == (5, 5)
+    assert (rois[:, 0] == 0).all()
+    assert (rois[:, 1] >= 0).all() and (rois[:, 3] <= 63).all()
+    assert (rois[:, 2] >= 0).all() and (rois[:, 4] <= 63).all()
+    assert (rois[:, 3] >= rois[:, 1]).all()
+
+
+def test_interleaved_matmul_selfatt_matches_reference_equivalent():
+    rng = np.random.RandomState(5)
+    s, b, heads, hd = 6, 2, 2, 4
+    qkv = rng.rand(s, b, heads * hd * 3).astype(np.float32)
+    scores = np.asarray(_inv("_contrib_interleaved_matmul_selfatt_qk",
+                             [qkv], heads=heads))
+    # reference equivalent code (transformer.cc describe block)
+    tmp = qkv.reshape(s, b, heads, 3, hd)
+    q = tmp[:, :, :, 0].transpose(1, 2, 0, 3).reshape(b * heads, s, hd)
+    k = tmp[:, :, :, 1].transpose(1, 2, 0, 3).reshape(b * heads, s, hd)
+    expect = (q / np.sqrt(hd)) @ k.transpose(0, 2, 1)
+    np.testing.assert_allclose(scores, expect, rtol=1e-5, atol=1e-6)
+
+    att = rng.rand(b * heads, s, s).astype(np.float32)
+    out = np.asarray(_inv("_contrib_interleaved_matmul_selfatt_valatt",
+                          [qkv, att], heads=heads))
+    v = tmp[:, :, :, 2].transpose(1, 2, 0, 3).reshape(b * heads, s, hd)
+    expect_out = (att @ v).reshape(b, heads, s, hd).transpose(
+        2, 0, 1, 3).reshape(s, b, heads * hd)
+    np.testing.assert_allclose(out, expect_out, rtol=1e-5, atol=1e-6)
+
+
+def test_interleaved_matmul_encdec():
+    rng = np.random.RandomState(6)
+    sq, sk, b, heads, hd = 3, 5, 2, 2, 4
+    q = rng.rand(sq, b, heads * hd).astype(np.float32)
+    kv = rng.rand(sk, b, heads * hd * 2).astype(np.float32)
+    scores = np.asarray(_inv("_contrib_interleaved_matmul_encdec_qk",
+                             [q, kv], heads=heads))
+    assert scores.shape == (b * heads, sq, sk)
+    att = rng.rand(b * heads, sq, sk).astype(np.float32)
+    out = np.asarray(_inv("_contrib_interleaved_matmul_encdec_valatt",
+                          [kv, att], heads=heads))
+    assert out.shape == (sq, b, heads * hd)
+
+
+def test_fft_ifft_roundtrip():
+    rng = np.random.RandomState(7)
+    x = rng.rand(3, 8).astype(np.float32)
+    spec = np.asarray(_inv("_contrib_fft", [x]))
+    assert spec.shape == (3, 16)
+    # interleaved layout vs numpy fft
+    ref = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(spec[:, 0::2], ref.real, rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(spec[:, 1::2], ref.imag, rtol=1e-4,
+                               atol=1e-4)
+    back = np.asarray(_inv("_contrib_ifft", [spec]))
+    np.testing.assert_allclose(back, x * 8, rtol=1e-4, atol=1e-4)
+
+
+def test_count_sketch():
+    data = np.array([[1.0, 2.0, 3.0]], np.float32)
+    h = np.array([[0, 1, 0]], np.float32)
+    s = np.array([[1, -1, 1]], np.float32)
+    out = np.asarray(_inv("_contrib_count_sketch", [data, h, s], out_dim=2))
+    np.testing.assert_allclose(out, [[4.0, -2.0]])
+
+
+def test_boolean_mask_index_copy_index_array():
+    data = np.arange(12, dtype=np.float32).reshape(4, 3)
+    mask = np.array([1, 0, 1, 0], np.float32)
+    out = np.asarray(_inv("_contrib_boolean_mask", [data, mask]))
+    np.testing.assert_array_equal(out, data[[0, 2]])
+
+    old = np.zeros((4, 2), np.float32)
+    new = np.ones((2, 2), np.float32)
+    got = np.asarray(_inv("_contrib_index_copy",
+                          [old, np.array([1, 3], np.float32), new]))
+    assert got[1].sum() == 2 and got[3].sum() == 2 and got[0].sum() == 0
+
+    ia = np.asarray(_inv("_contrib_index_array", [np.zeros((2, 3))]))
+    assert ia.shape == (2, 3, 2)
+    assert ia[1, 2, 0] == 1 and ia[1, 2, 1] == 2
+
+
+def test_sync_batch_norm_matches_batch_norm():
+    rng = np.random.RandomState(8)
+    x = rng.rand(4, 3, 5, 5).astype(np.float32)
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    mm = np.zeros(3, np.float32)
+    mv = np.ones(3, np.float32)
+    a = np.asarray(_inv("_contrib_SyncBatchNorm", [x, gamma, beta, mm, mv],
+                        fix_gamma=False, ndev=4, key="sbn"))
+    b = np.asarray(_inv("BatchNorm", [x, gamma, beta, mm, mv],
+                        fix_gamma=False))
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_registry_count_grew():
+    distinct = len({id(o) for o in reg.OPS.values()})
+    assert distinct >= 275, distinct

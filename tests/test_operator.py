@@ -216,3 +216,25 @@ def test_slice_ops():
     assert out.shape == (2, 3, 2)
     out = nd.slice_like(x, nd.zeros((2, 2, 2)))
     assert out.shape == (2, 2, 2)
+
+
+def test_eager_jit_cache_not_poisoned_by_trace_mode():
+    """Regression (round-3 review): a BatchNorm traced inside a hybridized
+    training graph must not leak its train-mode jaxpr into the eager
+    predict-mode dispatch cache (and vice versa)."""
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu import autograd as ag
+
+    mx.random.seed(0)
+    net = nn.BatchNorm(in_channels=3)
+    net.initialize()
+    net.hybridize()
+    x = nd.random.normal(1.0, 2.0, shape=(8, 3, 4, 4))
+    with ag.record():
+        net(x)  # hybridized training trace (tc.training=True)
+    # eager predict-mode BN with the same shapes/attrs must use moving
+    # stats (mean 0 var 1 -> output == input)
+    out = nd.BatchNorm(x, nd.ones((3,)), nd.zeros((3,)), nd.zeros((3,)),
+                       nd.ones((3,)), fix_gamma=False, eps=1e-10)
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy(), rtol=1e-4,
+                               atol=1e-4)

@@ -1,0 +1,125 @@
+"""Subgraph partition API (subgraph_property.h analog) + runtime Pallas
+kernels (mx.rtc / CudaModule analog)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu import subgraph as sg
+from incubator_mxnet_tpu import symbol as sym
+
+
+def _mlp():
+    x = sym.var("data")
+    h = sym.FullyConnected(x, sym.var("w1"), sym.var("b1"), num_hidden=8)
+    h = sym.Activation(h, act_type="relu")
+    out = sym.FullyConnected(h, sym.var("w2"), sym.var("b2"), num_hidden=3)
+    return out
+
+
+def _bind_args(rng):
+    return {"data": nd.array(rng.normal(size=(4, 5)).astype(np.float32)),
+            "w1": nd.array(rng.normal(size=(8, 5)).astype(np.float32)),
+            "b1": nd.zeros((8,)),
+            "w2": nd.array(rng.normal(size=(3, 8)).astype(np.float32)),
+            "b2": nd.zeros((3,))}
+
+
+def test_xla_backend_fuses_whole_graph():
+    out = _mlp()
+    part = sg.build_subgraph(out, sg.get_subgraph_backend("xla"))
+    # the whole MLP collapses into one super-node
+    ops = [n.op for n in _toposort_ops(part)]
+    assert ops == ["_xla_subgraph_op"], ops
+    rng = np.random.RandomState(0)
+    args = _bind_args(rng)
+    ref = out.bind(mx.cpu(), args=args).forward()[0].asnumpy()
+    got = part.bind(mx.cpu(), args=args).forward()[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def _toposort_ops(s):
+    from incubator_mxnet_tpu.symbol.symbol import _toposort
+
+    return [n for n in _toposort([n for n, _ in s._outputs])
+            if not n.is_var]
+
+
+def test_custom_selector_partial_fusion():
+    """A selector that refuses Activation splits the graph into FC-only
+    islands with the activation left as a standalone node."""
+
+    class FCOnly(sg.SubgraphSelector):
+        def _ok(self, n):
+            return n.op == "FullyConnected"
+
+        def select(self, n):
+            return self._ok(n)
+
+        def select_input(self, cur, inp):
+            return self._ok(inp)
+
+        def select_output(self, cur, outp):
+            return self._ok(outp)
+
+    class FCProp(sg.SubgraphProperty):
+        name = "fconly"
+
+        def create_subgraph_selector(self):
+            return FCOnly()
+
+    sg.register_subgraph_backend(FCProp)
+    out = _mlp()
+    part = sg.build_subgraph(out, sg.get_subgraph_backend("fconly"))
+    ops = [n.op for n in _toposort_ops(part)]
+    assert ops.count("_fconly_subgraph_op") == 2
+    assert "Activation" in ops
+    rng = np.random.RandomState(1)
+    args = _bind_args(rng)
+    ref = out.bind(mx.cpu(), args=args).forward()[0].asnumpy()
+    got = part.bind(mx.cpu(), args=args).forward()[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_partition_env_var(monkeypatch):
+    monkeypatch.setenv("MXNET_SUBGRAPH_BACKEND", "xla")
+    part = sg.partition(_mlp())
+    assert [n.op for n in _toposort_ops(part)] == ["_xla_subgraph_op"]
+    monkeypatch.setenv("MXNET_SUBGRAPH_BACKEND", "")
+    same = sg.partition(_mlp())
+    assert len(_toposort_ops(same)) == 3
+
+
+def test_rtc_pallas_module_elementwise():
+    src = """
+def scale_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0 + 1.0
+"""
+    mod = mx.rtc.PallasModule(src, exports=["scale_kernel"])
+    k = mod.get_kernel("scale_kernel")
+    x = nd.array(np.arange(8, dtype=np.float32).reshape(2, 4))
+    y = k.launch([x], out_shape=((2, 4), "float32"))
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy() * 2 + 1)
+
+
+def test_rtc_pallas_module_grid_matmul():
+    src = """
+def mm_kernel(a_ref, b_ref, o_ref):
+    o_ref[...] = jnp.dot(a_ref[...], b_ref[...],
+                         preferred_element_type=jnp.float32)
+"""
+    mod = mx.rtc.PallasModule(src)
+    k = mod.get_kernel("mm_kernel")
+    rng = np.random.RandomState(0)
+    a = rng.normal(size=(16, 32)).astype(np.float32)
+    b = rng.normal(size=(32, 8)).astype(np.float32)
+    y = k.launch([nd.array(a), nd.array(b)], out_shape=((16, 8), "float32"))
+    np.testing.assert_allclose(y.asnumpy(), a @ b, rtol=1e-4, atol=1e-4)
+
+
+def test_rtc_missing_export_raises():
+    with pytest.raises(ValueError):
+        mx.rtc.PallasModule("x = 1", exports=["nope"])
+    mod = mx.rtc.PallasModule("def k(o_ref): o_ref[...] = 0.0")
+    with pytest.raises(ValueError):
+        mod.get_kernel("missing")
