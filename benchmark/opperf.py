@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Per-operator micro-benchmark harness (``benchmark/opperf`` parity).
+
+Reference: ``benchmark/opperf/`` — runs individual operators over
+representative shapes and reports per-op latency.  Here each op executes
+through the eager dispatch path (per-op compiled executable, warm cache),
+so the numbers measure exactly what imperative user code sees.
+
+Usage:
+  python benchmark/opperf.py                      # default op set
+  python benchmark/opperf.py --ops dot,relu,sum   # subset
+  python benchmark/opperf.py --json results.json  # machine-readable dump
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def default_cases():
+    r = np.random.RandomState(0)
+
+    def f(*shape):
+        return r.normal(0, 1, shape).astype(np.float32)
+
+    b = 32
+    return [
+        # (op, inputs, attrs)
+        ("broadcast_add", [f(b, 256), f(b, 256)], {}),
+        ("broadcast_mul", [f(b, 256), f(b, 256)], {}),
+        ("relu", [f(b, 1024)], {}),
+        ("sigmoid", [f(b, 1024)], {}),
+        ("tanh", [f(b, 1024)], {}),
+        ("exp", [f(b, 1024)], {}),
+        ("sum", [f(b, 64, 64)], {"axis": (1, 2)}),
+        ("mean", [f(b, 64, 64)], {"axis": 1}),
+        ("softmax", [f(b, 1000)], {}),
+        ("log_softmax", [f(b, 1000)], {}),
+        ("dot", [f(256, 256), f(256, 256)], {}),
+        ("batch_dot", [f(b, 64, 64), f(b, 64, 64)], {}),
+        ("FullyConnected", [f(b, 512), f(256, 512), f(256)],
+         {"num_hidden": 256}),
+        ("Convolution", [f(8, 32, 28, 28), f(64, 32, 3, 3), f(64)],
+         {"kernel": (3, 3), "num_filter": 64, "pad": (1, 1)}),
+        ("Pooling", [f(8, 32, 28, 28)],
+         {"kernel": (2, 2), "stride": (2, 2), "pool_type": "max"}),
+        ("BatchNorm", [f(8, 32, 28, 28), np.abs(f(32)) + .5, f(32), f(32),
+                       np.abs(f(32)) + .5], {"fix_gamma": False}),
+        ("LayerNorm", [f(b, 512), np.abs(f(512)) + .5, f(512)], {}),
+        ("transpose", [f(b, 64, 64)], {"axes": (2, 0, 1)}),
+        ("take", [f(1000, 64), r.randint(0, 1000, 128).astype(np.float32)],
+         {}),
+        ("topk", [f(b, 1000)], {"k": 10, "ret_typ": "value"}),
+        ("sort", [f(b, 1024)], {}),
+        ("argmax", [f(b, 1000)], {"axis": 1}),
+        ("one_hot", [r.randint(0, 100, b).astype(np.float32)],
+         {"depth": 100}),
+        ("where", [(f(b, 256) > 0).astype(np.float32), f(b, 256),
+                   f(b, 256)], {}),
+        ("_contrib_interleaved_matmul_selfatt_qk", [f(128, 4, 192)],
+         {"heads": 4}),
+    ]
+
+
+def bench_op(name, arrays, attrs, warmup=3, iters=50):
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.ops import registry as reg
+
+    ins = [nd.array(a) for a in arrays]
+    for _ in range(warmup):
+        out = reg.invoke(name, ins, **attrs)
+    _wait(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = reg.invoke(name, ins, **attrs)
+    _wait(out)
+    return (time.perf_counter() - t0) / iters * 1e6  # us
+
+
+def _wait(out):
+    (out[0] if isinstance(out, list) else out).wait_to_read()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", default="", help="comma-separated subset")
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--json", default="", help="write results to file")
+    args = ap.parse_args()
+
+    cases = default_cases()
+    if args.ops:
+        wanted = set(args.ops.split(","))
+        cases = [c for c in cases if c[0] in wanted]
+
+    results = []
+    print("%-45s %12s" % ("op", "latency(us)"))
+    print("-" * 58)
+    for name, arrays, attrs in cases:
+        try:
+            us = bench_op(name, arrays, attrs, iters=args.iters)
+            results.append({"op": name, "latency_us": round(us, 1),
+                            "attrs": {k: str(v) for k, v in attrs.items()}})
+            print("%-45s %12.1f" % (name, us))
+        except Exception as e:  # noqa: BLE001
+            results.append({"op": name, "error": str(e)})
+            print("%-45s %12s  (%s)" % (name, "ERROR", e))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=1)
+        print("wrote %s" % args.json)
+
+
+if __name__ == "__main__":
+    main()

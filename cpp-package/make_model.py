@@ -1,0 +1,35 @@
+#!/usr/bin/env python
+"""Export a small model checkpoint for the C++ predict demo."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu import symbol as sym
+from incubator_mxnet_tpu.model import save_checkpoint
+
+
+def main():
+    prefix = sys.argv[1] if len(sys.argv) > 1 else "model"
+    rng = np.random.RandomState(0)
+    out = sym.FullyConnected(sym.var("data"), sym.var("w1"), sym.var("b1"),
+                             num_hidden=16)
+    out = sym.Activation(out, act_type="relu")
+    out = sym.FullyConnected(out, sym.var("w2"), sym.var("b2"), num_hidden=4)
+    out = sym.softmax(out)
+    args = {"w1": nd.array(rng.normal(0, 0.5, (16, 8)).astype(np.float32)),
+            "b1": nd.zeros((16,)),
+            "w2": nd.array(rng.normal(0, 0.5, (4, 16)).astype(np.float32)),
+            "b2": nd.zeros((4,))}
+    save_checkpoint(prefix, 0, out, args, {})
+    print("exported %s-symbol.json / %s-0000.params" % (prefix, prefix))
+
+
+if __name__ == "__main__":
+    main()

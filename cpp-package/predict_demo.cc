@@ -1,0 +1,99 @@
+// C++ consumer of the MXNet-compatible C ABI (L9 binding path).
+//
+// Reference analog: cpp-package/ + example/image-classification/predict-cpp
+// — a C++ program that loads a checkpoint (symbol JSON + params blob) and
+// serves it through the C predict API (include/mxnet/c_predict_api.h:84,
+// 254, 263, 289) with no Python in the source.  Linked against
+// ../src/native/libmxtpu_capi.so.
+//
+// Build & run:  make run  (see Makefile; needs a model exported by
+// make_model.py first).
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <fstream>
+#include <string>
+#include <vector>
+
+typedef void* PredictorHandle;
+
+extern "C" {
+const char* MXGetLastError();
+int MXGetVersion(int* out);
+int MXPredCreate(const char* symbol_json, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 uint32_t num_input_nodes, const char** input_keys,
+                 const uint32_t* input_shape_indptr,
+                 const uint32_t* input_shape_data, PredictorHandle* out);
+int MXPredSetInput(PredictorHandle h, const char* key, const float* data,
+                   uint32_t size);
+int MXPredForward(PredictorHandle h);
+int MXPredGetOutputShape(PredictorHandle h, uint32_t index,
+                         uint32_t** shape_data, uint32_t* shape_ndim);
+int MXPredGetOutput(PredictorHandle h, uint32_t index, float* data,
+                    uint32_t size);
+int MXPredFree(PredictorHandle h);
+}
+
+static std::string ReadFile(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) {
+    std::fprintf(stderr, "cannot open %s\n", path.c_str());
+    std::exit(2);
+  }
+  return std::string((std::istreambuf_iterator<char>(f)),
+                     std::istreambuf_iterator<char>());
+}
+
+#define CHECK_RC(call)                                              \
+  do {                                                              \
+    if ((call) != 0) {                                              \
+      std::fprintf(stderr, "FAIL %s: %s\n", #call, MXGetLastError()); \
+      return 1;                                                     \
+    }                                                               \
+  } while (0)
+
+int main(int argc, char** argv) {
+  const std::string prefix = argc > 1 ? argv[1] : "model";
+  int version = 0;
+  CHECK_RC(MXGetVersion(&version));
+  std::printf("libmxtpu_capi version %d\n", version);
+
+  const std::string json = ReadFile(prefix + "-symbol.json");
+  const std::string params = ReadFile(prefix + "-0000.params");
+
+  const char* input_keys[] = {"data"};
+  const uint32_t indptr[] = {0, 2};
+  const uint32_t shape[] = {2, 8};
+  PredictorHandle pred = nullptr;
+  CHECK_RC(MXPredCreate(json.c_str(), params.data(),
+                        static_cast<int>(params.size()), 1, 0, 1, input_keys,
+                        indptr, shape, &pred));
+
+  std::vector<float> x(2 * 8);
+  for (size_t i = 0; i < x.size(); ++i) x[i] = 0.1f * static_cast<float>(i);
+  CHECK_RC(MXPredSetInput(pred, "data", x.data(),
+                          static_cast<uint32_t>(x.size())));
+  CHECK_RC(MXPredForward(pred));
+
+  uint32_t* oshape = nullptr;
+  uint32_t ondim = 0;
+  CHECK_RC(MXPredGetOutputShape(pred, 0, &oshape, &ondim));
+  uint32_t total = 1;
+  std::printf("output shape: (");
+  for (uint32_t i = 0; i < ondim; ++i) {
+    std::printf(i ? ", %u" : "%u", oshape[i]);
+    total *= oshape[i];
+  }
+  std::printf(")\n");
+
+  std::vector<float> out(total);
+  CHECK_RC(MXPredGetOutput(pred, 0, out.data(), total));
+  float sum = 0.0f;
+  for (float v : out) sum += v;
+  std::printf("output[0..3]: %.4f %.4f %.4f %.4f  (sum %.4f)\n", out[0],
+              out[1], out[2], out[3], sum);
+  CHECK_RC(MXPredFree(pred));
+  std::printf("PREDICT_DEMO_OK\n");
+  return 0;
+}

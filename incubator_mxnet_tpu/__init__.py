@@ -90,3 +90,6 @@ from . import visualization as viz
 from . import runtime
 from . import rtc
 from . import subgraph
+from . import config
+from . import library
+from . import resource

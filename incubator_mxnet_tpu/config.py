@@ -1,0 +1,113 @@
+"""Environment-variable configuration system.
+
+Reference model (``docs/.../env_var.md``, SURVEY §5.6): MXNet has no config
+files — behavior is tuned through ~62 documented ``MXNET_*`` environment
+variables read via ``dmlc::GetEnv``.  This module is the central registry:
+every variable the TPU framework consumes (or accepts for compatibility) is
+declared once with type, default, and mapping, and read through
+:func:`get`.  ``describe()`` renders the env_var.md-style table.
+
+Variables whose reference behavior is subsumed by XLA are accepted and
+documented as such (set → no error, behavior note explains what replaces
+them) so reference launch scripts run unmodified.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, NamedTuple, Optional
+
+__all__ = ["get", "describe", "VARS"]
+
+
+class Var(NamedTuple):
+    name: str
+    typ: Callable
+    default: Any
+    doc: str
+
+
+def _bool(s):
+    return str(s).lower() not in ("0", "false", "")
+
+
+VARS: Dict[str, Var] = {}
+
+
+def _decl(name, typ, default, doc):
+    VARS[name] = Var(name, typ, default, doc)
+
+
+# -- active: consumed by this framework -------------------------------------
+_decl("MXNET_SUBGRAPH_BACKEND", str, "",
+      "Graph-partition backend applied at bind (subgraph.partition); "
+      "built-in: 'xla' (maximal traceable subgraphs).")
+_decl("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", _bool, True,
+      "Warn when a sparse op densifies (ndarray/sparse.py).")
+_decl("MXNET_CPU_WORKER_NTHREADS", int, 4,
+      "Host worker threads for the native engine and data pipelines "
+      "(ImageRecordIter default preprocess_threads).")
+_decl("MXNET_ENGINE_TYPE", str, "ThreadedEnginePerDevice",
+      "Host engine selection: ThreadedEngine* -> native C++ engine, "
+      "NaiveEngine -> synchronous Python fallback (engine.py).")
+_decl("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
+      "Arrays above this size use the fused batched collective path "
+      "individually rather than being concatenated (kvstore/dist.py).")
+_decl("MXNET_ENFORCE_DETERMINISM", _bool, False,
+      "Assert deterministic collectives/reductions; jax is deterministic "
+      "per program, so this only forbids known-nondeterministic ops.")
+_decl("MXNET_PROFILER_AUTOSTART", _bool, False,
+      "Start mx.profiler at import (profiler.py).")
+
+# -- compatibility: accepted, behavior subsumed by XLA/JAX ------------------
+for _name, _doc in [
+    ("MXNET_EXEC_BULK_EXEC_TRAIN",
+     "Engine op bulking — subsumed: the whole graph compiles to one XLA "
+     "program (executor.py)."),
+    ("MXNET_EXEC_BULK_EXEC_INFERENCE", "As above for inference."),
+    ("MXNET_EXEC_ENABLE_INPLACE",
+     "In-place planning — subsumed by XLA buffer donation/aliasing."),
+    ("MXNET_ELIMINATE_COMMON_EXPR", "CSE — subsumed by XLA."),
+    ("MXNET_USE_FUSION", "Pointwise fusion — subsumed by XLA."),
+    ("MXNET_GPU_MEM_POOL_TYPE",
+     "Device memory pooling — subsumed by the PJRT allocator."),
+    ("MXNET_CUDNN_AUTOTUNE_DEFAULT",
+     "Kernel autotune — subsumed by XLA autotuning; persist results with "
+     "jax_compilation_cache_dir instead."),
+    ("MXNET_USE_OPERATOR_TUNING", "OMP tuning — subsumed by XLA:CPU."),
+    ("MXNET_KVSTORE_USETREE",
+     "Topology-aware reduce — subsumed by XLA collective scheduling."),
+    ("MXNET_KVSTORE_REDUCTION_NTHREADS", "As above."),
+    ("MXNET_UPDATE_ON_KVSTORE",
+     "Honored by Trainer/Module: optimizer runs in the store when a "
+     "kvstore updater is set (kvstore.py set_optimizer)."),
+    ("MXNET_SAFE_ACCUMULATION",
+     "f32 accumulation for f16/bf16 reductions — always on: norm/softmax/"
+     "BN bodies accumulate in float32 (ops/nn.py)."),
+    ("MXNET_BACKWARD_DO_MIRROR",
+     "Gradient recompute — use jax.checkpoint/remat on blocks instead."),
+]:
+    _decl(_name, str, "", "[compat] " + _doc)
+
+
+def get(name: str, default: Optional[Any] = None):
+    """Read a declared variable with its declared type and default
+    (``dmlc::GetEnv`` analog)."""
+    var = VARS.get(name)
+    raw = os.environ.get(name)
+    if var is None:
+        return raw if raw is not None else default
+    if raw is None:
+        return default if default is not None else var.default
+    try:
+        return var.typ(raw)
+    except (TypeError, ValueError):
+        return var.default
+
+
+def describe() -> str:
+    """env_var.md-style table of every declared variable."""
+    lines = ["%-40s %-10s %s" % ("variable", "default", "description"),
+             "-" * 100]
+    for v in sorted(VARS.values()):
+        lines.append("%-40s %-10s %s" % (v.name, str(v.default)[:10], v.doc))
+    return "\n".join(lines)

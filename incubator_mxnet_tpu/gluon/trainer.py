@@ -105,7 +105,16 @@ class Trainer:
         Single-process XLA already returns reduced grads from sharded steps;
         with an attached dist kvstore, pushpull runs the mesh psum.
         """
-        if self._kvstore is not None and getattr(self._kvstore, "num_workers", 1) > 1:
+        if self._kvstore is None:
+            return
+        from ..kvstore.kvstore import KVStore
+
+        # built-in single-worker stores are a no-op reduction; third-party
+        # stores (KVStoreBase.register — the Horovod plug-in hook) always
+        # get the pushpull so their communication runs
+        plugged = type(self._kvstore) is not KVStore and \
+            not self._kvstore.type.startswith("dist")
+        if getattr(self._kvstore, "num_workers", 1) > 1 or plugged:
             for i, p in enumerate(self._params):
                 if p.grad_req != "null":
                     self._kvstore.pushpull(i, p.grad(), out=p.grad())

@@ -107,7 +107,7 @@ class ImageRecordIter(DataIter):
                  path_imgidx=None, shuffle=False, rand_crop=False,
                  rand_mirror=False, resize=-1, mean_r=0.0, mean_g=0.0,
                  mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
-                 preprocess_threads=4, prefetch_buffer=4, part_index=0,
+                 preprocess_threads=None, prefetch_buffer=4, part_index=0,
                  num_parts=1, label_width=1, round_batch=True, seed=0,
                  data_name="data", label_name="softmax_label", dtype="float32",
                  **kwargs):
@@ -127,6 +127,10 @@ class ImageRecordIter(DataIter):
         self.round_batch = round_batch
         self.dtype = np.dtype(dtype)
         self._rng = np.random.RandomState(seed + part_index)
+        if preprocess_threads is None:
+            from .. import config
+
+            preprocess_threads = config.get("MXNET_CPU_WORKER_NTHREADS")
         self._pool = ThreadPoolExecutor(max_workers=int(preprocess_threads))
         self.data_name, self.label_name = data_name, label_name
 

@@ -1,0 +1,91 @@
+"""Dynamic native operator libraries (``mx.library`` parity).
+
+Reference: ``include/mxnet/lib_api.h:540`` + ``python/mxnet/library.py`` —
+an external shared library registers custom ops at runtime via
+``MXLoadLib``.
+
+TPU-native contract (simpler and jit-composable): the library exports
+
+.. code-block:: c
+
+    // JSON: [{"name": "my_gelu", "num_inputs": 1}, ...]
+    const char* MXTPULibOpList();
+    // all inputs share one shape; out has the same shape (f32)
+    int MXTPULibOpCompute(const char* name, int n_in, const float** ins,
+                          const int64_t* shape, int ndim, float* out);
+
+Loaded ops are registered in the normal op registry and execute through
+``jax.pure_callback``, so they work eagerly AND inside ``jax.jit`` programs
+(XLA inserts a host callback — the TPU equivalent of the reference's
+CPU-custom-op engine push; the tensor round-trips through host memory like
+any host-side custom kernel would).
+"""
+from __future__ import annotations
+
+import ctypes
+import json
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ops.registry import register
+
+__all__ = ["load"]
+
+
+def _make_fn(lib: ctypes.CDLL, name: str, num_inputs: int):
+    cname = name.encode()
+
+    def host_compute(*arrays):
+        arrs = [np.ascontiguousarray(np.asarray(a, np.float32))
+                for a in arrays]
+        shape = arrs[0].shape
+        out = np.empty(shape, np.float32)
+        ins = (ctypes.POINTER(ctypes.c_float) * len(arrs))(
+            *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+              for a in arrs])
+        shp = (ctypes.c_int64 * len(shape))(*shape)
+        rc = lib.MXTPULibOpCompute(
+            cname, len(arrs), ins, shp, len(shape),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if rc != 0:
+            raise RuntimeError("custom op %r failed (rc=%d)" % (name, rc))
+        return out
+
+    def fn(*arrays, **_attrs):
+        spec = jax.ShapeDtypeStruct(arrays[0].shape, jnp.float32)
+        return jax.pure_callback(
+            host_compute, spec,
+            *[a.astype(jnp.float32) for a in arrays], vmap_method="sequential")
+
+    fn.__name__ = name
+    return fn
+
+
+def load(path: str, verbose: bool = True) -> List[str]:
+    """Load a custom-op library; returns the registered op names
+    (``MXLoadLib`` / ``python/mxnet/library.py:load`` analog)."""
+    lib = ctypes.CDLL(path)
+    lib.MXTPULibOpList.restype = ctypes.c_char_p
+    lib.MXTPULibOpCompute.argtypes = [
+        ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float)]
+    ops = json.loads(lib.MXTPULibOpList().decode())
+    names = []
+    for spec in ops:
+        name = spec["name"]
+        n_in = int(spec.get("num_inputs", 1))
+        register(name, _make_fn(lib, name, n_in), num_inputs=n_in,
+                 differentiable=False,
+                 doc="custom native op from %s" % path)
+        names.append(name)
+    if verbose:
+        import logging
+
+        logging.info("loaded %d custom ops from %s: %s", len(names), path,
+                     names)
+    return names

@@ -45,16 +45,15 @@ __all__ = [
     "cast_storage", "dot", "retain", "add", "subtract", "multiply",
 ]
 
-_FALLBACK_VERBOSE = os.environ.get("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", "1")
-
-
 def _as_jax(x):
     return x._data if isinstance(x, NDArray) else jnp.asarray(x)
 
 
 def _log_fallback(op, stypes):
     """MXNET_STORAGE_FALLBACK_LOG_VERBOSE analog (src/common/utils.h)."""
-    if _FALLBACK_VERBOSE not in ("0", "false", "False"):
+    from .. import config
+
+    if config.get("MXNET_STORAGE_FALLBACK_LOG_VERBOSE"):
         warnings.warn(
             "%s: storage fallback to dense for stypes %s" % (op, stypes),
             stacklevel=3)

@@ -1,0 +1,123 @@
+"""Per-context shared resources (ResourceManager parity).
+
+Reference: ``include/mxnet/resource.h:38-130`` + ``src/resource.cc:87`` —
+ops request shared resources (``kTempSpace`` scratch, ``kRandom`` /
+``kParallelRandom`` generators) from a per-device manager instead of
+allocating privately.
+
+TPU-native mapping: device scratch inside compiled programs is XLA's
+business (buffer assignment), so ``kTempSpace`` here serves the HOST side —
+pooled aligned buffers from the native storage manager
+(``src/native/storage.cc``) reused across requests, which is what IO
+pipelines, decoders and checkpoint writers need.  ``kRandom`` hands out the
+process PRNG stream (``rng.py``); ``kParallelRandom`` derives independent
+streams by folding in a per-resource index (the philox analog of the
+reference's sliced parallel sample streams).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["ResourceRequest", "Resource", "request", "ResourceManager"]
+
+
+class ResourceRequest:
+    """resource.h:38 ResourceRequest::Type."""
+
+    kRandom = "random"
+    kTempSpace = "temp_space"
+    kParallelRandom = "parallel_random"
+
+    def __init__(self, type):  # noqa: A002
+        self.type = type
+
+
+class Resource:
+    """A granted resource (resource.h:130 surface)."""
+
+    def __init__(self, req: ResourceRequest, manager: "ResourceManager",
+                 idx: int):
+        self.req = req
+        self._manager = manager
+        self._idx = idx
+
+    # -- kTempSpace ---------------------------------------------------------
+    def get_space(self, shape, dtype="float32") -> np.ndarray:
+        """Host scratch of at least the requested size, recycled from the
+        pooled storage manager; contents are undefined (resource.h:130)."""
+        if self.req.type != ResourceRequest.kTempSpace:
+            raise TypeError("get_space on a %s resource" % self.req.type)
+        return self._manager._temp_space(shape, dtype, self._idx)
+
+    get_host_space = get_space
+
+    # -- kRandom / kParallelRandom -----------------------------------------
+    def get_random(self):
+        """A fresh PRNG key from this resource's stream."""
+        import jax
+
+        from . import rng
+
+        if self.req.type == ResourceRequest.kRandom:
+            return rng.next_key()
+        if self.req.type == ResourceRequest.kParallelRandom:
+            with jax.ensure_compile_time_eval():
+                return jax.random.fold_in(rng.next_key(), self._idx)
+        raise TypeError("get_random on a %s resource" % self.req.type)
+
+
+class ResourceManager:
+    """Per-process manager (src/resource.cc:87 analog): temp buffers are
+    cached by slot so repeated requests reuse one growing allocation, like
+    the reference's per-device temp space."""
+
+    def __init__(self):
+        self._slots: Dict[int, np.ndarray] = {}
+        self._handles: Dict[int, object] = {}  # native allocs kept alive
+        self._count = 0
+
+    def request(self, req: ResourceRequest) -> Resource:
+        idx = self._count
+        self._count += 1
+        return Resource(req, self, idx)
+
+    def _temp_space(self, shape, dtype, idx) -> np.ndarray:
+        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        buf = self._slots.get(idx)
+        if buf is None or buf.nbytes < nbytes:
+            # pooled aligned allocation via the native storage manager when
+            # available; plain numpy otherwise
+            try:
+                from . import storage
+
+                handle = storage.alloc(max(nbytes, 64))
+                buf = handle.array
+                old = self._handles.get(idx)
+                self._handles[idx] = handle  # keep the native alloc alive
+                if old is not None:
+                    storage.free(old)
+            except Exception:
+                buf = np.empty(max(nbytes, 64), np.uint8)
+                self._handles.pop(idx, None)
+            self._slots[idx] = buf
+        return buf[:nbytes].view(np.dtype(dtype)).reshape(shape)
+
+
+_MANAGER: Optional[ResourceManager] = None
+
+
+def _manager() -> ResourceManager:
+    global _MANAGER
+    if _MANAGER is None:
+        _MANAGER = ResourceManager()
+    return _MANAGER
+
+
+def request(req) -> Resource:
+    """Request a resource from the global manager
+    (``ResourceManager::Get()->Request`` analog)."""
+    if isinstance(req, str):
+        req = ResourceRequest(req)
+    return _manager().request(req)

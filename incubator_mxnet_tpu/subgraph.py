@@ -262,7 +262,9 @@ class _XlaProperty(SubgraphProperty):
 
 def partition(symbol: Symbol, backend: Optional[str] = None) -> Symbol:
     """Apply a registered backend (default: $MXNET_SUBGRAPH_BACKEND)."""
-    backend = backend or os.environ.get("MXNET_SUBGRAPH_BACKEND", "")
+    from . import config
+
+    backend = backend or config.get("MXNET_SUBGRAPH_BACKEND")
     if not backend:
         return symbol
     return build_subgraph(symbol, get_subgraph_backend(backend))

@@ -65,36 +65,49 @@ int FailFromPython() {
   return Fail(msg);
 }
 
-// Lazily bring up the interpreter (no-op when embedded in a live one) and
-// import the marshalling module.
-PyObject* Impl() {
-  static PyObject* impl = nullptr;
+// Bring up the interpreter once for standalone C/C++ consumers (no-op when
+// the host process is already a live interpreter).  Must run BEFORE any
+// PyGILState_Ensure: taking the GIL on an uninitialized runtime crashes.
+void EnsureInterpreter() {
   static std::once_flag once;
   std::call_once(once, []() {
     if (!Py_IsInitialized()) {
       Py_InitializeEx(0);
+      // drop the GIL the init acquired so PyGILState_* manages it from
+      // any caller thread
+      PyEval_SaveThread();
     }
-    PyGILState_STATE g = PyGILState_Ensure();
-    impl = PyImport_ImportModule("incubator_mxnet_tpu.capi_impl");
-    if (impl == nullptr) PyErr_Print();
-    PyGILState_Release(g);
   });
-  return impl;
 }
 
 class Gil {
  public:
-  Gil() : state_(PyGILState_Ensure()) {}
+  Gil() {
+    EnsureInterpreter();
+    state_ = PyGILState_Ensure();
+  }
   ~Gil() { PyGILState_Release(state_); }
 
  private:
   PyGILState_STATE state_;
 };
 
+// Import the marshalling module (caller must hold the GIL).  No call_once:
+// blocking in a foreign once while holding the GIL would deadlock against
+// the importing thread (imports release the GIL mid-way); CPython's
+// sys.modules makes repeat imports cheap and idempotent, and the import
+// lock serializes racing first-imports correctly under the GIL.
+PyObject* Impl() {
+  PyObject* impl = PyImport_ImportModule("incubator_mxnet_tpu.capi_impl");
+  if (impl == nullptr) PyErr_Print();
+  return impl;
+}
+
 PyObject* CallImpl(const char* fn, PyObject* args) {
   PyObject* mod = Impl();
   if (mod == nullptr) return nullptr;
   PyObject* f = PyObject_GetAttrString(mod, fn);
+  Py_DECREF(mod);
   if (f == nullptr) return nullptr;
   PyObject* out = PyObject_CallObject(f, args);
   Py_DECREF(f);

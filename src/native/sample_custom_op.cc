@@ -1,0 +1,43 @@
+// Sample custom-op library (lib_api.h / example/extensions/lib_custom_op
+// analog) for the MXTPULibOp* contract consumed by
+// incubator_mxnet_tpu/library.py.
+//
+// Build: make libsample_custom_op.so   (src/native/Makefile)
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+#define MXTPU_API extern "C" __attribute__((visibility("default")))
+
+MXTPU_API const char* MXTPULibOpList() {
+  return "[{\"name\": \"my_gelu\", \"num_inputs\": 1},"
+         " {\"name\": \"my_weighted_add\", \"num_inputs\": 2}]";
+}
+
+static int64_t NumElems(const int64_t* shape, int ndim) {
+  int64_t n = 1;
+  for (int i = 0; i < ndim; ++i) n *= shape[i];
+  return n;
+}
+
+MXTPU_API int MXTPULibOpCompute(const char* name, int n_in,
+                                const float** ins, const int64_t* shape,
+                                int ndim, float* out) {
+  const int64_t n = NumElems(shape, ndim);
+  if (std::strcmp(name, "my_gelu") == 0 && n_in == 1) {
+    const float* x = ins[0];
+    for (int64_t i = 0; i < n; ++i) {
+      const float v = x[i];
+      out[i] = 0.5f * v * (1.0f + std::tanh(0.7978845608f *
+                                            (v + 0.044715f * v * v * v)));
+    }
+    return 0;
+  }
+  if (std::strcmp(name, "my_weighted_add") == 0 && n_in == 2) {
+    for (int64_t i = 0; i < n; ++i) {
+      out[i] = 0.75f * ins[0][i] + 0.25f * ins[1][i];
+    }
+    return 0;
+  }
+  return 1;  // unknown op / arity
+}

@@ -1,0 +1,51 @@
+"""Env-var config system (env_var.md / dmlc::GetEnv analog, SURVEY §5.6)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import config
+
+
+def test_defaults_and_types(monkeypatch):
+    monkeypatch.delenv("MXNET_CPU_WORKER_NTHREADS", raising=False)
+    assert config.get("MXNET_CPU_WORKER_NTHREADS") == 4
+    monkeypatch.setenv("MXNET_CPU_WORKER_NTHREADS", "7")
+    assert config.get("MXNET_CPU_WORKER_NTHREADS") == 7
+    monkeypatch.setenv("MXNET_CPU_WORKER_NTHREADS", "junk")
+    assert config.get("MXNET_CPU_WORKER_NTHREADS") == 4  # falls back
+
+
+def test_bool_var(monkeypatch):
+    monkeypatch.setenv("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", "0")
+    assert config.get("MXNET_STORAGE_FALLBACK_LOG_VERBOSE") is False
+    monkeypatch.setenv("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", "1")
+    assert config.get("MXNET_STORAGE_FALLBACK_LOG_VERBOSE") is True
+
+
+def test_undeclared_passthrough(monkeypatch):
+    monkeypatch.setenv("MXNET_SOMETHING_NEW", "abc")
+    assert config.get("MXNET_SOMETHING_NEW") == "abc"
+    assert config.get("MXNET_NOT_SET", default="d") == "d"
+
+
+def test_describe_covers_reference_vocabulary():
+    text = config.describe()
+    for name in ("MXNET_SUBGRAPH_BACKEND", "MXNET_ENGINE_TYPE",
+                 "MXNET_USE_FUSION", "MXNET_CUDNN_AUTOTUNE_DEFAULT",
+                 "MXNET_UPDATE_ON_KVSTORE", "MXNET_SAFE_ACCUMULATION"):
+        assert name in text
+    assert len(config.VARS) >= 20
+
+
+def test_sparse_fallback_respects_flag(monkeypatch):
+    from incubator_mxnet_tpu.ndarray.sparse import csr_matrix
+
+    csr = csr_matrix((np.array([1.0], np.float32),
+                      np.array([0], np.int64),
+                      np.array([0, 1, 1], np.int64)), shape=(2, 2))
+    monkeypatch.setenv("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", "0")
+    import warnings as w
+
+    with w.catch_warnings():
+        w.simplefilter("error")
+        (csr + csr)  # densifying add: silent when flag off

@@ -1,0 +1,43 @@
+"""L9 binding path: a pure C++ consumer of the C ABI (cpp-package/),
+equivalent to the reference's cpp-package + predict-cpp example."""
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DIR = os.path.join(_REPO, "cpp-package")
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+def test_cpp_predict_demo_builds_and_serves(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    site = subprocess.run(
+        [sys.executable, "-c",
+         "import site;print(site.getsitepackages()[0])"],
+        capture_output=True, text=True).stdout.strip()
+    env["PYTHONPATH"] = os.pathsep.join(
+        [_REPO, site, env.get("PYTHONPATH", "")])
+
+    build = subprocess.run(["make", "predict_demo"], cwd=_DIR, env=env,
+                           capture_output=True, text=True, timeout=300)
+    assert build.returncode == 0, build.stderr[-2000:]
+
+    prefix = str(tmp_path / "model")
+    mk = subprocess.run([sys.executable,
+                         os.path.join(_DIR, "make_model.py"), prefix],
+                        cwd=_DIR, env=env, capture_output=True, text=True,
+                        timeout=300)
+    assert mk.returncode == 0, mk.stderr[-2000:]
+
+    run = subprocess.run([os.path.join(_DIR, "predict_demo"), prefix],
+                         cwd=_DIR, env=env, capture_output=True, text=True,
+                         timeout=300)
+    assert run.returncode == 0, run.stdout + run.stderr[-2000:]
+    assert "PREDICT_DEMO_OK" in run.stdout
+    assert "output shape: (2, 4)" in run.stdout
+    # softmax rows sum to 1 each
+    assert "(sum 2.0000)" in run.stdout

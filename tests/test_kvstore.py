@@ -109,3 +109,50 @@ def test_gradient_compression_api():
     store = kv.create("dist_sync_device")
     store.set_gradient_compression({"type": "2bit", "threshold": 0.5})
     assert store._compression_params["type"] == "2bit"
+
+
+def test_pluggable_kvstore_backend_via_trainer():
+    """KVStoreBase.register (base.py:75 parity, the Horovod plug-in hook):
+    a third-party store registered by name is created by kv.create and
+    carries a gluon Trainer end to end."""
+    import numpy as np
+
+    from incubator_mxnet_tpu import autograd, gluon, nd
+    from incubator_mxnet_tpu.kvstore.kvstore import KVStore, KVStoreBase
+
+    calls = {"push": 0, "pull": 0}
+
+    @KVStoreBase.register
+    class MyHorovodLike(KVStore):
+        def __init__(self):
+            super().__init__("myhorovodlike")
+
+        def push(self, key, value, priority=0):
+            calls["push"] += 1
+            return super().push(key, value, priority)
+
+        def pull(self, key, out=None, priority=0, ignore_sparse=True):
+            calls["pull"] += 1
+            return super().pull(key, out, priority, ignore_sparse)
+
+    store = kv.create("myhorovodlike")
+    assert isinstance(store, MyHorovodLike)
+    assert store.type == "myhorovodlike"
+
+    mx.random.seed(0)
+    net = gluon.nn.Dense(2, in_units=3)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=store)
+    loss_fn = gluon.loss.L2Loss()
+    rng = np.random.RandomState(0)
+    w0 = net.weight.data().asnumpy().copy()
+    for _ in range(2):
+        x = nd.array(rng.rand(4, 3).astype(np.float32))
+        y = nd.array(rng.rand(4, 2).astype(np.float32))
+        with autograd.record():
+            loss = loss_fn(net(x), y).mean()
+        loss.backward()
+        trainer.step(4)
+    assert calls["push"] > 0 and calls["pull"] > 0
+    assert np.abs(net.weight.data().asnumpy() - w0).sum() > 0

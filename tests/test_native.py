@@ -242,3 +242,29 @@ def test_engine_as_io_pipeline(tmp_path):
     eng.wait_for_all()
     assert result["data"] == payload
     eng.close()
+
+
+def test_resource_manager_temp_space_and_rng():
+    """ResourceManager parity (resource.h:38-130): pooled host scratch is
+    reused across requests; parallel random streams are independent."""
+    import numpy as np
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.resource import ResourceRequest, request
+
+    r = request(ResourceRequest.kTempSpace)
+    a = r.get_space((16, 4), "float32")
+    a[:] = 7.0
+    b = r.get_space((8,), "float32")  # smaller: same slot buffer reused
+    assert a.__array_interface__["data"][0] == \
+        b.__array_interface__["data"][0]  # same backing buffer (slot reuse)
+    big = r.get_space((64, 64), "float64")
+    assert big.shape == (64, 64) and big.dtype == np.float64
+
+    pr1 = request(ResourceRequest.kParallelRandom)
+    pr2 = request(ResourceRequest.kParallelRandom)
+    k1, k2 = pr1.get_random(), pr2.get_random()
+    assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+
+    rr = request(ResourceRequest.kRandom)
+    assert np.asarray(rr.get_random()).shape == np.asarray(k1).shape
