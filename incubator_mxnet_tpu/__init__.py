@@ -93,3 +93,5 @@ from . import subgraph
 from . import config
 from . import library
 from . import resource
+from . import tensorboard
+from . import torch_bridge

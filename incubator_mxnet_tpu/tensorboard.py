@@ -1,0 +1,159 @@
+"""Minimal TensorBoard event writer (``python/mxnet/tensorboard.py`` /
+mxboard parity for scalar logging).
+
+Self-contained: writes TensorFlow event files (the TFRecord-framed
+``Event``/``Summary`` protos) by hand-encoding the protobuf wire format and
+the masked-CRC32C framing, so no tensorflow/tensorboard package is needed.
+TensorBoard reads the resulting ``events.out.tfevents.*`` files directly.
+
+Supported: ``add_scalar`` (the overwhelmingly common case for the
+reference's LogMetricsCallback-style usage) and ``add_text``.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+from typing import Optional
+
+__all__ = ["SummaryWriter"]
+
+# -- CRC32C (software, Castagnoli polynomial) -------------------------------
+def _build_crc_table():
+    poly = 0x82F63B78
+    table = []
+    for n in range(256):
+        c = n
+        for _ in range(8):
+            c = (c >> 1) ^ poly if c & 1 else c >> 1
+        table.append(c)
+    return table
+
+
+_CRC_TABLE = _build_crc_table()  # at import: no lazy-init thread race
+
+
+def _crc32c(data: bytes) -> int:
+    table = _CRC_TABLE
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# -- protobuf wire-format helpers -------------------------------------------
+
+def _varint(n: int) -> bytes:
+    # negatives encode as 64-bit two's complement (protobuf int64 rule);
+    # plain arithmetic shift would loop forever on n < 0
+    n &= (1 << 64) - 1
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b | 0x80])
+        else:
+            out += bytes([b])
+            return out
+
+
+def _key(field: int, wire: int) -> bytes:
+    return _varint(field << 3 | wire)
+
+
+def _pb_bytes(field: int, payload: bytes) -> bytes:
+    return _key(field, 2) + _varint(len(payload)) + payload
+
+
+def _pb_float(field: int, v: float) -> bytes:
+    return _key(field, 5) + struct.pack("<f", v)
+
+
+def _pb_double(field: int, v: float) -> bytes:
+    return _key(field, 1) + struct.pack("<d", v)
+
+
+def _pb_int(field: int, v: int) -> bytes:
+    return _key(field, 0) + _varint(v)
+
+
+# Event proto (tensorflow/core/util/event.proto):
+#   1: double wall_time   2: int64 step   5: Summary summary
+#   3: string file_version
+# Summary.Value (summary.proto): 1: tag  2: simple_value(float, field 2)
+#   8: metadata ... ; text uses tensor field — we use simple string via
+#   tag + metadata-free simple_value/or tensor; for text we write it as a
+#   tensor of dtype DT_STRING (field 8 plugin_name "text").
+
+
+def _scalar_event(tag: str, value: float, step: int, wall: float) -> bytes:
+    val = _pb_bytes(1, tag.encode()) + _pb_float(2, float(value))
+    summary = _pb_bytes(1, val)
+    return (_pb_double(1, wall) + _pb_int(2, int(step))
+            + _pb_bytes(5, summary))
+
+
+def _text_event(tag: str, text: str, step: int, wall: float) -> bytes:
+    # TensorProto: 1: dtype (DT_STRING=7), 8: string_val
+    tensor = _pb_int(1, 7) + _pb_bytes(8, text.encode())
+    # SummaryMetadata: 1: PluginData{1: plugin_name}
+    plugin = _pb_bytes(1, _pb_bytes(1, b"text"))
+    val = (_pb_bytes(1, (tag + "/text_summary").encode())
+           + _pb_bytes(9, plugin) + _pb_bytes(8, tensor))
+    summary = _pb_bytes(1, val)
+    return (_pb_double(1, wall) + _pb_int(2, int(step))
+            + _pb_bytes(5, summary))
+
+
+class SummaryWriter:
+    """Log scalars/text for TensorBoard (mxboard SummaryWriter surface)."""
+
+    _serial = 0
+
+    def __init__(self, logdir: str, filename_suffix: str = ""):
+        os.makedirs(logdir, exist_ok=True)
+        # pid + per-process serial uniquify the name: two writers created in
+        # the same second (train+eval sharing a logdir) must not clobber
+        SummaryWriter._serial += 1
+        fname = "events.out.tfevents.%010d.%s.%d.%d%s" % (
+            int(time.time()), socket.gethostname(), os.getpid(),
+            SummaryWriter._serial, filename_suffix)
+        self._path = os.path.join(logdir, fname)
+        self._f = open(self._path, "wb")
+        # file_version header event
+        self._write_record(_pb_double(1, time.time())
+                           + _pb_bytes(3, b"brain.Event:2"))
+
+    def _write_record(self, event: bytes) -> None:
+        header = struct.pack("<Q", len(event))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(event)
+        self._f.write(struct.pack("<I", _masked_crc(event)))
+        self._f.flush()
+
+    def add_scalar(self, tag: str, value, global_step: int = 0) -> None:
+        self._write_record(_scalar_event(tag, float(value), global_step,
+                                         time.time()))
+
+    def add_text(self, tag: str, text: str, global_step: int = 0) -> None:
+        self._write_record(_text_event(tag, text, global_step, time.time()))
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

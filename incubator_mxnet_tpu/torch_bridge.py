@@ -1,0 +1,78 @@
+"""PyTorch interop (``python/mxnet/torch.py`` plugin-bridge parity).
+
+The reference bridged Torch7 kernels through a C plugin; the modern
+equivalent is zero-copy tensor interchange with PyTorch over DLPack
+(``python/mxnet/dlpack.py`` machinery), which this module provides:
+
+- :func:`to_torch` — NDArray → torch.Tensor (zero-copy via __dlpack__
+  when devices allow, copy fallback otherwise);
+- :func:`from_torch` — torch.Tensor → NDArray;
+- :func:`torch_function` — wrap a torch callable as an eager op on
+  NDArrays (the "run a torch kernel on framework tensors" use the
+  reference's mx.th bridge served).
+
+Torch is an optional dependency: importing this module without torch
+installed raises only when a bridge function is called.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from .ndarray import NDArray
+from .ndarray.ndarray import array as _nd_array
+
+__all__ = ["to_torch", "from_torch", "torch_function"]
+
+
+def _torch():
+    try:
+        import torch
+    except ImportError as e:  # pragma: no cover
+        raise ImportError("torch_bridge requires pytorch") from e
+    return torch
+
+
+def to_torch(arr: NDArray):
+    """NDArray → torch.Tensor (zero-copy when the buffer is shareable)."""
+    torch = _torch()
+    data = arr._data if isinstance(arr, NDArray) else arr
+    try:
+        return torch.from_dlpack(data)
+    except Exception:
+        import numpy as np
+
+        return torch.from_numpy(np.asarray(data))
+
+
+def from_torch(tensor) -> NDArray:
+    """torch.Tensor → NDArray."""
+    import jax
+
+    try:
+        return NDArray(jax.dlpack.from_dlpack(tensor))
+    except Exception:
+        return _nd_array(tensor.detach().cpu().numpy())
+
+
+def torch_function(fn: Callable[..., Any]) -> Callable[..., Any]:
+    """Wrap a torch callable so it consumes/produces NDArrays.
+
+    Example::
+
+        relu6 = torch_function(torch.nn.functional.relu6)
+        y = relu6(x_ndarray)          # NDArray in, NDArray out
+    """
+
+    def wrapped(*args, **kwargs):
+        conv = [to_torch(a) if isinstance(a, NDArray) else a for a in args]
+        kconv = {k: to_torch(v) if isinstance(v, NDArray) else v
+                 for k, v in kwargs.items()}
+        out = fn(*conv, **kconv)
+        torch = _torch()
+        if isinstance(out, (list, tuple)):
+            return type(out)(from_torch(o) if isinstance(o, torch.Tensor)
+                             else o for o in out)
+        return from_torch(out) if isinstance(out, torch.Tensor) else out
+
+    wrapped.__name__ = getattr(fn, "__name__", "torch_function")
+    return wrapped

@@ -268,3 +268,22 @@ def test_resource_manager_temp_space_and_rng():
 
     rr = request(ResourceRequest.kRandom)
     assert np.asarray(rr.get_random()).shape == np.asarray(k1).shape
+
+
+def test_engine_sanitizer_harness():
+    """SURVEY §5.2: the C++ engine stress test (writes serialize per var,
+    reads overlap, sticky errors, clean drain) — the same binary builds
+    under -fsanitize=address/thread via `make asan-check` / `tsan-check`."""
+    import shutil
+    import subprocess
+
+    if shutil.which("make") is None:
+        import pytest
+
+        pytest.skip("no make")
+    native = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "src", "native")
+    run = subprocess.run(["make", "engine-check"], cwd=native,
+                         capture_output=True, text=True, timeout=300)
+    assert run.returncode == 0, run.stdout + run.stderr[-1500:]
+    assert "ENGINE_TEST_OK" in run.stdout

@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Flakiness checker (``tools/flakiness_checker.py`` parity): rerun a test
+N times with distinct seeds and report the failure rate.
+
+Usage:
+  python tools/flakiness_checker.py tests/test_operator.py::test_dropout -n 20
+  python tools/flakiness_checker.py tests/test_rnn.py -n 10 --seed 7
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("test", help="pytest node id (file[::test])")
+    ap.add_argument("-n", "--trials", type=int, default=10)
+    ap.add_argument("--seed", type=int, default=0,
+                    help="base seed; trial i runs with seed base+i")
+    ap.add_argument("--stop-on-fail", action="store_true")
+    args = ap.parse_args()
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    failures = []
+    for i in range(args.trials):
+        env = dict(os.environ, MXNET_TEST_SEED=str(args.seed + i),
+                   PYTHONHASHSEED=str(args.seed + i))
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", args.test, "-x", "-q"],
+            cwd=repo, env=env, capture_output=True, text=True)
+        ok = proc.returncode == 0
+        print("trial %2d seed=%d: %s" % (i, args.seed + i,
+                                         "PASS" if ok else "FAIL"),
+              flush=True)
+        if not ok:
+            failures.append((i, proc.stdout[-1500:]))
+            if args.stop_on_fail:
+                break
+    print("\n%d/%d trials failed" % (len(failures), args.trials))
+    for i, log in failures[:3]:
+        print("--- trial %d tail ---\n%s" % (i, log))
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()
