@@ -38,6 +38,14 @@ def log(msg):
 def setup_jax():
     import jax
 
+    # honor $JAX_PLATFORMS even when a sitecustomize force-selects a
+    # platform after env is read (lets `JAX_PLATFORMS=cpu python bench.py`
+    # run off-chip)
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except Exception:
+            pass
     cache = os.path.join(REPO, ".jax_cache")
     os.makedirs(cache, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache)
@@ -253,6 +261,25 @@ def run_attention(seq=2048, heads=8, head_dim=128, batch=4, iters=20):
     return dt_flash
 
 
+def _backend_alive(timeout_s=240):
+    """jax backend init can block FOREVER when the TPU tunnel is down
+    (observed: port 8083 gone mid-session); probe it on a watchdog thread
+    so a dead tunnel still yields a parseable JSON error line."""
+    import threading
+
+    box = {}
+
+    def probe():
+        import jax
+
+        box["devices"] = list(jax.devices())
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    return box.get("devices")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="train",
@@ -263,6 +290,19 @@ def main():
     ap.add_argument("--data", default="synthetic",
                     choices=["synthetic", "recordio"])
     args = ap.parse_args()
+
+    setup_jax()
+    log("probing backend...")
+    devices = _backend_alive()
+    if devices is None:
+        log("backend init timed out — TPU tunnel down?")
+        metric = ("flash_attention_ms" if args.mode == "attention"
+                  else "resnet50_train_img_per_sec")
+        emit(metric, 0.0, "ms" if args.mode == "attention" else "img/s",
+             BASELINE_IMG_S,
+             {"error": "jax backend init timed out (TPU tunnel down?)"})
+        sys.exit(1)
+    log("backend ok: %s" % (devices,))
 
     if args.mode == "attention":
         run_attention()

@@ -32,16 +32,24 @@ def _torch():
     return torch
 
 
-def to_torch(arr: NDArray):
-    """NDArray → torch.Tensor (zero-copy when the buffer is shareable)."""
+def to_torch(arr: NDArray, copy: bool = True):
+    """NDArray → torch.Tensor.
+
+    ``copy=True`` (default) returns an owned tensor that is safe to mutate.
+    ``copy=False`` returns a zero-copy DLPack view of the jax buffer — jax
+    buffers are immutable and may be aliased, so in-place torch ops on the
+    view would silently corrupt the source (the read-only contract of
+    ``NDArray.to_dlpack_for_read``, ndarray.py:161); only opt in for
+    read-only consumption."""
     torch = _torch()
     data = arr._data if isinstance(arr, NDArray) else arr
     try:
-        return torch.from_dlpack(data)
+        t = torch.from_dlpack(data)
     except Exception:
         import numpy as np
 
-        return torch.from_numpy(np.asarray(data))
+        t = torch.from_numpy(np.asarray(data))
+    return t.clone() if copy else t
 
 
 def from_torch(tensor) -> NDArray:

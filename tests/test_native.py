@@ -283,7 +283,9 @@ def test_engine_sanitizer_harness():
         pytest.skip("no make")
     native = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "src", "native")
-    run = subprocess.run(["make", "engine-check"], cwd=native,
-                         capture_output=True, text=True, timeout=300)
+    # --always-make: a checked-out stale binary must never be what runs
+    run = subprocess.run(["make", "--always-make", "engine-check"],
+                         cwd=native, capture_output=True, text=True,
+                         timeout=300)
     assert run.returncode == 0, run.stdout + run.stderr[-1500:]
     assert "ENGINE_TEST_OK" in run.stdout

@@ -34,7 +34,7 @@ def main():
                                          "PASS" if ok else "FAIL"),
               flush=True)
         if not ok:
-            failures.append((i, proc.stdout[-1500:]))
+            failures.append((i, (proc.stdout + proc.stderr)[-1500:]))
             if args.stop_on_fail:
                 break
     print("\n%d/%d trials failed" % (len(failures), args.trials))
