@@ -1,0 +1,127 @@
+// Public C header for the MXNet-compatible ABI exported by
+// src/native/libmxtpu_capi.so.
+//
+// Reference contract: include/mxnet/c_api.h (242 MXNET_DLL functions) and
+// include/mxnet/c_predict_api.h.  This header declares the implemented
+// subset; semantics follow the reference signatures (CSR-style shape
+// marshalling, thread-local return buffers valid until the next call on the
+// same thread, MXGetLastError after any nonzero return).
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* NDArrayHandle;
+typedef void* SymbolHandle;
+typedef void* ExecutorHandle;
+typedef void* PredictorHandle;
+
+/* error / version ------------------------------------------------------- */
+const char* MXGetLastError(void);
+int MXGetVersion(int* out);
+
+/* NDArray --------------------------------------------------------------- */
+int MXNDArrayCreate(const uint32_t* shape, uint32_t ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle* out);
+int MXNDArrayCreateEx(const uint32_t* shape, uint32_t ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle* out);
+int MXNDArrayFree(NDArrayHandle handle);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void* data,
+                             uint64_t size);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void* data, uint64_t size);
+int MXNDArrayWaitToRead(NDArrayHandle handle);
+int MXNDArrayGetShape(NDArrayHandle handle, uint32_t* out_dim,
+                      const uint32_t** out_pdata);
+int MXNDArrayGetDType(NDArrayHandle handle, int* out);
+int MXNDArraySave(const char* fname, uint32_t num_args, NDArrayHandle* args,
+                  const char** keys);
+int MXNDArrayLoad(const char* fname, uint32_t* out_size,
+                  NDArrayHandle** out_arr, uint32_t* out_name_size,
+                  const char*** out_names);
+
+/* ops ------------------------------------------------------------------- */
+int MXListAllOpNames(uint32_t* out_size, const char*** out_array);
+int MXImperativeInvokeByName(const char* op_name, int num_inputs,
+                             NDArrayHandle* inputs, int* num_outputs,
+                             NDArrayHandle** outputs, int num_params,
+                             const char** param_keys,
+                             const char** param_vals);
+
+/* Symbol ---------------------------------------------------------------- */
+int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out);
+int MXSymbolSaveToJSON(SymbolHandle sym, const char** out_json);
+int MXSymbolFree(SymbolHandle sym);
+int MXSymbolListArguments(SymbolHandle sym, uint32_t* out_size,
+                          const char*** out_array);
+int MXSymbolListOutputs(SymbolHandle sym, uint32_t* out_size,
+                        const char*** out_array);
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, uint32_t* out_size,
+                                const char*** out_array);
+int MXSymbolCreateVariable(const char* name, SymbolHandle* out);
+/* One-shot CreateAtomicSymbol+Compose: op node over named/positional input
+ * symbols.  input_keys may be NULL (all positional); entries may be NULL. */
+int MXSymbolCreateFromOp(const char* op_name, uint32_t num_params,
+                         const char** param_keys, const char** param_vals,
+                         uint32_t num_inputs, const char** input_keys,
+                         SymbolHandle* inputs, const char* name,
+                         SymbolHandle* out);
+int MXSymbolInferShape(SymbolHandle sym, uint32_t num_args, const char** keys,
+                       const uint32_t* arg_ind_ptr,
+                       const uint32_t* arg_shape_data,
+                       uint32_t* in_shape_size, const uint32_t** in_shape_ndim,
+                       const uint32_t*** in_shape_data,
+                       uint32_t* out_shape_size,
+                       const uint32_t** out_shape_ndim,
+                       const uint32_t*** out_shape_data,
+                       uint32_t* aux_shape_size,
+                       const uint32_t** aux_shape_ndim,
+                       const uint32_t*** aux_shape_data, int* complete);
+int MXSymbolInferShapePartial(
+    SymbolHandle sym, uint32_t num_args, const char** keys,
+    const uint32_t* arg_ind_ptr, const uint32_t* arg_shape_data,
+    uint32_t* in_shape_size, const uint32_t** in_shape_ndim,
+    const uint32_t*** in_shape_data, uint32_t* out_shape_size,
+    const uint32_t** out_shape_ndim, const uint32_t*** out_shape_data,
+    uint32_t* aux_shape_size, const uint32_t** aux_shape_ndim,
+    const uint32_t*** aux_shape_data, int* complete);
+
+/* Executor -------------------------------------------------------------- */
+/* grad_req_type codes follow OpReqType: 0 null, 1 write, 2 inplace-write,
+ * 3 add.  in_args/aux_states arrive in list_arguments /
+ * list_auxiliary_states order; arg_grad_store entries may be NULL. */
+int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id, uint32_t len,
+                   NDArrayHandle* in_args, NDArrayHandle* arg_grad_store,
+                   uint32_t* grad_req_type, uint32_t aux_len,
+                   NDArrayHandle* aux_states, ExecutorHandle* out);
+int MXExecutorForward(ExecutorHandle h, int is_train);
+int MXExecutorOutputs(ExecutorHandle h, uint32_t* out_size,
+                      NDArrayHandle** out);
+int MXExecutorBackward(ExecutorHandle h, uint32_t len,
+                       NDArrayHandle* head_grads);
+int MXExecutorFree(ExecutorHandle h);
+
+/* Predict API (c_predict_api.h) ----------------------------------------- */
+int MXPredCreate(const char* symbol_json, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 uint32_t num_input_nodes, const char** input_keys,
+                 const uint32_t* input_shape_indptr,
+                 const uint32_t* input_shape_data, PredictorHandle* out);
+int MXPredSetInput(PredictorHandle h, const char* key, const float* data,
+                   uint32_t size);
+int MXPredForward(PredictorHandle h);
+int MXPredGetOutputShape(PredictorHandle h, uint32_t index,
+                         uint32_t** shape_data, uint32_t* shape_ndim);
+int MXPredGetOutput(PredictorHandle h, uint32_t index, float* data,
+                    uint32_t size);
+int MXPredFree(PredictorHandle h);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXTPU_C_API_H_ */

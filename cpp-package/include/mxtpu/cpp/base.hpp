@@ -1,0 +1,49 @@
+// C++ binding over the MXNet-compatible C ABI — error handling + handle
+// plumbing shared by all classes.
+//
+// Reference analog: cpp-package/include/mxnet-cpp/base.h.  Design differs:
+// handles are PyObject-backed (the runtime is JAX), RAII is std::shared_ptr
+// with the ABI's Free as deleter, errors become std::runtime_error carrying
+// MXGetLastError().
+#ifndef MXTPU_CPP_BASE_HPP_
+#define MXTPU_CPP_BASE_HPP_
+
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "../c_api.h"
+
+namespace mxtpu {
+
+inline void Check(int rc, const char* what) {
+  if (rc != 0) {
+    throw std::runtime_error(std::string(what) + ": " + MXGetLastError());
+  }
+}
+
+// stringify op parameters the way the ABI expects (python literal syntax for
+// tuples, lowercase bools)
+inline std::string ParamStr(const std::string& v) { return v; }
+inline std::string ParamStr(const char* v) { return v; }
+inline std::string ParamStr(bool v) { return v ? "True" : "False"; }
+template <typename T>
+inline std::string ParamStr(const T& v) {
+  std::ostringstream os;
+  os << v;
+  return os.str();
+}
+template <typename T>
+inline std::string ParamStr(const std::vector<T>& v) {
+  std::ostringstream os;
+  os << "(";
+  for (size_t i = 0; i < v.size(); ++i) os << (i ? ", " : "") << v[i];
+  if (v.size() == 1) os << ",";
+  os << ")";
+  return os.str();
+}
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_BASE_HPP_

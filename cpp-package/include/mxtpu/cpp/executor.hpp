@@ -1,0 +1,87 @@
+// Executor — bound symbolic graph: forward / backward / outputs.
+//
+// Reference analog: cpp-package/include/mxnet-cpp/executor.h over
+// MXExecutorBind/Forward/Backward/Outputs.  Gradient buffers passed at bind
+// time are written in place by Backward (OpReqType kWriteTo/kAddTo), so the
+// caller's handles always hold the latest gradients.
+#ifndef MXTPU_CPP_EXECUTOR_HPP_
+#define MXTPU_CPP_EXECUTOR_HPP_
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "base.hpp"
+#include "ndarray.hpp"
+#include "symbol.hpp"
+
+namespace mxtpu {
+
+// OpReqType (include/mxnet/op_attr_types.h)
+enum GradReq : uint32_t {
+  kNullOp = 0,
+  kWriteTo = 1,
+  kWriteInplace = 2,
+  kAddTo = 3,
+};
+
+class Executor {
+ public:
+  // in_args follow sym.ListArguments() order, aux_states follow
+  // sym.ListAuxiliaryStates() order; arg_grads entries may be null
+  // NDArrays (no gradient for that argument).
+  Executor(const Symbol& sym, std::vector<NDArray> in_args,
+           std::vector<NDArray> arg_grads, std::vector<uint32_t> grad_reqs,
+           std::vector<NDArray> aux_states = {})
+      : arg_arrays(std::move(in_args)),
+        grad_arrays(std::move(arg_grads)),
+        aux_arrays(std::move(aux_states)) {
+    std::vector<NDArrayHandle> args, grads, aux;
+    for (const auto& a : arg_arrays) args.push_back(a.get());
+    for (const auto& g : grad_arrays) {
+      grads.push_back(g.IsNull() ? nullptr : g.get());
+    }
+    for (const auto& a : aux_arrays) aux.push_back(a.get());
+    ExecutorHandle out = nullptr;
+    Check(MXExecutorBind(sym.get(), 1, 0,
+                         static_cast<uint32_t>(args.size()), args.data(),
+                         grads.empty() ? nullptr : grads.data(),
+                         grad_reqs.empty() ? nullptr : grad_reqs.data(),
+                         static_cast<uint32_t>(aux.size()), aux.data(),
+                         &out),
+          "MXExecutorBind");
+    h_ = std::shared_ptr<void>(out, MXExecutorFree);
+  }
+
+  void Forward(bool is_train) {
+    Check(MXExecutorForward(h_.get(), is_train ? 1 : 0), "MXExecutorForward");
+    uint32_t n = 0;
+    NDArrayHandle* outs = nullptr;
+    Check(MXExecutorOutputs(h_.get(), &n, &outs), "MXExecutorOutputs");
+    outputs.clear();
+    for (uint32_t i = 0; i < n; ++i) outputs.emplace_back(outs[i]);
+  }
+
+  void Backward(const std::vector<NDArray>& head_grads = {}) {
+    std::vector<NDArrayHandle> hg;
+    for (const auto& g : head_grads) hg.push_back(g.get());
+    Check(MXExecutorBackward(h_.get(),
+                             static_cast<uint32_t>(hg.size()),
+                             hg.empty() ? nullptr : hg.data()),
+          "MXExecutorBackward");
+  }
+
+  std::vector<NDArray> arg_arrays;
+  std::vector<NDArray> grad_arrays;
+  std::vector<NDArray> aux_arrays;
+  std::vector<NDArray> outputs;
+
+ private:
+  std::shared_ptr<void> h_;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_EXECUTOR_HPP_

@@ -1,0 +1,166 @@
+// NDArray — C++ tensor handle over the C ABI.
+//
+// Reference analog: cpp-package/include/mxnet-cpp/ndarray.h (NDArray class
+// over MXNDArray*).  Own design: shared_ptr RAII, imperative ops through
+// MXImperativeInvokeByName (optionally writing into caller buffers — the
+// MXImperativeInvokeEx in-place contract).
+#ifndef MXTPU_CPP_NDARRAY_HPP_
+#define MXTPU_CPP_NDARRAY_HPP_
+
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <numeric>
+#include <string>
+#include <vector>
+
+#include "base.hpp"
+
+namespace mxtpu {
+
+class NDArray {
+ public:
+  NDArray() = default;
+  // Takes ownership of a handle returned by the ABI.
+  explicit NDArray(NDArrayHandle h) : h_(h, MXNDArrayFree) {}
+
+  explicit NDArray(const std::vector<uint32_t>& shape, int dtype = 0) {
+    NDArrayHandle out = nullptr;
+    Check(MXNDArrayCreateEx(shape.data(),
+                            static_cast<uint32_t>(shape.size()), 1, 0, 0,
+                            dtype, &out),
+          "MXNDArrayCreateEx");
+    h_ = std::shared_ptr<void>(out, MXNDArrayFree);
+  }
+
+  NDArray(const std::vector<uint32_t>& shape, const std::vector<float>& data)
+      : NDArray(shape) {
+    SyncCopyFromCPU(data.data(), data.size());
+  }
+
+  bool IsNull() const { return h_ == nullptr; }
+  NDArrayHandle get() const { return h_.get(); }
+
+  void SyncCopyFromCPU(const float* data, size_t size) {
+    Check(MXNDArraySyncCopyFromCPU(h_.get(), data, size),
+          "MXNDArraySyncCopyFromCPU");
+  }
+
+  void SyncCopyToCPU(float* data, size_t size) const {
+    Check(MXNDArraySyncCopyToCPU(h_.get(), data, size),
+          "MXNDArraySyncCopyToCPU");
+  }
+
+  std::vector<float> ToVector() const {
+    std::vector<float> out(Size());
+    SyncCopyToCPU(out.data(), out.size());
+    return out;
+  }
+
+  std::vector<uint32_t> Shape() const {
+    uint32_t ndim = 0;
+    const uint32_t* data = nullptr;
+    Check(MXNDArrayGetShape(h_.get(), &ndim, &data), "MXNDArrayGetShape");
+    return std::vector<uint32_t>(data, data + ndim);
+  }
+
+  size_t Size() const {
+    auto s = Shape();
+    return std::accumulate(s.begin(), s.end(), size_t{1},
+                           std::multiplies<size_t>());
+  }
+
+  int DType() const {
+    int dt = 0;
+    Check(MXNDArrayGetDType(h_.get(), &dt), "MXNDArrayGetDType");
+    return dt;
+  }
+
+  void WaitToRead() const {
+    Check(MXNDArrayWaitToRead(h_.get()), "MXNDArrayWaitToRead");
+  }
+
+  static void Save(const std::string& fname,
+                   const std::map<std::string, NDArray>& arrays) {
+    std::vector<NDArrayHandle> handles;
+    std::vector<const char*> keys;
+    for (const auto& kv : arrays) {
+      keys.push_back(kv.first.c_str());
+      handles.push_back(kv.second.get());
+    }
+    Check(MXNDArraySave(fname.c_str(),
+                        static_cast<uint32_t>(handles.size()),
+                        handles.data(), keys.data()),
+          "MXNDArraySave");
+  }
+
+  static std::map<std::string, NDArray> Load(const std::string& fname) {
+    uint32_t n = 0, nn = 0;
+    NDArrayHandle* arrs = nullptr;
+    const char** names = nullptr;
+    Check(MXNDArrayLoad(fname.c_str(), &n, &arrs, &nn, &names),
+          "MXNDArrayLoad");
+    std::map<std::string, NDArray> out;
+    for (uint32_t i = 0; i < n; ++i) {
+      std::string key = nn == n ? names[i] : std::to_string(i);
+      out.emplace(key, NDArray(arrs[i]));
+    }
+    return out;
+  }
+
+ private:
+  std::shared_ptr<void> h_;
+};
+
+// Imperative invoke: run a registered op on NDArrays.  When `outs` is
+// non-null its handles receive the results in place (optimizer updates);
+// otherwise fresh arrays are returned.
+inline std::vector<NDArray> Invoke(
+    const std::string& op, const std::vector<NDArray>& inputs,
+    const std::map<std::string, std::string>& params = {},
+    std::vector<NDArray>* outs = nullptr) {
+  std::vector<NDArrayHandle> ins;
+  for (const auto& a : inputs) ins.push_back(a.get());
+  std::vector<const char*> keys;
+  std::vector<const char*> vals;
+  for (const auto& kv : params) {
+    keys.push_back(kv.first.c_str());
+    vals.push_back(kv.second.c_str());
+  }
+  std::vector<NDArrayHandle> out_handles;
+  int num_outputs = 0;
+  NDArrayHandle* out_ptr = nullptr;
+  if (outs != nullptr) {
+    for (const auto& a : *outs) out_handles.push_back(a.get());
+    num_outputs = static_cast<int>(out_handles.size());
+    out_ptr = out_handles.data();
+  }
+  Check(MXImperativeInvokeByName(op.c_str(),
+                                 static_cast<int>(ins.size()), ins.data(),
+                                 &num_outputs, &out_ptr,
+                                 static_cast<int>(keys.size()), keys.data(),
+                                 vals.data()),
+        ("MXImperativeInvokeByName(" + op + ")").c_str());
+  if (outs != nullptr) return *outs;
+  std::vector<NDArray> result;
+  for (int i = 0; i < num_outputs; ++i) result.emplace_back(out_ptr[i]);
+  return result;
+}
+
+inline NDArray operator+(const NDArray& a, const NDArray& b) {
+  return Invoke("broadcast_add", {a, b})[0];
+}
+inline NDArray operator-(const NDArray& a, const NDArray& b) {
+  return Invoke("broadcast_sub", {a, b})[0];
+}
+inline NDArray operator*(const NDArray& a, const NDArray& b) {
+  return Invoke("broadcast_mul", {a, b})[0];
+}
+inline NDArray operator/(const NDArray& a, const NDArray& b) {
+  return Invoke("broadcast_div", {a, b})[0];
+}
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_NDARRAY_HPP_

@@ -1,0 +1,67 @@
+// Optimizer — parameter updates from C++ through the registered update ops.
+//
+// Reference analog: cpp-package/include/mxnet-cpp/optimizer.h (Optimizer
+// registry dispatching to sgd_update/sgd_mom_update/adam_update...).  The
+// update ops run as in-place imperative invokes (caller-provided outputs),
+// so weights and optimizer state mutate exactly like the reference's
+// kWriteInplace update kernels.
+#ifndef MXTPU_CPP_OPTIMIZER_HPP_
+#define MXTPU_CPP_OPTIMIZER_HPP_
+
+#include <map>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "base.hpp"
+#include "ndarray.hpp"
+
+namespace mxtpu {
+
+class Optimizer {
+ public:
+  // type: "sgd" (momentum optional) or "adam"
+  explicit Optimizer(const std::string& type = "sgd", float lr = 0.01f,
+                     float momentum = 0.0f, float wd = 0.0f)
+      : type_(type), lr_(lr), momentum_(momentum), wd_(wd) {}
+
+  void SetLearningRate(float lr) { lr_ = lr; }
+
+  void Update(int index, NDArray& weight, const NDArray& grad) {
+    std::map<std::string, std::string> p{{"lr", ParamStr(lr_)},
+                                         {"wd", ParamStr(wd_)}};
+    if (type_ == "adam") {
+      auto& m = StateFor(index, weight, 0);
+      auto& v = StateFor(index, weight, 1);
+      std::vector<NDArray> outs{weight, m, v};
+      Invoke("adam_update", {weight, grad, m, v}, p, &outs);
+    } else if (momentum_ != 0.0f) {
+      auto& m = StateFor(index, weight, 0);
+      p["momentum"] = ParamStr(momentum_);
+      std::vector<NDArray> outs{weight, m};
+      Invoke("sgd_mom_update", {weight, grad, m}, p, &outs);
+    } else {
+      std::vector<NDArray> outs{weight};
+      Invoke("sgd_update", {weight, grad}, p, &outs);
+    }
+  }
+
+ private:
+  NDArray& StateFor(int index, const NDArray& weight, int slot) {
+    auto key = std::make_pair(index, slot);
+    auto it = states_.find(key);
+    if (it == states_.end()) {
+      NDArray zeros = Invoke("zeros_like", {weight})[0];
+      it = states_.emplace(key, zeros).first;
+    }
+    return it->second;
+  }
+
+  std::string type_;
+  float lr_, momentum_, wd_;
+  std::map<std::pair<int, int>, NDArray> states_;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_OPTIMIZER_HPP_

@@ -1,0 +1,162 @@
+// Symbol + Operator — symbolic graph construction from C++.
+//
+// Reference analog: cpp-package/include/mxnet-cpp/symbol.h + operator.h
+// (Operator::SetParam/SetInput/CreateSymbol over MXSymbolCreateAtomicSymbol
+// + MXSymbolCompose).  Here composition is the one-shot
+// MXSymbolCreateFromOp; the graph itself lives in the runtime's Symbol IR
+// (incubator_mxnet_tpu/symbol/symbol.py).
+#ifndef MXTPU_CPP_SYMBOL_HPP_
+#define MXTPU_CPP_SYMBOL_HPP_
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "base.hpp"
+
+namespace mxtpu {
+
+class Symbol {
+ public:
+  Symbol() = default;
+  explicit Symbol(SymbolHandle h) : h_(h, MXSymbolFree) {}
+
+  static Symbol Variable(const std::string& name) {
+    SymbolHandle out = nullptr;
+    Check(MXSymbolCreateVariable(name.c_str(), &out),
+          "MXSymbolCreateVariable");
+    return Symbol(out);
+  }
+
+  static Symbol FromJSON(const std::string& json) {
+    SymbolHandle out = nullptr;
+    Check(MXSymbolCreateFromJSON(json.c_str(), &out),
+          "MXSymbolCreateFromJSON");
+    return Symbol(out);
+  }
+
+  std::string ToJSON() const {
+    const char* json = nullptr;
+    Check(MXSymbolSaveToJSON(h_.get(), &json), "MXSymbolSaveToJSON");
+    return json;
+  }
+
+  std::vector<std::string> ListArguments() const {
+    return StrList(MXSymbolListArguments);
+  }
+  std::vector<std::string> ListOutputs() const {
+    return StrList(MXSymbolListOutputs);
+  }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    return StrList(MXSymbolListAuxiliaryStates);
+  }
+
+  // Shape inference from known input shapes (MXSymbolInferShape CSR
+  // marshalling).  Returns true when every shape is fully known.
+  bool InferShape(
+      const std::map<std::string, std::vector<uint32_t>>& known,
+      std::vector<std::vector<uint32_t>>* arg_shapes,
+      std::vector<std::vector<uint32_t>>* out_shapes,
+      std::vector<std::vector<uint32_t>>* aux_shapes) const {
+    std::vector<const char*> keys;
+    std::vector<uint32_t> indptr{0};
+    std::vector<uint32_t> data;
+    for (const auto& kv : known) {
+      keys.push_back(kv.first.c_str());
+      data.insert(data.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<uint32_t>(data.size()));
+    }
+    uint32_t sizes[3] = {0, 0, 0};
+    const uint32_t* ndims[3] = {nullptr, nullptr, nullptr};
+    const uint32_t** shapes[3] = {nullptr, nullptr, nullptr};
+    int complete = 0;
+    Check(MXSymbolInferShape(h_.get(),
+                             static_cast<uint32_t>(keys.size()), keys.data(),
+                             indptr.data(), data.data(), &sizes[0],
+                             &ndims[0], &shapes[0], &sizes[1], &ndims[1],
+                             &shapes[1], &sizes[2], &ndims[2], &shapes[2],
+                             &complete),
+          "MXSymbolInferShape");
+    std::vector<std::vector<uint32_t>>* dsts[3] = {arg_shapes, out_shapes,
+                                                   aux_shapes};
+    for (int g = 0; g < 3; ++g) {
+      if (dsts[g] == nullptr) continue;
+      dsts[g]->clear();
+      for (uint32_t i = 0; i < sizes[g]; ++i) {
+        dsts[g]->emplace_back(shapes[g][i], shapes[g][i] + ndims[g][i]);
+      }
+    }
+    return complete != 0;
+  }
+
+  SymbolHandle get() const { return h_.get(); }
+
+ private:
+  using ListFn = int (*)(SymbolHandle, uint32_t*, const char***);
+  std::vector<std::string> StrList(ListFn fn) const {
+    uint32_t n = 0;
+    const char** arr = nullptr;
+    Check(fn(h_.get(), &n, &arr), "MXSymbolList*");
+    return std::vector<std::string>(arr, arr + n);
+  }
+
+  std::shared_ptr<void> h_;
+};
+
+// Fluent op-node builder (mxnet-cpp Operator semantics):
+//   auto fc = Operator("FullyConnected").SetParam("num_hidden", 64)
+//                 .SetInput("data", x).CreateSymbol("fc1");
+class Operator {
+ public:
+  explicit Operator(const std::string& op_name) : op_(op_name) {}
+
+  template <typename T>
+  Operator& SetParam(const std::string& key, const T& value) {
+    params_.emplace_back(key, ParamStr(value));
+    return *this;
+  }
+
+  Operator& SetInput(const std::string& key, const Symbol& s) {
+    inputs_.emplace_back(key, s);
+    return *this;
+  }
+
+  Operator& AddInput(const Symbol& s) {
+    inputs_.emplace_back("", s);
+    return *this;
+  }
+
+  Symbol CreateSymbol(const std::string& name = "") {
+    std::vector<const char*> pkeys, pvals, ikeys;
+    for (const auto& kv : params_) {
+      pkeys.push_back(kv.first.c_str());
+      pvals.push_back(kv.second.c_str());
+    }
+    std::vector<SymbolHandle> ins;
+    for (const auto& kv : inputs_) {
+      ikeys.push_back(kv.first.empty() ? nullptr : kv.first.c_str());
+      ins.push_back(kv.second.get());
+    }
+    SymbolHandle out = nullptr;
+    Check(MXSymbolCreateFromOp(op_.c_str(),
+                               static_cast<uint32_t>(pkeys.size()),
+                               pkeys.data(), pvals.data(),
+                               static_cast<uint32_t>(ins.size()),
+                               ikeys.data(), ins.data(),
+                               name.empty() ? nullptr : name.c_str(), &out),
+          ("MXSymbolCreateFromOp(" + op_ + ")").c_str());
+    return Symbol(out);
+  }
+
+ private:
+  std::string op_;
+  std::vector<std::pair<std::string, std::string>> params_;
+  std::vector<std::pair<std::string, Symbol>> inputs_;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_SYMBOL_HPP_

@@ -1,0 +1,13 @@
+// Umbrella header for the C++ binding package (reference analog:
+// cpp-package/include/mxnet-cpp/MxNetCpp.h).
+#ifndef MXTPU_MXTPU_CPP_HPP_
+#define MXTPU_MXTPU_CPP_HPP_
+
+#include "c_api.h"
+#include "cpp/base.hpp"
+#include "cpp/ndarray.hpp"
+#include "cpp/symbol.hpp"
+#include "cpp/executor.hpp"
+#include "cpp/optimizer.hpp"
+
+#endif  // MXTPU_MXTPU_CPP_HPP_

@@ -1,0 +1,148 @@
+// End-to-end TRAINING in pure C++ through the binding package (L9).
+//
+// Reference analog: cpp-package/example/mlp.cpp — build an MLP symbolically,
+// bind an Executor, run forward/backward, update weights with an Optimizer,
+// watch the loss fall.  No Python in this source; the runtime is reached
+// only through libmxtpu_capi.so.
+//
+// Task: binary classification of two Gaussian blobs in 8-D.  An MLP with
+// one hidden layer separates them; training accuracy must reach >0.9 from
+// a 0.5 start for the demo to pass.
+#include <cmath>
+#include <cstdio>
+#include <random>
+#include <vector>
+
+#include "include/mxtpu/mxtpu_cpp.hpp"
+
+using mxtpu::Executor;
+using mxtpu::NDArray;
+using mxtpu::Operator;
+using mxtpu::Optimizer;
+using mxtpu::Symbol;
+
+int main() {
+  int version = 0;
+  mxtpu::Check(MXGetVersion(&version), "MXGetVersion");
+  std::printf("libmxtpu_capi version %d\n", version);
+
+  const uint32_t kBatch = 64, kDim = 8, kHidden = 32, kClasses = 2;
+
+  // ---- symbolic MLP: data -> fc1 -> relu -> fc2 -> SoftmaxOutput ----------
+  Symbol data = Symbol::Variable("data");
+  Symbol label = Symbol::Variable("softmax_label");
+  Symbol fc1 = Operator("FullyConnected")
+                   .SetParam("num_hidden", kHidden)
+                   .SetInput("data", data)
+                   .CreateSymbol("fc1");
+  Symbol act = Operator("Activation")
+                   .SetParam("act_type", "relu")
+                   .SetInput("data", fc1)
+                   .CreateSymbol("relu1");
+  Symbol fc2 = Operator("FullyConnected")
+                   .SetParam("num_hidden", kClasses)
+                   .SetInput("data", act)
+                   .CreateSymbol("fc2");
+  Symbol net = Operator("SoftmaxOutput")
+                   .SetParam("normalization", "batch")  // mean over batch:
+                   // keeps grads O(1) so SGD at lr 0.2 converges
+                   .SetInput("data", fc2)
+                   .SetInput("label", label)
+                   .CreateSymbol("softmax");
+
+  auto arg_names = net.ListArguments();
+  std::printf("arguments:");
+  for (const auto& n : arg_names) std::printf(" %s", n.c_str());
+  std::printf("\n");
+
+  // ---- infer shapes, allocate args + grads --------------------------------
+  std::vector<std::vector<uint32_t>> arg_shapes, out_shapes;
+  bool complete = net.InferShape({{"data", {kBatch, kDim}},
+                                  {"softmax_label", {kBatch}}},
+                                 &arg_shapes, &out_shapes, nullptr);
+  if (!complete || out_shapes.empty()) {
+    std::fprintf(stderr, "shape inference incomplete\n");
+    return 1;
+  }
+
+  std::mt19937 rng(7);
+  std::normal_distribution<float> gauss(0.0f, 0.1f);
+  std::vector<NDArray> args, grads;
+  std::vector<uint32_t> reqs;
+  for (size_t i = 0; i < arg_names.size(); ++i) {
+    const bool is_input =
+        arg_names[i] == "data" || arg_names[i] == "softmax_label";
+    NDArray arr(arg_shapes[i]);
+    if (!is_input) {  // xavier-ish init for parameters
+      std::vector<float> w(arr.Size());
+      for (auto& v : w) v = gauss(rng);
+      arr.SyncCopyFromCPU(w.data(), w.size());
+    }
+    args.push_back(arr);
+    grads.push_back(is_input ? NDArray() : NDArray(arg_shapes[i]));
+    reqs.push_back(is_input ? mxtpu::kNullOp : mxtpu::kWriteTo);
+  }
+
+  Executor exe(net, args, grads, reqs);
+  Optimizer opt("sgd", 0.2f, 0.9f, 1e-4f);
+
+  // ---- synthetic two-blob dataset ----------------------------------------
+  std::vector<float> x(kBatch * kDim), y(kBatch);
+  auto make_batch = [&]() {
+    for (uint32_t b = 0; b < kBatch; ++b) {
+      float cls = static_cast<float>(b % 2);
+      y[b] = cls;
+      for (uint32_t d = 0; d < kDim; ++d) {
+        x[b * kDim + d] = gauss(rng) * 5.0f + (cls ? 1.0f : -1.0f);
+      }
+    }
+  };
+
+  // ---- training loop ------------------------------------------------------
+  float first_acc = -1.0f, acc = 0.0f;
+  for (int epoch = 0; epoch < 30; ++epoch) {
+    make_batch();
+    // bound input handles are written in place; the executor sees the batch
+    for (size_t i = 0; i < arg_names.size(); ++i) {
+      if (arg_names[i] == "data") args[i].SyncCopyFromCPU(x.data(), x.size());
+      if (arg_names[i] == "softmax_label") {
+        args[i].SyncCopyFromCPU(y.data(), y.size());
+      }
+    }
+    exe.Forward(true);
+    exe.Backward();
+    for (size_t i = 0; i < arg_names.size(); ++i) {
+      if (!grads[i].IsNull()) {
+        opt.Update(static_cast<int>(i), args[i], grads[i]);
+      }
+    }
+    // accuracy on this batch from the softmax output
+    auto probs = exe.outputs[0].ToVector();
+    int correct = 0;
+    for (uint32_t b = 0; b < kBatch; ++b) {
+      int pred = probs[b * kClasses] > probs[b * kClasses + 1] ? 0 : 1;
+      correct += pred == static_cast<int>(y[b]);
+    }
+    acc = static_cast<float>(correct) / kBatch;
+    if (first_acc < 0.0f) first_acc = acc;
+    if (epoch % 10 == 0 || epoch == 29) {
+      std::printf("epoch %2d  batch accuracy %.3f\n", epoch, acc);
+    }
+  }
+
+  // ---- save the trained parameters through the ABI ------------------------
+  std::map<std::string, NDArray> params;
+  for (size_t i = 0; i < arg_names.size(); ++i) {
+    if (!grads[i].IsNull()) params["arg:" + arg_names[i]] = args[i];
+  }
+  NDArray::Save("train_demo-0000.params", params);
+  auto loaded = NDArray::Load("train_demo-0000.params");
+  std::printf("saved+reloaded %zu params\n", loaded.size());
+
+  if (acc < 0.9f) {
+    std::fprintf(stderr, "FAIL: final accuracy %.3f < 0.9\n", acc);
+    return 1;
+  }
+  std::printf("TRAIN_DEMO_OK (accuracy %.3f -> %.3f)\n", first_acc, acc);
+  return 0;
+}

@@ -80,11 +80,15 @@ def list_op_names() -> List[str]:
     return _reg.list_ops()
 
 
-def imperative_invoke(op_name: str, inputs, keys, vals):
+def imperative_invoke(op_name: str, inputs, keys, vals, outs=None):
+    """``outs`` non-empty = caller-provided output buffers (the reference's
+    MXImperativeInvokeEx in-place contract, c_api_ndarray.cc:138): results
+    are written into those handles and the same handles are returned."""
     attrs = {}
     for k, v in zip(keys, vals):
         attrs[k] = sym_mod.symbol._parse_attr(v)
-    out = _reg.invoke(op_name, list(inputs), **attrs)
+    out = _reg.invoke(op_name, list(inputs), out=list(outs) if outs else None,
+                      **attrs)
     return out if isinstance(out, list) else [out]
 
 
@@ -108,6 +112,79 @@ def symbol_list_outputs(s) -> List[str]:
 
 def symbol_list_aux(s) -> List[str]:
     return list(s.list_auxiliary_states())
+
+
+def symbol_create_variable(name: str):
+    return sym_mod.var(name)
+
+
+def symbol_create_from_op(op_name: str, keys, vals, in_names, in_handles,
+                          name: str):
+    """Create an op node composed over input symbols in one shot — covers the
+    reference's MXSymbolCreateAtomicSymbol + MXSymbolCompose pair
+    (src/c_api/c_api_symbolic.cc)."""
+    attrs = {k: sym_mod.symbol._parse_attr(v) for k, v in zip(keys, vals)}
+    if name:
+        attrs["name"] = name
+    fn = getattr(sym_mod, op_name)
+    pos, kw = [], {}
+    for n, h in zip(in_names, in_handles):
+        if n:
+            kw[n] = h
+        else:
+            pos.append(h)
+    kw.update(attrs)
+    return fn(*pos, **kw)
+
+
+def symbol_infer_shape(s, keys, shapes, partial: bool):
+    """Returns (arg_shapes, out_shapes, aux_shapes, complete) as lists of
+    int-lists (MXSymbolInferShape / InferShapePartial semantics)."""
+    known = {k: tuple(int(d) for d in shp) for k, shp in zip(keys, shapes)}
+    fn = s.infer_shape_partial if partial else s.infer_shape
+    arg, out, aux = fn(**known)
+
+    def conv(lst):
+        return [list(map(int, t)) if t is not None else [] for t in lst]
+
+    complete = all(t is not None for t in list(arg) + list(out) + list(aux))
+    return conv(arg), conv(out), conv(aux), bool(complete)
+
+
+# -- Executor (MXExecutorBind/Forward/Backward/Outputs) ----------------------
+
+_GRAD_REQ_OF_CODE = {0: "null", 1: "write", 2: "write", 3: "add"}
+
+
+def executor_bind(s, in_args, arg_grads, req_codes, aux_states):
+    """MXExecutorBind semantics (c_api_executor.cc): handles arrive in
+    list_arguments / list_auxiliary_states order; arg_grads entries may be
+    None; grad_req codes follow OpReqType (kNullOp/kWriteTo/kWriteInplace/
+    kAddTo)."""
+    from . import cpu
+    from .executor import Executor
+
+    arg_names = s.list_arguments()
+    aux_names = s.list_auxiliary_states()
+    args = dict(zip(arg_names, in_args))
+    grads = {n: g for n, g in zip(arg_names, arg_grads) if g is not None}
+    reqs = {n: _GRAD_REQ_OF_CODE.get(int(c), "null")
+            for n, c in zip(arg_names, req_codes)}
+    aux = dict(zip(aux_names, aux_states))
+    return Executor(s, cpu(), args, args_grad=grads or None, grad_req=reqs,
+                    aux_states=aux)
+
+
+def executor_forward(exe, is_train: bool):
+    return list(exe.forward(is_train=bool(is_train)))
+
+
+def executor_outputs(exe):
+    return list(exe.outputs)
+
+
+def executor_backward(exe, head_grads):
+    exe.backward(list(head_grads) if head_grads else None)
 
 
 # -- Predict API (c_predict_api.h:84-289) -----------------------------------

@@ -260,8 +260,16 @@ def _invoke_impl(name: str, inputs: Sequence[Any], out=None, **attrs):
         autograd.attach_node(nd_outs, node)
 
     if out is not None:
-        # write into provided output buffer(s) — reference kWriteTo semantics
+        # write into provided output buffer(s) — reference kWriteTo semantics.
+        # Fewer buffers than outputs is allowed (trailing state outputs are
+        # dropped, matching reference ops whose extra states are mutated
+        # internally); MORE is an error — the surplus handles would silently
+        # keep stale data.
         outs = out if isinstance(out, (tuple, list)) else [out]
+        if len(outs) > len(nd_outs):
+            raise ValueError(
+                "op %r produced %d output(s) but %d output buffer(s) were "
+                "provided" % (name, len(nd_outs), len(outs)))
         for dst, src in zip(outs, nd_outs):
             dst._data = src._data
             dst._ag_node = getattr(src, "_ag_node", None)

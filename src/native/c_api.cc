@@ -337,10 +337,29 @@ MXTPU_API int MXImperativeInvokeByName(
     PyList_SetItem(keys, i, PyUnicode_FromString(param_keys[i]));
     PyList_SetItem(vals, i, PyUnicode_FromString(param_vals[i]));
   }
-  PyObject* args = Py_BuildValue("(sNNN)", op_name, ins, keys, vals);
+  // caller-provided outputs = in-place write request (MXImperativeInvokeEx
+  // contract, src/c_api/c_api_ndarray.cc:138)
+  const bool provided = *outputs != nullptr && *num_outputs > 0;
+  PyObject* pouts;
+  if (provided) {
+    pouts = PyList_New(*num_outputs);
+    for (int i = 0; i < *num_outputs; ++i) {
+      PyObject* o = static_cast<PyObject*>((*outputs)[i]);
+      Py_INCREF(o);
+      PyList_SetItem(pouts, i, o);
+    }
+  } else {
+    pouts = Py_None;
+    Py_INCREF(pouts);
+  }
+  PyObject* args = Py_BuildValue("(sNNNN)", op_name, ins, keys, vals, pouts);
   PyObject* res = CallImpl("imperative_invoke", args);
   Py_DECREF(args);
   if (res == nullptr) return FailFromPython();
+  if (provided) {  // results landed in the caller's handles
+    Py_DECREF(res);
+    return 0;
+  }
   Py_ssize_t n = PyList_Size(res);
   g_handle_store.clear();
   for (Py_ssize_t i = 0; i < n; ++i) {
@@ -412,6 +431,251 @@ MXTPU_API int MXSymbolListAuxiliaryStates(SymbolHandle sym,
                                           uint32_t* out_size,
                                           const char*** out_array) {
   return SymbolStrList("symbol_list_aux", sym, out_size, out_array);
+}
+
+MXTPU_API int MXSymbolCreateVariable(const char* name, SymbolHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", name);
+  PyObject* res = CallImpl("symbol_create_variable", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+// One-shot CreateAtomicSymbol + Compose (src/c_api/c_api_symbolic.cc):
+// builds the op node over named/positional input symbols.  input_keys may be
+// nullptr (all positional) and individual entries may be nullptr.
+MXTPU_API int MXSymbolCreateFromOp(const char* op_name, uint32_t num_params,
+                                   const char** param_keys,
+                                   const char** param_vals,
+                                   uint32_t num_inputs,
+                                   const char** input_keys,
+                                   SymbolHandle* inputs, const char* name,
+                                   SymbolHandle* out) {
+  Gil gil;
+  PyObject* keys = PyList_New(num_params);
+  PyObject* vals = PyList_New(num_params);
+  for (uint32_t i = 0; i < num_params; ++i) {
+    PyList_SetItem(keys, i, PyUnicode_FromString(param_keys[i]));
+    PyList_SetItem(vals, i, PyUnicode_FromString(param_vals[i]));
+  }
+  PyObject* in_names = PyList_New(num_inputs);
+  PyObject* in_syms = PyList_New(num_inputs);
+  for (uint32_t i = 0; i < num_inputs; ++i) {
+    const char* k = input_keys != nullptr ? input_keys[i] : nullptr;
+    PyList_SetItem(in_names, i,
+                   k != nullptr ? PyUnicode_FromString(k)
+                                : (Py_INCREF(Py_None), Py_None));
+    PyObject* s = static_cast<PyObject*>(inputs[i]);
+    Py_INCREF(s);
+    PyList_SetItem(in_syms, i, s);
+  }
+  PyObject* args = Py_BuildValue("(sNNNNs)", op_name, keys, vals, in_names,
+                                 in_syms, name != nullptr ? name : "");
+  PyObject* res = CallImpl("symbol_create_from_op", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+namespace {
+
+// arena for MXSymbolInferShape outputs (alive until the next call on this
+// thread, mirroring MXAPIThreadLocalEntry)
+thread_local std::vector<std::vector<uint32_t>> g_is_shapes[3];
+thread_local std::vector<uint32_t> g_is_ndim[3];
+thread_local std::vector<const uint32_t*> g_is_ptr[3];
+
+int StoreShapeGroup(PyObject* lst, int slot, uint32_t* out_size,
+                    const uint32_t** out_ndim, const uint32_t*** out_data) {
+  auto& shapes = g_is_shapes[slot];
+  auto& ndims = g_is_ndim[slot];
+  auto& ptrs = g_is_ptr[slot];
+  shapes.clear();
+  ndims.clear();
+  ptrs.clear();
+  Py_ssize_t n = PyList_Size(lst);
+  shapes.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* shp = PyList_GetItem(lst, i);
+    Py_ssize_t nd = PyList_Size(shp);
+    for (Py_ssize_t d = 0; d < nd; ++d) {
+      shapes[i].push_back(static_cast<uint32_t>(
+          PyLong_AsLong(PyList_GetItem(shp, d))));
+    }
+    ndims.push_back(static_cast<uint32_t>(nd));
+  }
+  for (auto& s : shapes) ptrs.push_back(s.data());
+  *out_size = static_cast<uint32_t>(n);
+  *out_ndim = ndims.data();
+  *out_data = ptrs.data();
+  return 0;
+}
+
+int InferShapeImpl(SymbolHandle sym, uint32_t num_args, const char** keys,
+                   const uint32_t* arg_ind_ptr,
+                   const uint32_t* arg_shape_data, uint32_t* in_size,
+                   const uint32_t** in_ndim, const uint32_t*** in_data,
+                   uint32_t* out_size, const uint32_t** out_ndim,
+                   const uint32_t*** out_data, uint32_t* aux_size,
+                   const uint32_t** aux_ndim, const uint32_t*** aux_data,
+                   int* complete, int partial) {
+  Gil gil;
+  PyObject* pkeys = PyList_New(num_args);
+  PyObject* pshapes = PyList_New(num_args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    PyList_SetItem(pkeys, i, PyUnicode_FromString(keys[i]));
+    uint32_t lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+    PyObject* shp = PyList_New(hi - lo);
+    for (uint32_t d = lo; d < hi; ++d) {
+      PyList_SetItem(shp, d - lo, PyLong_FromLong(arg_shape_data[d]));
+    }
+    PyList_SetItem(pshapes, i, shp);
+  }
+  PyObject* args = Py_BuildValue("(ONNi)", static_cast<PyObject*>(sym),
+                                 pkeys, pshapes, partial);
+  PyObject* res = CallImpl("symbol_infer_shape", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  StoreShapeGroup(PyTuple_GetItem(res, 0), 0, in_size, in_ndim, in_data);
+  StoreShapeGroup(PyTuple_GetItem(res, 1), 1, out_size, out_ndim, out_data);
+  StoreShapeGroup(PyTuple_GetItem(res, 2), 2, aux_size, aux_ndim, aux_data);
+  *complete = PyObject_IsTrue(PyTuple_GetItem(res, 3));
+  Py_DECREF(res);
+  return 0;
+}
+
+}  // namespace
+
+MXTPU_API int MXSymbolInferShape(
+    SymbolHandle sym, uint32_t num_args, const char** keys,
+    const uint32_t* arg_ind_ptr, const uint32_t* arg_shape_data,
+    uint32_t* in_shape_size, const uint32_t** in_shape_ndim,
+    const uint32_t*** in_shape_data, uint32_t* out_shape_size,
+    const uint32_t** out_shape_ndim, const uint32_t*** out_shape_data,
+    uint32_t* aux_shape_size, const uint32_t** aux_shape_ndim,
+    const uint32_t*** aux_shape_data, int* complete) {
+  return InferShapeImpl(sym, num_args, keys, arg_ind_ptr, arg_shape_data,
+                        in_shape_size, in_shape_ndim, in_shape_data,
+                        out_shape_size, out_shape_ndim, out_shape_data,
+                        aux_shape_size, aux_shape_ndim, aux_shape_data,
+                        complete, 0);
+}
+
+MXTPU_API int MXSymbolInferShapePartial(
+    SymbolHandle sym, uint32_t num_args, const char** keys,
+    const uint32_t* arg_ind_ptr, const uint32_t* arg_shape_data,
+    uint32_t* in_shape_size, const uint32_t** in_shape_ndim,
+    const uint32_t*** in_shape_data, uint32_t* out_shape_size,
+    const uint32_t** out_shape_ndim, const uint32_t*** out_shape_data,
+    uint32_t* aux_shape_size, const uint32_t** aux_shape_ndim,
+    const uint32_t*** aux_shape_data, int* complete) {
+  return InferShapeImpl(sym, num_args, keys, arg_ind_ptr, arg_shape_data,
+                        in_shape_size, in_shape_ndim, in_shape_data,
+                        out_shape_size, out_shape_ndim, out_shape_data,
+                        aux_shape_size, aux_shape_ndim, aux_shape_data,
+                        complete, 1);
+}
+
+// ---------------------------------------------------------------------------
+// Executor (MXExecutorBind family, include/mxnet/c_api.h)
+// ---------------------------------------------------------------------------
+
+typedef void* ExecutorHandle;
+
+MXTPU_API int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id,
+                             uint32_t len, NDArrayHandle* in_args,
+                             NDArrayHandle* arg_grad_store,
+                             uint32_t* grad_req_type, uint32_t aux_len,
+                             NDArrayHandle* aux_states, ExecutorHandle* out) {
+  (void)dev_type;
+  (void)dev_id;
+  Gil gil;
+  PyObject* pargs = PyList_New(len);
+  PyObject* pgrads = PyList_New(len);
+  PyObject* preqs = PyList_New(len);
+  for (uint32_t i = 0; i < len; ++i) {
+    PyObject* a = static_cast<PyObject*>(in_args[i]);
+    Py_INCREF(a);
+    PyList_SetItem(pargs, i, a);
+    PyObject* g = arg_grad_store != nullptr && arg_grad_store[i] != nullptr
+                      ? static_cast<PyObject*>(arg_grad_store[i])
+                      : Py_None;
+    Py_INCREF(g);
+    PyList_SetItem(pgrads, i, g);
+    PyList_SetItem(preqs, i,
+                   PyLong_FromLong(grad_req_type != nullptr
+                                       ? static_cast<long>(grad_req_type[i])
+                                       : 0L));
+  }
+  PyObject* paux = PyList_New(aux_len);
+  for (uint32_t i = 0; i < aux_len; ++i) {
+    PyObject* a = static_cast<PyObject*>(aux_states[i]);
+    Py_INCREF(a);
+    PyList_SetItem(paux, i, a);
+  }
+  PyObject* args = Py_BuildValue("(ONNNN)", static_cast<PyObject*>(sym),
+                                 pargs, pgrads, preqs, paux);
+  PyObject* res = CallImpl("executor_bind", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXExecutorForward(ExecutorHandle h, int is_train) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oi)", static_cast<PyObject*>(h), is_train);
+  PyObject* res = CallImpl("executor_forward", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXExecutorOutputs(ExecutorHandle h, uint32_t* out_size,
+                                NDArrayHandle** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(h));
+  PyObject* res = CallImpl("executor_outputs", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_ssize_t n = PyList_Size(res);
+  g_handle_store.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* item = PyList_GetItem(res, i);
+    Py_INCREF(item);
+    g_handle_store.push_back(item);
+  }
+  Py_DECREF(res);
+  *out_size = static_cast<uint32_t>(n);
+  *out = g_handle_store.data();
+  return 0;
+}
+
+MXTPU_API int MXExecutorBackward(ExecutorHandle h, uint32_t len,
+                                 NDArrayHandle* head_grads) {
+  Gil gil;
+  PyObject* pgrads = PyList_New(len);
+  for (uint32_t i = 0; i < len; ++i) {
+    PyObject* g = static_cast<PyObject*>(head_grads[i]);
+    Py_INCREF(g);
+    PyList_SetItem(pgrads, i, g);
+  }
+  PyObject* args = Py_BuildValue("(ON)", static_cast<PyObject*>(h), pgrads);
+  PyObject* res = CallImpl("executor_backward", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXExecutorFree(ExecutorHandle h) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject*>(h));
+  return 0;
 }
 
 // ---------------------------------------------------------------------------

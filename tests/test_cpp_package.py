@@ -41,3 +41,28 @@ def test_cpp_predict_demo_builds_and_serves(tmp_path):
     assert "output shape: (2, 4)" in run.stdout
     # softmax rows sum to 1 each
     assert "(sum 2.0000)" in run.stdout
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+def test_cpp_train_demo_learns(tmp_path):
+    """Full TRAINING through the C++ binding package: symbolic MLP built
+    with Operator/Symbol, Executor fwd+bwd, Optimizer in-place updates —
+    the cpp-package/example/mlp.cpp analog."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    site = subprocess.run(
+        [sys.executable, "-c",
+         "import site;print(site.getsitepackages()[0])"],
+        capture_output=True, text=True).stdout.strip()
+    env["PYTHONPATH"] = os.pathsep.join(
+        [_REPO, site, env.get("PYTHONPATH", "")])
+
+    build = subprocess.run(["make", "train_demo"], cwd=_DIR, env=env,
+                           capture_output=True, text=True, timeout=300)
+    assert build.returncode == 0, build.stderr[-2000:]
+
+    run = subprocess.run([os.path.join(_DIR, "train_demo")],
+                         cwd=str(tmp_path), env=env, capture_output=True,
+                         text=True, timeout=600)
+    assert run.returncode == 0, run.stdout + run.stderr[-2000:]
+    assert "TRAIN_DEMO_OK" in run.stdout
