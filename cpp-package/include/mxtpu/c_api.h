@@ -19,6 +19,7 @@ typedef void* NDArrayHandle;
 typedef void* SymbolHandle;
 typedef void* ExecutorHandle;
 typedef void* PredictorHandle;
+typedef void* AtomicSymbolCreator;
 
 /* error / version ------------------------------------------------------- */
 const char* MXGetLastError(void);
@@ -63,6 +64,15 @@ int MXSymbolListOutputs(SymbolHandle sym, uint32_t* out_size,
 int MXSymbolListAuxiliaryStates(SymbolHandle sym, uint32_t* out_size,
                                 const char*** out_array);
 int MXSymbolCreateVariable(const char* name, SymbolHandle* out);
+/* Op reflection — the surface language bindings code-gen wrappers from.
+ * Creator handles are interned op-name strings. */
+int MXSymbolListAtomicSymbolCreators(uint32_t* out_size,
+                                     AtomicSymbolCreator** out);
+int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                const char** name, const char** description,
+                                uint32_t* num_args, const char*** arg_names,
+                                const char*** arg_types,
+                                const char*** arg_descriptions);
 /* One-shot CreateAtomicSymbol+Compose: op node over named/positional input
  * symbols.  input_keys may be NULL (all positional); entries may be NULL. */
 int MXSymbolCreateFromOp(const char* op_name, uint32_t num_params,

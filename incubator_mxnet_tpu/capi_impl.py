@@ -114,6 +114,23 @@ def symbol_list_aux(s) -> List[str]:
     return list(s.list_auxiliary_states())
 
 
+def op_info_strings(op_name: str):
+    """MXSymbolGetAtomicSymbolInfo marshalling: (name, description,
+    arg_names, arg_types, arg_descs) with tensor inputs first (the reference
+    lists inputs as NDArray-typed arguments in the same table)."""
+    info = _reg.op_info(op_name)
+    names, types, descs = [], [], []
+    for n, t in info["inputs"]:
+        names.append(n)
+        types.append(t)
+        descs.append("input tensor")
+    for n, t, d in info["arguments"]:
+        names.append(n)
+        types.append(t if d is None else "%s, default=%s" % (t, d))
+        descs.append("")
+    return info["name"], info["description"], names, types, descs
+
+
 def symbol_create_variable(name: str):
     return sym_mod.var(name)
 

@@ -87,7 +87,9 @@ def _make_wrapper(name: str, op: "_reg.Op"):
         return _reg.invoke(op.name, inputs, out=out, **kwargs)
 
     wrapper.__name__ = name
-    wrapper.__doc__ = op.doc
+    # full dmlc::Parameter-style schema docstring (MXSymbolGetAtomicSymbolInfo
+    # analog) so help(mx.nd.op) shows inputs + typed parameters
+    wrapper.__doc__ = _reg.op_doc(op.name)
     return wrapper
 
 

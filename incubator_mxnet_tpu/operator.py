@@ -17,9 +17,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from .ops.registry import register as _register_op
+from .ops.registry import list_ops as get_all_op_names  # noqa: F401
+from .ops.registry import op_doc as get_op_doc  # noqa: F401
+from .ops.registry import op_info as get_op_info  # noqa: F401
 
 __all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered",
-           "NDArrayOp"]
+           "NDArrayOp", "get_op_info", "get_op_doc", "get_all_op_names"]
 
 _CUSTOM_PROPS: Dict[str, type] = {}
 

@@ -105,6 +105,100 @@ def list_ops() -> List[str]:
     return sorted(OPS.keys())
 
 
+def _dmlc_type_name(default):
+    """Map a python default to a dmlc::Parameter-style type string
+    (dmlc/parameter.h field-type names as they appear in op docs)."""
+    if isinstance(default, bool):
+        return "boolean"
+    if isinstance(default, int):
+        return "int"
+    if isinstance(default, float):
+        return "float"
+    if isinstance(default, str):
+        return "string"
+    if isinstance(default, (tuple, list)):
+        return "Shape(tuple)"
+    if default is None:
+        return "string or None"
+    return type(default).__name__
+
+
+def op_info(name: str) -> Dict[str, Any]:
+    """dmlc::Parameter-style reflection for a registered op.
+
+    The reference exposes each op's parameter schema (declared via
+    DMLC_DECLARE_PARAMETER, dmlc/parameter.h) through
+    MXSymbolGetAtomicSymbolInfo (src/c_api/c_api_symbolic.cc) and code-gens
+    python wrappers + docs from it.  Here the schema is derived from the
+    FCompute signature itself: leading positional parameters are tensor
+    inputs, keyword parameters (with defaults) are op attributes.
+
+    Returns dict with: name, description, inputs [(name, type)], arguments
+    [(name, type_str, default_repr or None)], num_outputs, aliases.
+    """
+    import inspect
+
+    # the symbol layer owns the authoritative input-vs-attribute
+    # classification (it drives graph composition); reuse it so reflection,
+    # composition and docs can never disagree
+    from ..symbol.symbol import _input_arg_names
+
+    op = get_op(name)
+    sig = inspect.signature(op.fn)
+    in_names = _input_arg_names(op)
+    inputs: List[Any] = []
+    arguments: List[Any] = []
+    for p in sig.parameters.values():
+        if p.kind == p.VAR_POSITIONAL:
+            inputs.append((p.name, "NDArray[]"))
+            continue
+        if p.kind == p.VAR_KEYWORD:
+            continue
+        if op.needs_rng and p.name == "key":
+            continue  # internal PRNG resource (ResourceRequest::kRandom)
+        if in_names is not None and p.name in in_names:
+            inputs.append((p.name, "NDArray" if
+                           p.default is inspect.Parameter.empty
+                           else "NDArray, optional"))
+        elif p.default is inspect.Parameter.empty:
+            if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+                inputs.append((p.name, "NDArray"))  # variadic-op leading arg
+            else:
+                arguments.append((p.name, "required", None))
+        else:
+            arguments.append((p.name, "%s, optional" %
+                              _dmlc_type_name(p.default), repr(p.default)))
+    return {
+        "name": op.name,
+        "description": (op.doc or "").strip(),
+        "inputs": inputs,
+        "arguments": arguments,
+        "num_outputs": op.num_outputs,
+        "aliases": list(op.aliases),
+    }
+
+
+def op_doc(name: str) -> str:
+    """Render op_info as a reference-style docstring (the text
+    MXSymbolGetAtomicSymbolInfo feeds into generated wrappers)."""
+    info = op_info(name)
+    lines = [info["name"], ""]
+    if info["description"]:
+        lines += [info["description"], ""]
+    if info["inputs"]:
+        lines.append("Inputs:")
+        for n, t in info["inputs"]:
+            lines.append("    %s : %s" % (n, t))
+        lines.append("")
+    if info["arguments"]:
+        lines.append("Parameters:")
+        for n, t, d in info["arguments"]:
+            lines.append("    %s : %s%s" % (n, t,
+                                            "" if d is None
+                                            else ", default=%s" % d))
+    return "\n".join(lines)
+
+
 # ---------------------------------------------------------------------------
 # Invocation
 # ---------------------------------------------------------------------------

@@ -124,7 +124,9 @@ def _make_wrapper(public_name, op):
         return _invoke_symbol(op.name, inputs, kwargs, name=name)
 
     wrapper.__name__ = public_name
-    wrapper.__doc__ = op.doc
+    # full dmlc::Parameter-style schema docstring (MXSymbolGetAtomicSymbolInfo
+    # analog) so help(mx.nd.op) shows inputs + typed parameters
+    wrapper.__doc__ = _reg.op_doc(op.name)
     return wrapper
 
 

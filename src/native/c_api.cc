@@ -433,6 +433,72 @@ MXTPU_API int MXSymbolListAuxiliaryStates(SymbolHandle sym,
   return SymbolStrList("symbol_list_aux", sym, out_size, out_array);
 }
 
+// Atomic-symbol creator reflection (MXSymbolListAtomicSymbolCreators +
+// MXSymbolGetAtomicSymbolInfo, src/c_api/c_api_symbolic.cc) — the surface
+// the reference code-gens every language binding's op wrappers from.
+// Creator handles are interned op-name strings.
+typedef void* AtomicSymbolCreator;
+
+namespace {
+thread_local std::vector<std::string> g_creator_names;
+thread_local std::vector<AtomicSymbolCreator> g_creator_ptrs;
+thread_local std::string g_info_name, g_info_desc;
+thread_local std::vector<std::string> g_info_store[3];
+thread_local std::vector<const char*> g_info_ptrs[3];
+}  // namespace
+
+MXTPU_API int MXSymbolListAtomicSymbolCreators(uint32_t* out_size,
+                                               AtomicSymbolCreator** out) {
+  Gil gil;
+  PyObject* res = CallImpl("list_op_names", nullptr);
+  if (res == nullptr) return FailFromPython();
+  Py_ssize_t n = PyList_Size(res);
+  g_creator_names.clear();
+  g_creator_ptrs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    g_creator_names.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(res, i)));
+  }
+  Py_DECREF(res);
+  for (auto& s : g_creator_names) {
+    g_creator_ptrs.push_back(const_cast<char*>(s.c_str()));
+  }
+  *out_size = static_cast<uint32_t>(n);
+  *out = g_creator_ptrs.data();
+  return 0;
+}
+
+MXTPU_API int MXSymbolGetAtomicSymbolInfo(
+    AtomicSymbolCreator creator, const char** name, const char** description,
+    uint32_t* num_args, const char*** arg_names, const char*** arg_types,
+    const char*** arg_descriptions) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", static_cast<const char*>(creator));
+  PyObject* res = CallImpl("op_info_strings", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  g_info_name = PyUnicode_AsUTF8(PyTuple_GetItem(res, 0));
+  g_info_desc = PyUnicode_AsUTF8(PyTuple_GetItem(res, 1));
+  const char*** outs[3] = {arg_names, arg_types, arg_descriptions};
+  uint32_t n = 0;
+  for (int g = 0; g < 3; ++g) {
+    PyObject* lst = PyTuple_GetItem(res, 2 + g);
+    Py_ssize_t m = PyList_Size(lst);
+    g_info_store[g].clear();
+    g_info_ptrs[g].clear();
+    for (Py_ssize_t i = 0; i < m; ++i) {
+      g_info_store[g].emplace_back(PyUnicode_AsUTF8(PyList_GetItem(lst, i)));
+    }
+    for (auto& s : g_info_store[g]) g_info_ptrs[g].push_back(s.c_str());
+    *outs[g] = g_info_ptrs[g].data();
+    n = static_cast<uint32_t>(m);
+  }
+  Py_DECREF(res);
+  *name = g_info_name.c_str();
+  *description = g_info_desc.c_str();
+  *num_args = n;
+  return 0;
+}
+
 MXTPU_API int MXSymbolCreateVariable(const char* name, SymbolHandle* out) {
   Gil gil;
   PyObject* args = Py_BuildValue("(s)", name);

@@ -189,3 +189,91 @@ def test_predict_api_end_to_end(lib, tmp_path):
     np.testing.assert_allclose(got.reshape(2, 4), expect, rtol=1e-5,
                                atol=1e-6)
     lib.MXPredFree(h)
+
+
+def test_atomic_symbol_info_reflection(lib):
+    """Op reflection through the ABI (MXSymbolListAtomicSymbolCreators +
+    MXSymbolGetAtomicSymbolInfo, src/c_api/c_api_symbolic.cc) — the surface
+    bindings code-gen op wrappers from."""
+    n = ctypes.c_uint32()
+    creators = ctypes.POINTER(ctypes.c_void_p)()
+    _check(lib, lib.MXSymbolListAtomicSymbolCreators(
+        ctypes.byref(n), ctypes.byref(creators)))
+    assert n.value > 250
+    names = [ctypes.cast(creators[i], ctypes.c_char_p).value.decode()
+             for i in range(n.value)]
+    assert "Convolution" in names
+    idx = names.index("sgd_mom_update")
+
+    name = ctypes.c_char_p()
+    desc = ctypes.c_char_p()
+    nargs = ctypes.c_uint32()
+    arg_names = ctypes.POINTER(ctypes.c_char_p)()
+    arg_types = ctypes.POINTER(ctypes.c_char_p)()
+    arg_descs = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib, lib.MXSymbolGetAtomicSymbolInfo(
+        ctypes.c_void_p(creators[idx]), ctypes.byref(name),
+        ctypes.byref(desc),
+        ctypes.byref(nargs), ctypes.byref(arg_names),
+        ctypes.byref(arg_types), ctypes.byref(arg_descs)))
+    assert name.value.decode() == "sgd_mom_update"
+    got = {arg_names[i].decode(): arg_types[i].decode()
+           for i in range(nargs.value)}
+    assert got["weight"] == "NDArray"
+    assert got["mom"] == "NDArray"
+    assert got["lr"].startswith("float, optional")
+
+
+def test_symbol_compose_and_executor_roundtrip(lib):
+    """MXSymbolCreateVariable/CreateFromOp + MXExecutorBind/Forward/Backward
+    driven as a raw C consumer: d/dx sum(2x) == 2."""
+    x = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateVariable(b"x", ctypes.byref(x)))
+    keys = (ctypes.c_char_p * 1)(b"scalar")
+    vals = (ctypes.c_char_p * 1)(b"2.0")
+    ins = (ctypes.c_void_p * 1)(x)
+    y = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateFromOp(
+        b"_mul_scalar", 1, keys, vals, 1, None, ins, b"y", ctypes.byref(y)))
+
+    shape = (ctypes.c_uint32 * 1)(4)
+    arr = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayCreateEx(shape, 1, 1, 0, 0, 0,
+                                      ctypes.byref(arr)))
+    data = np.arange(4, dtype=np.float32)
+    _check(lib, lib.MXNDArraySyncCopyFromCPU(
+        arr, data.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(4)))
+    grad = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayCreateEx(shape, 1, 1, 0, 0, 0,
+                                      ctypes.byref(grad)))
+
+    args = (ctypes.c_void_p * 1)(arr)
+    grads = (ctypes.c_void_p * 1)(grad)
+    reqs = (ctypes.c_uint32 * 1)(1)  # kWriteTo
+    exe = ctypes.c_void_p()
+    _check(lib, lib.MXExecutorBind(y, 1, 0, 1, args, grads, reqs, 0, None,
+                                   ctypes.byref(exe)))
+    _check(lib, lib.MXExecutorForward(exe, 1))
+    n_out = ctypes.c_uint32()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    _check(lib, lib.MXExecutorOutputs(exe, ctypes.byref(n_out),
+                                      ctypes.byref(outs)))
+    assert n_out.value == 1
+    out = np.zeros(4, np.float32)
+    o = ctypes.c_void_p(outs[0])
+    _check(lib, lib.MXNDArraySyncCopyToCPU(
+        o, out.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(4)))
+    np.testing.assert_allclose(out, 2.0 * data)
+
+    _check(lib, lib.MXExecutorBackward(exe, 0, None))
+    g = np.zeros(4, np.float32)
+    _check(lib, lib.MXNDArraySyncCopyToCPU(
+        grad, g.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(4)))
+    np.testing.assert_allclose(g, np.full(4, 2.0, np.float32))
+
+    lib.MXExecutorFree(exe)
+    lib.MXSymbolFree(x)
+    lib.MXSymbolFree(y)
+    lib.MXNDArrayFree(arr)
+    lib.MXNDArrayFree(grad)
+    lib.MXNDArrayFree(o)

@@ -238,3 +238,25 @@ def test_eager_jit_cache_not_poisoned_by_trace_mode():
                        nd.ones((3,)), fix_gamma=False, eps=1e-10)
     np.testing.assert_allclose(out.asnumpy(), x.asnumpy(), rtol=1e-4,
                                atol=1e-4)
+
+
+def test_op_info_reflection():
+    """dmlc::Parameter-style schema reflection (get_op_info/get_op_doc —
+    MXSymbolGetAtomicSymbolInfo analog, src/c_api/c_api_symbolic.cc)."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.ops import registry
+
+    info = mx.operator.get_op_info("Convolution")
+    assert ("data", "NDArray") in info["inputs"]
+    assert ("bias", "NDArray, optional") in info["inputs"]
+    args = {n: t for n, t, _ in info["arguments"]}
+    assert "kernel" in args and "num_filter" in args
+
+    doc = mx.operator.get_op_doc("sgd_mom_update")
+    assert "momentum : float, optional, default=0.0" in doc
+    # generated wrappers carry the schema docstring
+    assert "Parameters:" in mx.nd.sgd_mom_update.__doc__
+
+    # every registered op reflects without error
+    for name in mx.operator.get_all_op_names():
+        registry.op_info(name)
