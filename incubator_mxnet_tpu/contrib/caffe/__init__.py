@@ -1,0 +1,14 @@
+"""Caffe model converter (reference analog: ``tools/caffe_converter/`` —
+caffe_parser.py / convert_symbol.py / convert_model.py / convert_mean.py).
+
+Self-contained: a text-format parser for ``.prototxt`` (NetParameter), a
+protobuf wire decoder for ``.caffemodel`` (reusing the repo's generic
+protobuf reader from contrib/onnx/_proto.py), and a layer translator that
+builds this framework's Symbol graph + parameter NDArrays.  Field numbers
+follow the public caffe.proto schema.
+"""
+from .converter import (convert_mean, convert_model, convert_symbol,
+                        parse_caffemodel, parse_prototxt)
+
+__all__ = ["convert_model", "convert_symbol", "convert_mean",
+           "parse_prototxt", "parse_caffemodel"]

@@ -1,0 +1,177 @@
+"""Caffe converter (contrib/caffe — tools/caffe_converter analog).
+
+The test SYNTHESIZES a caffe artifact pair — prototxt text + binary
+caffemodel encoded with the repo's own protobuf emitters (field numbers
+from the public caffe.proto) — then converts and checks the numerics
+against a straight jnp computation with the same weights.
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.contrib.caffe import (convert_mean, convert_model,
+                                               parse_caffemodel,
+                                               parse_prototxt)
+from incubator_mxnet_tpu.contrib.onnx._proto import (emit_bytes, emit_str,
+                                                     emit_varint)
+import struct
+
+
+def _blob(arr):
+    """Encode a BlobProto: shape (field 7, BlobShape.dim=1) + packed float
+    data (field 5)."""
+    arr = np.asarray(arr, np.float32)
+    shape_msg = b"".join(emit_varint(1, int(d)) for d in arr.shape)
+    data = struct.pack("<%df" % arr.size, *arr.reshape(-1).tolist())
+    return emit_bytes(7, shape_msg) + emit_bytes(5, data)
+
+
+def _layer(name, blobs):
+    """LayerParameter (field 100 of NetParameter): name=1, blobs=7."""
+    body = emit_str(1, name)
+    for b in blobs:
+        body += emit_bytes(7, _blob(b))
+    return emit_bytes(100, body)
+
+
+PROTOTXT = """
+name: "TinyNet"   # comment survives the tokenizer
+layer {
+  name: "data"  type: "Input"  top: "data"
+  input_param { shape { dim: 2 dim: 3 dim: 8 dim: 8 } }
+}
+layer {
+  name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 stride: 1 pad: 1 }
+}
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer {
+  name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "fc1" type: "InnerProduct" bottom: "pool1" top: "fc1"
+  inner_product_param { num_output: 5 }
+}
+layer { name: "prob" type: "Softmax" bottom: "fc1" top: "prob" }
+"""
+
+
+def _make_caffemodel(rng):
+    conv_w = rng.normal(0, 0.5, (4, 3, 3, 3)).astype(np.float32)
+    conv_b = rng.normal(0, 0.1, (4,)).astype(np.float32)
+    fc_w = rng.normal(0, 0.2, (5, 4 * 4 * 4)).astype(np.float32)
+    fc_b = rng.normal(0, 0.1, (5,)).astype(np.float32)
+    blob = (_layer("conv1", [conv_w, conv_b]) +
+            _layer("fc1", [fc_w, fc_b]))
+    return blob, (conv_w, conv_b, fc_w, fc_b)
+
+
+def test_parse_prototxt_shapes():
+    net = parse_prototxt(PROTOTXT)
+    assert net["name"] == ["TinyNet"]
+    layers = net["layer"]
+    assert len(layers) == 6
+    conv = layers[1]
+    p = conv["convolution_param"][0]
+    assert p["num_output"] == [4] and p["kernel_size"] == [3]
+    shape = layers[0]["input_param"][0]["shape"][0]
+    assert shape["dim"] == [2, 3, 8, 8]
+
+
+def test_parse_caffemodel_blobs():
+    rng = np.random.RandomState(0)
+    blob, (conv_w, conv_b, fc_w, fc_b) = _make_caffemodel(rng)
+    parsed = parse_caffemodel(blob)
+    assert set(parsed) == {"conv1", "fc1"}
+    np.testing.assert_array_equal(parsed["conv1"][0], conv_w)
+    np.testing.assert_array_equal(parsed["fc1"][1], fc_b)
+
+
+def test_convert_model_numerics():
+    rng = np.random.RandomState(1)
+    blob, (conv_w, conv_b, fc_w, fc_b) = _make_caffemodel(rng)
+    sym, arg_params, aux_params = convert_model(PROTOTXT, blob)
+    assert set(arg_params) == {"conv1_weight", "conv1_bias", "fc1_weight",
+                               "fc1_bias"}
+    x = rng.normal(0, 1, (2, 3, 8, 8)).astype(np.float32)
+    args = {"data": mx.nd.array(x)}
+    args.update(arg_params)
+    exe = sym.bind(mx.cpu(), args=args, aux_states=aux_params)
+    (out,) = exe.forward(is_train=False)
+
+    # straight numpy/jax recomputation
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    conv = lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(conv_w), (1, 1), [(1, 1), (1, 1)])
+    conv = conv + jnp.asarray(conv_b)[None, :, None, None]
+    act = jnp.maximum(conv, 0)
+    pool = lax.reduce_window(act, -jnp.inf, lax.max, (1, 1, 2, 2),
+                             (1, 1, 2, 2), "VALID")
+    flat = pool.reshape(2, -1)
+    logits = flat @ jnp.asarray(fc_w).T + jnp.asarray(fc_b)
+    ref = jax.nn.softmax(logits, axis=-1)
+    np.testing.assert_allclose(np.asarray(out.asnumpy()), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_scale_fusion():
+    proto = """
+layer { name: "data" type: "Input" top: "data" }
+layer { name: "bn" type: "BatchNorm" bottom: "data" top: "bn"
+        batch_norm_param { eps: 0.001 } }
+layer { name: "sc" type: "Scale" bottom: "bn" top: "bn"
+        scale_param { bias_term: true } }
+"""
+    mean = np.array([1.0, -1.0], np.float32)
+    var = np.array([4.0, 9.0], np.float32)
+    factor = np.array([2.0], np.float32)  # caffe stores scaled stats
+    gamma = np.array([1.5, 0.5], np.float32)
+    beta = np.array([0.25, -0.25], np.float32)
+    blob = (_layer("bn", [mean * 2.0, var * 2.0, factor]) +
+            _layer("sc", [gamma, beta]))
+    sym, arg_params, aux_params = convert_model(proto, blob)
+    np.testing.assert_allclose(aux_params["bn_moving_mean"].asnumpy(), mean)
+    np.testing.assert_allclose(aux_params["bn_moving_var"].asnumpy(), var)
+    np.testing.assert_allclose(arg_params["bn_gamma"].asnumpy(), gamma)
+    np.testing.assert_allclose(arg_params["bn_beta"].asnumpy(), beta)
+
+    x = np.random.RandomState(2).normal(0, 1, (3, 2)).astype(np.float32)
+    args = {"data": mx.nd.array(x)}
+    args.update(arg_params)
+    exe = sym.bind(mx.cpu(), args=args, aux_states=aux_params)
+    (out,) = exe.forward(is_train=False)
+    ref = gamma * (x - mean) / np.sqrt(var + 1e-3) + beta
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_convert_mean_roundtrip():
+    arr = np.arange(12, dtype=np.float32).reshape(3, 2, 2)
+    nd_mean = convert_mean(_blob(arr))
+    np.testing.assert_array_equal(nd_mean.asnumpy(), arr)
+
+
+def test_cli_tool(tmp_path):
+    import subprocess
+    import sys as _sys
+    import os
+
+    rng = np.random.RandomState(3)
+    blob, _ = _make_caffemodel(rng)
+    proto_f = tmp_path / "net.prototxt"
+    model_f = tmp_path / "net.caffemodel"
+    proto_f.write_text(PROTOTXT)
+    model_f.write_bytes(blob)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [_sys.executable, os.path.join(repo, "tools", "caffe_converter.py"),
+         str(proto_f), str(model_f), str(tmp_path / "out")],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        str(tmp_path / "out"), 0)
+    assert "conv1_weight" in arg_params

@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Convert a Caffe model to this framework's checkpoint format.
+
+Reference analog: ``tools/caffe_converter/convert_model.py`` CLI.
+
+Usage:
+    python tools/caffe_converter.py deploy.prototxt net.caffemodel out_prefix
+
+Writes ``{out_prefix}-symbol.json`` and ``{out_prefix}-0000.params``
+(stock checkpoint container), loadable with ``mx.model.load_checkpoint``.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("prototxt")
+    ap.add_argument("caffemodel")
+    ap.add_argument("prefix")
+    args = ap.parse_args()
+
+    from incubator_mxnet_tpu import model
+    from incubator_mxnet_tpu.contrib.caffe import convert_model
+
+    with open(args.prototxt) as f:
+        text = f.read()
+    with open(args.caffemodel, "rb") as f:
+        blob = f.read()
+    sym, arg_params, aux_params = convert_model(text, blob)
+    model.save_checkpoint(args.prefix, 0, sym, arg_params, aux_params)
+    print("saved %s-symbol.json and %s-0000.params (%d args, %d aux)"
+          % (args.prefix, args.prefix, len(arg_params), len(aux_params)))
+
+
+if __name__ == "__main__":
+    main()
