@@ -95,3 +95,9 @@ from . import library
 from . import resource
 from . import tensorboard
 from . import torch_bridge
+
+# MXNET_PROFILER_AUTOSTART / MXNET_PROFILER_MODE (env_var.md): begin
+# profiling at import so short scripts get a trace without code changes
+if config.get("MXNET_PROFILER_AUTOSTART"):
+    profiler.set_config(aggregate_stats=True)
+    profiler.set_state("run")

@@ -57,8 +57,83 @@ _decl("MXNET_ENFORCE_DETERMINISM", _bool, False,
       "per program, so this only forbids known-nondeterministic ops.")
 _decl("MXNET_PROFILER_AUTOSTART", _bool, False,
       "Start mx.profiler at import (profiler.py).")
+_decl("MXNET_PROFILER_MODE", str, "symbolic",
+      "Profiler scope at autostart: 'symbolic' (compiled programs only) or "
+      "'all' (every eager op via the per-op hook).")
+_decl("MXNET_HOME", str, "~/.mxnet",
+      "Data/cache root for gluon datasets and model zoo files "
+      "(util.data_dir; gluon/data/vision re-roots default paths here).")
+_decl("MXNET_LIBRARY_PATH", str, "",
+      "Extra directory searched by mx.library.load for dynamic custom-op "
+      "libraries (library.py).")
+_decl("MXNET_GLUON_REPO", str, "",
+      "Model-zoo artifact source.  This environment has no egress, so only "
+      "file:// or local paths are meaningful; gluon model_zoo falls back "
+      "to untrained weights when unset.")
+_decl("MXNET_TEST_SEED", int, 0,
+      "Seed override honored by the test suite's with_seed fixture "
+      "(tests/conftest.py; used by tools/flakiness_checker.py).")
+_decl("MXNET_EXEC_NUM_TEMP", int, 1,
+      "Max pooled kTempSpace host scratch buffers per device "
+      "(resource.py ResourceManager).")
 
-# -- compatibility: accepted, behavior subsumed by XLA/JAX ------------------
+# -- compatibility: accepted, behavior subsumed by XLA/JAX or n/a on TPU ----
+for _name, _doc in [
+    ("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN",
+     "Bulk-segment size cap — subsumed: one XLA program per graph."),
+    ("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN_FWD", "As above (forward)."),
+    ("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN_BWD", "As above (backward)."),
+    ("MXNET_CPU_PRIORITY_NTHREADS",
+     "Priority host-engine pool size — the native engine runs a single "
+     "FIFO pool; priorities order the queue instead."),
+    ("MXNET_CPU_NNPACK_NTHREADS", "NNPACK — n/a (XLA:CPU kernels)."),
+    ("MXNET_CPU_PARALLEL_SIZE",
+     "OMP elementwise threshold — subsumed by XLA:CPU."),
+    ("MXNET_CPU_PARALLEL_RAND_COPY", "As above for PRNG."),
+    ("MXNET_CPU_TEMP_COPY", "Temp-space copy workers — host scratch is "
+     "pooled by resource.py."),
+    ("MXNET_GPU_WORKER_NTHREADS", "n/a on TPU (one stream per chip)."),
+    ("MXNET_GPU_WORKER_NSTREAMS", "n/a on TPU."),
+    ("MXNET_GPU_COPY_NTHREADS", "n/a on TPU (PJRT transfers)."),
+    ("MXNET_GPU_TEMP_COPY", "n/a on TPU."),
+    ("MXNET_GPU_PARALLEL_RAND_COPY", "n/a on TPU."),
+    ("MXNET_GPU_CUDNN_DROPOUT_STATE_COPY", "n/a (no cuDNN)."),
+    ("MXNET_GPU_MEM_POOL_RESERVE", "Device pool reserve — PJRT allocator."),
+    ("MXNET_GPU_MEM_LARGE_ALLOC_ROUND_SIZE", "As above."),
+    ("MXNET_GPU_MEM_POOL_ROUND_LINEAR_CUTOFF", "As above."),
+    ("MXNET_CUDA_ALLOW_TENSOR_CORE",
+     "Tensor-core opt-in — MXU bf16 is the default compute path; use "
+     "compute_dtype=float32 on TrainStep to opt out."),
+    ("MXNET_CUDA_TENSOR_OP_MATH_ALLOW_CONVERSION", "As above."),
+    ("MXNET_CUDA_LIB_CHECKING", "n/a (no CUDA libs)."),
+    ("MXNET_CUDNN_LIB_CHECKING", "n/a (no cuDNN)."),
+    ("MXNET_ENABLE_GPU_P2P", "n/a (ICI collectives)."),
+    ("MXNET_MKLDNN_ENABLED", "n/a (XLA:CPU)."),
+    ("MXNET_MKLDNN_CACHE_NUM", "n/a."),
+    ("MXNET_USE_MKLDNN_RNN", "n/a."),
+    ("MXNET_ENABLE_OPERATOR_TUNING", "OMP tuning — subsumed by XLA."),
+    ("MXNET_USE_NUM_CORES_OPERATOR_TUNING", "As above."),
+    ("MXNET_ENABLE_CYTHON",
+     "Cython bridge — n/a: the frontend IS python; the C ABI serves "
+     "external bindings (src/native/c_api.cc)."),
+    ("MXNET_ENFORCE_CYTHON", "As above."),
+    ("MXNET_FUSION_VERBOSE", "Pointwise-fusion logging — use "
+     "jax.log_compiles / XLA dump flags instead."),
+    ("MXNET_KVSTORE_LOGTREE", "Tree-reduce logging — n/a."),
+    ("MXNET_KVSTORE_TREE_ARRAY_BOUND", "Tree-reduce tuning — n/a."),
+    ("MXNET_KVSTORE_TREE_BACKTRACK", "As above."),
+    ("MXNET_KVSTORE_TREE_LINK_USAGE_PENALTY", "As above."),
+    ("MXNET_OPTIMIZER_AGGREGATION_SIZE",
+     "Multi-tensor update aggregation — subsumed: the fused TrainStep "
+     "updates every parameter in one XLA program."),
+    ("MXNET_MP_WORKER_NTHREADS",
+     "DataLoader worker threads — pass num_workers to DataLoader; thread "
+     "pools are the default (fork is unsafe under JAX)."),
+    ("MXNET_MP_OPENCV_NUM_THREADS", "OpenCV threads in workers — n/a "
+     "(PIL/numpy decode)."),
+]:
+    _decl(_name, str, "", "[compat] " + _doc)
+
 for _name, _doc in [
     ("MXNET_EXEC_BULK_EXEC_TRAIN",
      "Engine op bulking — subsumed: the whole graph compiles to one XLA "

@@ -38,6 +38,12 @@ class _DownloadedDataset(Dataset):
         self._transform = transform
         self._data = None
         self._label = None
+        # default "~/.mxnet/..." roots are re-rooted under $MXNET_HOME when
+        # set (env_var.md MXNET_HOME semantics)
+        from ....util import data_dir
+        default_prefix = os.path.join("~", ".mxnet")
+        if root.startswith(default_prefix):
+            root = data_dir() + root[len(default_prefix):]
         root = os.path.expanduser(root)
         self._root = root
         self._get_data()

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import ctypes
 import json
+import os
 from typing import List
 
 import jax
@@ -67,6 +68,14 @@ def _make_fn(lib: ctypes.CDLL, name: str, num_inputs: int):
 def load(path: str, verbose: bool = True) -> List[str]:
     """Load a custom-op library; returns the registered op names
     (``MXLoadLib`` / ``python/mxnet/library.py:load`` analog)."""
+    if not os.path.exists(path):
+        # search MXNET_LIBRARY_PATH (env_var.md) before giving up
+        from . import config as _config
+
+        search = _config.get("MXNET_LIBRARY_PATH", "")
+        cand = os.path.join(search, os.path.basename(path)) if search else ""
+        if cand and os.path.exists(cand):
+            path = cand
     lib = ctypes.CDLL(path)
     lib.MXTPULibOpList.restype = ctypes.c_char_p
     lib.MXTPULibOpCompute.argtypes = [

@@ -7,7 +7,14 @@ import os
 import threading
 
 __all__ = ["is_np_array", "is_np_shape", "set_np", "reset_np", "np_array",
-           "np_shape", "use_np", "getenv", "setenv", "makedirs"]
+           "np_shape", "use_np", "getenv", "setenv", "makedirs", "data_dir"]
+
+
+def data_dir() -> str:
+    """Framework data/cache root: ``$MXNET_HOME`` if set, else ``~/.mxnet``
+    (python/mxnet/util.py:data_dir / env_var.md MXNET_HOME)."""
+    return os.environ.get("MXNET_HOME") or os.path.join(
+        os.path.expanduser("~"), ".mxnet")
 
 _STATE = threading.local()
 
