@@ -1,0 +1,34 @@
+"""Launcher failure detection + elastic whole-job restart (§5.3 —
+ps-lite tracker heartbeat/timeout analog + checkpoint/resume recovery
+model)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+from launch import launch_local  # noqa: E402
+
+
+# fails on the first attempt, succeeds once restarted
+_FLAKY = ("import os,sys;"
+          "sys.exit(0 if int(os.environ['MXNET_RESTART_COUNT']) > 0 "
+          "else 7)")
+
+
+def test_restart_recovers_flaky_job():
+    rc = launch_local(2, [sys.executable, "-c", _FLAKY], max_restarts=2,
+                      grace=5.0)
+    assert rc == 0
+
+
+def test_no_restart_propagates_failure():
+    rc = launch_local(2, [sys.executable, "-c", _FLAKY], max_restarts=0,
+                      grace=5.0)
+    assert rc == 7
+
+
+def test_restart_budget_exhausted():
+    rc = launch_local(1, [sys.executable, "-c", "import sys;sys.exit(3)"],
+                      max_restarts=2, grace=5.0)
+    assert rc == 3

@@ -27,13 +27,37 @@ def _free_port() -> int:
 
 
 def launch_local(num_workers: int, command, port: int | None = None,
-                 extra_env=None, grace: float = 20.0) -> int:
+                 extra_env=None, grace: float = 20.0,
+                 max_restarts: int = 0) -> int:
     """Spawn ``command`` num_workers times; return first nonzero exit.
 
-    If any worker dies with a nonzero code, the survivors (likely blocked
-    in a collective waiting for the dead peer) are terminated after
-    ``grace`` seconds instead of hanging the launcher forever.
+    Failure detection (§5.3): worker liveness is polled (the launcher IS
+    the heartbeat — ps-lite's tracker-side timeout analog).  If any worker
+    dies nonzero, the survivors (likely blocked in a collective waiting
+    for the dead peer) are terminated after ``grace`` seconds instead of
+    hanging the launcher forever.
+
+    Elastic recovery: with ``max_restarts > 0`` a failed job is relaunched
+    whole, up to that many times, on a fresh rendezvous port.  XLA
+    collectives are SPMD all-or-nothing, so whole-job restart + workers
+    resuming from their last checkpoint (CheckpointHandler
+    resume_from_checkpoint / Module --load-epoch pattern) is the recovery
+    model; MXNET_RESTART_COUNT tells workers which attempt they are in.
     """
+    attempt = 0
+    while True:
+        rc = _launch_once(num_workers, command, port, extra_env, grace,
+                          attempt)
+        if rc == 0 or attempt >= max_restarts:
+            return rc
+        attempt += 1
+        print("[launch] job failed (rc=%d); restart %d/%d"
+              % (rc, attempt, max_restarts), file=sys.stderr, flush=True)
+        port = None  # new rendezvous
+
+
+def _launch_once(num_workers: int, command, port, extra_env, grace: float,
+                 attempt: int = 0) -> int:
     import time
 
     port = port or _free_port()
@@ -47,6 +71,7 @@ def launch_local(num_workers: int, command, port: int | None = None,
             "DMLC_NUM_WORKER": str(num_workers),
             "DMLC_NUM_SERVER": "0",
             "DMLC_WORKER_ID": str(rank),
+            "MXNET_RESTART_COUNT": str(attempt),
         })
         if extra_env:
             env.update(extra_env)
@@ -88,12 +113,18 @@ def main(argv=None) -> int:
                     help="only local (single-host multi-process) here; "
                          "multi-host uses your cluster scheduler + "
                          "DMLC_* env directly")
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    help="relaunch the whole job up to N times after a "
+                         "worker failure (workers resume from their last "
+                         "checkpoint; MXNET_RESTART_COUNT carries the "
+                         "attempt number)")
     ap.add_argument("command", nargs=argparse.REMAINDER,
                     help="training command to run")
     args = ap.parse_args(argv)
     if not args.command:
         ap.error("no command given")
-    return launch_local(args.num_workers, args.command)
+    return launch_local(args.num_workers, args.command,
+                        max_restarts=args.max_restarts)
 
 
 if __name__ == "__main__":
