@@ -443,6 +443,24 @@ def _nonzero(data, size=None):
     return jnp.stack(jnp.nonzero(data, size=size or data.size, fill_value=-1), axis=-1)
 
 
+@register("_np_unique", num_inputs=1, differentiable=False, no_trace=True,
+          num_outputs=1)
+def _unique(data, return_index=False, return_inverse=False,
+            return_counts=False, axis=None):
+    """np.unique (src/operator/numpy/np_unique_op.cc): output shape is
+    data-dependent, so the op is host-evaluated (no_trace) like the
+    reference's CPU-only kernel.  Inside jit use jnp.unique with a static
+    ``size=`` instead."""
+    import numpy as _onp
+
+    outs = _onp.unique(_onp.asarray(data), return_index=return_index,
+                       return_inverse=return_inverse,
+                       return_counts=return_counts, axis=axis)
+    if isinstance(outs, tuple):
+        return tuple(jnp.asarray(o) for o in outs)
+    return jnp.asarray(outs)
+
+
 @register("tril", num_inputs=1)
 def _tril(data, k=0):
     return jnp.tril(data, k=k)

@@ -260,3 +260,19 @@ def test_op_info_reflection():
     # every registered op reflects without error
     for name in mx.operator.get_all_op_names():
         registry.op_info(name)
+
+
+def test_np_unique_op():
+    """_np_unique (src/operator/numpy/np_unique_op.cc) — host-evaluated
+    data-dependent-shape op."""
+    import numpy as np
+
+    import incubator_mxnet_tpu as mx
+
+    a = mx.nd.array(np.array([3, 1, 2, 3, 1], np.float32))
+    np.testing.assert_array_equal(mx.nd._np_unique(a).asnumpy(), [1, 2, 3])
+    u, inv, cnt = mx.nd._np_unique(a, return_inverse=True,
+                                   return_counts=True)
+    np.testing.assert_array_equal(u.asnumpy()[inv.asnumpy()],
+                                  a.asnumpy())
+    np.testing.assert_array_equal(cnt.asnumpy(), [2, 1, 2])
