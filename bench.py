@@ -191,6 +191,52 @@ def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
     return best
 
 
+BASELINE_INFER_IMG_S = 2355.04  # V100 fp16 batch-128 inference (perf.md:210)
+
+
+def run_infer(batch_size=128, image_size=224, iters=30):
+    """ResNet-50 inference throughput (perf.md:189-210 benchmark_score.py
+    analog): hybridized forward as one XLA program, bf16."""
+    jax = setup_jax()
+    import numpy as np
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    log("devices: %s" % (jax.devices(),))
+    mx.random.seed(0)
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(init=mx.init.Xavier())
+    net.shape_init((1, 3, image_size, image_size))
+    net.cast("bfloat16")
+    net.hybridize(static_alloc=True)
+
+    x = nd.random.uniform(
+        shape=(batch_size, 3, image_size, image_size)).astype("bfloat16")
+    t = time.time()
+    out = net(x)
+    out.wait_to_read()
+    log("first forward (trace+compile) %.1fs" % (time.time() - t))
+
+    best = 0.0
+    for chunk in range(4):
+        t = time.time()
+        for _ in range(iters):
+            out = net(x)
+        out.wait_to_read()
+        dt = time.time() - t
+        img_s = iters * batch_size / dt
+        best = max(best, img_s)
+        log("chunk %d: %.1f img/s (%.2f ms/batch)"
+            % (chunk, img_s, 1e3 * dt / iters))
+        emit("resnet50_infer_img_per_sec", best, "img/s",
+             BASELINE_INFER_IMG_S,
+             {"batch": batch_size, "dtype": "bfloat16",
+              "chunks_done": chunk + 1})
+    return best
+
+
 def run_attention(seq=2048, heads=8, head_dim=128, batch=4, iters=20):
     """Compiled (non-interpret) Pallas flash attention on the chip, checked
     against the reference attention and timed vs jax.nn.dot_product_attention.
@@ -302,7 +348,7 @@ def _backend_alive(timeout_s=240):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="train",
-                    choices=["train", "attention"])
+                    choices=["train", "infer", "attention"])
     ap.add_argument("--batch", type=int, default=0)
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--chunks", type=int, default=8)
@@ -324,6 +370,9 @@ def main():
 
     if args.mode == "attention":
         run_attention()
+        return
+    if args.mode == "infer":
+        run_infer(batch_size=args.batch or 128, image_size=args.image_size)
         return
 
     batches = (args.batch,) if args.batch else (256, 128, 64, 32)
