@@ -196,8 +196,13 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     bshape[axis] = data.shape[axis]
     use_batch = _is_train() and not use_global_stats
     if use_batch:
-        mean = jnp.mean(data.astype(jnp.float32), axis=red_axes)
-        var = jnp.var(data.astype(jnp.float32), axis=red_axes)
+        # single-pass stats (E[x], E[x^2] in one read of the activation —
+        # jnp.var would re-read it for the deviation pass); f32 accumulation
+        # keeps bf16 inputs well-conditioned
+        x32 = data.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=red_axes)
+        var = jnp.maximum(
+            jnp.mean(jnp.square(x32), axis=red_axes) - jnp.square(mean), 0.0)
     else:
         mean, var = moving_mean, moving_var
     g = jnp.ones_like(gamma) if fix_gamma else gamma
@@ -215,7 +220,12 @@ def _batch_norm_stats(data, axis=1):
     """Helper (not in reference): batch mean/var for running-stat updates."""
     red_axes = tuple(i for i in range(data.ndim) if i != axis)
     x = data.astype(jnp.float32)
-    return jnp.mean(x, axis=red_axes), jnp.var(x, axis=red_axes)
+    mean = jnp.mean(x, axis=red_axes)
+    # same single-pass form as the BatchNorm body so whole-graph CSE folds
+    # the two computations into one reduction
+    var = jnp.maximum(
+        jnp.mean(jnp.square(x), axis=red_axes) - jnp.square(mean), 0.0)
+    return mean, var
 
 
 def _batch_norm_aux_update(in_vals, out_vals, momentum=0.9, axis=1,
