@@ -32,3 +32,19 @@ def test_restart_budget_exhausted():
     rc = launch_local(1, [sys.executable, "-c", "import sys;sys.exit(3)"],
                       max_restarts=2, grace=5.0)
     assert rc == 3
+
+
+def test_bandwidth_tool_runs():
+    """tools/bandwidth.py (reference tools/bandwidth measure.py analog)
+    reports transfer + collective + kvstore numbers on a CPU mesh."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "bandwidth.py"),
+         "--size-mb", "1", "--iters", "2"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "all-reduce" in r.stdout and "kvstore push+pull" in r.stdout
