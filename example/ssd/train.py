@@ -105,7 +105,7 @@ def main():
             loss = cls_l.mean() + loc_l
         loss.backward()
         trainer.step(args.batch_size)
-        if (b + 1) % 20 == 0:
+        if (b + 1) % 20 == 0 or (b + 1) == args.batches:
             logging.info("batch %d  loss %.4f", b + 1,
                          float(loss.asscalar()))
 

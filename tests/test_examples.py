@@ -1,0 +1,53 @@
+"""Smoke-run every graded example config (SURVEY §3.6) in a tiny setting —
+the reference CI runs example scripts the same way (tests/nightly)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(rel, *args, timeout=600):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, os.path.join(_REPO, rel), *args],
+                       capture_output=True, text=True, timeout=timeout,
+                       env=env, cwd=_REPO)
+    assert r.returncode == 0, (rel, r.stdout[-1000:], r.stderr[-2000:])
+    return r.stdout + r.stderr  # examples log through logging (stderr)
+
+
+@pytest.mark.slow
+def test_module_mnist_example():
+    out = _run("example/image_classification/train_mnist.py",
+               "--num-epochs", "1", "--batch-size", "32")
+    assert "accuracy" in out.lower() or "Epoch" in out
+
+
+@pytest.mark.slow
+def test_gluon_image_classification_example():
+    out = _run("example/gluon/image_classification.py",
+               "--epochs", "1", "--samples", "64", "--batch-size", "16",
+               "--model", "resnet18_v1")
+    assert "epoch" in out.lower()
+
+
+@pytest.mark.slow
+def test_word_lm_example():
+    out = _run("example/rnn/word_lm/train.py",
+               "--epochs", "1", "--batch-size", "8", "--bptt", "10")
+    assert "ppl" in out.lower() or "perplexity" in out.lower()
+
+
+@pytest.mark.slow
+def test_ssd_example():
+    out = _run("example/ssd/train.py", "--batches", "4", "--batch-size", "4")
+    assert "loss" in out.lower()
+
+
+@pytest.mark.slow
+def test_distributed_cifar_example():
+    out = _run("example/distributed_training/cifar10_dist.py",
+               "--epochs", "1", "--samples", "64", "--batch-size", "16")
+    assert "epoch" in out.lower()
