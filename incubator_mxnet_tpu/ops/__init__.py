@@ -19,6 +19,10 @@ from . import control_flow  # noqa: F401  (foreach/while_loop/cond)
 from . import quantization  # noqa: F401  (int8 ops)
 from . import contrib_tail  # noqa: F401  (warping/deformable/proposal/
 #                                          transformer-matmul/fft tail)
+from . import parity_tail  # noqa: F401  (remaining user-visible tail:
+#                                         compare aliases, im2col, STE,
+#                                         *_like samplers, multi-tensor
+#                                         optimizer updates)
 
 __all__ = ["registry", "Op", "get_op", "invoke", "invoke_raw", "list_ops",
            "register"]

@@ -38,7 +38,7 @@ def _compose_num_outputs(opname, attrs):
         return reg_op.num_outputs
     if opname in ("SliceChannel", "split"):
         return int(attrs.get("num_outputs", 2))
-    if opname == "split_v2":
+    if opname in ("split_v2", "_split_v2"):
         sections = int(attrs.get("sections", 0))
         return sections if sections else len(attrs.get("indices", ())) + 1
     if opname == "topk" and attrs.get("ret_typ") == "both":

@@ -1,0 +1,171 @@
+"""Parity-tail operators (ops/parity_tail.py) — the registry names found
+missing when diffing the reference's NNVM_REGISTER_OP sites."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd
+
+
+def test_compare_aliases():
+    a = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    b = nd.array(np.array([2.0, 2.0, 2.0], np.float32))
+    np.testing.assert_array_equal(nd.less(a, b).asnumpy(), [1, 0, 0])
+    np.testing.assert_array_equal(nd.greater_equal(a, b).asnumpy(),
+                                  [0, 1, 1])
+    np.testing.assert_array_equal(nd.not_equal(a, b).asnumpy(), [1, 0, 1])
+
+
+def test_moments_and_reshape_like():
+    x = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+    mean, var = nd.moments(nd.array(x), axes=(0, 1))
+    np.testing.assert_allclose(mean.asnumpy(), x.mean(), rtol=1e-6)
+    np.testing.assert_allclose(var.asnumpy(), x.var(), rtol=1e-5)
+    like = nd.zeros((4, 3))
+    assert nd.reshape_like(nd.array(x), like).shape == (4, 3)
+
+
+def test_softmax_cross_entropy():
+    rng = np.random.RandomState(1)
+    logits = rng.rand(5, 7).astype(np.float32)
+    labels = rng.randint(0, 7, 5).astype(np.float32)
+    out = nd.softmax_cross_entropy(nd.array(logits), nd.array(labels))
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(5), labels.astype(int)]).sum()
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5)
+
+
+def test_im2col_col2im_adjoint():
+    """col2im is the adjoint of im2col: <im2col(x), y> == <x, col2im(y)>."""
+    rng = np.random.RandomState(2)
+    x = rng.rand(2, 3, 6, 6).astype(np.float32)
+    cols = nd.im2col(nd.array(x), kernel=(3, 3), stride=(2, 2), pad=(1, 1))
+    y = rng.rand(*cols.shape).astype(np.float32)
+    back = nd.col2im(nd.array(y), output_size=(6, 6), kernel=(3, 3),
+                     stride=(2, 2), pad=(1, 1))
+    lhs = float((cols.asnumpy() * y).sum())
+    rhs = float((x * back.asnumpy()).sum())
+    np.testing.assert_allclose(lhs, rhs, rtol=1e-4)
+
+
+def test_straight_through_estimators():
+    x = nd.array(np.array([-1.4, 0.3, 2.6], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd._contrib_round_ste(x)
+        y.backward()
+    np.testing.assert_array_equal(y.asnumpy(), [-1, 0, 3])
+    np.testing.assert_array_equal(x.grad.asnumpy(), [1, 1, 1])
+
+    x.attach_grad()
+    with autograd.record():
+        z = nd._contrib_gradientmultiplier(x, scalar=0.5)
+        z.backward()
+    np.testing.assert_array_equal(z.asnumpy(), x.asnumpy())
+    np.testing.assert_allclose(x.grad.asnumpy(), [0.5, 0.5, 0.5])
+
+
+def test_box_encode_decode_roundtrip():
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.5, 0.5],
+                                  [0.4, 0.4, 0.9, 0.8]]], np.float32))
+    refs = nd.array(np.array([[[0.15, 0.1, 0.55, 0.56],
+                               [0.5, 0.4, 0.95, 0.9]]], np.float32))
+    samples = nd.array(np.ones((1, 2), np.float32))
+    matches = nd.array(np.array([[0, 1]], np.float32))
+    targets, masks = nd._contrib_box_encode(samples, matches, anchors, refs)
+    assert masks.asnumpy().all()
+    decoded = nd._contrib_box_decode(targets, anchors)
+    np.testing.assert_allclose(decoded.asnumpy(), refs.asnumpy(), atol=1e-5)
+
+
+def test_like_samplers_shapes_and_stats():
+    base = nd.zeros((500, 4))
+    u = nd._random_uniform_like(base, low=2.0, high=3.0)
+    assert u.shape == (500, 4)
+    assert 2.0 <= float(u.asnumpy().min()) and float(u.asnumpy().max()) <= 3.0
+    n = nd._random_normal_like(base, loc=5.0, scale=0.1)
+    assert abs(float(n.asnumpy().mean()) - 5.0) < 0.05
+
+
+def test_multi_tensor_utils():
+    a = nd.array(np.array([1.0, 2.0], np.float32))
+    b = nd.array(np.array([[3.0], [4.0]], np.float32))
+    sq = nd.multi_sum_sq(a, b, num_arrays=2)
+    np.testing.assert_allclose(sq[0].asnumpy(), 5.0)
+    np.testing.assert_allclose(sq[1].asnumpy(), 25.0)
+    z = nd.reset_arrays(a, b, num_arrays=2)
+    assert float(z[0].asnumpy().sum()) == 0.0
+
+
+def test_preloaded_multi_sgd():
+    rng = np.random.RandomState(3)
+    w1, g1 = rng.rand(4).astype("f"), rng.rand(4).astype("f")
+    w2, g2 = rng.rand(2, 2).astype("f"), rng.rand(2, 2).astype("f")
+    lrs = np.array([0.1, 0.2], np.float32)
+    wds = np.array([0.0, 0.0], np.float32)
+    outs = nd.preloaded_multi_sgd_update(
+        nd.array(w1), nd.array(g1), nd.array(w2), nd.array(g2),
+        nd.array(lrs), nd.array(wds), num_weights=2)
+    np.testing.assert_allclose(outs[0].asnumpy(), w1 - 0.1 * g1, rtol=1e-5)
+    np.testing.assert_allclose(outs[1].asnumpy(), w2 - 0.2 * g2, rtol=1e-5)
+
+
+def test_mp_adamw_and_group_adagrad():
+    rng = np.random.RandomState(4)
+    w16 = rng.rand(3).astype(np.float16)
+    w32 = w16.astype(np.float32)
+    g = rng.rand(3).astype(np.float16)
+    mean = np.zeros(3, np.float32)
+    var = np.zeros(3, np.float32)
+    w_out, m, v, w32_out = nd._mp_adamw_update(
+        nd.array(w16), nd.array(g), nd.array(mean), nd.array(var),
+        nd.array(w32), lr=0.1, wd=0.01)
+    assert w_out.dtype == np.float16
+    g32 = g.astype(np.float32)
+    em = 0.1 * g32
+    ev = 0.001 * np.square(g32)
+    ref = w32 - (0.1 * em / (np.sqrt(ev) + 1e-8) + 0.01 * w32)
+    np.testing.assert_allclose(w32_out.asnumpy(), ref, rtol=1e-3)
+
+    hist = np.zeros(2, np.float32)
+    w = rng.rand(2, 3).astype(np.float32)
+    gr = rng.rand(2, 3).astype(np.float32)
+    new_w, new_h = nd._contrib_group_adagrad_update(
+        nd.array(w), nd.array(gr), nd.array(hist), lr=0.1)
+    np.testing.assert_allclose(new_h.asnumpy(),
+                               np.square(gr).mean(axis=1), rtol=1e-5)
+
+
+def test_multi_lars():
+    lrs = nd.array(np.array([0.1, 0.1], np.float32))
+    wss = nd.array(np.array([4.0, 0.0], np.float32))
+    gss = nd.array(np.array([1.0, 1.0], np.float32))
+    out = nd.multi_lars(lrs, wss, gss, wds=(0.0, 0.0), eta=0.01)
+    # trust ratio = eta*|w|/|g| = 0.01*2/1 for the first, 1.0 (no weight)
+    np.testing.assert_allclose(out.asnumpy(), [0.1 * 0.02, 0.1], rtol=1e-4)
+
+
+def test_slice_assign():
+    x = nd.zeros((3, 3))
+    v = nd.array(np.ones((1, 3), np.float32))
+    out = nd._slice_assign(x, v, begin=(1, 0), end=(2, 3))
+    np.testing.assert_array_equal(out.asnumpy()[1], [1, 1, 1])
+    out2 = nd._slice_assign_scalar(x, scalar=7.0, begin=(0, 0), end=(1, 1))
+    assert float(out2.asnumpy()[0, 0]) == 7.0
+
+
+def test_split_v2():
+    x = nd.array(np.arange(10, dtype="f"))
+    parts = nd._split_v2(x, sections=5)
+    assert len(parts) == 5 and parts[0].shape == (2,)
+    parts = nd._split_v2(x, indices=(3, 7))
+    assert [p.shape[0] for p in parts] == [3, 4, 3]
+
+
+def test_arange_like_and_getnnz():
+    x = nd.zeros((2, 3))
+    r = nd._contrib_arange_like(x, start=1.0)
+    assert r.shape == (2, 3) and float(r.asnumpy()[0, 0]) == 1.0
+    y = nd.array(np.array([[1.0, 0.0], [0.0, 2.0]], np.float32))
+    assert int(nd._contrib_getnnz(y).asnumpy()) == 2
