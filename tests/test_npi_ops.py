@@ -1,0 +1,86 @@
+"""numpy-internal ABI names (ops/npi.py): aliases resolve and thin bodies
+match numpy."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.ops import registry
+
+
+def test_npi_aliases_resolve():
+    for name in ("_npi_sin", "_npi_mean", "_npi_add_scalar",
+                 "_npi_multiply", "_npi_concatenate", "_npi_unique",
+                 "_npi_around", "_npi_cholesky", "_np_copy",
+                 "_npx_nonzero"):
+        assert name in registry.OPS, name
+
+
+def test_npi_bodies_match_numpy():
+    rng = np.random.RandomState(0)
+    a = rng.rand(3, 4).astype(np.float32)
+    x = nd.array(a)
+    np.testing.assert_allclose(nd.trace(x).asnumpy(), np.trace(a),
+                               rtol=1e-6)
+    np.testing.assert_allclose(nd.std(x).asnumpy(), a.std(), rtol=1e-5)
+    np.testing.assert_allclose(nd.var(x, axis=1).asnumpy(), a.var(axis=1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(nd.rot90(x).asnumpy(), np.rot90(a))
+    np.testing.assert_allclose(nd.roll(x, shift=2, axis=1).asnumpy(),
+                               np.roll(a, 2, axis=1))
+    np.testing.assert_allclose(
+        nd.moveaxis(x, source=0, destination=1).asnumpy(),
+        np.moveaxis(a, 0, 1))
+    np.testing.assert_allclose(nd.diff(x).asnumpy(), np.diff(a), rtol=1e-6)
+    np.testing.assert_allclose(
+        nd.copysign(x, nd.array(-np.ones_like(a))).asnumpy(),
+        np.copysign(a, -1))
+    np.testing.assert_allclose(nd.arctan2(x, x).asnumpy(),
+                               np.arctan2(a, a), rtol=1e-6)
+
+
+def test_npi_windows_and_constructors():
+    np.testing.assert_allclose(nd._npi_hanning(M=8).asnumpy(),
+                               np.hanning(8), atol=1e-6)
+    np.testing.assert_allclose(nd._npi_hamming(M=8).asnumpy(),
+                               np.hamming(8), atol=1e-6)
+    np.testing.assert_allclose(nd._npi_blackman(M=8).asnumpy(),
+                               np.blackman(8), atol=1e-6)
+    np.testing.assert_allclose(
+        nd._npi_logspace(start=0.0, stop=2.0, num=5).asnumpy(),
+        np.logspace(0, 2, 5), rtol=1e-5)
+    assert nd._npi_indices(dimensions=(2, 3)).shape == (2, 2, 3)
+
+
+def test_npi_linalg_host_ops():
+    rng = np.random.RandomState(1)
+    a = rng.rand(4, 3).astype(np.float32)
+    u, s, vt = nd._npi_svd(nd.array(a))
+    rec = (u.asnumpy() * s.asnumpy()) @ vt.asnumpy()
+    np.testing.assert_allclose(rec, a, atol=1e-5)
+
+    sq = rng.rand(3, 3).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+    b = rng.rand(3).astype(np.float32)
+    np.testing.assert_allclose(
+        nd._npi_solve(nd.array(sq), nd.array(b)).asnumpy(),
+        np.linalg.solve(sq, b), rtol=1e-4)
+
+
+def test_npi_masks_and_delete():
+    a = np.array([1.0, -2.0, 3.0, -4.0], np.float32)
+    mask = np.array([0, 1, 0, 1], np.float32)
+    out = nd._npi_boolean_mask_assign_scalar(nd.array(a), nd.array(mask),
+                                             value=9.0)
+    np.testing.assert_allclose(out.asnumpy(), [1, 9, 3, 9])
+    d = nd._npi_delete(nd.array(a), obj=1)
+    np.testing.assert_allclose(d.asnumpy(), [1, 3, -4])
+    np.testing.assert_array_equal(
+        nd.bincount(nd.array(np.array([0, 2, 2], np.float32)),
+                    minlength=5).asnumpy(), [1, 0, 2, 0, 0])
+
+
+def test_npi_samplers():
+    mx.random.seed(0)
+    u = nd._npi_uniform_n(low=1.0, high=2.0, size=(100,))
+    assert 1.0 <= float(u.asnumpy().min()) <= float(u.asnumpy().max()) <= 2.0
+    c = nd._npi_choice(a=5, size=(50,))
+    assert set(np.unique(c.asnumpy())) <= {0, 1, 2, 3, 4}
