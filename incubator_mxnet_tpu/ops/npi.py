@@ -31,14 +31,14 @@ _RENAMES = {
     "_npi_subtract": "broadcast_sub",
     "_npi_multiply": "broadcast_mul",
     "_npi_true_divide": "broadcast_div",
-    "_npi_concatenate": "concat",
+
     "_npi_unique": "_np_unique",
     "_npx_nonzero": "_np_nonzero",
     "_np_copy": "_copy",
-    "_npi_around": "round",
+
     "_npi_cholesky": "linalg_potrf",
     "_npi_tensordot_int_axes": "tensordot",
-    "_npi_average": "mean",
+
 }
 
 
@@ -47,14 +47,14 @@ def _register_renames_and_autoaliases():
         if new not in OPS and old in OPS:
             _alias(new, old)
     # automatic: _npi_sin -> sin, _npi_mod -> broadcast_mod, ...
-    auto_src = [n for n in
-                ("arange arccos arccosh arcsin arcsinh arctan arctanh argmax "
+    auto_src = (
+        "arange arccos arccosh arcsin arcsinh arctan arctanh argmax "
                  "argmin bernoulli bitwise_and cbrt ceil choice cos cosh "
                  "degrees exp expm1 eye fix flip floor hypot identity lcm "
                  "log log10 log1p log2 logical_not mean multinomial negative "
                  "normal ones power radians reciprocal rint sign sin sinh "
-                 "sqrt square stack tan tanh tril trunc uniform where zeros "
-                 "mod dot cumsum diag hsplit split").split()]
+        "sqrt square stack tan tanh tril trunc uniform where zeros "
+        "mod dot cumsum diag hsplit split").split()
     for base in auto_src:
         npi = "_npi_" + base
         if npi in OPS:
@@ -118,8 +118,29 @@ def _rldexp_scalar(x, scalar=0.0):
 
 @register("_npi_bitwise_not", num_inputs=1, differentiable=False)
 def _bitwise_not(x):
-    return jnp.bitwise_not(x.astype(jnp.int32)) if x.dtype == jnp.bool_ \
-        else jnp.bitwise_not(x)
+    return jnp.bitwise_not(x)  # bool invert and integer ~ both correct
+
+
+@register("_npi_concatenate", aliases=("concatenate",))
+def _concatenate(*data, axis=0):
+    return jnp.concatenate(data, axis=None if axis is None else int(axis))
+
+
+@register("_npi_around", num_inputs=1, aliases=("around",))
+def _around(x, decimals=0):
+    return jnp.round(x, int(decimals))
+
+
+@register("_npi_average", num_inputs=1, aliases=("average",))
+def _average(a, weights=None, axis=None, returned=False):
+    ax = None if axis is None else int(axis)
+    w = None if weights is None else jnp.asarray(weights)
+    out = jnp.average(a, axis=ax, weights=w)
+    if returned:
+        scl = jnp.sum(w, axis=ax) if w is not None else \
+            jnp.asarray(a.size if ax is None else a.shape[ax], out.dtype)
+        return out, jnp.broadcast_to(scl, out.shape)
+    return out
 
 
 @register("_npi_bitwise_or", num_inputs=2, differentiable=False,
@@ -146,12 +167,12 @@ def _bitwise_xor_scalar(x, scalar=0):
 
 @register("_npi_lcm_scalar", num_inputs=1, differentiable=False)
 def _lcm_scalar(x, scalar=1):
-    return jnp.lcm(x.astype(jnp.int32), int(scalar))
+    return jnp.lcm(x, int(scalar))
 
 
 @register("_npi_lcm", num_inputs=2, differentiable=False, aliases=("lcm",))
 def _lcm(x1, x2):
-    return jnp.lcm(x1.astype(jnp.int32), x2.astype(jnp.int32))
+    return jnp.lcm(x1, x2)
 
 
 @register("_npi_deg2rad", num_inputs=1)
@@ -299,7 +320,8 @@ def _solve(a, b):
     return jnp.linalg.solve(a, b)
 
 
-@register("_npi_tensorinv", num_inputs=1, no_trace=True)
+@register("_npi_tensorinv", num_inputs=1, no_trace=True,
+          differentiable=False)
 def _tensorinv(a, ind=2):
     # host-evaluated: LAPACK-class op, CPU-only in the reference too; the
     # TPU backend has no stable lowering (observed libtpu abort for svd)
@@ -308,7 +330,8 @@ def _tensorinv(a, ind=2):
     return jnp.asarray(onp.linalg.tensorinv(onp.asarray(a), ind=int(ind)))
 
 
-@register("_npi_tensorsolve", num_inputs=2, no_trace=True)
+@register("_npi_tensorsolve", num_inputs=2, no_trace=True,
+          differentiable=False)
 def _tensorsolve(a, b, a_axes=None):
     import numpy as onp
 
@@ -317,7 +340,7 @@ def _tensorsolve(a, b, a_axes=None):
 
 
 @register("_npi_svd", num_inputs=1, num_outputs=3, no_trace=True,
-          aliases=("linalg_gesvd",))
+          differentiable=False, aliases=("linalg_gesvd",))
 def _svd(a):
     import numpy as onp
 
@@ -330,8 +353,9 @@ def _svd(a):
 def _bincount(x, minlength=0, weights=None):
     import numpy as onp
 
+    w = None if weights is None else onp.asarray(weights)
     return jnp.asarray(onp.bincount(onp.asarray(x).astype(onp.int64),
-                                    minlength=int(minlength)))
+                                    weights=w, minlength=int(minlength)))
 
 
 @register("_npi_delete", num_inputs=1, differentiable=False, no_trace=True)
@@ -385,4 +409,5 @@ def _uniform_n(low=0.0, high=1.0, size=None, key=None, dtype=None,
 @register("_npi_choice", num_inputs=0, differentiable=False, needs_rng=True)
 def _choice(a=0, size=None, replace=True, weights=None, key=None, ctx=None):
     shape = tuple(size) if size else ()
-    return jax.random.choice(key, int(a), shape, replace=bool(replace))
+    p = None if weights is None else jnp.asarray(weights)
+    return jax.random.choice(key, int(a), shape, replace=bool(replace), p=p)

@@ -84,3 +84,38 @@ def test_npi_samplers():
     assert 1.0 <= float(u.asnumpy().min()) <= float(u.asnumpy().max()) <= 2.0
     c = nd._npi_choice(a=5, size=(50,))
     assert set(np.unique(c.asnumpy())) <= {0, 1, 2, 3, 4}
+
+
+def test_npi_review_fixes():
+    """Regression pins for the review findings: bool bitwise_not, weighted
+    bincount/choice, reference kwarg names, autograd over host linalg."""
+    from incubator_mxnet_tpu import autograd
+
+    b = nd.array(np.array([1, 0], np.float32)).astype("bool")
+    np.testing.assert_array_equal(nd._npi_bitwise_not(b).asnumpy(),
+                                  [False, True])
+    # reference kwarg spellings work through the npi names
+    out = nd._npi_concatenate(nd.ones((1, 2)), nd.zeros((1, 2)), axis=1)
+    assert out.shape == (1, 4)
+    np.testing.assert_allclose(
+        nd._npi_around(nd.array(np.array([1.237], np.float32)),
+                       decimals=2).asnumpy(), [1.24])
+    np.testing.assert_allclose(
+        nd._npi_average(nd.array(np.array([1.0, 3.0], np.float32)),
+                        weights=(3.0, 1.0)).asnumpy(), 1.5)
+    np.testing.assert_allclose(
+        nd.bincount(nd.array(np.array([0, 1, 1], np.float32)),
+                    weights=(0.5, 2.0, 3.0)).asnumpy(), [0.5, 5.0])
+    mx.random.seed(0)
+    c = nd._npi_choice(a=3, size=(100,), weights=(1.0, 0.0, 0.0))
+    assert set(np.unique(c.asnumpy()).tolist()) == {0}
+    # wide integers survive lcm
+    if np.dtype(np.int64).itemsize == 8:
+        big = nd.array(np.array([2 ** 20], np.float32)).astype("int32")
+        assert int(nd.lcm(big, big).asnumpy()[0]) == 2 ** 20
+    # host-evaluated linalg inside autograd.record must not crash
+    x = nd.array(np.random.RandomState(0).rand(3, 3).astype("f"))
+    x.attach_grad()
+    with autograd.record():
+        _, s, _ = nd._npi_svd(x)
+    assert s.shape == (3,)
