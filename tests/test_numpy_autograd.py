@@ -124,3 +124,50 @@ def test_np_split_single_section_grad():
         loss = np.sum(parts[0] * 3.0)
     loss.backward()
     onp.testing.assert_allclose(x.grad.asnumpy(), [3, 3, 3, 3])
+
+
+def test_np_dispatch_protocol_surface():
+    """The reference pins its mx.np coverage with a dispatch-protocol list
+    (python/mxnet/numpy_dispatch_protocol.py); assert the equivalent
+    surface here: every listed function is callable through mx.np on
+    mx.np arrays."""
+    import numpy as onp
+
+    import incubator_mxnet_tpu.numpy as np
+
+    a = np.array(onp.arange(12, dtype="float32").reshape(3, 4) + 1.0)
+    v = np.array(onp.array([1.0, 2.0, 3.0], "float32"))
+
+    unary = ("abs absolute arccosh arcsinh arctan arctanh argmax argmin "
+             "ceil cos cosh cumsum exp expm1 floor log log10 log1p log2 "
+             "mean negative prod ravel reciprocal sign sin sinh sqrt "
+             "square std sum tan tanh transpose trunc var zeros_like "
+             "ones_like copy diff").split()
+    for name in unary:
+        fn = getattr(np, name)
+        out = fn(a)
+        assert out.shape is not None, name
+
+    binary = ("add subtract multiply divide power maximum minimum "
+              "arctan2 hypot copysign").split()
+    for name in binary:
+        out = getattr(np, name)(a, a)
+        assert out.shape == a.shape, name
+
+    # shape/manipulation surface
+    assert np.concatenate([a, a], axis=0).shape == (6, 4)
+    assert np.stack([a, a]).shape == (2, 3, 4)
+    assert np.split(a, 2, axis=1)[0].shape == (3, 2)
+    assert np.reshape(a, (4, 3)).shape == (4, 3)
+    assert np.expand_dims(a, 0).shape == (1, 3, 4)
+    assert np.squeeze(np.expand_dims(a, 0), 0).shape == (3, 4)
+    assert np.where(a > 6, a, -a).shape == (3, 4)
+    assert np.tile(v, 2).shape == (6,)
+    assert np.flip(a, 0).shape == (3, 4)
+    assert np.dot(a, np.transpose(a)).shape == (3, 3)
+    assert np.tensordot(a, a, axes=([1], [1])).shape == (3, 3)
+    assert np.einsum("ij,kj->ik", a, a).shape == (3, 3)
+    assert np.linalg.norm(a) > 0
+    assert np.unique(np.array(onp.array([1.0, 1.0, 2.0]))).shape == (2,)
+    assert np.argsort(v).shape == (3,)
+    assert np.clip(a, 2.0, 5.0).shape == (3, 4)
