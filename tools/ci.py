@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""CI legs in one entrypoint (reference analog: ci/runtime_functions.sh —
+unittest / quantization / sanity / nightly legs).
+
+Legs:
+  unit       pytest tests/ (CPU-pinned, 8-device virtual mesh)
+  examples   the five graded example configs (pytest -m slow subset)
+  tpu        pytest -m tpu (op consistency + int8 on the real chip)
+  sanitize   C++ engine suite under ASAN and TSAN
+  dryrun     8-device multichip sharding dry run (dp/tp/sp/pp/ep)
+  all        everything above that the environment supports
+
+Usage: python tools/ci.py [leg ...]
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(name, cmd, env=None, timeout=3600):
+    t = time.time()
+    print("== %s: %s" % (name, " ".join(cmd)), flush=True)
+    e = dict(os.environ)
+    if env:
+        e.update(env)
+    rc = subprocess.run(cmd, cwd=REPO, env=e, timeout=timeout).returncode
+    print("== %s: %s in %.0fs" % (name, "ok" if rc == 0 else
+                                  "FAILED rc=%d" % rc, time.time() - t),
+          flush=True)
+    return rc
+
+
+def leg_unit():
+    return _run("unit", [sys.executable, "-m", "pytest", "tests/", "-q"])
+
+
+def leg_examples():
+    return _run("examples", [sys.executable, "-m", "pytest",
+                             "tests/test_examples.py", "-q", "-m", "slow",
+                             "--override-ini", "addopts="])
+
+
+def leg_tpu():
+    return _run("tpu", [sys.executable, "-m", "pytest", "tests/", "-q",
+                        "-m", "tpu", "--override-ini", "addopts="])
+
+
+def leg_sanitize():
+    rc = _run("asan", ["make", "-C", "src/native", "asan-check"])
+    return rc or _run("tsan", ["make", "-C", "src/native", "tsan-check"])
+
+
+def leg_dryrun():
+    return _run(
+        "dryrun",
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(8)"],
+        env={"JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+
+
+LEGS = {"unit": leg_unit, "examples": leg_examples, "tpu": leg_tpu,
+        "sanitize": leg_sanitize, "dryrun": leg_dryrun}
+
+
+def main(argv):
+    names = argv or ["all"]
+    if names == ["all"]:
+        names = ["unit", "examples", "dryrun", "sanitize", "tpu"]
+    bad = [n for n in names if n not in LEGS]
+    if bad:
+        print("unknown legs: %s (have: %s)" % (bad, sorted(LEGS)))
+        return 2
+    rc = 0
+    for n in names:
+        rc = LEGS[n]() or rc
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
