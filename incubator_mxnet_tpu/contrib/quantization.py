@@ -29,6 +29,16 @@ def _get_optimal_threshold(arr, num_bins=1001, num_quantized_bins=255):
     if amax == 0.0:
         return 0.0
     hist, edges = np.histogram(arr, bins=num_bins, range=(0, amax))
+    return _entropy_threshold_from_hist(hist, edges, num_quantized_bins)
+
+
+def _entropy_threshold_from_hist(hist, edges, num_quantized_bins=255):
+    """Histogram-input form of the KL search — also the body of the
+    `_contrib_calibrate_entropy` op (calibrate.cc takes hist+edges)."""
+    hist = np.asarray(hist, np.float64)
+    edges = np.asarray(edges, np.float64)
+    num_bins = len(hist)
+    amax = float(edges[-1])
     total = hist.sum()
     if total == 0:
         return float(amax)

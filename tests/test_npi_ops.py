@@ -119,3 +119,42 @@ def test_npi_review_fixes():
     with autograd.record():
         _, s, _ = nd._npi_svd(x)
     assert s.shape == (3,)
+
+
+def test_final_tail_image_and_multi_ops():
+    """Last visible-name batch: image ops, _np_* reduces, multi adamw,
+    calibrate_entropy."""
+    rng = np.random.RandomState(0)
+    img = nd.array(rng.randint(0, 255, (8, 10, 3)).astype(np.float32))
+    t = nd.to_tensor(img)
+    assert t.shape == (3, 8, 10)
+    assert float(t.asnumpy().max()) <= 1.0
+    r = nd._image_resize(img, size=(5, 4))
+    assert r.shape == (4, 5, 3)
+    c = nd._image_crop(img, x_=2, y=1, width=4, height=3)
+    np.testing.assert_array_equal(c.asnumpy(), img.asnumpy()[1:4, 2:6, :])
+
+    np.testing.assert_allclose(nd._np_sum(nd.ones((2, 3))).asnumpy(), 6.0)
+    np.testing.assert_allclose(
+        nd._square_sum(nd.array(np.array([1.0, 2.0], np.float32))).asnumpy(),
+        5.0)
+
+    # multi adamw matches the single-tensor op
+    w = rng.rand(3).astype(np.float32)
+    g = rng.rand(3).astype(np.float32)
+    outs = nd._multi_adamw_update(
+        nd.array(w), nd.array(g), nd.zeros((3,)), nd.zeros((3,)),
+        num_weights=1, lrs=(0.1,), wds=(0.01,), etas=(1.0,))
+    m = 0.1 * g
+    v = 0.001 * np.square(g)
+    ref = w - (0.1 * m / (np.sqrt(v) + 1e-8) + 0.01 * w)
+    np.testing.assert_allclose(outs[0].asnumpy(), ref, rtol=1e-4)
+
+    # entropy calibration returns a plausible symmetric threshold
+    arr = rng.normal(0, 1, 20000)
+    h, e = np.histogram(np.abs(arr), bins=1001,
+                        range=(0, float(np.abs(arr).max())))
+    lo, hi = nd._contrib_calibrate_entropy(
+        nd.array(h.astype(np.float32)), nd.array(e.astype(np.float32)))
+    assert 0.5 < float(hi.asnumpy()) <= float(np.abs(arr).max())
+    assert float(lo.asnumpy()) == -float(hi.asnumpy())
