@@ -78,15 +78,19 @@ def emit(metric, value, unit, baseline, extra=None):
     return rec
 
 
-def _synth_recordio(image_size, n=512):
-    """Synthesize (once, cached on disk) a JPEG recordio shard for the
-    --data recordio mode; returns the file prefix."""
+def _synth_recordio(image_size, n=512, img_fmt=".jpg"):
+    """Synthesize (once, cached on disk) a recordio shard for the
+    --data recordio mode; returns the file prefix.  img_fmt '.npy' writes
+    raw payloads (no JPEG decode cost — isolates the IO path from the
+    host's decode throughput, which matters on few-core hosts)."""
     import numpy as np
 
     from incubator_mxnet_tpu.recordio import (IRHeader, MXIndexedRecordIO,
                                               pack_img)
 
-    prefix = os.path.join(REPO, ".bench_data", "synth%d" % image_size)
+    tag = "" if img_fmt == ".jpg" else img_fmt.replace(".", "_")
+    prefix = os.path.join(REPO, ".bench_data", "synth%d%s" % (image_size,
+                                                              tag))
     if os.path.exists(prefix + ".idx"):
         return prefix
     os.makedirs(os.path.dirname(prefix), exist_ok=True)
@@ -98,7 +102,7 @@ def _synth_recordio(image_size, n=512):
     for i in range(n):
         img = rng.randint(0, 255, (image_size, image_size, 3), dtype=np.uint8)
         rec.write_idx(i, pack_img(IRHeader(0, float(i % 1000), i, 0), img,
-                                  quality=90, img_fmt=".jpg"))
+                                  quality=90, img_fmt=img_fmt))
     rec.close()
     os.replace(tmp + ".rec", prefix + ".rec")
     os.replace(tmp + ".idx", prefix + ".idx")
@@ -107,7 +111,8 @@ def _synth_recordio(image_size, n=512):
 
 
 def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
-              compute_dtype="bfloat16", data="synthetic"):
+              compute_dtype="bfloat16", data="synthetic",
+              record_format=".jpg"):
     jax = setup_jax()
     import numpy as np
 
@@ -147,15 +152,17 @@ def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
 
     batch_src = None
     if data == "recordio":
-        from incubator_mxnet_tpu.io import ImageRecordIter
+        # uint8 iterator: 1/4 the host->device bytes and no host-side
+        # normalize — the cast to compute_dtype fuses into the step
+        from incubator_mxnet_tpu.io import ImageRecordUInt8Iter
 
-        prefix = _synth_recordio(image_size)
-        rit = ImageRecordIter(path_imgrec=prefix + ".rec",
-                              path_imgidx=prefix + ".idx",
-                              data_shape=(3, image_size, image_size),
-                              batch_size=batch_size, shuffle=True,
-                              rand_mirror=True, preprocess_threads=8,
-                              prefetch_buffer=8)
+        prefix = _synth_recordio(image_size, img_fmt=record_format)
+        rit = ImageRecordUInt8Iter(path_imgrec=prefix + ".rec",
+                                   path_imgidx=prefix + ".idx",
+                                   data_shape=(3, image_size, image_size),
+                                   batch_size=batch_size, shuffle=True,
+                                   rand_mirror=True, preprocess_threads=8,
+                                   prefetch_buffer=8)
 
         def batch_src():
             try:
@@ -354,6 +361,10 @@ def main():
     ap.add_argument("--chunks", type=int, default=8)
     ap.add_argument("--data", default="synthetic",
                     choices=["synthetic", "recordio"])
+    ap.add_argument("--record-format", default=".jpg",
+                    choices=[".jpg", ".npy"],
+                    help=".npy writes raw payloads — no JPEG decode cost "
+                         "(isolates IO from single-core decode limits)")
     args = ap.parse_args()
 
     setup_jax()
@@ -380,7 +391,8 @@ def main():
     for batch in batches:
         try:
             run_train(batch_size=batch, image_size=args.image_size,
-                      chunks=args.chunks, data=args.data)
+                      chunks=args.chunks, data=args.data,
+                      record_format=args.record_format)
             return
         except Exception as e:  # noqa: BLE001 - report best-effort
             err = e

@@ -34,7 +34,8 @@ from ..ndarray import ndarray as _nd
 from ..recordio import MXIndexedRecordIO, MXRecordIO, unpack, unpack_img
 from .io import DataBatch, DataDesc, DataIter
 
-__all__ = ["ImageRecordIter", "MNISTIter", "LibSVMIter"]
+__all__ = ["ImageRecordIter", "ImageRecordUInt8Iter", "MNISTIter",
+           "LibSVMIter"]
 
 
 class _Prefetcher:
@@ -197,13 +198,14 @@ class ImageRecordIter(DataIter):
         img = img[y0:y0 + h, x0:x0 + w]
         if self.rand_mirror and rng.rand() < 0.5:
             img = img[:, ::-1]
-        img = img.astype(np.float32)
-        img = (img - self.mean) / self.std
-        data = np.ascontiguousarray(img.transpose(2, 0, 1)[:c])
+        # stay uint8 HWC here: normalize/transpose run ONCE per batch
+        # (vectorized) in _epoch — per-image float work dominated the
+        # single-core pipeline cost
         label = np.asarray(header.label, np.float32).reshape(-1)
         if label.size < self.label_width:
             label = np.pad(label, (0, self.label_width - label.size))
-        return eidx, data, label[: self.label_width]
+        return eidx, np.ascontiguousarray(img[..., :c]), \
+            label[: self.label_width]
 
     def _epoch(self):
         order = list(self._keys)
@@ -221,15 +223,24 @@ class ImageRecordIter(DataIter):
                 pad = bs - len(chunk)
                 while len(chunk) < bs:  # wrap repeatedly: shard may be tiny
                     chunk = chunk + order[: bs - len(chunk)]
-            data = np.empty((bs, c, h, w), self.dtype)
+            raw = np.empty((bs, h, w, c), np.uint8)
             label = np.empty((bs, self.label_width), np.float32)
             aug_seed = int(self._rng.randint(0, 2**31))  # producer thread only
             futs = [self._pool.submit(self._decode_one, k, i, aug_seed)
                     for i, k in enumerate(chunk)]
             for f in futs:
                 i, d, l = f.result()
-                data[i] = d
+                raw[i] = d
                 label[i] = l
+            if self.dtype == np.uint8:
+                # ImageRecordUInt8Iter contract: raw NCHW uint8, no
+                # normalization (normalize on-device instead)
+                data = np.ascontiguousarray(raw.transpose(0, 3, 1, 2))
+            else:
+                data = ((raw.astype(np.float32) - self.mean) /
+                        self.std).transpose(0, 3, 1, 2).astype(
+                            self.dtype, copy=False)
+                data = np.ascontiguousarray(data)
             yield (data, label, pad)
 
     # -- DataIter interface ------------------------------------------------
@@ -311,6 +322,18 @@ def _read_idx_file(path):
                      dtype_code]
         data = np.frombuffer(f.read(), dtype=np.dtype(dtype).newbyteorder(">"))
         return data.reshape(dims).astype(dtype)
+
+
+class ImageRecordUInt8Iter(ImageRecordIter):
+    """ImageRecordIter emitting raw NCHW uint8 batches with no host-side
+    normalization (reference: ImageRecordUInt8Iter,
+    src/io/iter_image_recordio_2.cc).  Preferred on few-core hosts: the
+    batch ships at 1/4 the bytes and mean/std normalization fuses into the
+    device program."""
+
+    def __init__(self, *args, **kwargs):
+        kwargs["dtype"] = "uint8"
+        super().__init__(*args, **kwargs)
 
 
 class MNISTIter(DataIter):

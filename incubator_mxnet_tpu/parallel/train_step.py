@@ -130,8 +130,14 @@ class TrainStep:
                     pv_c = [v.astype(compute_dtype)
                             if jnp.issubdtype(v.dtype, jnp.floating) else v
                             for v in pv]
-                    x_c = x.astype(compute_dtype) \
-                        if jnp.issubdtype(x.dtype, jnp.floating) else x
+                    # floats re-cast to the compute dtype; unsigned ints are
+                    # raw image bytes (ImageRecordUInt8Iter) — promote them
+                    # so convs run in the compute dtype too
+                    if jnp.issubdtype(x.dtype, jnp.floating) or \
+                            jnp.issubdtype(x.dtype, jnp.unsignedinteger):
+                        x_c = x.astype(compute_dtype)
+                    else:
+                        x_c = x
                 else:
                     pv_c, x_c = pv, x
                 tc = tracing.TraceContext(key, training=True)

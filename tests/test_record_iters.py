@@ -193,3 +193,46 @@ def test_image_record_iter_tiny_shard_pads_fully(tmp_path):
         seen += 1
     assert seen == 1
     it.close()
+
+
+def test_uint8_iter_and_train_step_promotion(tmp_path):
+    """ImageRecordUInt8Iter emits raw NCHW uint8 (no host normalize) and
+    the fused train step promotes uint8 inputs to the compute dtype
+    (iter_image_recordio_2.cc ImageRecordUInt8Iter semantics)."""
+    import numpy as np
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, nd
+    from incubator_mxnet_tpu.io import ImageRecordUInt8Iter
+    from incubator_mxnet_tpu.parallel import make_train_step
+    from incubator_mxnet_tpu.recordio import (IRHeader, MXIndexedRecordIO,
+                                              pack_img)
+
+    prefix = str(tmp_path / "u8")
+    rec = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    rng = np.random.RandomState(0)
+    for i in range(32):
+        img = rng.randint(0, 255, (16, 16, 3), dtype=np.uint8)
+        rec.write_idx(i, pack_img(IRHeader(0, float(i % 4), i, 0), img,
+                                  img_fmt=".npy"))
+    rec.close()
+
+    it = ImageRecordUInt8Iter(path_imgrec=prefix + ".rec",
+                              path_imgidx=prefix + ".idx",
+                              data_shape=(3, 16, 16), batch_size=8,
+                              preprocess_threads=2, prefetch_buffer=2)
+    batch = next(it)
+    x = batch.data[0]
+    assert x.dtype == np.uint8 and x.shape == (8, 3, 16, 16)
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(4, 3, padding=1), gluon.nn.Flatten(),
+            gluon.nn.Dense(4))
+    net.initialize()
+    net(nd.zeros((1, 3, 16, 16)))  # materialize deferred params
+    step = make_train_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           optimizer="sgd", learning_rate=0.1,
+                           compute_dtype="bfloat16")
+    loss = step(x, batch.label[0])
+    assert np.isfinite(float(loss.asscalar()))
+    it.close()
