@@ -223,22 +223,30 @@ class ImageRecordIter(DataIter):
                 pad = bs - len(chunk)
                 while len(chunk) < bs:  # wrap repeatedly: shard may be tiny
                     chunk = chunk + order[: bs - len(chunk)]
-            raw = np.empty((bs, h, w, c), np.uint8)
+            # staging dtype preserves payload values: uint8 only on the
+            # raw-bytes path (JPEG/PNG always decode to uint8); float/other
+            # payloads stage at the iterator dtype so nothing wraps mod 256
+            raw_bytes = getattr(self, "_raw_bytes", False)
+            stage = np.empty((bs, h, w, c),
+                             np.uint8 if raw_bytes else self.dtype)
             label = np.empty((bs, self.label_width), np.float32)
             aug_seed = int(self._rng.randint(0, 2**31))  # producer thread only
             futs = [self._pool.submit(self._decode_one, k, i, aug_seed)
                     for i, k in enumerate(chunk)]
             for f in futs:
                 i, d, l = f.result()
-                raw[i] = d
+                stage[i] = d
                 label[i] = l
-            if self.dtype == np.uint8:
-                # ImageRecordUInt8Iter contract: raw NCHW uint8, no
-                # normalization (normalize on-device instead)
-                data = np.ascontiguousarray(raw.transpose(0, 3, 1, 2))
+            if raw_bytes:
+                # ImageRecordUInt8Iter contract: raw NCHW bytes; the
+                # consumer normalizes in its own device program
+                data = np.ascontiguousarray(stage.transpose(0, 3, 1, 2))
             else:
-                data = ((raw.astype(np.float32) - self.mean) /
-                        self.std).transpose(0, 3, 1, 2).astype(
+                # batch-level vectorized normalize (mean/std sliced to the
+                # requested channel count so 1-channel shapes don't
+                # broadcast back up to 3)
+                data = ((stage.astype(np.float32) - self.mean[:c]) /
+                        self.std[:c]).transpose(0, 3, 1, 2).astype(
                             self.dtype, copy=False)
                 data = np.ascontiguousarray(data)
             yield (data, label, pad)
@@ -325,13 +333,21 @@ def _read_idx_file(path):
 
 
 class ImageRecordUInt8Iter(ImageRecordIter):
-    """ImageRecordIter emitting raw NCHW uint8 batches with no host-side
-    normalization (reference: ImageRecordUInt8Iter,
-    src/io/iter_image_recordio_2.cc).  Preferred on few-core hosts: the
-    batch ships at 1/4 the bytes and mean/std normalization fuses into the
-    device program."""
+    """ImageRecordIter emitting raw NCHW uint8 batches — NO normalization
+    (reference: ImageRecordUInt8Iter, src/io/iter_image_recordio_2.cc:
+    raw bytes; the consumer applies mean/std in its own device program).
+    Preferred on few-core hosts: 1/4 the host->device bytes and no
+    host-side float pass."""
+
+    _raw_bytes = True
 
     def __init__(self, *args, **kwargs):
+        for k in ("mean_r", "mean_g", "mean_b", "std_r", "std_g", "std_b"):
+            if k in kwargs:
+                raise ValueError(
+                    "ImageRecordUInt8Iter emits raw bytes; %s has no "
+                    "effect — normalize in the consumer (device) instead "
+                    "or use ImageRecordIter" % k)
         kwargs["dtype"] = "uint8"
         super().__init__(*args, **kwargs)
 

@@ -139,7 +139,10 @@ class TrainStep:
                     else:
                         x_c = x
                 else:
-                    pv_c, x_c = pv, x
+                    pv_c = pv
+                    # raw image bytes must still become floats for the convs
+                    x_c = x.astype(jnp.float32) \
+                        if jnp.issubdtype(x.dtype, jnp.unsignedinteger) else x
                 tc = tracing.TraceContext(key, training=True)
                 for p, v in zip(gp_list, pv_c):
                     tc.bindings[id(p)] = v

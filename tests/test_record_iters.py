@@ -236,3 +236,69 @@ def test_uint8_iter_and_train_step_promotion(tmp_path):
     loss = step(x, batch.label[0])
     assert np.isfinite(float(loss.asscalar()))
     it.close()
+
+
+def test_record_iter_review_pins(tmp_path):
+    """Pins for the review findings: 1-channel shapes, non-uint8 payload
+    preservation, uint8-iter kwarg rejection, default-dtype promotion."""
+    import numpy as np
+
+    from incubator_mxnet_tpu import gluon, nd
+    from incubator_mxnet_tpu.io import (ImageRecordIter,
+                                        ImageRecordUInt8Iter)
+    from incubator_mxnet_tpu.parallel import make_train_step
+    from incubator_mxnet_tpu.recordio import (IRHeader, MXIndexedRecordIO,
+                                              pack_img)
+
+    # 1-channel data_shape keeps 1 channel through the batch normalize
+    prefix = str(tmp_path / "gray")
+    rec = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    rng = np.random.RandomState(0)
+    for i in range(8):
+        img = rng.randint(0, 255, (8, 8, 3), dtype=np.uint8)
+        rec.write_idx(i, pack_img(IRHeader(0, 0.0, i, 0), img,
+                                  img_fmt=".npy"))
+    rec.close()
+    it = ImageRecordIter(path_imgrec=prefix + ".rec",
+                         path_imgidx=prefix + ".idx", data_shape=(1, 8, 8),
+                         batch_size=4, preprocess_threads=1,
+                         prefetch_buffer=1)
+    b = next(it)
+    assert b.data[0].shape == (4, 1, 8, 8)
+    it.close()
+
+    # float payloads outside [0,255] survive the float iterator untouched
+    prefix = str(tmp_path / "floats")
+    rec = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    arr = (rng.rand(8, 8, 3).astype(np.float32) * 1000.0) - 500.0
+    rec.write_idx(0, pack_img(IRHeader(0, 0.0, 0, 0), arr, img_fmt=".npy"))
+    rec.close()
+    it = ImageRecordIter(path_imgrec=prefix + ".rec",
+                         path_imgidx=prefix + ".idx", data_shape=(3, 8, 8),
+                         batch_size=1, preprocess_threads=1,
+                         prefetch_buffer=1, shuffle=False, rand_mirror=False)
+    b = next(it)
+    np.testing.assert_allclose(b.data[0].asnumpy()[0],
+                               arr.transpose(2, 0, 1), rtol=1e-5)
+    it.close()
+
+    # raw-bytes iterator rejects normalization kwargs instead of silently
+    # ignoring them
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        ImageRecordUInt8Iter(path_imgrec=prefix + ".rec",
+                             path_imgidx=prefix + ".idx",
+                             data_shape=(3, 8, 8), batch_size=1,
+                             mean_r=123.0)
+
+    # uint8 batches work with the DEFAULT train step (no compute_dtype)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(2, 3, padding=1), gluon.nn.Flatten(),
+            gluon.nn.Dense(2))
+    net.initialize()
+    net(nd.zeros((1, 3, 8, 8)))
+    step = make_train_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           optimizer="sgd", learning_rate=0.01)
+    x8 = nd.array(np.zeros((2, 3, 8, 8), np.uint8))
+    loss = step(x8, nd.zeros((2,)))
+    assert np.isfinite(float(loss.asscalar()))
