@@ -45,7 +45,8 @@ def _input_names(op: "_reg.Op"):
             if p.default is inspect.Parameter.empty:
                 names.append(p.name)
             elif p.name in ("bias", "gamma", "sequence_length", "label_lengths",
-                            "data_lengths", "r1_r2", "min_bias", "max_bias"):
+                            "data_lengths", "r1_r2", "min_bias", "max_bias",
+                            "valid_length", "max_time"):
                 names.append(p.name)  # optional tensor inputs
     return names
 

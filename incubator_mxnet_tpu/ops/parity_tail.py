@@ -586,3 +586,132 @@ def _group_adagrad_update(weight, grad, history, lr=0.01, rescale_grad=1.0,
     shape = (-1,) + (1,) * (g.ndim - 1)
     return (weight - float(lr) * g /
             (jnp.sqrt(new_hist).reshape(shape) + float(epsilon)), new_hist)
+
+
+# -- last named contrib gaps -------------------------------------------------
+
+def edge_id(csr, u, v):
+    """Edge-id lookup in a CSR adjacency: out[i] = data[k] where
+    (indices[k] == v[i]) within row u[i]'s span, else -1
+    (src/operator/contrib/dgl_graph.cc _contrib_edge_id).  Takes the
+    CSRNDArray directly — CSR structure is python-side here, so this is a
+    sparse-frontend function rather than a registry op."""
+    import numpy as np
+
+    from ..ndarray import ndarray as _nd
+
+    indptr = np.asarray(csr.indptr.asnumpy())
+    indices = np.asarray(csr.indices.asnumpy())
+    data = np.asarray(csr.data.asnumpy())
+    uu = np.asarray(u.asnumpy() if hasattr(u, "asnumpy") else u).astype(int)
+    vv = np.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v).astype(int)
+    out = np.full(uu.shape, -1.0, np.float32)
+    for i, (a, b) in enumerate(zip(uu, vv)):
+        lo, hi = indptr[a], indptr[a + 1]
+        hit = np.nonzero(indices[lo:hi] == b)[0]
+        if hit.size:
+            out[i] = data[lo + hit[0]]
+    return _nd.array(out)
+
+
+def _make_kl_sparse_reg():
+    @jax.custom_vjp
+    def f(x, sparseness_target, penalty, momentum):
+        return x
+
+    def fwd(x, sparseness_target, penalty, momentum):
+        # rho_hat per hidden unit (mean over the batch axis); the reference
+        # keeps a momentum-smoothed estimate in aux state — here the batch
+        # estimate is used directly (momentum accepted for API parity)
+        rho_hat = jnp.clip(jnp.mean(x, axis=0), 1e-6, 1 - 1e-6)
+        return x, (rho_hat, sparseness_target, penalty, x.shape[0])
+
+    def bwd(res, g):
+        rho_hat, rho, penalty, n = res
+        # d/dx sum KL(rho || rho_hat(x)) with rho_hat = mean over batch:
+        # (-rho/rho_hat + (1-rho)/(1-rho_hat)) / n per element
+        kl_grad = (penalty / n) * (-rho / rho_hat + (1 - rho) / (1 - rho_hat))
+        return (g + jnp.broadcast_to(kl_grad, g.shape),
+                jnp.zeros_like(res[1]), jnp.zeros_like(res[2]), None)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+_kl_sparse_reg = _make_kl_sparse_reg()
+
+
+@register("IdentityAttachKLSparseReg", num_inputs=1)
+def _identity_attach_kl_sparse_reg(data, sparseness_target=0.1, penalty=0.001,
+                                   momentum=0.9):
+    """Identity forward; backward adds the gradient of a KL sparsity
+    penalty on batch-mean activations (src/operator/
+    identity_attach_KL_sparse_reg.cc — sparse-autoencoder regularizer)."""
+    return _kl_sparse_reg(data,
+                          jnp.asarray(float(sparseness_target), jnp.float32),
+                          jnp.asarray(float(penalty), jnp.float32),
+                          float(momentum))
+
+
+@register("_contrib_hawkesll", num_inputs=7, num_outputs=2,
+          differentiable=False)
+def _hawkesll(mu, alpha, beta, lags, marks, valid_length=None,
+              max_time=None):
+    """Log-likelihood of a multivariate Hawkes process with exponential
+    kernels (src/operator/contrib/hawkes_ll.cc).
+
+    mu: (K,) background intensities; alpha: (K,) branching scales;
+    beta: (K,) decay rates; lags: (N, T) inter-arrival times;
+    marks: (N, T) int event types; valid_length: (N,) events per row;
+    max_time: (N,) observation horizon.  Returns (loglik (N,), last decayed
+    states (N, K)).
+    """
+    K = mu.shape[0]
+    N, T = lags.shape
+    marks = marks.astype(jnp.int32)
+    vl = (jnp.full((N,), T) if valid_length is None
+          else valid_length.astype(jnp.int32).reshape(-1))
+    mt = (jnp.sum(lags, axis=1) if max_time is None
+          else max_time.reshape(-1))
+
+    def seq_ll(lag_row, mark_row, n_valid, horizon):
+        def step(carry, inp):
+            t, states, ll = carry
+            dt, k, idx = inp
+            # decay all states to the new event time
+            states = states * jnp.exp(-beta * dt)
+            lam = mu[k] + alpha[k] * beta[k] * states[k]
+            valid = idx < n_valid
+            ll = ll + jnp.where(valid, jnp.log(jnp.maximum(lam, 1e-30)), 0.0)
+            states = states + jnp.where(valid,
+                                        jax.nn.one_hot(k, K, dtype=states.dtype),
+                                        jnp.zeros((K,), states.dtype))
+            return (t + jnp.where(valid, dt, 0.0), states, ll), None
+
+        init = (jnp.asarray(0.0, jnp.float32),
+                jnp.zeros((K,), jnp.float32), jnp.asarray(0.0, jnp.float32))
+        (t_last, states, ll), _ = jax.lax.scan(
+            step, init, (lag_row.astype(jnp.float32), mark_row,
+                         jnp.arange(T)))
+        # compensator: integral of intensity over [0, horizon]
+        # background: sum_k mu_k * horizon; excitation: for each event of
+        # type k at time t_i: alpha_k * (1 - exp(-beta_k (horizon - t_i)))
+        states_T = states * jnp.exp(-beta * (horizon - t_last))
+        # accumulated excitation integral equals alpha_k * (n_events_k -
+        # decayed remainder at horizon)
+        counts = jnp.zeros((K,), jnp.float32)
+
+        def count_step(c, inp):
+            k, idx = inp
+            return c + jnp.where(idx < n_valid,
+                                 jax.nn.one_hot(k, K, dtype=c.dtype),
+                                 jnp.zeros((K,), c.dtype)), None
+
+        counts, _ = jax.lax.scan(count_step, counts,
+                                 (mark_row, jnp.arange(T)))
+        compensator = jnp.sum(mu * horizon) + jnp.sum(
+            alpha * (counts - states_T))
+        return ll - compensator, states_T
+
+    lls, states = jax.vmap(seq_ll)(lags, marks, vl, mt)
+    return lls, states

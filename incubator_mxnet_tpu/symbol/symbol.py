@@ -652,7 +652,7 @@ def _input_arg_names(op: _reg.Op):
             if p.default is inspect.Parameter.empty or p.name in PARAM_INPUT_NAMES \
                     or p.name in ("sequence_length", "label_lengths",
                                   "data_lengths", "r1_r2", "min_bias",
-                                  "max_bias"):
+                                  "max_bias", "valid_length", "max_time"):
                 names.append(p.name)
     return names
 
