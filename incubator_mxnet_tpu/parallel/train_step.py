@@ -105,6 +105,9 @@ class TrainStep:
         self._aux = None
         self._opt_state = None
         self._step_count = 0
+        self._key_dev = None   # device-carried PRNG key (donated each step)
+        self._step_dev = None  # device-carried int32 step counter
+        self._key_epoch = None  # rng.epoch() at key draw (reseed detection)
         self._jit = None
         self._compiled = None
         self._compiled_key = None
@@ -125,6 +128,12 @@ class TrainStep:
         compute_dtype = self.compute_dtype
 
         def step(p_vals, aux_vals, opt_state, x, y, key, step_count):
+            # key/step_count are DEVICE-carried state (donated, updated in
+            # program): a fresh host scalar or an eager key split per step
+            # costs ~10-100 ms of serialized host->device transfer through a
+            # tunneled runtime, which dominated the measured step gap
+            step_count = step_count + 1
+            key, use_key = jax.random.split(key)
             def loss_of(pv):
                 if compute_dtype is not None:
                     pv_c = [v.astype(compute_dtype)
@@ -143,7 +152,7 @@ class TrainStep:
                     # raw image bytes must still become floats for the convs
                     x_c = x.astype(jnp.float32) \
                         if jnp.issubdtype(x.dtype, jnp.unsignedinteger) else x
-                tc = tracing.TraceContext(key, training=True)
+                tc = tracing.TraceContext(use_key, training=True)
                 for p, v in zip(gp_list, pv_c):
                     tc.bindings[id(p)] = v
                 for p, v in zip(aux_list, aux_vals):
@@ -169,9 +178,9 @@ class TrainStep:
             (loss_val, new_aux), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(p_vals)
             new_p, new_s = opt.apply(p_vals, grads, opt_state, step_count)
-            return loss_val, new_p, list(new_aux), new_s
+            return loss_val, new_p, list(new_aux), new_s, key, step_count
 
-        donate = (0, 1, 2) if self._donate else ()
+        donate = (0, 1, 2, 5, 6) if self._donate else ()
         if self.mesh is None:
             return jax.jit(step, donate_argnums=donate)
 
@@ -195,8 +204,9 @@ class TrainStep:
         self._shardings = (p_sh, aux_sh, state_sh, batch_sh, repl)
         return jax.jit(step, donate_argnums=donate,
                        in_shardings=(p_sh, aux_sh, state_sh, batch_sh,
-                                     batch_sh, repl, None),
-                       out_shardings=(repl, p_sh, aux_sh, state_sh))
+                                     batch_sh, repl, repl),
+                       out_shardings=(repl, p_sh, aux_sh, state_sh, repl,
+                                      repl))
 
     # ------------------------------------------------------------------
     def _ensure_built(self):
@@ -211,6 +221,23 @@ class TrainStep:
             self._multihost = self.mesh is not None and any(
                 d.process_index != jax.process_index()
                 for d in self.mesh.devices.flat)
+        if self._key_dev is None or self._key_epoch != rng.epoch():
+            # (re)draw the carried key — also when the user reseeded after
+            # steps already ran (mx.random.seed / rng.set_state must keep
+            # affecting the training stream)
+            self._key_epoch = rng.epoch()
+            self._key_dev = rng.next_key()
+            if self._placed:
+                if self._multihost:
+                    from jax.experimental import multihost_utils as mhu
+
+                    self._key_dev = mhu.host_local_array_to_global_array(
+                        self._key_dev, self.mesh, self._shardings[4].spec)
+                else:
+                    self._key_dev = jax.device_put(self._key_dev,
+                                                   self._shardings[4])
+        if self._step_dev is None:
+            self._step_dev = jnp.int32(self._step_count)
 
     def _place_state(self, p_vals, aux_vals):
         """One-time placement of params/opt-state on their target shardings
@@ -218,7 +245,7 @@ class TrainStep:
         host-local replicas (identical after seeded init / broadcast) become
         global arrays — dist_sync_device ≡ one GSPMD program over every
         process's devices (SURVEY §5.8)."""
-        p_sh, aux_sh, state_sh, _, _ = self._shardings
+        p_sh, aux_sh, state_sh, _, repl = self._shardings
         if self._multihost:
             from jax.experimental import multihost_utils as mhu
 
@@ -229,12 +256,20 @@ class TrainStep:
             self._opt_state = jax.tree.map(
                 lambda v, s: mhu.host_local_array_to_global_array(
                     v, self.mesh, s.spec), self._opt_state, state_sh)
+            # carried key/step must be identical across hosts (same seed);
+            # promote the host-local replicas to replicated global arrays
+            self._key_dev = mhu.host_local_array_to_global_array(
+                self._key_dev, self.mesh, repl.spec)
+            self._step_dev = mhu.host_local_array_to_global_array(
+                self._step_dev, self.mesh, repl.spec)
         else:
             p_vals = [jax.device_put(v, s) for v, s in zip(p_vals, p_sh)]
             aux_vals = [jax.device_put(v, s)
                         for v, s in zip(aux_vals, aux_sh)]
             self._opt_state = jax.tree.map(
                 jax.device_put, self._opt_state, state_sh)
+            self._key_dev = jax.device_put(self._key_dev, repl)
+            self._step_dev = jax.device_put(self._step_dev, repl)
         self._placed = True
         return p_vals, aux_vals
 
@@ -279,10 +314,9 @@ class TrainStep:
                 for p, v in zip(self._aux, aux_vals):
                     p._data._data = v
             xv, yv = self._place_batch(xv, yv)
-        key = rng.next_key()
         t0 = _time.time()
         traced = self._jit.trace(p_vals, aux_vals, self._opt_state, xv, yv,
-                                 key, jnp.int32(self._step_count + 1))
+                                 self._key_dev, self._step_dev)
         lowered = traced.lower()
         t_trace = _time.time() - t0
         t0 = _time.time()
@@ -298,8 +332,6 @@ class TrainStep:
 
         xv = x._data if isinstance(x, NDArray) else jnp.asarray(x)
         yv = y._data if isinstance(y, NDArray) else jnp.asarray(y)
-        key = rng.next_key()
-        self._step_count += 1
         p_vals = [p._data._data for p in self._gp]
         aux_vals = [p._data._data for p in self._aux]
         if self.mesh is not None:
@@ -312,9 +344,12 @@ class TrainStep:
         if self._compiled is not None and self._compiled_key == (
                 (xv.shape, str(xv.dtype)), (yv.shape, str(yv.dtype))):
             fn = self._compiled
-        loss, new_p, new_aux, new_s = fn(
-            p_vals, aux_vals, self._opt_state, xv, yv, key,
-            jnp.int32(self._step_count))
+        loss, new_p, new_aux, new_s, self._key_dev, self._step_dev = fn(
+            p_vals, aux_vals, self._opt_state, xv, yv, self._key_dev,
+            self._step_dev)
+        # host mirror of the device counter, advanced only on success so the
+        # two can't drift when a step raises (bad shapes, donation errors)
+        self._step_count += 1
         for p, v in zip(self._gp, new_p):
             p._data._data = v
         for p, v in zip(self._aux, new_aux):

@@ -19,6 +19,8 @@ __all__ = ["seed", "next_key", "get_state", "set_state"]
 _LOCK = threading.Lock()
 _KEY = None  # lazy: creating a key initializes a backend; defer to first use
 _SEEDED = False
+_EPOCH = 0  # bumped on seed()/set_state(); lets carried-key consumers
+#             (TrainStep) notice a reseed and re-draw their device key
 
 
 def _key():
@@ -30,10 +32,11 @@ def _key():
 
 def seed(seed_state: int, ctx=None):  # ctx accepted for API parity
     """Seed the global generator (mx.random.seed parity)."""
-    global _KEY, _SEEDED
+    global _KEY, _SEEDED, _EPOCH
     with _LOCK:
         _KEY = jax.random.PRNGKey(int(seed_state) & 0x7FFFFFFF)
         _SEEDED = True
+        _EPOCH += 1
 
 
 def next_key() -> jax.Array:
@@ -61,5 +64,12 @@ def get_state():
 
 
 def set_state(key):
-    global _KEY
-    _KEY = key
+    global _KEY, _EPOCH
+    with _LOCK:
+        _KEY = key
+        _EPOCH += 1
+
+
+def epoch() -> int:
+    """Monotonic reseed counter; changes whenever seed()/set_state() run."""
+    return _EPOCH

@@ -177,3 +177,33 @@ def test_collectives_in_shard_map():
     np.testing.assert_allclose(np.asarray(total)[:, 0],
                                np.full(n, x.sum()), rtol=1e-6)
     assert gathered.shape == (n, 2 * n)
+
+
+def test_train_step_carried_rng_reseed():
+    """The step carries its PRNG key/step counter on device (no per-step
+    host transfers); mx.random.seed after steps must still restart the
+    dropout stream deterministically, and the host step mirror must track
+    the device counter."""
+    from incubator_mxnet_tpu import gluon, nd
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.parallel import make_train_step
+
+    mx.random.seed(7)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dropout(0.5), nn.Dense(4))
+    net.initialize(init=mx.init.Xavier())
+    net.shape_init((1, 16))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    # lr 0: params frozen, so the loss is purely a function of the dropout key
+    step = make_train_step(net, loss_fn, optimizer="sgd", learning_rate=0.0)
+    x = nd.random.uniform(shape=(32, 16))
+    y = nd.array(np.random.RandomState(0).randint(0, 4, 32)
+                 .astype(np.float32))
+    float(step(x, y).asscalar())
+    float(step(x, y).asscalar())
+    mx.random.seed(123)
+    a = [float(step(x, y).asscalar()) for _ in range(2)]
+    mx.random.seed(123)
+    b = [float(step(x, y).asscalar()) for _ in range(2)]
+    assert a == b
+    assert step._step_count == int(step._step_dev) == 6
