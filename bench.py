@@ -112,7 +112,7 @@ def _synth_recordio(image_size, n=512, img_fmt=".jpg"):
 
 def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
               compute_dtype="bfloat16", data="synthetic",
-              record_format=".jpg"):
+              record_format=".jpg", s2d_stem=False, ghost_bn=0):
     jax = setup_jax()
     import numpy as np
 
@@ -124,7 +124,13 @@ def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
     log("devices: %s" % (jax.devices(),))
     mx.random.seed(0)
     t = time.time()
-    net = vision.resnet50_v1(classes=1000)
+    # s2d_stem: exact space-to-depth rewrite of the 7x7/s2 stem conv
+    # (docs/PERF.md; checkpoint-compatible, numerically identical)
+    # ghost_bn: fused Pallas BN with group statistics (parallel/fused_bn.py;
+    # explicit opt-in — matches per-device stats of the distributed
+    # north-star scenario, see docs/PERF.md)
+    net = vision.resnet50_v1(classes=1000, s2d_stem=s2d_stem,
+                             ghost_bn=ghost_bn)
     net.initialize(init=mx.init.Xavier())
     log("build+param-init %.1fs" % (time.time() - t))
     t = time.time()
@@ -196,6 +202,8 @@ def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
             % (c, chunk_iters, dt, img_s, 1e3 * dt / chunk_iters))
         emit(metric, best, "img/s", BASELINE_IMG_S,
              {"batch": batch_size, "dtype": compute_dtype, "data": data,
+              "s2d_stem": bool(s2d_stem),
+              "bn": ("ghost%d" % ghost_bn) if ghost_bn else "batch",
               "step_ms": round(1e3 / (best / batch_size), 2),
               "mfu_bf16": round(best * TRAIN_FLOPS_PER_IMG /
                                 V5E_PEAK_FLOPS, 4),
@@ -368,6 +376,10 @@ def main():
     ap.add_argument("--chunks", type=int, default=8)
     ap.add_argument("--data", default="synthetic",
                     choices=["synthetic", "recordio"])
+    ap.add_argument("--s2d-stem", action="store_true",
+                    help="space-to-depth stem conv (exact rewrite)")
+    ap.add_argument("--ghost-bn", type=int, default=0,
+                    help="fused ghost-BN group size (0 = stock BatchNorm)")
     ap.add_argument("--record-format", default=".jpg",
                     choices=[".jpg", ".npy"],
                     help=".npy writes raw payloads — no JPEG decode cost "
@@ -399,7 +411,8 @@ def main():
         try:
             run_train(batch_size=batch, image_size=args.image_size,
                       chunks=args.chunks, data=args.data,
-                      record_format=args.record_format)
+                      record_format=args.record_format,
+                      s2d_stem=args.s2d_stem, ghost_bn=args.ghost_bn)
             return
         except Exception as e:  # noqa: BLE001 - report best-effort
             err = e

@@ -249,6 +249,69 @@ OPS["BatchNorm"].aux_update = _batch_norm_aux_update
 OPS["BatchNorm"].mutate_idx = (3, 4)
 
 
+def _ghost_bn_common(data, residual, gamma, beta, moving_mean, moving_var,
+                     eps, group):
+    """Shared body for the fused ghost-BN ops.  Training: Pallas fused
+    kernel (parallel/fused_bn.py) with group statistics; eval: moving-stat
+    normalize (+add) + relu as plain jnp (XLA fuses it fine)."""
+    if _is_train():
+        from ..parallel.fused_bn import ghost_bn_act, ghost_bn_stats_merge
+
+        out, m, v = ghost_bn_act(data, gamma.astype(jnp.float32),
+                                 beta.astype(jnp.float32),
+                                 residual=residual, eps=eps, act="relu",
+                                 group=group)
+        bm, bv = ghost_bn_stats_merge(m, v)
+        return out, bm, bv
+    inv = lax.rsqrt(moving_var.astype(jnp.float32) + eps)
+    g32 = gamma.astype(jnp.float32)
+    scale = (g32 * inv).reshape(1, -1, 1, 1)
+    shift = (beta.astype(jnp.float32)
+             - moving_mean.astype(jnp.float32) * g32 * inv).reshape(1, -1, 1, 1)
+    y = data.astype(jnp.float32) * scale + shift
+    if residual is not None:
+        y = y + residual.astype(jnp.float32)
+    return (jnp.maximum(y, 0.0).astype(data.dtype),
+            moving_mean.astype(jnp.float32), moving_var.astype(jnp.float32))
+
+
+@register("_contrib_GhostBNReLU", num_inputs=5, num_outputs=3,
+          mutate_idx=(3, 4))
+def _ghost_bn_relu(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                   momentum=0.9, group=0):
+    """Fused ghost-BN + ReLU (TPU Pallas; see parallel/fused_bn.py).
+
+    Outputs: (out, batch_mean, batch_var) — stats feed the running-average
+    aux update like BatchNorm's (``src/operator/nn/batch_norm.cc:493``
+    stateful forward), with group (ghost) statistics in training.
+    """
+    return _ghost_bn_common(data, None, gamma, beta, moving_mean, moving_var,
+                            float(eps), int(group))
+
+
+@register("_contrib_GhostBNAddReLU", num_inputs=6, num_outputs=3,
+          mutate_idx=(4, 5))
+def _ghost_bn_add_relu(data, residual, gamma, beta, moving_mean, moving_var,
+                       eps=1e-3, momentum=0.9, group=0):
+    """Fused ghost-BN + residual add + ReLU (the bottleneck-exit pattern)."""
+    return _ghost_bn_common(data, residual, gamma, beta, moving_mean,
+                            moving_var, float(eps), int(group))
+
+
+def _ghost_bn_aux_update(in_vals, out_vals, momentum=0.9, **_):
+    m = float(momentum)
+    base = 3 if len(in_vals) == 5 else 4
+    old_m, old_v = in_vals[base], in_vals[base + 1]
+    return {base: (m * old_m.astype(jnp.float32)
+                   + (1 - m) * out_vals[1]).astype(old_m.dtype),
+            base + 1: (m * old_v.astype(jnp.float32)
+                       + (1 - m) * out_vals[2]).astype(old_v.dtype)}
+
+
+OPS["_contrib_GhostBNReLU"].aux_update = _ghost_bn_aux_update
+OPS["_contrib_GhostBNAddReLU"].aux_update = _ghost_bn_aux_update
+
+
 @register("LayerNorm", aliases=("layer_norm",))
 def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
     mean = jnp.mean(data.astype(jnp.float32), axis=axis, keepdims=True)

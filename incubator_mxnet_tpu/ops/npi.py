@@ -540,8 +540,8 @@ def _multi_mp_adamw_update(*arrays, num_weights=None, lrs=(), wds=(),
     return tuple(out)
 
 
-@register("_contrib_calibrate_entropy", num_inputs=2, differentiable=False,
-          no_trace=True)
+@register("_contrib_calibrate_entropy", num_inputs=2, num_outputs=2,
+          differentiable=False, no_trace=True)
 def _calibrate_entropy(hist, hist_edges, num_quantized_bins=255):
     """KL-optimal quantization threshold from a histogram
     (src/operator/quantization/calibrate.cc) — delegates to the

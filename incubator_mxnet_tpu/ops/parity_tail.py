@@ -627,12 +627,19 @@ def _make_kl_sparse_reg():
         return x, (rho_hat, sparseness_target, penalty, x.shape[0])
 
     def bwd(res, g):
-        rho_hat, rho, penalty, n = res
+        # coerce residuals: the eager-jit invoke path can hand them back as
+        # frontend array wrappers without operator overloads (JAX 0.9
+        # literal handling) — jnp.asarray restores jnp semantics
+        rho_hat = jnp.asarray(res[0])
+        rho = jnp.asarray(res[1])
+        penalty = jnp.asarray(res[2])
+        n = res[3]
+        g = jnp.asarray(g)
         # d/dx sum KL(rho || rho_hat(x)) with rho_hat = mean over batch:
         # (-rho/rho_hat + (1-rho)/(1-rho_hat)) / n per element
         kl_grad = (penalty / n) * (-rho / rho_hat + (1 - rho) / (1 - rho_hat))
         return (g + jnp.broadcast_to(kl_grad, g.shape),
-                jnp.zeros_like(res[1]), jnp.zeros_like(res[2]), None)
+                jnp.zeros_like(rho), jnp.zeros_like(penalty), None)
 
     f.defvjp(fwd, bwd)
     return f
@@ -653,8 +660,7 @@ def _identity_attach_kl_sparse_reg(data, sparseness_target=0.1, penalty=0.001,
                           float(momentum))
 
 
-@register("_contrib_hawkesll", num_inputs=7, num_outputs=2,
-          differentiable=False)
+@register("_contrib_hawkesll", num_inputs=7, num_outputs=2)
 def _hawkesll(mu, alpha, beta, lags, marks, valid_length=None,
               max_time=None):
     """Log-likelihood of a multivariate Hawkes process with exponential

@@ -1,0 +1,479 @@
+"""Pallas fused ghost batch norm (+ReLU, +residual-add) for TPU.
+
+The north-star ResNet-50 train step is HBM-bound (docs/PERF.md): XLA runs
+BatchNorm as separate full passes over each conv output — a stats
+reduction read, a normalize+activation read+write in fwd, and a reduce
+pass plus an elementwise pass in bwd (23 ms/step of
+`convert_reduce_fusion` at batch 256).  These kernels keep a slab of the
+activation resident in VMEM and do
+
+* fwd:  statistics + normalize + (residual add) + ReLU in ONE read of X,
+* bwd:  the dgamma/dbeta reductions AND dX (+ residual grad) in one
+        read of (dY, X),
+
+cutting ~2 full HBM passes per BatchNorm layer.
+
+The price is *ghost* statistics: mean/var are computed per group of
+images (the slab must fit VMEM), not over the whole local batch.  This
+matches the per-device semantics of the distributed north-star row
+(`dist_sync_device` computes BN stats per worker over batch/N_workers in
+the reference — `src/operator/nn/batch_norm.cc` never reduces stats
+across devices), and ghost/sub-batch BN is a standard, documented
+technique; it is exposed as an explicit opt-in (`ghost_bn` on the model
+zoo / `group` here), never a silent default.
+
+Layout (the whole game — a wrong view forces XLA to insert full-tensor
+transposes around the custom call):
+
+* C >= 128: X viewed as (L, N, C), L = H*W.  The conv's TPU layout for
+  these tensors is {1,0,3,2} (minor dims C, N) == row-major (H, W, N, C)
+  — a bitcast.  Channels ride the 128 lanes; the ghost group is a
+  sublane block of N (multiples of 16 for bf16, so windows don't pad).
+* C < 128: X viewed as (L, C, N).  XLA lays small-C tensors out as
+  {0,1,3,2} (minor dims N, C) == row-major (H, W, C, N) — also a
+  bitcast.  Channels ride sublanes; the ghost group is the lane block
+  of N (=128): an even larger statistics group.
+
+Layers whose windows can't fit VMEM (the 112x112 stem, the 56x56
+residual exits) fall back to an equivalent jnp formulation with the same
+ghost statistics.
+
+Interpret mode runs the same kernels on CPU for tests, like
+parallel/flash_attention.py.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_I0 = np.int32(0)  # index-map literal pinned to i32 (package enables x64)
+
+__all__ = ["ghost_bn_act", "ghost_bn_stats_merge"]
+
+_VMEM_KERNEL_LIMIT = 100 * 1024 * 1024
+_WINDOW_BUDGET = 96 * 1024 * 1024
+
+
+def _use_interpret():
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True
+
+
+def _rup(x, m):
+    return -(-x // m) * m
+
+
+def _sublane(itemsize):
+    return 16 if itemsize == 2 else 8
+
+
+def _pick_lnc(n, c, l, itemsize, group=0, slab_budget=8 * 1024 * 1024):
+    """(L, N, C) view blocks: lane dim = channel block (128 or C), sublane
+    = ghost group (multiples of the dtype tile so windows don't pad; the
+    user group is a CAP — large-L layers fall back to smaller groups)."""
+    cb = c if (c <= 128 or c % 128) else 128
+    sub = _sublane(itemsize)
+    cap = group if group else 32
+    ngs = [g for g in range(cap, sub - 1, -sub)
+           if n % g == 0 and g % sub == 0]
+    if n % min(n, cap) == 0 and min(n, cap) not in ngs:
+        ngs.append(min(n, cap))  # small batches: ng == n is always legal
+    for ng in ngs:
+        if ng * cb * l * itemsize <= slab_budget:
+            return ng, cb
+    if ngs:
+        return ngs[-1], cb
+    raise ValueError("no feasible ghost group for N=%d C=%d L=%d group=%d"
+                     % (n, c, l, group))
+
+
+def _pick_lcn(n, c, l, itemsize, slab_budget=8 * 1024 * 1024):
+    """(L, C, N) view blocks for C < 128: lane dim = batch block (the
+    ghost group, = min(N, 128)), sublane = channel block."""
+    nb = min(n, 128)
+    while n % nb:
+        nb //= 2
+    sub = _sublane(itemsize)
+    cb = min(c, max(sub, (slab_budget // (nb * l * itemsize)) // sub * sub))
+    while c % cb or cb % sub:
+        cb -= sub
+        if cb <= 0:
+            return None
+    return cb, nb
+
+
+# ---------------------------------------------------------------------------
+# kernels (parameterized by which block axis carries channels)
+# ---------------------------------------------------------------------------
+# Block shape is (L, A, B); ch_axis 2 means channels on B (lanes, LNC
+# view), ch_axis 1 means channels on A (sublanes, LCN view).  Reductions
+# run over the other two axes; scoped-VMEM stack limits (~16 MB) force
+# chunked loops over L instead of whole-slab f32 temps.
+
+
+def _chunk(l, a, b, budget=1536 * 1024):
+    lc = max(1, budget // (a * b * 4))
+    lc = min(lc, l)
+    while l % lc:
+        lc -= 1
+    return lc
+
+
+def _bshape(vec, ch_axis):
+    return vec[None, :, None] if ch_axis == 1 else vec[None, None, :]
+
+
+def _fwd_kernel(x_ref, g_ref, b_ref, y_ref, m_ref, v_ref, *, eps, act, lc,
+                ch_axis, r_ref=None):
+    l, a, b = x_ref.shape
+    k = l // lc
+    cnt = l * (b if ch_axis == 1 else a)
+    nch = a if ch_axis == 1 else b
+
+    # per-chunk reduce only over the major (L) axis into an (A, B) f32
+    # accumulator — cross-sublane/lane reduction happens ONCE at the end
+    # (per-chunk cross reduces were the VPU bottleneck)
+    def red(i, acc):
+        s, ss = acc
+        xc = x_ref[pl.ds(i * jnp.int32(lc), lc)].astype(jnp.float32)
+        return s + jnp.sum(xc, axis=0), ss + jnp.sum(xc * xc, axis=0)
+    zero = jnp.zeros((a, b), jnp.float32)
+    sm, ssq = jax.lax.fori_loop(jnp.int32(0), jnp.int32(k), red,
+                                (zero, zero))
+    cross = 1 if ch_axis == 1 else 0
+    sm = jnp.sum(sm, axis=cross)
+    ssq = jnp.sum(ssq, axis=cross)
+    m = sm / cnt
+    v = jnp.maximum(ssq / cnt - m * m, 0.0)
+    rstd = jax.lax.rsqrt(v + eps)
+    g = g_ref[...].reshape(-1).astype(jnp.float32)
+    bb = b_ref[...].reshape(-1).astype(jnp.float32)
+    scale = _bshape(g * rstd, ch_axis)
+    shift = _bshape(bb - m * g * rstd, ch_axis)
+
+    def norm(i, _):
+        sl = pl.ds(i * jnp.int32(lc), lc)
+        y = x_ref[sl].astype(jnp.float32) * scale + shift
+        if r_ref is not None:
+            y = y + r_ref[sl].astype(jnp.float32)
+        if act == "relu":
+            y = jnp.maximum(y, 0.0)
+        y_ref[sl] = y.astype(y_ref.dtype)
+        return jnp.int32(0)
+    jax.lax.fori_loop(jnp.int32(0), jnp.int32(k), norm, jnp.int32(0))
+    m_ref[...] = m.reshape(m_ref.shape)
+    v_ref[...] = v.reshape(v_ref.shape)
+
+
+def _fwd_kernel_res(x_ref, r_ref, g_ref, b_ref, y_ref, m_ref, v_ref, *,
+                    eps, act, lc, ch_axis):
+    _fwd_kernel(x_ref, g_ref, b_ref, y_ref, m_ref, v_ref, eps=eps, act=act,
+                lc=lc, ch_axis=ch_axis, r_ref=r_ref)
+
+
+def _bwd_kernel(gy_ref, x_ref, g_ref, b_ref, m_ref, v_ref, dx_ref, dg_ref,
+                db_ref, *, eps, act, lc, ch_axis, y_ref=None, dr_ref=None):
+    l, a, b = x_ref.shape
+    k = l // lc
+    cnt = l * (b if ch_axis == 1 else a)
+    m = m_ref[...].reshape(-1)
+    v = v_ref[...].reshape(-1)
+    rstd = jax.lax.rsqrt(v + eps)
+    g = g_ref[...].reshape(-1).astype(jnp.float32)
+    bb = b_ref[...].reshape(-1).astype(jnp.float32) if b_ref is not None \
+        else None
+    mb = _bshape(m, ch_axis)
+    rb = _bshape(rstd, ch_axis)
+    gb = _bshape(g, ch_axis)
+
+    def masked(sl, gyc, xhat):
+        if act != "relu":
+            return gyc
+        if y_ref is not None:
+            return jnp.where(y_ref[sl].astype(jnp.float32) > 0, gyc, 0.0)
+        pre = xhat * gb + _bshape(bb, ch_axis)
+        return jnp.where(pre > 0, gyc, 0.0)
+
+    def red(i, acc):
+        sdb, sdg = acc
+        sl = pl.ds(i * jnp.int32(lc), lc)
+        xhat = (x_ref[sl].astype(jnp.float32) - mb) * rb
+        gp = masked(sl, gy_ref[sl].astype(jnp.float32), xhat)
+        return sdb + jnp.sum(gp, axis=0), sdg + jnp.sum(gp * xhat, axis=0)
+    zero = jnp.zeros((a, b), jnp.float32)
+    db, dg = jax.lax.fori_loop(jnp.int32(0), jnp.int32(k), red, (zero, zero))
+    cross = 1 if ch_axis == 1 else 0
+    db = jnp.sum(db, axis=cross)
+    dg = jnp.sum(dg, axis=cross)
+    dbb = _bshape(db, ch_axis)
+    dgb = _bshape(dg, ch_axis)
+
+    def wr(i, _):
+        sl = pl.ds(i * jnp.int32(lc), lc)
+        xhat = (x_ref[sl].astype(jnp.float32) - mb) * rb
+        gp = masked(sl, gy_ref[sl].astype(jnp.float32), xhat)
+        dx = gb * rb * (gp - (dbb + xhat * dgb) / cnt)
+        dx_ref[sl] = dx.astype(dx_ref.dtype)
+        if dr_ref is not None:
+            dr_ref[sl] = gp.astype(dr_ref.dtype)
+        return jnp.int32(0)
+    jax.lax.fori_loop(jnp.int32(0), jnp.int32(k), wr, jnp.int32(0))
+    dg_ref[...] = dg.reshape(dg_ref.shape)
+    db_ref[...] = db.reshape(db_ref.shape)
+
+
+def _bwd_kernel_res(gy_ref, x_ref, y_ref, g_ref, m_ref, v_ref, dx_ref,
+                    dg_ref, db_ref, dr_ref, *, eps, act, lc, ch_axis):
+    # residual variant: the post-add ReLU mask comes from the saved OUTPUT
+    # (y > 0 iff pre+res > 0), so the residual tensor itself is not re-read
+    _bwd_kernel(gy_ref, x_ref, g_ref, None, m_ref, v_ref, dx_ref, dg_ref,
+                db_ref, eps=eps, act=act, lc=lc, ch_axis=ch_axis,
+                y_ref=y_ref, dr_ref=dr_ref)
+
+
+# ---------------------------------------------------------------------------
+# pallas_call plumbing
+# ---------------------------------------------------------------------------
+
+
+def _specs(l, n, c, ab, ch_axis):
+    """Block specs for the (L, A, B) view.  ab = (A-block, B-block).
+    Grid is (groups, channel-blocks); channel params/stats use the
+    'equal-dim trick' shapes so small channel blocks stay legal."""
+    a_blk, b_blk = ab
+    if ch_axis == 2:   # LNC: A=N (groups on sublanes), B=C
+        xspec = pl.BlockSpec((l, a_blk, b_blk), lambda g, ci: (_I0, g, ci))
+        pspec = pl.BlockSpec((1, b_blk), lambda g, ci: (_I0, ci))
+        sspec = pl.BlockSpec((1, 1, b_blk), lambda g, ci: (g, _I0, ci))
+        n_groups = n // a_blk
+        pshape = (1, c)
+        sshape = (n_groups, 1, c)
+    else:              # LCN: A=C (channels on sublanes), B=N (groups)
+        xspec = pl.BlockSpec((l, a_blk, b_blk), lambda g, ci: (_I0, ci, g))
+        pspec = pl.BlockSpec((a_blk, 1), lambda g, ci: (ci, _I0))
+        sspec = pl.BlockSpec((1, a_blk, 1), lambda g, ci: (g, ci, _I0))
+        n_groups = n // b_blk
+        pshape = (c, 1)
+        sshape = (n_groups, c, 1)
+    return xspec, pspec, sspec, n_groups, pshape, sshape
+
+
+def _call_fwd(x_v, gamma, beta, residual, eps, act, ab, ch_axis):
+    l = x_v.shape[0]
+    n = x_v.shape[1] if ch_axis == 2 else x_v.shape[2]
+    c = x_v.shape[2] if ch_axis == 2 else x_v.shape[1]
+    xspec, pspec, sspec, ngroups, pshape, sshape = _specs(l, n, c, ab,
+                                                          ch_axis)
+    grid = (ngroups, c // (ab[1] if ch_axis == 2 else ab[0]))
+    lc = _chunk(l, *ab)
+    out_shape = [jax.ShapeDtypeStruct(x_v.shape, x_v.dtype),
+                 jax.ShapeDtypeStruct(sshape, jnp.float32),
+                 jax.ShapeDtypeStruct(sshape, jnp.float32)]
+    if residual is None:
+        kern = functools.partial(_fwd_kernel, eps=eps, act=act, lc=lc,
+                                 ch_axis=ch_axis)
+        in_specs = [xspec, pspec, pspec]
+        args = (x_v, gamma.reshape(pshape), beta.reshape(pshape))
+    else:
+        kern = functools.partial(_fwd_kernel_res, eps=eps, act=act, lc=lc,
+                                 ch_axis=ch_axis)
+        in_specs = [xspec, xspec, pspec, pspec]
+        args = (x_v, residual, gamma.reshape(pshape), beta.reshape(pshape))
+    y, m, v = pl.pallas_call(
+        kern, grid=grid, in_specs=in_specs,
+        out_specs=[xspec, sspec, sspec], out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel"),
+            vmem_limit_bytes=_VMEM_KERNEL_LIMIT),
+        interpret=_use_interpret())(*args)
+    return y, m.reshape(ngroups, c), v.reshape(ngroups, c)
+
+
+def _call_bwd(gy, x_v, y_v, gamma, beta, m, v, eps, act, ab, ch_axis):
+    l = x_v.shape[0]
+    n = x_v.shape[1] if ch_axis == 2 else x_v.shape[2]
+    c = x_v.shape[2] if ch_axis == 2 else x_v.shape[1]
+    xspec, pspec, sspec, ngroups, pshape, sshape = _specs(l, n, c, ab,
+                                                          ch_axis)
+    grid = (ngroups, c // (ab[1] if ch_axis == 2 else ab[0]))
+    lc = _chunk(l, *ab)
+    dstat = jax.ShapeDtypeStruct(sshape, jnp.float32)
+    m_s = m.reshape(sshape)
+    v_s = v.reshape(sshape)
+    if y_v is None:
+        kern = functools.partial(_bwd_kernel, eps=eps, act=act, lc=lc,
+                                 ch_axis=ch_axis)
+        dx, dg, db = pl.pallas_call(
+            kern, grid=grid,
+            in_specs=[xspec, xspec, pspec, pspec, sspec, sspec],
+            out_specs=[xspec, sspec, sspec],
+            out_shape=[jax.ShapeDtypeStruct(x_v.shape, x_v.dtype), dstat,
+                       dstat],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel"),
+                vmem_limit_bytes=_VMEM_KERNEL_LIMIT),
+            interpret=_use_interpret())(
+            gy, x_v, gamma.reshape(pshape), beta.reshape(pshape), m_s, v_s)
+        dr = None
+    else:
+        kern = functools.partial(_bwd_kernel_res, eps=eps, act=act, lc=lc,
+                                 ch_axis=ch_axis)
+        dx, dg, db, dr = pl.pallas_call(
+            kern, grid=grid,
+            in_specs=[xspec, xspec, xspec, pspec, sspec, sspec],
+            out_specs=[xspec, sspec, sspec, xspec],
+            out_shape=[jax.ShapeDtypeStruct(x_v.shape, x_v.dtype), dstat,
+                       dstat, jax.ShapeDtypeStruct(x_v.shape, x_v.dtype)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel"),
+                vmem_limit_bytes=_VMEM_KERNEL_LIMIT),
+            interpret=_use_interpret())(
+            gy, x_v, y_v, gamma.reshape(pshape), m_s, v_s)
+    return (dx, dg.reshape(ngroups, c).sum(0), db.reshape(ngroups, c).sum(0),
+            dr)
+
+
+# ---------------------------------------------------------------------------
+# plan selection + views
+# ---------------------------------------------------------------------------
+
+
+def _plan(n, c, l, itemsize, group, has_res):
+    """Choose (ch_axis, (A-block, B-block)) or None for jnp fallback.
+    The bwd window budget decides: Mosaic double-buffers every window and
+    pads sublanes to the dtype tile and lanes to 128; the residual bwd has
+    5 big windows, the plain one 3."""
+    sub = _sublane(itemsize)
+    windows = 5 if has_res else 3
+
+    def fits(a_blk, b_blk):
+        padded = l * _rup(a_blk, sub) * _rup(b_blk, 128) * itemsize
+        return windows * 2 * padded <= _WINDOW_BUDGET
+
+    if c >= 128:
+        ng, cb = _pick_lnc(n, c, l, itemsize, group=group)
+        if fits(ng, cb):
+            return 2, (ng, cb)
+        return None
+    blocks = _pick_lcn(n, c, l, itemsize)
+    if blocks is not None and fits(*blocks):
+        return 1, blocks
+    return None
+
+
+def _to_view(x, ch_axis):
+    n, c, h, w = x.shape
+    if ch_axis == 2:   # (L, N, C): bitcast of layout {1,0,3,2}
+        return jnp.transpose(x, (2, 3, 0, 1)).reshape(h * w, n, c)
+    # (L, C, N): bitcast of layout {0,1,3,2}
+    return jnp.transpose(x, (2, 3, 1, 0)).reshape(h * w, c, n)
+
+
+def _from_view(x_v, shape, ch_axis):
+    n, c, h, w = shape
+    if ch_axis == 2:
+        return jnp.transpose(x_v.reshape(h, w, n, c), (2, 3, 0, 1))
+    return jnp.transpose(x_v.reshape(h, w, c, n), (3, 2, 0, 1))
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp public entry
+# ---------------------------------------------------------------------------
+
+
+def _gbn_fwd(x, gamma, beta, residual, eps, act, group):
+    n, c, h, w = x.shape
+    ch_axis, ab = _plan(n, c, h * w, x.dtype.itemsize, group,
+                        residual is not None)
+    x_v = _to_view(x, ch_axis)
+    r_v = None if residual is None else _to_view(residual, ch_axis)
+    y_v, m, v = _call_fwd(x_v, gamma, beta, r_v, eps, act, ab, ch_axis)
+    y = _from_view(y_v, x.shape, ch_axis)
+    res = (x_v, y_v if residual is not None else None, gamma, beta, m, v,
+           x.shape)
+    return ((y, m, v), res)
+
+
+def _gbn_bwd(eps, act, group, res, ct):
+    x_v, y_v, gamma, beta, m, v, shape = res
+    gy, _, _ = ct  # cotangents for the stat outputs are not propagated
+    n, c, h, w = shape
+    ch_axis, ab = _plan(n, c, h * w, x_v.dtype.itemsize, group,
+                        y_v is not None)
+    gy_v = _to_view(gy, ch_axis)
+    dx, dg, db, dr = _call_bwd(gy_v, x_v, y_v, gamma, beta, m, v, eps, act,
+                               ab, ch_axis)
+    dx = _from_view(dx, shape, ch_axis)
+    dr = None if dr is None else _from_view(dr, shape, ch_axis)
+    return (dx, dg.astype(gamma.dtype), db.astype(beta.dtype), dr)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _gbn_full(x, gamma, beta, residual, eps, act, group):
+    """Returns (y, group_mean, group_var) — stat outputs get zero vjp."""
+    return _gbn_fwd(x, gamma, beta, residual, eps, act, group)[0]
+
+
+_gbn_full.defvjp(_gbn_fwd, _gbn_bwd)
+
+
+def ghost_bn_stats_merge(m, v):
+    """(G, C) group stats -> (C,) whole-batch population stats via the law
+    of total variance (for running-average updates)."""
+    bm = jnp.mean(m, axis=0)
+    bv = jnp.mean(v + m * m, axis=0) - bm * bm
+    return bm, jnp.maximum(bv, 0.0)
+
+
+def _gbn_ref(x, gamma, beta, residual, eps, act, group):
+    """Pure-jnp ghost BN (same semantics, standard XLA passes) — the
+    fallback for layers whose slab cannot fit the VMEM window budget
+    (e.g. the 112x112 stem at batch 256)."""
+    n, c, h, w = x.shape
+    ng = min(n, group or 32)
+    while n % ng:
+        ng -= 1
+    g = n // ng
+    x32 = x.astype(jnp.float32).reshape(g, ng, c, h, w)
+    m = jnp.mean(x32, axis=(1, 3, 4))
+    v = jnp.maximum(jnp.mean(x32 * x32, axis=(1, 3, 4)) - m * m, 0.0)
+    rstd = jax.lax.rsqrt(v + eps)
+    g32 = gamma.astype(jnp.float32)
+    scale = (g32[None] * rstd)[:, None, :, None, None]
+    shift = (beta.astype(jnp.float32)[None]
+             - m * g32[None] * rstd)[:, None, :, None, None]
+    y = (x32 * scale + shift).reshape(n, c, h, w)
+    if residual is not None:
+        y = y + residual.astype(jnp.float32)
+    if act == "relu":
+        y = jnp.maximum(y, 0.0)
+    return y.astype(x.dtype), m, v
+
+
+def ghost_bn_act(x, gamma, beta, residual=None, eps=1e-3, act="relu",
+                 group=0):
+    """Fused ghost-BN(+residual)+activation.
+
+    x: (N, C, H, W).  Returns ``(y, group_mean, group_var)`` with stats of
+    shape (G, C).  The effective ghost group is chosen per layer shape
+    (the ``group`` argument is a cap for the sublane path; the small-C
+    lane path uses groups of up to 128) — deterministic per shape.
+    Differentiable in x, gamma, beta and residual (stat outputs carry
+    zero gradient — they feed running-stat updates, which the reference
+    likewise excludes from autograd, ``src/operator/nn/batch_norm.cc``
+    aux states).  Layers whose windows can't fit the VMEM budget use an
+    equivalent jnp formulation.
+    """
+    n, c, h, w = x.shape
+    if _plan(n, c, h * w, x.dtype.itemsize, int(group),
+             residual is not None) is None:
+        return _gbn_ref(x, gamma, beta, residual, float(eps), act,
+                        int(group))
+    return _gbn_full(x, gamma, beta, residual, float(eps), act, int(group))

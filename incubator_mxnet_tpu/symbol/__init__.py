@@ -52,6 +52,9 @@ def _compose_num_outputs(opname, attrs):
     if opname == "RNN":
         return 3 if attrs.get("mode", "lstm") == "lstm" and attrs.get(
             "state_outputs") else (2 if attrs.get("state_outputs") else 1)
+    if opname in ("_npi_average", "average") and str(
+            attrs.get("returned", "False")).lower() not in ("false", "0"):
+        return 2
     if opname == "amp_multicast":
         return int(attrs.get("num_outputs", 1))
     if opname in ("_linalg_slogdet", "linalg_slogdet", "batch_norm_stats",

@@ -1,0 +1,156 @@
+"""Fused ghost-BN Pallas kernels (parallel/fused_bn.py) and the resnet
+perf variants (s2d stem, ghost_bn blocks) — CPU interpret-mode tests.
+
+Reference semantics: BatchNorm (src/operator/nn/batch_norm.cc) with
+group (ghost) statistics; at group == N the result must equal stock
+BatchNorm + ReLU exactly.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.parallel.fused_bn import (ghost_bn_act,
+                                                   ghost_bn_stats_merge)
+
+
+def _ref(x, gamma, beta, residual=None, eps=1e-3, group=4):
+    n, c, h, w = x.shape
+    g = n // group
+    xg = x.astype(jnp.float32).reshape(g, group, c, h, w)
+    m = xg.mean(axis=(1, 3, 4))
+    v = ((xg - m[:, None, :, None, None]) ** 2).mean(axis=(1, 3, 4))
+    y = ((xg - m[:, None, :, None, None])
+         * jax.lax.rsqrt(v + eps)[:, None, :, None, None])
+    y = (y * gamma[None, None, :, None, None]
+         + beta[None, None, :, None, None]).reshape(n, c, h, w)
+    if residual is not None:
+        y = y + residual.astype(jnp.float32)
+    return jnp.maximum(y, 0.0).astype(x.dtype), m, v
+
+
+@pytest.mark.parametrize("c,kernel_group", [(256, 4), (64, 8)])
+def test_ghost_bn_fwd_bwd_matches_reference(c, kernel_group):
+    # c=256 exercises the lane-channel (LNC) kernel; c=64 the
+    # sublane-channel (LCN) kernel whose group is the full lane block
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.normal(size=(8, c, 6, 6)).astype(np.float32))
+    res = jnp.asarray(rng.normal(size=(8, c, 6, 6)).astype(np.float32))
+    gamma = jnp.asarray(rng.uniform(0.5, 1.5, c).astype(np.float32))
+    beta = jnp.asarray(rng.normal(size=c).astype(np.float32) * 0.2)
+    residuals = (None, res) if c >= 128 else (None,)
+    for residual in residuals:
+        y, m, v = ghost_bn_act(x, gamma, beta, residual=residual, group=4)
+        yr, mr, vr = _ref(x, gamma, beta, residual=residual,
+                          group=kernel_group)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                                   rtol=3e-5, atol=3e-5)
+        np.testing.assert_allclose(np.asarray(m), np.asarray(mr),
+                                   rtol=1e-4, atol=1e-5)
+
+        def lk(x, gamma, beta, r):
+            y, _, _ = ghost_bn_act(x, gamma, beta, residual=r, group=4)
+            return (y * jnp.cos(jnp.arange(y.size).reshape(y.shape))).sum()
+
+        def lr(x, gamma, beta, r):
+            y, _, _ = _ref(x, gamma, beta, residual=r, group=kernel_group)
+            return (y * jnp.cos(jnp.arange(y.size).reshape(y.shape))).sum()
+
+        argn = (0, 1, 2) if residual is None else (0, 1, 2, 3)
+        gk = jax.grad(lk, argnums=argn)(x, gamma, beta, residual)
+        gr = jax.grad(lr, argnums=argn)(x, gamma, beta, residual)
+        for a, b in zip(gk, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-4)
+
+
+def test_ghost_bn_stats_merge_equals_full_batch():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.normal(size=(8, 32, 5, 5)).astype(np.float32))
+    gamma = jnp.ones(32, jnp.float32)
+    beta = jnp.zeros(32, jnp.float32)
+    _, m, v = ghost_bn_act(x, gamma, beta, group=4)
+    bm, bv = ghost_bn_stats_merge(m, v)
+    np.testing.assert_allclose(np.asarray(bm),
+                               np.asarray(x.mean(axis=(0, 2, 3))),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(bv),
+                               np.asarray(x.var(axis=(0, 2, 3))),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ghost_bn_block_matches_batchnorm_at_full_group():
+    """GhostBNReLU(group=N) == BatchNorm + relu exactly (output, grads,
+    running stats)."""
+    from incubator_mxnet_tpu.gluon.model_zoo.vision.resnet import GhostBNReLU
+
+    mx.random.seed(0)
+    gbn = GhostBNReLU(group=8, epsilon=1e-3)
+    gbn.initialize()
+    gbn.shape_init((1, 16, 5, 5))
+    bn = nn.BatchNorm(epsilon=1e-3)
+    bn.initialize()
+    bn.shape_init((1, 16, 5, 5))
+    x = nd.random.uniform(shape=(8, 16, 5, 5))
+    x.attach_grad()
+    with autograd.record():
+        y = gbn(x)
+        (y * y).sum().backward()
+    g1 = x.grad.asnumpy().copy()
+    x2 = nd.array(x.asnumpy())
+    x2.attach_grad()
+    with autograd.record():
+        y2 = nd.relu(nd.BatchNorm(x2, bn.gamma.data(), bn.beta.data(),
+                                  bn.running_mean.data(),
+                                  bn.running_var.data(), eps=1e-3))
+        (y2 * y2).sum().backward()
+    np.testing.assert_allclose(y.asnumpy(), y2.asnumpy(), rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(g1, x2.grad.asnumpy(), rtol=1e-3, atol=1e-4)
+    assert np.abs(gbn.running_mean.data().asnumpy()).sum() > 0
+
+
+def test_resnet50_ghost_bn_trains_and_updates_stats():
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    from incubator_mxnet_tpu.parallel import make_train_step
+
+    mx.random.seed(0)
+    net = vision.resnet50_v1(classes=10, ghost_bn=8)
+    net.initialize(init=mx.init.Xavier())
+    net.shape_init((1, 3, 32, 32))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = make_train_step(net, loss_fn, optimizer="sgd",
+                           learning_rate=0.01, momentum=0.9)
+    x = nd.random.uniform(shape=(8, 3, 32, 32))
+    y = nd.array(np.random.RandomState(0).randint(0, 10, 8)
+                 .astype(np.float32))
+    losses = [float(step(x, y).asscalar()) for _ in range(6)]
+    assert min(losses[2:]) < losses[0]
+    rm = net.features[1].running_mean.data().asnumpy()
+    assert np.abs(rm).sum() > 0
+    # eval-mode forward uses moving stats
+    out = net(x)
+    assert out.shape == (8, 10)
+
+
+def test_s2d_stem_exact():
+    """Space-to-depth stem == the 7x7/s2 conv exactly (same weights)."""
+    from incubator_mxnet_tpu.gluon.model_zoo.vision.resnet import \
+        _S2DStemConv
+
+    mx.random.seed(0)
+    conv = nn.Conv2D(16, 7, 2, 3, use_bias=False, in_channels=3)
+    conv.initialize(init=mx.init.Xavier())
+    conv.shape_init((1, 3, 64, 64))
+    s2d = _S2DStemConv(16)
+    s2d.initialize()
+    s2d.shape_init((1, 3, 64, 64))
+    s2d.weight.set_data(conv.weight.data())
+    x = nd.random.uniform(shape=(2, 3, 64, 64))
+    np.testing.assert_allclose(conv(x).asnumpy(), s2d(x).asnumpy(),
+                               rtol=1e-5, atol=1e-5)

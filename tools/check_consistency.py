@@ -83,6 +83,69 @@ def _tol(dtype, name):
     return {"float32": (1e-4, 1e-5), "bfloat16": (5e-2, 5e-3)}[dtype]
 
 
+_SWEEP_MXU = ("FullyConnected", "dot", "Dot", "batch_dot", "Convolution",
+              "Deconvolution", "Correlation", "_contrib_interleaved_matmul",
+              "_npi_einsum", "_npi_tensordot", "_npi_matmul", "_npi_dot",
+              "_npi_vdot", "_npi_inner", "_npi_outer", "_npi_kron", "RNN",
+              "_linalg_gemm", "_linalg_trmm", "_linalg_trsm", "_linalg_syrk",
+              "_contrib_DeformableConvolution", "khatri_rao",
+              "_npi_tensorinv", "_npi_tensorsolve", "_contrib_quantized")
+
+
+def _sweep_tol(opname):
+    if any(opname.startswith(p) or opname == p for p in _SWEEP_MXU):
+        return 2e-2, 1e-2
+    return 1e-4, 1e-5
+
+
+def run_registry_sweep(jax, jnp, reg, cpu_dev, tpu_dev, failures):
+    """Full-registry TPU-vs-CPU forward battery over the reflection-
+    synthesized cases (tools/op_sweep.py) — every op with a synthesizable
+    signature executes on the TPU backend, not just the curated battery.
+    Host-eval (no_trace) ops run on the host by construction and are
+    skipped; skips are counted, never silent."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from op_sweep import build_cases
+
+    cases, uncovered = build_cases()
+    n = 0
+    skipped = list(uncovered)
+    for name in sorted(cases):
+        op = reg.get_op(name)
+        if op.no_trace:
+            skipped.append(name)
+            continue
+        arrays, attrs = cases[name]
+        attrs = dict(attrs)
+        if attrs.get("key") == "sweep" or op.needs_rng:
+            attrs["key"] = jax.random.PRNGKey(11)
+        rtol, atol = _sweep_tol(name)
+        try:
+            outs = {}
+            for tag, dev in (("cpu", cpu_dev), ("tpu", tpu_dev)):
+                args = [jax.device_put(jnp.asarray(a), dev) for a in arrays]
+                key = attrs.get("key")
+                if key is not None:
+                    attrs["key"] = jax.device_put(key, dev)
+                o = jax.jit(lambda *xs: op.fn(*xs, **attrs))(*args)
+                outs[tag] = o if isinstance(o, (tuple, list)) else (o,)
+            for oc, ot in zip(outs["cpu"], outs["tpu"]):
+                ref = np.asarray(oc, np.float32)
+                got = np.asarray(ot, np.float32)
+                scale = float(np.abs(ref).max()) if ref.size else 1.0
+                np.testing.assert_allclose(ref, got, rtol=rtol,
+                                           atol=atol * max(scale, 1.0))
+            n += 1
+        except AssertionError as e:
+            failures.append(("sweep:" + name, "float32",
+                             str(e).split("\n")[0]))
+        except Exception:
+            failures.append(("sweep:" + name, "float32",
+                             traceback.format_exc(limit=1).strip()
+                             .replace("\n", " ")))
+    return n, skipped
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -154,7 +217,11 @@ def main():
         failures.append(("flash_attention", "float32",
                          str(e).split("\n")[0]))
 
-    result = {"checked": n_checked, "failures": len(failures)}
+    n_sweep, sweep_skipped = run_registry_sweep(jax, jnp, reg, cpu_dev,
+                                                tpu_dev, failures)
+    result = {"checked": n_checked, "sweep_ops": n_sweep,
+              "sweep_skipped": sorted(sweep_skipped),
+              "failures": len(failures)}
     if failures:
         for name, dtype, msg in failures:
             print("FAIL %s[%s]: %s" % (name, dtype, msg), file=sys.stderr)
