@@ -10,6 +10,7 @@
 #define MXTPU_C_API_H_
 
 #include <stdint.h>
+#include <stdbool.h>
 
 #ifdef __cplusplus
 extern "C" {
@@ -129,6 +130,111 @@ int MXPredGetOutputShape(PredictorHandle h, uint32_t index,
 int MXPredGetOutput(PredictorHandle h, uint32_t index, float* data,
                     uint32_t size);
 int MXPredFree(PredictorHandle h);
+
+
+/* Autograd (c_api.h MXAutograd* block) ---------------------------------- */
+int MXAutogradSetIsRecording(int is_recording, int* prev);
+int MXAutogradSetIsTraining(int is_training, int* prev);
+int MXAutogradIsRecording(bool* curr);
+int MXAutogradIsTraining(bool* curr);
+int MXAutogradMarkVariables(uint32_t num_var, NDArrayHandle* var_handles,
+                            uint32_t* reqs_array,
+                            NDArrayHandle* grad_handles);
+int MXAutogradBackward(uint32_t num_output, NDArrayHandle* output_handles,
+                       NDArrayHandle* ograd_handles, int retain_graph);
+int MXAutogradBackwardEx(uint32_t num_output, NDArrayHandle* output_handles,
+                         NDArrayHandle* ograd_handles,
+                         uint32_t num_variables, NDArrayHandle* var_handles,
+                         int retain_graph, int create_graph, int is_train,
+                         NDArrayHandle** grad_handles, int** grad_stypes);
+int MXAutogradComputeGradient(uint32_t num_output,
+                              NDArrayHandle* output_handles);
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle* out);
+int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle* out);
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, int* dims,
+                     NDArrayHandle* out);
+int MXNDArraySlice(NDArrayHandle handle, uint32_t begin, uint32_t end,
+                   NDArrayHandle* out);
+int MXNDArrayAt(NDArrayHandle handle, uint32_t idx, NDArrayHandle* out);
+int MXNDArrayGetContext(NDArrayHandle handle, int* out_dev_type,
+                        int* out_dev_id);
+
+/* KVStore (c_api.h MXKVStore* block) ------------------------------------ */
+typedef void* KVStoreHandle;
+typedef void(MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                               NDArrayHandle local, void* handle);
+int MXKVStoreCreate(const char* type, KVStoreHandle* out);
+int MXKVStoreFree(KVStoreHandle handle);
+int MXKVStoreInit(KVStoreHandle kv, uint32_t num, const int* keys,
+                  NDArrayHandle* vals);
+int MXKVStoreInitEx(KVStoreHandle kv, uint32_t num, const char** keys,
+                    NDArrayHandle* vals);
+int MXKVStorePush(KVStoreHandle kv, uint32_t num, const int* keys,
+                  NDArrayHandle* vals, int priority);
+int MXKVStorePushEx(KVStoreHandle kv, uint32_t num, const char** keys,
+                    NDArrayHandle* vals, int priority);
+int MXKVStorePull(KVStoreHandle kv, uint32_t num, const int* keys,
+                  NDArrayHandle* vals, int priority);
+int MXKVStorePullEx(KVStoreHandle kv, uint32_t num, const char** keys,
+                    NDArrayHandle* vals, int priority);
+int MXKVStoreGetType(KVStoreHandle kv, const char** type);
+int MXKVStoreGetRank(KVStoreHandle kv, int* rank);
+int MXKVStoreGetGroupSize(KVStoreHandle kv, int* size);
+int MXKVStoreBarrier(KVStoreHandle kv);
+int MXKVStoreIsWorkerNode(int* ret);
+int MXKVStoreIsServerNode(int* ret);
+int MXKVStoreIsSchedulerNode(int* ret);
+int MXKVStoreSetUpdater(KVStoreHandle kv, MXKVStoreUpdater updater,
+                        void* updater_handle);
+
+/* DataIter (c_api.h MXDataIter* block) ---------------------------------- */
+typedef void* DataIterHandle;
+int MXListDataIters(uint32_t* out_size, const char*** out_array);
+int MXDataIterCreateIter(const char* name, uint32_t num_param,
+                         const char** keys, const char** vals,
+                         DataIterHandle* out);
+int MXDataIterFree(DataIterHandle handle);
+int MXDataIterNext(DataIterHandle handle, int* out);
+int MXDataIterBeforeFirst(DataIterHandle handle);
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle* out);
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle* out);
+int MXDataIterGetPadNum(DataIterHandle handle, int* pad);
+int MXDataIterGetIndex(DataIterHandle handle, uint64_t** out_index,
+                       uint64_t* out_size);
+
+/* RecordIO (c_api.h MXRecordIO* block) ---------------------------------- */
+typedef void* RecordIOHandle;
+int MXRecordIOWriterCreate(const char* uri, RecordIOHandle* out);
+int MXRecordIOWriterFree(RecordIOHandle handle);
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char* buf,
+                                size_t size);
+int MXRecordIOWriterTell(RecordIOHandle handle, size_t* pos);
+int MXRecordIOReaderCreate(const char* uri, RecordIOHandle* out);
+int MXRecordIOReaderFree(RecordIOHandle handle);
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, char const** buf,
+                               size_t* size);
+int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos);
+int MXRecordIOReaderTell(RecordIOHandle handle, size_t* pos);
+
+/* CachedOp -------------------------------------------------------------- */
+typedef void* CachedOpHandle;
+int MXCreateCachedOp(SymbolHandle sym, CachedOpHandle* out);
+int MXCreateCachedOpEx(SymbolHandle sym, int num_flags, const char** keys,
+                       const char** vals, CachedOpHandle* out);
+int MXFreeCachedOp(CachedOpHandle handle);
+int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                     NDArrayHandle* inputs, int* num_outputs,
+                     NDArrayHandle** outputs);
+int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
+                       NDArrayHandle* inputs, int* num_outputs,
+                       NDArrayHandle** outputs, const int** out_stypes);
+
+/* Misc runtime ---------------------------------------------------------- */
+int MXRandomSeed(int seed);
+int MXEngineWaitAll(void);
+int MXNotifyShutdown(void);
+int MXSetNumOMPThreads(int n);
+int MXStorageEmptyCache(int dev_type, int dev_id);
 
 #ifdef __cplusplus
 }

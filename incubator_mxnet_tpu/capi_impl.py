@@ -264,3 +264,316 @@ class Predictor:
 def pred_create(symbol_json, param_bytes, input_names, input_shapes):
     return Predictor(symbol_json, param_bytes, list(input_names),
                      [list(s) for s in input_shapes])
+
+
+# ---------------------------------------------------------------------------
+# autograd (MXAutograd* ABI, c_api.h MXAutogradSetIsRecording..BackwardEx)
+# ---------------------------------------------------------------------------
+
+def autograd_set_recording(flag: int) -> int:
+    from . import autograd
+    return int(autograd.set_recording(bool(flag)))
+
+
+def autograd_set_training(flag: int) -> int:
+    from . import autograd
+    return int(autograd.set_training(bool(flag)))
+
+
+def autograd_is_recording() -> int:
+    from . import autograd
+    return int(autograd.is_recording())
+
+
+def autograd_is_training() -> int:
+    from . import autograd
+    return int(autograd.is_training())
+
+
+_GRAD_REQ_CODE = {0: "null", 1: "write", 2: "add"}
+
+
+def autograd_mark_variables(handles, req_codes, grad_handles) -> None:
+    from . import autograd
+    reqs = [_GRAD_REQ_CODE.get(int(c), "write") for c in req_codes]
+    autograd.mark_variables(list(handles), list(grad_handles), reqs)
+
+
+def autograd_backward(out_handles, ograd_handles, retain_graph: bool,
+                      train_mode: bool) -> None:
+    from . import autograd
+    heads = list(out_handles)
+    ograds = None if ograd_handles is None else list(ograd_handles)
+    autograd.backward(heads, ograds, retain_graph=bool(retain_graph),
+                      train_mode=bool(train_mode))
+
+
+def ndarray_get_grad(handle):
+    g = handle.grad
+    if g is None:
+        raise ValueError("no gradient attached (call MXAutogradMarkVariables)")
+    return g
+
+
+def ndarray_detach(handle):
+    return handle.detach()
+
+
+def ndarray_reshape(handle, shape):
+    return handle.reshape(tuple(int(s) for s in shape))
+
+
+def ndarray_slice(handle, begin: int, end: int):
+    return handle[int(begin):int(end)]
+
+
+def ndarray_at(handle, idx: int):
+    return handle[int(idx)]
+
+
+def ndarray_context(handle):
+    ctx = handle.context
+    return int(ctx.device_typeid), int(ctx.device_id)
+
+
+# ---------------------------------------------------------------------------
+# KVStore (MXKVStore* ABI, c_api.h MXKVStoreCreate..SetUpdater)
+# ---------------------------------------------------------------------------
+
+def kvstore_create(type_str: str):
+    from .kvstore import create
+    return create(type_str or "local")
+
+
+def kvstore_init(kv, keys, vals) -> None:
+    kv.init(list(keys), list(vals))
+
+
+def kvstore_push(kv, keys, vals, priority: int) -> None:
+    kv.push(list(keys), list(vals), priority=int(priority))
+
+
+def kvstore_pull(kv, keys, outs, priority: int) -> None:
+    kv.pull(list(keys), out=list(outs), priority=int(priority))
+
+
+def kvstore_type(kv) -> str:
+    return kv.type
+
+
+def kvstore_rank(kv) -> int:
+    return int(kv.rank)
+
+
+def kvstore_group_size(kv) -> int:
+    return int(kv.num_workers)
+
+
+def kvstore_barrier(kv) -> None:
+    if hasattr(kv, "_barrier"):
+        kv._barrier()
+
+
+def kvstore_set_updater(kv, updater) -> None:
+    """updater: python callable (int_key, recv NDArray, local NDArray);
+    the C trampoline wraps the user's MXKVStoreUpdater function pointer."""
+    def _upd(key, recv, local):
+        updater(int(key) if not isinstance(key, str) else key, recv, local)
+    kv.set_updater(_upd)
+
+
+# ---------------------------------------------------------------------------
+# DataIter (MXDataIter* ABI, c_api.h MXListDataIters..MXDataIterGetPadNum)
+# ---------------------------------------------------------------------------
+
+_DATA_ITERS = None
+
+
+def _data_iter_registry():
+    global _DATA_ITERS
+    if _DATA_ITERS is None:
+        from . import io as _io
+        from .io import record_iter as _ri
+        _DATA_ITERS = {
+            "MNISTIter": _ri.MNISTIter,
+            "ImageRecordIter": _ri.ImageRecordIter,
+            "ImageRecordUInt8Iter": _ri.ImageRecordUInt8Iter,
+            "LibSVMIter": _ri.LibSVMIter,
+            "CSVIter": _io.CSVIter,
+        }
+    return _DATA_ITERS
+
+
+def list_data_iters():
+    return sorted(_data_iter_registry())
+
+
+def data_iter_create(name: str, keys, vals):
+    import ast
+    cls = _data_iter_registry()[name]
+    kwargs = {}
+    for k, v in zip(keys, vals):
+        try:
+            kwargs[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            kwargs[k] = v
+    it = cls(**kwargs)
+    it._capi_batch = None
+    return it
+
+
+def data_iter_next(it) -> int:
+    try:
+        it._capi_batch = next(it)
+        return 1
+    except StopIteration:
+        it._capi_batch = None
+        return 0
+
+
+def data_iter_before_first(it) -> None:
+    it.reset()
+    it._capi_batch = None
+
+
+def data_iter_data(it):
+    return it._capi_batch.data[0]
+
+
+def data_iter_label(it):
+    return it._capi_batch.label[0]
+
+
+def data_iter_pad(it) -> int:
+    return int(it._capi_batch.pad or 0)
+
+
+def data_iter_index(it):
+    idx = it._capi_batch.index
+    import numpy as _np
+    return b"" if idx is None else _np.asarray(idx, _np.uint64).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# RecordIO (MXRecordIO* ABI, c_api.h MXRecordIOWriterCreate..ReaderSeek)
+# ---------------------------------------------------------------------------
+
+def recordio_writer_create(path: str):
+    from .recordio import MXRecordIO
+    return MXRecordIO(path, "w")
+
+
+def recordio_writer_write(w, data: bytes) -> None:
+    w.write(data)
+
+
+def recordio_writer_tell(w) -> int:
+    return int(w.tell())
+
+
+def recordio_writer_free(w) -> None:
+    w.close()
+
+
+def recordio_reader_create(path: str):
+    from .recordio import MXRecordIO
+    return MXRecordIO(path, "r")
+
+
+def recordio_reader_read(r):
+    out = r.read()
+    return out  # None at EOF
+
+
+def recordio_reader_seek(r, pos: int) -> None:
+    r.seek(int(pos))
+
+
+def recordio_reader_tell(r) -> int:
+    return int(r.tell())
+
+
+def recordio_reader_free(r) -> None:
+    r.close()
+
+
+# ---------------------------------------------------------------------------
+# CachedOp (MXCreateCachedOp/MXInvokeCachedOp ABI)
+# ---------------------------------------------------------------------------
+
+class _CApiCachedOp:
+    """Symbol-backed cached executor keyed on input shapes (the CachedOp
+    contract, src/imperative/cached_op.cc: compile once per signature,
+    replay thereafter)."""
+
+    def __init__(self, symbol):
+        self._symbol = symbol
+        self._execs = {}
+
+    def invoke(self, inputs):
+        from . import ndarray as _nd
+        from .executor import Executor
+
+        arg_names = self._symbol.list_arguments()
+        aux_names = self._symbol.list_auxiliary_states()
+        n_args, n_aux = len(arg_names), len(aux_names)
+        # the reference CachedOp takes list_inputs() = args + aux; accept
+        # the args-only arity too (aux inferred from the arg shapes)
+        if len(inputs) == n_args + n_aux:
+            arg_in, aux_in = inputs[:n_args], inputs[n_args:]
+        elif len(inputs) == n_args:
+            arg_in, aux_in = inputs, None
+        else:
+            raise ValueError(
+                "CachedOp expects %d args%s, got %d inputs"
+                % (n_args, (" (+%d aux)" % n_aux) if n_aux else "",
+                   len(inputs)))
+        key = tuple((tuple(a.shape), str(a.dtype)) for a in inputs)
+        exe = self._execs.get(key)
+        if exe is None:
+            args = {n: _nd.zeros(a.shape, dtype=a.dtype)
+                    for n, a in zip(arg_names, arg_in)}
+            if aux_in is not None:
+                aux = {n: _nd.zeros(a.shape, dtype=a.dtype)
+                       for n, a in zip(aux_names, aux_in)}
+            elif n_aux:
+                shape_kwargs = {n: tuple(a.shape)
+                                for n, a in zip(arg_names, arg_in)}
+                _, _, aux_shapes = self._symbol.infer_shape(**shape_kwargs)
+                aux = {n: _nd.zeros(s)
+                       for n, s in zip(aux_names, aux_shapes)}
+            else:
+                aux = {}
+            exe = Executor(self._symbol, None, args, None, "null", aux)
+            self._execs[key] = exe
+        if aux_in is not None:
+            for n, a in zip(aux_names, aux_in):
+                exe.aux_dict[n]._data = a._data
+        outs = exe.forward(is_train=False,
+                           **dict(zip(arg_names, arg_in)))
+        return list(outs)
+
+
+def cached_op_create(symbol):
+    return _CApiCachedOp(symbol)
+
+
+def cached_op_invoke(op, inputs):
+    return op.invoke(list(inputs))
+
+
+# ---------------------------------------------------------------------------
+# misc runtime (MXRandomSeed, MXEngineWaitAll, ...)
+# ---------------------------------------------------------------------------
+
+def random_seed(seed: int) -> None:
+    from . import rng
+    rng.seed(int(seed))
+
+
+def engine_wait_all() -> None:
+    import jax
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass

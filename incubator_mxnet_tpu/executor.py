@@ -30,9 +30,18 @@ __all__ = ["Executor"]
 
 class Executor:
     def __init__(self, symbol: Symbol, ctx, args, args_grad=None,
-                 grad_req="write", aux_states=None):
+                 grad_req="write", aux_states=None, mesh=None,
+                 batch_args=()):
+        """``mesh``/``batch_args``: data-parallel execution over a device
+        mesh — batch inputs shard along the mesh's ``dp`` axis while
+        parameters stay replicated, and GSPMD inserts the gradient
+        all-reduce (the DataParallelExecutorGroup semantics,
+        ``python/mxnet/module/executor_group.py:282`` decide_slices, as ONE
+        sharded XLA program instead of per-device executor replicas)."""
         self._symbol = symbol
         self._ctx = ctx
+        self._mesh = mesh
+        self._batch_args = frozenset(batch_args)
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
 
@@ -132,8 +141,23 @@ class Executor:
 
         return pure
 
+    def _shardings(self):
+        """(arg_shardings list, aux replicated, key) for the dp mesh."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(self._mesh, P())
+        batch = NamedSharding(self._mesh, P("dp"))
+        arg_sh = [batch if n in self._batch_args else repl
+                  for n in self._arg_names]
+        aux_sh = [repl for _ in self._aux_names]
+        return arg_sh, aux_sh, repl
+
     def _build(self, train: bool):
-        return jax.jit(self._pure(train))
+        if self._mesh is None:
+            return jax.jit(self._pure(train))
+        arg_sh, aux_sh, repl = self._shardings()
+        return jax.jit(self._pure(train),
+                       in_shardings=(arg_sh, aux_sh, repl))
 
     def _build_train_pair(self, grad_args):
         """One-time construction of the cached training programs (the
@@ -175,7 +199,14 @@ class Executor:
             (g_grads,) = vjp_fn((list(cots), wcots))
             return g_grads
 
-        return jax.jit(fwd_train), jax.jit(bwd_custom)
+        if self._mesh is None:
+            return jax.jit(fwd_train), jax.jit(bwd_custom)
+        arg_sh, aux_sh, repl = self._shardings()
+        g_sh = [arg_sh[j] for j in g_idx]
+        return (jax.jit(fwd_train,
+                        in_shardings=(g_sh, arg_sh, aux_sh, repl)),
+                jax.jit(bwd_custom,
+                        in_shardings=(g_sh, arg_sh, aux_sh, repl, None)))
 
     # ------------------------------------------------------------------
     def forward(self, is_train=False, **kwargs):
@@ -294,7 +325,8 @@ class Executor:
             if self.aux_dict.get(n) is not None and \
                     self.aux_dict[n].shape == aux[n].shape:
                 aux[n] = self.aux_dict[n]
-        return Executor(self._symbol, self._ctx, args, grads, self.grad_req, aux)
+        return Executor(self._symbol, self._ctx, args, grads, self.grad_req,
+                        aux, mesh=self._mesh, batch_args=self._batch_args)
 
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):

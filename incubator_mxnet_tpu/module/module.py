@@ -118,8 +118,34 @@ class Module(BaseModule):
         aux = {n: _nd.zeros(s) for n, s in zip(self._aux_names, aux_shapes)}
         from ..executor import Executor
 
+        mesh = None
+        if len(self._context) > 1:
+            # multi-device data parallelism: the contexts become a dp mesh
+            # and bind produces ONE sharded program — batch sliced across
+            # devices, params replicated, grad all-reduce via GSPMD (the
+            # reference's DataParallelExecutorGroup.decide_slices,
+            # executor_group.py:282, without per-device executor replicas)
+            import numpy as _np
+            from jax.sharding import Mesh
+
+            devs = [c.jax_device() for c in self._context]
+            if any(d is None for d in devs):
+                raise MXNetError("cannot resolve context list %s to devices"
+                                 % (self._context,))
+            if len(set(devs)) != len(devs):
+                raise MXNetError(
+                    "context list %s maps to duplicate devices %s — the "
+                    "host exposes fewer devices than contexts requested"
+                    % (self._context, devs))
+            batch = self._data_shapes[0].shape[0] if self._data_shapes else 0
+            if batch % len(devs):
+                raise MXNetError(
+                    "batch size %d not divisible by %d contexts"
+                    % (batch, len(devs)))
+            mesh = Mesh(_np.array(devs), ("dp",))
+        batch_args = set(self._data_names) | set(self._label_names)
         self._exec = Executor(self._symbol, self._context[0], args, grads,
-                              req, aux)
+                              req, aux, mesh=mesh, batch_args=batch_args)
         self.binded = True
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,

@@ -118,6 +118,14 @@ class MXRecordIO:
             return self._nlib.MXTRecordIOReaderTell(self._nh)
         return self.fh.tell()
 
+    def seek(self, pos):
+        """Reader byte-seek (MXRecordIOReaderSeek contract)."""
+        assert not self.writable
+        if getattr(self, "_nh", None):
+            self._nlib.MXTRecordIOReaderSeek(self._nh, int(pos))
+        else:
+            self.fh.seek(int(pos))
+
     def write(self, buf):
         assert self.writable
         if not isinstance(buf, (bytes, bytearray)):

@@ -9,15 +9,31 @@
 // takes the GIL, so the library is callable from any C/C++ thread — the
 // same contract the reference's thread-safe predict API documents.
 //
-// Implemented surface (the subset every binding/serving path needs):
+// Implemented surface (every subsystem a binding needs):
 //   error     MXGetLastError, MXGetVersion
 //   ndarray   MXNDArrayCreate/Ex, Free, SyncCopyFromCPU, SyncCopyToCPU,
-//             GetShape, GetDType, WaitToRead, MXNDArraySave, MXNDArrayLoad
+//             GetShape, GetDType, WaitToRead, Save, Load, GetGrad, Detach,
+//             Reshape, Slice, At, GetContext
 //   ops       MXListAllOpNames, MXImperativeInvokeByName
 //   symbol    MXSymbolCreateFromJSON, SaveToJSON, Free, ListArguments,
-//             ListOutputs, ListAuxiliaryStates
+//             ListOutputs, ListAuxiliaryStates, CreateVariable,
+//             CreateFromOp, InferShape(Partial), AtomicSymbol reflection
+//   executor  MXExecutorBind, Forward, Outputs, Backward, Free
+//   autograd  MXAutogradSetIsRecording/Training, IsRecording/Training,
+//             MarkVariables, Backward(Ex), ComputeGradient
+//   kvstore   MXKVStoreCreate, Free, Init(Ex), Push(Ex), Pull(Ex),
+//             GetType/Rank/GroupSize, Barrier, Is*Node, SetUpdater
+//             (C callback trampoline)
+//   io        MXListDataIters, MXDataIterCreateIter/Free/Next/BeforeFirst/
+//             GetData/GetLabel/GetPadNum/GetIndex
+//   recordio  MXRecordIOWriter{Create,Free,WriteRecord,Tell},
+//             MXRecordIOReader{Create,Free,ReadRecord,Seek,Tell}
+//   cachedop  MXCreateCachedOp(Ex), MXFreeCachedOp, MXInvokeCachedOp(Ex)
+//   misc      MXRandomSeed, MXEngineWaitAll, MXNotifyShutdown,
+//             MXSetNumOMPThreads, MXStorageEmptyCache
 //   predict   MXPredCreate, SetInput, Forward, GetOutputShape, GetOutput,
 //             Free
+#define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
 #include <cstdint>
@@ -841,5 +857,799 @@ MXTPU_API int MXPredGetOutput(PredictorHandle handle, uint32_t index,
 MXTPU_API int MXPredFree(PredictorHandle handle) {
   Gil gil;
   Py_XDECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// autograd (MXAutograd*: c_api.h autograd block)
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// call a 0/1-arg impl fn returning an int
+int CallIntImpl(const char* fn, PyObject* args, int* out) {
+  Gil gil;
+  PyObject* res = CallImpl(fn, args);
+  Py_XDECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+}  // namespace
+
+MXTPU_API int MXAutogradSetIsRecording(int is_recording, int* prev) {
+  Gil gil;  // args must be built under the GIL
+  return CallIntImpl("autograd_set_recording",
+                     Py_BuildValue("(i)", is_recording), prev);
+}
+
+MXTPU_API int MXAutogradSetIsTraining(int is_training, int* prev) {
+  Gil gil;
+  return CallIntImpl("autograd_set_training",
+                     Py_BuildValue("(i)", is_training), prev);
+}
+
+MXTPU_API int MXAutogradIsRecording(bool* curr) {
+  Gil gil;
+  int v = 0;
+  int rc = CallIntImpl("autograd_is_recording", PyTuple_New(0), &v);
+  *curr = v != 0;
+  return rc;
+}
+
+MXTPU_API int MXAutogradIsTraining(bool* curr) {
+  Gil gil;
+  int v = 0;
+  int rc = CallIntImpl("autograd_is_training", PyTuple_New(0), &v);
+  *curr = v != 0;
+  return rc;
+}
+
+MXTPU_API int MXAutogradMarkVariables(uint32_t num_var,
+                                      NDArrayHandle* var_handles,
+                                      uint32_t* reqs_array,
+                                      NDArrayHandle* grad_handles) {
+  Gil gil;
+  PyObject* vars = PyList_New(num_var);
+  PyObject* reqs = PyList_New(num_var);
+  PyObject* grads = PyList_New(num_var);
+  for (uint32_t i = 0; i < num_var; ++i) {
+    PyObject* v = static_cast<PyObject*>(var_handles[i]);
+    Py_INCREF(v);
+    PyList_SetItem(vars, i, v);
+    PyList_SetItem(reqs, i, PyLong_FromLong(reqs_array[i]));
+    PyObject* g = static_cast<PyObject*>(grad_handles[i]);
+    Py_INCREF(g);
+    PyList_SetItem(grads, i, g);
+  }
+  PyObject* args = PyTuple_Pack(3, vars, reqs, grads);
+  Py_DECREF(vars);
+  Py_DECREF(reqs);
+  Py_DECREF(grads);
+  PyObject* res = CallImpl("autograd_mark_variables", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+namespace {
+
+int AutogradBackwardImpl(uint32_t num_output, NDArrayHandle* output_handles,
+                         NDArrayHandle* ograd_handles, int retain_graph,
+                         int train_mode) {
+  Gil gil;
+  PyObject* outs = PyList_New(num_output);
+  for (uint32_t i = 0; i < num_output; ++i) {
+    PyObject* o = static_cast<PyObject*>(output_handles[i]);
+    Py_INCREF(o);
+    PyList_SetItem(outs, i, o);
+  }
+  PyObject* ograds = Py_None;
+  Py_INCREF(Py_None);
+  if (ograd_handles != nullptr) {
+    bool any = false;
+    for (uint32_t i = 0; i < num_output; ++i) {
+      if (ograd_handles[i] != nullptr) any = true;
+    }
+    if (any) {
+      Py_DECREF(Py_None);
+      ograds = PyList_New(num_output);
+      for (uint32_t i = 0; i < num_output; ++i) {
+        PyObject* g = static_cast<PyObject*>(ograd_handles[i]);
+        Py_INCREF(g);
+        PyList_SetItem(ograds, i, g);
+      }
+    }
+  }
+  PyObject* args = Py_BuildValue("(OOii)", outs, ograds, retain_graph,
+                                 train_mode);
+  Py_DECREF(outs);
+  Py_DECREF(ograds);
+  PyObject* res = CallImpl("autograd_backward", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+}  // namespace
+
+MXTPU_API int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle* out);
+
+MXTPU_API int MXAutogradBackward(uint32_t num_output,
+                                 NDArrayHandle* output_handles,
+                                 NDArrayHandle* ograd_handles,
+                                 int retain_graph) {
+  return AutogradBackwardImpl(num_output, output_handles, ograd_handles,
+                              retain_graph, 1);
+}
+
+MXTPU_API int MXAutogradBackwardEx(uint32_t num_output,
+                                   NDArrayHandle* output_handles,
+                                   NDArrayHandle* ograd_handles,
+                                   uint32_t num_variables,
+                                   NDArrayHandle* var_handles,
+                                   int retain_graph, int create_graph,
+                                   int is_train, NDArrayHandle** grad_handles,
+                                   int** grad_stypes) {
+  (void)create_graph;  // higher-order via python autograd only
+  int rc = AutogradBackwardImpl(num_output, output_handles, ograd_handles,
+                                retain_graph, is_train);
+  if (rc != 0) return rc;
+  if (grad_handles != nullptr) *grad_handles = nullptr;
+  if (grad_stypes != nullptr) *grad_stypes = nullptr;
+  if (num_variables > 0 && var_handles != nullptr &&
+      grad_handles != nullptr) {
+    Gil gil;
+    g_handle_store.clear();
+    static thread_local std::vector<int> stypes;
+    stypes.assign(num_variables, 0);  // dense
+    for (uint32_t i = 0; i < num_variables; ++i) {
+      NDArrayHandle g = nullptr;
+      rc = MXNDArrayGetGrad(var_handles[i], &g);
+      if (rc != 0) return rc;
+      g_handle_store.push_back(g);
+    }
+    *grad_handles = g_handle_store.data();
+    if (grad_stypes != nullptr) *grad_stypes = stypes.data();
+  }
+  return 0;
+}
+
+MXTPU_API int MXAutogradComputeGradient(uint32_t num_output,
+                                        NDArrayHandle* output_handles) {
+  return AutogradBackwardImpl(num_output, output_handles, nullptr, 0, 1);
+}
+
+MXTPU_API int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallImpl("ndarray_get_grad", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;  // strong reference becomes the handle
+  return 0;
+}
+
+MXTPU_API int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallImpl("ndarray_detach", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXNDArrayReshape(NDArrayHandle handle, int ndim, int* dims,
+                               NDArrayHandle* out) {
+  Gil gil;
+  PyObject* shape = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    PyList_SetItem(shape, i, PyLong_FromLong(dims[i]));
+  }
+  PyObject* args = Py_BuildValue("(ON)", static_cast<PyObject*>(handle),
+                                 shape);
+  PyObject* res = CallImpl("ndarray_reshape", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXNDArraySlice(NDArrayHandle handle, uint32_t begin,
+                             uint32_t end, NDArrayHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OII)", static_cast<PyObject*>(handle),
+                                 begin, end);
+  PyObject* res = CallImpl("ndarray_slice", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXNDArrayAt(NDArrayHandle handle, uint32_t idx,
+                          NDArrayHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OI)", static_cast<PyObject*>(handle), idx);
+  PyObject* res = CallImpl("ndarray_at", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetContext(NDArrayHandle handle, int* out_dev_type,
+                                  int* out_dev_id) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallImpl("ndarray_context", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out_dev_type = static_cast<int>(
+      PyLong_AsLong(PyTuple_GetItem(res, 0)));
+  *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(res, 1)));
+  Py_DECREF(res);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// KVStore (MXKVStore*: c_api.h kvstore block)
+// ---------------------------------------------------------------------------
+
+typedef void* KVStoreHandle;
+typedef void(MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                               NDArrayHandle local, void* handle);
+typedef void(MXKVStoreStrUpdater)(const char* key, NDArrayHandle recv,
+                                  NDArrayHandle local, void* handle);
+
+namespace {
+
+struct UpdaterClosure {
+  MXKVStoreUpdater* fn;
+  void* handle;
+};
+
+// PyCFunction trampoline: capi_impl's updater wrapper calls this with
+// (capsule, key, recv, local) so the user's C function pointer runs with
+// live NDArray handles (borrowed references for the duration of the call)
+PyObject* CallCUpdater(PyObject*, PyObject* args) {
+  PyObject* capsule = nullptr;
+  PyObject* key_obj = nullptr;
+  PyObject* recv = nullptr;
+  PyObject* local = nullptr;
+  if (!PyArg_ParseTuple(args, "OOOO", &capsule, &key_obj, &recv, &local)) {
+    return nullptr;
+  }
+  // int keys pass through; numeric strings (InitEx/PushEx path) convert —
+  // a C MXKVStoreUpdater only carries int keys (c_api.h)
+  long key = 0;
+  if (PyLong_Check(key_obj)) {
+    key = PyLong_AsLong(key_obj);
+  } else if (PyUnicode_Check(key_obj)) {
+    PyObject* as_int = PyLong_FromUnicodeObject(key_obj, 10);
+    if (as_int == nullptr) {
+      PyErr_SetString(PyExc_TypeError,
+                      "C kvstore updater requires integer keys; use string "
+                      "keys only with a python-level updater");
+      return nullptr;
+    }
+    key = PyLong_AsLong(as_int);
+    Py_DECREF(as_int);
+  }
+  auto* cl = static_cast<UpdaterClosure*>(
+      PyCapsule_GetPointer(capsule, "mxtpu_updater"));
+  if (cl == nullptr) return nullptr;
+  cl->fn(static_cast<int>(key), recv, local, cl->handle);
+  Py_RETURN_NONE;
+}
+
+PyMethodDef g_call_c_updater_def = {
+    "call_c_updater", CallCUpdater, METH_VARARGS,
+    "trampoline into a C MXKVStoreUpdater"};
+
+void FreeUpdaterCapsule(PyObject* capsule) {
+  delete static_cast<UpdaterClosure*>(
+      PyCapsule_GetPointer(capsule, "mxtpu_updater"));
+}
+
+int HandlesToList(uint32_t n, NDArrayHandle* hs, PyObject** out) {
+  PyObject* list = PyList_New(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    PyObject* h = static_cast<PyObject*>(hs[i]);
+    Py_INCREF(h);
+    PyList_SetItem(list, i, h);
+  }
+  *out = list;
+  return 0;
+}
+
+PyObject* IntKeysToList(uint32_t n, const int* keys) {
+  PyObject* list = PyList_New(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    PyList_SetItem(list, i, PyLong_FromLong(keys[i]));
+  }
+  return list;
+}
+
+PyObject* StrKeysToList(uint32_t n, const char** keys) {
+  PyObject* list = PyList_New(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    PyList_SetItem(list, i, PyUnicode_FromString(keys[i]));
+  }
+  return list;
+}
+
+int KVCall3(const char* fn, KVStoreHandle kv, PyObject* keys, uint32_t num,
+            NDArrayHandle* vals, int priority, bool with_priority) {
+  PyObject* hlist = nullptr;
+  HandlesToList(num, vals, &hlist);
+  PyObject* args = with_priority
+      ? Py_BuildValue("(ONNi)", static_cast<PyObject*>(kv), keys, hlist,
+                      priority)
+      : Py_BuildValue("(ONN)", static_cast<PyObject*>(kv), keys, hlist);
+  PyObject* res = CallImpl(fn, args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+}  // namespace
+
+MXTPU_API int MXKVStoreCreate(const char* type, KVStoreHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", type == nullptr ? "local" : type);
+  PyObject* res = CallImpl("kvstore_create", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXKVStoreFree(KVStoreHandle handle) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+MXTPU_API int MXKVStoreInit(KVStoreHandle kv, uint32_t num, const int* keys,
+                            NDArrayHandle* vals) {
+  Gil gil;
+  return KVCall3("kvstore_init", kv, IntKeysToList(num, keys), num, vals, 0,
+                 false);
+}
+
+MXTPU_API int MXKVStoreInitEx(KVStoreHandle kv, uint32_t num,
+                              const char** keys, NDArrayHandle* vals) {
+  Gil gil;
+  return KVCall3("kvstore_init", kv, StrKeysToList(num, keys), num, vals, 0,
+                 false);
+}
+
+MXTPU_API int MXKVStorePush(KVStoreHandle kv, uint32_t num, const int* keys,
+                            NDArrayHandle* vals, int priority) {
+  Gil gil;
+  return KVCall3("kvstore_push", kv, IntKeysToList(num, keys), num, vals,
+                 priority, true);
+}
+
+MXTPU_API int MXKVStorePushEx(KVStoreHandle kv, uint32_t num,
+                              const char** keys, NDArrayHandle* vals,
+                              int priority) {
+  Gil gil;
+  return KVCall3("kvstore_push", kv, StrKeysToList(num, keys), num, vals,
+                 priority, true);
+}
+
+MXTPU_API int MXKVStorePull(KVStoreHandle kv, uint32_t num, const int* keys,
+                            NDArrayHandle* vals, int priority) {
+  Gil gil;
+  return KVCall3("kvstore_pull", kv, IntKeysToList(num, keys), num, vals,
+                 priority, true);
+}
+
+MXTPU_API int MXKVStorePullEx(KVStoreHandle kv, uint32_t num,
+                              const char** keys, NDArrayHandle* vals,
+                              int priority) {
+  Gil gil;
+  return KVCall3("kvstore_pull", kv, StrKeysToList(num, keys), num, vals,
+                 priority, true);
+}
+
+MXTPU_API int MXKVStoreGetType(KVStoreHandle kv, const char** type) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(kv));
+  PyObject* res = CallImpl("kvstore_type", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  g_json_buf = PyUnicode_AsUTF8(res);
+  Py_DECREF(res);
+  *type = g_json_buf.c_str();
+  return 0;
+}
+
+MXTPU_API int MXKVStoreGetRank(KVStoreHandle kv, int* rank) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(kv));
+  return CallIntImpl("kvstore_rank", args, rank);
+}
+
+MXTPU_API int MXKVStoreGetGroupSize(KVStoreHandle kv, int* size) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(kv));
+  return CallIntImpl("kvstore_group_size", args, size);
+}
+
+MXTPU_API int MXKVStoreBarrier(KVStoreHandle kv) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(kv));
+  PyObject* res = CallImpl("kvstore_barrier", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXKVStoreIsWorkerNode(int* ret) {
+  *ret = 1;
+  return 0;
+}
+
+MXTPU_API int MXKVStoreIsServerNode(int* ret) {
+  *ret = 0;
+  return 0;
+}
+
+MXTPU_API int MXKVStoreIsSchedulerNode(int* ret) {
+  *ret = 0;
+  return 0;
+}
+
+MXTPU_API int MXKVStoreSetUpdater(KVStoreHandle kv, MXKVStoreUpdater updater,
+                                  void* updater_handle) {
+  Gil gil;
+  auto* cl = new UpdaterClosure{updater, updater_handle};
+  PyObject* capsule = PyCapsule_New(cl, "mxtpu_updater", FreeUpdaterCapsule);
+  PyObject* tramp = PyCFunction_New(&g_call_c_updater_def, nullptr);
+  // partial(call_c_updater, capsule) built in python for simplicity
+  PyObject* functools = PyImport_ImportModule("functools");
+  PyObject* partial = PyObject_GetAttrString(functools, "partial");
+  PyObject* bound = PyObject_CallFunctionObjArgs(partial, tramp, capsule,
+                                                 nullptr);
+  Py_DECREF(functools);
+  Py_DECREF(partial);
+  Py_DECREF(tramp);
+  Py_DECREF(capsule);
+  if (bound == nullptr) return FailFromPython();
+  PyObject* args = Py_BuildValue("(ON)", static_cast<PyObject*>(kv), bound);
+  PyObject* res = CallImpl("kvstore_set_updater", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// DataIter (MXDataIter*: c_api.h io block)
+// ---------------------------------------------------------------------------
+
+typedef void* DataIterHandle;
+
+MXTPU_API int MXListDataIters(uint32_t* out_size, const char*** out_array) {
+  Gil gil;
+  PyObject* res = CallImpl("list_data_iters", PyTuple_New(0));
+  if (res == nullptr) return FailFromPython();
+  StoreStringList(res, out_size, out_array);
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXDataIterCreateIter(const char* name, uint32_t num_param,
+                                   const char** keys, const char** vals,
+                                   DataIterHandle* out) {
+  Gil gil;
+  PyObject* k = StrKeysToList(num_param, keys);
+  PyObject* v = StrKeysToList(num_param, vals);
+  PyObject* args = Py_BuildValue("(sNN)", name, k, v);
+  PyObject* res = CallImpl("data_iter_create", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXDataIterFree(DataIterHandle handle) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+MXTPU_API int MXDataIterNext(DataIterHandle handle, int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  return CallIntImpl("data_iter_next", args, out);
+}
+
+MXTPU_API int MXDataIterBeforeFirst(DataIterHandle handle) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallImpl("data_iter_before_first", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+namespace {
+
+int DataIterGet(const char* fn, DataIterHandle handle, NDArrayHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallImpl(fn, args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+}  // namespace
+
+MXTPU_API int MXDataIterGetData(DataIterHandle handle, NDArrayHandle* out) {
+  return DataIterGet("data_iter_data", handle, out);
+}
+
+MXTPU_API int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle* out) {
+  return DataIterGet("data_iter_label", handle, out);
+}
+
+MXTPU_API int MXDataIterGetPadNum(DataIterHandle handle, int* pad) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  return CallIntImpl("data_iter_pad", args, pad);
+}
+
+MXTPU_API int MXDataIterGetIndex(DataIterHandle handle, uint64_t** out_index,
+                                 uint64_t* out_size) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallImpl("data_iter_index", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  char* buf = nullptr;
+  Py_ssize_t n = 0;
+  PyBytes_AsStringAndSize(res, &buf, &n);
+  static thread_local std::vector<uint64_t> idx_buf;
+  idx_buf.assign(reinterpret_cast<uint64_t*>(buf),
+                 reinterpret_cast<uint64_t*>(buf) + n / 8);
+  Py_DECREF(res);
+  *out_index = idx_buf.data();
+  *out_size = idx_buf.size();
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// RecordIO (MXRecordIO*: c_api.h recordio block)
+// ---------------------------------------------------------------------------
+
+typedef void* RecordIOHandle;
+
+MXTPU_API int MXRecordIOWriterCreate(const char* uri, RecordIOHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", uri);
+  PyObject* res = CallImpl("recordio_writer_create", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXRecordIOWriterFree(RecordIOHandle handle) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallImpl("recordio_writer_free", args);
+  Py_DECREF(args);
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXRecordIOWriterWriteRecord(RecordIOHandle handle,
+                                          const char* buf, size_t size) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oy#)", static_cast<PyObject*>(handle),
+                                 buf, static_cast<Py_ssize_t>(size));
+  PyObject* res = CallImpl("recordio_writer_write", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+namespace {
+
+int CallSizeImpl(const char* fn, PyObject* args, size_t* out) {
+  Gil gil;
+  PyObject* res = CallImpl(fn, args);
+  Py_XDECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = static_cast<size_t>(PyLong_AsUnsignedLongLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+}  // namespace
+
+MXTPU_API int MXRecordIOWriterTell(RecordIOHandle handle, size_t* pos) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  return CallSizeImpl("recordio_writer_tell", args, pos);
+}
+
+MXTPU_API int MXRecordIOReaderCreate(const char* uri, RecordIOHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", uri);
+  PyObject* res = CallImpl("recordio_reader_create", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXRecordIOReaderFree(RecordIOHandle handle) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallImpl("recordio_reader_free", args);
+  Py_DECREF(args);
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXRecordIOReaderReadRecord(RecordIOHandle handle,
+                                         char const** buf, size_t* size) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallImpl("recordio_reader_read", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  if (res == Py_None) {
+    Py_DECREF(res);
+    *buf = nullptr;
+    *size = 0;
+    return 0;
+  }
+  char* b = nullptr;
+  Py_ssize_t n = 0;
+  PyBytes_AsStringAndSize(res, &b, &n);
+  g_json_buf.assign(b, static_cast<size_t>(n));
+  Py_DECREF(res);
+  *buf = g_json_buf.data();
+  *size = g_json_buf.size();
+  return 0;
+}
+
+MXTPU_API int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(On)", static_cast<PyObject*>(handle),
+                                 static_cast<Py_ssize_t>(pos));
+  PyObject* res = CallImpl("recordio_reader_seek", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXRecordIOReaderTell(RecordIOHandle handle, size_t* pos) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  return CallSizeImpl("recordio_reader_tell", args, pos);
+}
+
+// ---------------------------------------------------------------------------
+// CachedOp (MXCreateCachedOp / MXInvokeCachedOp)
+// ---------------------------------------------------------------------------
+
+typedef void* CachedOpHandle;
+
+MXTPU_API int MXCreateCachedOp(SymbolHandle sym, CachedOpHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallImpl("cached_op_create", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXCreateCachedOpEx(SymbolHandle sym, int num_flags,
+                                 const char** keys, const char** vals,
+                                 CachedOpHandle* out) {
+  (void)num_flags;
+  (void)keys;
+  (void)vals;  // flags (static_alloc etc.) are no-ops: XLA owns buffers
+  return MXCreateCachedOp(sym, out);
+}
+
+MXTPU_API int MXFreeCachedOp(CachedOpHandle handle) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+MXTPU_API int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                               NDArrayHandle* inputs, int* num_outputs,
+                               NDArrayHandle** outputs) {
+  Gil gil;
+  PyObject* ins = nullptr;
+  HandlesToList(num_inputs, inputs, &ins);
+  PyObject* args = Py_BuildValue("(ON)", static_cast<PyObject*>(handle),
+                                 ins);
+  PyObject* res = CallImpl("cached_op_invoke", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_ssize_t n = PyList_Size(res);
+  g_handle_store.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GetItem(res, i);
+    Py_INCREF(o);
+    g_handle_store.push_back(o);
+  }
+  Py_DECREF(res);
+  *num_outputs = static_cast<int>(n);
+  *outputs = g_handle_store.data();
+  return 0;
+}
+
+MXTPU_API int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
+                                 NDArrayHandle* inputs, int* num_outputs,
+                                 NDArrayHandle** outputs,
+                                 const int** out_stypes) {
+  static thread_local std::vector<int> stypes;
+  int rc = MXInvokeCachedOp(handle, num_inputs, inputs, num_outputs, outputs);
+  if (rc != 0) return rc;
+  stypes.assign(static_cast<size_t>(*num_outputs), 0);  // dense
+  *out_stypes = stypes.data();
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// misc runtime
+// ---------------------------------------------------------------------------
+
+MXTPU_API int MXRandomSeed(int seed) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", seed);
+  PyObject* res = CallImpl("random_seed", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXEngineWaitAll() {
+  Gil gil;
+  PyObject* res = CallImpl("engine_wait_all", PyTuple_New(0));
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXNotifyShutdown() { return 0; }
+
+MXTPU_API int MXSetNumOMPThreads(int n) {
+  (void)n;  // XLA owns its own thread pools
+  return 0;
+}
+
+MXTPU_API int MXStorageEmptyCache(int dev_type, int dev_id) {
+  (void)dev_type;
+  (void)dev_id;  // XLA allocator; nothing to flush
   return 0;
 }

@@ -277,3 +277,142 @@ def test_symbol_compose_and_executor_roundtrip(lib):
     lib.MXNDArrayFree(arr)
     lib.MXNDArrayFree(grad)
     lib.MXNDArrayFree(o)
+
+
+def _make_nd(lib, arr):
+    arr = np.ascontiguousarray(arr, np.float32)
+    h = ctypes.c_void_p()
+    shape = (ctypes.c_uint32 * arr.ndim)(*arr.shape)
+    _check(lib, lib.MXNDArrayCreateEx(shape, arr.ndim, 1, 0, 0, 0,
+                                      ctypes.byref(h)))
+    _check(lib, lib.MXNDArraySyncCopyFromCPU(
+        h, arr.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(arr.size)))
+    return h
+
+
+def _to_np(lib, h, shape):
+    out = np.zeros(shape, np.float32)
+    _check(lib, lib.MXNDArraySyncCopyToCPU(
+        h, out.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(out.size)))
+    return out
+
+
+def test_autograd_abi(lib):
+    """MXAutogradMarkVariables / SetIsRecording / Backward / GetGrad
+    (c_api.h autograd block): d(x*x)/dx == 2x through the C ABI."""
+    x = _make_nd(lib, np.array([1., 2., 3.], np.float32))
+    g = _make_nd(lib, np.zeros(3, np.float32))
+    _check(lib, lib.MXAutogradMarkVariables(
+        1, (ctypes.c_void_p * 1)(x), (ctypes.c_uint32 * 1)(1),
+        (ctypes.c_void_p * 1)(g)))
+    prev = ctypes.c_int()
+    _check(lib, lib.MXAutogradSetIsRecording(1, ctypes.byref(prev)))
+    outp = ctypes.POINTER(ctypes.c_void_p)()
+    n = ctypes.c_int(0)
+    _check(lib, lib.MXImperativeInvokeByName(
+        b"elemwise_mul", 2, (ctypes.c_void_p * 2)(x, x), ctypes.byref(n),
+        ctypes.byref(outp), 0, None, None))
+    y = ctypes.c_void_p(outp[0])
+    _check(lib, lib.MXAutogradSetIsRecording(0, ctypes.byref(prev)))
+    _check(lib, lib.MXAutogradBackward(1, (ctypes.c_void_p * 1)(y), None, 0))
+    gh = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayGetGrad(x, ctypes.byref(gh)))
+    np.testing.assert_allclose(_to_np(lib, gh, (3,)), [2., 4., 6.])
+    rec = ctypes.c_bool()
+    _check(lib, lib.MXAutogradIsRecording(ctypes.byref(rec)))
+    assert not rec.value
+
+
+def test_kvstore_abi_with_c_updater(lib):
+    """MXKVStoreCreate/Init/Push/Pull/SetUpdater: the C updater callback
+    fires at push (kvstore.h:269 set_updater contract)."""
+    UPDATER = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_void_p,
+                               ctypes.c_void_p, ctypes.c_void_p)
+    calls = []
+
+    @UPDATER
+    def upd(key, recv, local, handle):
+        calls.append(key)
+
+    kv = ctypes.c_void_p()
+    _check(lib, lib.MXKVStoreCreate(b"local", ctypes.byref(kv)))
+    _check(lib, lib.MXKVStoreSetUpdater(kv, upd, None))
+    keys = (ctypes.c_int * 1)(3)
+    _check(lib, lib.MXKVStoreInit(
+        kv, 1, keys, (ctypes.c_void_p * 1)(
+            _make_nd(lib, np.ones(4, np.float32)))))
+    _check(lib, lib.MXKVStorePush(
+        kv, 1, keys, (ctypes.c_void_p * 1)(
+            _make_nd(lib, np.full(4, 0.5, np.float32))), 0))
+    dst = _make_nd(lib, np.zeros(4, np.float32))
+    _check(lib, lib.MXKVStorePull(kv, 1, keys, (ctypes.c_void_p * 1)(dst),
+                                  0))
+    assert calls == [3]
+    rank = ctypes.c_int()
+    size = ctypes.c_int()
+    _check(lib, lib.MXKVStoreGetRank(kv, ctypes.byref(rank)))
+    _check(lib, lib.MXKVStoreGetGroupSize(kv, ctypes.byref(size)))
+    assert (rank.value, size.value) == (0, 1)
+    _check(lib, lib.MXKVStoreFree(kv))
+
+
+def test_recordio_abi(lib, tmp_path):
+    p = str(tmp_path / "t.rec").encode()
+    w = ctypes.c_void_p()
+    _check(lib, lib.MXRecordIOWriterCreate(p, ctypes.byref(w)))
+    _check(lib, lib.MXRecordIOWriterWriteRecord(w, b"hello-capi", 10))
+    pos = ctypes.c_size_t()
+    _check(lib, lib.MXRecordIOWriterTell(w, ctypes.byref(pos)))
+    _check(lib, lib.MXRecordIOWriterFree(w))
+    r = ctypes.c_void_p()
+    _check(lib, lib.MXRecordIOReaderCreate(p, ctypes.byref(r)))
+    buf = ctypes.c_char_p()
+    sz = ctypes.c_size_t()
+    _check(lib, lib.MXRecordIOReaderReadRecord(r, ctypes.byref(buf),
+                                               ctypes.byref(sz)))
+    assert ctypes.string_at(buf, sz.value) == b"hello-capi"
+    # EOF -> NULL/0
+    _check(lib, lib.MXRecordIOReaderReadRecord(r, ctypes.byref(buf),
+                                               ctypes.byref(sz)))
+    assert sz.value == 0
+    _check(lib, lib.MXRecordIOReaderFree(r))
+
+
+def test_dataiter_abi(lib):
+    ns = ctypes.c_uint32()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib, lib.MXListDataIters(ctypes.byref(ns), ctypes.byref(arr)))
+    names = [arr[i].decode() for i in range(ns.value)]
+    assert "MNISTIter" in names and "ImageRecordIter" in names
+
+
+def test_cached_op_abi(lib):
+    """MXCreateCachedOp + MXInvokeCachedOp: compiled-once replay of a
+    symbol (src/imperative/cached_op.cc contract)."""
+    v = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateVariable(b"data", ctypes.byref(v)))
+    s = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateFromOp(
+        b"relu", 0, (ctypes.c_char_p * 0)(), (ctypes.c_char_p * 0)(),
+        1, (ctypes.c_char_p * 1)(b"data"), (ctypes.c_void_p * 1)(v),
+        b"act0", ctypes.byref(s)))
+    cop = ctypes.c_void_p()
+    _check(lib, lib.MXCreateCachedOp(s, ctypes.byref(cop)))
+    xin = _make_nd(lib, np.array([-1., 2., -3., 4.], np.float32))
+    no = ctypes.c_int(0)
+    couts = ctypes.POINTER(ctypes.c_void_p)()
+    for _ in range(2):  # second call replays the cached executable
+        _check(lib, lib.MXInvokeCachedOp(cop, 1, (ctypes.c_void_p * 1)(xin),
+                                         ctypes.byref(no),
+                                         ctypes.byref(couts)))
+    np.testing.assert_allclose(
+        _to_np(lib, ctypes.c_void_p(couts[0]), (4,)), [0., 2., 0., 4.])
+    _check(lib, lib.MXFreeCachedOp(cop))
+
+
+def test_misc_runtime_abi(lib):
+    _check(lib, lib.MXRandomSeed(7))
+    _check(lib, lib.MXEngineWaitAll())
+    _check(lib, lib.MXNotifyShutdown())
+    _check(lib, lib.MXSetNumOMPThreads(4))
+    _check(lib, lib.MXStorageEmptyCache(1, 0))

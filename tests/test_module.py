@@ -119,3 +119,57 @@ def test_bucketing_module():
     mod.update()
     out10 = mod.get_outputs()[0]
     assert out10.shape == (4, 4)
+
+
+def _mlp_mod(ctx):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",), context=ctx)
+    mod.bind(data_shapes=[("data", (8, 16))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(initializer=mx.init.Xavier(rnd_type="uniform",
+                                               magnitude=2))
+    return mod
+
+
+def test_module_multi_context_dp_matches_single():
+    """Module(context=[...]) runs ONE dp-sharded program over the mesh of
+    contexts — outputs and gradients must match single-device exactly
+    (reference: DataParallelExecutorGroup.decide_slices,
+    executor_group.py:282)."""
+    np.random.seed(0)
+    x = np.random.uniform(size=(8, 16)).astype(np.float32)
+    y = np.random.randint(0, 4, 8).astype(np.float32)
+    batch = io.DataBatch(data=[nd.array(x)], label=[nd.array(y)])
+    results = {}
+    for ctx in ([mx.cpu(0)], [mx.cpu(i) for i in range(4)]):
+        mx.random.seed(0)
+        mod = _mlp_mod(ctx)
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        results[len(ctx)] = (
+            mod.get_outputs()[0].asnumpy().copy(),
+            {n: g.asnumpy().copy() for n, g in
+             zip(mod._exec._arg_names, mod._exec.grad_arrays)
+             if g is not None})
+    np.testing.assert_allclose(results[1][0], results[4][0], rtol=1e-5)
+    for n in results[1][1]:
+        np.testing.assert_allclose(results[1][1][n], results[4][1][n],
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_module_multi_context_fit():
+    np.random.seed(0)
+    x = np.random.uniform(size=(8, 16)).astype(np.float32)
+    y = np.random.randint(0, 4, 8).astype(np.float32)
+    mx.random.seed(0)
+    mod = _mlp_mod([mx.cpu(i) for i in range(4)])
+    it = io.NDArrayIter(data=x, label=y, batch_size=8,
+                        label_name="softmax_label")
+    mod.fit(it, num_epoch=3, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    assert mod.score(it, mx.metric.Accuracy())[0][1] >= 0.25

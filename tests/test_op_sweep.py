@@ -29,6 +29,9 @@ from incubator_mxnet_tpu.ops import registry
 from op_sweep import build_cases
 
 _CASES, _UNCOVERED = build_cases()
+# snapshot: tests elsewhere register ops dynamically (CustomOp, native
+# libs); exhaustiveness is judged against the import-time registry
+_IMPORT_TIME_OPS = {id(op): op.name for op in registry.OPS.values()}
 
 # ops whose gradient check is skipped, with reasons
 _GRAD_SKIP = {
@@ -65,10 +68,9 @@ _names = sorted(_CASES)
 
 def test_sweep_is_exhaustive():
     """Every distinct op is either synthesized or has a documented reason."""
-    distinct = {id(op): op.name for op in registry.OPS.values()}
     allowed_missing = {"Custom", "_cond", "_foreach", "_while_loop",
                        "_CustomFunction"}
-    missing = set(distinct.values()) - set(_CASES) - allowed_missing
+    missing = set(_IMPORT_TIME_OPS.values()) - set(_CASES) - allowed_missing
     assert not missing, "ops with no sweep case: %s" % sorted(missing)
     assert len(_CASES) >= 380
 
