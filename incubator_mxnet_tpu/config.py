@@ -158,10 +158,14 @@ for _name, _doc in [
     ("MXNET_SAFE_ACCUMULATION",
      "f32 accumulation for f16/bf16 reductions — always on: norm/softmax/"
      "BN bodies accumulate in float32 (ops/nn.py)."),
-    ("MXNET_BACKWARD_DO_MIRROR",
-     "Gradient recompute — use jax.checkpoint/remat on blocks instead."),
 ]:
     _decl(_name, str, "", "[compat] " + _doc)
+
+_decl("MXNET_BACKWARD_DO_MIRROR", str, "",
+      "Gradient recompute (memory mirror, src/nnvm/gradient.cc): when "
+      "truthy, every HybridBlock without a remat-active ancestor wraps its "
+      "forward in jax.checkpoint so backward rematerializes activations. "
+      "Per-block opt-in: hybridize(remat=True) (gluon/block.py).")
 
 
 def get(name: str, default: Optional[Any] = None):

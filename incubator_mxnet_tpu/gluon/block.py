@@ -31,6 +31,9 @@ from ..ndarray import NDArray
 from ..ndarray import ndarray as _nd_mod
 from .parameter import DeferredInitializationError, Parameter, ParameterDict
 
+_REMAT_STATE = threading.local()
+_REMAT_STATE.active = False
+
 __all__ = ["Block", "HybridBlock", "SymbolBlock", "CachedOp"]
 
 
@@ -414,7 +417,73 @@ class HybridBlock(Block):
                     else:
                         raise
             params = self._gather_params()
+        if self._remat_wanted() and tracing.current_trace() is not None \
+                and not getattr(_REMAT_STATE, "active", False) \
+                and isinstance(x, NDArray):
+            return self._forward_remat(F, params, x, *args)
         return self.hybrid_forward(F, x, *args, **params)
+
+    def _remat_wanted(self):
+        if self._flags.get("remat") is not None:
+            return bool(self._flags.get("remat"))
+        from .. import config as _cfg
+
+        return str(_cfg.get("MXNET_BACKWARD_DO_MIRROR", "") or "") \
+            .lower() in ("1", "true")
+
+    def _forward_remat(self, F, params, x, *args):  # noqa: N803
+        """Gradient rematerialization: wrap this block's forward in
+        ``jax.checkpoint`` so its interior activations are recomputed in
+        the backward pass instead of saved (the reference's memory-mirror
+        pass, ``src/nnvm/gradient.cc`` MXNET_BACKWARD_DO_MIRROR).  Opt in
+        per block via ``hybridize(remat=True)`` (cascades; the outermost
+        opted-in block on each call path becomes the remat region) or
+        globally via MXNET_BACKWARD_DO_MIRROR=1.  Aux-state writes (BN
+        running stats) are routed through the checkpoint as outputs so
+        they stay valid in the outer trace."""
+        tc = tracing.current_trace()
+        pnames = sorted(params)
+        pvals = [params[n]._data for n in pnames]
+        all_in = (x,) + args
+        arr_idx = [i for i, a in enumerate(all_in) if isinstance(a, NDArray)]
+        arr_vals = [all_in[i]._data for i in arr_idx]
+        shape_meta = {"is_tuple": False, "aux": []}
+
+        def inner(arr_vals, pvals):
+            full = list(all_in)
+            for i, v in zip(arr_idx, arr_vals):
+                full[i] = NDArray(v)
+            nd_params = {n: NDArray(v) for n, v in zip(pnames, pvals)}
+            before = dict(tc.aux_writes)
+            _REMAT_STATE.active = True
+            try:
+                out = self.hybrid_forward(F, *full, **nd_params)
+            finally:
+                _REMAT_STATE.active = False
+            shape_meta["is_tuple"] = isinstance(out, (tuple, list))
+            outs = [o._data for o in (out if shape_meta["is_tuple"]
+                                      else (out,))]
+            # aux values written inside carry inner tracers: lift them out
+            # as checkpoint outputs and restore the outer dict
+            writes = []
+            shape_meta["aux"] = []
+            for k in list(tc.aux_writes):
+                h, v = tc.aux_writes[k]
+                if k not in before:
+                    shape_meta["aux"].append(h)
+                    writes.append(v)
+                    del tc.aux_writes[k]
+                elif before[k][1] is not v:
+                    shape_meta["aux"].append(h)
+                    writes.append(v)
+                    tc.aux_writes[k] = before[k]
+            return outs, writes
+
+        outs, writes = jax.checkpoint(inner)(arr_vals, pvals)
+        for h, v in zip(shape_meta["aux"], writes):
+            tc.write_aux(h, v)
+        nd_outs = [NDArray(o) for o in outs]
+        return tuple(nd_outs) if shape_meta["is_tuple"] else nd_outs[0]
 
     def hybrid_forward(self, F, x, *args, **kwargs):  # noqa: N803
         raise NotImplementedError

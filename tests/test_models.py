@@ -99,3 +99,70 @@ def test_train_step_on_mesh():
     for _ in range(15):
         loss = step(x, y)
     assert float(loss.asscalar()) < l1
+
+
+def test_hybridize_remat_transparent_and_applied():
+    """hybridize(remat=True) wraps the block in jax.checkpoint (the
+    MXNET_BACKWARD_DO_MIRROR memory-mirror analog, src/nnvm/gradient.cc):
+    numerics identical, BN aux writes still flow, and the grad jaxpr
+    contains the remat primitive."""
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu import gluon, nd, tracing
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.ndarray import NDArray
+    from incubator_mxnet_tpu.parallel import make_train_step
+
+    def build(remat):
+        mx.random.seed(3)
+        net = nn.HybridSequential()
+        for _ in range(3):
+            blk = nn.HybridSequential()
+            blk.add(nn.Dense(32, activation="relu"), nn.BatchNorm(),
+                    nn.Dense(32, activation="relu"))
+            if remat:
+                blk.hybridize(active=False, remat=True)
+            net.add(blk)
+        net.add(nn.Dense(4))
+        net.initialize(init=mx.init.Xavier())
+        net.shape_init((1, 16))
+        return net
+
+    x = nd.random.uniform(shape=(8, 16))
+    y = nd.array(np.random.RandomState(0).randint(0, 4, 8)
+                 .astype(np.float32))
+    losses = {}
+    for remat in (False, True):
+        net = build(remat)
+        step = make_train_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                               optimizer="sgd", learning_rate=0.1,
+                               momentum=0.9)
+        losses[remat] = [float(step(x, y).asscalar()) for _ in range(4)]
+        rm = net[0][1].running_mean.data().asnumpy()
+        assert np.abs(rm).sum() > 0, "aux writes lost under remat"
+    np.testing.assert_allclose(losses[False], losses[True], rtol=1e-5,
+                               atol=1e-6)
+
+    # the checkpoint must actually be in the program
+    blk = nn.HybridSequential()
+    blk.add(nn.Dense(8, activation="relu"))
+    blk.hybridize(active=False, remat=True)
+    blk.initialize(init=mx.init.Xavier())
+    blk.shape_init((1, 8))
+    plist = list(blk.collect_params().values())
+    pvals = [p.data()._data for p in plist]
+
+    def loss(xv, pv):
+        tc = tracing.TraceContext(jax.random.PRNGKey(0), training=True)
+        for p, v in zip(plist, pv):
+            tc.bindings[id(p)] = v
+        tracing.push_trace(tc)
+        try:
+            out = blk._forward_impl(NDArray(xv))
+        finally:
+            tracing.pop_trace()
+        return out._data.sum()
+
+    jaxpr = str(jax.make_jaxpr(jax.grad(loss))(jnp.ones((4, 8)), pvals))
+    assert "remat" in jaxpr
