@@ -417,9 +417,9 @@ class HybridBlock(Block):
                     else:
                         raise
             params = self._gather_params()
-        if self._remat_wanted() and tracing.current_trace() is not None \
+        if tracing.current_trace() is not None \
                 and not getattr(_REMAT_STATE, "active", False) \
-                and isinstance(x, NDArray):
+                and isinstance(x, NDArray) and self._remat_wanted():
             return self._forward_remat(F, params, x, *args)
         return self.hybrid_forward(F, x, *args, **params)
 
@@ -428,8 +428,13 @@ class HybridBlock(Block):
             return bool(self._flags.get("remat"))
         from .. import config as _cfg
 
-        return str(_cfg.get("MXNET_BACKWARD_DO_MIRROR", "") or "") \
-            .lower() in ("1", "true")
+        v = str(_cfg.get("MXNET_BACKWARD_DO_MIRROR", "") or "").strip()
+        if not v:
+            return False
+        try:
+            return int(v) != 0  # dmlc::GetEnv parses a nonzero int
+        except ValueError:
+            return v.lower() in ("true", "yes", "on")
 
     def _forward_remat(self, F, params, x, *args):  # noqa: N803
         """Gradient rematerialization: wrap this block's forward in
@@ -447,7 +452,7 @@ class HybridBlock(Block):
         all_in = (x,) + args
         arr_idx = [i for i, a in enumerate(all_in) if isinstance(a, NDArray)]
         arr_vals = [all_in[i]._data for i in arr_idx]
-        shape_meta = {"is_tuple": False, "aux": []}
+        shape_meta = {"treedef": None, "aux": []}
 
         def inner(arr_vals, pvals):
             full = list(all_in)
@@ -460,11 +465,13 @@ class HybridBlock(Block):
                 out = self.hybrid_forward(F, *full, **nd_params)
             finally:
                 _REMAT_STATE.active = False
-            shape_meta["is_tuple"] = isinstance(out, (tuple, list))
-            outs = [o._data for o in (out if shape_meta["is_tuple"]
-                                      else (out,))]
+            # arbitrary pytree outputs (RNN cells return (out, [states]))
+            flat, treedef = jax.tree.flatten(
+                out, is_leaf=lambda o: isinstance(o, NDArray))
+            shape_meta["treedef"] = treedef
+            outs = [o._data if isinstance(o, NDArray) else o for o in flat]
             # aux values written inside carry inner tracers: lift them out
-            # as checkpoint outputs and restore the outer dict
+            # as checkpoint outputs and restore the outer dict/order
             writes = []
             shape_meta["aux"] = []
             for k in list(tc.aux_writes):
@@ -473,6 +480,8 @@ class HybridBlock(Block):
                     shape_meta["aux"].append(h)
                     writes.append(v)
                     del tc.aux_writes[k]
+                    if k in tc.aux_order:
+                        tc.aux_order.remove(k)
                 elif before[k][1] is not v:
                     shape_meta["aux"].append(h)
                     writes.append(v)
@@ -482,8 +491,8 @@ class HybridBlock(Block):
         outs, writes = jax.checkpoint(inner)(arr_vals, pvals)
         for h, v in zip(shape_meta["aux"], writes):
             tc.write_aux(h, v)
-        nd_outs = [NDArray(o) for o in outs]
-        return tuple(nd_outs) if shape_meta["is_tuple"] else nd_outs[0]
+        return jax.tree.unflatten(shape_meta["treedef"],
+                                  [NDArray(o) for o in outs])
 
     def hybrid_forward(self, F, x, *args, **kwargs):  # noqa: N803
         raise NotImplementedError

@@ -56,9 +56,15 @@ class TraceContext:
         self.aux_writes[oid] = (holder, value)
 
     def collect_aux(self):
-        """Return ([holders], [values]) in deterministic write order."""
+        """Return ([holders], [values]) in deterministic write order.
+        Skips duplicated/stale order entries (a remat region may lift a
+        write out and re-commit it, gluon/block.py _forward_remat)."""
         holders, values = [], []
+        seen = set()
         for oid in self.aux_order:
+            if oid in seen or oid not in self.aux_writes:
+                continue
+            seen.add(oid)
             h, v = self.aux_writes[oid]
             holders.append(h)
             values.append(v)
