@@ -1,0 +1,223 @@
+"""Asynchronous parameter host (the dist_async server analog).
+
+Reference model: ``src/kvstore/kvstore_dist_server.h:155`` — the server
+absorbs each worker's push WITHOUT any barrier and applies the update
+immediately (``ApplyUpdates:325-346``, async branch: no aggregation
+across workers, first-come-first-served), and serves pulls with whatever
+the current value is.  Workers therefore run completely unsynchronized
+step counts (Hogwild-style staleness).
+
+TPU-native role: the *synchronous* dist types ride XLA collectives
+(dist.py) — there is no server.  ``dist_async`` genuinely needs a
+mutable, always-available host, so rank 0 runs this thread: a
+length-prefixed-pickle TCP server holding float32 parameter state, with
+a per-key lock and an optional server-side optimizer
+(``set_optimizer`` ships the pickled optimizer, exactly the reference's
+``MXKVStoreSendCommmandToServers(kController, optimizer)`` flow).
+
+Wire ops: INIT (first-writer-wins), PUSH (apply update now), PULL,
+SET_OPT, STOP.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+__all__ = ["AsyncParamHost", "AsyncParamClient"]
+
+
+def _int_key(key) -> int:
+    try:
+        return int(key)
+    except (TypeError, ValueError):
+        return abs(hash(str(key))) % (1 << 31)
+
+_HDR = struct.Struct("<I")
+
+
+def _send(sock: socket.socket, obj: Any) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def _recv(sock: socket.socket) -> Any:
+    hdr = b""
+    while len(hdr) < _HDR.size:
+        chunk = sock.recv(_HDR.size - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    (n,) = _HDR.unpack(hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class AsyncParamHost:
+    """Rank-0 parameter host thread."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1"):
+        # loopback by default: launch_local co-locates workers; multi-host
+        # deployments pass the DMLC_PS_ROOT_URI interface explicitly.
+        # (messages are pickled — never expose this port beyond the
+        # training cluster's trust boundary)
+        self._values: Dict[str, np.ndarray] = {}
+        self._states: Dict[str, Any] = {}
+        self._locks: Dict[str, threading.Lock] = {}
+        self._global_lock = threading.Lock()
+        self._optimizer = None
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):  # noqa: A003
+                try:
+                    while True:
+                        msg = _recv(self.request)
+                        op = msg[0]
+                        if op == "STOP":
+                            _send(self.request, ("OK",))
+                            outer._server.shutdown()
+                            return
+                        try:
+                            res = outer._handle(msg)
+                        except Exception as e:  # noqa: BLE001 - to client
+                            res = ("ERR", "%s: %s" % (type(e).__name__, e))
+                        _send(self.request, res)
+                except (ConnectionError, OSError):
+                    return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True,
+                                        name="mx-async-param-host")
+        self._thread.start()
+
+    # -- server-side ops ---------------------------------------------------
+    def _lock(self, key: str) -> threading.Lock:
+        with self._global_lock:
+            return self._locks.setdefault(key, threading.Lock())
+
+    def _handle(self, msg):
+        op = msg[0]
+        if op == "INIT":
+            _, key, val = msg
+            with self._lock(key):
+                if key not in self._values:  # first writer wins (rank 0)
+                    self._values[key] = np.asarray(val, np.float32).copy()
+            return ("OK",)
+        if op == "PUSH":
+            _, key, grad = msg
+            with self._lock(key):
+                if key not in self._values:
+                    return ("ERR", "key %r has not been initialized" % key)
+                w = self._values[key]
+                if self._optimizer is not None:
+                    idx = _int_key(key)
+                    st = self._states.get(key)
+                    if st is None:
+                        st = self._optimizer.create_state_multi_precision(
+                            idx, _ND(w))
+                        self._states[key] = st
+                    wnd = _ND(w)
+                    self._optimizer.update_multi_precision(
+                        idx, wnd, _ND(np.asarray(grad, np.float32)), st)
+                    self._values[key] = wnd.asnumpy()
+                else:
+                    # no optimizer installed: plain accumulate (the
+                    # reference server's default sum-merge behavior)
+                    self._values[key] = w + np.asarray(grad, np.float32)
+            return ("OK",)
+        if op == "PULL":
+            _, key = msg
+            with self._lock(key):
+                if key not in self._values:
+                    return ("ERR", "key %r has not been initialized" % key)
+                return ("OK", self._values[key].copy())
+        if op == "SET_OPT":
+            _, blob = msg
+            self._optimizer = pickle.loads(blob)
+            return ("OK",)
+        return ("ERR", "unknown op %r" % (op,))
+
+    def stop(self):
+        try:
+            self._server.shutdown()
+        finally:
+            self._server.server_close()
+
+
+def _ND(arr):  # noqa: N802 - tiny adapter
+    from ..ndarray import ndarray as _nd
+
+    return _nd.array(np.asarray(arr, np.float32))
+
+
+class AsyncParamClient:
+    """Per-worker connection to the parameter host."""
+
+    def __init__(self, host: str, port: int, timeout: float = 60.0):
+        deadline = timeout
+        last = None
+        import time
+
+        t0 = time.time()
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=10)
+                break
+            except OSError as e:  # host thread may not be up yet
+                last = e
+                if time.time() - t0 > deadline:
+                    raise ConnectionError(
+                        "async param host %s:%d unreachable: %s"
+                        % (host, port, last))
+                time.sleep(0.1)
+        self._lock = threading.Lock()
+
+    def _call(self, *msg):
+        with self._lock:
+            _send(self._sock, msg)
+            res = _recv(self._sock)
+        if res[0] != "OK":
+            raise RuntimeError("async param host error: %r" % (res,))
+        return res
+
+    def init(self, key: str, value) -> None:
+        self._call("INIT", key, np.asarray(value, np.float32))
+
+    def push(self, key: str, grad) -> None:
+        self._call("PUSH", key, np.asarray(grad, np.float32))
+
+    def pull(self, key: str) -> np.ndarray:
+        return self._call("PULL", key)[1]
+
+    def set_optimizer(self, optimizer) -> None:
+        self._call("SET_OPT", pickle.dumps(optimizer))
+
+    def stop_host(self) -> None:
+        try:
+            self._call("STOP")
+        except (RuntimeError, ConnectionError):
+            pass
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -82,12 +82,13 @@ class DistKVStore(KVStore):
     one it degrades to a single-worker store with a loud warning (the
     reference would hang waiting for a scheduler instead).
 
-    NOTE on ``dist_async``: there is no parameter server to absorb
-    asynchronous pushes, so async types run with *synchronous* collective
-    semantics here — every rank must make the same sequence of push/init
-    calls.  Workers taking different numbers of steps would block in the
-    collective; pad or truncate epochs to equal length (the same
-    requirement jax/pmap-style SPMD training always has).
+    ``dist_async`` runs a REAL asynchronous parameter host: rank 0
+    spawns :class:`.async_host.AsyncParamHost` (the
+    ``kvstore_dist_server.h:155`` analog), every worker pushes gradients
+    to it without any barrier (updates apply immediately, Hogwild-style
+    staleness), and pulls fetch the current value — workers may take
+    unequal numbers of steps (tests/async_worker.py exercises exactly
+    that).
     """
 
     def __init__(self, kv_type="dist_sync"):
@@ -100,6 +101,20 @@ class DistKVStore(KVStore):
                 "(DMLC_NUM_WORKER unset or 1) — running single-worker. "
                 "Launch with tools/launch.py -n <N> for real distributed "
                 "training." % kv_type, stacklevel=3)
+        self._async = (kv_type.startswith("dist_async")
+                       and self.num_workers > 1)
+        if self._async:
+            # rank 0 hosts the asynchronous parameter server thread
+            # (kvstore_dist_server.h:155): pushes apply immediately,
+            # no barrier, workers free-run at unequal step counts
+            from .async_host import AsyncParamClient, AsyncParamHost
+
+            port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091")) + 1
+            uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+            if self.rank == 0:
+                self._param_host = AsyncParamHost(port, host=uri)
+            self.barrier()  # host must be listening before clients dial
+            self._client = AsyncParamClient(uri, port)
 
     # ------------------------------------------------------------------
     @property
@@ -195,12 +210,70 @@ class DistKVStore(KVStore):
         super().init(key, value)
         if self.num_workers == 1:
             return
+        if self._async:
+            # host holds the authority copy: rank 0 initializes it (the
+            # reference's worker-0 init push), then everyone syncs local
+            # replicas from the host
+            keys, _ = self._norm_keys_vals(key, value)
+            if self.rank == 0:
+                for k in keys:
+                    self._client.init(k, self._store[k].asnumpy())
+            self.barrier()
+            for k in keys:
+                self._store[k]._data = jnp.asarray(self._client.pull(k))
+            return
         from jax.experimental import multihost_utils
 
         keys, _ = self._norm_keys_vals(key, value)
         for k in keys:
             self._store[k]._data = jnp.asarray(
                 multihost_utils.broadcast_one_to_all(self._store[k]._data))
+
+    def push(self, key, value, priority=0):
+        if not self._async:
+            return super().push(key, value, priority)
+        # asynchronous path: merge THIS worker's values locally, send to
+        # the parameter host (which applies the update immediately), no
+        # collective and no barrier — other workers' progress is unseen
+        # until the next pull (kvstore_dist_server.h ApplyUpdates async)
+        keys, values = self._norm_keys_vals(key, value)
+        from ..ndarray.sparse import BaseSparseNDArray
+
+        for k, v in zip(keys, values):
+            merged = self._merge(v if isinstance(v, (list, tuple)) else [v])
+            if isinstance(merged, BaseSparseNDArray):
+                merged = merged.todense()._data
+            elif getattr(self, "_compressor", None) is not None:
+                merged = self._compressor.compress(k, merged)
+            self._client.push(k, jnp.asarray(merged))
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if not self._async:
+            return super().pull(key, out, priority, ignore_sparse)
+        keys, outs = self._norm_keys_vals(key, out)
+        for k, o in zip(keys, outs):
+            val = jnp.asarray(self._client.pull(k))
+            if k in self._store:
+                self._store[k]._data = val.astype(self._store[k]._data.dtype)
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                t._data = jnp.asarray(val, t.dtype)
+        return out
+
+    def set_optimizer(self, optimizer):
+        if self._async:
+            # ship the optimizer to the parameter host — the reference's
+            # kController command carrying the pickled optimizer
+            # (python/mxnet/kvstore.py set_optimizer -> _send_command)
+            if self.rank == 0:
+                # only rank 0 installs the host-side optimizer (the
+                # reference gates _send_command_to_servers on rank 0 too,
+                # python/mxnet/kvstore.py set_optimizer)
+                self._client.set_optimizer(optimizer)
+            self.barrier()  # no pushes before the optimizer is installed
+            self._optimizer = optimizer
+            return
+        super().set_optimizer(optimizer)
 
     def barrier(self):
         """Real global barrier across workers (kvstore_dist.h Barrier)."""
@@ -211,6 +284,20 @@ class DistKVStore(KVStore):
             self._barrier_count = getattr(self, "_barrier_count", 0) + 1
             multihost_utils.sync_global_devices(
                 "kvstore_barrier_%d" % self._barrier_count)
+
+    def close(self):
+        """Tear down the async parameter host/client (idempotent).  The
+        host thread is a daemon, so training scripts that exit without
+        closing still terminate — but a second dist_async store in the
+        same process needs the port released first."""
+        if getattr(self, "_client", None) is not None:
+            if self.rank == 0:
+                self._client.stop_host()
+            self._client.close()
+            self._client = None
+        if getattr(self, "_param_host", None) is not None:
+            self._param_host.stop()
+            self._param_host = None
 
     def _send_command_to_servers(self, head, body):
         """No servers exist; commands are meaningless. Barrier for parity

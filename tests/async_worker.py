@@ -1,0 +1,51 @@
+"""dist_async worker body (spawned by tests/test_dist_kvstore.py).
+
+Each rank trains a shared linear-regression parameter through the
+asynchronous parameter host with a DIFFERENT number of steps (rank r runs
+20 + 15*r): the async contract (kvstore_dist_server.h ApplyUpdates, async
+branch) is that nothing blocks on the slower/faster peers.  The parent
+asserts the final pulled weight solved the problem on every rank.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu import nd  # noqa: E402
+
+
+def main(outdir):
+    kv = mx.kv.create("dist_async")
+    rank, nw = kv.rank, kv.num_workers
+    rng = np.random.RandomState(100 + rank)
+
+    # shared truth: w* = [1, -2, 3]; per-rank data
+    w_true = np.array([1.0, -2.0, 3.0], np.float32)
+    X = rng.normal(size=(64, 3)).astype(np.float32)
+    y = X @ w_true
+
+    kv.init("w", nd.array(np.zeros(3, np.float32)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.05))
+
+    steps = 20 + 15 * rank  # deliberately unequal step counts
+    w = nd.array(np.zeros(3, np.float32))
+    for _ in range(steps):
+        kv.pull("w", out=w)
+        wv = w.asnumpy()
+        grad = 2.0 / len(X) * X.T @ (X @ wv - y)
+        kv.push("w", nd.array(grad.astype(np.float32)))
+    # settle: barrier (all pushes done) -> pull -> barrier (all pulls
+    # done before any rank may exit and take the host thread with it)
+    kv.barrier()
+    kv.pull("w", out=w)
+    kv.barrier()
+    np.savez(os.path.join(outdir, "rank%d.npz" % rank),
+             rank=rank, nw=nw, steps=steps, w=w.asnumpy(), w_true=w_true)
+    kv.close()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

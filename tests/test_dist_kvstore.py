@@ -111,3 +111,27 @@ def test_multihost_train_step(worker_results):
     np.testing.assert_array_equal(a["mh_losses"], b["mh_losses"])
     assert a["mh_w"].tobytes() == b["mh_w"].tobytes()
     assert np.isfinite(a["mh_w"]).all() and np.abs(a["mh_w"]).sum() > 0
+
+
+def test_dist_async_unequal_steps(tmp_path):
+    """dist_async runs a real rank-0 parameter host: workers take UNEQUAL
+    step counts (20 vs 35) without blocking, and both converge on the
+    shared regression weight (kvstore_dist_server.h:325-346 async
+    ApplyUpdates semantics)."""
+    outdir = str(tmp_path)
+    env = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": _REPO,
+           "DMLC_PS_ROOT_PORT": "9207"}
+    rc = launch_local(N, [sys.executable,
+                          os.path.join(_REPO, "tests", "async_worker.py"),
+                          outdir], extra_env=env)
+    assert rc == 0, "an async worker failed (rc=%d)" % rc
+    results = []
+    for r in range(N):
+        path = os.path.join(outdir, "rank%d.npz" % r)
+        assert os.path.exists(path)
+        results.append(dict(np.load(path)))
+    steps = sorted(int(w["steps"]) for w in results)
+    assert steps == [20, 35], steps  # genuinely unequal
+    for w in results:
+        np.testing.assert_allclose(w["w"], w["w_true"], rtol=0.15,
+                                   atol=0.15)
