@@ -577,3 +577,93 @@ def engine_wait_all() -> None:
         jax.effects_barrier()
     except Exception:
         pass
+
+
+# ---------------------------------------------------------------------------
+# Profiler (MXProfile* / MXSetProfilerConfig ABI, c_api.h profiler block)
+# ---------------------------------------------------------------------------
+
+def profiler_set_config(keys, vals) -> None:
+    from . import profiler
+    import ast
+
+    kwargs = {}
+    for k, v in zip(keys, vals):
+        try:
+            kwargs[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            kwargs[k] = v
+    profiler.set_config(**kwargs)
+
+
+def profiler_set_state(state: int) -> None:
+    from . import profiler
+    profiler.set_state("run" if state else "stop")
+
+
+def profiler_pause(profile_process: int) -> None:
+    from . import profiler
+    profiler.pause("server" if profile_process else "worker")
+
+
+def profiler_resume(profile_process: int) -> None:
+    from . import profiler
+    profiler.resume("server" if profile_process else "worker")
+
+
+def profiler_dump(finished: int, profile_process: int) -> None:
+    from . import profiler
+    profiler.dump(bool(finished),
+                  "server" if profile_process else "worker")
+
+
+def profiler_dumps(reset: int) -> str:
+    from . import profiler
+    return profiler.dumps(bool(reset))
+
+
+def profile_create_domain(name: str):
+    from . import profiler
+    return profiler.Domain(name)
+
+
+def profile_create_task(domain, name: str):
+    from . import profiler
+    return profiler.Task(name, domain=domain)
+
+
+def profile_create_frame(domain, name: str):
+    from . import profiler
+    return profiler.Frame(name, domain=domain)
+
+
+def profile_create_event(name: str):
+    from . import profiler
+    return profiler.Event(name)
+
+
+def profile_create_counter(domain, name: str):
+    from . import profiler
+    return profiler.Counter(name, domain=domain)
+
+
+def profile_duration_start(obj) -> None:
+    obj.start()
+
+
+def profile_duration_stop(obj) -> None:
+    obj.stop()
+
+
+def profile_set_counter(counter, value: int) -> None:
+    counter.set_value(int(value))
+
+
+def profile_adjust_counter(counter, delta: int) -> None:
+    counter.increment(int(delta)) if delta >= 0 else \
+        counter.decrement(-int(delta))
+
+
+def profile_set_marker(domain, name: str, scope: str) -> None:
+    from . import profiler
+    profiler.Marker(name, domain=domain).mark(scope or "process")

@@ -183,6 +183,16 @@ class Event(_Scope):
     _cat = "event"
 
 
+class Domain:
+    """Named grouping for profiler objects (profiler.py:331 Domain)."""
+
+    def __init__(self, name):
+        self.name = str(name)
+
+    def __repr__(self):
+        return "Domain(%s)" % self.name
+
+
 class Counter:
     """Numeric counter series (profiler.py:366)."""
 

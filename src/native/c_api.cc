@@ -1653,3 +1653,160 @@ MXTPU_API int MXStorageEmptyCache(int dev_type, int dev_id) {
   (void)dev_id;  // XLA allocator; nothing to flush
   return 0;
 }
+
+// ---------------------------------------------------------------------------
+// Profiler (MXProfile* / MXSetProfilerConfig: c_api.h profiler block;
+// reference impl src/c_api/c_api_profile.cc)
+// ---------------------------------------------------------------------------
+
+typedef void* ProfileHandle;
+
+namespace {
+
+int ProfileCreate(const char* fn, PyObject* args, ProfileHandle* out) {
+  PyObject* res = CallImpl(fn, args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+int CallVoidImpl(const char* fn, PyObject* args) {
+  PyObject* res = CallImpl(fn, args);
+  Py_XDECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+}  // namespace
+
+MXTPU_API int MXSetProfilerConfig(int num_params, const char* const* keys,
+                                  const char* const* vals) {
+  Gil gil;
+  PyObject* k = PyList_New(num_params);
+  PyObject* v = PyList_New(num_params);
+  for (int i = 0; i < num_params; ++i) {
+    PyList_SetItem(k, i, PyUnicode_FromString(keys[i]));
+    PyList_SetItem(v, i, PyUnicode_FromString(vals[i]));
+  }
+  return CallVoidImpl("profiler_set_config", Py_BuildValue("(NN)", k, v));
+}
+
+MXTPU_API int MXSetProfilerState(int state) {
+  Gil gil;
+  return CallVoidImpl("profiler_set_state", Py_BuildValue("(i)", state));
+}
+
+MXTPU_API int MXProfilePause(int profile_process) {
+  Gil gil;
+  return CallVoidImpl("profiler_pause",
+                      Py_BuildValue("(i)", profile_process));
+}
+
+MXTPU_API int MXProfileResume(int profile_process) {
+  Gil gil;
+  return CallVoidImpl("profiler_resume",
+                      Py_BuildValue("(i)", profile_process));
+}
+
+MXTPU_API int MXDumpProfile(int finished) {
+  Gil gil;
+  return CallVoidImpl("profiler_dump", Py_BuildValue("(ii)", finished, 0));
+}
+
+MXTPU_API int MXAggregateProfileStatsPrint(const char** out_str, int reset) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", reset);
+  PyObject* res = CallImpl("profiler_dumps", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  g_json_buf = PyUnicode_AsUTF8(res);
+  Py_DECREF(res);
+  *out_str = g_json_buf.c_str();
+  return 0;
+}
+
+MXTPU_API int MXProfileCreateDomain(const char* domain, ProfileHandle* out) {
+  Gil gil;
+  return ProfileCreate("profile_create_domain",
+                       Py_BuildValue("(s)", domain), out);
+}
+
+MXTPU_API int MXProfileCreateTask(ProfileHandle domain, const char* name,
+                                  ProfileHandle* out) {
+  Gil gil;
+  return ProfileCreate("profile_create_task",
+                       Py_BuildValue("(Os)",
+                                     static_cast<PyObject*>(domain), name),
+                       out);
+}
+
+MXTPU_API int MXProfileCreateFrame(ProfileHandle domain, const char* name,
+                                   ProfileHandle* out) {
+  Gil gil;
+  return ProfileCreate("profile_create_frame",
+                       Py_BuildValue("(Os)",
+                                     static_cast<PyObject*>(domain), name),
+                       out);
+}
+
+MXTPU_API int MXProfileCreateEvent(const char* name, ProfileHandle* out) {
+  Gil gil;
+  return ProfileCreate("profile_create_event", Py_BuildValue("(s)", name),
+                       out);
+}
+
+MXTPU_API int MXProfileCreateCounter(ProfileHandle domain, const char* name,
+                                     ProfileHandle* out) {
+  Gil gil;
+  return ProfileCreate("profile_create_counter",
+                       Py_BuildValue("(Os)",
+                                     static_cast<PyObject*>(domain), name),
+                       out);
+}
+
+MXTPU_API int MXProfileDestroyHandle(ProfileHandle handle) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+MXTPU_API int MXProfileDurationStart(ProfileHandle duration) {
+  Gil gil;
+  return CallVoidImpl(
+      "profile_duration_start",
+      Py_BuildValue("(O)", static_cast<PyObject*>(duration)));
+}
+
+MXTPU_API int MXProfileDurationStop(ProfileHandle duration) {
+  Gil gil;
+  return CallVoidImpl(
+      "profile_duration_stop",
+      Py_BuildValue("(O)", static_cast<PyObject*>(duration)));
+}
+
+MXTPU_API int MXProfileSetCounter(ProfileHandle counter, uint64_t value) {
+  Gil gil;
+  return CallVoidImpl(
+      "profile_set_counter",
+      Py_BuildValue("(OK)", static_cast<PyObject*>(counter),
+                    static_cast<unsigned long long>(value)));
+}
+
+MXTPU_API int MXProfileAdjustCounter(ProfileHandle counter, int64_t delta) {
+  Gil gil;
+  return CallVoidImpl(
+      "profile_adjust_counter",
+      Py_BuildValue("(OL)", static_cast<PyObject*>(counter),
+                    static_cast<long long>(delta)));
+}
+
+MXTPU_API int MXProfileSetMarker(ProfileHandle domain, const char* name,
+                                 const char* scope) {
+  Gil gil;
+  return CallVoidImpl(
+      "profile_set_marker",
+      Py_BuildValue("(Oss)", static_cast<PyObject*>(domain), name,
+                    scope == nullptr ? "process" : scope));
+}

@@ -416,3 +416,31 @@ def test_misc_runtime_abi(lib):
     _check(lib, lib.MXNotifyShutdown())
     _check(lib, lib.MXSetNumOMPThreads(4))
     _check(lib, lib.MXStorageEmptyCache(1, 0))
+
+
+def test_profiler_abi(lib, tmp_path):
+    """MXSetProfilerConfig/State + MXProfile* object surface
+    (c_api.h profiler block; reference src/c_api/c_api_profile.cc)."""
+    fname = str(tmp_path / "prof.json")
+    keys = (ctypes.c_char_p * 1)(b"filename")
+    vals = (ctypes.c_char_p * 1)(fname.encode())
+    _check(lib, lib.MXSetProfilerConfig(1, keys, vals))
+    _check(lib, lib.MXSetProfilerState(1))
+    dom = ctypes.c_void_p()
+    _check(lib, lib.MXProfileCreateDomain(b"capi", ctypes.byref(dom)))
+    task = ctypes.c_void_p()
+    _check(lib, lib.MXProfileCreateTask(dom, b"task0", ctypes.byref(task)))
+    _check(lib, lib.MXProfileDurationStart(task))
+    _check(lib, lib.MXProfileDurationStop(task))
+    ctr = ctypes.c_void_p()
+    _check(lib, lib.MXProfileCreateCounter(dom, b"ctr0", ctypes.byref(ctr)))
+    _check(lib, lib.MXProfileSetCounter(ctr, ctypes.c_uint64(5)))
+    _check(lib, lib.MXProfileAdjustCounter(ctr, ctypes.c_int64(-2)))
+    _check(lib, lib.MXProfileSetMarker(dom, b"mark0", b"process"))
+    out = ctypes.c_char_p()
+    _check(lib, lib.MXAggregateProfileStatsPrint(ctypes.byref(out), 0))
+    stats = out.value.decode()
+    assert stats.startswith("Name") and "task0" in stats, stats
+    _check(lib, lib.MXSetProfilerState(0))
+    for h in (task, ctr, dom):
+        _check(lib, lib.MXProfileDestroyHandle(h))

@@ -1,0 +1,74 @@
+"""Large-tensor (int64 index) paths: arrays past the 2^31 element mark.
+
+Reference analog: ``tests/nightly/test_large_array.py`` /
+``test_large_vector.py`` — ops must index with 64-bit arithmetic (the
+reference needs MXNET_USE_INT64_TENSOR_SIZE; here x64 indexing is native
+to jnp/XLA, and these tests pin that contract).  Marked ``slow``: each
+touches multi-GB buffers.
+
+Run: python -m pytest tests/test_large_tensor.py -m slow
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+LARGE = (1 << 31) + 5  # one past the int32 boundary
+
+
+@pytest.mark.slow
+def test_large_vector_create_index_reduce():
+    """> 2^31-element vector: creation, far-end indexing, and a reduction
+    whose COUNT itself exceeds int32 (test_large_vector.py analog)."""
+    a = nd.ones((LARGE,), dtype="uint8")
+    assert a.size == LARGE
+    assert int(a[LARGE - 1].asscalar()) == 1
+    assert int(a[1 << 31].asscalar()) == 1
+    # sum over > int32 elements must not wrap (accumulate wide)
+    total = int(a.astype("float64").sum().asscalar())
+    assert total == LARGE
+    # far-end slice
+    tail = a[LARGE - 3:LARGE]
+    assert tail.shape == (3,)
+    np.testing.assert_array_equal(tail.asnumpy(), np.ones(3, np.uint8))
+
+
+@pytest.mark.slow
+def test_large_vector_elemwise_and_argmax():
+    a = nd.zeros((LARGE,), dtype="uint8")
+    a[LARGE - 2] = 3  # a single hot element past the 2^31 boundary
+    b = a + a
+    assert int(b[LARGE - 2].asscalar()) == 6
+    # np-namespace argmax returns int64, so an index past 2^31 is exact
+    idx = int(mx.np.argmax(mx.np.ndarray(a._data)).item())
+    assert idx == LARGE - 2
+    # the legacy op keeps the reference's float32 output contract, which
+    # cannot represent indices above 2^24 exactly — pin that it lands
+    # within float32 rounding of the true index (the reference has the
+    # same limitation: argmax output dtype is f32)
+    legacy = int(a.argmax(axis=0).asscalar())
+    assert abs(legacy - (LARGE - 2)) <= 256
+
+
+@pytest.mark.slow
+def test_large_2d_take_int64_indices():
+    """take with indices addressing rows past 2^31 elements total."""
+    rows = (1 << 27) + 3          # x 17 cols ≈ 2.28e9 elements
+    cols = 17
+    a = nd.ones((rows, cols), dtype="uint8")
+    picks = nd.array(np.array([0, rows - 1, rows // 2], np.int64))
+    out = nd.take(a, picks)
+    assert out.shape == (3, cols)
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  np.ones((3, cols), np.uint8))
+
+
+@pytest.mark.slow
+def test_large_reshape_transpose_roundtrip():
+    a = nd.arange(0, 256, dtype="uint8").reshape(1, 256)
+    big = nd.broadcast_to(a, ((1 << 23) + 1, 256))  # ≈ 2.15e9 elements
+    assert big.size > (1 << 31)
+    r = big.reshape(-1)
+    assert r.shape == (big.size,)
+    assert int(r[big.size - 1].asscalar()) == 255
