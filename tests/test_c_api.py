@@ -444,3 +444,26 @@ def test_profiler_abi(lib, tmp_path):
     _check(lib, lib.MXSetProfilerState(0))
     for h in (task, ctr, dom):
         _check(lib, lib.MXProfileDestroyHandle(h))
+
+
+def test_serving_bundle(tmp_path):
+    """tools/make_serving_bundle.py (amalgamation/ analog): the bundle
+    serves through MXPred* from a clean environment with nothing from the
+    repo on the path."""
+    import subprocess
+    import sys
+
+    bundle = str(tmp_path / "bundle")
+    rc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools",
+                                      "make_serving_bundle.py"),
+         os.path.join(_REPO, "cpp-package", "model"), bundle, "[2, 8]"],
+        capture_output=True, text=True)
+    assert rc.returncode == 0, rc.stderr
+    run = subprocess.run(
+        [sys.executable, os.path.join(bundle, "serve.py")],
+        capture_output=True, text=True, cwd=bundle,
+        env={"PATH": os.environ.get("PATH", ""), "JAX_PLATFORMS": "cpu"},
+        timeout=300)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "SERVE OK" in run.stdout
