@@ -13,10 +13,117 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-__all__ = ["quantize_model", "quantize_graph", "_get_optimal_threshold"]
+__all__ = ["quantize_model", "quantize_graph", "fold_batch_norm",
+           "_get_optimal_threshold"]
 
 _QUANTIZABLE = {"FullyConnected": "_contrib_quantized_fully_connected",
                 "Convolution": "_contrib_quantized_conv"}
+
+
+def fold_batch_norm(sym, arg_params, aux_params):
+    """Inference-time BN folding: Convolution→BatchNorm collapses into one
+    Convolution with rescaled weights and a bias.
+
+    w' = w * (gamma / sqrt(var + eps)) per output channel,
+    b' = beta - mean * gamma / sqrt(var + eps)  (+ b * gamma / sqrt(...)).
+
+    The reference reaches the same graph via the MKLDNN subgraph fusion
+    (src/operator/subgraph/mkldnn/mkldnn_conv.cc); here it is a symbol
+    rewrite so the int8 pass sees conv(+bias)→relu chains with no f32
+    BatchNorm forcing a dequantize/quantize boundary around every conv.
+    Returns (folded_sym, new_arg_params, new_aux_params).
+    """
+    from ..ndarray import ndarray as _nd
+    from ..symbol.symbol import Symbol, _Node, _toposort
+
+    args = dict(arg_params)
+    aux = dict(aux_params)
+    old_nodes = _toposort([n for n, _ in sym._outputs])
+    # fan-out per node: only fold a conv consumed solely by its BN
+    fanout: Dict[int, int] = {}
+    for node in old_nodes:
+        for p, _i in node.inputs:
+            fanout[id(p)] = fanout.get(id(p), 0) + 1
+    for node, _i in sym._outputs:
+        fanout[id(node)] = fanout.get(id(node), 0) + 1
+
+    mapping: Dict[int, _Node] = {}
+    for node in old_nodes:
+        if node.is_var:
+            mapping[id(node)] = node
+            continue
+        new_inputs = [(mapping[id(p)], i) for p, i in node.inputs]
+        src = node.inputs[0][0] if node.inputs else None
+        bn_axis = int(node.attrs.get("axis", 1)) if node.attrs else 1
+        conv_layout = str(src.attrs.get("layout", "None")) \
+            if (src is not None and not src.is_var) else "None"
+        if node.op in ("BatchNorm", "batch_norm") and src is not None \
+                and not src.is_var and src.op == "Convolution" \
+                and fanout.get(id(src), 0) == 1 and bn_axis == 1 \
+                and (conv_layout in ("None", "") or
+                     conv_layout.startswith("NC")):
+            # inference fold uses the moving statistics (aux states);
+            # guarded to channel-first layouts with BN over axis 1 — any
+            # other combination keeps the BN node (fold would rescale the
+            # wrong weight axis silently)
+            conv = src
+            conv_mapped = mapping[id(conv)]
+            wname = conv.inputs[1][0].name
+            has_bias = (len(conv.inputs) >= 3
+                        and not (conv.inputs[2][0].is_var
+                                 and conv.inputs[2][0].name == "__null__")
+                        and str(conv.attrs.get("no_bias",
+                                               "False")) in ("False", "0"))
+            gamma_n = node.inputs[1][0].name
+            beta_n = node.inputs[2][0].name
+            mean_n = node.inputs[3][0].name
+            var_n = node.inputs[4][0].name
+            if wname not in args or mean_n not in aux or var_n not in aux:
+                mapping[id(node)] = _Node(node.op, node.name,
+                                          dict(node.attrs), new_inputs,
+                                          num_outputs=node.num_outputs)
+                continue
+            eps = float(node.attrs.get("eps", 1e-3))
+            fix_gamma = str(node.attrs.get("fix_gamma", "True")) \
+                not in ("False", "0")
+            w = args[wname].asnumpy()
+            gamma = (np.ones(w.shape[0], np.float32) if fix_gamma
+                     or gamma_n not in args
+                     else args[gamma_n].asnumpy())
+            beta = (args[beta_n].asnumpy() if beta_n in args
+                    else np.zeros(w.shape[0], np.float32))
+            mean = aux[mean_n].asnumpy()
+            var = aux[var_n].asnumpy()
+            scale = gamma / np.sqrt(var + eps)
+            w_f = (w * scale.reshape((-1,) + (1,) * (w.ndim - 1))) \
+                .astype(np.float32)
+            b_old = (args[conv.inputs[2][0].name].asnumpy()
+                     if has_bias else np.zeros(w.shape[0], np.float32))
+            b_f = (beta - mean * scale + b_old * scale).astype(np.float32)
+            # keyed by the CONV name: a shared weight var feeding two
+            # conv+BN pairs must not collide on the folded param names
+            wf_name = conv.name + "_bnfold_weight"
+            bf_name = conv.name + "_bnfold_bias"
+            args[wf_name] = _nd.array(w_f)
+            args[bf_name] = _nd.array(b_f)
+            wf_var = _Node(None, wf_name)
+            bf_var = _Node(None, bf_name)
+            attrs = dict(conv.attrs)
+            attrs["no_bias"] = False
+            folded = _Node("Convolution", conv.name + "_bnfold", attrs,
+                           [conv_mapped.inputs[0], (wf_var, 0),
+                            (bf_var, 0)])
+            mapping[id(node)] = folded
+            continue
+        mapping[id(node)] = _Node(node.op, node.name, dict(node.attrs),
+                                  new_inputs, num_outputs=node.num_outputs)
+
+    folded_sym = Symbol([(mapping[id(n)], i) for n, i in sym._outputs])
+    keep_args = set(folded_sym.list_arguments())
+    keep_aux = set(folded_sym.list_auxiliary_states())
+    return (folded_sym,
+            {k: v for k, v in args.items() if k in keep_args},
+            {k: v for k, v in aux.items() if k in keep_aux})
 
 
 def _get_optimal_threshold(arr, num_bins=1001, num_quantized_bins=255):
@@ -129,11 +236,95 @@ def quantize_graph(sym, excluded_sym_names=(), calib_ranges=None,
         return _Node(op, "%s_q%d" % (hint, uid[0]), attrs, entries,
                      num_outputs=num_outputs)
 
+    # int8-commuting ops: monotone + zero-preserving under the symmetric
+    # int8 map (relu, max-pool) or pure data movement — a dequantize
+    # followed only by these then a re-quantize is replaced by ONE
+    # requantize (int32→int8) with the chain replayed on the int8 tensor
+    # (quantize_graph_pass.cc requantize insertion; avoids bouncing every
+    # activation through f32 HBM between quantized convs — the measured
+    # int8 ceiling, tools/int8_analysis.py)
+    def _commutes(n):
+        if n.op in ("relu", "Flatten", "Reshape", "reshape"):
+            return True
+        if n.op == "Activation" and str(
+                n.attrs.get("act_type", "relu")) == "relu":
+            return True
+        if n.op == "Pooling" and str(
+                n.attrs.get("pool_type", "max")) == "max":
+            return True
+        return False
+
+    _rq_cache = {}
+
+    def int8_source(entry):
+        """If ``entry`` (in the NEW graph) is dequantize∘[commuting ops],
+        return (int8_entry, min_entry, max_entry) on the int8 path.
+        Requantize + replayed links are cached per source node so fanout
+        consumers share one int8 materialization."""
+        chain = []
+        n, _i = entry
+        while not n.is_var and n.op != "_contrib_dequantize":
+            if not _commutes(n) or not n.inputs:
+                return None
+            chain.append(n)
+            n, _i = n.inputs[0]
+        if n.is_var or n.op != "_contrib_dequantize":
+            return None
+        if id(entry[0]) in _rq_cache:
+            return _rq_cache[id(entry[0])]
+        acc, mn, mx = n.inputs[0], n.inputs[1], n.inputs[2]
+        if id(n) in _rq_cache:
+            cur, cmin, cmax = _rq_cache[id(n)]
+        elif acc[0].op in ("_contrib_quantized_elemwise_add",
+                           "_contrib_requantize"):
+            # producer is already int8 with its own ranges: reuse directly
+            # (a second requantize would re-round and rescan for nothing)
+            cur, cmin, cmax = acc, mn, mx
+            _rq_cache[id(n)] = (cur, cmin, cmax)
+        else:
+            # the dequantize node carries the ORIGINAL op's name, so the
+            # calibration table's "<name>_output" range applies to this
+            # requantize — without it every activation pays a full
+            # data-dependent abs-max rescan and entropy calibration is dead
+            rattrs = {}
+            ckey = "%s_output" % n.name
+            if ckey in calib_ranges:
+                rattrs = {"min_calib_range": calib_ranges[ckey][0],
+                          "max_calib_range": calib_ranges[ckey][1]}
+            rq = new_node("_contrib_requantize", "requant", rattrs,
+                          [acc, mn, mx], num_outputs=3)
+            cur, cmin, cmax = (rq, 0), (rq, 1), (rq, 2)
+            _rq_cache[id(n)] = (cur, cmin, cmax)
+        for link in reversed(chain):
+            replay = new_node(link.op, link.name + "_int8",
+                              dict(link.attrs), [cur])
+            cur = (replay, 0)
+        out = (cur, cmin, cmax)
+        _rq_cache[id(entry[0])] = out
+        return out
+
     for node in old_nodes:
         if node.is_var:
             mapping[id(node)] = node
             continue
         new_inputs = [(mapping[id(p)], i) for p, i in node.inputs]
+        if node.op in ("elemwise_add", "_plus", "_Plus", "broadcast_add") \
+                and node.name not in excluded and len(new_inputs) == 2:
+            # residual adds stay on the int8 wire when both operands are
+            # int8-resolvable (quantized_elemwise_add.cc) — the bottleneck
+            # exit otherwise forces dequantize+quantize around every block
+            lhs8 = int8_source(new_inputs[0])
+            rhs8 = int8_source(new_inputs[1])
+            if lhs8 is not None and rhs8 is not None:
+                (le, lmin, lmax), (re_, rmin, rmax) = lhs8, rhs8
+                qadd = new_node("_contrib_quantized_elemwise_add",
+                                node.name + "_qadd", {},
+                                [le, re_, lmin, lmax, rmin, rmax],
+                                num_outputs=3)
+                deq = _Node("_contrib_dequantize", node.name, {},
+                            [(qadd, 0), (qadd, 1), (qadd, 2)])
+                mapping[id(node)] = deq
+                continue
         if node.op in _QUANTIZABLE and node.name not in excluded \
                 and len(new_inputs) >= 2:
             qop = _QUANTIZABLE[node.op]
@@ -147,8 +338,13 @@ def quantize_graph(sym, excluded_sym_names=(), calib_ranges=None,
             elif data_entry[0].is_var and data_entry[0].name in calib_ranges:
                 lo, hi = calib_ranges[data_entry[0].name]
                 dattrs = {"min_calib_range": lo, "max_calib_range": hi}
-            qdata = new_node("_contrib_quantize_v2", "qdata", dattrs,
-                             [data_entry], num_outputs=3)
+            src8 = int8_source(data_entry)
+            if src8 is not None:
+                d_entry, d_min, d_max = src8
+            else:
+                qdata = new_node("_contrib_quantize_v2", "qdata", dattrs,
+                                 [data_entry], num_outputs=3)
+                d_entry, d_min, d_max = (qdata, 0), (qdata, 1), (qdata, 2)
             wattrs = {}
             wname = new_inputs[1][0].name
             if weight_ranges and wname in weight_ranges:
@@ -169,8 +365,8 @@ def quantize_graph(sym, excluded_sym_names=(), calib_ranges=None,
                 bias_entries = [(_NULL_NODE, 0)]
                 bias_ranges = [(_NULL_NODE, 0), (_NULL_NODE, 0)]
             q_attrs = dict(node.attrs)
-            q_entries = ([(qdata, 0), (qweight, 0)] + bias_entries +
-                         [(qdata, 1), (qdata, 2), (qweight, 1),
+            q_entries = ([d_entry, (qweight, 0)] + bias_entries +
+                         [d_min, d_max, (qweight, 1),
                           (qweight, 2)] + bias_ranges)
             qnode = new_node(qop, node.name + "_quantized", q_attrs,
                              q_entries, num_outputs=3)

@@ -395,6 +395,16 @@ class HybridBlock(Block):
         return out
 
     def forward(self, x, *args):
+        from ..symbol.symbol import Symbol
+
+        if isinstance(x, Symbol):
+            # symbolic trace (export/quantize path): params become vars and
+            # nested blocks recurse through this same branch
+            from .. import symbol as sym_mod
+
+            params = {name: p.var()
+                      for name, p in self._reg_params.items()}
+            return self.hybrid_forward(sym_mod, x, *args, **params)
         if (self._active and tracing.current_trace() is None
                 and isinstance(x, NDArray)):
             if self._cached_op is None:
@@ -536,19 +546,20 @@ class HybridBlock(Block):
         inputs = [sym_mod.var("data")]
         out = self._trace_symbol(inputs)
         out.save("%s-symbol.json" % path)
+        aux_names = set(out.list_auxiliary_states())
         arg = {}
         for name, p in params.items():
-            arg["arg:" + name] = p.data()
+            tag = "aux:" if name in aux_names else "arg:"
+            arg[tag + name] = p.data()
         from ..ndarray import save as nd_save
 
         nd_save("%s-%04d.params" % (path, epoch), arg)
         return "%s-symbol.json" % path, "%s-%04d.params" % (path, epoch)
 
     def _trace_symbol(self, inputs):
-        from .. import symbol as sym_mod
-
-        params = {name: p.var() for name, p in self._reg_params.items()}
-        return self.hybrid_forward(sym_mod, *inputs, **params)
+        # forward() routes Symbol inputs through the symbolic branch, so
+        # nested children trace correctly too
+        return self(*inputs)
 
 
 class SymbolBlock(HybridBlock):

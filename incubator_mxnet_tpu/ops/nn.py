@@ -156,8 +156,10 @@ def _pooling(data, kernel=None, pool_type="max", global_pool=False,
     # NB: scalar init values keep the reduce recognizable as the max/add
     # monoid so XLA uses the dedicated (differentiable) pooling primitives.
     if pool_type == "max":
+        # init must carry the operand dtype (an int-typed pool — e.g. the
+        # int8 inference path — rejects a python-int/int64 init)
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \
-            else jnp.iinfo(data.dtype).min
+            else np.asarray(jnp.iinfo(data.dtype).min, data.dtype)[()]
         return lax.reduce_window(data, init, lax.max, window, strides, padding)
     if pool_type in ("avg", "sum"):
         summed = lax.reduce_window(data, 0.0 if jnp.issubdtype(

@@ -90,6 +90,21 @@ def _requantize(data, min_range, max_range, min_calib_range=None,
     return q, -amax, amax
 
 
+@register("_contrib_quantized_elemwise_add", num_inputs=6, num_outputs=3,
+          differentiable=False)
+def _quantized_elemwise_add(a, b, min_a, max_a, min_b, max_b):
+    """int8 + int8 -> int8 residual add with scale alignment
+    (quantized_elemwise_add.cc).  Output range is the sum of input
+    ranges (exact containment, no data-dependent rescan)."""
+    sa = jnp.maximum(jnp.abs(min_a), jnp.abs(max_a)) / 127.0
+    sb = jnp.maximum(jnp.abs(min_b), jnp.abs(max_b)) / 127.0
+    amax_out = sa * 127.0 + sb * 127.0
+    real = a.astype(jnp.float32) * sa + b.astype(jnp.float32) * sb
+    scale = jnp.where(amax_out > 0, 127.0 / amax_out, 1.0)
+    q = jnp.clip(jnp.rint(real * scale), -127, 127).astype(jnp.int8)
+    return q, -amax_out, amax_out
+
+
 @register("_contrib_quantized_fully_connected", num_inputs=9, num_outputs=3,
           differentiable=False)
 def _quantized_fully_connected(data, weight, bias, min_data, max_data,

@@ -1,4 +1,6 @@
 """Quantization tests (model: tests/python/quantization/test_quantization.py)."""
+import os
+
 import numpy as np
 import pytest
 
@@ -88,3 +90,60 @@ def test_quantize_model_excluded_layers():
                                    excluded_sym_names=["fc1"],
                                    calib_mode="none")
     assert "_contrib_quantized_fully_connected" not in qsym.tojson()
+
+
+def test_fold_batch_norm_exact():
+    """fold_batch_norm: conv->BN collapses into conv(+bias) with identical
+    numerics (the MKLDNN conv-BN fusion analog,
+    src/operator/subgraph/mkldnn/mkldnn_conv.cc)."""
+    import tempfile
+
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.contrib.quantization import fold_batch_norm
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    from incubator_mxnet_tpu.parallel import make_train_step
+
+    mx.random.seed(0)
+    net = vision.resnet18_v1(classes=10)
+    net.initialize(init=mx.init.Xavier())
+    net.shape_init((1, 3, 32, 32))
+    step = make_train_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           optimizer="sgd", learning_rate=0.01, momentum=0.9)
+    x = mx.nd.random.uniform(shape=(8, 3, 32, 32))
+    y = mx.nd.array(np.random.RandomState(0).randint(0, 10, 8)
+                    .astype(np.float32))
+    for _ in range(3):
+        step(x, y)
+    with tempfile.TemporaryDirectory() as td:
+        prefix = os.path.join(td, "m")
+        net.export(prefix)
+        sym, args, aux = mx.model.load_checkpoint(prefix, 0)
+
+    def run(s, a, au, x_):
+        binds = dict(a)
+        binds["data"] = mx.nd.array(x_)
+        exe = s.bind(mx.cpu(), args=binds, aux_states=au)
+        return exe.forward(is_train=False)[0].asnumpy()
+
+    xnp = np.random.RandomState(1).uniform(size=(4, 3, 32, 32)) \
+        .astype(np.float32)
+    o_ref = run(sym, args, aux, xnp)
+    fsym, fargs, faux = fold_batch_norm(sym, args, aux)
+    assert fsym.tojson().count("BatchNorm") == 0
+    assert not faux
+    o_f = run(fsym, fargs, faux, xnp)
+    np.testing.assert_allclose(o_ref, o_f, rtol=1e-3, atol=1e-3)
+
+    # fold + quantize: the whole net runs on the int8 wire (requantize
+    # chains + quantized residual adds; dequantize only at the exits)
+    from incubator_mxnet_tpu.contrib.quantization import quantize_model
+
+    qsym, qargs, qaux = quantize_model(fsym, fargs, faux, calib_mode="none")
+    j = qsym.tojson()
+    assert j.count("_contrib_requantize") > 0
+    assert j.count("_contrib_quantized_elemwise_add") > 0
+    assert j.count("_contrib_dequantize") <= 3
+    o_q = run(qsym, qargs, qaux, xnp)
+    cos = float((o_ref * o_q).sum()
+                / (np.linalg.norm(o_ref) * np.linalg.norm(o_q) + 1e-12))
+    assert cos > 0.98, cos
