@@ -333,18 +333,37 @@ def _make_attn(scale, causal, block_q, block_k, interpret):
 
 
 def flash_attention(q, k, v, causal=False, scale: Optional[float] = None,
-                    block_q=128, block_k=128, interpret=None):
+                    block_q=128, block_k=128, interpret=None,
+                    use_pallas=None):
     """Flash attention over (B, H, S, D) tensors.
 
     Returns softmax(QKᵀ·scale [+ causal mask]) V without materializing
-    the score matrix.  Differentiable (custom VJP with flash backward
-    kernels)."""
+    the score matrix.  Differentiable.
+
+    Backend policy (round-4 measurement, docs/PERF.md): on TPU the stock
+    XLA fused attention (`jax.nn.dot_product_attention`) beat this
+    module's Pallas kernels (5.8 vs 6.3 ms at 2048/8/128), so the XLA
+    path is the DEFAULT; the Pallas kernels remain behind
+    ``use_pallas=True`` (and keep serving ring attention's per-shard
+    block compute, where the blockwise-update formulation is required).
+    Interpret-mode (non-TPU backends) keeps Pallas so the kernels stay
+    CPU-tested.
+    """
     b, h, s, d = q.shape
     sk = k.shape[2]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     if interpret is None:
         interpret = _use_interpret()
+    if use_pallas is None:
+        use_pallas = interpret  # real-chip default: XLA fused attention
+    if not use_pallas:
+        # jax.nn.dot_product_attention is (B, S, H, D)
+        out = jax.nn.dot_product_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), scale=float(scale),
+            is_causal=bool(causal))
+        return out.transpose(0, 2, 1, 3)
 
     qf = q.reshape(b * h, s, d)
     kf = k.reshape(b * h, sk, d)
