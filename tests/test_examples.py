@@ -51,3 +51,19 @@ def test_distributed_cifar_example():
     out = _run("example/distributed_training/cifar10_dist.py",
                "--epochs", "1", "--samples", "64", "--batch-size", "16")
     assert "epoch" in out.lower()
+
+
+@pytest.mark.slow
+def test_long_context_lm_example():
+    """Ring-attention sequence-parallel LM (SURVEY §5.7 long-context) on
+    the 8-device virtual mesh — both sp implementations."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    for impl in ("ring", "ulysses"):
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "example", "long_context", "train_lm.py"),
+             "--seq", "256", "--steps", "6", "--impl", impl],
+            capture_output=True, text=True, timeout=600, env=env, cwd=_REPO)
+        assert r.returncode == 0, (impl, r.stdout[-800:], r.stderr[-1500:])
+        assert "PASS" in r.stdout
