@@ -667,3 +667,108 @@ def profile_adjust_counter(counter, delta: int) -> None:
 def profile_set_marker(domain, name: str, scope: str) -> None:
     from . import profiler
     profiler.Marker(name, domain=domain).mark(scope or "process")
+
+
+# ---------------------------------------------------------------------------
+# Legacy function registry (MXFunc* / MXListFunctions ABI)
+# ---------------------------------------------------------------------------
+
+def list_functions():
+    from .ops import registry
+    return sorted({op.name for op in registry.OPS.values()})
+
+
+def func_info(name: str):
+    from .ops import registry
+    info = registry.op_info(name)
+    return (info["name"], info["description"][:512],
+            [i[0] for i in info["inputs"]],
+            [a[0] for a in info["arguments"]],
+            [a[1] for a in info["arguments"]])
+
+
+def func_invoke(name: str, use_handles, scalar_args, mutate_handles):
+    """Old-style imperative call: inputs + float scalars -> writes into
+    mutate_handles (the pre-nnvm MXFuncInvoke contract)."""
+    from .ops import registry
+
+    ins = [h._data for h in use_handles]
+    op = registry.get_op(name)
+    import inspect
+
+    attrs = {}
+    if scalar_args:
+        sig = [p.name for p in inspect.signature(op.fn).parameters.values()
+               if p.default is not inspect.Parameter.empty]
+        for k, v in zip(sig, scalar_args):
+            attrs[k] = float(v)
+    out = op.fn(*ins, **attrs)
+    outs = out if isinstance(out, (tuple, list)) else (out,)
+    import jax.numpy as jnp
+    for h, o in zip(mutate_handles, outs):
+        h._data = jnp.asarray(o)
+
+
+# ---------------------------------------------------------------------------
+# RTC (MXRtcCudaModule* ABI over rtc.PallasModule)
+# ---------------------------------------------------------------------------
+
+def rtc_module_create(source: str, options, exports):
+    from . import rtc
+    return rtc.PallasModule(source, options=tuple(options),
+                            exports=tuple(exports))
+
+
+def rtc_kernel_create(mod, name: str, signature: str = ""):
+    return mod.get_kernel(name, signature)
+
+
+def rtc_kernel_call(kernel, in_handles, out_handles):
+    """Launch with NDArray inputs; results write into out_handles (the
+    CudaKernel.launch contract with outputs taken from mutable args)."""
+    import jax.numpy as jnp
+
+    ins = [h._data for h in in_handles]
+    outs = [(tuple(h.shape), str(h.dtype)) for h in out_handles]
+    res = kernel.launch(ins, out_shape=outs[0] if len(outs) == 1 else outs)
+    res = res if isinstance(res, (tuple, list)) else (res,)
+    from .ndarray import NDArray as _NDA
+    for h, o in zip(out_handles, res):
+        h._data = o._data if isinstance(o, _NDA) else jnp.asarray(o)
+
+
+# ---------------------------------------------------------------------------
+# Engine (MXEnginePush* ABI over engine.NativeEngine)
+# ---------------------------------------------------------------------------
+
+_ENGINE = None
+_ND_VAR = {}
+
+
+def _engine():
+    global _ENGINE
+    if _ENGINE is None:
+        from .engine import NativeEngine
+        _ENGINE = NativeEngine()
+    return _ENGINE
+
+
+def _nd_var(handle):
+    """Per-NDArray engine var (the NDArray::var() mapping)."""
+    key = id(handle)
+    if key not in _ND_VAR:
+        _ND_VAR[key] = _engine().new_var()
+    return _ND_VAR[key]
+
+
+def engine_push(fn, const_nds, mutable_nds, wait: int):
+    eng = _engine()
+    cvars = [_nd_var(h) for h in const_nds]
+    mvars = [_nd_var(h) for h in mutable_nds]
+    eng.push(fn, const_vars=cvars, mutable_vars=mvars)
+    if wait:
+        eng.wait_for_all()
+
+
+def engine_wait_for_nd(handle):
+    _engine().wait_for_var(_nd_var(handle))

@@ -1810,3 +1810,345 @@ MXTPU_API int MXProfileSetMarker(ProfileHandle domain, const char* name,
       Py_BuildValue("(Oss)", static_cast<PyObject*>(domain), name,
                     scope == nullptr ? "process" : scope));
 }
+
+// ---------------------------------------------------------------------------
+// Legacy function registry (MXListFunctions / MXFunc*: c_api.h)
+// ---------------------------------------------------------------------------
+
+typedef void* FunctionHandle;
+
+MXTPU_API int MXListFunctions(uint32_t* out_size, FunctionHandle** out_array) {
+  Gil gil;
+  PyObject* res = CallImpl("list_functions", PyTuple_New(0));
+  if (res == nullptr) return FailFromPython();
+  Py_ssize_t n = PyList_Size(res);
+  g_handle_store.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* s = PyList_GetItem(res, i);
+    Py_INCREF(s);
+    g_handle_store.push_back(s);  // handle == interned op-name string
+  }
+  Py_DECREF(res);
+  *out_size = static_cast<uint32_t>(n);
+  *out_array = g_handle_store.data();
+  return 0;
+}
+
+MXTPU_API int MXFuncGetInfo(FunctionHandle fun, const char** name,
+                            const char** description, uint32_t* num_args,
+                            const char*** arg_names,
+                            const char*** arg_type_infos,
+                            const char*** arg_descriptions,
+                            const char** return_type) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(fun));
+  PyObject* res = CallImpl("func_info", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  static thread_local std::vector<std::string> strs;
+  static thread_local std::vector<const char*> names_p, types_p, descs_p;
+  strs.clear();
+  names_p.clear();
+  types_p.clear();
+  descs_p.clear();
+  strs.emplace_back(PyUnicode_AsUTF8(PyTuple_GetItem(res, 0)));
+  strs.emplace_back(PyUnicode_AsUTF8(PyTuple_GetItem(res, 1)));
+  PyObject* ins = PyTuple_GetItem(res, 2);
+  PyObject* arg_n = PyTuple_GetItem(res, 3);
+  PyObject* arg_t = PyTuple_GetItem(res, 4);
+  size_t base = strs.size();
+  for (Py_ssize_t i = 0; i < PyList_Size(ins); ++i) {
+    strs.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(ins, i)));
+  }
+  size_t n_in = PyList_Size(ins);
+  for (Py_ssize_t i = 0; i < PyList_Size(arg_n); ++i) {
+    strs.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(arg_n, i)));
+  }
+  for (Py_ssize_t i = 0; i < PyList_Size(arg_t); ++i) {
+    strs.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(arg_t, i)));
+  }
+  size_t n_attr = PyList_Size(arg_n);
+  for (size_t i = 0; i < n_in + n_attr; ++i) {
+    names_p.push_back(strs[base + i].c_str());
+    types_p.push_back(i < n_in ? "NDArray"
+                               : strs[base + n_in + n_attr +
+                                      (i - n_in)].c_str());
+    descs_p.push_back("");
+  }
+  Py_DECREF(res);
+  *name = strs[0].c_str();
+  *description = strs[1].c_str();
+  *num_args = static_cast<uint32_t>(n_in + n_attr);
+  *arg_names = names_p.data();
+  *arg_type_infos = types_p.data();
+  *arg_descriptions = descs_p.data();
+  if (return_type != nullptr) *return_type = "NDArray";
+  return 0;
+}
+
+MXTPU_API int MXFuncDescribe(FunctionHandle fun, uint32_t* num_use_vars,
+                             uint32_t* num_scalars,
+                             uint32_t* num_mutate_vars, int* type_mask) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(fun));
+  PyObject* res = CallImpl("func_info", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *num_use_vars = static_cast<uint32_t>(
+      PyList_Size(PyTuple_GetItem(res, 2)));
+  *num_scalars = static_cast<uint32_t>(
+      PyList_Size(PyTuple_GetItem(res, 3)));
+  *num_mutate_vars = 1;
+  *type_mask = 0;
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXFuncInvoke(FunctionHandle fun, NDArrayHandle* use_vars,
+                           float* scalar_args, NDArrayHandle* mutate_vars,
+                           uint32_t num_use_vars, uint32_t num_scalars,
+                           uint32_t num_mutate_vars) {
+  Gil gil;
+  PyObject* uses = nullptr;
+  HandlesToList(num_use_vars, use_vars, &uses);
+  PyObject* muts = nullptr;
+  HandlesToList(num_mutate_vars, mutate_vars, &muts);
+  PyObject* scalars = PyList_New(num_scalars);
+  for (uint32_t i = 0; i < num_scalars; ++i) {
+    PyList_SetItem(scalars, i, PyFloat_FromDouble(scalar_args[i]));
+  }
+  PyObject* args = Py_BuildValue("(ONNN)", static_cast<PyObject*>(fun),
+                                 uses, scalars, muts);
+  PyObject* res = CallImpl("func_invoke", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXFuncInvokeEx(FunctionHandle fun, NDArrayHandle* use_vars,
+                             float* scalar_args, NDArrayHandle* mutate_vars,
+                             uint32_t num_use_vars, uint32_t num_scalars,
+                             uint32_t num_mutate_vars, int num_params,
+                             char** param_keys, char** param_vals) {
+  (void)num_params;
+  (void)param_keys;
+  (void)param_vals;  // string attrs flow through MXImperativeInvokeByName
+  return MXFuncInvoke(fun, use_vars, scalar_args, mutate_vars, num_use_vars,
+                      num_scalars, num_mutate_vars);
+}
+
+// ---------------------------------------------------------------------------
+// RTC (MXRtcCudaModule*: runtime Pallas compilation — rtc.PallasModule)
+// ---------------------------------------------------------------------------
+
+typedef void* CudaModuleHandle;
+typedef void* CudaKernelHandle;
+
+MXTPU_API int MXRtcCudaModuleCreate(const char* source, int num_options,
+                                    const char** options, int num_exports,
+                                    const char** exports,
+                                    CudaModuleHandle* out) {
+  Gil gil;
+  PyObject* opts = StrKeysToList(num_options, options);
+  PyObject* exps = StrKeysToList(num_exports, exports);
+  PyObject* args = Py_BuildValue("(sNN)", source, opts, exps);
+  PyObject* res = CallImpl("rtc_module_create", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXRtcCudaModuleFree(CudaModuleHandle handle) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+MXTPU_API int MXRtcCudaKernelCreate(CudaModuleHandle handle, const char* name,
+                                    int num_args, int* is_ndarray,
+                                    int* is_const, int* arg_types,
+                                    CudaKernelHandle* out) {
+  (void)num_args;
+  (void)is_ndarray;
+  (void)is_const;
+  (void)arg_types;  // types come from launch-time JAX tracing
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", static_cast<PyObject*>(handle),
+                                 name);
+  PyObject* res = CallImpl("rtc_kernel_create", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXRtcCudaKernelFree(CudaKernelHandle handle) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+MXTPU_API int MXRtcCudaKernelCall(CudaKernelHandle handle, int dev_id,
+                                  void** ndarray_args, int num_inputs,
+                                  int num_outputs) {
+  // TPU-native signature: inputs then outputs as NDArray handles (grid /
+  // block / shared-mem of the CUDA ABI have no Pallas meaning; the
+  // kernel's own grid spec governs).  dev_id ignored: XLA places.
+  (void)dev_id;
+  Gil gil;
+  PyObject* ins = nullptr;
+  HandlesToList(static_cast<uint32_t>(num_inputs),
+                reinterpret_cast<NDArrayHandle*>(ndarray_args), &ins);
+  PyObject* outs = nullptr;
+  HandlesToList(static_cast<uint32_t>(num_outputs),
+                reinterpret_cast<NDArrayHandle*>(ndarray_args) + num_inputs,
+                &outs);
+  PyObject* args = Py_BuildValue("(ONN)", static_cast<PyObject*>(handle),
+                                 ins, outs);
+  PyObject* res = CallImpl("rtc_kernel_call", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Engine (MXEnginePush*: c_api.h engine block over the C++ host engine)
+// ---------------------------------------------------------------------------
+
+typedef void (*EngineSyncFunc)(void* data);
+
+namespace {
+
+struct EngineClosure {
+  EngineSyncFunc fn;
+  void* data;
+};
+
+PyObject* CallCEngineFn(PyObject*, PyObject* args) {
+  PyObject* capsule = nullptr;
+  if (!PyArg_ParseTuple(args, "O", &capsule)) return nullptr;
+  auto* cl = static_cast<EngineClosure*>(
+      PyCapsule_GetPointer(capsule, "mxtpu_engine_fn"));
+  if (cl == nullptr) return nullptr;
+  // release the GIL for the user's C work (it may be long-running IO)
+  Py_BEGIN_ALLOW_THREADS
+  cl->fn(cl->data);
+  Py_END_ALLOW_THREADS
+  delete cl;
+  Py_RETURN_NONE;
+}
+
+PyMethodDef g_call_c_engine_fn_def = {
+    "call_c_engine_fn", CallCEngineFn, METH_VARARGS,
+    "trampoline into a C engine op"};
+
+int EnginePushImpl(EngineSyncFunc fn, void* data,
+                   NDArrayHandle* const_nds, int num_const,
+                   NDArrayHandle* mutable_nds, int num_mutable, int wait) {
+  Gil gil;
+  auto* cl = new EngineClosure{fn, data};
+  PyObject* capsule = PyCapsule_New(cl, "mxtpu_engine_fn", nullptr);
+  PyObject* tramp = PyCFunction_New(&g_call_c_engine_fn_def, nullptr);
+  PyObject* functools = PyImport_ImportModule("functools");
+  PyObject* partial = PyObject_GetAttrString(functools, "partial");
+  PyObject* bound = PyObject_CallFunctionObjArgs(partial, tramp, capsule,
+                                                 nullptr);
+  Py_DECREF(functools);
+  Py_DECREF(partial);
+  Py_DECREF(tramp);
+  Py_DECREF(capsule);
+  if (bound == nullptr) {
+    delete cl;
+    return FailFromPython();
+  }
+  PyObject* cn = nullptr;
+  HandlesToList(static_cast<uint32_t>(num_const), const_nds, &cn);
+  PyObject* mn = nullptr;
+  HandlesToList(static_cast<uint32_t>(num_mutable), mutable_nds, &mn);
+  PyObject* args = Py_BuildValue("(NNNi)", bound, cn, mn, wait);
+  PyObject* res = CallImpl("engine_push", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+}  // namespace
+
+MXTPU_API int MXEnginePushSyncND(EngineSyncFunc sync_func, void* func_param,
+                                 void* deleter_param, void* ctx_handle,
+                                 NDArrayHandle* const_nds_handle,
+                                 int num_const_nds,
+                                 NDArrayHandle* mutable_nds_handle,
+                                 int num_mutable_nds) {
+  (void)deleter_param;
+  (void)ctx_handle;
+  return EnginePushImpl(sync_func, func_param, const_nds_handle,
+                        num_const_nds, mutable_nds_handle, num_mutable_nds,
+                        /*wait=*/1);
+}
+
+MXTPU_API int MXEnginePushAsyncND(EngineSyncFunc sync_func, void* func_param,
+                                  void* deleter_param, void* ctx_handle,
+                                  NDArrayHandle* const_nds_handle,
+                                  int num_const_nds,
+                                  NDArrayHandle* mutable_nds_handle,
+                                  int num_mutable_nds) {
+  (void)deleter_param;
+  (void)ctx_handle;
+  return EnginePushImpl(sync_func, func_param, const_nds_handle,
+                        num_const_nds, mutable_nds_handle, num_mutable_nds,
+                        /*wait=*/0);
+}
+
+MXTPU_API int MXEnginePushSync(EngineSyncFunc sync_func, void* func_param,
+                               void* deleter_param, void* ctx_handle,
+                               void* const_vars, int num_const,
+                               void* mutable_vars, int num_mutable) {
+  (void)const_vars;
+  (void)num_const;
+  (void)mutable_vars;
+  (void)num_mutable;  // var-handle form degrades to dep-free execution
+  return EnginePushImpl(sync_func, func_param, nullptr, 0, nullptr, 0, 1);
+}
+
+MXTPU_API int MXEnginePushAsync(EngineSyncFunc sync_func, void* func_param,
+                                void* deleter_param, void* ctx_handle,
+                                void* const_vars, int num_const,
+                                void* mutable_vars, int num_mutable) {
+  (void)const_vars;
+  (void)num_const;
+  (void)mutable_vars;
+  (void)num_mutable;
+  return EnginePushImpl(sync_func, func_param, nullptr, 0, nullptr, 0, 0);
+}
+
+MXTPU_API int MXNDArrayWaitToWrite(NDArrayHandle handle) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallImpl("engine_wait_for_nd", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// misc device queries
+// ---------------------------------------------------------------------------
+
+MXTPU_API int MXGetGPUCount(int* out) {
+  *out = 0;  // no CUDA devices in the TPU runtime
+  return 0;
+}
+
+MXTPU_API int MXGetGPUMemoryInformation64(int dev, uint64_t* free_mem,
+                                          uint64_t* total_mem) {
+  (void)dev;
+  *free_mem = 0;
+  *total_mem = 0;  // CUDA query; TPU HBM is managed by XLA
+  return 0;
+}

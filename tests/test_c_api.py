@@ -467,3 +467,98 @@ def test_serving_bundle(tmp_path):
         timeout=300)
     assert run.returncode == 0, run.stdout + run.stderr
     assert "SERVE OK" in run.stdout
+
+
+def test_func_registry_abi(lib):
+    """MXListFunctions / MXFuncGetInfo / MXFuncInvoke (legacy function
+    registry over the op registry)."""
+    ns = ctypes.c_uint32()
+    arr = ctypes.POINTER(ctypes.c_void_p)()
+    _check(lib, lib.MXListFunctions(ctypes.byref(ns), ctypes.byref(arr)))
+    assert ns.value > 300
+    # find relu's handle
+    handle = None
+    for i in range(ns.value):
+        h = ctypes.cast(arr[i], ctypes.c_void_p)
+        nm = ctypes.c_char_p()
+        # handle is a python str; use GetInfo to read its name
+    # invoke via a fresh known handle: list returns interned names, so
+    # just walk for the one whose info name is 'relu'
+    name = ctypes.c_char_p()
+    desc = ctypes.c_char_p()
+    na = ctypes.c_uint32()
+    anames = ctypes.POINTER(ctypes.c_char_p)()
+    atypes = ctypes.POINTER(ctypes.c_char_p)()
+    adescs = ctypes.POINTER(ctypes.c_char_p)()
+    rett = ctypes.c_char_p()
+    found = None
+    for i in range(ns.value):
+        _check(lib, lib.MXFuncGetInfo(
+            ctypes.c_void_p(arr[i]), ctypes.byref(name), ctypes.byref(desc),
+            ctypes.byref(na), ctypes.byref(anames), ctypes.byref(atypes),
+            ctypes.byref(adescs), ctypes.byref(rett)))
+        if name.value == b"relu":
+            found = ctypes.c_void_p(arr[i])
+            break
+    assert found is not None
+    x = _make_nd(lib, np.array([-1.0, 2.0, -3.0], np.float32))
+    out = _make_nd(lib, np.zeros(3, np.float32))
+    _check(lib, lib.MXFuncInvoke(found, (ctypes.c_void_p * 1)(x), None,
+                                 (ctypes.c_void_p * 1)(out), 1, 0, 1))
+    np.testing.assert_allclose(_to_np(lib, out, (3,)), [0.0, 2.0, 0.0])
+
+
+def test_rtc_abi(lib):
+    """MXRtcCudaModule*/Kernel* over runtime Pallas compilation (rtc.py)."""
+    src = b"""
+def scale_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 3.0
+"""
+    mod = ctypes.c_void_p()
+    exports = (ctypes.c_char_p * 1)(b"scale_kernel")
+    _check(lib, lib.MXRtcCudaModuleCreate(src, 0, None, 1, exports,
+                                          ctypes.byref(mod)))
+    kern = ctypes.c_void_p()
+    _check(lib, lib.MXRtcCudaKernelCreate(mod, b"scale_kernel", 0, None,
+                                          None, None, ctypes.byref(kern)))
+    x = _make_nd(lib, np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    out = _make_nd(lib, np.zeros((2, 2), np.float32))
+    args = (ctypes.c_void_p * 2)(x, out)
+    _check(lib, lib.MXRtcCudaKernelCall(kern, 0, args, 1, 1))
+    np.testing.assert_allclose(_to_np(lib, out, (2, 2)),
+                               [[3.0, 6.0], [9.0, 12.0]])
+    _check(lib, lib.MXRtcCudaKernelFree(kern))
+    _check(lib, lib.MXRtcCudaModuleFree(mod))
+
+
+def test_engine_push_abi(lib):
+    """MXEnginePushSyncND / MXEnginePushAsyncND + MXNDArrayWaitToWrite:
+    C callbacks scheduled through the host dependency engine."""
+    ENGINE_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+    hits = []
+
+    @ENGINE_FN
+    def work(data):
+        hits.append(int(data or 0))
+
+    nd1 = _make_nd(lib, np.ones(4, np.float32))
+    _check(lib, lib.MXEnginePushSyncND(
+        work, ctypes.c_void_p(7), None, None,
+        (ctypes.c_void_p * 1)(nd1), 1, None, 0))
+    assert hits == [7]
+    _check(lib, lib.MXEnginePushAsyncND(
+        work, ctypes.c_void_p(9), None, None,
+        None, 0, (ctypes.c_void_p * 1)(nd1), 1))
+    _check(lib, lib.MXNDArrayWaitToWrite(nd1))
+    _check(lib, lib.MXEngineWaitAll())
+    assert hits == [7, 9]
+
+
+def test_gpu_queries_abi(lib):
+    n = ctypes.c_int(-1)
+    _check(lib, lib.MXGetGPUCount(ctypes.byref(n)))
+    assert n.value == 0
+    free = ctypes.c_uint64()
+    tot = ctypes.c_uint64()
+    _check(lib, lib.MXGetGPUMemoryInformation64(0, ctypes.byref(free),
+                                                ctypes.byref(tot)))
