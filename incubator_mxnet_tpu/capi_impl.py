@@ -772,3 +772,84 @@ def engine_push(fn, const_nds, mutable_nds, wait: int):
 
 def engine_wait_for_nd(handle):
     _engine().wait_for_var(_nd_var(handle))
+
+
+# ---------------------------------------------------------------------------
+# Symbol tail (MXSymbolGetName/Attr/Copy/Internals/... ABI)
+# ---------------------------------------------------------------------------
+
+def symbol_get_name(s):
+    n = s.name
+    return n if n is not None else ""
+
+
+def symbol_get_attr(s, key: str):
+    v = s.attr(key)
+    return v if v is not None else ""
+
+
+def symbol_set_attr(s, key: str, value: str) -> None:
+    s._set_attr(**{key: value})
+
+
+def symbol_list_attr(s):
+    out = []
+    for k, v in sorted(s.list_attr().items()):
+        out.append(k)
+        out.append(str(v))
+    return out
+
+
+def symbol_copy(s):
+    import copy
+    return copy.deepcopy(s)
+
+
+def symbol_get_internals(s):
+    return s.get_internals()
+
+
+def symbol_get_children(s):
+    c = s.get_children()
+    if c is None:
+        raise ValueError("symbol has no children")
+    return c
+
+
+def symbol_get_output(s, index: int):
+    return s[int(index)]
+
+
+def symbol_get_num_outputs(s) -> int:
+    return len(s.list_outputs())
+
+
+def symbol_save_file(s, fname: str) -> None:
+    s.save(fname)
+
+
+def symbol_load_file(fname: str):
+    from .symbol.symbol import load
+    return load(fname)
+
+
+def symbol_print(s) -> str:
+    lines = ["Symbol outputs: %s" % ", ".join(s.list_outputs()),
+             "arguments: %s" % ", ".join(s.list_arguments())]
+    aux = s.list_auxiliary_states()
+    if aux:
+        lines.append("auxiliary: %s" % ", ".join(aux))
+    return "\n".join(lines)
+
+
+def symbol_infer_type(s, keys, dtype_codes):
+    """Returns (arg_codes, out_codes, aux_codes) via the mshadow dtype
+    code table (_CODE_OF)."""
+    known = {}
+    for k, c in zip(keys, dtype_codes):
+        known[k] = _DTYPE_OF[int(c)]
+    args_t, outs_t, aux_t = s.infer_type(**known)
+
+    def codes(lst):
+        return [(-1 if t is None else _CODE_OF[np.dtype(t)]) for t in lst]
+    return codes(args_t), codes(outs_t), codes(aux_t)

@@ -2152,3 +2152,183 @@ MXTPU_API int MXGetGPUMemoryInformation64(int dev, uint64_t* free_mem,
   *total_mem = 0;  // CUDA query; TPU HBM is managed by XLA
   return 0;
 }
+
+// ---------------------------------------------------------------------------
+// Symbol tail (MXSymbolGetName/Attr/Copy/Internals/InferType/...)
+// ---------------------------------------------------------------------------
+
+namespace {
+
+int SymbolToSymbol(const char* fn, SymbolHandle sym, SymbolHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallImpl(fn, args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+int SymbolToString(const char* fn, SymbolHandle sym, const char** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallImpl(fn, args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  g_json_buf = PyUnicode_AsUTF8(res);
+  Py_DECREF(res);
+  *out = g_json_buf.c_str();
+  return 0;
+}
+
+}  // namespace
+
+MXTPU_API int MXSymbolGetName(SymbolHandle sym, const char** out,
+                              int* success) {
+  int rc = SymbolToString("symbol_get_name", sym, out);
+  if (success != nullptr) *success = (rc == 0 && **out != '\0') ? 1 : 0;
+  return rc;
+}
+
+MXTPU_API int MXSymbolGetAttr(SymbolHandle sym, const char* key,
+                              const char** out, int* success) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", static_cast<PyObject*>(sym), key);
+  PyObject* res = CallImpl("symbol_get_attr", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  g_json_buf = PyUnicode_AsUTF8(res);
+  Py_DECREF(res);
+  *out = g_json_buf.c_str();
+  if (success != nullptr) *success = g_json_buf.empty() ? 0 : 1;
+  return 0;
+}
+
+MXTPU_API int MXSymbolSetAttr(SymbolHandle sym, const char* key,
+                              const char* value) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oss)", static_cast<PyObject*>(sym), key,
+                                 value);
+  PyObject* res = CallImpl("symbol_set_attr", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXSymbolListAttr(SymbolHandle sym, uint32_t* out_size,
+                               const char*** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallImpl("symbol_list_attr", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  StoreStringList(res, out_size, out);
+  Py_DECREF(res);
+  *out_size /= 2;  // (key, value) pairs — reference returns pair count
+  return 0;
+}
+
+MXTPU_API int MXSymbolListAttrShallow(SymbolHandle sym, uint32_t* out_size,
+                                      const char*** out) {
+  return MXSymbolListAttr(sym, out_size, out);
+}
+
+MXTPU_API int MXSymbolCopy(SymbolHandle sym, SymbolHandle* out) {
+  return SymbolToSymbol("symbol_copy", sym, out);
+}
+
+MXTPU_API int MXSymbolGetInternals(SymbolHandle sym, SymbolHandle* out) {
+  return SymbolToSymbol("symbol_get_internals", sym, out);
+}
+
+MXTPU_API int MXSymbolGetChildren(SymbolHandle sym, SymbolHandle* out) {
+  return SymbolToSymbol("symbol_get_children", sym, out);
+}
+
+MXTPU_API int MXSymbolGetOutput(SymbolHandle sym, uint32_t index,
+                                SymbolHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OI)", static_cast<PyObject*>(sym), index);
+  PyObject* res = CallImpl("symbol_get_output", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXSymbolGetNumOutputs(SymbolHandle sym, uint32_t* out) {
+  Gil gil;
+  int v = 0;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  int rc = CallIntImpl("symbol_get_num_outputs", args, &v);
+  *out = static_cast<uint32_t>(v);
+  return rc;
+}
+
+MXTPU_API int MXSymbolSaveToFile(SymbolHandle sym, const char* fname) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", static_cast<PyObject*>(sym), fname);
+  PyObject* res = CallImpl("symbol_save_file", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXSymbolCreateFromFile(const char* fname, SymbolHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", fname);
+  PyObject* res = CallImpl("symbol_load_file", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXSymbolPrint(SymbolHandle sym, const char** out_str) {
+  return SymbolToString("symbol_print", sym, out_str);
+}
+
+MXTPU_API int MXSymbolInferType(SymbolHandle sym, uint32_t num_args,
+                                const char** keys, const int* arg_type_data,
+                                uint32_t* in_type_size,
+                                const int** in_type_data,
+                                uint32_t* out_type_size,
+                                const int** out_type_data,
+                                uint32_t* aux_type_size,
+                                const int** aux_type_data, int* complete) {
+  Gil gil;
+  PyObject* k = StrKeysToList(num_args, keys);
+  PyObject* codes = PyList_New(num_args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    PyList_SetItem(codes, i, PyLong_FromLong(arg_type_data[i]));
+  }
+  PyObject* args = Py_BuildValue("(ONN)", static_cast<PyObject*>(sym), k,
+                                 codes);
+  PyObject* res = CallImpl("symbol_infer_type", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  static thread_local std::vector<int> in_t, out_t, aux_t;
+  auto fill = [&](PyObject* lst, std::vector<int>* dst) {
+    dst->clear();
+    for (Py_ssize_t i = 0; i < PyList_Size(lst); ++i) {
+      dst->push_back(static_cast<int>(
+          PyLong_AsLong(PyList_GetItem(lst, i))));
+    }
+  };
+  fill(PyTuple_GetItem(res, 0), &in_t);
+  fill(PyTuple_GetItem(res, 1), &out_t);
+  fill(PyTuple_GetItem(res, 2), &aux_t);
+  Py_DECREF(res);
+  *in_type_size = static_cast<uint32_t>(in_t.size());
+  *in_type_data = in_t.data();
+  *out_type_size = static_cast<uint32_t>(out_t.size());
+  *out_type_data = out_t.data();
+  *aux_type_size = static_cast<uint32_t>(aux_t.size());
+  *aux_type_data = aux_t.data();
+  bool done = true;
+  for (int c : in_t) done = done && c != -1;
+  if (complete != nullptr) *complete = done ? 1 : 0;
+  return 0;
+}

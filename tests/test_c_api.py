@@ -562,3 +562,59 @@ def test_gpu_queries_abi(lib):
     tot = ctypes.c_uint64()
     _check(lib, lib.MXGetGPUMemoryInformation64(0, ctypes.byref(free),
                                                 ctypes.byref(tot)))
+
+
+def test_symbol_tail_abi(lib, tmp_path):
+    """MXSymbolGetName/Attr/SetAttr/Copy/Internals/GetOutput/InferType/
+    SaveToFile/CreateFromFile/Print."""
+    v = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateVariable(b"data", ctypes.byref(v)))
+    s = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateFromOp(
+        b"relu", 0, (ctypes.c_char_p * 0)(), (ctypes.c_char_p * 0)(),
+        1, (ctypes.c_char_p * 1)(b"data"), (ctypes.c_void_p * 1)(v),
+        b"act0", ctypes.byref(s)))
+    name = ctypes.c_char_p()
+    ok = ctypes.c_int()
+    _check(lib, lib.MXSymbolGetName(s, ctypes.byref(name), ctypes.byref(ok)))
+    assert name.value == b"act0" and ok.value == 1
+    _check(lib, lib.MXSymbolSetAttr(s, b"__lr_mult__", b"2.0"))
+    val = ctypes.c_char_p()
+    _check(lib, lib.MXSymbolGetAttr(s, b"__lr_mult__", ctypes.byref(val),
+                                    ctypes.byref(ok)))
+    assert val.value == b"2.0" and ok.value == 1
+    cp = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCopy(s, ctypes.byref(cp)))
+    n_out = ctypes.c_uint32()
+    _check(lib, lib.MXSymbolGetNumOutputs(cp, ctypes.byref(n_out)))
+    assert n_out.value == 1
+    internals = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolGetInternals(s, ctypes.byref(internals)))
+    o0 = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolGetOutput(s, 0, ctypes.byref(o0)))
+    txt = ctypes.c_char_p()
+    _check(lib, lib.MXSymbolPrint(s, ctypes.byref(txt)))
+    assert b"data" in txt.value
+    # infer type: data f32 -> out f32
+    keys = (ctypes.c_char_p * 1)(b"data")
+    codes = (ctypes.c_int * 1)(0)
+    isz = ctypes.c_uint32()
+    osz = ctypes.c_uint32()
+    asz = ctypes.c_uint32()
+    ip = ctypes.POINTER(ctypes.c_int)()
+    op = ctypes.POINTER(ctypes.c_int)()
+    ap = ctypes.POINTER(ctypes.c_int)()
+    comp = ctypes.c_int()
+    _check(lib, lib.MXSymbolInferType(
+        s, 1, keys, codes, ctypes.byref(isz), ctypes.byref(ip),
+        ctypes.byref(osz), ctypes.byref(op), ctypes.byref(asz),
+        ctypes.byref(ap), ctypes.byref(comp)))
+    assert comp.value == 1 and osz.value == 1 and op[0] == 0
+    # file round trip
+    path = str(tmp_path / "sym.json").encode()
+    _check(lib, lib.MXSymbolSaveToFile(s, path))
+    s2 = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateFromFile(path, ctypes.byref(s2)))
+    _check(lib, lib.MXSymbolGetName(s2, ctypes.byref(name),
+                                    ctypes.byref(ok)))
+    assert name.value == b"act0"
