@@ -155,6 +155,14 @@ def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
     times = step.aot_compile(x, y)
     log("trace+lower %.1fs, XLA compile %.1fs" %
         (times["trace"], times["compile"]))
+    if times["compile"] > 120:
+        # loud cache-discipline failure (round checklist, docs/PERF.md):
+        # a cold compile here means .jax_cache was invalidated after a
+        # train-step change without re-warming (`python bench.py
+        # --chunks 2`); the driver's clock would otherwise eat the budget
+        log("WARNING: cold XLA compile (%.0fs) — .jax_cache was NOT "
+            "warmed for this program; run `python bench.py --chunks 2` "
+            "after train-step changes" % times["compile"])
 
     t = time.time()
     loss = step(x, y)

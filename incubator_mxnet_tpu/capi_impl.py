@@ -853,3 +853,45 @@ def symbol_infer_type(s, keys, dtype_codes):
     def codes(lst):
         return [(-1 if t is None else _CODE_OF[np.dtype(t)]) for t in lst]
     return codes(args_t), codes(outs_t), codes(aux_t)
+
+
+# ---------------------------------------------------------------------------
+# Quantization + subgraph + kvstore tail + raw-bytes ABI
+# ---------------------------------------------------------------------------
+
+def quantize_symbol(sym, excluded_names):
+    from .contrib.quantization import quantize_graph
+    return quantize_graph(sym, excluded_sym_names=tuple(excluded_names))
+
+
+def gen_backend_subgraph(sym, backend: str):
+    from .subgraph import partition
+    return partition(sym, backend=backend or None)
+
+
+def kvstore_pushpull(kv, keys, vals, outs, priority: int) -> None:
+    kv.pushpull(list(keys), list(vals), out=list(outs),
+                priority=int(priority))
+
+
+def kvstore_set_gradient_compression(kv, keys, vals) -> None:
+    params = dict(zip(keys, vals))
+    if "threshold" in params:
+        params["threshold"] = float(params["threshold"])
+    kv.set_gradient_compression(params)
+
+
+def ndarray_save_raw_bytes(handle) -> bytes:
+    """Single-array wire serialization (MXNDArraySaveRawBytes) — reuses the
+    .params container for one unnamed array."""
+    from .ndarray.legacy_io import save_legacy
+    return save_legacy([handle])
+
+
+def ndarray_load_from_raw_bytes(data: bytes):
+    from .ndarray.legacy_io import load_legacy_buffer
+    out = load_legacy_buffer(bytes(data))
+    arrays = out[0] if isinstance(out, tuple) else out
+    if isinstance(arrays, dict):
+        return next(iter(arrays.values()))
+    return arrays[0]

@@ -2332,3 +2332,119 @@ MXTPU_API int MXSymbolInferType(SymbolHandle sym, uint32_t num_args,
   if (complete != nullptr) *complete = done ? 1 : 0;
   return 0;
 }
+
+// ---------------------------------------------------------------------------
+// Quantization / subgraph / kvstore tail / raw-bytes
+// ---------------------------------------------------------------------------
+
+MXTPU_API int MXQuantizeSymbol(SymbolHandle sym, SymbolHandle* out,
+                               const uint32_t num_excluded,
+                               const char** excluded_symbols,
+                               const uint32_t num_offline,
+                               const char** offline_params,
+                               const char* quantized_dtype) {
+  (void)num_offline;
+  (void)offline_params;  // weights quantize in-graph (quantize_v2)
+  (void)quantized_dtype;  // int8 only on the MXU
+  Gil gil;
+  PyObject* ex = StrKeysToList(num_excluded, excluded_symbols);
+  PyObject* args = Py_BuildValue("(ON)", static_cast<PyObject*>(sym), ex);
+  PyObject* res = CallImpl("quantize_symbol", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXGenBackendSubgraph(SymbolHandle sym, const char* backend,
+                                   SymbolHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", static_cast<PyObject*>(sym),
+                                 backend == nullptr ? "" : backend);
+  PyObject* res = CallImpl("gen_backend_subgraph", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXKVStorePushPull(KVStoreHandle kv, uint32_t num,
+                                const int* keys, NDArrayHandle* vals,
+                                NDArrayHandle* outs, int priority) {
+  Gil gil;
+  PyObject* k = IntKeysToList(num, keys);
+  PyObject* v = nullptr;
+  HandlesToList(num, vals, &v);
+  PyObject* o = nullptr;
+  HandlesToList(num, outs, &o);
+  PyObject* args = Py_BuildValue("(ONNNi)", static_cast<PyObject*>(kv), k,
+                                 v, o, priority);
+  PyObject* res = CallImpl("kvstore_pushpull", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXKVStorePushPullEx(KVStoreHandle kv, uint32_t num,
+                                  const char** keys, NDArrayHandle* vals,
+                                  NDArrayHandle* outs, int priority) {
+  Gil gil;
+  PyObject* k = StrKeysToList(num, keys);
+  PyObject* v = nullptr;
+  HandlesToList(num, vals, &v);
+  PyObject* o = nullptr;
+  HandlesToList(num, outs, &o);
+  PyObject* args = Py_BuildValue("(ONNNi)", static_cast<PyObject*>(kv), k,
+                                 v, o, priority);
+  PyObject* res = CallImpl("kvstore_pushpull", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXKVStoreSetGradientCompression(KVStoreHandle kv,
+                                              uint32_t num_params,
+                                              const char** keys,
+                                              const char** vals) {
+  Gil gil;
+  PyObject* k = StrKeysToList(num_params, keys);
+  PyObject* v = StrKeysToList(num_params, vals);
+  PyObject* args = Py_BuildValue("(ONN)", static_cast<PyObject*>(kv), k, v);
+  PyObject* res = CallImpl("kvstore_set_gradient_compression", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t* out_size,
+                                    const char** out_buf) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallImpl("ndarray_save_raw_bytes", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  char* b = nullptr;
+  Py_ssize_t n = 0;
+  PyBytes_AsStringAndSize(res, &b, &n);
+  g_json_buf.assign(b, static_cast<size_t>(n));
+  Py_DECREF(res);
+  *out_buf = g_json_buf.data();
+  *out_size = g_json_buf.size();
+  return 0;
+}
+
+MXTPU_API int MXNDArrayLoadFromRawBytes(const void* buf, size_t size,
+                                        NDArrayHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(y#)", static_cast<const char*>(buf),
+      static_cast<Py_ssize_t>(size));
+  PyObject* res = CallImpl("ndarray_load_from_raw_bytes", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}

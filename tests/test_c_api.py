@@ -618,3 +618,60 @@ def test_symbol_tail_abi(lib, tmp_path):
     _check(lib, lib.MXSymbolGetName(s2, ctypes.byref(name),
                                     ctypes.byref(ok)))
     assert name.value == b"act0"
+
+
+def test_quantize_and_subgraph_abi(lib):
+    """MXQuantizeSymbol + MXGenBackendSubgraph through the C ABI."""
+    v = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateVariable(b"data", ctypes.byref(v)))
+    w = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateVariable(b"w", ctypes.byref(w)))
+    s = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateFromOp(
+        b"FullyConnected", 1, (ctypes.c_char_p * 1)(b"num_hidden"),
+        (ctypes.c_char_p * 1)(b"8"), 2,
+        (ctypes.c_char_p * 2)(b"data", b"weight"),
+        (ctypes.c_void_p * 2)(v, w), b"fc0", ctypes.byref(s)))
+    q = ctypes.c_void_p()
+    _check(lib, lib.MXQuantizeSymbol(s, ctypes.byref(q), 0, None, 0, None,
+                                     b"int8"))
+    js = ctypes.c_char_p()
+    _check(lib, lib.MXSymbolSaveToJSON(q, ctypes.byref(js)))
+    assert b"_contrib_quantized_fully_connected" in js.value
+    sub = ctypes.c_void_p()
+    _check(lib, lib.MXGenBackendSubgraph(s, b"xla", ctypes.byref(sub)))
+
+
+def test_ndarray_raw_bytes_abi(lib):
+    x = _make_nd(lib, np.arange(6, dtype=np.float32).reshape(2, 3))
+    buf = ctypes.c_char_p()
+    sz = ctypes.c_size_t()
+    _check(lib, lib.MXNDArraySaveRawBytes(x, ctypes.byref(sz),
+                                          ctypes.byref(buf)))
+    raw = ctypes.string_at(buf, sz.value)
+    y = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayLoadFromRawBytes(raw, len(raw),
+                                              ctypes.byref(y)))
+    np.testing.assert_array_equal(_to_np(lib, y, (2, 3)),
+                                  np.arange(6, dtype=np.float32)
+                                  .reshape(2, 3))
+
+
+def test_kvstore_pushpull_and_compression_abi(lib):
+    kv = ctypes.c_void_p()
+    _check(lib, lib.MXKVStoreCreate(b"local", ctypes.byref(kv)))
+    keys = (ctypes.c_int * 1)(5)
+    _check(lib, lib.MXKVStoreInit(
+        kv, 1, keys,
+        (ctypes.c_void_p * 1)(_make_nd(lib, np.zeros(4, np.float32)))))
+    _check(lib, lib.MXKVStoreSetGradientCompression(
+        kv, 2, (ctypes.c_char_p * 2)(b"type", b"threshold"),
+        (ctypes.c_char_p * 2)(b"2bit", b"0.5")))
+    g = _make_nd(lib, np.full(4, 1.0, np.float32))
+    out = _make_nd(lib, np.zeros(4, np.float32))
+    _check(lib, lib.MXKVStorePushPull(kv, 1, keys,
+                                      (ctypes.c_void_p * 1)(g),
+                                      (ctypes.c_void_p * 1)(out), 0))
+    got = _to_np(lib, out, (4,))
+    assert np.isfinite(got).all()
+    _check(lib, lib.MXKVStoreFree(kv))
