@@ -180,6 +180,7 @@ class TrainStep:
             new_p, new_s = opt.apply(p_vals, grads, opt_state, step_count)
             return loss_val, new_p, list(new_aux), new_s, key, step_count
 
+        self._step_fn = step  # shared by the multi-step (scan) program
         donate = (0, 1, 2, 5, 6) if self._donate else ()
         if self.mesh is None:
             return jax.jit(step, donate_argnums=donate)
@@ -326,6 +327,88 @@ class TrainStep:
         self._compiled_key = ((xv.shape, str(xv.dtype)),
                               (yv.shape, str(yv.dtype)))
         return {"trace": t_trace, "compile": t_compile}
+
+    def _build_multi(self):
+        """K steps in ONE compiled program: lax.scan over stacked batches.
+
+        Removes per-step dispatch/launch entirely (useful when host
+        latency or program-launch overhead matters — e.g. tunneled or
+        congested runtimes) and is the natural carrier for gradient-
+        accumulation-style loops.  Params/opt-state/key/step thread
+        through the scan carry; returns per-step losses.
+        """
+        step = self._step_fn
+
+        def multi(p_vals, aux_vals, opt_state, xs, ys, key, step_count):
+            def body(carry, xy):
+                p, a, st, k, c = carry
+                x, y = xy
+                loss, p2, a2, s2, k2, c2 = step(p, a, st, x, y, k, c)
+                return (p2, a2, s2, k2, c2), loss
+
+            carry, losses = jax.lax.scan(
+                body, (p_vals, aux_vals, opt_state, key, step_count),
+                (xs, ys))
+            p, a, st, k, c = carry
+            return losses, p, a, st, k, c
+
+        donate = (0, 1, 2, 5, 6) if self._donate else ()
+        if self.mesh is None:
+            return jax.jit(multi, donate_argnums=donate)
+        p_sh, aux_sh, state_sh, batch_sh, repl = self._shardings
+        stack_sh = NamedSharding(self.mesh, P(None, self.batch_axis))
+        return jax.jit(multi, donate_argnums=donate,
+                       in_shardings=(p_sh, aux_sh, state_sh, stack_sh,
+                                     stack_sh, repl, repl),
+                       out_shardings=(repl, p_sh, aux_sh, state_sh, repl,
+                                      repl))
+
+    def run_steps(self, xs, ys):
+        """Run ``K = len(xs)`` steps as one program (see _build_multi).
+        ``xs``/``ys``: stacked arrays with a leading K axis, or sequences
+        of per-step batches.  Returns the K losses as an NDArray."""
+        self._ensure_built()
+        if isinstance(xs, (list, tuple)):
+            xs = jnp.stack([x._data if isinstance(x, NDArray)
+                            else jnp.asarray(x) for x in xs])
+        else:
+            xs = xs._data if isinstance(xs, NDArray) else jnp.asarray(xs)
+        if isinstance(ys, (list, tuple)):
+            ys = jnp.stack([y._data if isinstance(y, NDArray)
+                            else jnp.asarray(y) for y in ys])
+        else:
+            ys = ys._data if isinstance(ys, NDArray) else jnp.asarray(ys)
+        if getattr(self, "_multi_jit", None) is None:
+            self._multi_jit = self._build_multi()
+        p_vals = [p._data._data for p in self._gp]
+        aux_vals = [p._data._data for p in self._aux]
+        if self.mesh is not None:
+            if not self._placed:
+                p_vals, aux_vals = self._place_state(p_vals, aux_vals)
+            from jax.sharding import NamedSharding as _NS
+
+            stack_sh = _NS(self.mesh, P(None, self.batch_axis))
+            if self._multihost:
+                from jax.experimental import multihost_utils as mhu
+
+                xs = mhu.host_local_array_to_global_array(
+                    xs, self.mesh, stack_sh.spec)
+                ys = mhu.host_local_array_to_global_array(
+                    ys, self.mesh, stack_sh.spec)
+            else:
+                xs = jax.device_put(xs, stack_sh)
+                ys = jax.device_put(ys, stack_sh)
+        k = xs.shape[0]
+        losses, new_p, new_aux, new_s, self._key_dev, self._step_dev = \
+            self._multi_jit(p_vals, aux_vals, self._opt_state, xs, ys,
+                            self._key_dev, self._step_dev)
+        self._step_count += int(k)
+        for pp, v in zip(self._gp, new_p):
+            pp._data._data = v
+        for pp, v in zip(self._aux, new_aux):
+            pp._data._data = v
+        self._opt_state = new_s
+        return NDArray(losses)
 
     def __call__(self, x, y):
         self._ensure_built()

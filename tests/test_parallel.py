@@ -207,3 +207,40 @@ def test_train_step_carried_rng_reseed():
     b = [float(step(x, y).asscalar()) for _ in range(2)]
     assert a == b
     assert step._step_count == int(step._step_dev) == 6
+
+
+def test_train_step_run_steps_matches_sequential():
+    """K steps as one scanned program (TrainStep.run_steps) must be
+    bitwise-consistent with K sequential step() calls — single-device and
+    on the dp mesh."""
+    from incubator_mxnet_tpu import gluon, nd
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.parallel import make_train_step
+
+    def build():
+        mx.random.seed(5)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(32, activation="relu"), nn.BatchNorm(),
+                nn.Dense(4))
+        net.initialize(init=mx.init.Xavier())
+        net.shape_init((1, 16))
+        return net
+
+    x = nd.random.uniform(shape=(16, 16))
+    y = nd.array(np.random.RandomState(0).randint(0, 4, 16)
+                 .astype(np.float32))
+    s1 = make_train_step(build(), gluon.loss.SoftmaxCrossEntropyLoss(),
+                         optimizer="sgd", learning_rate=0.05, momentum=0.9)
+    seq = [float(s1(x, y).asscalar()) for _ in range(6)]
+    s2 = make_train_step(build(), gluon.loss.SoftmaxCrossEntropyLoss(),
+                         optimizer="sgd", learning_rate=0.05, momentum=0.9)
+    multi = list(s2.run_steps([x] * 3, [y] * 3).asnumpy()) + \
+        list(s2.run_steps([x] * 3, [y] * 3).asnumpy())
+    np.testing.assert_allclose(seq, multi, rtol=1e-5, atol=1e-6)
+    assert s2._step_count == 6
+    mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    s3 = make_train_step(build(), gluon.loss.SoftmaxCrossEntropyLoss(),
+                         optimizer="sgd", learning_rate=0.05, momentum=0.9,
+                         mesh=mesh)
+    lm = s3.run_steps([x] * 3, [y] * 3).asnumpy()
+    np.testing.assert_allclose(lm, seq[:3], rtol=1e-5, atol=1e-6)
