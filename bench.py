@@ -224,6 +224,70 @@ def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
 BASELINE_INFER_IMG_S = 2355.04  # V100 fp16 batch-128 inference (perf.md:210)
 
 
+def run_infer_int8(batch_size=128, image_size=224, iters=20):
+    """INT8 ResNet-50 inference through the round-4 int8 wire
+    (fold_batch_norm + requantize chaining + quantized residual adds,
+    docs/PERF.md) vs the bf16 forward — reports both img/s and the ratio.
+    """
+    jax = setup_jax()
+    import tempfile
+
+    import numpy as np
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.contrib.quantization import (fold_batch_norm,
+                                                          quantize_model)
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    log("devices: %s" % (jax.devices(),))
+    mx.random.seed(0)
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(init=mx.init.Xavier())
+    net.shape_init((1, 3, image_size, image_size))
+    with tempfile.TemporaryDirectory() as td:
+        prefix = os.path.join(td, "r50")
+        net.export(prefix)
+        sym, args, aux = mx.model.load_checkpoint(prefix, 0)
+    fsym, fargs, faux = fold_batch_norm(sym, args, aux)
+    qsym, qargs, qaux = quantize_model(fsym, fargs, faux, calib_mode="none")
+    xnp = np.random.RandomState(0).uniform(
+        size=(batch_size, 3, image_size, image_size)).astype(np.float32)
+
+    def bind(s, a, au):
+        binds = dict(a)
+        binds["data"] = nd.array(xnp)
+        return s.bind(mx.cpu(), args=binds, aux_states=au), binds["data"]
+
+    results = {}
+    for tag, (s_, a_, au_) in (("bf16", (fsym, fargs, faux)),
+                               ("int8", (qsym, qargs, qaux))):
+        if tag == "bf16":
+            a_ = {k: v.astype("bfloat16") if str(v.dtype).startswith("f")
+                  else v for k, v in a_.items()}
+        exe, xin = bind(s_, a_, au_)
+        if tag == "bf16":
+            xin._data = xin._data.astype("bfloat16")
+        t = time.time()
+        (out,) = exe.forward(is_train=False)
+        out.wait_to_read()
+        log("%s first forward (compile) %.1fs" % (tag, time.time() - t))
+        best = 0.0
+        for _ in range(3):
+            t = time.time()
+            for _ in range(iters):
+                (out,) = exe.forward(is_train=False)
+            out.wait_to_read()
+            best = max(best, iters * batch_size / (time.time() - t))
+        results[tag] = best
+        log("%s: %.0f img/s" % (tag, best))
+    emit("resnet50_int8_infer_img_per_sec", results["int8"], "img/s",
+         BASELINE_INFER_IMG_S,
+         {"batch": batch_size, "bf16_img_per_sec": round(results["bf16"], 1),
+          "int8_over_bf16": round(results["int8"] / results["bf16"], 3)})
+    return results
+
+
 def run_infer(batch_size=128, image_size=224, iters=30):
     """ResNet-50 inference throughput (perf.md:189-210 benchmark_score.py
     analog): hybridized forward as one XLA program, bf16."""
@@ -391,7 +455,7 @@ def _backend_alive(timeout_s=240):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="train",
-                    choices=["train", "infer", "attention"])
+                    choices=["train", "infer", "infer-int8", "attention"])
     ap.add_argument("--batch", type=int, default=0)
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--chunks", type=int, default=8)
@@ -424,6 +488,10 @@ def main():
         return
     if args.mode == "infer":
         run_infer(batch_size=args.batch or 128, image_size=args.image_size)
+        return
+    if args.mode == "infer-int8":
+        run_infer_int8(batch_size=args.batch or 128,
+                       image_size=args.image_size)
         return
 
     batches = (args.batch,) if args.batch else (256, 128, 64, 32)
