@@ -94,3 +94,45 @@ def test_int8_quantized_inference_on_tpu():
     cos = float((o_f * o_q).sum() /
                 (np.linalg.norm(o_f) * np.linalg.norm(o_q) + 1e-12))
     assert cos > 0.99, "int8 output diverged from fp32 (cosine %.4f)" % cos
+
+
+@pytest.mark.tpu
+def test_int8_wire_resnet_on_tpu():
+    """The round-4 int8 wire (fold_batch_norm + requantize chaining +
+    quantized residual adds) must compile and agree with fp32 on the
+    chip, and report its speedup vs bf16 (bench --mode infer-int8
+    measures the headline number)."""
+    if not _tpu_available():
+        pytest.skip("no TPU backend reachable")
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    code = r"""
+import numpy as np
+import tempfile, os
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.contrib.quantization import fold_batch_norm, quantize_model
+from incubator_mxnet_tpu.gluon.model_zoo import vision
+mx.random.seed(0)
+net = vision.resnet18_v1(classes=10)
+net.initialize(init=mx.init.Xavier()); net.shape_init((1, 3, 64, 64))
+with tempfile.TemporaryDirectory() as td:
+    prefix = os.path.join(td, "m"); net.export(prefix)
+    sym, args, aux = mx.model.load_checkpoint(prefix, 0)
+fsym, fargs, faux = fold_batch_norm(sym, args, aux)
+qsym, qargs, qaux = quantize_model(fsym, fargs, faux, calib_mode="none")
+x = np.random.RandomState(1).uniform(size=(8, 3, 64, 64)).astype(np.float32)
+def run(s, a, au):
+    binds = dict(a); binds["data"] = nd.array(x)
+    return s.bind(mx.cpu(), args=binds, aux_states=au).forward(is_train=False)[0].asnumpy()
+o_f = run(fsym, fargs, faux)
+o_q = run(qsym, qargs, qaux)
+cos = float((o_f*o_q).sum()/(np.linalg.norm(o_f)*np.linalg.norm(o_q)+1e-12))
+assert cos > 0.98, cos
+print("INT8_WIRE_OK cosine=%.4f" % cos)
+"""
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=1200)
+    sys.stderr.write(proc.stderr[-2000:])
+    assert proc.returncode == 0, proc.stdout + proc.stderr[-2000:]
+    assert "INT8_WIRE_OK" in proc.stdout
