@@ -678,29 +678,45 @@ def list_functions():
     return sorted({op.name for op in registry.OPS.values()})
 
 
+def _numeric_attr_names(op):
+    """Defaulted parameters with NUMERIC defaults, in signature order —
+    the only ones MXFuncInvoke's float scalars can map onto."""
+    import inspect
+
+    out = []
+    for p in inspect.signature(op.fn).parameters.values():
+        if p.default is inspect.Parameter.empty:
+            continue
+        if isinstance(p.default, (int, float)) \
+                and not isinstance(p.default, bool):
+            out.append(p.name)
+    return out
+
+
 def func_info(name: str):
     from .ops import registry
     info = registry.op_info(name)
+    op = registry.get_op(name)
     return (info["name"], info["description"][:512],
             [i[0] for i in info["inputs"]],
             [a[0] for a in info["arguments"]],
-            [a[1] for a in info["arguments"]])
+            [a[1] for a in info["arguments"]],
+            len(_numeric_attr_names(op)))
 
 
 def func_invoke(name: str, use_handles, scalar_args, mutate_handles):
     """Old-style imperative call: inputs + float scalars -> writes into
-    mutate_handles (the pre-nnvm MXFuncInvoke contract)."""
+    mutate_handles (the pre-nnvm MXFuncInvoke contract).  Scalars map
+    onto NUMERIC-defaulted attrs only (string/tuple attrs are not
+    reachable through the float-scalar ABI — use
+    MXImperativeInvokeByName for those)."""
     from .ops import registry
 
     ins = [h._data for h in use_handles]
     op = registry.get_op(name)
-    import inspect
-
     attrs = {}
     if scalar_args:
-        sig = [p.name for p in inspect.signature(op.fn).parameters.values()
-               if p.default is not inspect.Parameter.empty]
-        for k, v in zip(sig, scalar_args):
+        for k, v in zip(_numeric_attr_names(op), scalar_args):
             attrs[k] = float(v)
     out = op.fn(*ins, **attrs)
     outs = out if isinstance(out, (tuple, list)) else (out,)
@@ -742,7 +758,6 @@ def rtc_kernel_call(kernel, in_handles, out_handles):
 # ---------------------------------------------------------------------------
 
 _ENGINE = None
-_ND_VAR = {}
 
 
 def _engine():
@@ -753,12 +768,33 @@ def _engine():
     return _ENGINE
 
 
+_ND_VARS = None  # WeakKeyDictionary: entries die with their arrays
+
+
 def _nd_var(handle):
-    """Per-NDArray engine var (the NDArray::var() mapping)."""
-    key = id(handle)
-    if key not in _ND_VAR:
-        _ND_VAR[key] = _engine().new_var()
-    return _ND_VAR[key]
+    """Per-NDArray engine var (the NDArray::var() mapping).  Weak-keyed by
+    the array object — id()-keyed maps would leak and alias recycled
+    addresses — with the engine var deleted at GC."""
+    global _ND_VARS
+    import weakref
+
+    if _ND_VARS is None:
+        _ND_VARS = weakref.WeakKeyDictionary()
+    var = _ND_VARS.get(handle)
+    if var is None:
+        eng = _engine()
+        var = eng.new_var()
+        _ND_VARS[handle] = var
+        weakref.finalize(handle, _safe_delete_var, var)
+    return var
+
+
+def _safe_delete_var(var):
+    try:
+        if _ENGINE is not None:
+            _ENGINE.delete_var(var)
+    except Exception:
+        pass
 
 
 def engine_push(fn, const_nds, mutable_nds, wait: int):
@@ -767,7 +803,14 @@ def engine_push(fn, const_nds, mutable_nds, wait: int):
     mvars = [_nd_var(h) for h in mutable_nds]
     eng.push(fn, const_vars=cvars, mutable_vars=mvars)
     if wait:
-        eng.wait_for_all()
+        # synchronous contract: wait for THIS op only (its vars), not a
+        # global barrier over unrelated outstanding work
+        waited = False
+        for v in mvars or cvars:
+            eng.wait_for_var(v)
+            waited = True
+        if not waited:
+            eng.wait_for_all()  # dep-free push: barrier is all we have
 
 
 def engine_wait_for_nd(handle):

@@ -1897,7 +1897,7 @@ MXTPU_API int MXFuncDescribe(FunctionHandle fun, uint32_t* num_use_vars,
   *num_use_vars = static_cast<uint32_t>(
       PyList_Size(PyTuple_GetItem(res, 2)));
   *num_scalars = static_cast<uint32_t>(
-      PyList_Size(PyTuple_GetItem(res, 3)));
+      PyLong_AsLong(PyTuple_GetItem(res, 5)));
   *num_mutate_vars = 1;
   *type_mask = 0;
   Py_DECREF(res);
@@ -2329,6 +2329,8 @@ MXTPU_API int MXSymbolInferType(SymbolHandle sym, uint32_t num_args,
   *aux_type_data = aux_t.data();
   bool done = true;
   for (int c : in_t) done = done && c != -1;
+  for (int c : out_t) done = done && c != -1;
+  for (int c : aux_t) done = done && c != -1;
   if (complete != nullptr) *complete = done ? 1 : 0;
   return 0;
 }

@@ -476,14 +476,7 @@ def test_func_registry_abi(lib):
     arr = ctypes.POINTER(ctypes.c_void_p)()
     _check(lib, lib.MXListFunctions(ctypes.byref(ns), ctypes.byref(arr)))
     assert ns.value > 300
-    # find relu's handle
-    handle = None
-    for i in range(ns.value):
-        h = ctypes.cast(arr[i], ctypes.c_void_p)
-        nm = ctypes.c_char_p()
-        # handle is a python str; use GetInfo to read its name
-    # invoke via a fresh known handle: list returns interned names, so
-    # just walk for the one whose info name is 'relu'
+    # handles are interned op names; walk for 'relu' via MXFuncGetInfo
     name = ctypes.c_char_p()
     desc = ctypes.c_char_p()
     na = ctypes.c_uint32()
