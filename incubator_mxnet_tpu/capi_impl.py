@@ -768,25 +768,28 @@ def _engine():
     return _ENGINE
 
 
-_ND_VARS = None  # WeakKeyDictionary: entries die with their arrays
+_ND_VARS = {}  # id -> engine var; entries evicted by the GC finalizer
+#               BEFORE the id can be recycled (finalizers run pre-free),
+#               so there is no aliasing and no leak
 
 
 def _nd_var(handle):
-    """Per-NDArray engine var (the NDArray::var() mapping).  Weak-keyed by
-    the array object — id()-keyed maps would leak and alias recycled
-    addresses — with the engine var deleted at GC."""
-    global _ND_VARS
+    """Per-NDArray engine var (the NDArray::var() mapping)."""
     import weakref
 
-    if _ND_VARS is None:
-        _ND_VARS = weakref.WeakKeyDictionary()
-    var = _ND_VARS.get(handle)
+    key = id(handle)
+    var = _ND_VARS.get(key)
     if var is None:
         eng = _engine()
         var = eng.new_var()
-        _ND_VARS[handle] = var
-        weakref.finalize(handle, _safe_delete_var, var)
+        _ND_VARS[key] = var
+        weakref.finalize(handle, _drop_nd_var, key, var)
     return var
+
+
+def _drop_nd_var(key, var):
+    _ND_VARS.pop(key, None)
+    _safe_delete_var(var)
 
 
 def _safe_delete_var(var):
