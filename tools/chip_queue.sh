@@ -1,0 +1,28 @@
+#!/bin/bash
+# Chip-blocked measurement queue (round-4 tunnel outage backlog).
+# Run when the TPU tunnel is reachable; each step is independently
+# timeboxed and failures don't stop the rest.  Probe first:
+#   curl -m5 127.0.0.1:8083 >/dev/null && bash tools/chip_queue.sh
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-chip_queue_results.txt}
+{
+echo "== chip queue $(date -u +%FT%TZ) =="
+
+echo "-- 1. headline bench (warm cache expected: compile <10s)"
+timeout 580 python bench.py --chunks 3
+
+echo "-- 2. int8 inference through the round-4 wire"
+timeout 580 python bench.py --mode infer-int8
+
+echo "-- 3. TPU consistency gate (375-op sweep + int8-wire resnet)"
+timeout 1500 python -m pytest tests/ -m tpu -q
+
+echo "-- 4. recordio-fed training (host-core bound on 1-vCPU driver)"
+timeout 580 python bench.py --data recordio --record-format .npy --chunks 3
+
+echo "-- 5. attention (XLA default headline + Pallas comparison)"
+timeout 580 python bench.py --mode attention
+
+echo "== done $(date -u +%FT%TZ) =="
+} 2>&1 | tee "$LOG"
