@@ -154,3 +154,28 @@ def test_s2d_stem_exact():
     x = nd.random.uniform(shape=(2, 3, 64, 64))
     np.testing.assert_allclose(conv(x).asnumpy(), s2d(x).asnumpy(),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_ghost_bn_export_symbol_parity():
+    """The ghost-BN perf variant must survive the export->symbol->Executor
+    path with identical inference numerics (deploy parity)."""
+    import os
+    import tempfile
+
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(0)
+    net = vision.resnet18_v1(classes=10, ghost_bn=8)
+    net.initialize(init=mx.init.Xavier())
+    net.shape_init((1, 3, 32, 32))
+    x = nd.random.uniform(shape=(4, 3, 32, 32))
+    ref = net(x).asnumpy()
+    with tempfile.TemporaryDirectory() as td:
+        prefix = os.path.join(td, "g")
+        net.export(prefix)
+        sym, args, aux = mx.model.load_checkpoint(prefix, 0)
+    binds = dict(args)
+    binds["data"] = x
+    out = sym.bind(mx.cpu(), args=binds, aux_states=aux) \
+        .forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(ref, out, rtol=1e-4, atol=1e-4)
