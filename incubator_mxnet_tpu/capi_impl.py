@@ -336,6 +336,186 @@ def ndarray_context(handle):
     return int(ctx.device_typeid), int(ctx.device_id)
 
 
+def ndarray_reshape_reverse(handle, shape, reverse: int):
+    """MXNDArrayReshape64's ``reverse`` contract (c_api.cc:1320) — the
+    Reshape op (ops/tensor.py) implements the full 0/-1/-2/-3/-4 special
+    codes including right-to-left matching."""
+    return handle.reshape(tuple(int(s) for s in shape),
+                          reverse=bool(reverse))
+
+
+def ndarray_storage_type(handle) -> int:
+    # kDefaultStorage=0, kRowSparseStorage=1, kCSRStorage=2 (reference
+    # python/mxnet/ndarray/sparse.py _STORAGE_TYPE_STR_TO_ID)
+    return {"default": 0, "row_sparse": 1, "csr": 2}.get(
+        getattr(handle, "stype", "default"), 0)
+
+
+def ndarray_data_ptr(handle) -> int:
+    """Host pointer to the array contents (MXNDArrayGetData).  The buffer is
+    pinned on the handle so the pointer stays valid until the handle is
+    freed or the next GetData call on it."""
+    buf = np.ascontiguousarray(handle.asnumpy())
+    handle._capi_host_buf = buf
+    return int(buf.ctypes.data)
+
+
+def ndarray_get_grad_state(handle) -> int:
+    return int(getattr(handle, "_fresh_grad", 0))
+
+
+def ndarray_set_grad_state(handle, state: int) -> None:
+    handle._fresh_grad = int(state)
+
+
+def ndarray_shallow_copy(handle):
+    """The reference's shallow copy shares the chunk, so mutations through
+    either handle are visible through both.  This runtime rebinds ``_data``
+    on mutation, so the only faithful aliasing is the object itself: the C
+    side holds a second strong reference (each MXNDArrayFree drops one)."""
+    return handle
+
+
+def ndarray_sync_copy_from_ndarray(dst, src, loc: int):
+    """MXNDArraySyncCopyFromNDArray: loc=-1 copies src into dst whole;
+    loc>=0 writes src into DST's aux slot loc (the reference calls
+    ``dst->SyncCopyFromNDArray(*src, -1, i)`` — c_api.cc:1484 — which is
+    how the frontend assembles a sparse array from dense components)."""
+    if loc >= 0:
+        aux = ndarray_aux_ndarray(dst, loc)  # validates stype + slot index
+        src_dense = src.tostype("default") if hasattr(src, "tostype") else src
+        if tuple(src_dense.shape) != tuple(aux.shape):
+            raise ValueError("aux copy shape mismatch %s vs %s"
+                             % (tuple(src_dense.shape), tuple(aux.shape)))
+        aux._data = src_dense._data.astype(aux.dtype)
+        return None
+    dst_stype = getattr(dst, "stype", "default")
+    if dst_stype != "default":
+        conv = src.tostype(dst_stype) if hasattr(src, "tostype") else \
+            _cast_dense_to(src, dst_stype)
+        if conv.shape != dst.shape:
+            raise ValueError("copy shape mismatch %s vs %s"
+                             % (conv.shape, dst.shape))
+        dst.data = conv.data
+        dst.indices = conv.indices
+        if dst_stype == "csr":
+            dst.indptr = conv.indptr
+        return None
+    src_dense = src.tostype("default") if hasattr(src, "tostype") else src
+    if tuple(src_dense.shape) != tuple(dst.shape):
+        raise ValueError("copy shape mismatch %s vs %s"
+                         % (tuple(src_dense.shape), tuple(dst.shape)))
+    dst._data = src_dense._data.astype(dst.dtype)
+    return None
+
+
+def _cast_dense_to(src, stype):
+    from .ndarray.sparse import cast_storage
+    return cast_storage(src, stype)
+
+
+def ndarray_load_from_buffer(data: bytes):
+    from .ndarray import legacy_io
+    loaded = legacy_io.load_legacy_buffer(data)
+    if isinstance(loaded, dict):
+        return list(loaded.values()), list(loaded.keys())
+    return list(loaded), []
+
+
+def ndarray_check_format(handle, full_check: int) -> None:
+    if getattr(handle, "stype", "default") == "default":
+        return
+    handle.check_format(full_check=bool(full_check))
+
+
+# -- sparse NDArray C surface (MXNDArrayCreateSparseEx / GetAux*) -----------
+
+def ndarray_create_sparse(stype_code: int, shape, dtype_code: int,
+                          aux_types, aux_shapes):
+    """An all-zero sparse array with the requested nnz capacity (the repo's
+    static-nnz design: aux shape 0 fixes capacity up front)."""
+    from .ndarray import sparse as _sp
+    shape = tuple(int(s) for s in shape)
+    dtype = _DTYPE_OF[int(dtype_code)]
+    del aux_types  # index dtypes are fixed int64/int32 by the repo design
+    if int(stype_code) == 2:  # csr
+        nnz = int(aux_shapes[1][0]) if len(aux_shapes) > 1 and aux_shapes[1] \
+            else 0
+        data = np.zeros((nnz,), dtype)
+        indices = np.zeros((nnz,), np.int64)
+        indptr = np.zeros((shape[0] + 1,), np.int64)
+        return _sp.CSRNDArray(data, indices, indptr, shape)
+    if int(stype_code) == 1:  # row_sparse
+        nrows = int(aux_shapes[0][0]) if aux_shapes and aux_shapes[0] else 0
+        data = np.zeros((nrows,) + shape[1:], dtype)
+        # 0..nrows-1: sorted+unique so a freshly created array passes
+        # check_format (all-zero rows stored explicitly is valid)
+        indices = np.arange(nrows, dtype=np.int64)
+        return _sp.RowSparseNDArray(data, indices, shape)
+    raise ValueError("unknown sparse storage code %d" % stype_code)
+
+
+def ndarray_aux_ndarray(handle, i: int):
+    stype = getattr(handle, "stype", "default")
+    if stype == "csr":
+        return (handle.indptr, handle.indices)[int(i)]
+    if stype == "row_sparse":
+        return (handle.indices,)[int(i)]
+    raise ValueError("dense NDArray has no aux array")
+
+
+def ndarray_aux_type(handle, i: int) -> int:
+    return _CODE_OF[np.dtype(ndarray_aux_ndarray(handle, i).dtype)]
+
+
+def ndarray_data_ndarray(handle):
+    return handle.data if hasattr(handle, "data") else handle
+
+
+# -- shared-memory NDArray (MXNDArrayCreateFromSharedMem / GetSharedMemHandle)
+
+def _shm_name(tag_hi: int, tag_lo: int) -> str:
+    return "/mxtpu_nd_%08x_%08x" % (tag_hi & 0x7fffffff, tag_lo & 0x7fffffff)
+
+
+def ndarray_to_shared_mem(handle):
+    """Copy into a named POSIX shm segment; returns ``(tag_hi, tag_lo)`` —
+    the two ints the reference ABI calls (shared_pid, shared_id)
+    (ndarray.cc:1892 passes fd+pid over a socket; here the ints DERIVE the
+    segment name, so any process can reattach with just the pair).  The
+    consumer unlinks after attaching (the usual POSIX one-shot transfer);
+    the producer's mapping stays valid until this handle is freed."""
+    import secrets
+    from . import storage
+    prev = getattr(handle, "_capi_shm", None)
+    if prev is not None:
+        # re-sharing the same handle abandons the previous pair: detach
+        # AND unlink so it can't leak (an already-attached consumer keeps
+        # its mapping; POSIX unlink only removes the name)
+        prev._owner = True
+        prev.close()
+    buf = np.ascontiguousarray(handle.asnumpy())
+    hi, lo = secrets.randbits(31), secrets.randbits(31)
+    shm = storage.SharedMemory(_shm_name(hi, lo), buf.nbytes, create=True)
+    shm._owner = False  # consumer unlinks; see docstring
+    shm.array[:buf.nbytes] = buf.reshape(-1).view(np.uint8)
+    handle._capi_shm = shm  # keep the segment mapped while the handle lives
+    return hi, lo
+
+
+def ndarray_from_shared_mem(tag_hi: int, tag_lo: int, shape, dtype_code: int):
+    from . import storage
+    shape = tuple(int(s) for s in shape)
+    dtype = _DTYPE_OF[int(dtype_code)]
+    nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    shm = storage.SharedMemory(_shm_name(tag_hi, tag_lo), nbytes,
+                               create=False)
+    arr = np.frombuffer(shm.array[:nbytes].tobytes(), dtype).reshape(shape)
+    shm._owner = True  # one-shot transfer: detach AND unlink on close
+    shm.close()
+    return _nd.array(arr)
+
+
 # ---------------------------------------------------------------------------
 # KVStore (MXKVStore* ABI, c_api.h MXKVStoreCreate..SetUpdater)
 # ---------------------------------------------------------------------------

@@ -149,6 +149,47 @@ class BaseSparseNDArray:
             return other
         raise TypeError("copyto: unsupported target %r" % (other,))
 
+    def check_format(self, full_check=True):
+        """Validate the storage format (reference
+        ``python/mxnet/ndarray/sparse.py:check_format`` /
+        MXNDArraySyncCheckFormat): raises on inconsistent aux arrays."""
+        if self.stype == "csr":
+            indptr = np.asarray(self.indptr.asnumpy(), np.int64)
+            indices = np.asarray(self.indices.asnumpy(), np.int64)
+            if indptr.shape != (self.shape[0] + 1,):
+                raise ValueError("csr indptr length %d != rows+1 (%d)"
+                                 % (indptr.size, self.shape[0] + 1))
+            if indptr[0] != 0 or np.any(np.diff(indptr) < 0):
+                raise ValueError("csr indptr must start at 0 and be "
+                                 "non-decreasing")
+            if indptr[-1] > indices.size:
+                raise ValueError("csr indptr[-1]=%d exceeds nnz capacity %d"
+                                 % (indptr[-1], indices.size))
+            live = indices[:indptr[-1]]
+            if full_check and live.size:
+                if live.min() < 0 or live.max() >= self.shape[1]:
+                    raise ValueError("csr column index out of range")
+                # columns must ascend within each row (reference
+                # CSRIndicesNotSortedError); vectorized: non-ascending
+                # adjacent pairs are violations unless they straddle a
+                # row boundary (diff position j compares entries j, j+1;
+                # j+1 being a row start makes it a boundary pair)
+                if live.size > 1:
+                    bad = np.diff(live) <= 0
+                    starts = indptr[1:-1]
+                    starts = starts[(starts > 0) & (starts < live.size)]
+                    bad[starts - 1] = False
+                    if np.any(bad):
+                        raise ValueError("csr indices not sorted within row")
+        elif self.stype == "row_sparse":
+            indices = np.asarray(self.indices.asnumpy(), np.int64)
+            if full_check and indices.size:
+                if indices.min() < 0 or indices.max() >= self.shape[0]:
+                    raise ValueError("row_sparse row index out of range")
+                if np.any(np.diff(indices) <= 0):
+                    raise ValueError("row_sparse indices must be sorted "
+                                     "and unique")
+
     # arithmetic — same-stype fast paths in subclasses; fallback densifies
     def _fallback_binop(self, other, opname, reverse=False):
         _log_fallback(opname, (self.stype, getattr(other, "stype", "scalar")))

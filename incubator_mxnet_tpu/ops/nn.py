@@ -13,6 +13,9 @@ re-layouts internally for the TPU's native tiling.
 """
 from __future__ import annotations
 
+import functools
+import itertools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -125,6 +128,42 @@ def _deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=Non
 # ---------------------------------------------------------------------------
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _maxpool_sws(data, window, strides, padding):
+    return lax.reduce_window(data, -jnp.inf, lax.max, window, strides, padding)
+
+
+def _maxpool_sws_fwd(data, window, strides, padding):
+    out = _maxpool_sws(data, window, strides, padding)
+    return out, (data, out)
+
+
+def _maxpool_sws_bwd(window, strides, padding, res, g):
+    data, out = res
+    neg = np.asarray(-jnp.inf, data.dtype)[()]
+    xp = lax.pad(data, neg, [(lo, hi, 0) for lo, hi in padding])
+    # one shifted strided view of the padded input per in-window offset:
+    # position p of the padded input contributes to window w iff
+    # p = w*stride + offset, so dX[p] = sum_offsets (xp[p] == y[w]) * g[w]
+    dxp = jnp.zeros(xp.shape, g.dtype)
+    for offset in itertools.product(*[range(k) for k in window]):
+        # (out-1)*stride + window <= padded dim by reduce_window's output
+        # formula, so every shifted view is in bounds
+        limit = [o + (y - 1) * s + 1
+                 for o, y, s in zip(offset, out.shape, strides)]
+        xs = lax.slice(xp, offset, limit, strides)
+        contrib = jnp.where(xs == out, g, jnp.zeros((), g.dtype))
+        dxp = dxp + lax.pad(contrib, np.asarray(0, g.dtype)[()], [
+            (o, d - l, s - 1)
+            for o, d, l, s in zip(offset, xp.shape, limit, strides)])
+    dx = lax.slice(dxp, [lo for lo, _ in padding],
+                   [d - hi for d, (_, hi) in zip(xp.shape, padding)])
+    return (dx.astype(data.dtype),)
+
+
+_maxpool_sws.defvjp(_maxpool_sws_fwd, _maxpool_sws_bwd)
+
+
 @register("Pooling", aliases=("pool",))
 def _pooling(data, kernel=None, pool_type="max", global_pool=False,
              cudnn_off=False, pooling_convention="valid", stride=None, pad=None,
@@ -158,8 +197,16 @@ def _pooling(data, kernel=None, pool_type="max", global_pool=False,
     if pool_type == "max":
         # init must carry the operand dtype (an int-typed pool — e.g. the
         # int8 inference path — rejects a python-int/int64 init)
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \
-            else np.asarray(jnp.iinfo(data.dtype).min, data.dtype)[()]
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            # custom VJP: XLA's autodiff of reduce_window-max is
+            # select-and-scatter, which is slow on TPU (1.5 ms/step in the
+            # ResNet-50 profile, docs/PERF.md).  The shifted-window mask
+            # backward below is a handful of fused elementwise passes and
+            # matches the reference's mshadow unpool semantics
+            # (pooling-inl.h: every position equal to the window max
+            # receives the full output gradient, ties included).
+            return _maxpool_sws(data, window, strides, tuple(padding))
+        init = np.asarray(jnp.iinfo(data.dtype).min, data.dtype)[()]
         return lax.reduce_window(data, init, lax.max, window, strides, padding)
     if pool_type in ("avg", "sum"):
         summed = lax.reduce_window(data, 0.0 if jnp.issubdtype(

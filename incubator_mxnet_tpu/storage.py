@@ -92,6 +92,9 @@ class SharedMemory:
             self.array = np.frombuffer(memoryview(self._mm), dtype=np.uint8)
 
     def close(self):
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
         if self._lib is not None:
             if getattr(self, "_ptr", None):
                 self._lib.MXTShmDetach(self._ptr, self.size)
@@ -102,6 +105,14 @@ class SharedMemory:
             self._file.close()
         if self._owner:
             self.unlink()
+
+    def __del__(self):
+        # last-resort detach so a dropped handle doesn't leak the mapping
+        # (and, for owners, the segment); explicit close() is the API
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def unlink(self):
         if self._lib is not None:

@@ -2450,3 +2450,377 @@ MXTPU_API int MXNDArrayLoadFromRawBytes(const void* buf, size_t size,
   *out = res;
   return 0;
 }
+
+// ---------------------------------------------------------------------------
+// NDArray tail: 64-bit / Ex variants, storage type, data access, shared mem,
+// sparse aux surface, dlpack (c_api.h NDArray block completion)
+// ---------------------------------------------------------------------------
+
+namespace {
+
+thread_local std::vector<int> g_shape_int_buf;
+thread_local std::vector<int64_t> g_shape_i64_buf;
+
+// shared int-list marshalling for the shape-returning variants
+PyObject* NDArrayShapeList(NDArrayHandle handle) {
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallImpl("ndarray_shape", args);
+  Py_DECREF(args);
+  return res;
+}
+
+}  // namespace
+
+MXTPU_API int MXNDArrayWaitAll() {
+  Gil gil;
+  PyObject* args = PyTuple_New(0);
+  PyObject* res = CallImpl("engine_wait_all", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetShapeEx(NDArrayHandle handle, int* out_dim,
+                                  const int** out_pdata) {
+  Gil gil;
+  PyObject* res = NDArrayShapeList(handle);
+  if (res == nullptr) return FailFromPython();
+  Py_ssize_t n = PyList_Size(res);
+  g_shape_int_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    g_shape_int_buf[i] = static_cast<int>(PyLong_AsLong(PyList_GetItem(res, i)));
+  }
+  Py_DECREF(res);
+  *out_dim = static_cast<int>(n);
+  *out_pdata = g_shape_int_buf.data();
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetShape64(NDArrayHandle handle, int* out_dim,
+                                  const int64_t** out_pdata) {
+  Gil gil;
+  PyObject* res = NDArrayShapeList(handle);
+  if (res == nullptr) return FailFromPython();
+  Py_ssize_t n = PyList_Size(res);
+  g_shape_i64_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    g_shape_i64_buf[i] = PyLong_AsLongLong(PyList_GetItem(res, i));
+  }
+  Py_DECREF(res);
+  *out_dim = static_cast<int>(n);
+  *out_pdata = g_shape_i64_buf.data();
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetShapeEx64(NDArrayHandle handle, int* out_dim,
+                                    const int64_t** out_pdata) {
+  return MXNDArrayGetShape64(handle, out_dim, out_pdata);
+}
+
+MXTPU_API int MXNDArrayCreateEx64(const int64_t* shape, int ndim, int dev_type,
+                                  int dev_id, int delay_alloc, int dtype,
+                                  NDArrayHandle* out) {
+  (void)dev_type; (void)dev_id; (void)delay_alloc;
+  Gil gil;
+  PyObject* shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    PyTuple_SetItem(shp, i, PyLong_FromLongLong(shape[i]));
+  }
+  PyObject* args = Py_BuildValue("(Ni)", shp, dtype);
+  PyObject* res = CallImpl("ndarray_create", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXNDArrayCreateNone(NDArrayHandle* out) {
+  // placeholder handle: a 0-element f32 vector (the reference's "none"
+  // NDArray is an empty chunk later assigned through MoveTo/CopyFrom)
+  const uint32_t shape[1] = {0};
+  return MXNDArrayCreateEx(shape, 1, 1, 0, 0, 0, out);
+}
+
+MXTPU_API int MXNDArrayReshape64(NDArrayHandle handle, int ndim,
+                                 const int64_t* dims, bool reverse,
+                                 NDArrayHandle* out) {
+  Gil gil;
+  PyObject* shape = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    PyList_SetItem(shape, i, PyLong_FromLongLong(dims[i]));
+  }
+  PyObject* args = Py_BuildValue("(ONi)", static_cast<PyObject*>(handle),
+                                 shape, reverse ? 1 : 0);
+  PyObject* res = CallImpl("ndarray_reshape_reverse", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXNDArraySlice64(NDArrayHandle handle, int64_t begin,
+                               int64_t end, NDArrayHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OLL)", static_cast<PyObject*>(handle),
+                                 static_cast<long long>(begin),
+                                 static_cast<long long>(end));
+  PyObject* res = CallImpl("ndarray_slice", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXNDArrayAt64(NDArrayHandle handle, int64_t idx,
+                            NDArrayHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OL)", static_cast<PyObject*>(handle),
+                                 static_cast<long long>(idx));
+  PyObject* res = CallImpl("ndarray_at", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetStorageType(NDArrayHandle handle, int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallImpl("ndarray_storage_type", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetData(NDArrayHandle handle, void** out_pdata) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallImpl("ndarray_data_ptr", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out_pdata = reinterpret_cast<void*>(PyLong_AsSize_t(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetGradState(NDArrayHandle handle, int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallImpl("ndarray_get_grad_state", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXNDArraySetGradState(NDArrayHandle handle, int state) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oi)", static_cast<PyObject*>(handle),
+                                 state);
+  PyObject* res = CallImpl("ndarray_set_grad_state", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXShallowCopyNDArray(NDArrayHandle handle, NDArrayHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallImpl("ndarray_shallow_copy", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXNDArraySyncCopyFromNDArray(NDArrayHandle dst,
+                                           NDArrayHandle src, int loc) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OOi)", static_cast<PyObject*>(dst),
+                                 static_cast<PyObject*>(src), loc);
+  PyObject* res = CallImpl("ndarray_sync_copy_from_ndarray", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXNDArraySyncCheckFormat(NDArrayHandle handle, bool full_check) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oi)", static_cast<PyObject*>(handle),
+                                 full_check ? 1 : 0);
+  PyObject* res = CallImpl("ndarray_check_format", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayLoadFromBuffer(const void* buf, size_t size,
+                                      uint32_t* out_size,
+                                      NDArrayHandle** out_arr,
+                                      uint32_t* out_name_size,
+                                      const char*** out_names) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(y#)", static_cast<const char*>(buf),
+                                 static_cast<Py_ssize_t>(size));
+  PyObject* res = CallImpl("ndarray_load_from_buffer", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  PyObject* arrs = PyTuple_GetItem(res, 0);
+  PyObject* names = PyTuple_GetItem(res, 1);
+  g_handle_store.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(arrs); ++i) {
+    PyObject* a = PyList_GetItem(arrs, i);
+    Py_INCREF(a);
+    g_handle_store.push_back(a);
+  }
+  *out_size = static_cast<uint32_t>(g_handle_store.size());
+  *out_arr = g_handle_store.data();
+  int rc = StoreStringList(names, out_name_size, out_names);
+  Py_DECREF(res);
+  return rc;
+}
+
+// -- sparse surface ---------------------------------------------------------
+
+MXTPU_API int MXNDArrayCreateSparseEx(
+    int storage_type, const uint32_t* shape, uint32_t ndim, int dev_type,
+    int dev_id, int delay_alloc, int dtype, uint32_t num_aux,
+    int* aux_type, uint32_t* aux_ndims, const uint32_t* aux_shape,
+    NDArrayHandle* out) {
+  (void)dev_type; (void)dev_id; (void)delay_alloc;
+  Gil gil;
+  PyObject* shp = PyList_New(ndim);
+  for (uint32_t i = 0; i < ndim; ++i) {
+    PyList_SetItem(shp, i, PyLong_FromUnsignedLong(shape[i]));
+  }
+  PyObject* types = PyList_New(num_aux);
+  PyObject* shapes = PyList_New(num_aux);
+  uint32_t off = 0;
+  for (uint32_t i = 0; i < num_aux; ++i) {
+    PyList_SetItem(types, i, PyLong_FromLong(aux_type ? aux_type[i] : 6));
+    PyObject* s = PyList_New(aux_ndims[i]);
+    for (uint32_t j = 0; j < aux_ndims[i]; ++j) {
+      PyList_SetItem(s, j, PyLong_FromUnsignedLong(aux_shape[off + j]));
+    }
+    off += aux_ndims[i];
+    PyList_SetItem(shapes, i, s);
+  }
+  PyObject* args = Py_BuildValue("(iNiNN)", storage_type, shp, dtype, types,
+                                 shapes);
+  PyObject* res = CallImpl("ndarray_create_sparse", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXNDArrayCreateSparseEx64(
+    int storage_type, const int64_t* shape, int ndim, int dev_type,
+    int dev_id, int delay_alloc, int dtype, uint32_t num_aux,
+    int* aux_type, int* aux_ndims, const int64_t* aux_shape,
+    NDArrayHandle* out) {
+  std::vector<uint32_t> shp(shape, shape + ndim);
+  std::vector<uint32_t> andims(aux_ndims, aux_ndims + num_aux);
+  size_t total = 0;
+  for (uint32_t i = 0; i < num_aux; ++i) total += andims[i];
+  std::vector<uint32_t> ashape(aux_shape, aux_shape + total);
+  return MXNDArrayCreateSparseEx(storage_type, shp.data(),
+                                 static_cast<uint32_t>(ndim), dev_type,
+                                 dev_id, delay_alloc, dtype, num_aux,
+                                 aux_type, andims.data(), ashape.data(), out);
+}
+
+MXTPU_API int MXNDArrayGetAuxNDArray(NDArrayHandle handle, uint32_t i,
+                                     NDArrayHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OI)", static_cast<PyObject*>(handle), i);
+  PyObject* res = CallImpl("ndarray_aux_ndarray", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetAuxNDArray64(NDArrayHandle handle, int64_t i,
+                                       NDArrayHandle* out) {
+  return MXNDArrayGetAuxNDArray(handle, static_cast<uint32_t>(i), out);
+}
+
+MXTPU_API int MXNDArrayGetAuxType(NDArrayHandle handle, uint32_t i,
+                                  int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OI)", static_cast<PyObject*>(handle), i);
+  PyObject* res = CallImpl("ndarray_aux_type", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetAuxType64(NDArrayHandle handle, int64_t i,
+                                    int* out) {
+  return MXNDArrayGetAuxType(handle, static_cast<uint32_t>(i), out);
+}
+
+MXTPU_API int MXNDArrayGetDataNDArray(NDArrayHandle handle,
+                                      NDArrayHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallImpl("ndarray_data_ndarray", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+// -- shared-memory transport ------------------------------------------------
+// The reference ABI identifies a segment by (shared_pid, shared_id); here
+// the pair deterministically derives the POSIX shm name (capi_impl.py
+// _shm_name), so any process holding the two ints can reattach — no
+// process-local state.
+
+MXTPU_API int MXNDArrayGetSharedMemHandle(NDArrayHandle handle,
+                                          int* shared_pid, int* shared_id) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallImpl("ndarray_to_shared_mem", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *shared_pid = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(res, 0)));
+  *shared_id = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(res, 1)));
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayCreateFromSharedMemEx(int shared_pid, int shared_id,
+                                             const int* shape, int ndim,
+                                             int dtype, NDArrayHandle* out) {
+  Gil gil;
+  PyObject* shp = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    PyList_SetItem(shp, i, PyLong_FromLong(shape[i]));
+  }
+  PyObject* args = Py_BuildValue("(iiNi)", shared_pid, shared_id, shp, dtype);
+  PyObject* res = CallImpl("ndarray_from_shared_mem", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXNDArrayCreateFromSharedMem(int shared_pid, int shared_id,
+                                           const uint32_t* shape,
+                                           uint32_t ndim, int dtype,
+                                           NDArrayHandle* out) {
+  std::vector<int> shp(shape, shape + ndim);
+  return MXNDArrayCreateFromSharedMemEx(shared_pid, shared_id, shp.data(),
+                                        static_cast<int>(ndim), dtype, out);
+}

@@ -454,10 +454,15 @@ def test_serving_bundle(tmp_path):
     import sys
 
     bundle = str(tmp_path / "bundle")
+    prefix = str(tmp_path / "model")
+    rc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "cpp-package", "make_model.py"),
+         prefix], capture_output=True, text=True)
+    assert rc.returncode == 0, rc.stderr
     rc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "tools",
                                       "make_serving_bundle.py"),
-         os.path.join(_REPO, "cpp-package", "model"), bundle, "[2, 8]"],
+         prefix, bundle, "[2, 8]"],
         capture_output=True, text=True)
     assert rc.returncode == 0, rc.stderr
     run = subprocess.run(
@@ -668,3 +673,190 @@ def test_kvstore_pushpull_and_compression_abi(lib):
     got = _to_np(lib, out, (4,))
     assert np.isfinite(got).all()
     _check(lib, lib.MXKVStoreFree(kv))
+
+
+def test_ndarray_tail_abi(lib):
+    """Round-4 NDArray tail: WaitAll, ShapeEx/64, Create64, Reshape64,
+    Slice64/At64, storage type, GetData, grad state, shallow copy,
+    SyncCopyFromNDArray, LoadFromBuffer."""
+    _check(lib, lib.MXNDArrayWaitAll())
+
+    x = _make_nd(lib, np.arange(12, dtype=np.float32).reshape(3, 4))
+    ndim = ctypes.c_int()
+    p_int = ctypes.POINTER(ctypes.c_int)()
+    _check(lib, lib.MXNDArrayGetShapeEx(x, ctypes.byref(ndim),
+                                        ctypes.byref(p_int)))
+    assert [p_int[i] for i in range(ndim.value)] == [3, 4]
+    p64 = ctypes.POINTER(ctypes.c_int64)()
+    _check(lib, lib.MXNDArrayGetShape64(x, ctypes.byref(ndim),
+                                        ctypes.byref(p64)))
+    assert [p64[i] for i in range(ndim.value)] == [3, 4]
+
+    h = ctypes.c_void_p()
+    shape64 = (ctypes.c_int64 * 2)(2, 5)
+    _check(lib, lib.MXNDArrayCreateEx64(shape64, 2, 1, 0, 0, 0,
+                                        ctypes.byref(h)))
+    _check(lib, lib.MXNDArrayGetShape64(h, ctypes.byref(ndim),
+                                        ctypes.byref(p64)))
+    assert [p64[i] for i in range(ndim.value)] == [2, 5]
+
+    none = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayCreateNone(ctypes.byref(none)))
+
+    r = ctypes.c_void_p()
+    dims = (ctypes.c_int64 * 2)(4, 3)
+    _check(lib, lib.MXNDArrayReshape64(x, 2, dims, False, ctypes.byref(r)))
+    np.testing.assert_array_equal(
+        _to_np(lib, r, (4, 3)),
+        np.arange(12, dtype=np.float32).reshape(4, 3))
+
+    s = ctypes.c_void_p()
+    _check(lib, lib.MXNDArraySlice64(x, 1, 3, ctypes.byref(s)))
+    np.testing.assert_array_equal(
+        _to_np(lib, s, (2, 4)),
+        np.arange(12, dtype=np.float32).reshape(3, 4)[1:3])
+    a = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayAt64(x, 2, ctypes.byref(a)))
+    np.testing.assert_array_equal(
+        _to_np(lib, a, (4,)), np.arange(12, dtype=np.float32)
+        .reshape(3, 4)[2])
+
+    st = ctypes.c_int()
+    _check(lib, lib.MXNDArrayGetStorageType(x, ctypes.byref(st)))
+    assert st.value == 0  # kDefaultStorage
+
+    ptr = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayGetData(x, ctypes.byref(ptr)))
+    host = np.ctypeslib.as_array(
+        ctypes.cast(ptr, ctypes.POINTER(ctypes.c_float)), (12,))
+    np.testing.assert_array_equal(host, np.arange(12, dtype=np.float32))
+
+    gs = ctypes.c_int()
+    _check(lib, lib.MXNDArrayGetGradState(x, ctypes.byref(gs)))
+    assert gs.value == 0
+    _check(lib, lib.MXNDArraySetGradState(x, 1))
+    _check(lib, lib.MXNDArrayGetGradState(x, ctypes.byref(gs)))
+    assert gs.value == 1
+
+    sc = ctypes.c_void_p()
+    _check(lib, lib.MXShallowCopyNDArray(x, ctypes.byref(sc)))
+    np.testing.assert_array_equal(
+        _to_np(lib, sc, (3, 4)), np.arange(12, dtype=np.float32).reshape(3, 4))
+    _check(lib, lib.MXNDArrayFree(sc))
+
+    dst = _make_nd(lib, np.zeros((3, 4), np.float32))
+    _check(lib, lib.MXNDArraySyncCopyFromNDArray(dst, x, -1))
+    np.testing.assert_array_equal(
+        _to_np(lib, dst, (3, 4)), np.arange(12, dtype=np.float32).reshape(3, 4))
+
+    # save to buffer via the save-file ABI, reload via LoadFromBuffer
+    import tempfile
+    with tempfile.NamedTemporaryFile(suffix=".params", delete=False) as f:
+        path = f.name
+    _check(lib, lib.MXNDArraySave(path.encode(), 1,
+                                  (ctypes.c_void_p * 1)(x),
+                                  (ctypes.c_char_p * 1)(b"w")))
+    blob = open(path, "rb").read()
+    os.unlink(path)
+    n_arr = ctypes.c_uint32()
+    arrs = ctypes.POINTER(ctypes.c_void_p)()
+    n_names = ctypes.c_uint32()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib, lib.MXNDArrayLoadFromBuffer(
+        blob, len(blob), ctypes.byref(n_arr), ctypes.byref(arrs),
+        ctypes.byref(n_names), ctypes.byref(names)))
+    assert n_arr.value == 1 and names[0] == b"w"
+    np.testing.assert_array_equal(
+        _to_np(lib, ctypes.c_void_p(arrs[0]), (3, 4)),
+        np.arange(12, dtype=np.float32).reshape(3, 4))
+
+
+def test_sparse_ndarray_abi(lib):
+    """MXNDArrayCreateSparseEx + aux accessors + SyncCheckFormat."""
+    h = ctypes.c_void_p()
+    shape = (ctypes.c_uint32 * 2)(4, 6)
+    aux_types = (ctypes.c_int * 2)(6, 6)  # int64 indptr / indices
+    aux_ndims = (ctypes.c_uint32 * 2)(1, 1)
+    aux_shape = (ctypes.c_uint32 * 2)(5, 3)  # indptr len 5, nnz 3
+    _check(lib, lib.MXNDArrayCreateSparseEx(
+        2, shape, 2, 1, 0, 0, 0, 2, aux_types, aux_ndims, aux_shape,
+        ctypes.byref(h)))
+    st = ctypes.c_int()
+    _check(lib, lib.MXNDArrayGetStorageType(h, ctypes.byref(st)))
+    assert st.value == 2  # kCSRStorage
+    t = ctypes.c_int()
+    _check(lib, lib.MXNDArrayGetAuxType(h, 0, ctypes.byref(t)))
+    assert t.value == 6  # int64
+    aux = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayGetAuxNDArray(h, 0, ctypes.byref(aux)))
+    ndim = ctypes.c_int()
+    p64 = ctypes.POINTER(ctypes.c_int64)()
+    _check(lib, lib.MXNDArrayGetShape64(aux, ctypes.byref(ndim),
+                                        ctypes.byref(p64)))
+    assert [p64[i] for i in range(ndim.value)] == [5]
+    data = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayGetDataNDArray(h, ctypes.byref(data)))
+    _check(lib, lib.MXNDArraySyncCheckFormat(h, True))
+
+
+def test_shared_mem_abi(lib):
+    """MXNDArrayGetSharedMemHandle -> MXNDArrayCreateFromSharedMem round
+    trip through a POSIX shm segment."""
+    src = _make_nd(lib, np.arange(8, dtype=np.float32).reshape(2, 4))
+    pid = ctypes.c_int()
+    sid = ctypes.c_int()
+    _check(lib, lib.MXNDArrayGetSharedMemHandle(src, ctypes.byref(pid),
+                                                ctypes.byref(sid)))
+    shape = (ctypes.c_uint32 * 2)(2, 4)
+    out = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayCreateFromSharedMem(pid, sid, shape, 2, 0,
+                                                 ctypes.byref(out)))
+    np.testing.assert_array_equal(
+        _to_np(lib, out, (2, 4)),
+        np.arange(8, dtype=np.float32).reshape(2, 4))
+
+
+def test_sparse_assembly_via_aux_copy_abi(lib):
+    """The canonical sparse-construction sequence (reference csr_matrix):
+    create sparse, then SyncCopyFromNDArray dense components into dst aux
+    slots (loc=0 indptr, loc=1 indices) and the data array (loc=-1)."""
+    h = ctypes.c_void_p()
+    shape = (ctypes.c_uint32 * 2)(2, 4)
+    aux_types = (ctypes.c_int * 2)(6, 6)
+    aux_ndims = (ctypes.c_uint32 * 2)(1, 1)
+    aux_shape = (ctypes.c_uint32 * 2)(3, 3)  # indptr len 3, nnz 3
+    _check(lib, lib.MXNDArrayCreateSparseEx(
+        2, shape, 2, 1, 0, 0, 0, 2, aux_types, aux_ndims, aux_shape,
+        ctypes.byref(h)))
+    indptr = _make_nd(lib, np.array([0, 2, 3], np.float32))
+    indices = _make_nd(lib, np.array([1, 3, 2], np.float32))
+    _check(lib, lib.MXNDArraySyncCopyFromNDArray(h, indptr, 0))
+    _check(lib, lib.MXNDArraySyncCopyFromNDArray(h, indices, 1))
+    data = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayGetDataNDArray(h, ctypes.byref(data)))
+    vals = _make_nd(lib, np.array([10., 20., 30.], np.float32))
+    _check(lib, lib.MXNDArraySyncCopyFromNDArray(data, vals, -1))
+    _check(lib, lib.MXNDArraySyncCheckFormat(h, True))
+    # densify through the Python side to verify the assembled contents
+    import incubator_mxnet_tpu.capi_impl as impl
+    import ctypes as ct
+    obj = ct.cast(h, ct.py_object).value
+    dense = obj.tostype("default").asnumpy()
+    want = np.zeros((2, 4), np.float32)
+    want[0, 1], want[0, 3], want[1, 2] = 10., 20., 30.
+    np.testing.assert_array_equal(dense, want)
+
+
+def test_reshape_reverse_abi(lib):
+    """MXNDArrayReshape64 reverse=true: wildcards match right-to-left
+    (reference mxnet.test_utils reshape semantics: (2,3,5) + (0,-1)
+    reverse -> (15,2)... canonical case (2,3,5)+(0,-3) -> (2,15))."""
+    x = _make_nd(lib, np.arange(30, dtype=np.float32).reshape(2, 3, 5))
+    r = ctypes.c_void_p()
+    dims = (ctypes.c_int64 * 2)(0, -3)
+    _check(lib, lib.MXNDArrayReshape64(x, 2, dims, True, ctypes.byref(r)))
+    ndim = ctypes.c_int()
+    p64 = ctypes.POINTER(ctypes.c_int64)()
+    _check(lib, lib.MXNDArrayGetShape64(r, ctypes.byref(ndim),
+                                        ctypes.byref(p64)))
+    assert [p64[i] for i in range(ndim.value)] == [2, 15]

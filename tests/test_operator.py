@@ -100,6 +100,37 @@ def test_pooling():
     assert out.asscalar() == 15.0
 
 
+def test_maxpool_backward():
+    # overlapping 3x3/s2 windows (the ResNet stem pool) through the
+    # autograd frontend; oracle = numeric windows walked in numpy
+    x_np = np.random.RandomState(3).rand(2, 3, 9, 9).astype(np.float32)
+    x = nd.array(x_np)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Pooling(x, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                       pool_type="max")
+        y.backward(nd.ones_like(y))
+    pad = np.full((2, 3, 11, 11), -np.inf, np.float32)
+    pad[:, :, 1:10, 1:10] = x_np
+    want = np.zeros_like(pad)
+    for i in range(5):
+        for j in range(5):
+            w = pad[:, :, 2 * i:2 * i + 3, 2 * j:2 * j + 3]
+            m = w == w.max(axis=(2, 3), keepdims=True)
+            want[:, :, 2 * i:2 * i + 3, 2 * j:2 * j + 3] += m
+    np.testing.assert_allclose(x.grad.asnumpy(), want[:, :, 1:10, 1:10])
+
+    # tie semantics: every position equal to the window max receives the
+    # full gradient (reference mshadow unpool, pooling-inl.h), unlike
+    # XLA select-and-scatter's first-match
+    t = nd.array(np.ones((1, 1, 2, 2), np.float32))
+    t.attach_grad()
+    with autograd.record():
+        y = nd.Pooling(t, kernel=(2, 2), stride=(2, 2), pool_type="max")
+        y.backward()
+    np.testing.assert_allclose(t.grad.asnumpy(), np.ones((1, 1, 2, 2)))
+
+
 def test_batchnorm_inference_and_training():
     x = nd.random.normal(0, 1, shape=(8, 3, 4, 4))
     gamma, beta = nd.ones((3,)), nd.zeros((3,))
