@@ -43,6 +43,10 @@ def ndarray_from_bytes(shape, dtype_code, data: bytes) -> NDArray:
     return _nd.array(arr)
 
 
+# dlpack import lands here (MXNDArrayFromDLPack builds the bytes in C)
+ndarray_from_bytes_dtype = ndarray_from_bytes
+
+
 def ndarray_sync_copy_from(handle: NDArray, data: bytes) -> None:
     arr = np.frombuffer(data, handle.dtype).reshape(handle.shape)
     handle._data = __import__("jax.numpy", fromlist=["asarray"]).asarray(arr)
@@ -92,7 +96,70 @@ def imperative_invoke(op_name: str, inputs, keys, vals, outs=None):
     return out if isinstance(out, list) else [out]
 
 
-# -- Symbol -----------------------------------------------------------------
+# -- misc runtime (numpy-shape mode, bulk, features, library, profiler) -----
+
+def is_numpy_shape() -> int:
+    from .util import is_np_shape
+    return int(is_np_shape())
+
+
+def set_is_numpy_shape(flag: int) -> int:
+    from . import util
+    prev = int(util.is_np_shape())
+    util._st().np_shape = bool(flag)
+    return prev
+
+
+def engine_set_bulk_size(size: int) -> int:
+    from . import engine
+    return int(engine.set_bulk_size(int(size)))
+
+
+def libinfo_features():
+    """Returns (names, enabled_flags) — MXLibInfoFeatures."""
+    from .runtime import feature_list
+    feats = feature_list()
+    return [f.name for f in feats], [int(bool(f.enabled)) for f in feats]
+
+
+def load_op_library(path: str):
+    from .library import load
+    return list(load(path))
+
+
+def autograd_get_symbol(handle):
+    from . import autograd
+    return autograd.get_symbol(handle)
+
+
+def amp_reduce_precision_symbol(s, target_dtype: str):
+    from .contrib.amp.amp import convert_symbol
+    return convert_symbol(s, target_dtype=target_dtype or "bfloat16")
+
+
+def symbol_optimize_for(s, backend: str):
+    return s.optimize_for(backend)
+
+
+def data_iter_info(name: str):
+    """(name, description, arg_names, arg_types, arg_descs) for
+    MXDataIterGetIterInfo — generated from the iterator registry."""
+    import inspect
+    reg = _data_iter_registry()
+    if name not in reg:
+        raise ValueError("unknown data iter %r" % name)
+    cls = reg[name]
+    sig = inspect.signature(cls)
+    names, types, descs = [], [], []
+    for p in sig.parameters.values():
+        if p.name in ("self", "args", "kwargs"):
+            continue
+        names.append(p.name)
+        types.append("any" if p.default is inspect.Parameter.empty
+                     else "any, default=%r" % (p.default,))
+        descs.append("")
+    return name, (cls.__doc__ or "").strip().split("\n")[0], names, types, \
+        descs
 
 def symbol_from_json(json_str: str):
     return sym_mod.load_json(json_str)
@@ -168,6 +235,180 @@ def symbol_infer_shape(s, keys, shapes, partial: bool):
     return conv(arg), conv(out), conv(aux), bool(complete)
 
 
+class AtomicSymbol:
+    """MXSymbolCreateAtomicSymbol's uncomposed op node: (op, attrs) waiting
+    for MXSymbolCompose to plug in inputs (c_api_symbolic.cc pairs the two
+    calls; symbol_create_from_op is the fused fast path).  Once composed it
+    proxies the underlying Symbol, so the same C handle works with every
+    MXSymbol* entry point — mirroring the reference where Compose mutates
+    the symbol in place."""
+
+    def __init__(self, op_name: str, attrs):
+        self.op_name = op_name
+        self.attrs = dict(attrs)
+
+    def __getattr__(self, name):
+        composed = self.__dict__.get("composed")
+        if composed is None:
+            raise AttributeError(
+                "atomic symbol %r not composed yet (call MXSymbolCompose)"
+                % self.__dict__.get("op_name"))
+        return getattr(composed, name)
+
+
+def symbol_create_atomic(op_name: str, keys, vals):
+    attrs = {k: sym_mod.symbol._parse_attr(v) for k, v in zip(keys, vals)}
+    return AtomicSymbol(op_name, attrs)
+
+
+def symbol_compose(handle, name: str, in_names, in_handles) -> None:
+    """MXSymbolCompose mutates the handle in place.  For an AtomicSymbol the
+    composed graph replaces its state; composing a composite symbol
+    substitutes its free arguments (reference nnvm::Symbol::Compose)."""
+    if isinstance(handle, AtomicSymbol):
+        composed = symbol_create_from_op(
+            handle.op_name, list(handle.attrs.keys()),
+            [sym_mod.symbol._attr_to_str(v) for v in handle.attrs.values()],
+            in_names, in_handles, name)
+        handle.composed = composed
+        return None
+    # composite: bind free variable nodes to the given symbols
+    args = handle.list_arguments()
+    if in_names and any(in_names):
+        mapping = dict(zip(in_names, in_handles))
+    else:
+        mapping = dict(zip(args, in_handles))
+    import copy as _copy
+    memo = {}
+    new_outputs = []
+    for node, idx in handle._outputs:
+        new_outputs.append((_substitute_node(node, mapping, memo), idx))
+    handle._outputs = new_outputs
+    return None
+
+
+def _substitute_node(node, mapping, memo):
+    if id(node) in memo:
+        return memo[id(node)]
+    if node.is_var() and node.name in mapping:
+        sub = mapping[node.name]
+        out = sub._outputs[0][0]
+        memo[id(node)] = out
+        return out
+    import copy as _copy
+    clone = _copy.copy(node)
+    clone.inputs = [(_substitute_node(n, mapping, memo), i)
+                    for n, i in node.inputs]
+    memo[id(node)] = clone
+    return clone
+
+
+def symbol_resolve(handle):
+    """The Symbol behind a handle — AtomicSymbol resolves to its composed
+    graph once MXSymbolCompose ran."""
+    if isinstance(handle, AtomicSymbol):
+        composed = getattr(handle, "composed", None)
+        if composed is None:
+            raise ValueError("atomic symbol %r not composed yet"
+                             % handle.op_name)
+        return composed
+    return handle
+
+
+def symbol_get_atomic_name(handle) -> str:
+    if isinstance(handle, AtomicSymbol):
+        return handle.op_name
+    node = handle._outputs[0][0]
+    return node.op or ""
+
+
+def symbol_gen_atomic(s):
+    """MXGenAtomicSymbolFromSymbol (c_api_symbolic.cc:1225): a fresh
+    uncomposed node carrying the head node's op + attrs."""
+    nodes = {id(n) for n, _ in s._outputs}
+    if len(nodes) != 1:
+        raise ValueError("only works for nongrouped symbol")
+    node = s._outputs[0][0]
+    if node.op is None:
+        raise ValueError("head node is a variable, not an op")
+    return AtomicSymbol(node.op, dict(node.attrs))
+
+
+def symbol_shallow_copy(s):
+    import copy as _copy
+    return _copy.copy(s)
+
+
+def symbol_create_group(handles):
+    return sym_mod.Group([symbol_resolve(h) for h in handles])
+
+
+def symbol_get_input_symbols(s):
+    """MXSymbolGetInputSymbols: one variable symbol per graph input."""
+    return [sym_mod.var(n) for n in s.list_inputs()]
+
+
+def symbol_cut_subgraph(s):
+    """MXSymbolCutSubgraph (c_api_symbolic.cc:376): if the output node
+    carries __subgraph_name__, cut every edge crossing INTO that subgraph
+    — each crossing input entry is replaced by a fresh variable in the
+    graph (mutating s) and returned."""
+    subg_attr = "__subgraph_name__"
+    head = s._outputs[0][0]
+    subg_name = (head.attrs or {}).get(subg_attr)
+    if subg_name is None:
+        return []
+    from .symbol.symbol import _Node, _toposort
+    cut = []
+    for node in _toposort([n for n, _ in s._outputs]):
+        if (node.attrs or {}).get(subg_attr) != subg_name:
+            continue
+        new_inputs = []
+        for src, idx in node.inputs:
+            if src.op is not None and \
+                    (src.attrs or {}).get(subg_attr) != subg_name:
+                v = _Node(None, "%s_cut%d" % (src.name, len(cut)))
+                cut.append(sym_mod.Symbol([(src, idx)]))
+                new_inputs.append((v, 0))
+            else:
+                new_inputs.append((src, idx))
+        node.inputs = new_inputs
+    return cut
+
+
+def symbol_infer_type_partial(s, keys, dtype_codes):
+    return symbol_infer_type(s, keys, dtype_codes, partial=True)
+
+
+def symbol_remove_amp_cast(s):
+    """MXSymbolRemoveAmpCast: strip amp_cast / amp_multicast nodes,
+    rewiring consumers to the cast inputs."""
+    import copy as _copy
+
+    def resolve(entry, memo):
+        node, idx = entry
+        if id(node) in memo:
+            node = memo[id(node)]
+        if node.op == "amp_cast":
+            return resolve(node.inputs[0], memo)
+        if node.op == "amp_multicast":
+            return resolve(node.inputs[idx], memo)
+        return node, idx
+
+    memo = {}
+    from .symbol.symbol import _toposort
+    order = _toposort([n for n, _ in s._outputs])
+    for node in order:
+        if node.op in ("amp_cast", "amp_multicast"):
+            continue
+        clone = _copy.copy(node)
+        clone.inputs = [resolve((memo.get(id(n), n), i), memo)
+                        for n, i in node.inputs]
+        memo[id(node)] = clone
+    outs = [resolve((memo.get(id(n), n), i), memo) for n, i in s._outputs]
+    return sym_mod.Symbol(outs)
+
+
 # -- Executor (MXExecutorBind/Forward/Backward/Outputs) ----------------------
 
 _GRAD_REQ_OF_CODE = {0: "null", 1: "write", 2: "write", 3: "add"}
@@ -202,6 +443,69 @@ def executor_outputs(exe):
 
 def executor_backward(exe, head_grads):
     exe.backward(list(head_grads) if head_grads else None)
+
+
+def executor_backward_ex(exe, head_grads, is_train: int):
+    exe.backward(list(head_grads) if head_grads else None,
+                 is_train=bool(is_train))
+
+
+def executor_simple_bind(s, shape_keys, shapes, type_keys, type_codes,
+                         req_names, req_types):
+    """MXExecutorSimpleBind(Ex): allocate arg/grad/aux arrays from inferred
+    shapes and bind.  Returns (exe, args, grads_or_None, auxs) in
+    list_arguments/list_auxiliary_states order so the C side can hand the
+    allocated NDArray handles back to the caller.  grad_req arrives as
+    (names, type-strings): empty names + one type = global req."""
+    from . import cpu
+    known = {k: tuple(int(d) for d in shp)
+             for k, shp in zip(shape_keys, shapes)}
+    type_dict = {k: _DTYPE_OF[int(c)] for k, c in zip(type_keys, type_codes)}
+    arg_names = s.list_arguments()
+    req_names = [n for n in (req_names or []) if n]
+    req_types = list(req_types or [])
+    if req_names:
+        grad_req = {n: t for n, t in zip(req_names, req_types)}
+        grad_req.update({n: "null" for n in arg_names if n not in grad_req})
+    elif req_types:
+        grad_req = req_types[0]
+    else:
+        grad_req = "write"
+    exe = s.simple_bind(cpu(), grad_req=grad_req, type_dict=type_dict,
+                        **known)
+    args = [exe.arg_dict[n] for n in arg_names]
+    grads = [exe.grad_dict.get(n) for n in arg_names]
+    auxs = [exe.aux_dict[n] for n in s.list_auxiliary_states()]
+    return exe, args, grads, auxs
+
+
+def executor_reshape(exe, keys, shapes, partial_shaping: int,
+                     allow_up_sizing: int):
+    known = {k: tuple(int(d) for d in shp) for k, shp in zip(keys, shapes)}
+    new_exe = exe.reshape(partial_shaping=bool(partial_shaping),
+                          allow_up_sizing=bool(allow_up_sizing), **known)
+    arg_names = new_exe._symbol.list_arguments()
+    args = [new_exe.arg_dict[n] for n in arg_names]
+    grads = [new_exe.grad_dict.get(n) for n in arg_names]
+    auxs = [new_exe.aux_dict[n]
+            for n in new_exe._symbol.list_auxiliary_states()]
+    return new_exe, args, grads, auxs
+
+
+def executor_print(exe) -> str:
+    return exe.debug_str()
+
+
+def executor_symbol(exe):
+    """MXExecutorGetOptimizedSymbol: the graph the executor actually runs
+    (after any subgraph backend rewrite at bind time)."""
+    return exe._symbol
+
+
+def executor_set_monitor_callback(exe, cb, monitor_all: int) -> None:
+    """cb is a C trampoline wrapper installed by the native layer; it
+    receives (name, NDArray)."""
+    exe.set_monitor_callback(cb, monitor_all=bool(monitor_all))
 
 
 # -- Predict API (c_predict_api.h:84-289) -----------------------------------
@@ -562,6 +866,11 @@ def kvstore_set_updater(kv, updater) -> None:
     kv.set_updater(_upd)
 
 
+def kvstore_pull_row_sparse(kv, keys, outs, row_ids, priority: int) -> None:
+    kv.row_sparse_pull(list(keys), out=list(outs), row_ids=list(row_ids),
+                       priority=int(priority))
+
+
 # ---------------------------------------------------------------------------
 # DataIter (MXDataIter* ABI, c_api.h MXListDataIters..MXDataIterGetPadNum)
 # ---------------------------------------------------------------------------
@@ -739,7 +1048,18 @@ def cached_op_create(symbol):
 
 
 def cached_op_invoke(op, inputs):
-    return op.invoke(list(inputs))
+    inputs = list(inputs)
+    outs = op.invoke(inputs)
+    hook = getattr(op, "_capi_hook", None)
+    if hook is not None:
+        cb, monitor_all = hook
+        out_list = outs if isinstance(outs, list) else [outs]
+        if monitor_all:
+            for i, a in enumerate(inputs):
+                cb("data%d" % i, "_cached_op", a)
+        for i, a in enumerate(out_list):
+            cb("output%d" % i, "_cached_op", a)
+    return outs
 
 
 # ---------------------------------------------------------------------------
@@ -856,6 +1176,15 @@ def profile_set_marker(domain, name: str, scope: str) -> None:
 def list_functions():
     from .ops import registry
     return sorted({op.name for op in registry.OPS.values()})
+
+
+def get_function_name(name: str) -> str:
+    """MXGetFunction validation: unknown names fail here (the reference
+    looks the name up in its Registry<NDArrayFunctionReg>)."""
+    from .ops import registry
+    if name not in registry.OPS:
+        raise ValueError("unknown function %r" % name)
+    return registry.OPS[name].name
 
 
 def _numeric_attr_names(op):
@@ -1068,9 +1397,13 @@ def symbol_print(s) -> str:
     return "\n".join(lines)
 
 
-def symbol_infer_type(s, keys, dtype_codes):
+def symbol_infer_type(s, keys, dtype_codes, partial=False):
     """Returns (arg_codes, out_codes, aux_codes) via the mshadow dtype
-    code table (_CODE_OF)."""
+    code table (_CODE_OF).  Symbol.infer_type is already partial-tolerant
+    (unknowns default rather than raise), so the ``partial`` variant shares
+    the one code path; genuine type contradictions still propagate as
+    errors through both entry points, like the reference."""
+    del partial
     known = {}
     for k, c in zip(keys, dtype_codes):
         known[k] = _DTYPE_OF[int(c)]
@@ -1087,7 +1420,66 @@ def symbol_infer_type(s, keys, dtype_codes):
 
 def quantize_symbol(sym, excluded_names):
     from .contrib.quantization import quantize_graph
-    return quantize_graph(sym, excluded_sym_names=tuple(excluded_names))
+    out = quantize_graph(sym, excluded_sym_names=tuple(excluded_names))
+    # remembered so MXSetCalibTableToQuantizedSymbol can re-run the pass
+    # with ranges (the reference's two-step C flow: quantize, calibrate,
+    # then set the table — c_api_symbolic.cc:2008)
+    out._capi_q_source = (sym, tuple(excluded_names))
+    return out
+
+
+def set_calib_table(qsym, layer_names, low_quantiles, high_quantiles):
+    from .contrib.quantization import quantize_graph
+    src = getattr(qsym, "_capi_q_source", None)
+    if src is None:
+        raise ValueError(
+            "symbol was not produced by MXQuantizeSymbol in this process; "
+            "cannot attach a calibration table")
+    sym, excluded = src
+    ranges = {name: (float(lo), float(hi)) for name, lo, hi in
+              zip(layer_names, low_quantiles, high_quantiles)}
+    out = quantize_graph(sym, excluded_sym_names=excluded,
+                         calib_ranges=ranges)
+    out._capi_q_source = src
+    return out
+
+
+def kvstore_pull_with_sparse(kv, keys, outs, priority: int,
+                             ignore_sparse: int) -> None:
+    kv.pull(list(keys), out=list(outs), priority=int(priority),
+            ignore_sparse=bool(ignore_sparse))
+
+
+def cached_op_register_hook(op, hook, monitor_all: int) -> None:
+    op._capi_hook = (hook, bool(monitor_all))
+
+
+def kvstore_run_server(kv, controller) -> None:
+    """MXKVStoreRunServer: register the command controller and serve.
+    There is no separate server PROCESS in the collective backend — for
+    dist_async the rank-0 in-process host thread IS the server, so this
+    installs the controller there and blocks until the host stops (the
+    reference's RunServer also blocks, ps-lite kvstore_dist_server.h);
+    for every other store the server role is the process itself, so the
+    controller is installed for synchronous dispatch and the call returns."""
+    kv._server_controller = controller
+    host = getattr(kv, "_param_host", None)
+    if host is not None:
+        host.set_controller(controller)
+        host._thread.join()
+
+
+def kvstore_send_command(kv, head: int, body: str) -> None:
+    """MXKVStoreSendCommmandToServers: deliver (head, body) to every
+    server — one logical server here: the async param host when present,
+    else the locally registered controller."""
+    client = getattr(kv, "_client", None)
+    if client is not None:
+        client.send_command(int(head), body)
+        return
+    ctrl = getattr(kv, "_server_controller", None)
+    if ctrl is not None:
+        ctrl(int(head), body)
 
 
 def gen_backend_subgraph(sym, backend: str):

@@ -152,7 +152,18 @@ class AsyncParamHost:
             _, blob = msg
             self._optimizer = pickle.loads(blob)
             return ("OK",)
+        if op == "CMD":
+            # MXKVStoreSendCommmandToServers: deliver (head, body) to the
+            # server-side controller (kvstore_dist_server.h CommandHandle)
+            _, head, body = msg
+            ctrl = getattr(self, "_controller", None)
+            if ctrl is not None:
+                ctrl(int(head), body)
+            return ("OK",)
         return ("ERR", "unknown op %r" % (op,))
+
+    def set_controller(self, controller):
+        self._controller = controller
 
     def stop(self):
         try:
@@ -209,6 +220,9 @@ class AsyncParamClient:
 
     def set_optimizer(self, optimizer) -> None:
         self._call("SET_OPT", pickle.dumps(optimizer))
+
+    def send_command(self, head: int, body: str) -> None:
+        self._call("CMD", int(head), body)
 
     def stop_host(self) -> None:
         try:

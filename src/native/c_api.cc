@@ -40,6 +40,7 @@
 #include <cstring>
 #include <mutex>
 #include <string>
+#include <unordered_set>
 #include <vector>
 
 #define MXTPU_API extern "C" __attribute__((visibility("default")))
@@ -2726,10 +2727,26 @@ MXTPU_API int MXNDArrayCreateSparseEx64(
     int dev_id, int delay_alloc, int dtype, uint32_t num_aux,
     int* aux_type, int* aux_ndims, const int64_t* aux_shape,
     NDArrayHandle* out) {
+  size_t total = 0;
+  for (uint32_t i = 0; i < num_aux; ++i) {
+    total += static_cast<size_t>(aux_ndims[i]);
+  }
+  // the 64-bit variant exists FOR >2^31 dims: refuse to truncate
+  for (int i = 0; i < ndim; ++i) {
+    if (shape[i] < 0 || shape[i] > UINT32_MAX) {
+      return Fail("MXNDArrayCreateSparseEx64: dim " + std::to_string(i) +
+                  " = " + std::to_string(shape[i]) +
+                  " exceeds the sparse create path's 32-bit dim budget");
+    }
+  }
+  for (size_t i = 0; i < total; ++i) {
+    if (aux_shape[i] < 0 || aux_shape[i] > UINT32_MAX) {
+      return Fail("MXNDArrayCreateSparseEx64: aux dim exceeds the 32-bit "
+                  "dim budget");
+    }
+  }
   std::vector<uint32_t> shp(shape, shape + ndim);
   std::vector<uint32_t> andims(aux_ndims, aux_ndims + num_aux);
-  size_t total = 0;
-  for (uint32_t i = 0; i < num_aux; ++i) total += andims[i];
   std::vector<uint32_t> ashape(aux_shape, aux_shape + total);
   return MXNDArrayCreateSparseEx(storage_type, shp.data(),
                                  static_cast<uint32_t>(ndim), dev_type,
@@ -2823,4 +2840,1813 @@ MXTPU_API int MXNDArrayCreateFromSharedMem(int shared_pid, int shared_id,
   std::vector<int> shp(shape, shape + ndim);
   return MXNDArrayCreateFromSharedMemEx(shared_pid, shared_id, shp.data(),
                                         static_cast<int>(ndim), dtype, out);
+}
+
+// ---------------------------------------------------------------------------
+// Symbol tail: atomic-symbol creation/compose, graph surgery, type partial
+// (c_api_symbolic.cc parity block)
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// keys/vals -> two PyLists (borrowed into a tuple by the caller)
+PyObject* StrList(uint32_t n, const char** strs) {
+  PyObject* lst = PyList_New(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    PyList_SetItem(lst, i, PyUnicode_FromString(strs[i] ? strs[i] : ""));
+  }
+  return lst;
+}
+
+}  // namespace
+
+MXTPU_API int MXSymbolCreateAtomicSymbol(const char* op_name,
+                                         uint32_t num_param,
+                                         const char** keys,
+                                         const char** vals,
+                                         SymbolHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(sNN)", op_name, StrList(num_param, keys),
+                                 StrList(num_param, vals));
+  PyObject* res = CallImpl("symbol_create_atomic", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXSymbolCompose(SymbolHandle sym, const char* name,
+                              uint32_t num_args, const char** keys,
+                              SymbolHandle* args_handles) {
+  Gil gil;
+  PyObject* names = PyList_New(num_args);
+  PyObject* ins = PyList_New(num_args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    PyList_SetItem(names, i, PyUnicode_FromString(
+        (keys != nullptr && keys[i] != nullptr) ? keys[i] : ""));
+    PyObject* h = static_cast<PyObject*>(args_handles[i]);
+    Py_INCREF(h);
+    PyList_SetItem(ins, i, h);
+  }
+  PyObject* args = Py_BuildValue("(OsNN)", static_cast<PyObject*>(sym),
+                                 name ? name : "", names, ins);
+  PyObject* res = CallImpl("symbol_compose", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXSymbolCreateGroup(uint32_t num_symbols,
+                                  SymbolHandle* symbols, SymbolHandle* out) {
+  Gil gil;
+  PyObject* lst = PyList_New(num_symbols);
+  for (uint32_t i = 0; i < num_symbols; ++i) {
+    PyObject* h = static_cast<PyObject*>(symbols[i]);
+    Py_INCREF(h);
+    PyList_SetItem(lst, i, h);
+  }
+  PyObject* args = Py_BuildValue("(N)", lst);
+  PyObject* res = CallImpl("symbol_create_group", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXSymbolGetAtomicSymbolName(SymbolHandle sym,
+                                          const char** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallImpl("symbol_get_atomic_name", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  g_json_buf = PyUnicode_AsUTF8(res);
+  Py_DECREF(res);
+  *out = g_json_buf.c_str();
+  return 0;
+}
+
+MXTPU_API int MXGenAtomicSymbolFromSymbol(SymbolHandle sym,
+                                          SymbolHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallImpl("symbol_gen_atomic", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXShallowCopySymbol(SymbolHandle sym, SymbolHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallImpl("symbol_shallow_copy", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXSymbolGetInputSymbols(SymbolHandle sym,
+                                      SymbolHandle** inputs, int* input_size) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallImpl("symbol_get_input_symbols", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  g_handle_store.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(res); ++i) {
+    PyObject* h = PyList_GetItem(res, i);
+    Py_INCREF(h);
+    g_handle_store.push_back(h);
+  }
+  Py_DECREF(res);
+  *inputs = g_handle_store.data();
+  *input_size = static_cast<int>(g_handle_store.size());
+  return 0;
+}
+
+MXTPU_API int MXSymbolCutSubgraph(SymbolHandle sym, SymbolHandle** inputs,
+                                  int* input_size) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallImpl("symbol_cut_subgraph", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  g_handle_store.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(res); ++i) {
+    PyObject* h = PyList_GetItem(res, i);
+    Py_INCREF(h);
+    g_handle_store.push_back(h);
+  }
+  Py_DECREF(res);
+  *inputs = g_handle_store.data();
+  *input_size = static_cast<int>(g_handle_store.size());
+  return 0;
+}
+
+MXTPU_API int MXSymbolGrad(SymbolHandle sym, uint32_t num_wrt,
+                           const char** wrt, SymbolHandle* out) {
+  // parity with the reference: c_api_symbolic.cc:910 is LOG(FATAL)
+  // "not implemented"; gradients flow through Executor.backward (vjp)
+  (void)sym; (void)num_wrt; (void)wrt; (void)out;
+  return Fail("MXSymbolGrad: not implemented (reference parity; use "
+              "Executor backward)");
+}
+
+MXTPU_API int MXSymbolInferTypePartial(SymbolHandle sym, uint32_t num_args,
+                                       const char** keys,
+                                       const int* arg_type_data,
+                                       uint32_t* in_type_size,
+                                       const int** in_type_data,
+                                       uint32_t* out_type_size,
+                                       const int** out_type_data,
+                                       uint32_t* aux_type_size,
+                                       const int** aux_type_data,
+                                       int* complete) {
+  Gil gil;
+  PyObject* k = StrKeysToList(num_args, keys);
+  PyObject* codes = PyList_New(num_args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    PyList_SetItem(codes, i, PyLong_FromLong(arg_type_data[i]));
+  }
+  PyObject* args = Py_BuildValue("(ONN)", static_cast<PyObject*>(sym), k,
+                                 codes);
+  PyObject* res = CallImpl("symbol_infer_type_partial", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  static thread_local std::vector<int> in_t, out_t, aux_t;
+  auto fill = [&](PyObject* lst, std::vector<int>* dst) {
+    dst->clear();
+    for (Py_ssize_t i = 0; i < PyList_Size(lst); ++i) {
+      dst->push_back(static_cast<int>(
+          PyLong_AsLong(PyList_GetItem(lst, i))));
+    }
+  };
+  fill(PyTuple_GetItem(res, 0), &in_t);
+  fill(PyTuple_GetItem(res, 1), &out_t);
+  fill(PyTuple_GetItem(res, 2), &aux_t);
+  Py_DECREF(res);
+  *in_type_size = static_cast<uint32_t>(in_t.size());
+  *in_type_data = in_t.data();
+  *out_type_size = static_cast<uint32_t>(out_t.size());
+  *out_type_data = out_t.data();
+  *aux_type_size = static_cast<uint32_t>(aux_t.size());
+  *aux_type_data = aux_t.data();
+  bool done = true;
+  for (int c : in_t) done = done && c != -1;
+  for (int c : out_t) done = done && c != -1;
+  for (int c : aux_t) done = done && c != -1;
+  if (complete != nullptr) *complete = done ? 1 : 0;
+  return 0;
+}
+
+MXTPU_API int MXSymbolRemoveAmpCast(SymbolHandle sym, SymbolHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(sym));
+  PyObject* res = CallImpl("symbol_remove_amp_cast", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Executor tail: SimpleBind, Reshape, Print, monitor callback, BackwardEx,
+// optimized symbol, BindX/BindEX (c_api_executor.cc parity block)
+// ---------------------------------------------------------------------------
+
+typedef void* ExecutorHandle;
+typedef void(MXExecutorMonitorCallback)(const char*, NDArrayHandle, void*);
+
+namespace {
+
+// unpack the (exe, args, grads, auxs) tuple simple_bind/reshape return;
+// allocated handles go to per-thread stores the caller copies out of
+thread_local std::vector<NDArrayHandle> g_exec_args, g_exec_grads,
+    g_exec_auxs;
+
+int UnpackExecutorTuple(PyObject* res, ExecutorHandle* out,
+                        uint32_t* num_in_args, NDArrayHandle** in_args,
+                        NDArrayHandle** arg_grads, uint32_t* num_aux,
+                        NDArrayHandle** aux_states) {
+  PyObject* exe = PyTuple_GetItem(res, 0);
+  PyObject* args = PyTuple_GetItem(res, 1);
+  PyObject* grads = PyTuple_GetItem(res, 2);
+  PyObject* auxs = PyTuple_GetItem(res, 3);
+  g_exec_args.clear();
+  g_exec_grads.clear();
+  g_exec_auxs.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(args); ++i) {
+    PyObject* h = PyList_GetItem(args, i);
+    Py_INCREF(h);
+    g_exec_args.push_back(h);
+  }
+  for (Py_ssize_t i = 0; i < PyList_Size(grads); ++i) {
+    PyObject* h = PyList_GetItem(grads, i);
+    if (h == Py_None) {
+      g_exec_grads.push_back(nullptr);
+    } else {
+      Py_INCREF(h);
+      g_exec_grads.push_back(h);
+    }
+  }
+  for (Py_ssize_t i = 0; i < PyList_Size(auxs); ++i) {
+    PyObject* h = PyList_GetItem(auxs, i);
+    Py_INCREF(h);
+    g_exec_auxs.push_back(h);
+  }
+  Py_INCREF(exe);
+  *out = exe;
+  *num_in_args = static_cast<uint32_t>(g_exec_args.size());
+  *in_args = g_exec_args.data();
+  *arg_grads = g_exec_grads.data();
+  if (num_aux != nullptr) {
+    *num_aux = static_cast<uint32_t>(g_exec_auxs.size());
+    *aux_states = g_exec_auxs.data();
+  }
+  return 0;
+}
+
+}  // namespace
+
+MXTPU_API int MXExecutorSimpleBindEx(
+    SymbolHandle symbol_handle, int dev_type, int dev_id,
+    const uint32_t num_g2c_keys, const char** g2c_keys,
+    const int* g2c_dev_types, const int* g2c_dev_ids,
+    const uint32_t provided_grad_req_list_len,
+    const char** provided_grad_req_names,
+    const char** provided_grad_req_types,
+    const uint32_t num_provided_arg_shapes,
+    const char** provided_arg_shape_names,
+    const int* provided_arg_shape_data,
+    const uint32_t* provided_arg_shape_idx,
+    const uint32_t num_provided_arg_dtypes,
+    const char** provided_arg_dtype_names, const int* provided_arg_dtypes,
+    const uint32_t num_provided_arg_stypes,
+    const char** provided_arg_stype_names, const int* provided_arg_stypes,
+    const uint32_t num_shared_arg_names, const char** shared_arg_name_list,
+    int* shared_buffer_len, const char** shared_buffer_name_list,
+    NDArrayHandle* shared_buffer_handle_list,
+    const char*** updated_shared_buffer_name_list,
+    NDArrayHandle** updated_shared_buffer_handle_list,
+    uint32_t* num_in_args, NDArrayHandle** in_args,
+    NDArrayHandle** arg_grads, uint32_t* num_aux_states,
+    NDArrayHandle** aux_states, ExecutorHandle shared_exec_handle,
+    ExecutorHandle* out) {
+  // device placement is XLA's; group2ctx / shared buffers are accepted and
+  // ignored (single-program compilation has no per-op context assignment)
+  (void)dev_type; (void)dev_id; (void)num_g2c_keys; (void)g2c_keys;
+  (void)g2c_dev_types; (void)g2c_dev_ids; (void)num_provided_arg_stypes;
+  (void)provided_arg_stype_names; (void)provided_arg_stypes;
+  (void)num_shared_arg_names; (void)shared_arg_name_list;
+  (void)shared_buffer_len; (void)shared_buffer_name_list;
+  (void)shared_buffer_handle_list; (void)updated_shared_buffer_name_list;
+  (void)updated_shared_buffer_handle_list; (void)shared_exec_handle;
+  Gil gil;
+  PyObject* shape_keys = PyList_New(num_provided_arg_shapes);
+  PyObject* shape_vals = PyList_New(num_provided_arg_shapes);
+  for (uint32_t i = 0; i < num_provided_arg_shapes; ++i) {
+    PyList_SetItem(shape_keys, i,
+                   PyUnicode_FromString(provided_arg_shape_names[i]));
+    uint32_t lo = provided_arg_shape_idx[i];
+    uint32_t hi = provided_arg_shape_idx[i + 1];
+    PyObject* shp = PyList_New(hi - lo);
+    for (uint32_t j = lo; j < hi; ++j) {
+      PyList_SetItem(shp, j - lo, PyLong_FromLong(provided_arg_shape_data[j]));
+    }
+    PyList_SetItem(shape_vals, i, shp);
+  }
+  PyObject* type_keys = PyList_New(num_provided_arg_dtypes);
+  PyObject* type_vals = PyList_New(num_provided_arg_dtypes);
+  for (uint32_t i = 0; i < num_provided_arg_dtypes; ++i) {
+    PyList_SetItem(type_keys, i,
+                   PyUnicode_FromString(provided_arg_dtype_names[i]));
+    PyList_SetItem(type_vals, i, PyLong_FromLong(provided_arg_dtypes[i]));
+  }
+  PyObject* req_names = PyList_New(provided_grad_req_list_len);
+  PyObject* req_types = PyList_New(provided_grad_req_list_len);
+  for (uint32_t i = 0; i < provided_grad_req_list_len; ++i) {
+    const char* n = provided_grad_req_names != nullptr
+                        ? provided_grad_req_names[i] : nullptr;
+    PyList_SetItem(req_names, i, PyUnicode_FromString(n != nullptr ? n : ""));
+    PyList_SetItem(req_types, i, PyUnicode_FromString(
+        provided_grad_req_types[i] != nullptr ? provided_grad_req_types[i]
+                                              : "write"));
+  }
+  PyObject* args = Py_BuildValue(
+      "(ONNNNNN)", static_cast<PyObject*>(symbol_handle), shape_keys,
+      shape_vals, type_keys, type_vals, req_names, req_types);
+  PyObject* res = CallImpl("executor_simple_bind", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  int rc = UnpackExecutorTuple(res, out, num_in_args, in_args, arg_grads,
+                               num_aux_states, aux_states);
+  Py_DECREF(res);
+  return rc;
+}
+
+MXTPU_API int MXExecutorReshapeEx(int partial_shaping, int allow_up_sizing,
+                                  int dev_type, int dev_id,
+                                  uint32_t num_map_keys,
+                                  const char** map_keys,
+                                  const int* map_dev_types,
+                                  const int* map_dev_ids,
+                                  const uint32_t num_provided_arg_shapes,
+                                  const char** provided_arg_shape_names,
+                                  const int* provided_arg_shape_data,
+                                  const uint32_t* provided_arg_shape_idx,
+                                  uint32_t* num_in_args,
+                                  NDArrayHandle** in_args,
+                                  NDArrayHandle** arg_grads,
+                                  uint32_t* num_aux_states,
+                                  NDArrayHandle** aux_states,
+                                  ExecutorHandle shared_exec,
+                                  ExecutorHandle* out) {
+  (void)dev_type; (void)dev_id; (void)num_map_keys; (void)map_keys;
+  (void)map_dev_types; (void)map_dev_ids;
+  Gil gil;
+  PyObject* keys = PyList_New(num_provided_arg_shapes);
+  PyObject* vals = PyList_New(num_provided_arg_shapes);
+  for (uint32_t i = 0; i < num_provided_arg_shapes; ++i) {
+    PyList_SetItem(keys, i,
+                   PyUnicode_FromString(provided_arg_shape_names[i]));
+    uint32_t lo = provided_arg_shape_idx[i];
+    uint32_t hi = provided_arg_shape_idx[i + 1];
+    PyObject* shp = PyList_New(hi - lo);
+    for (uint32_t j = lo; j < hi; ++j) {
+      PyList_SetItem(shp, j - lo, PyLong_FromLong(provided_arg_shape_data[j]));
+    }
+    PyList_SetItem(vals, i, shp);
+  }
+  PyObject* args = Py_BuildValue("(ONNii)",
+                                 static_cast<PyObject*>(shared_exec), keys,
+                                 vals, partial_shaping, allow_up_sizing);
+  PyObject* res = CallImpl("executor_reshape", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  int rc = UnpackExecutorTuple(res, out, num_in_args, in_args, arg_grads,
+                               num_aux_states, aux_states);
+  Py_DECREF(res);
+  return rc;
+}
+
+MXTPU_API int MXExecutorPrint(ExecutorHandle handle, const char** out_str) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallImpl("executor_print", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  g_json_buf = PyUnicode_AsUTF8(res);
+  Py_DECREF(res);
+  *out_str = g_json_buf.c_str();
+  return 0;
+}
+
+MXTPU_API int MXExecutorGetOptimizedSymbol(ExecutorHandle handle,
+                                           SymbolHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallImpl("executor_symbol", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXExecutorBackwardEx(ExecutorHandle handle, uint32_t len,
+                                   NDArrayHandle* head_grads, int is_train) {
+  Gil gil;
+  PyObject* grads;
+  if (len == 0) {
+    grads = Py_None;
+    Py_INCREF(Py_None);
+  } else {
+    grads = PyList_New(len);
+    for (uint32_t i = 0; i < len; ++i) {
+      PyObject* h = static_cast<PyObject*>(head_grads[i]);
+      Py_INCREF(h);
+      PyList_SetItem(grads, i, h);
+    }
+  }
+  PyObject* args = Py_BuildValue("(ONi)", static_cast<PyObject*>(handle),
+                                 grads, is_train);
+  PyObject* res = CallImpl("executor_backward_ex", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+namespace {
+
+// C monitor-callback trampoline: wraps the function pointer in a small
+// PyCapsule-driven callable the Python executor invokes per output
+struct MonitorCtx {
+  MXExecutorMonitorCallback* cb;
+  void* param;
+};
+
+PyObject* MonitorTrampoline(PyObject* self, PyObject* py_args) {
+  MonitorCtx* ctx = static_cast<MonitorCtx*>(
+      PyCapsule_GetPointer(self, "mxtpu.monitor"));
+  const char* name = nullptr;
+  PyObject* arr = nullptr;
+  if (!PyArg_ParseTuple(py_args, "sO", &name, &arr)) return nullptr;
+  Py_INCREF(arr);  // callee receives a borrowed handle; keep it alive
+  ctx->cb(name, arr, ctx->param);
+  Py_DECREF(arr);
+  Py_RETURN_NONE;
+}
+
+PyMethodDef g_monitor_def = {"monitor_trampoline", MonitorTrampoline,
+                             METH_VARARGS, nullptr};
+
+void MonitorCapsuleDestructor(PyObject* cap) {
+  delete static_cast<MonitorCtx*>(
+      PyCapsule_GetPointer(cap, "mxtpu.monitor"));
+}
+
+}  // namespace
+
+MXTPU_API int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                           MXExecutorMonitorCallback callback,
+                                           void* callback_handle) {
+  Gil gil;
+  MonitorCtx* ctx = new MonitorCtx{callback, callback_handle};
+  PyObject* cap = PyCapsule_New(ctx, "mxtpu.monitor",
+                                MonitorCapsuleDestructor);
+  PyObject* fn = PyCFunction_New(&g_monitor_def, cap);
+  Py_DECREF(cap);
+  PyObject* args = Py_BuildValue("(ONi)", static_cast<PyObject*>(handle),
+                                 fn, 0);
+  PyObject* res = CallImpl("executor_set_monitor_callback", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXExecutorSetMonitorCallbackEX(
+    ExecutorHandle handle, MXExecutorMonitorCallback callback,
+    void* callback_handle, bool monitor_all) {
+  Gil gil;
+  MonitorCtx* ctx = new MonitorCtx{callback, callback_handle};
+  PyObject* cap = PyCapsule_New(ctx, "mxtpu.monitor",
+                                MonitorCapsuleDestructor);
+  PyObject* fn = PyCFunction_New(&g_monitor_def, cap);
+  Py_DECREF(cap);
+  PyObject* args = Py_BuildValue("(ONi)", static_cast<PyObject*>(handle),
+                                 fn, monitor_all ? 1 : 0);
+  PyObject* res = CallImpl("executor_set_monitor_callback", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Misc runtime tail: numpy-shape mode, bulk size, features, library loading,
+// creator-handle imperative invoke, process profiler aliases, AMP/backend
+// symbol passes, kvstore sparse pull + env surface
+// ---------------------------------------------------------------------------
+
+MXTPU_API int MXIsNumpyShape(int* curr) {
+  Gil gil;
+  PyObject* res = CallImpl("is_numpy_shape", nullptr);
+  if (res == nullptr) return FailFromPython();
+  *curr = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXSetIsNumpyShape(int is_np_shape, int* prev) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", is_np_shape);
+  PyObject* res = CallImpl("set_is_numpy_shape", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *prev = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXRandomSeedContext(int seed, int dev_type, int dev_id) {
+  (void)dev_type; (void)dev_id;  // one seeded philox stream per process
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", seed);
+  PyObject* res = CallImpl("random_seed", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXEngineSetBulkSize(int bulk_size, int* prev_bulk_size) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", bulk_size);
+  PyObject* res = CallImpl("engine_set_bulk_size", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *prev_bulk_size = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+// mirrors the reference's LibFeature struct (include/mxnet/libinfo.h): the
+// caller receives a pointer to an array of {name, enabled}
+struct MXTPULibFeature {
+  const char* name;
+  bool enabled;
+};
+
+namespace {
+thread_local std::vector<std::string> g_feat_names;
+thread_local std::vector<MXTPULibFeature> g_feats;
+}  // namespace
+
+MXTPU_API int MXLibInfoFeatures(const MXTPULibFeature** lib_features,
+                                size_t* size) {
+  Gil gil;
+  PyObject* res = CallImpl("libinfo_features", nullptr);
+  if (res == nullptr) return FailFromPython();
+  PyObject* names = PyTuple_GetItem(res, 0);
+  PyObject* flags = PyTuple_GetItem(res, 1);
+  Py_ssize_t n = PyList_Size(names);
+  g_feat_names.clear();
+  g_feats.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    g_feat_names.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(names, i)));
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    g_feats.push_back({g_feat_names[i].c_str(),
+                       PyLong_AsLong(PyList_GetItem(flags, i)) != 0});
+  }
+  Py_DECREF(res);
+  *lib_features = g_feats.data();
+  *size = static_cast<size_t>(n);
+  return 0;
+}
+
+MXTPU_API int MXLoadLib(const char* path, unsigned verbose) {
+  (void)verbose;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", path);
+  PyObject* res = CallImpl("load_op_library", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXGetGPUMemoryInformation64(int dev, uint64_t* free_mem,
+                                          uint64_t* total_mem);
+
+MXTPU_API int MXGetGPUMemoryInformation(int dev, int* free_mem,
+                                        int* total_mem) {
+  uint64_t f = 0, t = 0;
+  int rc = MXGetGPUMemoryInformation64(dev, &f, &t);
+  if (rc != 0) return rc;
+  *free_mem = static_cast<int>(f >> 20);   // MB, like the reference
+  *total_mem = static_cast<int>(t >> 20);
+  return 0;
+}
+
+// creator-handle imperative invoke: creators ARE interned op-name strings
+// (MXSymbolListAtomicSymbolCreators above), so these delegate byte-for-byte
+MXTPU_API int MXImperativeInvoke(void* creator, int num_inputs,
+                                 NDArrayHandle* inputs, int* num_outputs,
+                                 NDArrayHandle** outputs, int num_params,
+                                 const char** param_keys,
+                                 const char** param_vals) {
+  return MXImperativeInvokeByName(static_cast<const char*>(creator),
+                                  num_inputs, inputs, num_outputs, outputs,
+                                  num_params, param_keys, param_vals);
+}
+
+MXTPU_API int MXImperativeInvokeEx(void* creator, int num_inputs,
+                                   NDArrayHandle* inputs, int* num_outputs,
+                                   NDArrayHandle** outputs, int num_params,
+                                   const char** param_keys,
+                                   const char** param_vals,
+                                   const int** out_stypes) {
+  int rc = MXImperativeInvokeByName(static_cast<const char*>(creator),
+                                    num_inputs, inputs, num_outputs, outputs,
+                                    num_params, param_keys, param_vals);
+  if (rc != 0) return rc;
+  Gil gil;
+  static thread_local std::vector<int> stypes;
+  stypes.clear();
+  for (int i = 0; i < *num_outputs; ++i) {
+    PyObject* args = Py_BuildValue(
+        "(O)", static_cast<PyObject*>((*outputs)[i]));
+    PyObject* res = CallImpl("ndarray_storage_type", args);
+    Py_DECREF(args);
+    if (res == nullptr) return FailFromPython();
+    stypes.push_back(static_cast<int>(PyLong_AsLong(res)));
+    Py_DECREF(res);
+  }
+  *out_stypes = stypes.data();
+  return 0;
+}
+
+// symbol creation from a creator handle (reference signature takes the
+// creator, not a name; both resolve identically here)
+MXTPU_API int MXSymbolCreateAtomicSymbolFromCreator(void* creator,
+                                                    uint32_t num_param,
+                                                    const char** keys,
+                                                    const char** vals,
+                                                    SymbolHandle* out) {
+  return MXSymbolCreateAtomicSymbol(static_cast<const char*>(creator),
+                                    num_param, keys, vals, out);
+}
+
+// process-level profiler surface: this runtime has one profiler per
+// process, so the process variants alias the per-worker entry points
+MXTPU_API int MXSetProfilerConfig(int num_params, const char* const* keys,
+                                  const char* const* vals);
+MXTPU_API int MXSetProfilerState(int state);
+MXTPU_API int MXDumpProfile(int finished);
+MXTPU_API int MXProfilePause(int paused);
+MXTPU_API int MXAggregateProfileStatsPrint(const char** out_str, int reset);
+
+MXTPU_API int MXSetProcessProfilerConfig(int num_params,
+                                         const char* const* keys,
+                                         const char* const* vals,
+                                         void* kvstore_handle) {
+  (void)kvstore_handle;  // dist-server profiling rides the same process
+  return MXSetProfilerConfig(num_params, keys, vals);
+}
+
+MXTPU_API int MXSetProcessProfilerState(int state, int profile_process,
+                                        void* kv_store_handle) {
+  (void)profile_process; (void)kv_store_handle;
+  return MXSetProfilerState(state);
+}
+
+MXTPU_API int MXDumpProcessProfile(int finished, int profile_process,
+                                   void* kv_store_handle) {
+  (void)profile_process; (void)kv_store_handle;
+  return MXDumpProfile(finished);
+}
+
+MXTPU_API int MXProcessProfilePause(int paused, int profile_process,
+                                    void* kv_store_handle) {
+  (void)profile_process; (void)kv_store_handle;
+  return MXProfilePause(paused);
+}
+
+MXTPU_API int MXAggregateProfileStatsPrintEx(const char** out_str, int reset,
+                                             int format, int sort_by,
+                                             int ascending) {
+  (void)format; (void)sort_by; (void)ascending;  // tabular default
+  return MXAggregateProfileStatsPrint(out_str, reset);
+}
+
+MXTPU_API int MXReducePrecisionSymbol(SymbolHandle sym, SymbolHandle* out,
+                                      uint32_t num_args, const int* arg_types,
+                                      uint32_t num_ind_ptr,
+                                      const int* ind_ptr,
+                                      const int* target_dtype,
+                                      const int cast_optional_params,
+                                      const uint32_t num_target_dtype_ops,
+                                      const uint32_t num_fp32_ops,
+                                      const uint32_t num_widest_dtype_ops,
+                                      const uint32_t num_conditional_fp32_ops,
+                                      const uint32_t num_excluded_symbols,
+                                      const uint32_t num_model_params,
+                                      const char** target_dtype_ops,
+                                      const char** fp32_ops,
+                                      const char** widest_dtype_ops,
+                                      const char** conditional_fp32_ops,
+                                      const char** excluded_symbols,
+                                      const char** conditional_param_names,
+                                      const char** conditional_param_vals,
+                                      const char** model_param_names,
+                                      const char** arg_names) {
+  (void)num_args; (void)arg_types; (void)num_ind_ptr; (void)ind_ptr;
+  (void)cast_optional_params; (void)num_target_dtype_ops; (void)num_fp32_ops;
+  (void)num_widest_dtype_ops; (void)num_conditional_fp32_ops;
+  (void)num_excluded_symbols; (void)num_model_params; (void)target_dtype_ops;
+  (void)fp32_ops; (void)widest_dtype_ops; (void)conditional_fp32_ops;
+  (void)excluded_symbols; (void)conditional_param_names;
+  (void)conditional_param_vals; (void)model_param_names; (void)arg_names;
+  Gil gil;
+  const char* dtype = (target_dtype != nullptr && *target_dtype == 2)
+                          ? "float16" : "bfloat16";
+  PyObject* args = Py_BuildValue("(Os)", static_cast<PyObject*>(sym), dtype);
+  PyObject* res = CallImpl("amp_reduce_precision_symbol", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXOptimizeForBackend(
+    SymbolHandle sym, const char* backend, const int dev_type,
+    SymbolHandle* ret_sym, const uint32_t args_len, NDArrayHandle* in_args,
+    const uint32_t aux_len, NDArrayHandle* in_aux, const uint32_t num_options,
+    const char** keys, const char** vals, int* new_args_cnt,
+    NDArrayHandle** new_args_handle, char*** new_arg_names_handle,
+    int* new_aux_cnt, NDArrayHandle** new_aux_handle,
+    char*** new_aux_names_handle) {
+  (void)dev_type; (void)args_len; (void)in_args; (void)aux_len;
+  (void)in_aux; (void)num_options; (void)keys; (void)vals;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", static_cast<PyObject*>(sym),
+                                 backend ? backend : "");
+  PyObject* res = CallImpl("symbol_optimize_for", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *ret_sym = res;
+  if (new_args_cnt != nullptr) *new_args_cnt = 0;
+  if (new_aux_cnt != nullptr) *new_aux_cnt = 0;
+  if (new_args_handle != nullptr) *new_args_handle = nullptr;
+  if (new_aux_handle != nullptr) *new_aux_handle = nullptr;
+  if (new_arg_names_handle != nullptr) *new_arg_names_handle = nullptr;
+  if (new_aux_names_handle != nullptr) *new_aux_names_handle = nullptr;
+  return 0;
+}
+
+typedef void* DataIterCreator;
+
+MXTPU_API int MXDataIterGetIterInfo(DataIterCreator creator,
+                                    const char** name,
+                                    const char** description,
+                                    uint32_t* num_args,
+                                    const char*** arg_names,
+                                    const char*** arg_types,
+                                    const char*** arg_descriptions) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", static_cast<const char*>(creator));
+  PyObject* res = CallImpl("data_iter_info", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  g_info_name = PyUnicode_AsUTF8(PyTuple_GetItem(res, 0));
+  g_info_desc = PyUnicode_AsUTF8(PyTuple_GetItem(res, 1));
+  const char*** outs[3] = {arg_names, arg_types, arg_descriptions};
+  uint32_t n = 0;
+  for (int g = 0; g < 3; ++g) {
+    PyObject* lst = PyTuple_GetItem(res, 2 + g);
+    Py_ssize_t m = PyList_Size(lst);
+    g_info_store[g].clear();
+    g_info_ptrs[g].clear();
+    for (Py_ssize_t i = 0; i < m; ++i) {
+      g_info_store[g].emplace_back(
+          PyUnicode_AsUTF8(PyList_GetItem(lst, i)));
+    }
+    for (auto& s : g_info_store[g]) g_info_ptrs[g].push_back(s.c_str());
+    *outs[g] = g_info_ptrs[g].data();
+    n = static_cast<uint32_t>(m);
+  }
+  Py_DECREF(res);
+  *name = g_info_name.c_str();
+  *description = g_info_desc.c_str();
+  *num_args = n;
+  return 0;
+}
+
+MXTPU_API int MXAutogradGetSymbol(NDArrayHandle handle, SymbolHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallImpl("autograd_get_symbol", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+// -- kvstore tail -----------------------------------------------------------
+
+MXTPU_API int MXKVStorePullRowSparseEx(KVStoreHandle kv, uint32_t num,
+                                       const char** keys,
+                                       NDArrayHandle* outs,
+                                       NDArrayHandle* row_ids,
+                                       int priority) {
+  Gil gil;
+  PyObject* k = PyList_New(num);
+  PyObject* o = PyList_New(num);
+  PyObject* r = PyList_New(num);
+  for (uint32_t i = 0; i < num; ++i) {
+    PyList_SetItem(k, i, PyUnicode_FromString(keys[i]));
+    PyObject* oh = static_cast<PyObject*>(outs[i]);
+    PyObject* rh = static_cast<PyObject*>(row_ids[i]);
+    Py_INCREF(oh);
+    Py_INCREF(rh);
+    PyList_SetItem(o, i, oh);
+    PyList_SetItem(r, i, rh);
+  }
+  PyObject* args = Py_BuildValue("(ONNNi)", static_cast<PyObject*>(kv), k, o,
+                                 r, priority);
+  PyObject* res = CallImpl("kvstore_pull_row_sparse", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXKVStorePullRowSparse(KVStoreHandle kv, uint32_t num,
+                                     const int* keys, NDArrayHandle* outs,
+                                     NDArrayHandle* row_ids, int priority) {
+  std::vector<std::string> skeys(num);
+  std::vector<const char*> pkeys(num);
+  for (uint32_t i = 0; i < num; ++i) {
+    skeys[i] = std::to_string(keys[i]);
+    pkeys[i] = skeys[i].c_str();
+  }
+  return MXKVStorePullRowSparseEx(kv, num, pkeys.data(), outs, row_ids,
+                                  priority);
+}
+
+MXTPU_API int MXInitPSEnv(uint32_t num_vars, const char** keys,
+                          const char** vals) {
+  // ps-lite env (DMLC_ROLE etc.) — the collective backend reads its own
+  // rendezvous env; accept and export so launchers can stay unchanged
+  Gil gil;
+  for (uint32_t i = 0; i < num_vars; ++i) {
+    setenv(keys[i], vals[i], 1);
+  }
+  return 0;
+}
+
+MXTPU_API int MXKVStoreSetBarrierBeforeExit(KVStoreHandle kv,
+                                            const int do_barrier) {
+  (void)kv; (void)do_barrier;  // exit barrier is implicit in collectives
+  return 0;
+}
+
+MXTPU_API int MXKVStoreGetNumDeadNode(KVStoreHandle kv, const int node_id,
+                                      int* number, const int timeout_sec) {
+  (void)kv; (void)node_id; (void)timeout_sec;
+  // liveness is the launcher's job (tools/launch.py polling); a reachable
+  // store implies zero dead peers in the collective world
+  *number = 0;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Final ABI tail: bind/reshape aliases, Ex/64 infer-shape family, function
+// registry by name, kvstore sparse/str-updater, cached-op hook, calib table,
+// dlpack, rtc/tvm build-parity errors
+// ---------------------------------------------------------------------------
+
+MXTPU_API int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id,
+                             uint32_t len, NDArrayHandle* in_args,
+                             NDArrayHandle* arg_grad_store,
+                             uint32_t* grad_req_type, uint32_t aux_len,
+                             NDArrayHandle* aux_states, ExecutorHandle* out);
+
+MXTPU_API int MXExecutorBindX(SymbolHandle sym, int dev_type, int dev_id,
+                              uint32_t num_map_keys, const char** map_keys,
+                              const int* map_dev_types,
+                              const int* map_dev_ids, uint32_t len,
+                              NDArrayHandle* in_args,
+                              NDArrayHandle* arg_grad_store,
+                              uint32_t* grad_req_type, uint32_t aux_len,
+                              NDArrayHandle* aux_states,
+                              ExecutorHandle* out) {
+  // group2ctx maps place op groups on devices; XLA owns placement here
+  (void)num_map_keys; (void)map_keys; (void)map_dev_types; (void)map_dev_ids;
+  return MXExecutorBind(sym, dev_type, dev_id, len, in_args, arg_grad_store,
+                        grad_req_type, aux_len, aux_states, out);
+}
+
+MXTPU_API int MXExecutorBindEX(SymbolHandle sym, int dev_type, int dev_id,
+                               uint32_t num_map_keys, const char** map_keys,
+                               const int* map_dev_types,
+                               const int* map_dev_ids, uint32_t len,
+                               NDArrayHandle* in_args,
+                               NDArrayHandle* arg_grad_store,
+                               uint32_t* grad_req_type, uint32_t aux_len,
+                               NDArrayHandle* aux_states,
+                               ExecutorHandle shared_exec,
+                               ExecutorHandle* out) {
+  (void)shared_exec;  // memory sharing is XLA buffer assignment's job
+  return MXExecutorBindX(sym, dev_type, dev_id, num_map_keys, map_keys,
+                         map_dev_types, map_dev_ids, len, in_args,
+                         arg_grad_store, grad_req_type, aux_len, aux_states,
+                         out);
+}
+
+MXTPU_API int MXExecutorSimpleBind(
+    SymbolHandle symbol_handle, int dev_type, int dev_id,
+    const uint32_t num_g2c_keys, const char** g2c_keys,
+    const int* g2c_dev_types, const int* g2c_dev_ids,
+    const uint32_t provided_grad_req_list_len,
+    const char** provided_grad_req_names,
+    const char** provided_grad_req_types,
+    const uint32_t num_provided_arg_shapes,
+    const char** provided_arg_shape_names,
+    const uint32_t* provided_arg_shape_data,
+    const uint32_t* provided_arg_shape_idx,
+    const uint32_t num_provided_arg_dtypes,
+    const char** provided_arg_dtype_names, const int* provided_arg_dtypes,
+    const uint32_t num_provided_arg_stypes,
+    const char** provided_arg_stype_names, const int* provided_arg_stypes,
+    const uint32_t num_shared_arg_names, const char** shared_arg_name_list,
+    int* shared_buffer_len, const char** shared_buffer_name_list,
+    NDArrayHandle* shared_buffer_handle_list,
+    const char*** updated_shared_buffer_name_list,
+    NDArrayHandle** updated_shared_buffer_handle_list,
+    uint32_t* num_in_args, NDArrayHandle** in_args,
+    NDArrayHandle** arg_grads, uint32_t* num_aux_states,
+    NDArrayHandle** aux_states, ExecutorHandle shared_exec_handle,
+    ExecutorHandle* out) {
+  size_t total = num_provided_arg_shapes
+                     ? provided_arg_shape_idx[num_provided_arg_shapes] : 0;
+  std::vector<int> data(provided_arg_shape_data,
+                        provided_arg_shape_data + total);
+  return MXExecutorSimpleBindEx(
+      symbol_handle, dev_type, dev_id, num_g2c_keys, g2c_keys, g2c_dev_types,
+      g2c_dev_ids, provided_grad_req_list_len, provided_grad_req_names,
+      provided_grad_req_types, num_provided_arg_shapes,
+      provided_arg_shape_names, data.data(), provided_arg_shape_idx,
+      num_provided_arg_dtypes, provided_arg_dtype_names, provided_arg_dtypes,
+      num_provided_arg_stypes, provided_arg_stype_names, provided_arg_stypes,
+      num_shared_arg_names, shared_arg_name_list, shared_buffer_len,
+      shared_buffer_name_list, shared_buffer_handle_list,
+      updated_shared_buffer_name_list, updated_shared_buffer_handle_list,
+      num_in_args, in_args, arg_grads, num_aux_states, aux_states,
+      shared_exec_handle, out);
+}
+
+MXTPU_API int MXExecutorSimpleBindEx64(
+    SymbolHandle symbol_handle, int dev_type, int dev_id,
+    const uint32_t num_g2c_keys, const char** g2c_keys,
+    const int* g2c_dev_types, const int* g2c_dev_ids,
+    const uint32_t provided_grad_req_list_len,
+    const char** provided_grad_req_names,
+    const char** provided_grad_req_types,
+    const uint32_t num_provided_arg_shapes,
+    const char** provided_arg_shape_names,
+    const int64_t* provided_arg_shape_data,
+    const int64_t* provided_arg_shape_idx,
+    const uint32_t num_provided_arg_dtypes,
+    const char** provided_arg_dtype_names, const int* provided_arg_dtypes,
+    const uint32_t num_provided_arg_stypes,
+    const char** provided_arg_stype_names, const int* provided_arg_stypes,
+    const uint32_t num_shared_arg_names, const char** shared_arg_name_list,
+    int* shared_buffer_len, const char** shared_buffer_name_list,
+    NDArrayHandle* shared_buffer_handle_list,
+    const char*** updated_shared_buffer_name_list,
+    NDArrayHandle** updated_shared_buffer_handle_list,
+    uint32_t* num_in_args, NDArrayHandle** in_args,
+    NDArrayHandle** arg_grads, uint32_t* num_aux_states,
+    NDArrayHandle** aux_states, ExecutorHandle shared_exec_handle,
+    ExecutorHandle* out) {
+  size_t total = num_provided_arg_shapes
+                     ? static_cast<size_t>(
+                           provided_arg_shape_idx[num_provided_arg_shapes])
+                     : 0;
+  for (size_t i = 0; i < total; ++i) {
+    if (provided_arg_shape_data[i] > INT32_MAX ||
+        provided_arg_shape_data[i] < INT32_MIN) {
+      return Fail("MXExecutorSimpleBindEx64: shape dim exceeds the bind "
+                  "path's 32-bit budget");
+    }
+  }
+  std::vector<int> data(provided_arg_shape_data,
+                        provided_arg_shape_data + total);
+  std::vector<uint32_t> idx(provided_arg_shape_idx,
+                            provided_arg_shape_idx +
+                                num_provided_arg_shapes + 1);
+  return MXExecutorSimpleBindEx(
+      symbol_handle, dev_type, dev_id, num_g2c_keys, g2c_keys, g2c_dev_types,
+      g2c_dev_ids, provided_grad_req_list_len, provided_grad_req_names,
+      provided_grad_req_types, num_provided_arg_shapes,
+      provided_arg_shape_names, data.data(), idx.data(),
+      num_provided_arg_dtypes, provided_arg_dtype_names, provided_arg_dtypes,
+      num_provided_arg_stypes, provided_arg_stype_names, provided_arg_stypes,
+      num_shared_arg_names, shared_arg_name_list, shared_buffer_len,
+      shared_buffer_name_list, shared_buffer_handle_list,
+      updated_shared_buffer_name_list, updated_shared_buffer_handle_list,
+      num_in_args, in_args, arg_grads, num_aux_states, aux_states,
+      shared_exec_handle, out);
+}
+
+MXTPU_API int MXExecutorReshape(int partial_shaping, int allow_up_sizing,
+                                int dev_type, int dev_id,
+                                uint32_t num_map_keys, const char** map_keys,
+                                const int* map_dev_types,
+                                const int* map_dev_ids,
+                                const uint32_t num_provided_arg_shapes,
+                                const char** provided_arg_shape_names,
+                                const uint32_t* provided_arg_shape_data,
+                                const uint32_t* provided_arg_shape_idx,
+                                uint32_t* num_in_args,
+                                NDArrayHandle** in_args,
+                                NDArrayHandle** arg_grads,
+                                uint32_t* num_aux_states,
+                                NDArrayHandle** aux_states,
+                                ExecutorHandle shared_exec,
+                                ExecutorHandle* out) {
+  size_t total = num_provided_arg_shapes
+                     ? provided_arg_shape_idx[num_provided_arg_shapes] : 0;
+  std::vector<int> data(provided_arg_shape_data,
+                        provided_arg_shape_data + total);
+  return MXExecutorReshapeEx(partial_shaping, allow_up_sizing, dev_type,
+                             dev_id, num_map_keys, map_keys, map_dev_types,
+                             map_dev_ids, num_provided_arg_shapes,
+                             provided_arg_shape_names, data.data(),
+                             provided_arg_shape_idx, num_in_args, in_args,
+                             arg_grads, num_aux_states, aux_states,
+                             shared_exec, out);
+}
+
+// -- Ex/64 infer-shape family ----------------------------------------------
+// One generic driver; each ABI variant converts its index/data widths.
+
+namespace {
+
+thread_local std::vector<std::vector<int64_t>> g_isg_shapes[3];
+thread_local std::vector<int> g_isg_ndim_int[3];
+thread_local std::vector<const int*> g_isg_rows_int[3];
+thread_local std::vector<std::vector<int>> g_isg_data_int[3];
+thread_local std::vector<const int64_t*> g_isg_rows_i64[3];
+
+int InferShapeGeneric(SymbolHandle sym, uint32_t num_args, const char** keys,
+                      const std::vector<std::vector<int64_t>>& in_shapes,
+                      int partial, int* complete) {
+  Gil gil;
+  PyObject* pkeys = PyList_New(num_args);
+  PyObject* pshapes = PyList_New(num_args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    PyList_SetItem(pkeys, i, PyUnicode_FromString(keys[i]));
+    PyObject* shp = PyList_New(in_shapes[i].size());
+    for (size_t d = 0; d < in_shapes[i].size(); ++d) {
+      PyList_SetItem(shp, d, PyLong_FromLongLong(in_shapes[i][d]));
+    }
+    PyList_SetItem(pshapes, i, shp);
+  }
+  PyObject* args = Py_BuildValue("(ONNi)", static_cast<PyObject*>(sym),
+                                 pkeys, pshapes, partial);
+  PyObject* res = CallImpl("symbol_infer_shape", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  for (int g = 0; g < 3; ++g) {
+    PyObject* group = PyTuple_GetItem(res, g);
+    g_isg_shapes[g].clear();
+    for (Py_ssize_t i = 0; i < PyList_Size(group); ++i) {
+      PyObject* shp = PyList_GetItem(group, i);
+      std::vector<int64_t> dims;
+      for (Py_ssize_t d = 0; d < PyList_Size(shp); ++d) {
+        dims.push_back(PyLong_AsLongLong(PyList_GetItem(shp, d)));
+      }
+      g_isg_shapes[g].push_back(std::move(dims));
+    }
+  }
+  if (complete != nullptr) {
+    *complete = PyObject_IsTrue(PyTuple_GetItem(res, 3));
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+void StoreGroupInt(int g, uint32_t* size, const int** ndim,
+                   const int*** data) {
+  auto& shapes = g_isg_shapes[g];
+  auto& rows = g_isg_rows_int[g];
+  auto& store = g_isg_data_int[g];
+  store.clear();
+  rows.clear();
+  g_isg_ndim_int[g].clear();
+  for (auto& dims : shapes) {
+    std::vector<int> row(dims.begin(), dims.end());
+    store.push_back(std::move(row));
+    g_isg_ndim_int[g].push_back(static_cast<int>(dims.size()));
+  }
+  for (auto& row : store) rows.push_back(row.data());
+  *size = static_cast<uint32_t>(shapes.size());
+  *ndim = g_isg_ndim_int[g].data();
+  *data = rows.data();
+}
+
+thread_local std::vector<std::vector<int64_t>> g_isg_data_i64[3];
+
+void StoreGroupI64(int g, size_t* size, const int** ndim,
+                   const int64_t*** data) {
+  auto& shapes = g_isg_shapes[g];
+  auto& rows = g_isg_rows_i64[g];
+  auto& store = g_isg_data_i64[g];
+  store.clear();
+  rows.clear();
+  g_isg_ndim_int[g].clear();
+  for (auto& dims : shapes) {
+    store.push_back(dims);
+    g_isg_ndim_int[g].push_back(static_cast<int>(dims.size()));
+  }
+  for (auto& row : store) rows.push_back(row.data());
+  *size = shapes.size();
+  *ndim = g_isg_ndim_int[g].data();
+  *data = rows.data();
+}
+
+std::vector<std::vector<int64_t>> PackShapes32(uint32_t num_args,
+                                               const uint32_t* ind_ptr,
+                                               const int* data) {
+  std::vector<std::vector<int64_t>> out(num_args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    for (uint32_t d = ind_ptr[i]; d < ind_ptr[i + 1]; ++d) {
+      out[i].push_back(data[d]);
+    }
+  }
+  return out;
+}
+
+std::vector<std::vector<int64_t>> PackShapes64(uint32_t num_args,
+                                               const int64_t* ind_ptr,
+                                               const int64_t* data) {
+  std::vector<std::vector<int64_t>> out(num_args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    for (int64_t d = ind_ptr[i]; d < ind_ptr[i + 1]; ++d) {
+      out[i].push_back(data[d]);
+    }
+  }
+  return out;
+}
+
+}  // namespace
+
+MXTPU_API int MXSymbolInferShapeEx(
+    SymbolHandle sym, uint32_t num_args, const char** keys,
+    const uint32_t* arg_ind_ptr, const int* arg_shape_data,
+    uint32_t* in_shape_size, const int** in_shape_ndim,
+    const int*** in_shape_data, uint32_t* out_shape_size,
+    const int** out_shape_ndim, const int*** out_shape_data,
+    uint32_t* aux_shape_size, const int** aux_shape_ndim,
+    const int*** aux_shape_data, int* complete) {
+  int rc = InferShapeGeneric(sym, num_args, keys,
+                             PackShapes32(num_args, arg_ind_ptr,
+                                          arg_shape_data), 0, complete);
+  if (rc != 0) return rc;
+  StoreGroupInt(0, in_shape_size, in_shape_ndim, in_shape_data);
+  StoreGroupInt(1, out_shape_size, out_shape_ndim, out_shape_data);
+  StoreGroupInt(2, aux_shape_size, aux_shape_ndim, aux_shape_data);
+  return 0;
+}
+
+MXTPU_API int MXSymbolInferShapePartialEx(
+    SymbolHandle sym, uint32_t num_args, const char** keys,
+    const uint32_t* arg_ind_ptr, const int* arg_shape_data,
+    uint32_t* in_shape_size, const int** in_shape_ndim,
+    const int*** in_shape_data, uint32_t* out_shape_size,
+    const int** out_shape_ndim, const int*** out_shape_data,
+    uint32_t* aux_shape_size, const int** aux_shape_ndim,
+    const int*** aux_shape_data, int* complete) {
+  int rc = InferShapeGeneric(sym, num_args, keys,
+                             PackShapes32(num_args, arg_ind_ptr,
+                                          arg_shape_data), 1, complete);
+  if (rc != 0) return rc;
+  StoreGroupInt(0, in_shape_size, in_shape_ndim, in_shape_data);
+  StoreGroupInt(1, out_shape_size, out_shape_ndim, out_shape_data);
+  StoreGroupInt(2, aux_shape_size, aux_shape_ndim, aux_shape_data);
+  return 0;
+}
+
+MXTPU_API int MXSymbolInferShape64(
+    SymbolHandle sym, uint32_t num_args, const char** keys,
+    const int64_t* arg_ind_ptr, const int64_t* arg_shape_data,
+    size_t* in_shape_size, const int** in_shape_ndim,
+    const int64_t*** in_shape_data, size_t* out_shape_size,
+    const int** out_shape_ndim, const int64_t*** out_shape_data,
+    size_t* aux_shape_size, const int** aux_shape_ndim,
+    const int64_t*** aux_shape_data, int* complete) {
+  int rc = InferShapeGeneric(sym, num_args, keys,
+                             PackShapes64(num_args, arg_ind_ptr,
+                                          arg_shape_data), 0, complete);
+  if (rc != 0) return rc;
+  StoreGroupI64(0, in_shape_size, in_shape_ndim, in_shape_data);
+  StoreGroupI64(1, out_shape_size, out_shape_ndim, out_shape_data);
+  StoreGroupI64(2, aux_shape_size, aux_shape_ndim, aux_shape_data);
+  return 0;
+}
+
+MXTPU_API int MXSymbolInferShapePartial64(
+    SymbolHandle sym, uint32_t num_args, const char** keys,
+    const int64_t* arg_ind_ptr, const int64_t* arg_shape_data,
+    size_t* in_shape_size, const int** in_shape_ndim,
+    const int64_t*** in_shape_data, size_t* out_shape_size,
+    const int** out_shape_ndim, const int64_t*** out_shape_data,
+    size_t* aux_shape_size, const int** aux_shape_ndim,
+    const int64_t*** aux_shape_data, int* complete) {
+  int rc = InferShapeGeneric(sym, num_args, keys,
+                             PackShapes64(num_args, arg_ind_ptr,
+                                          arg_shape_data), 1, complete);
+  if (rc != 0) return rc;
+  StoreGroupI64(0, in_shape_size, in_shape_ndim, in_shape_data);
+  StoreGroupI64(1, out_shape_size, out_shape_ndim, out_shape_data);
+  StoreGroupI64(2, aux_shape_size, aux_shape_ndim, aux_shape_data);
+  return 0;
+}
+
+MXTPU_API int MXSymbolInferShapeEx64(
+    SymbolHandle sym, uint32_t num_args, const char** keys,
+    const int64_t* arg_ind_ptr, const int64_t* arg_shape_data,
+    size_t* in_shape_size, const int** in_shape_ndim,
+    const int64_t*** in_shape_data, size_t* out_shape_size,
+    const int** out_shape_ndim, const int64_t*** out_shape_data,
+    size_t* aux_shape_size, const int** aux_shape_ndim,
+    const int64_t*** aux_shape_data, int* complete) {
+  return MXSymbolInferShape64(sym, num_args, keys, arg_ind_ptr,
+                              arg_shape_data, in_shape_size, in_shape_ndim,
+                              in_shape_data, out_shape_size, out_shape_ndim,
+                              out_shape_data, aux_shape_size, aux_shape_ndim,
+                              aux_shape_data, complete);
+}
+
+MXTPU_API int MXSymbolInferShapePartialEx64(
+    SymbolHandle sym, uint32_t num_args, const char** keys,
+    const int64_t* arg_ind_ptr, const int64_t* arg_shape_data,
+    size_t* in_shape_size, const int** in_shape_ndim,
+    const int64_t*** in_shape_data, size_t* out_shape_size,
+    const int** out_shape_ndim, const int64_t*** out_shape_data,
+    size_t* aux_shape_size, const int** aux_shape_ndim,
+    const int64_t*** aux_shape_data, int* complete) {
+  return MXSymbolInferShapePartial64(sym, num_args, keys, arg_ind_ptr,
+                                     arg_shape_data, in_shape_size,
+                                     in_shape_ndim, in_shape_data,
+                                     out_shape_size, out_shape_ndim,
+                                     out_shape_data, aux_shape_size,
+                                     aux_shape_ndim, aux_shape_data,
+                                     complete);
+}
+
+// -- function registry by name / kvstore str-updater / cached-op hook -------
+
+typedef void* FunctionHandle;
+
+namespace {
+// process-wide interned function names: unordered_set nodes never move,
+// so returned handles stay valid for the process lifetime (function
+// handles are long-lived in bindings, unlike the per-call thread-local
+// borrow contract the listing entry points use)
+std::mutex g_fn_intern_mu;
+std::unordered_set<std::string>* FnInternTable() {
+  static std::unordered_set<std::string> table;
+  return &table;
+}
+}  // namespace
+
+MXTPU_API int MXGetFunction(const char* name, FunctionHandle* out) {
+  Gil gil;
+  // validate the name against the registry so unknown names fail here,
+  // not at call time
+  PyObject* args = Py_BuildValue("(s)", name);
+  PyObject* res = CallImpl("get_function_name", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  std::string canonical = PyUnicode_AsUTF8(res);
+  Py_DECREF(res);
+  std::lock_guard<std::mutex> lock(g_fn_intern_mu);
+  auto it = FnInternTable()->insert(std::move(canonical)).first;
+  *out = const_cast<char*>(it->c_str());
+  return 0;
+}
+
+typedef void(MXKVStoreStrUpdater)(const char* key, NDArrayHandle recv,
+                                  NDArrayHandle local, void* handle);
+
+namespace {
+
+struct UpdaterExClosure {
+  MXKVStoreUpdater* fn;
+  MXKVStoreStrUpdater* str_fn;
+  void* handle;
+};
+
+PyObject* CallCUpdaterEx(PyObject*, PyObject* args) {
+  PyObject* capsule = nullptr;
+  PyObject* key_obj = nullptr;
+  PyObject* recv = nullptr;
+  PyObject* local = nullptr;
+  if (!PyArg_ParseTuple(args, "OOOO", &capsule, &key_obj, &recv, &local)) {
+    return nullptr;
+  }
+  auto* cl = static_cast<UpdaterExClosure*>(
+      PyCapsule_GetPointer(capsule, "mxtpu_updater_ex"));
+  if (cl == nullptr) return nullptr;
+  if (PyUnicode_Check(key_obj)) {
+    // string keys dispatch to the string updater (the API the caller
+    // used); numeric conversion is only a fallback when no string
+    // updater was registered
+    if (cl->str_fn != nullptr) {
+      cl->str_fn(PyUnicode_AsUTF8(key_obj), recv, local, cl->handle);
+      Py_RETURN_NONE;
+    }
+    PyObject* as_int = PyLong_FromUnicodeObject(key_obj, 10);
+    if (as_int == nullptr || cl->fn == nullptr) {
+      Py_XDECREF(as_int);
+      PyErr_SetString(PyExc_TypeError,
+                      "no updater registered for string keys");
+      return nullptr;
+    }
+    cl->fn(static_cast<int>(PyLong_AsLong(as_int)), recv, local,
+           cl->handle);
+    Py_DECREF(as_int);
+  } else {
+    if (cl->fn == nullptr) {
+      PyErr_SetString(PyExc_TypeError, "no int updater registered");
+      return nullptr;
+    }
+    cl->fn(static_cast<int>(PyLong_AsLong(key_obj)), recv, local,
+           cl->handle);
+  }
+  Py_RETURN_NONE;
+}
+
+PyMethodDef g_call_c_updater_ex_def = {
+    "call_c_updater_ex", CallCUpdaterEx, METH_VARARGS,
+    "trampoline into a C MXKVStoreUpdater / MXKVStoreStrUpdater pair"};
+
+void FreeUpdaterExCapsule(PyObject* capsule) {
+  delete static_cast<UpdaterExClosure*>(
+      PyCapsule_GetPointer(capsule, "mxtpu_updater_ex"));
+}
+
+}  // namespace
+
+MXTPU_API int MXKVStoreSetUpdaterEx(KVStoreHandle kv,
+                                    MXKVStoreUpdater updater,
+                                    MXKVStoreStrUpdater str_updater,
+                                    void* updater_handle) {
+  Gil gil;
+  auto* cl = new UpdaterExClosure{updater, str_updater, updater_handle};
+  PyObject* capsule = PyCapsule_New(cl, "mxtpu_updater_ex",
+                                    FreeUpdaterExCapsule);
+  PyObject* tramp = PyCFunction_New(&g_call_c_updater_ex_def, nullptr);
+  PyObject* functools = PyImport_ImportModule("functools");
+  PyObject* partial = PyObject_GetAttrString(functools, "partial");
+  PyObject* bound = PyObject_CallFunctionObjArgs(partial, tramp, capsule,
+                                                 nullptr);
+  Py_DECREF(functools);
+  Py_DECREF(partial);
+  Py_DECREF(tramp);
+  Py_DECREF(capsule);
+  if (bound == nullptr) return FailFromPython();
+  PyObject* args = Py_BuildValue("(ON)", static_cast<PyObject*>(kv), bound);
+  PyObject* res = CallImpl("kvstore_set_updater", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXKVStorePullWithSparseEx(KVStoreHandle kv, uint32_t num,
+                                        const char** keys,
+                                        NDArrayHandle* vals, int priority,
+                                        bool ignore_sparse) {
+  Gil gil;
+  PyObject* k = PyList_New(num);
+  PyObject* o = PyList_New(num);
+  for (uint32_t i = 0; i < num; ++i) {
+    PyList_SetItem(k, i, PyUnicode_FromString(keys[i]));
+    PyObject* oh = static_cast<PyObject*>(vals[i]);
+    Py_INCREF(oh);
+    PyList_SetItem(o, i, oh);
+  }
+  PyObject* args = Py_BuildValue("(ONNii)", static_cast<PyObject*>(kv), k, o,
+                                 priority, ignore_sparse ? 1 : 0);
+  PyObject* res = CallImpl("kvstore_pull_with_sparse", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXKVStorePullWithSparse(KVStoreHandle kv, uint32_t num,
+                                      const int* keys, NDArrayHandle* vals,
+                                      int priority, bool ignore_sparse) {
+  // int keys stay ints (IntKeysToList convention shared with MXKVStorePull)
+  Gil gil;
+  PyObject* k = PyList_New(num);
+  PyObject* o = PyList_New(num);
+  for (uint32_t i = 0; i < num; ++i) {
+    PyList_SetItem(k, i, PyLong_FromLong(keys[i]));
+    PyObject* oh = static_cast<PyObject*>(vals[i]);
+    Py_INCREF(oh);
+    PyList_SetItem(o, i, oh);
+  }
+  PyObject* args = Py_BuildValue("(ONNii)", static_cast<PyObject*>(kv), k, o,
+                                 priority, ignore_sparse ? 1 : 0);
+  PyObject* res = CallImpl("kvstore_pull_with_sparse", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+typedef void(MXTPUCachedOpMonitorCallback)(const char*, const char*,
+                                           NDArrayHandle);
+
+namespace {
+
+struct CachedHookClosure {
+  MXTPUCachedOpMonitorCallback* fn;
+};
+
+PyObject* CallCachedHook(PyObject*, PyObject* args) {
+  PyObject* capsule = nullptr;
+  const char* name = nullptr;
+  const char* opr = nullptr;
+  PyObject* arr = nullptr;
+  if (!PyArg_ParseTuple(args, "OssO", &capsule, &name, &opr, &arr)) {
+    return nullptr;
+  }
+  auto* cl = static_cast<CachedHookClosure*>(
+      PyCapsule_GetPointer(capsule, "mxtpu_cached_hook"));
+  if (cl == nullptr) return nullptr;
+  cl->fn(name, opr, arr);
+  Py_RETURN_NONE;
+}
+
+PyMethodDef g_cached_hook_def = {"call_cached_hook", CallCachedHook,
+                                 METH_VARARGS, nullptr};
+
+void FreeCachedHookCapsule(PyObject* capsule) {
+  delete static_cast<CachedHookClosure*>(
+      PyCapsule_GetPointer(capsule, "mxtpu_cached_hook"));
+}
+
+}  // namespace
+
+MXTPU_API int MXCachedOpRegisterOpHook(NDArrayHandle handle,
+                                       MXTPUCachedOpMonitorCallback callback,
+                                       bool monitor_all) {
+  Gil gil;
+  auto* cl = new CachedHookClosure{callback};
+  PyObject* capsule = PyCapsule_New(cl, "mxtpu_cached_hook",
+                                    FreeCachedHookCapsule);
+  PyObject* tramp = PyCFunction_New(&g_cached_hook_def, nullptr);
+  PyObject* functools = PyImport_ImportModule("functools");
+  PyObject* partial = PyObject_GetAttrString(functools, "partial");
+  PyObject* bound = PyObject_CallFunctionObjArgs(partial, tramp, capsule,
+                                                 nullptr);
+  Py_DECREF(functools);
+  Py_DECREF(partial);
+  Py_DECREF(tramp);
+  Py_DECREF(capsule);
+  if (bound == nullptr) return FailFromPython();
+  PyObject* args = Py_BuildValue("(ONi)", static_cast<PyObject*>(handle),
+                                 bound, monitor_all ? 1 : 0);
+  PyObject* res = CallImpl("cached_op_register_hook", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXSetCalibTableToQuantizedSymbol(SymbolHandle qsym,
+                                               const uint32_t num_layers,
+                                               const char** layer_names,
+                                               const float* low_quantiles,
+                                               const float* high_quantiles,
+                                               SymbolHandle* out) {
+  Gil gil;
+  PyObject* names = PyList_New(num_layers);
+  PyObject* lows = PyList_New(num_layers);
+  PyObject* highs = PyList_New(num_layers);
+  for (uint32_t i = 0; i < num_layers; ++i) {
+    PyList_SetItem(names, i, PyUnicode_FromString(layer_names[i]));
+    PyList_SetItem(lows, i, PyFloat_FromDouble(low_quantiles[i]));
+    PyList_SetItem(highs, i, PyFloat_FromDouble(high_quantiles[i]));
+  }
+  PyObject* args = Py_BuildValue("(ONNN)", static_cast<PyObject*>(qsym),
+                                 names, lows, highs);
+  PyObject* res = CallImpl("set_calib_table", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+// -- dlpack -----------------------------------------------------------------
+// Self-contained DLManagedTensor production/consumption: the exported
+// tensor owns a host copy (TPU buffers can't alias host memory; the copy
+// IS the honest semantics, exactly like MXNDArraySyncCopyToCPU).
+
+extern "C" {
+
+typedef struct {
+  int32_t device_type;  // kDLCPU = 1
+  int32_t device_id;
+} MXTPUDLDevice;
+
+typedef struct {
+  uint8_t code;  // 0=int 1=uint 2=float 4=bfloat 6=bool
+  uint8_t bits;
+  uint16_t lanes;
+} MXTPUDLDataType;
+
+typedef struct {
+  void* data;
+  MXTPUDLDevice device;
+  int32_t ndim;
+  MXTPUDLDataType dtype;
+  int64_t* shape;
+  int64_t* strides;
+  uint64_t byte_offset;
+} MXTPUDLTensor;
+
+typedef struct MXTPUDLManagedTensor {
+  MXTPUDLTensor dl_tensor;
+  void* manager_ctx;
+  void (*deleter)(struct MXTPUDLManagedTensor* self);
+} MXTPUDLManagedTensor;
+
+}  // extern "C"
+
+namespace {
+
+struct DLPackExport {
+  MXTPUDLManagedTensor tensor;
+  std::vector<char> payload;
+  std::vector<int64_t> shape;
+};
+
+void DLPackExportDeleter(MXTPUDLManagedTensor* self) {
+  delete static_cast<DLPackExport*>(self->manager_ctx);
+}
+
+// mshadow dtype code -> (dlpack code, bits)
+bool DTypeToDL(int code, uint8_t* dl_code, uint8_t* bits) {
+  switch (code) {
+    case 0: *dl_code = 2; *bits = 32; return true;   // f32
+    case 1: *dl_code = 2; *bits = 64; return true;   // f64
+    case 2: *dl_code = 2; *bits = 16; return true;   // f16
+    case 3: *dl_code = 1; *bits = 8; return true;    // u8
+    case 4: *dl_code = 0; *bits = 32; return true;   // i32
+    case 5: *dl_code = 0; *bits = 8; return true;    // i8
+    case 6: *dl_code = 0; *bits = 64; return true;   // i64
+    case 7: *dl_code = 6; *bits = 8; return true;    // bool
+  }
+  return false;
+}
+
+int DLToDType(uint8_t dl_code, uint8_t bits) {
+  if (dl_code == 2 && bits == 32) return 0;
+  if (dl_code == 2 && bits == 64) return 1;
+  if (dl_code == 2 && bits == 16) return 2;
+  if (dl_code == 1 && bits == 8) return 3;
+  if (dl_code == 0 && bits == 32) return 4;
+  if (dl_code == 0 && bits == 8) return 5;
+  if (dl_code == 0 && bits == 64) return 6;
+  if (dl_code == 6 && bits == 8) return 7;
+  return -1;
+}
+
+}  // namespace
+
+MXTPU_API int MXNDArrayToDLPack(NDArrayHandle handle,
+                                MXTPUDLManagedTensor** out_dlpack) {
+  Gil gil;
+  // dtype code + shape + contents
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* dt = CallImpl("ndarray_dtype", args);
+  if (dt == nullptr) { Py_DECREF(args); return FailFromPython(); }
+  int code = static_cast<int>(PyLong_AsLong(dt));
+  Py_DECREF(dt);
+  PyObject* shp = CallImpl("ndarray_shape", args);
+  if (shp == nullptr) { Py_DECREF(args); return FailFromPython(); }
+  PyObject* bytes = CallImpl("ndarray_to_bytes", args);
+  Py_DECREF(args);
+  if (bytes == nullptr) { Py_DECREF(shp); return FailFromPython(); }
+
+  auto* exp = new DLPackExport();
+  for (Py_ssize_t i = 0; i < PyList_Size(shp); ++i) {
+    exp->shape.push_back(PyLong_AsLongLong(PyList_GetItem(shp, i)));
+  }
+  Py_DECREF(shp);
+  char* buf = nullptr;
+  Py_ssize_t n = 0;
+  PyBytes_AsStringAndSize(bytes, &buf, &n);
+  exp->payload.assign(buf, buf + n);
+  Py_DECREF(bytes);
+
+  uint8_t dl_code = 0, bits = 0;
+  if (!DTypeToDL(code, &dl_code, &bits)) {
+    delete exp;
+    return Fail("dtype code not representable in dlpack");
+  }
+  exp->tensor.dl_tensor.data = exp->payload.data();
+  exp->tensor.dl_tensor.device = {1, 0};  // kDLCPU
+  exp->tensor.dl_tensor.ndim = static_cast<int32_t>(exp->shape.size());
+  exp->tensor.dl_tensor.dtype = {dl_code, bits, 1};
+  exp->tensor.dl_tensor.shape = exp->shape.data();
+  exp->tensor.dl_tensor.strides = nullptr;  // compact row-major
+  exp->tensor.dl_tensor.byte_offset = 0;
+  exp->tensor.manager_ctx = exp;
+  exp->tensor.deleter = DLPackExportDeleter;
+  *out_dlpack = &exp->tensor;
+  return 0;
+}
+
+MXTPU_API int MXNDArrayFromDLPackEx(MXTPUDLManagedTensor* dlpack,
+                                    const bool transient_handle,
+                                    NDArrayHandle* out) {
+  (void)transient_handle;
+  if (dlpack == nullptr) return Fail("null dlpack tensor");
+  MXTPUDLTensor* t = &dlpack->dl_tensor;
+  int code = DLToDType(t->dtype.code, t->dtype.bits);
+  if (code < 0 || t->dtype.lanes != 1) {
+    return Fail("unsupported dlpack dtype");
+  }
+  // require compact row-major (strides null or matching)
+  int64_t elems = 1;
+  if (t->strides != nullptr) {
+    int64_t expect = 1;
+    for (int i = t->ndim - 1; i >= 0; --i) {
+      if (t->shape[i] != 1 && t->strides[i] != expect) {
+        return Fail("dlpack import requires a compact row-major tensor");
+      }
+      expect *= t->shape[i];
+    }
+  }
+  for (int i = 0; i < t->ndim; ++i) elems *= t->shape[i];
+  size_t nbytes = static_cast<size_t>(elems) * (t->dtype.bits / 8);
+  Gil gil;
+  PyObject* shp = PyTuple_New(t->ndim);
+  for (int i = 0; i < t->ndim; ++i) {
+    PyTuple_SetItem(shp, i, PyLong_FromLongLong(t->shape[i]));
+  }
+  PyObject* args = Py_BuildValue(
+      "(Niy#)", shp, code,
+      static_cast<const char*>(t->data) + t->byte_offset,
+      static_cast<Py_ssize_t>(nbytes));
+  PyObject* res = CallImpl("ndarray_from_bytes_dtype", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *out = res;
+  return 0;
+}
+
+MXTPU_API int MXNDArrayFromDLPack(MXTPUDLManagedTensor* dlpack,
+                                  NDArrayHandle* out) {
+  return MXNDArrayFromDLPackEx(dlpack, false, out);
+}
+
+MXTPU_API int MXNDArrayCallDLPackDeleter(MXTPUDLManagedTensor* dlpack) {
+  if (dlpack != nullptr && dlpack->deleter != nullptr) {
+    dlpack->deleter(dlpack);
+  }
+  return 0;
+}
+
+// -- rtc / tvm build-parity errors ------------------------------------------
+// The reference compiled WITHOUT CUDA / TVM returns an error from these
+// entry points (MXNET_USE_CUDA=0 guards, LOG(FATAL) in c_api.cc); this
+// runtime's string-kernel path is the Pallas MXRtcCudaKernel* surface.
+
+typedef void* RtcHandle;
+
+MXTPU_API int MXRtcCreate(char* name, uint32_t num_input,
+                          uint32_t num_output, char** input_names,
+                          char** output_names, NDArrayHandle* inputs,
+                          NDArrayHandle* outputs, char* kernel,
+                          RtcHandle* out) {
+  (void)name; (void)num_input; (void)num_output; (void)input_names;
+  (void)output_names; (void)inputs; (void)outputs; (void)kernel; (void)out;
+  return Fail("MXRtcCreate: CUDA RTC is not available on the TPU runtime "
+              "(use MXRtcCudaKernelCreate's Pallas path)");
+}
+
+MXTPU_API int MXRtcPush(RtcHandle handle, uint32_t num_input,
+                        uint32_t num_output, NDArrayHandle* inputs,
+                        NDArrayHandle* outputs, uint32_t gridDimX,
+                        uint32_t gridDimY, uint32_t gridDimZ,
+                        uint32_t blockDimX, uint32_t blockDimY,
+                        uint32_t blockDimZ) {
+  (void)handle; (void)num_input; (void)num_output; (void)inputs;
+  (void)outputs; (void)gridDimX; (void)gridDimY; (void)gridDimZ;
+  (void)blockDimX; (void)blockDimY; (void)blockDimZ;
+  return Fail("MXRtcPush: CUDA RTC is not available on the TPU runtime");
+}
+
+MXTPU_API int MXRtcFree(RtcHandle handle) {
+  (void)handle;
+  return Fail("MXRtcFree: CUDA RTC is not available on the TPU runtime");
+}
+
+MXTPU_API int MXLoadTVMConfig(const void* config) {
+  (void)config;
+  return Fail("MXLoadTVMConfig: built without TVM op support (reference "
+              "parity for MXNET_USE_TVM_OP=0; Pallas/rtc.py is the "
+              "runtime-kernel path)");
+}
+
+MXTPU_API int MXLoadTVMOp(const char* libpath) {
+  (void)libpath;
+  return Fail("MXLoadTVMOp: built without TVM op support (reference parity "
+              "for MXNET_USE_TVM_OP=0)");
+}
+
+// -- kvstore server surface -------------------------------------------------
+
+typedef void(MXKVStoreServerController)(int head, const char* body,
+                                        void* controller_handle);
+
+namespace {
+
+struct ControllerClosure {
+  MXKVStoreServerController* fn;
+  void* handle;
+};
+
+PyObject* CallCController(PyObject*, PyObject* args) {
+  PyObject* capsule = nullptr;
+  int head = 0;
+  const char* body = nullptr;
+  if (!PyArg_ParseTuple(args, "Ois", &capsule, &head, &body)) return nullptr;
+  auto* cl = static_cast<ControllerClosure*>(
+      PyCapsule_GetPointer(capsule, "mxtpu_controller"));
+  if (cl == nullptr) return nullptr;
+  cl->fn(head, body, cl->handle);
+  Py_RETURN_NONE;
+}
+
+PyMethodDef g_controller_def = {"call_c_controller", CallCController,
+                                METH_VARARGS, nullptr};
+
+void FreeControllerCapsule(PyObject* capsule) {
+  delete static_cast<ControllerClosure*>(
+      PyCapsule_GetPointer(capsule, "mxtpu_controller"));
+}
+
+}  // namespace
+
+MXTPU_API int MXKVStoreRunServer(KVStoreHandle kv,
+                                 MXKVStoreServerController controller,
+                                 void* controller_handle) {
+  Gil gil;
+  auto* cl = new ControllerClosure{controller, controller_handle};
+  PyObject* capsule = PyCapsule_New(cl, "mxtpu_controller",
+                                    FreeControllerCapsule);
+  PyObject* tramp = PyCFunction_New(&g_controller_def, nullptr);
+  PyObject* functools = PyImport_ImportModule("functools");
+  PyObject* partial = PyObject_GetAttrString(functools, "partial");
+  PyObject* bound = PyObject_CallFunctionObjArgs(partial, tramp, capsule,
+                                                 nullptr);
+  Py_DECREF(functools);
+  Py_DECREF(partial);
+  Py_DECREF(tramp);
+  Py_DECREF(capsule);
+  if (bound == nullptr) return FailFromPython();
+  PyObject* args = Py_BuildValue("(ON)", static_cast<PyObject*>(kv), bound);
+  PyObject* res = CallImpl("kvstore_run_server", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXKVStoreSendCommmandToServers(KVStoreHandle kv, int cmd_id,
+                                             const char* cmd_body) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Ois)", static_cast<PyObject*>(kv), cmd_id,
+                                 cmd_body ? cmd_body : "");
+  PyObject* res = CallImpl("kvstore_send_command", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
 }

@@ -860,3 +860,434 @@ def test_reshape_reverse_abi(lib):
     _check(lib, lib.MXNDArrayGetShape64(r, ctypes.byref(ndim),
                                         ctypes.byref(p64)))
     assert [p64[i] for i in range(ndim.value)] == [2, 15]
+
+
+def test_symbol_atomic_compose_abi(lib):
+    """MXSymbolCreateAtomicSymbol + MXSymbolCompose (the reference's
+    two-step construction), atomic-name reflection, group, shallow copy,
+    input symbols."""
+    atom = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 1)(b"num_hidden")
+    vals = (ctypes.c_char_p * 1)(b"4")
+    _check(lib, lib.MXSymbolCreateAtomicSymbol(b"FullyConnected", 1, keys,
+                                               vals, ctypes.byref(atom)))
+    nm = ctypes.c_char_p()
+    _check(lib, lib.MXSymbolGetAtomicSymbolName(atom, ctypes.byref(nm)))
+    assert nm.value == b"FullyConnected"
+
+    data = ctypes.c_void_p()
+    w = ctypes.c_void_p()
+    b = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateVariable(b"data", ctypes.byref(data)))
+    _check(lib, lib.MXSymbolCreateVariable(b"w", ctypes.byref(w)))
+    _check(lib, lib.MXSymbolCreateVariable(b"b", ctypes.byref(b)))
+    in_keys = (ctypes.c_char_p * 3)(b"data", b"weight", b"bias")
+    in_args = (ctypes.c_void_p * 3)(data, w, b)
+    _check(lib, lib.MXSymbolCompose(atom, b"fc0", 3, in_keys, in_args))
+    n = ctypes.c_uint32()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib, lib.MXSymbolListArguments(atom, ctypes.byref(n),
+                                          ctypes.byref(arr)))
+    assert [arr[i].decode() for i in range(n.value)] == ["data", "w", "b"]
+
+    # GenAtomicSymbolFromSymbol reflects back the head op
+    atom2 = ctypes.c_void_p()
+    _check(lib, lib.MXGenAtomicSymbolFromSymbol(atom, ctypes.byref(atom2)))
+    _check(lib, lib.MXSymbolGetAtomicSymbolName(atom2, ctypes.byref(nm)))
+    assert nm.value == b"FullyConnected"
+
+    cp = ctypes.c_void_p()
+    _check(lib, lib.MXShallowCopySymbol(atom, ctypes.byref(cp)))
+    _check(lib, lib.MXSymbolListArguments(cp, ctypes.byref(n),
+                                          ctypes.byref(arr)))
+    assert n.value == 3
+
+    grp = ctypes.c_void_p()
+    syms = (ctypes.c_void_p * 2)(atom, cp)
+    _check(lib, lib.MXSymbolCreateGroup(2, syms, ctypes.byref(grp)))
+    _check(lib, lib.MXSymbolGetNumOutputs(grp, ctypes.byref(n)))
+    assert n.value == 2
+
+    ins = ctypes.POINTER(ctypes.c_void_p)()
+    sz = ctypes.c_int()
+    _check(lib, lib.MXSymbolGetInputSymbols(atom, ctypes.byref(ins),
+                                            ctypes.byref(sz)))
+    assert sz.value == 3
+
+    # MXSymbolGrad is reference-parity unimplemented: must FAIL loudly
+    g = ctypes.c_void_p()
+    wrt = (ctypes.c_char_p * 1)(b"data")
+    assert lib.MXSymbolGrad(atom, 1, wrt, ctypes.byref(g)) != 0
+
+
+def test_symbol_infer_type_partial_abi(lib):
+    import incubator_mxnet_tpu.symbol as sym
+    s = sym.FullyConnected(sym.var("data"), sym.var("w"), sym.var("b"),
+                           num_hidden=4)
+    h = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateFromJSON(s.tojson().encode(),
+                                           ctypes.byref(h)))
+    keys = (ctypes.c_char_p * 1)(b"data")
+    codes = (ctypes.c_int * 1)(0)
+    in_sz = ctypes.c_uint32(); out_sz = ctypes.c_uint32()
+    aux_sz = ctypes.c_uint32()
+    in_t = ctypes.POINTER(ctypes.c_int)()
+    out_t = ctypes.POINTER(ctypes.c_int)()
+    aux_t = ctypes.POINTER(ctypes.c_int)()
+    comp = ctypes.c_int()
+    _check(lib, lib.MXSymbolInferTypePartial(
+        h, 1, keys, codes, ctypes.byref(in_sz), ctypes.byref(in_t),
+        ctypes.byref(out_sz), ctypes.byref(out_t), ctypes.byref(aux_sz),
+        ctypes.byref(aux_t), ctypes.byref(comp)))
+    assert in_sz.value == 3 and out_sz.value == 1
+
+
+def test_executor_simple_bind_monitor_abi(lib):
+    """MXExecutorSimpleBindEx allocates arrays; train step through
+    Forward/BackwardEx; monitor callback fires per output; Print and
+    GetOptimizedSymbol reflect the bound graph."""
+    import incubator_mxnet_tpu.symbol as sym
+    s = sym.FullyConnected(sym.var("data"), sym.var("w"), sym.var("b"),
+                           num_hidden=3)
+    h = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateFromJSON(s.tojson().encode(),
+                                           ctypes.byref(h)))
+
+    shape_names = (ctypes.c_char_p * 1)(b"data")
+    shape_data = (ctypes.c_int * 2)(2, 5)
+    shape_idx = (ctypes.c_uint32 * 2)(0, 2)
+    n_in = ctypes.c_uint32(); n_aux = ctypes.c_uint32()
+    in_args = ctypes.POINTER(ctypes.c_void_p)()
+    arg_grads = ctypes.POINTER(ctypes.c_void_p)()
+    auxs = ctypes.POINTER(ctypes.c_void_p)()
+    exe = ctypes.c_void_p()
+    _check(lib, lib.MXExecutorSimpleBindEx(
+        h, 1, 0,                      # dev
+        0, None, None, None,          # group2ctx
+        0, None, None,                # grad req -> default write
+        1, shape_names, shape_data, shape_idx,
+        0, None, None,                # dtypes
+        0, None, None,                # stypes
+        0, None,                      # shared arg names
+        None, None, None, None, None, # shared buffer
+        ctypes.byref(n_in), ctypes.byref(in_args), ctypes.byref(arg_grads),
+        ctypes.byref(n_aux), ctypes.byref(auxs),
+        None, ctypes.byref(exe)))
+    assert n_in.value == 3
+    # fill data/w/b
+    xs = [np.random.RandomState(i).rand(*shp).astype(np.float32)
+          for i, shp in enumerate([(2, 5), (3, 5), (3,)])]
+    for hdl, arr in zip([in_args[i] for i in range(3)], xs):
+        _check(lib, lib.MXNDArraySyncCopyFromCPU(
+            ctypes.c_void_p(hdl), arr.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_size_t(arr.size)))
+
+    seen = []
+    CB = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p,
+                          ctypes.c_void_p)
+    cb = CB(lambda name, arr, param: seen.append(name.decode()))
+    _check(lib, lib.MXExecutorSetMonitorCallback(exe, cb, None))
+
+    _check(lib, lib.MXExecutorForward(exe, 1))
+    n_out = ctypes.c_uint32()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    _check(lib, lib.MXExecutorOutputs(exe, ctypes.byref(n_out),
+                                      ctypes.byref(outs)))
+    got = _to_np(lib, ctypes.c_void_p(outs[0]), (2, 3))
+    np.testing.assert_allclose(got, xs[0] @ xs[1].T + xs[2], rtol=1e-5)
+    assert seen, "monitor callback never fired"
+
+    og = _make_nd(lib, np.ones((2, 3), np.float32))
+    _check(lib, lib.MXExecutorBackwardEx(exe, 1,
+                                         (ctypes.c_void_p * 1)(og), 1))
+    gw = _to_np(lib, ctypes.c_void_p(arg_grads[1]), (3, 5))
+    np.testing.assert_allclose(gw, np.ones((2, 3)).T @ xs[0], rtol=1e-5)
+
+    txt = ctypes.c_char_p()
+    _check(lib, lib.MXExecutorPrint(exe, ctypes.byref(txt)))
+    assert b"arg" in txt.value
+    opt = ctypes.c_void_p()
+    _check(lib, lib.MXExecutorGetOptimizedSymbol(exe, ctypes.byref(opt)))
+    n = ctypes.c_uint32()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib, lib.MXSymbolListArguments(opt, ctypes.byref(n),
+                                          ctypes.byref(arr)))
+    assert n.value == 3
+
+
+def test_misc_runtime_tail_abi(lib):
+    """Numpy-shape mode, bulk size, features, GPU info, creator-handle
+    invoke, process-profiler aliases, optimize-for/AMP symbol passes."""
+    prev = ctypes.c_int()
+    _check(lib, lib.MXSetIsNumpyShape(1, ctypes.byref(prev)))
+    cur = ctypes.c_int()
+    _check(lib, lib.MXIsNumpyShape(ctypes.byref(cur)))
+    assert cur.value == 1
+    _check(lib, lib.MXSetIsNumpyShape(prev.value, ctypes.byref(cur)))
+
+    _check(lib, lib.MXRandomSeedContext(7, 1, 0))
+    pb = ctypes.c_int()
+    _check(lib, lib.MXEngineSetBulkSize(16, ctypes.byref(pb)))
+
+    class Feat(ctypes.Structure):
+        _fields_ = [("name", ctypes.c_char_p), ("enabled", ctypes.c_bool)]
+    feats = ctypes.POINTER(Feat)()
+    n = ctypes.c_size_t()
+    _check(lib, lib.MXLibInfoFeatures(ctypes.byref(feats), ctypes.byref(n)))
+    names = {feats[i].name.decode() for i in range(n.value)}
+    assert n.value > 0 and any("TPU" in x or "XLA" in x for x in names), names
+
+    free_mb = ctypes.c_int(); total_mb = ctypes.c_int()
+    _check(lib, lib.MXGetGPUMemoryInformation(0, ctypes.byref(free_mb),
+                                              ctypes.byref(total_mb)))
+
+    # creator-handle invoke: list creators, find relu, invoke through it
+    nc = ctypes.c_uint32()
+    creators = ctypes.POINTER(ctypes.c_void_p)()
+    _check(lib, lib.MXSymbolListAtomicSymbolCreators(ctypes.byref(nc),
+                                                     ctypes.byref(creators)))
+    relu = None
+    for i in range(nc.value):
+        if ctypes.cast(creators[i], ctypes.c_char_p).value == b"relu":
+            relu = creators[i]
+            break
+    assert relu is not None
+    x = _make_nd(lib, np.array([-1., 2., -3.], np.float32))
+    n_out = ctypes.c_int(0)
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    stypes = ctypes.POINTER(ctypes.c_int)()
+    _check(lib, lib.MXImperativeInvokeEx(
+        ctypes.c_void_p(relu), 1, (ctypes.c_void_p * 1)(x),
+        ctypes.byref(n_out), ctypes.byref(outs), 0, None, None,
+        ctypes.byref(stypes)))
+    got = _to_np(lib, ctypes.c_void_p(outs[0]), (3,))
+    np.testing.assert_array_equal(got, [0., 2., 0.])
+    assert stypes[0] == 0
+
+    # process-profiler aliases ride the per-worker profiler
+    keys = (ctypes.c_char_p * 1)(b"profile_all")
+    vals = (ctypes.c_char_p * 1)(b"1")
+    _check(lib, lib.MXSetProcessProfilerConfig(1, keys, vals, None))
+    _check(lib, lib.MXSetProcessProfilerState(1, 0, None))
+    _check(lib, lib.MXProcessProfilePause(1, 0, None))
+    _check(lib, lib.MXProcessProfilePause(0, 0, None))
+    _check(lib, lib.MXSetProcessProfilerState(0, 0, None))
+
+    # AMP + backend passes return usable symbols
+    import incubator_mxnet_tpu.symbol as sym
+    s = sym.FullyConnected(sym.var("data"), sym.var("w"), sym.var("b"),
+                           num_hidden=4)
+    h = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateFromJSON(s.tojson().encode(),
+                                           ctypes.byref(h)))
+    amp = ctypes.c_void_p()
+    tgt = (ctypes.c_int * 1)(1)
+    _check(lib, lib.MXReducePrecisionSymbol(
+        h, ctypes.byref(amp), 0, None, 0, None, tgt, 0,
+        0, 0, 0, 0, 0, 0,
+        None, None, None, None, None, None, None, None, None))
+    opt = ctypes.c_void_p()
+    _check(lib, lib.MXOptimizeForBackend(
+        h, b"xla", 1, ctypes.byref(opt), 0, None, 0, None, 0, None, None,
+        None, None, None, None, None, None))
+    na = ctypes.c_uint32()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib, lib.MXSymbolListArguments(opt, ctypes.byref(na),
+                                          ctypes.byref(arr)))
+    assert na.value == 3
+
+    # data-iter reflection
+    nm = ctypes.c_char_p(); desc = ctypes.c_char_p()
+    nargs = ctypes.c_uint32()
+    an = ctypes.POINTER(ctypes.c_char_p)()
+    at = ctypes.POINTER(ctypes.c_char_p)()
+    ad = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib, lib.MXDataIterGetIterInfo(
+        ctypes.c_char_p(b"MNISTIter"), ctypes.byref(nm), ctypes.byref(desc),
+        ctypes.byref(nargs), ctypes.byref(an), ctypes.byref(at),
+        ctypes.byref(ad)))
+    assert nm.value == b"MNISTIter"
+
+    # ps-env + dead-node + exit-barrier surface
+    _check(lib, lib.MXInitPSEnv(1, (ctypes.c_char_p * 1)(b"DMLC_ROLE"),
+                                (ctypes.c_char_p * 1)(b"worker")))
+    kv = ctypes.c_void_p()
+    _check(lib, lib.MXKVStoreCreate(b"local", ctypes.byref(kv)))
+    dead = ctypes.c_int(-1)
+    _check(lib, lib.MXKVStoreGetNumDeadNode(kv, 0, ctypes.byref(dead), 1))
+    assert dead.value == 0
+    _check(lib, lib.MXKVStoreSetBarrierBeforeExit(kv, 1))
+    _check(lib, lib.MXKVStoreFree(kv))
+
+
+def test_abi_tail_batch(lib):
+    """Bind/SimpleBind legacy+64 aliases, InferShapeEx/64 family,
+    MXGetFunction, PullWithSparse, SetUpdaterEx str keys, cached-op hook,
+    dlpack round trip, rtc/tvm build-parity errors."""
+    import incubator_mxnet_tpu.symbol as sym
+    s = sym.FullyConnected(sym.var("data"), sym.var("w"), sym.var("b"),
+                           num_hidden=3)
+    h = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateFromJSON(s.tojson().encode(),
+                                           ctypes.byref(h)))
+
+    # InferShapeEx (int data)
+    keys = (ctypes.c_char_p * 1)(b"data")
+    ind = (ctypes.c_uint32 * 2)(0, 2)
+    data = (ctypes.c_int * 2)(2, 5)
+    isz = ctypes.c_uint32(); osz = ctypes.c_uint32(); asz = ctypes.c_uint32()
+    indim = ctypes.POINTER(ctypes.c_int)()
+    ondim = ctypes.POINTER(ctypes.c_int)()
+    andim = ctypes.POINTER(ctypes.c_int)()
+    idata = ctypes.POINTER(ctypes.POINTER(ctypes.c_int))()
+    odata = ctypes.POINTER(ctypes.POINTER(ctypes.c_int))()
+    adata = ctypes.POINTER(ctypes.POINTER(ctypes.c_int))()
+    comp = ctypes.c_int()
+    _check(lib, lib.MXSymbolInferShapeEx(
+        h, 1, keys, ind, data, ctypes.byref(isz), ctypes.byref(indim),
+        ctypes.byref(idata), ctypes.byref(osz), ctypes.byref(ondim),
+        ctypes.byref(odata), ctypes.byref(asz), ctypes.byref(andim),
+        ctypes.byref(adata), ctypes.byref(comp)))
+    assert comp.value == 1 and osz.value == 1
+    assert [odata[0][d] for d in range(ondim[0])] == [2, 3]
+
+    # InferShape64 (int64 everywhere)
+    ind64 = (ctypes.c_int64 * 2)(0, 2)
+    data64 = (ctypes.c_int64 * 2)(2, 5)
+    isz64 = ctypes.c_size_t(); osz64 = ctypes.c_size_t()
+    asz64 = ctypes.c_size_t()
+    i64 = ctypes.POINTER(ctypes.POINTER(ctypes.c_int64))()
+    o64 = ctypes.POINTER(ctypes.POINTER(ctypes.c_int64))()
+    a64 = ctypes.POINTER(ctypes.POINTER(ctypes.c_int64))()
+    _check(lib, lib.MXSymbolInferShape64(
+        h, 1, keys, ind64, data64, ctypes.byref(isz64), ctypes.byref(indim),
+        ctypes.byref(i64), ctypes.byref(osz64), ctypes.byref(ondim),
+        ctypes.byref(o64), ctypes.byref(asz64), ctypes.byref(andim),
+        ctypes.byref(a64), ctypes.byref(comp)))
+    assert osz64.value == 1
+    assert [o64[0][d] for d in range(ondim[0])] == [2, 3]
+
+    # legacy SimpleBind (uint32 shapes) through the Ex path
+    shape_names = (ctypes.c_char_p * 1)(b"data")
+    shape_data = (ctypes.c_uint32 * 2)(2, 5)
+    shape_idx = (ctypes.c_uint32 * 2)(0, 2)
+    n_in = ctypes.c_uint32(); n_aux = ctypes.c_uint32()
+    in_args = ctypes.POINTER(ctypes.c_void_p)()
+    arg_grads = ctypes.POINTER(ctypes.c_void_p)()
+    auxs = ctypes.POINTER(ctypes.c_void_p)()
+    exe = ctypes.c_void_p()
+    _check(lib, lib.MXExecutorSimpleBind(
+        h, 1, 0, 0, None, None, None, 0, None, None,
+        1, shape_names, shape_data, shape_idx,
+        0, None, None, 0, None, None, 0, None,
+        None, None, None, None, None,
+        ctypes.byref(n_in), ctypes.byref(in_args), ctypes.byref(arg_grads),
+        ctypes.byref(n_aux), ctypes.byref(auxs), None, ctypes.byref(exe)))
+    assert n_in.value == 3
+
+    # MXGetFunction: valid + invalid names
+    fh = ctypes.c_void_p()
+    _check(lib, lib.MXGetFunction(b"relu", ctypes.byref(fh)))
+    assert ctypes.cast(fh, ctypes.c_char_p).value == b"relu"
+    assert lib.MXGetFunction(b"not_a_real_op_name", ctypes.byref(fh)) != 0
+
+    # PullWithSparse over a local store
+    kv = ctypes.c_void_p()
+    _check(lib, lib.MXKVStoreCreate(b"local", ctypes.byref(kv)))
+    ikeys = (ctypes.c_int * 1)(3)
+    _check(lib, lib.MXKVStoreInit(
+        kv, 1, ikeys,
+        (ctypes.c_void_p * 1)(_make_nd(lib, np.full(4, 2.0, np.float32)))))
+    out = _make_nd(lib, np.zeros(4, np.float32))
+    _check(lib, lib.MXKVStorePullWithSparse(
+        kv, 1, ikeys, (ctypes.c_void_p * 1)(out), 0, True))
+    np.testing.assert_array_equal(_to_np(lib, out, (4,)),
+                                  np.full(4, 2.0, np.float32))
+
+    # SetUpdaterEx: int keys hit the int updater
+    hits = []
+    UPD = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_void_p,
+                           ctypes.c_void_p, ctypes.c_void_p)
+    SUPD = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p,
+                            ctypes.c_void_p, ctypes.c_void_p)
+    upd = UPD(lambda k, r, l, p: hits.append(("int", k)))
+    supd = SUPD(lambda k, r, l, p: hits.append(("str", k)))
+    _check(lib, lib.MXKVStoreSetUpdaterEx(kv, upd, supd, None))
+    g = _make_nd(lib, np.ones(4, np.float32))
+    _check(lib, lib.MXKVStorePush(kv, 1, ikeys,
+                                  (ctypes.c_void_p * 1)(g), 0))
+    assert ("int", 3) in hits
+    _check(lib, lib.MXKVStoreFree(kv))
+
+    # cached-op monitor hook fires on invoke
+    co = ctypes.c_void_p()
+    _check(lib, lib.MXCreateCachedOp(h, ctypes.byref(co)))
+    seen = []
+    HOOK = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_char_p,
+                            ctypes.c_void_p)
+    hook = HOOK(lambda name, opr, arr: seen.append(name.decode()))
+    _check(lib, lib.MXCachedOpRegisterOpHook(co, hook, False))
+    xs = [np.random.RandomState(i).rand(*shp).astype(np.float32)
+          for i, shp in enumerate([(2, 5), (3, 5), (3,)])]
+    handles = (ctypes.c_void_p * 3)(*[_make_nd(lib, a) for a in xs])
+    n_out = ctypes.c_int(0)
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    _check(lib, lib.MXInvokeCachedOp(co, 3, handles, ctypes.byref(n_out),
+                                     ctypes.byref(outs)))
+    assert seen == ["output0"]
+
+    # dlpack round trip
+    src = _make_nd(lib, np.arange(6, dtype=np.float32).reshape(2, 3))
+    dlp = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayToDLPack(src, ctypes.byref(dlp)))
+    back = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayFromDLPack(dlp, ctypes.byref(back)))
+    np.testing.assert_array_equal(
+        _to_np(lib, back, (2, 3)),
+        np.arange(6, dtype=np.float32).reshape(2, 3))
+    _check(lib, lib.MXNDArrayCallDLPackDeleter(dlp))
+
+    # rtc / tvm: faithful built-without-support errors
+    assert lib.MXRtcFree(None) != 0
+    assert lib.MXLoadTVMOp(b"/nonexistent.so") != 0
+
+
+def test_set_calib_table_abi(lib):
+    """MXQuantizeSymbol -> MXSetCalibTableToQuantizedSymbol re-runs the
+    quantization pass with ranges attached to requantize nodes."""
+    import incubator_mxnet_tpu.symbol as sym
+    s = sym.Convolution(sym.var("data"), sym.var("w"), None, kernel=(1, 1),
+                        num_filter=4, no_bias=True)
+    s = sym.Activation(s, act_type="relu")
+    h = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateFromJSON(s.tojson().encode(),
+                                           ctypes.byref(h)))
+    q = ctypes.c_void_p()
+    _check(lib, lib.MXQuantizeSymbol(h, ctypes.byref(q), 0, None, 0, None,
+                                     b"int8"))
+    names = (ctypes.c_char_p * 2)(b"data", b"convolution0_output")
+    lows = (ctypes.c_float * 2)(-3.0, -6.0)
+    highs = (ctypes.c_float * 2)(3.0, 6.0)
+    out = ctypes.c_void_p()
+    _check(lib, lib.MXSetCalibTableToQuantizedSymbol(
+        q, 2, names, lows, highs, ctypes.byref(out)))
+    js = ctypes.c_char_p()
+    _check(lib, lib.MXSymbolSaveToJSON(out, ctypes.byref(js)))
+    # calibrated ranges pin the quantize nodes (no data-dependent rescan)
+    assert b"min_calib_range" in js.value
+
+
+def test_kvstore_server_surface_abi(lib):
+    """MXKVStoreRunServer installs the command controller (no separate
+    server process: the store itself is the server role) and
+    MXKVStoreSendCommmandToServers dispatches to it."""
+    kv = ctypes.c_void_p()
+    _check(lib, lib.MXKVStoreCreate(b"local", ctypes.byref(kv)))
+    got = []
+    CTRL = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_char_p,
+                            ctypes.c_void_p)
+    ctrl = CTRL(lambda head, body, p: got.append((head, body.decode())))
+    _check(lib, lib.MXKVStoreRunServer(kv, ctrl, None))
+    _check(lib, lib.MXKVStoreSendCommmandToServers(kv, 7, b"set_lr:0.01"))
+    assert got == [(7, "set_lr:0.01")]
+    _check(lib, lib.MXKVStoreFree(kv))

@@ -1,0 +1,38 @@
+#!/bin/bash
+# Round-4 perf-variant backlog: the roofline argument (docs/PERF.md) says
+# the stock-BN byte count caps the chip at ~2.2k img/s; these runs measure
+# the levers (fused ghost-BN Pallas kernels, space-to-depth stem, the new
+# shifted-window max-pool backward) and re-warm the default cache.
+# Probe first:  curl -m5 127.0.0.1:8083 >/dev/null && bash tools/chip_queue2.sh
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-chip_queue2_results.txt}
+{
+echo "== chip queue2 $(date -u +%FT%TZ) =="
+
+echo "-- 1. default config (re-warm cache after maxpool-bwd change)"
+timeout 580 python bench.py --chunks 3
+
+echo "-- 2. ghost-bn 64"
+timeout 580 python bench.py --chunks 3 --ghost-bn 64
+
+echo "-- 3. ghost-bn 64 + s2d stem"
+timeout 580 python bench.py --chunks 3 --ghost-bn 64 --s2d-stem
+
+echo "-- 4. ghost-bn 32 + s2d stem"
+timeout 580 python bench.py --chunks 3 --ghost-bn 32 --s2d-stem
+
+echo "-- 5. batch 512 ghost-bn 64 + s2d"
+timeout 580 python bench.py --chunks 3 --batch 512 --ghost-bn 64 --s2d-stem
+
+echo "-- 6. int8 inference (carried over from queue1 outage)"
+timeout 580 python bench.py --mode infer-int8
+
+echo "-- 7. attention (carried over)"
+timeout 580 python bench.py --mode attention
+
+echo "-- 8. recordio-fed training (carried over)"
+timeout 580 python bench.py --data recordio --record-format .npy --chunks 3
+
+echo "== done $(date -u +%FT%TZ) =="
+} 2>&1 | tee "$LOG"
