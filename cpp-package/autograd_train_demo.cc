@@ -72,6 +72,12 @@ void SgdUpdater(int key, NDArrayHandle recv, NDArrayHandle local,
   std::vector<float> v(n);
   Check(MXNDArraySyncCopyToCPU(updated, v.data(), n), "CopyToCPU");
   Check(MXNDArraySyncCopyFromCPU(local, v.data(), n), "CopyFromCPU");
+  // recv/local arrive owned (reference set_updater contract); the
+  // kvstore keeps its own reference to local alive
+  MXNDArrayFree(scaled);
+  MXNDArrayFree(updated);
+  MXNDArrayFree(recv);
+  MXNDArrayFree(local);
 }
 
 }  // namespace

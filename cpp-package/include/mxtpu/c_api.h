@@ -229,6 +229,69 @@ int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
                        NDArrayHandle* inputs, int* num_outputs,
                        NDArrayHandle** outputs, const int** out_stypes);
 
+/* Custom operators (C registration protocol) ---------------------------- */
+/* Reference: include/mxnet/c_api.h:153-217 — struct-of-callbacks
+   registration; the callee-owned MXCallbackList carries the prop/op/
+   function callbacks plus their contexts. */
+struct MXCallbackList {
+  int num_callbacks;
+  int (**callbacks)(void);
+  void** contexts;
+};
+
+enum CustomOpCallbacks {
+  kCustomOpDelete,
+  kCustomOpForward,
+  kCustomOpBackward
+};
+
+enum CustomOpPropCallbacks {
+  kCustomOpPropDelete,
+  kCustomOpPropListArguments,
+  kCustomOpPropListOutputs,
+  kCustomOpPropListAuxiliaryStates,
+  kCustomOpPropInferShape,
+  kCustomOpPropDeclareBackwardDependency,
+  kCustomOpPropCreateOperator,
+  kCustomOpPropInferType,
+  kCustomOpPropInferStorageType,
+  kCustomOpPropBackwardInferStorageType
+};
+
+typedef int (*CustomOpFBFunc)(int size, void** ptrs, int* tags,
+                              const int* reqs, const int is_train,
+                              void* state);
+typedef int (*CustomOpDelFunc)(void* state);
+typedef int (*CustomOpListFunc)(char*** args, void* state);
+typedef int (*CustomOpInferShapeFunc)(int num_input, int* ndims,
+                                      int** shapes, void* state);
+typedef int (*CustomOpInferTypeFunc)(int num_input, int* types, void* state);
+typedef int (*CustomOpBwdDepFunc)(const int* out_grad, const int* in_data,
+                                  const int* out_data, int* num_deps,
+                                  int** rdeps, void* state);
+typedef int (*CustomOpCreateFunc)(const char* ctx, int num_inputs,
+                                  unsigned** shapes, const int* ndims,
+                                  const int* dtypes,
+                                  struct MXCallbackList* ret, void* state);
+typedef int (*CustomOpPropCreator)(const char* op_type, const int num_kwargs,
+                                   const char** keys, const char** values,
+                                   struct MXCallbackList* ret);
+
+enum CustomFunctionCallbacks {
+  kCustomFunctionBackward,
+  kCustomFunctionDelete
+};
+
+typedef int (*CustomFunctionBwdFunc)(int num_ograds, int num_igrads,
+                                     void** ptrs, const int* reqs,
+                                     const int is_train, void* state);
+typedef int (*CustomFunctionDelFunc)(void* state);
+
+int MXCustomOpRegister(const char* op_type, CustomOpPropCreator creator);
+int MXCustomFunctionRecord(int num_inputs, NDArrayHandle* inputs,
+                           int num_outputs, NDArrayHandle* outputs,
+                           struct MXCallbackList* callbacks);
+
 /* Misc runtime ---------------------------------------------------------- */
 int MXRandomSeed(int seed);
 int MXEngineWaitAll(void);

@@ -658,10 +658,39 @@ def ndarray_storage_type(handle) -> int:
 def ndarray_data_ptr(handle) -> int:
     """Host pointer to the array contents (MXNDArrayGetData).  The buffer is
     pinned on the handle so the pointer stays valid until the handle is
-    freed or the next GetData call on it."""
+    freed or the next GetData call on it.
+
+    The reference returns the live mutable chunk (c_api.cc GetData), so
+    frontends write through the pointer.  The device buffer here is not
+    host-addressable, so this is copy-on-read + write-back: mutations
+    through the pointer are synced into the array at the next
+    MXNDArrayWaitToRead / MXNDArrayWaitToWrite / MXNDArrayFree — or the
+    next GetData — on this handle (the reference's own engine sync
+    discipline for raw-pointer access)."""
+    ndarray_writeback_host_buf(handle)  # re-GetData is a sync boundary
     buf = np.ascontiguousarray(handle.asnumpy())
     handle._capi_host_buf = buf
+    handle._capi_host_snap = buf.copy()
     return int(buf.ctypes.data)
+
+
+def ndarray_writeback_host_buf(handle) -> None:
+    """Sync a mutated GetData buffer back into the array (no-op when no
+    GetData pointer is outstanding or the C side only read through it).
+    The pristine snapshot is an ndarray so the steady-state check is a
+    plain memcmp-style compare — no per-wait serialization."""
+    buf = getattr(handle, "_capi_host_buf", None)
+    if buf is None:
+        return
+    snap = handle._capi_host_snap
+    if not np.array_equal(buf.view(np.uint8), snap.view(np.uint8)):
+        ndarray_sync_copy_from(handle, buf.tobytes())
+        handle._capi_host_snap = buf.copy()
+
+
+def ndarray_wait_to_read(handle) -> None:
+    ndarray_writeback_host_buf(handle)
+    handle.wait_to_read()
 
 
 def ndarray_get_grad_state(handle) -> int:
@@ -787,8 +816,9 @@ def ndarray_to_shared_mem(handle):
     the two ints the reference ABI calls (shared_pid, shared_id)
     (ndarray.cc:1892 passes fd+pid over a socket; here the ints DERIVE the
     segment name, so any process can reattach with just the pair).  The
-    consumer unlinks after attaching (the usual POSIX one-shot transfer);
-    the producer's mapping stays valid until this handle is freed."""
+    PRODUCER owns the name: consumers may attach any number of times
+    (the reference allows repeated multi-consumer attach), and the
+    segment is unlinked when this handle is freed or re-shared."""
     import secrets
     from . import storage
     prev = getattr(handle, "_capi_shm", None)
@@ -796,14 +826,14 @@ def ndarray_to_shared_mem(handle):
         # re-sharing the same handle abandons the previous pair: detach
         # AND unlink so it can't leak (an already-attached consumer keeps
         # its mapping; POSIX unlink only removes the name)
-        prev._owner = True
         prev.close()
     buf = np.ascontiguousarray(handle.asnumpy())
     hi, lo = secrets.randbits(31), secrets.randbits(31)
     shm = storage.SharedMemory(_shm_name(hi, lo), buf.nbytes, create=True)
-    shm._owner = False  # consumer unlinks; see docstring
     shm.array[:buf.nbytes] = buf.reshape(-1).view(np.uint8)
-    handle._capi_shm = shm  # keep the segment mapped while the handle lives
+    # producer keeps _owner=True: the segment is mapped AND named until
+    # the source handle dies, so any number of consumers can attach
+    handle._capi_shm = shm
     return hi, lo
 
 
@@ -815,7 +845,7 @@ def ndarray_from_shared_mem(tag_hi: int, tag_lo: int, shape, dtype_code: int):
     shm = storage.SharedMemory(_shm_name(tag_hi, tag_lo), nbytes,
                                create=False)
     arr = np.frombuffer(shm.array[:nbytes].tobytes(), dtype).reshape(shape)
-    shm._owner = True  # one-shot transfer: detach AND unlink on close
+    shm._owner = False  # the producer unlinks; consumers only detach
     shm.close()
     return _nd.array(arr)
 
@@ -1326,6 +1356,7 @@ def engine_push(fn, const_nds, mutable_nds, wait: int):
 
 
 def engine_wait_for_nd(handle):
+    ndarray_writeback_host_buf(handle)
     _engine().wait_for_var(_nd_var(handle))
 
 
@@ -1513,3 +1544,164 @@ def ndarray_load_from_raw_bytes(data: bytes):
     if isinstance(arrays, dict):
         return next(iter(arrays.values()))
     return arrays[0]
+
+
+# ---------------------------------------------------------------------------
+# Custom-op C registration protocol (MXCustomOpRegister /
+# MXCustomFunctionRecord — reference src/operator/custom/custom.cc:70-119,
+# src/c_api/c_api_function.cc:186).  The C side passes PyCFunction
+# trampolines that call the user's function pointers; this module builds a
+# CustomOpProp subclass around them and registers it in the same registry
+# the Python `mx.operator.register` path uses, so `nd.Custom(...,
+# op_type=...)` and symbolic Custom nodes work identically for C-defined
+# ops.
+# ---------------------------------------------------------------------------
+
+# CustomOpPropCallbacks / CustomOpCallbacks indices (c_api.h:164-181)
+_K_PROP_LIST_ARGS = 1
+_K_PROP_LIST_OUTS = 2
+_K_PROP_LIST_AUX = 3
+_K_PROP_INFER_SHAPE = 4
+_K_PROP_BWD_DEP = 5
+_K_PROP_CREATE_OP = 6
+_K_PROP_INFER_TYPE = 7
+_K_OP_FORWARD = 1
+_K_OP_BACKWARD = 2
+
+_REQ_CODE = {"null": 0, "write": 1, "inplace": 2, "add": 3}
+
+
+def custom_op_register_c(op_type: str, creator_capsule, tr: dict) -> None:
+    from . import operator as _op
+
+    class _CCustomOp(_op.CustomOp):
+        """Stateful kernel driving the C forward/backward callbacks.
+
+        Handles passed to the callbacks are live NDArrays, borrowed for
+        the duration of the call; the callee mutates outputs through the
+        MXNDArray* C surface (fwd tags 0=in/1=out/4=aux, bwd tags
+        3=ograd/0=in/1=out/2=igrad/4=aux — custom.cc:308,373)."""
+
+        def __init__(self, oph):
+            self._oph = oph
+
+        def _run(self, which, groups, reqs, is_train):
+            handles, tags, host_views = [], [], []
+            for tag, arrs in groups:
+                for a in arrs:
+                    nd_a = _nd.array(np.asarray(a))
+                    handles.append(nd_a)
+                    tags.append(tag)
+                    host_views.append((a, nd_a))
+            tr["c_custom_op_call"](self._oph, which, handles, tags,
+                                   [_REQ_CODE.get(r, 1) for r in reqs],
+                                   int(is_train))
+            return host_views
+
+        @staticmethod
+        def _copy_back(views):
+            for host, nd_a in views:
+                # a callee writing through an MXNDArrayGetData pointer
+                # may return without an explicit WaitToRead; flush any
+                # outstanding host buffer before reading the array
+                ndarray_writeback_host_buf(nd_a)
+                host[:] = nd_a.asnumpy()
+
+        def forward(self, is_train, req, in_data, out_data, aux):
+            views = self._run(_K_OP_FORWARD,
+                              [(0, in_data), (1, out_data), (4, aux)],
+                              req, is_train)
+            self._copy_back(views[len(in_data):])  # outputs + aux
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            views = self._run(
+                _K_OP_BACKWARD,
+                [(3, out_grad), (0, in_data), (1, out_data), (2, in_grad),
+                 (4, aux)], req, 1)
+            base = len(out_grad) + len(in_data) + len(out_data)
+            # igrads AND aux: a BN-like C op updates running statistics
+            # (tag-4 handles) during backward too (custom.cc:373)
+            self._copy_back(views[base:])
+
+    class _CCustomOpProp(_op.CustomOpProp):
+        """CustomOpProp over a C MXCallbackList (custom.cc AttrParser)."""
+
+        def __init__(self, **kwargs):
+            super().__init__(need_top_grad=True)
+            keys = tuple(kwargs.keys())
+            vals = tuple(str(v) for v in kwargs.values())
+            self._h = tr["c_custom_prop_create"](creator_capsule, op_type,
+                                                 keys, vals)
+
+        def list_arguments(self):
+            return tr["c_custom_prop_list"](self._h, _K_PROP_LIST_ARGS)
+
+        def list_outputs(self):
+            return tr["c_custom_prop_list"](self._h, _K_PROP_LIST_OUTS)
+
+        def list_auxiliary_states(self):
+            return tr["c_custom_prop_list"](self._h, _K_PROP_LIST_AUX)
+
+        def infer_shape(self, in_shape):
+            n_args = len(self.list_arguments())
+            n_outs = len(self.list_outputs())
+            n_aux = len(self.list_auxiliary_states())
+            total = n_args + n_outs + n_aux
+            full = tr["c_custom_prop_infer_shape"](
+                self._h, [list(map(int, s)) for s in in_shape], total)
+            return (full[:n_args], full[n_args:n_args + n_outs],
+                    full[n_args + n_outs:])
+
+        def infer_type(self, in_type):
+            if not tr["c_custom_prop_has"](self._h, _K_PROP_INFER_TYPE):
+                return super().infer_type(in_type)
+            n_args = len(self.list_arguments())
+            n_outs = len(self.list_outputs())
+            n_aux = len(self.list_auxiliary_states())
+            codes = [_CODE_OF[np.dtype(t)] for t in in_type]
+            full = tr["c_custom_prop_infer_type"](
+                self._h, codes, n_args + n_outs + n_aux)
+            types = [_DTYPE_OF[c] for c in full]
+            return (types[:n_args], types[n_args:n_args + n_outs],
+                    types[n_args + n_outs:])
+
+        def declare_backward_dependency(self, out_grad, in_data, out_data):
+            return tr["c_custom_prop_bwd_dep"](
+                self._h, list(map(int, out_grad)), list(map(int, in_data)),
+                list(map(int, out_data)))
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            oph = tr["c_custom_prop_create_operator"](
+                self._h, str(ctx if ctx is not None else "cpu(0)"),
+                [list(map(int, s)) for s in in_shapes],
+                [_CODE_OF[np.dtype(t)] for t in in_dtypes])
+            return _CCustomOp(oph)
+
+    _op.register(op_type)(_CCustomOpProp)
+
+
+def custom_function_record(inputs, outputs, fn_capsule, trampoline) -> None:
+    """Record a C custom autograd function on the tape: the node's
+    pullback calls CustomFunctionBackward with ptrs = [ograds..,
+    igrads..] and per-igrad write reqs (c_api_function.cc Backward)."""
+    from . import autograd as ag
+
+    if not ag.is_recording():
+        raise ValueError(
+            "MXCustomFunctionRecord requires autograd to be recording "
+            "(reference CHECK(Imperative::Get()->is_recording()))")
+    ins = list(inputs)
+    outs = list(outputs)
+
+    def vjp_fn(cotangents):
+        cots = (cotangents,) if len(outs) == 1 else cotangents
+        ograds = [_nd.array(np.asarray(c)) for c in cots]
+        igrads = [_nd.zeros(tuple(a.shape), dtype=a.dtype) for a in ins]
+        trampoline(fn_capsule, len(ograds), len(igrads),
+                   ograds + igrads, [1] * len(igrads), 1)
+        for g in igrads:  # flush GetData-pointer writes (see _copy_back)
+            ndarray_writeback_host_buf(g)
+        return [g._data for g in igrads]
+
+    node = ag.TapeNode(vjp_fn, ins, outs, name="_CustomFunction")
+    ag.attach_node(outs, node)

@@ -17,6 +17,18 @@ a per-key lock and an optional server-side optimizer
 
 Wire ops: INIT (first-writer-wins), PUSH (apply update now), PULL,
 SET_OPT, STOP.
+
+Trust model: the wire is length-prefixed PICKLE and is therefore only
+safe among mutually-trusting processes — exactly the reference
+ps-lite deployment assumption (workers/servers inside one training
+cluster; ``van.cc`` likewise runs unauthenticated).  The host binds
+loopback by default; a multi-host deployment must keep the
+DMLC_PS_ROOT_URI interface inside the cluster's network boundary.
+Messages are bounded (``_MAX_MSG``) and parameter state is strictly
+float32: a push/init of any other dtype is REJECTED loudly rather than
+silently cast, so mixed-precision trainers must keep their f32 master
+weights on the worker side (the reference server also stores a single
+real_t copy, kvstore_dist_server.h:155).
 """
 from __future__ import annotations
 
@@ -39,10 +51,16 @@ def _int_key(key) -> int:
         return abs(hash(str(key))) % (1 << 31)
 
 _HDR = struct.Struct("<I")
+# one message holds one tensor (+small framing); 1 GiB bounds memory per
+# connection and rejects corrupted/hostile length prefixes
+_MAX_MSG = 1 << 30
 
 
 def _send(sock: socket.socket, obj: Any) -> None:
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    if len(payload) > _MAX_MSG:
+        raise ValueError("async-host message of %d bytes exceeds the %d "
+                         "byte bound" % (len(payload), _MAX_MSG))
     sock.sendall(_HDR.pack(len(payload)) + payload)
 
 
@@ -54,6 +72,10 @@ def _recv(sock: socket.socket) -> Any:
             raise ConnectionError("peer closed")
         hdr += chunk
     (n,) = _HDR.unpack(hdr)
+    if n > _MAX_MSG:
+        raise ConnectionError(
+            "async-host frame of %d bytes exceeds the %d byte bound "
+            "(corrupted stream?)" % (n, _MAX_MSG))
     buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(min(1 << 20, n - len(buf)))
@@ -112,16 +134,29 @@ class AsyncParamHost:
         with self._global_lock:
             return self._locks.setdefault(key, threading.Lock())
 
+    @staticmethod
+    def _check_f32(tag, key, arr):
+        arr = np.asarray(arr)
+        if arr.dtype != np.float32:
+            raise TypeError(
+                "%s for key %r carries dtype %s; the async parameter host "
+                "stores float32 only (kvstore_dist_server.h real_t) — cast "
+                "on the worker (mixed-precision trainers keep their f32 "
+                "master copy there)" % (tag, key, arr.dtype))
+        return arr
+
     def _handle(self, msg):
         op = msg[0]
         if op == "INIT":
             _, key, val = msg
+            val = self._check_f32("INIT", key, val)
             with self._lock(key):
                 if key not in self._values:  # first writer wins (rank 0)
-                    self._values[key] = np.asarray(val, np.float32).copy()
+                    self._values[key] = val.copy()
             return ("OK",)
         if op == "PUSH":
             _, key, grad = msg
+            grad = self._check_f32("PUSH", key, grad)
             with self._lock(key):
                 if key not in self._values:
                     return ("ERR", "key %r has not been initialized" % key)
@@ -156,11 +191,38 @@ class AsyncParamHost:
             # MXKVStoreSendCommmandToServers: deliver (head, body) to the
             # server-side controller (kvstore_dist_server.h CommandHandle)
             _, head, body = msg
+            if int(head) == 5:  # CommandType::kSetProfilerParams
+                self._profiler_command(str(body))
+                return ("OK",)
             ctrl = getattr(self, "_controller", None)
             if ctrl is not None:
                 ctrl(int(head), body)
             return ("OK",)
         return ("ERR", "unknown op %r" % (op,))
+
+    @staticmethod
+    def _profiler_command(body: str) -> None:
+        """Server-side profiling of the parameter host process — the
+        KVStoreServerProfilerCommand wire (kvstore.h:49,
+        kvstore_dist_server.h:276): the body's LAST char selects
+        {0: set_config 'k:v,k:v', 1: set_state, 2: pause/resume,
+        3: dump}, the rest is the payload."""
+        from .. import profiler
+
+        sub, payload = int(body[-1]), body[:-1]
+        if sub == 0:
+            kwargs = {}
+            for kv in filter(None, payload.split(",")):
+                k, v = kv.split(":", 1)
+                kwargs[k] = (v if not v.isdigit() else int(v)) if v not in (
+                    "True", "False") else v == "True"
+            profiler.set_config(**kwargs)
+        elif sub == 1:
+            profiler.set_state("run" if payload[:1] == "1" else "stop")
+        elif sub == 2:
+            (profiler.pause if payload[:1] == "1" else profiler.resume)()
+        elif sub == 3:
+            profiler.dump(finished=False)
 
     def set_controller(self, controller):
         self._controller = controller
@@ -210,10 +272,14 @@ class AsyncParamClient:
         return res
 
     def init(self, key: str, value) -> None:
-        self._call("INIT", key, np.asarray(value, np.float32))
+        self._call("INIT", key, AsyncParamHost._check_f32("INIT", key,
+                                                          value))
 
     def push(self, key: str, grad) -> None:
-        self._call("PUSH", key, np.asarray(grad, np.float32))
+        # no silent up-cast: a bf16/f16 push is a caller bug (the f32
+        # master copy lives on the worker) and fails loudly here
+        self._call("PUSH", key, AsyncParamHost._check_f32("PUSH", key,
+                                                          grad))
 
     def pull(self, key: str) -> np.ndarray:
         return self._call("PULL", key)[1]

@@ -28,6 +28,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..base import MXNetError
 from .kvstore import KVStore
@@ -217,10 +218,15 @@ class DistKVStore(KVStore):
             keys, _ = self._norm_keys_vals(key, value)
             if self.rank == 0:
                 for k in keys:
-                    self._client.init(k, self._store[k].asnumpy())
+                    # the host stores f32 only (and rejects anything else
+                    # loudly); this layer owns the mixed-precision cast —
+                    # pull() casts back to each replica's dtype
+                    self._client.init(
+                        k, self._store[k].asnumpy().astype(np.float32))
             self.barrier()
             for k in keys:
-                self._store[k]._data = jnp.asarray(self._client.pull(k))
+                self._store[k]._data = jnp.asarray(
+                    self._client.pull(k)).astype(self._store[k]._data.dtype)
             return
         from jax.experimental import multihost_utils
 
@@ -245,7 +251,9 @@ class DistKVStore(KVStore):
                 merged = merged.todense()._data
             elif getattr(self, "_compressor", None) is not None:
                 merged = self._compressor.compress(k, merged)
-            self._client.push(k, jnp.asarray(merged))
+            # explicit f32 cast: the wire rejects non-f32 (async_host
+            # trust/dtype contract); bf16 grads up-cast losslessly
+            self._client.push(k, np.asarray(merged, np.float32))
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if not self._async:

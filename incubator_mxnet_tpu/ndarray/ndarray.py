@@ -31,10 +31,12 @@ __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
 class NDArray:
     __slots__ = ("_data", "_ctx", "_ag_node", "_ag_out_idx", "_ag_grad",
                  "_ag_grad_req", "__weakref__",
-                 # C-ABI pins (capi_impl.py): host buffer for
-                 # MXNDArrayGetData, shm segment for GetSharedMemHandle,
-                 # fresh-grad flag for Get/SetGradState
-                 "_capi_host_buf", "_capi_shm", "_fresh_grad")
+                 # C-ABI pins (capi_impl.py): host buffer + pristine
+                 # snapshot for MXNDArrayGetData write-back, shm segment
+                 # for GetSharedMemHandle, fresh-grad flag for
+                 # Get/SetGradState
+                 "_capi_host_buf", "_capi_host_snap", "_capi_shm",
+                 "_fresh_grad")
 
     def __init__(self, data, ctx: Optional[Context] = None):
         if isinstance(data, NDArray):

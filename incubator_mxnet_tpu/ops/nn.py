@@ -144,15 +144,28 @@ def _maxpool_sws_bwd(window, strides, padding, res, g):
     xp = lax.pad(data, neg, [(lo, hi, 0) for lo, hi in padding])
     # one shifted strided view of the padded input per in-window offset:
     # position p of the padded input contributes to window w iff
-    # p = w*stride + offset, so dX[p] = sum_offsets (xp[p] == y[w]) * g[w]
-    dxp = jnp.zeros(xp.shape, g.dtype)
-    for offset in itertools.product(*[range(k) for k in window]):
+    # p = w*stride + offset.  The reference's active Pooling backward
+    # (pool.h unpool_max_*_cpu) routes the WHOLE gradient to a single
+    # argmax — the first match in row-major window scan order — so pass
+    # 1 computes that winner's linear offset per window and pass 2
+    # scatters g to it alone (post-ReLU zero ties are common; giving
+    # every tie the full gradient would inflate dX by the tie count).
+    offsets = list(itertools.product(*[range(k) for k in window]))
+    noff = len(offsets)
+    views = []
+    first = jnp.full(out.shape, noff, jnp.int32)
+    for lin, offset in enumerate(offsets):
         # (out-1)*stride + window <= padded dim by reduce_window's output
         # formula, so every shifted view is in bounds
         limit = [o + (y - 1) * s + 1
                  for o, y, s in zip(offset, out.shape, strides)]
         xs = lax.slice(xp, offset, limit, strides)
-        contrib = jnp.where(xs == out, g, jnp.zeros((), g.dtype))
+        views.append((offset, limit))
+        first = jnp.minimum(first, jnp.where(xs == out, jnp.int32(lin),
+                                             jnp.int32(noff)))
+    dxp = jnp.zeros(xp.shape, g.dtype)
+    for lin, (offset, limit) in enumerate(views):
+        contrib = jnp.where(first == lin, g, jnp.zeros((), g.dtype))
         dxp = dxp + lax.pad(contrib, np.asarray(0, g.dtype)[()], [
             (o, d - l, s - 1)
             for o, d, l, s in zip(offset, xp.shape, limit, strides)])
@@ -201,10 +214,10 @@ def _pooling(data, kernel=None, pool_type="max", global_pool=False,
             # custom VJP: XLA's autodiff of reduce_window-max is
             # select-and-scatter, which is slow on TPU (1.5 ms/step in the
             # ResNet-50 profile, docs/PERF.md).  The shifted-window mask
-            # backward below is a handful of fused elementwise passes and
-            # matches the reference's mshadow unpool semantics
-            # (pooling-inl.h: every position equal to the window max
-            # receives the full output gradient, ties included).
+            # backward is a handful of fused elementwise passes and
+            # matches the reference's active unpool semantics (pool.h
+            # unpool_max_*_cpu: the whole gradient goes to the first
+            # argmax in window scan order, not to every tie).
             return _maxpool_sws(data, window, strides, tuple(padding))
         init = np.asarray(jnp.iinfo(data.dtype).min, data.dtype)[()]
         return lax.reduce_window(data, init, lax.max, window, strides, padding)

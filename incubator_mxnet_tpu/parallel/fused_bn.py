@@ -55,8 +55,8 @@ _I0 = np.int32(0)  # index-map literal pinned to i32 (package enables x64)
 
 __all__ = ["ghost_bn_act", "ghost_bn_stats_merge"]
 
-_VMEM_KERNEL_LIMIT = 100 * 1024 * 1024
-_WINDOW_BUDGET = 96 * 1024 * 1024
+_VMEM_KERNEL_LIMIT = 120 * 1024 * 1024
+_WINDOW_BUDGET = 104 * 1024 * 1024
 
 
 def _use_interpret():
@@ -74,39 +74,12 @@ def _sublane(itemsize):
     return 16 if itemsize == 2 else 8
 
 
-def _pick_lnc(n, c, l, itemsize, group=0, slab_budget=8 * 1024 * 1024):
-    """(L, N, C) view blocks: lane dim = channel block (128 or C), sublane
-    = ghost group (multiples of the dtype tile so windows don't pad; the
-    user group is a CAP — large-L layers fall back to smaller groups)."""
-    cb = c if (c <= 128 or c % 128) else 128
-    sub = _sublane(itemsize)
-    cap = group if group else 32
-    ngs = [g for g in range(cap, sub - 1, -sub)
-           if n % g == 0 and g % sub == 0]
-    if n % min(n, cap) == 0 and min(n, cap) not in ngs:
-        ngs.append(min(n, cap))  # small batches: ng == n is always legal
-    for ng in ngs:
-        if ng * cb * l * itemsize <= slab_budget:
-            return ng, cb
-    if ngs:
-        return ngs[-1], cb
-    raise ValueError("no feasible ghost group for N=%d C=%d L=%d group=%d"
-                     % (n, c, l, group))
-
-
-def _pick_lcn(n, c, l, itemsize, slab_budget=8 * 1024 * 1024):
-    """(L, C, N) view blocks for C < 128: lane dim = batch block (the
-    ghost group, = min(N, 128)), sublane = channel block."""
-    nb = min(n, 128)
-    while n % nb:
-        nb //= 2
-    sub = _sublane(itemsize)
-    cb = min(c, max(sub, (slab_budget // (nb * l * itemsize)) // sub * sub))
-    while c % cb or cb % sub:
-        cb -= sub
-        if cb <= 0:
-            return None
-    return cb, nb
+# NB round-5 rewrite: the round-4 kernels split C >= 256 into 128-wide
+# lane blocks, which turned every window DMA into cb*itemsize-byte
+# strided runs (256 B at 512 B stride for the stage-2 exits) — exactly
+# the measured ~55 % of the BW roofline.  The channel dim is now NEVER
+# split in the LNC view: a (L, ng, C) block reads ng*C*itemsize
+# CONTIGUOUS runs (4-16 KB on the ResNet-50 shapes).
 
 
 # ---------------------------------------------------------------------------
@@ -346,26 +319,57 @@ def _call_bwd(gy, x_v, y_v, gamma, beta, m, v, eps, act, ab, ch_axis):
 
 
 def _plan(n, c, l, itemsize, group, has_res):
-    """Choose (ch_axis, (A-block, B-block)) or None for jnp fallback.
-    The bwd window budget decides: Mosaic double-buffers every window and
-    pads sublanes to the dtype tile and lanes to 128; the residual bwd has
-    5 big windows, the plain one 3."""
+    """Choose ``(ch_axis, (A-block, B-block), bwd_pallas)`` or None for
+    the full-jnp fallback.
+
+    Feasibility is per DIRECTION: Mosaic double-buffers every window
+    (x2) and pads sublanes/lanes to the dtype tile; the fwd needs
+    2(+1 residual) big windows vs the bwd's 3(+2).  A layer whose bwd
+    windows bust the budget still runs the single-read Pallas FWD with
+    an equivalent jnp bwd over the same ghost groups (hybrid) — every
+    non-stem ResNet-50 BN keeps at least the fwd stats-read saving.
+    """
     sub = _sublane(itemsize)
-    windows = 5 if has_res else 3
 
-    def fits(a_blk, b_blk):
-        padded = l * _rup(a_blk, sub) * _rup(b_blk, 128) * itemsize
-        return windows * 2 * padded <= _WINDOW_BUDGET
+    def padded(a_blk, b_blk):
+        return l * _rup(a_blk, sub) * _rup(b_blk, 128) * itemsize
 
-    if c >= 128:
-        ng, cb = _pick_lnc(n, c, l, itemsize, group=group)
-        if fits(ng, cb):
-            return 2, (ng, cb)
+    def fits(nwin, a_blk, b_blk):
+        return nwin * 2 * padded(a_blk, b_blk) <= _WINDOW_BUDGET
+
+    fw = 3 if has_res else 2
+    bw = 5 if has_res else 3
+    if c >= 128 or n > 128:
+        # LNC: full C on lanes, ghost group on sublanes.  Prefer
+        # tile-multiple groups (a sub-tile group pads VMEM to the tile
+        # without shrinking it), largest first; the user group is a CAP.
+        cap = min(group if group else 32, n)
+        ngs = sorted((g for g in range(1, cap + 1) if n % g == 0),
+                     key=lambda g: (g % sub == 0, g), reverse=True)
+        # prefer the largest group for which BOTH directions fuse (group
+        # size doesn't change the bytes saved, a fused bwd does); fall
+        # back to the largest fwd-only group
+        best_fwd = None
+        for ng in ngs:
+            if fits(fw, ng, c):
+                if fits(bw, ng, c):
+                    return 2, (ng, c), True
+                if best_fwd is None:
+                    best_fwd = ng
+        if best_fwd is not None:
+            return 2, (best_fwd, c), False
         return None
-    blocks = _pick_lcn(n, c, l, itemsize)
-    if blocks is not None and fits(*blocks):
-        return 1, blocks
-    return None
+    # small-N path (N <= 128, C < 128): channels on sublanes, the WHOLE
+    # batch on lanes — exact full-batch statistics, contiguous
+    # cb*N*itemsize runs (the block covers full N and a dense C-slice)
+    cb = c
+    while cb > 0 and not fits(fw, cb, n):
+        cb -= sub
+        while cb > 0 and c % cb:
+            cb -= 1
+    if cb <= 0:
+        return None
+    return 1, (cb, n), fits(bw, cb, n)
 
 
 def _to_view(x, ch_axis):
@@ -390,8 +394,8 @@ def _from_view(x_v, shape, ch_axis):
 
 def _gbn_fwd(x, gamma, beta, residual, eps, act, group):
     n, c, h, w = x.shape
-    ch_axis, ab = _plan(n, c, h * w, x.dtype.itemsize, group,
-                        residual is not None)
+    ch_axis, ab, _ = _plan(n, c, h * w, x.dtype.itemsize, group,
+                           residual is not None)
     x_v = _to_view(x, ch_axis)
     r_v = None if residual is None else _to_view(residual, ch_axis)
     y_v, m, v = _call_fwd(x_v, gamma, beta, r_v, eps, act, ab, ch_axis)
@@ -401,17 +405,57 @@ def _gbn_fwd(x, gamma, beta, residual, eps, act, group):
     return ((y, m, v), res)
 
 
+def _gbn_bwd_jnp(gy, x, y, gamma, beta, m, v, eps, act, ng):
+    """Ghost-BN backward in plain jnp over the SAME ghost groups as the
+    kernels — the hybrid path for layers whose bwd windows don't fit
+    VMEM but whose fwd does (the fwd still saves its stats read)."""
+    n, c, h, w = x.shape
+    g = n // ng
+    f32 = jnp.float32
+    x5 = x.astype(f32).reshape(g, ng, c, h, w)
+    gy5 = gy.astype(f32).reshape(g, ng, c, h, w)
+    mb = m.reshape(g, 1, c, 1, 1)
+    rstd = jax.lax.rsqrt(v + eps).reshape(g, 1, c, 1, 1)
+    gam = gamma.astype(f32).reshape(1, 1, c, 1, 1)
+    xhat = (x5 - mb) * rstd
+    if act == "relu":
+        if y is not None:
+            keep = y.astype(f32).reshape(g, ng, c, h, w) > 0
+        else:
+            keep = (xhat * gam
+                    + beta.astype(f32).reshape(1, 1, c, 1, 1)) > 0
+        gp = jnp.where(keep, gy5, 0.0)
+    else:
+        gp = gy5
+    cnt = ng * h * w
+    db = gp.sum(axis=(1, 3, 4))
+    dg = (gp * xhat).sum(axis=(1, 3, 4))
+    dx = (gam * rstd
+          * (gp - (db.reshape(g, 1, c, 1, 1)
+                   + xhat * dg.reshape(g, 1, c, 1, 1)) / cnt))
+    dr = gp.reshape(n, c, h, w).astype(x.dtype) if y is not None else None
+    return (dx.reshape(n, c, h, w).astype(x.dtype), dg.sum(0), db.sum(0),
+            dr)
+
+
 def _gbn_bwd(eps, act, group, res, ct):
     x_v, y_v, gamma, beta, m, v, shape = res
     gy, _, _ = ct  # cotangents for the stat outputs are not propagated
     n, c, h, w = shape
-    ch_axis, ab = _plan(n, c, h * w, x_v.dtype.itemsize, group,
-                        y_v is not None)
-    gy_v = _to_view(gy, ch_axis)
-    dx, dg, db, dr = _call_bwd(gy_v, x_v, y_v, gamma, beta, m, v, eps, act,
-                               ab, ch_axis)
-    dx = _from_view(dx, shape, ch_axis)
-    dr = None if dr is None else _from_view(dr, shape, ch_axis)
+    ch_axis, ab, bwd_pallas = _plan(n, c, h * w, x_v.dtype.itemsize, group,
+                                    y_v is not None)
+    if bwd_pallas:
+        gy_v = _to_view(gy, ch_axis)
+        dx, dg, db, dr = _call_bwd(gy_v, x_v, y_v, gamma, beta, m, v, eps,
+                                   act, ab, ch_axis)
+        dx = _from_view(dx, shape, ch_axis)
+        dr = None if dr is None else _from_view(dr, shape, ch_axis)
+    else:
+        x = _from_view(x_v, shape, ch_axis)
+        y = None if y_v is None else _from_view(y_v, shape, ch_axis)
+        ng = ab[0] if ch_axis == 2 else ab[1]
+        dx, dg, db, dr = _gbn_bwd_jnp(gy, x, y, gamma, beta, m, v, eps,
+                                      act, ng)
     return (dx, dg.astype(gamma.dtype), db.astype(beta.dtype), dr)
 
 

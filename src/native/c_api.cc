@@ -188,7 +188,17 @@ MXTPU_API int MXNDArrayCreate(const uint32_t* shape, uint32_t ndim,
 
 MXTPU_API int MXNDArrayFree(NDArrayHandle handle) {
   Gil gil;
-  Py_XDECREF(static_cast<PyObject*>(handle));
+  PyObject* h = static_cast<PyObject*>(handle);
+  // last chance to sync writes made through a GetData pointer (shallow
+  // copies share the object, so the data may outlive this handle)
+  if (h != nullptr && PyObject_HasAttrString(h, "_capi_host_buf")) {
+    PyObject* args = Py_BuildValue("(O)", h);
+    PyObject* res = CallImpl("ndarray_writeback_host_buf", args);
+    Py_DECREF(args);
+    if (res == nullptr) PyErr_Clear();
+    else Py_DECREF(res);
+  }
+  Py_XDECREF(h);
   return 0;
 }
 
@@ -239,8 +249,11 @@ MXTPU_API int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void* data,
 
 MXTPU_API int MXNDArrayWaitToRead(NDArrayHandle handle) {
   Gil gil;
-  PyObject* res = PyObject_CallMethod(static_cast<PyObject*>(handle),
-                                      "wait_to_read", nullptr);
+  // routed through capi_impl so an outstanding GetData host buffer is
+  // written back before the wait (raw-pointer write contract)
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = CallImpl("ndarray_wait_to_read", args);
+  Py_DECREF(args);
   if (res == nullptr) return FailFromPython();
   Py_DECREF(res);
   return 0;
@@ -1117,7 +1130,9 @@ struct UpdaterClosure {
 
 // PyCFunction trampoline: capi_impl's updater wrapper calls this with
 // (capsule, key, recv, local) so the user's C function pointer runs with
-// live NDArray handles (borrowed references for the duration of the call)
+// live NDArray handles. Ownership of both handles transfers to the
+// callee (reference contract: the frontend wrapper wraps recv and local
+// in owning NDArrays that call MXNDArrayFree on destruction).
 PyObject* CallCUpdater(PyObject*, PyObject* args) {
   PyObject* capsule = nullptr;
   PyObject* key_obj = nullptr;
@@ -1145,6 +1160,8 @@ PyObject* CallCUpdater(PyObject*, PyObject* args) {
   auto* cl = static_cast<UpdaterClosure*>(
       PyCapsule_GetPointer(capsule, "mxtpu_updater"));
   if (cl == nullptr) return nullptr;
+  Py_INCREF(recv);
+  Py_INCREF(local);
   cl->fn(static_cast<int>(key), recv, local, cl->handle);
   Py_RETURN_NONE;
 }
@@ -3294,9 +3311,12 @@ PyObject* MonitorTrampoline(PyObject* self, PyObject* py_args) {
   const char* name = nullptr;
   PyObject* arr = nullptr;
   if (!PyArg_ParseTuple(py_args, "sO", &name, &arr)) return nullptr;
-  Py_INCREF(arr);  // callee receives a borrowed handle; keep it alive
+  // Ownership of the handle transfers to the callee (reference
+  // contract: frontends wrap it in NDArray and call MXNDArrayFree,
+  // c_api_executor.cc monitor path) — INCREF with no balancing DECREF;
+  // the callee's MXNDArrayFree supplies it.
+  Py_INCREF(arr);
   ctx->cb(name, arr, ctx->param);
-  Py_DECREF(arr);
   Py_RETURN_NONE;
 }
 
@@ -4166,11 +4186,17 @@ PyObject* CallCUpdaterEx(PyObject*, PyObject* args) {
   auto* cl = static_cast<UpdaterExClosure*>(
       PyCapsule_GetPointer(capsule, "mxtpu_updater_ex"));
   if (cl == nullptr) return nullptr;
+  // Both handles transfer ownership to the updater (reference
+  // contract: the frontend wrapper wraps recv AND local in owning
+  // NDArrays that call MXNDArrayFree on destruction); the kvstore's
+  // own reference keeps `local` alive after the callee frees its copy.
   if (PyUnicode_Check(key_obj)) {
     // string keys dispatch to the string updater (the API the caller
     // used); numeric conversion is only a fallback when no string
     // updater was registered
     if (cl->str_fn != nullptr) {
+      Py_INCREF(recv);
+      Py_INCREF(local);
       cl->str_fn(PyUnicode_AsUTF8(key_obj), recv, local, cl->handle);
       Py_RETURN_NONE;
     }
@@ -4181,6 +4207,8 @@ PyObject* CallCUpdaterEx(PyObject*, PyObject* args) {
                       "no updater registered for string keys");
       return nullptr;
     }
+    Py_INCREF(recv);
+    Py_INCREF(local);
     cl->fn(static_cast<int>(PyLong_AsLong(as_int)), recv, local,
            cl->handle);
     Py_DECREF(as_int);
@@ -4189,6 +4217,8 @@ PyObject* CallCUpdaterEx(PyObject*, PyObject* args) {
       PyErr_SetString(PyExc_TypeError, "no int updater registered");
       return nullptr;
     }
+    Py_INCREF(recv);
+    Py_INCREF(local);
     cl->fn(static_cast<int>(PyLong_AsLong(key_obj)), recv, local,
            cl->handle);
   }
@@ -4296,6 +4326,7 @@ PyObject* CallCachedHook(PyObject*, PyObject* args) {
   auto* cl = static_cast<CachedHookClosure*>(
       PyCapsule_GetPointer(capsule, "mxtpu_cached_hook"));
   if (cl == nullptr) return nullptr;
+  Py_INCREF(arr);  // ownership transfers; callee frees via MXNDArrayFree
   cl->fn(name, opr, arr);
   Py_RETURN_NONE;
 }
@@ -4487,6 +4518,11 @@ MXTPU_API int MXNDArrayFromDLPackEx(MXTPUDLManagedTensor* dlpack,
   (void)transient_handle;
   if (dlpack == nullptr) return Fail("null dlpack tensor");
   MXTPUDLTensor* t = &dlpack->dl_tensor;
+  // the data pointer is dereferenced as host memory below; a device
+  // tensor (kDLCUDA etc.) would read garbage or fault
+  if (t->device.device_type != 1 /* kDLCPU */) {
+    return Fail("dlpack import requires a kDLCPU tensor");
+  }
   int code = DLToDType(t->dtype.code, t->dtype.bits);
   if (code < 0 || t->dtype.lanes != 1) {
     return Fail("unsupported dlpack dtype");
@@ -4645,6 +4681,477 @@ MXTPU_API int MXKVStoreSendCommmandToServers(KVStoreHandle kv, int cmd_id,
   PyObject* args = Py_BuildValue("(Ois)", static_cast<PyObject*>(kv), cmd_id,
                                  cmd_body ? cmd_body : "");
   PyObject* res = CallImpl("kvstore_send_command", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Custom-op C registration protocol: MXCustomOpRegister /
+// MXCustomFunctionRecord (reference include/mxnet/c_api.h:153-217,
+// src/operator/custom/custom.cc:70-119, src/c_api/c_api_function.cc:186).
+// The reference dispatches these callbacks on dedicated engine threads;
+// this runtime's host path is synchronous, so the async callback-thread
+// discipline collapses to direct calls.  Callbacks receive live NDArray
+// handles, valid for the duration of the call, and act on them through
+// the same MXNDArray* surface a reference custom-op library uses.
+// ---------------------------------------------------------------------------
+
+struct MXTPUCallbackList {
+  int num_callbacks;
+  int (**callbacks)(void);
+  void** contexts;
+};
+
+namespace {
+
+typedef int (*MXTPUCustomOpFBFunc)(int, void**, int*, const int*, const int,
+                                   void*);
+typedef int (*MXTPUCustomOpDelFunc)(void*);
+typedef int (*MXTPUCustomOpListFunc)(char***, void*);
+typedef int (*MXTPUCustomOpInferShapeFunc)(int, int*, int**, void*);
+typedef int (*MXTPUCustomOpInferTypeFunc)(int, int*, void*);
+typedef int (*MXTPUCustomOpBwdDepFunc)(const int*, const int*, const int*,
+                                       int*, int**, void*);
+typedef int (*MXTPUCustomOpCreateFunc)(const char*, int, unsigned**,
+                                       const int*, const int*,
+                                       MXTPUCallbackList*, void*);
+typedef int (*MXTPUCustomOpPropCreator)(const char*, const int, const char**,
+                                        const char**, MXTPUCallbackList*);
+typedef int (*MXTPUCustomFunctionBwdFunc)(int, int, void**, const int*,
+                                          const int, void*);
+typedef int (*MXTPUCustomFunctionDelFunc)(void*);
+
+enum {
+  kMXTPUCustomOpDelete,
+  kMXTPUCustomOpForward,
+  kMXTPUCustomOpBackward
+};
+enum {
+  kMXTPUCustomOpPropDelete,
+  kMXTPUCustomOpPropListArguments,
+  kMXTPUCustomOpPropListOutputs,
+  kMXTPUCustomOpPropListAuxiliaryStates,
+  kMXTPUCustomOpPropInferShape,
+  kMXTPUCustomOpPropDeclareBackwardDependency,
+  kMXTPUCustomOpPropCreateOperator,
+  kMXTPUCustomOpPropInferType
+};
+enum { kMXTPUCustomFunctionBackward, kMXTPUCustomFunctionDelete };
+
+bool CbPresent(const MXTPUCallbackList& cb, int which) {
+  return which < cb.num_callbacks && cb.callbacks[which] != nullptr;
+}
+
+// owned deep copy of a creator/callee-filled callback list (the caller's
+// struct may live on its stack)
+MXTPUCallbackList* CopyCbList(const MXTPUCallbackList& src) {
+  typedef int (*RawCb)(void);
+  auto* dst = new MXTPUCallbackList;
+  dst->num_callbacks = src.num_callbacks;
+  dst->callbacks = new RawCb[src.num_callbacks];
+  dst->contexts = new void*[src.num_callbacks];
+  for (int i = 0; i < src.num_callbacks; ++i) {
+    dst->callbacks[i] = src.callbacks[i];
+    dst->contexts[i] = src.contexts[i];
+  }
+  return dst;
+}
+
+void FreeCbList(MXTPUCallbackList* cb, int del_idx) {
+  if (cb == nullptr) return;
+  if (CbPresent(*cb, del_idx)) {
+    reinterpret_cast<MXTPUCustomOpDelFunc>(cb->callbacks[del_idx])(
+        cb->contexts[del_idx]);
+  }
+  delete[] cb->callbacks;
+  delete[] cb->contexts;
+  delete cb;
+}
+
+void PropCapsuleDel(PyObject* cap) {
+  FreeCbList(static_cast<MXTPUCallbackList*>(
+                 PyCapsule_GetPointer(cap, "mxtpu_custom_prop")),
+             kMXTPUCustomOpPropDelete);
+}
+
+void OpCapsuleDel(PyObject* cap) {
+  FreeCbList(static_cast<MXTPUCallbackList*>(
+                 PyCapsule_GetPointer(cap, "mxtpu_custom_op")),
+             kMXTPUCustomOpDelete);
+}
+
+void FnCapsuleDel(PyObject* cap) {
+  FreeCbList(static_cast<MXTPUCallbackList*>(
+                 PyCapsule_GetPointer(cap, "mxtpu_custom_fn")),
+             kMXTPUCustomFunctionDelete);
+}
+
+MXTPUCallbackList* CapList(PyObject* cap, const char* name) {
+  return static_cast<MXTPUCallbackList*>(PyCapsule_GetPointer(cap, name));
+}
+
+// trampoline: (creator_capsule, op_type, keys tuple, vals tuple) ->
+// prop capsule
+PyObject* CCustomPropCreate(PyObject*, PyObject* args) {
+  PyObject* cap = nullptr;
+  const char* op_type = nullptr;
+  PyObject* keys = nullptr;
+  PyObject* vals = nullptr;
+  if (!PyArg_ParseTuple(args, "OsOO", &cap, &op_type, &keys, &vals)) {
+    return nullptr;
+  }
+  auto creator = reinterpret_cast<MXTPUCustomOpPropCreator>(
+      PyCapsule_GetPointer(cap, "mxtpu_custom_creator"));
+  if (creator == nullptr) return nullptr;
+  Py_ssize_t n = PyTuple_Size(keys);
+  std::vector<const char*> ks(n), vs(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    ks[i] = PyUnicode_AsUTF8(PyTuple_GetItem(keys, i));
+    vs[i] = PyUnicode_AsUTF8(PyTuple_GetItem(vals, i));
+  }
+  MXTPUCallbackList cb{0, nullptr, nullptr};
+  if (!creator(op_type, static_cast<int>(n), ks.data(), vs.data(), &cb)) {
+    PyErr_Format(PyExc_RuntimeError,
+                 "CustomOpPropCreator for %s returned failure", op_type);
+    return nullptr;
+  }
+  return PyCapsule_New(CopyCbList(cb), "mxtpu_custom_prop", PropCapsuleDel);
+}
+
+// (prop_capsule, which) -> [str, ...] via a CustomOpListFunc
+PyObject* CCustomPropList(PyObject*, PyObject* args) {
+  PyObject* cap = nullptr;
+  int which = 0;
+  if (!PyArg_ParseTuple(args, "Oi", &cap, &which)) return nullptr;
+  auto* cb = CapList(cap, "mxtpu_custom_prop");
+  if (cb == nullptr) return nullptr;
+  char** names = nullptr;
+  if (!CbPresent(*cb, which) ||
+      !reinterpret_cast<MXTPUCustomOpListFunc>(cb->callbacks[which])(
+          &names, cb->contexts[which])) {
+    PyErr_SetString(PyExc_RuntimeError, "custom-op list callback failed");
+    return nullptr;
+  }
+  PyObject* out = PyList_New(0);
+  for (int i = 0; names != nullptr && names[i] != nullptr; ++i) {
+    PyObject* s = PyUnicode_FromString(names[i]);
+    PyList_Append(out, s);
+    Py_DECREF(s);
+  }
+  return out;
+}
+
+// (prop_capsule, which) -> bool
+PyObject* CCustomPropHas(PyObject*, PyObject* args) {
+  PyObject* cap = nullptr;
+  int which = 0;
+  if (!PyArg_ParseTuple(args, "Oi", &cap, &which)) return nullptr;
+  auto* cb = CapList(cap, "mxtpu_custom_prop");
+  if (cb == nullptr) return nullptr;
+  return PyBool_FromLong(CbPresent(*cb, which) ? 1 : 0);
+}
+
+// (prop_capsule, [[in shapes]], total) -> [[all shapes]] — the callback
+// sees ndims/shapes arrays over args+outs+auxs with inputs filled and
+// sets the rest to callee-owned storage (custom.cc InferShape contract)
+PyObject* CCustomPropInferShape(PyObject*, PyObject* args) {
+  PyObject* cap = nullptr;
+  PyObject* in_shapes = nullptr;
+  int total = 0;
+  if (!PyArg_ParseTuple(args, "OOi", &cap, &in_shapes, &total)) {
+    return nullptr;
+  }
+  auto* cb = CapList(cap, "mxtpu_custom_prop");
+  if (cb == nullptr) return nullptr;
+  Py_ssize_t n_in = PyList_Size(in_shapes);
+  std::vector<std::vector<int>> store(n_in);
+  std::vector<int> ndims(total, 0);
+  std::vector<int*> shapes(total, nullptr);
+  for (Py_ssize_t i = 0; i < n_in; ++i) {
+    PyObject* s = PyList_GetItem(in_shapes, i);
+    Py_ssize_t d = PyList_Size(s);
+    store[i].resize(d);
+    for (Py_ssize_t j = 0; j < d; ++j) {
+      store[i][j] =
+          static_cast<int>(PyLong_AsLong(PyList_GetItem(s, j)));
+    }
+    ndims[i] = static_cast<int>(d);
+    shapes[i] = store[i].data();
+  }
+  if (!CbPresent(*cb, kMXTPUCustomOpPropInferShape) ||
+      !reinterpret_cast<MXTPUCustomOpInferShapeFunc>(
+          cb->callbacks[kMXTPUCustomOpPropInferShape])(
+          total, ndims.data(), shapes.data(),
+          cb->contexts[kMXTPUCustomOpPropInferShape])) {
+    PyErr_SetString(PyExc_RuntimeError,
+                    "custom-op infer_shape callback failed");
+    return nullptr;
+  }
+  PyObject* out = PyList_New(total);
+  for (int i = 0; i < total; ++i) {
+    PyObject* s = PyList_New(ndims[i]);
+    for (int j = 0; j < ndims[i]; ++j) {
+      PyList_SetItem(s, j, PyLong_FromLong(
+          shapes[i] != nullptr ? shapes[i][j] : 0));
+    }
+    PyList_SetItem(out, i, s);
+  }
+  return out;
+}
+
+// (prop_capsule, [in dtype codes], total) -> [all dtype codes]
+PyObject* CCustomPropInferType(PyObject*, PyObject* args) {
+  PyObject* cap = nullptr;
+  PyObject* in_types = nullptr;
+  int total = 0;
+  if (!PyArg_ParseTuple(args, "OOi", &cap, &in_types, &total)) {
+    return nullptr;
+  }
+  auto* cb = CapList(cap, "mxtpu_custom_prop");
+  if (cb == nullptr) return nullptr;
+  std::vector<int> types(total, -1);
+  Py_ssize_t n_in = PyList_Size(in_types);
+  for (Py_ssize_t i = 0; i < n_in; ++i) {
+    types[i] = static_cast<int>(PyLong_AsLong(PyList_GetItem(in_types, i)));
+  }
+  if (!CbPresent(*cb, kMXTPUCustomOpPropInferType) ||
+      !reinterpret_cast<MXTPUCustomOpInferTypeFunc>(
+          cb->callbacks[kMXTPUCustomOpPropInferType])(
+          total, types.data(), cb->contexts[kMXTPUCustomOpPropInferType])) {
+    PyErr_SetString(PyExc_RuntimeError,
+                    "custom-op infer_type callback failed");
+    return nullptr;
+  }
+  PyObject* out = PyList_New(total);
+  for (int i = 0; i < total; ++i) {
+    PyList_SetItem(out, i, PyLong_FromLong(types[i]));
+  }
+  return out;
+}
+
+// (prop_capsule, [out_grad ids], [in_data ids], [out_data ids]) -> [deps]
+PyObject* CCustomPropBwdDep(PyObject*, PyObject* args) {
+  PyObject* cap = nullptr;
+  PyObject* og = nullptr;
+  PyObject* idata = nullptr;
+  PyObject* odata = nullptr;
+  if (!PyArg_ParseTuple(args, "OOOO", &cap, &og, &idata, &odata)) {
+    return nullptr;
+  }
+  auto* cb = CapList(cap, "mxtpu_custom_prop");
+  if (cb == nullptr) return nullptr;
+  auto to_vec = [](PyObject* l) {
+    std::vector<int> v(PyList_Size(l));
+    for (size_t i = 0; i < v.size(); ++i) {
+      v[i] = static_cast<int>(
+          PyLong_AsLong(PyList_GetItem(l, static_cast<Py_ssize_t>(i))));
+    }
+    return v;
+  };
+  std::vector<int> ogv = to_vec(og), iv = to_vec(idata), ov = to_vec(odata);
+  int num_deps = 0;
+  int* rdeps = nullptr;
+  if (!CbPresent(*cb, kMXTPUCustomOpPropDeclareBackwardDependency) ||
+      !reinterpret_cast<MXTPUCustomOpBwdDepFunc>(
+          cb->callbacks[kMXTPUCustomOpPropDeclareBackwardDependency])(
+          ogv.data(), iv.data(), ov.data(), &num_deps, &rdeps,
+          cb->contexts[kMXTPUCustomOpPropDeclareBackwardDependency])) {
+    PyErr_SetString(PyExc_RuntimeError,
+                    "custom-op declare_backward_dependency failed");
+    return nullptr;
+  }
+  PyObject* out = PyList_New(num_deps);
+  for (int i = 0; i < num_deps; ++i) {
+    PyList_SetItem(out, i, PyLong_FromLong(rdeps[i]));
+  }
+  return out;
+}
+
+// (prop_capsule, ctx_str, [[in shapes]], [in dtypes]) -> op capsule
+PyObject* CCustomPropCreateOperator(PyObject*, PyObject* args) {
+  PyObject* cap = nullptr;
+  const char* ctx = nullptr;
+  PyObject* shps = nullptr;
+  PyObject* dts = nullptr;
+  if (!PyArg_ParseTuple(args, "OsOO", &cap, &ctx, &shps, &dts)) {
+    return nullptr;
+  }
+  auto* cb = CapList(cap, "mxtpu_custom_prop");
+  if (cb == nullptr) return nullptr;
+  Py_ssize_t n = PyList_Size(shps);
+  std::vector<std::vector<unsigned>> store(n);
+  std::vector<unsigned*> shapes(n);
+  std::vector<int> ndims(n), dtypes(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* s = PyList_GetItem(shps, i);
+    Py_ssize_t d = PyList_Size(s);
+    store[i].resize(d);
+    for (Py_ssize_t j = 0; j < d; ++j) {
+      store[i][j] = static_cast<unsigned>(
+          PyLong_AsUnsignedLong(PyList_GetItem(s, j)));
+    }
+    shapes[i] = store[i].data();
+    ndims[i] = static_cast<int>(d);
+    dtypes[i] = static_cast<int>(PyLong_AsLong(PyList_GetItem(dts, i)));
+  }
+  MXTPUCallbackList op{0, nullptr, nullptr};
+  if (!CbPresent(*cb, kMXTPUCustomOpPropCreateOperator) ||
+      !reinterpret_cast<MXTPUCustomOpCreateFunc>(
+          cb->callbacks[kMXTPUCustomOpPropCreateOperator])(
+          ctx, static_cast<int>(n), shapes.data(), ndims.data(),
+          dtypes.data(), &op,
+          cb->contexts[kMXTPUCustomOpPropCreateOperator])) {
+    PyErr_SetString(PyExc_RuntimeError,
+                    "custom-op create_operator callback failed");
+    return nullptr;
+  }
+  return PyCapsule_New(CopyCbList(op), "mxtpu_custom_op", OpCapsuleDel);
+}
+
+// (op_capsule, which, [handles], [tags], [reqs], is_train) — the
+// forward/backward CustomOpFBFunc call; handles are borrowed for the
+// duration of the call (the reference engine owns its copies likewise)
+PyObject* CCustomOpCall(PyObject*, PyObject* args) {
+  PyObject* cap = nullptr;
+  int which = 0;
+  PyObject* handles = nullptr;
+  PyObject* tags = nullptr;
+  PyObject* reqs = nullptr;
+  int is_train = 0;
+  if (!PyArg_ParseTuple(args, "OiOOOi", &cap, &which, &handles, &tags,
+                        &reqs, &is_train)) {
+    return nullptr;
+  }
+  auto* cb = CapList(cap, "mxtpu_custom_op");
+  if (cb == nullptr) return nullptr;
+  Py_ssize_t n = PyList_Size(handles);
+  std::vector<void*> ptrs(n);
+  std::vector<int> tagv(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    ptrs[i] = PyList_GetItem(handles, i);
+    tagv[i] = static_cast<int>(PyLong_AsLong(PyList_GetItem(tags, i)));
+  }
+  Py_ssize_t nr = PyList_Size(reqs);
+  std::vector<int> reqv(nr);
+  for (Py_ssize_t i = 0; i < nr; ++i) {
+    reqv[i] = static_cast<int>(PyLong_AsLong(PyList_GetItem(reqs, i)));
+  }
+  if (!CbPresent(*cb, which) ||
+      !reinterpret_cast<MXTPUCustomOpFBFunc>(cb->callbacks[which])(
+          static_cast<int>(n), ptrs.data(), tagv.data(), reqv.data(),
+          is_train, cb->contexts[which])) {
+    PyErr_SetString(PyExc_RuntimeError,
+                    "custom-op forward/backward callback failed");
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+// (fn_capsule, num_ograds, num_igrads, [handles], [reqs], is_train)
+PyObject* CCustomFunctionCall(PyObject*, PyObject* args) {
+  PyObject* cap = nullptr;
+  int n_og = 0;
+  int n_ig = 0;
+  PyObject* handles = nullptr;
+  PyObject* reqs = nullptr;
+  int is_train = 0;
+  if (!PyArg_ParseTuple(args, "OiiOOi", &cap, &n_og, &n_ig, &handles,
+                        &reqs, &is_train)) {
+    return nullptr;
+  }
+  auto* cb = CapList(cap, "mxtpu_custom_fn");
+  if (cb == nullptr) return nullptr;
+  Py_ssize_t n = PyList_Size(handles);
+  std::vector<void*> ptrs(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    ptrs[i] = PyList_GetItem(handles, i);
+  }
+  Py_ssize_t nr = PyList_Size(reqs);
+  std::vector<int> reqv(nr);
+  for (Py_ssize_t i = 0; i < nr; ++i) {
+    reqv[i] = static_cast<int>(PyLong_AsLong(PyList_GetItem(reqs, i)));
+  }
+  if (!CbPresent(*cb, kMXTPUCustomFunctionBackward) ||
+      !reinterpret_cast<MXTPUCustomFunctionBwdFunc>(
+          cb->callbacks[kMXTPUCustomFunctionBackward])(
+          n_og, n_ig, ptrs.data(), reqv.data(), is_train,
+          cb->contexts[kMXTPUCustomFunctionBackward])) {
+    PyErr_SetString(PyExc_RuntimeError,
+                    "custom-function backward callback failed");
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+PyMethodDef g_custom_defs[] = {
+    {"c_custom_prop_create", CCustomPropCreate, METH_VARARGS, nullptr},
+    {"c_custom_prop_list", CCustomPropList, METH_VARARGS, nullptr},
+    {"c_custom_prop_has", CCustomPropHas, METH_VARARGS, nullptr},
+    {"c_custom_prop_infer_shape", CCustomPropInferShape, METH_VARARGS,
+     nullptr},
+    {"c_custom_prop_infer_type", CCustomPropInferType, METH_VARARGS,
+     nullptr},
+    {"c_custom_prop_bwd_dep", CCustomPropBwdDep, METH_VARARGS, nullptr},
+    {"c_custom_prop_create_operator", CCustomPropCreateOperator,
+     METH_VARARGS, nullptr},
+    {"c_custom_op_call", CCustomOpCall, METH_VARARGS, nullptr},
+    {"c_custom_function_call", CCustomFunctionCall, METH_VARARGS, nullptr},
+};
+
+PyObject* CustomTrampolineDict() {
+  PyObject* d = PyDict_New();
+  for (auto& def : g_custom_defs) {
+    PyObject* f = PyCFunction_New(&def, nullptr);
+    PyDict_SetItemString(d, def.ml_name, f);
+    Py_DECREF(f);
+  }
+  return d;
+}
+
+}  // namespace
+
+MXTPU_API int MXCustomOpRegister(const char* op_type,
+                                 MXTPUCustomOpPropCreator creator) {
+  Gil gil;
+  PyObject* cap = PyCapsule_New(reinterpret_cast<void*>(creator),
+                                "mxtpu_custom_creator", nullptr);
+  PyObject* args = Py_BuildValue("(sNN)", op_type, cap,
+                                 CustomTrampolineDict());
+  PyObject* res = CallImpl("custom_op_register_c", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXCustomFunctionRecord(int num_inputs, NDArrayHandle* inputs,
+                                     int num_outputs, NDArrayHandle* outputs,
+                                     MXTPUCallbackList* callbacks) {
+  Gil gil;
+  PyObject* ins = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    PyObject* h = static_cast<PyObject*>(inputs[i]);
+    Py_INCREF(h);
+    PyList_SetItem(ins, i, h);
+  }
+  PyObject* outs = PyList_New(num_outputs);
+  for (int i = 0; i < num_outputs; ++i) {
+    PyObject* h = static_cast<PyObject*>(outputs[i]);
+    Py_INCREF(h);
+    PyList_SetItem(outs, i, h);
+  }
+  PyObject* cap = PyCapsule_New(CopyCbList(*callbacks), "mxtpu_custom_fn",
+                                FnCapsuleDel);
+  PyObject* tramp = nullptr;
+  for (auto& def : g_custom_defs) {
+    if (std::string(def.ml_name) == "c_custom_function_call") {
+      tramp = PyCFunction_New(&def, nullptr);
+    }
+  }
+  PyObject* args = Py_BuildValue("(NNNN)", ins, outs, cap, tramp);
+  PyObject* res = CallImpl("custom_function_record", args);
   Py_DECREF(args);
   if (res == nullptr) return FailFromPython();
   Py_DECREF(res);

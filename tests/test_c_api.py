@@ -297,6 +297,13 @@ def _to_np(lib, h, shape):
     return out
 
 
+def _py_handle(obj):
+    """NDArrayHandle of an in-process Python NDArray: handles ARE the
+    PyObject* (c_api.cc header contract), and CPython's id() is the
+    object address."""
+    return ctypes.c_void_p(id(obj))
+
+
 def test_autograd_abi(lib):
     """MXAutogradMarkVariables / SetIsRecording / Backward / GetGrad
     (c_api.h autograd block): d(x*x)/dx == 2x through the C ABI."""
@@ -325,7 +332,9 @@ def test_autograd_abi(lib):
 
 def test_kvstore_abi_with_c_updater(lib):
     """MXKVStoreCreate/Init/Push/Pull/SetUpdater: the C updater callback
-    fires at push (kvstore.h:269 set_updater contract)."""
+    fires at push (kvstore.h:269 set_updater contract). recv/local
+    arrive as OWNED handles the callee must MXNDArrayFree (the
+    reference frontend wraps both in owning NDArrays)."""
     UPDATER = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_void_p,
                                ctypes.c_void_p, ctypes.c_void_p)
     calls = []
@@ -333,6 +342,8 @@ def test_kvstore_abi_with_c_updater(lib):
     @UPDATER
     def upd(key, recv, local, handle):
         calls.append(key)
+        lib.MXNDArrayFree(ctypes.c_void_p(recv))
+        lib.MXNDArrayFree(ctypes.c_void_p(local))
 
     kv = ctypes.c_void_p()
     _check(lib, lib.MXKVStoreCreate(b"local", ctypes.byref(kv)))
@@ -730,6 +741,23 @@ def test_ndarray_tail_abi(lib):
     host = np.ctypeslib.as_array(
         ctypes.cast(ptr, ctypes.POINTER(ctypes.c_float)), (12,))
     np.testing.assert_array_equal(host, np.arange(12, dtype=np.float32))
+    # writes through the GetData pointer sync back at the next wait
+    # (reference returns the live chunk; here copy-on-read + write-back)
+    host[0] = 99.0
+    _check(lib, lib.MXNDArrayWaitToRead(x))
+    assert _to_np(lib, x, (3, 4))[0, 0] == 99.0
+    host[0] = 0.0
+    _check(lib, lib.MXNDArrayWaitToWrite(x))
+    assert _to_np(lib, x, (3, 4))[0, 0] == 0.0
+    # a second GetData is itself a sync boundary: pointer writes pending
+    # at the time of the call survive into the fresh buffer
+    host[1] = 7.0
+    _check(lib, lib.MXNDArrayGetData(x, ctypes.byref(ptr)))
+    host = np.ctypeslib.as_array(
+        ctypes.cast(ptr, ctypes.POINTER(ctypes.c_float)), (12,))
+    assert host[1] == 7.0 and _to_np(lib, x, (3, 4))[0, 1] == 7.0
+    host[1] = 1.0  # restore for the assertions below
+    _check(lib, lib.MXNDArrayWaitToRead(x))
 
     gs = ctypes.c_int()
     _check(lib, lib.MXNDArrayGetGradState(x, ctypes.byref(gs)))
@@ -814,6 +842,18 @@ def test_shared_mem_abi(lib):
     np.testing.assert_array_equal(
         _to_np(lib, out, (2, 4)),
         np.arange(8, dtype=np.float32).reshape(2, 4))
+    # the producer owns the segment name: a SECOND consumer can attach
+    # the same (pid, id) pair (reference allows repeated attach)
+    out2 = ctypes.c_void_p()
+    _check(lib, lib.MXNDArrayCreateFromSharedMem(pid, sid, shape, 2, 0,
+                                                 ctypes.byref(out2)))
+    np.testing.assert_array_equal(
+        _to_np(lib, out2, (2, 4)),
+        np.arange(8, dtype=np.float32).reshape(2, 4))
+    # freeing the producer handle unlinks the name; a new attach fails
+    _check(lib, lib.MXNDArrayFree(src))
+    assert lib.MXNDArrayCreateFromSharedMem(pid, sid, shape, 2, 0,
+                                            ctypes.byref(out2)) != 0
 
 
 def test_sparse_assembly_via_aux_copy_abi(lib):
@@ -985,7 +1025,10 @@ def test_executor_simple_bind_monitor_abi(lib):
     seen = []
     CB = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p,
                           ctypes.c_void_p)
-    cb = CB(lambda name, arr, param: seen.append(name.decode()))
+    # the monitor hands the callee an OWNED handle (reference contract)
+    cb = CB(lambda name, arr, param: (seen.append(name.decode()),
+                                      lib.MXNDArrayFree(
+                                          ctypes.c_void_p(arr))))
     _check(lib, lib.MXExecutorSetMonitorCallback(exe, cb, None))
 
     _check(lib, lib.MXExecutorForward(exe, 1))
@@ -1210,8 +1253,12 @@ def test_abi_tail_batch(lib):
                            ctypes.c_void_p, ctypes.c_void_p)
     SUPD = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p,
                             ctypes.c_void_p, ctypes.c_void_p)
-    upd = UPD(lambda k, r, l, p: hits.append(("int", k)))
-    supd = SUPD(lambda k, r, l, p: hits.append(("str", k)))
+    def _rec_free(tag, k, r, l):
+        hits.append((tag, k))
+        lib.MXNDArrayFree(ctypes.c_void_p(r))
+        lib.MXNDArrayFree(ctypes.c_void_p(l))
+    upd = UPD(lambda k, r, l, p: _rec_free("int", k, r, l))
+    supd = SUPD(lambda k, r, l, p: _rec_free("str", k, r, l))
     _check(lib, lib.MXKVStoreSetUpdaterEx(kv, upd, supd, None))
     g = _make_nd(lib, np.ones(4, np.float32))
     _check(lib, lib.MXKVStorePush(kv, 1, ikeys,
@@ -1225,7 +1272,9 @@ def test_abi_tail_batch(lib):
     seen = []
     HOOK = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_char_p,
                             ctypes.c_void_p)
-    hook = HOOK(lambda name, opr, arr: seen.append(name.decode()))
+    hook = HOOK(lambda name, opr, arr: (seen.append(name.decode()),
+                                        lib.MXNDArrayFree(
+                                            ctypes.c_void_p(arr))))
     _check(lib, lib.MXCachedOpRegisterOpHook(co, hook, False))
     xs = [np.random.RandomState(i).rand(*shp).astype(np.float32)
           for i, shp in enumerate([(2, 5), (3, 5), (3,)])]
@@ -1291,3 +1340,222 @@ def test_kvstore_server_surface_abi(lib):
     _check(lib, lib.MXKVStoreSendCommmandToServers(kv, 7, b"set_lr:0.01"))
     assert got == [(7, "set_lr:0.01")]
     _check(lib, lib.MXKVStoreFree(kv))
+
+
+class _MXCallbackList(ctypes.Structure):
+    _fields_ = [("num_callbacks", ctypes.c_int),
+                ("callbacks", ctypes.POINTER(
+                    ctypes.CFUNCTYPE(ctypes.c_int))),
+                ("contexts", ctypes.POINTER(ctypes.c_void_p))]
+
+
+def test_custom_op_register_abi(lib):
+    """MXCustomOpRegister: the full struct-of-callbacks protocol
+    (c_api.h:153-206, custom.cc AttrParser/List/InferShape) — a C
+    'library' (ctypes function pointers) registers op 'cdouble'
+    (y = 2x), and nd.Custom(op_type='cdouble') runs fwd+bwd through
+    the C callbacks."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, nd
+
+    keep = []  # keep every callback/static buffer alive for the test
+
+    RAWFN = ctypes.CFUNCTYPE(ctypes.c_int)
+    LIST = ctypes.CFUNCTYPE(ctypes.c_int,
+                            ctypes.POINTER(ctypes.POINTER(
+                                ctypes.c_char_p)), ctypes.c_void_p)
+    SHAPE = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int,
+                             ctypes.POINTER(ctypes.c_int),
+                             ctypes.POINTER(ctypes.POINTER(ctypes.c_int)),
+                             ctypes.c_void_p)
+    DEP = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+                           ctypes.POINTER(ctypes.c_int),
+                           ctypes.POINTER(ctypes.c_int),
+                           ctypes.POINTER(ctypes.c_int),
+                           ctypes.POINTER(ctypes.POINTER(ctypes.c_int)),
+                           ctypes.c_void_p)
+    CREATE = ctypes.CFUNCTYPE(
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint)),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(_MXCallbackList), ctypes.c_void_p)
+    FB = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int,
+                          ctypes.POINTER(ctypes.c_void_p),
+                          ctypes.POINTER(ctypes.c_int),
+                          ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+                          ctypes.c_void_p)
+    CREATOR = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+                               ctypes.POINTER(ctypes.c_char_p),
+                               ctypes.POINTER(ctypes.c_char_p),
+                               ctypes.POINTER(_MXCallbackList))
+
+    def make_list(names):
+        arr = (ctypes.c_char_p * (len(names) + 1))(
+            *[n.encode() for n in names], None)
+        keep.append(arr)
+
+        @LIST
+        def fn(out, _state):
+            out[0] = ctypes.cast(arr, ctypes.POINTER(ctypes.c_char_p))
+            return 1
+        keep.append(fn)
+        return fn
+
+    list_args = make_list(["data"])
+    list_outs = make_list(["output"])
+    list_aux = make_list([])
+
+    @SHAPE
+    def infer_shape(num_input, ndims, shapes, _state):
+        # total = 1 arg + 1 out; output shape := input shape
+        assert num_input == 2
+        ndims[1] = ndims[0]
+        shapes[1] = shapes[0]
+        return 1
+    keep.append(infer_shape)
+
+    @DEP
+    def bwd_dep(out_grad, in_data, out_data, num_deps, rdeps, _state):
+        deps = (ctypes.c_int * 2)(out_grad[0], in_data[0])
+        keep.append(deps)
+        num_deps[0] = 2
+        rdeps[0] = ctypes.cast(deps, ctypes.POINTER(ctypes.c_int))
+        return 1
+    keep.append(bwd_dep)
+
+    def _nd_scale(lib, handle, factor, out_handle):
+        """Reads `handle` via the C API and writes factor*x into
+        out_handle THROUGH the MXNDArrayGetData pointer with no explicit
+        WaitToRead — the canonical reference custom-op style; the bridge
+        must flush the host buffer when the callback returns."""
+        ndim = ctypes.c_uint32()
+        pshape = ctypes.POINTER(ctypes.c_uint32)()
+        _check(lib, lib.MXNDArrayGetShape(handle, ctypes.byref(ndim),
+                                          ctypes.byref(pshape)))
+        size = 1
+        for i in range(ndim.value):
+            size *= pshape[i]
+        buf = np.zeros(size, np.float32)
+        _check(lib, lib.MXNDArraySyncCopyToCPU(
+            handle, buf.ctypes.data_as(ctypes.c_void_p), size))
+        buf *= factor
+        ptr = ctypes.c_void_p()
+        _check(lib, lib.MXNDArrayGetData(out_handle, ctypes.byref(ptr)))
+        ctypes.memmove(ptr, buf.ctypes.data_as(ctypes.c_void_p),
+                       buf.nbytes)
+
+    @FB
+    def forward(size, ptrs, tags, reqs, is_train, _state):
+        ins = [ptrs[i] for i in range(size) if tags[i] == 0]
+        outs = [ptrs[i] for i in range(size) if tags[i] == 1]
+        _nd_scale(lib, ctypes.c_void_p(ins[0]), 2.0,
+                  ctypes.c_void_p(outs[0]))
+        return 1
+    keep.append(forward)
+
+    @FB
+    def backward(size, ptrs, tags, reqs, is_train, _state):
+        ogs = [ptrs[i] for i in range(size) if tags[i] == 3]
+        igs = [ptrs[i] for i in range(size) if tags[i] == 2]
+        _nd_scale(lib, ctypes.c_void_p(ogs[0]), 2.0,
+                  ctypes.c_void_p(igs[0]))
+        return 1
+    keep.append(backward)
+
+    @CREATE
+    def create_operator(ctx, num_inputs, shapes, ndims, dtypes, ret,
+                        _state):
+        cbs = (ctypes.CFUNCTYPE(ctypes.c_int) * 3)(
+            ctypes.cast(None, RAWFN), ctypes.cast(forward, RAWFN),
+            ctypes.cast(backward, RAWFN))
+        ctxs = (ctypes.c_void_p * 3)(None, None, None)
+        keep.extend((cbs, ctxs))
+        ret[0].num_callbacks = 3
+        ret[0].callbacks = ctypes.cast(
+            cbs, ctypes.POINTER(ctypes.CFUNCTYPE(ctypes.c_int)))
+        ret[0].contexts = ctypes.cast(ctxs,
+                                      ctypes.POINTER(ctypes.c_void_p))
+        return 1
+    keep.append(create_operator)
+
+    @CREATOR
+    def creator(op_type, num_kwargs, keys, vals, ret):
+        # prop callback table (order = CustomOpPropCallbacks)
+        cbs = (ctypes.CFUNCTYPE(ctypes.c_int) * 8)(
+            ctypes.cast(None, RAWFN),            # PropDelete
+            ctypes.cast(list_args, RAWFN),
+            ctypes.cast(list_outs, RAWFN),
+            ctypes.cast(list_aux, RAWFN),
+            ctypes.cast(infer_shape, RAWFN),
+            ctypes.cast(bwd_dep, RAWFN),
+            ctypes.cast(create_operator, RAWFN),
+            ctypes.cast(None, RAWFN))            # InferType (absent)
+        ctxs = (ctypes.c_void_p * 8)(*([None] * 8))
+        keep.extend((cbs, ctxs))
+        ret[0].num_callbacks = 8
+        ret[0].callbacks = ctypes.cast(
+            cbs, ctypes.POINTER(ctypes.CFUNCTYPE(ctypes.c_int)))
+        ret[0].contexts = ctypes.cast(ctxs,
+                                      ctypes.POINTER(ctypes.c_void_p))
+        return 1
+    keep.append(creator)
+
+    _check(lib, lib.MXCustomOpRegister(b"cdouble", creator))
+
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="cdouble")
+        y.backward(nd.ones_like(y))
+    np.testing.assert_allclose(y.asnumpy(), 2 * x.asnumpy())
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               np.full((2, 3), 2.0, np.float32))
+
+
+def test_custom_function_record_abi(lib):
+    """MXCustomFunctionRecord (c_api_function.cc:186): graft a C backward
+    onto imperatively computed outputs; backward receives
+    [ograds.., igrads..] and fills igrads."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, nd
+
+    keep = []
+    RAWFN = ctypes.CFUNCTYPE(ctypes.c_int)
+    BWD = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                           ctypes.POINTER(ctypes.c_void_p),
+                           ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+                           ctypes.c_void_p)
+
+    @BWD
+    def backward(num_ograds, num_igrads, ptrs, reqs, is_train, _state):
+        assert num_ograds == 1 and num_igrads == 1
+        og, ig = ctypes.c_void_p(ptrs[0]), ctypes.c_void_p(ptrs[1])
+        buf = np.zeros(4, np.float32)
+        _check(lib, lib.MXNDArraySyncCopyToCPU(
+            og, buf.ctypes.data_as(ctypes.c_void_p), 4))
+        buf *= 3.0  # d/dx of the 'pretend' function y = 3x
+        _check(lib, lib.MXNDArraySyncCopyFromCPU(
+            ig, buf.ctypes.data_as(ctypes.c_void_p), 4))
+        return 1
+    keep.append(backward)
+
+    x = nd.array(np.arange(4, dtype=np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3.0  # computed imperatively; C function claims its grad
+
+        cbs = (ctypes.CFUNCTYPE(ctypes.c_int) * 2)(
+            ctypes.cast(backward, RAWFN), ctypes.cast(None, RAWFN))
+        ctxs = (ctypes.c_void_p * 2)(None, None)
+        keep.extend((cbs, ctxs))
+        cblist = _MXCallbackList(
+            2, ctypes.cast(cbs,
+                           ctypes.POINTER(ctypes.CFUNCTYPE(ctypes.c_int))),
+            ctypes.cast(ctxs, ctypes.POINTER(ctypes.c_void_p)))
+        ins = (ctypes.c_void_p * 1)(_py_handle(x))
+        outs = (ctypes.c_void_p * 1)(_py_handle(y))
+        _check(lib, lib.MXCustomFunctionRecord(1, ins, 1, outs,
+                                               ctypes.byref(cblist)))
+        y.backward(nd.ones_like(y))
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               np.full(4, 3.0, np.float32))

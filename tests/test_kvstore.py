@@ -156,3 +156,64 @@ def test_pluggable_kvstore_backend_via_trainer():
         trainer.step(4)
     assert calls["push"] > 0 and calls["pull"] > 0
     assert np.abs(net.weight.data().asnumpy() - w0).sum() > 0
+
+
+def test_async_host_rejects_non_f32_and_bounds_messages():
+    """The async parameter host stores f32 ONLY and fails loudly on any
+    other dtype (no silent cast — kvstore_dist_server.h real_t analog);
+    oversized frames are rejected at the wire."""
+    import numpy as np
+    import pytest
+
+    from incubator_mxnet_tpu.kvstore.async_host import (AsyncParamClient,
+                                                        AsyncParamHost,
+                                                        _MAX_MSG, _send)
+
+    host = AsyncParamHost(0)
+    client = AsyncParamClient("127.0.0.1", host.port)
+    try:
+        client.init("w", np.ones(4, np.float32))
+        client.push("w", np.full(4, 0.5, np.float32))
+        np.testing.assert_allclose(client.pull("w"),
+                                   np.full(4, 1.5, np.float32))
+        # bf16/f16/f64 pushes are caller bugs, rejected client-side
+        import jax.numpy as jnp
+        for bad in (np.ones(4, np.float16), np.ones(4, np.float64),
+                    np.asarray(jnp.ones(4, jnp.bfloat16))):
+            with pytest.raises(TypeError, match="float32 only"):
+                client.push("w", bad)
+        with pytest.raises(TypeError, match="float32 only"):
+            client.init("v", np.ones(2, np.int32))
+        # an oversized frame dies at the sender before hitting the wire
+        with pytest.raises(ValueError):
+            _send(client._sock, b"x" * (_MAX_MSG + 1))
+    finally:
+        client.close()
+        host.stop()
+
+
+def test_async_host_server_profiler_commands(tmp_path):
+    """KVStoreServerProfilerCommand over the CMD wire (kvstore.h:49,
+    kvstore_dist_server.h ProcessServerProfilerCommands): set_config +
+    state run + dump profile the HOST process from a worker client."""
+    import json
+    import os
+
+    from incubator_mxnet_tpu.kvstore.async_host import (AsyncParamClient,
+                                                        AsyncParamHost)
+
+    host = AsyncParamHost(0)
+    client = AsyncParamClient("127.0.0.1", host.port)
+    out = str(tmp_path / "server_profile.json")
+    try:
+        # body = payload + last-char subcommand digit (reference wire)
+        client.send_command(5, "filename:%s,0" % out)
+        client.send_command(5, "11")       # kState: run
+        client.init("w", np.ones(2, np.float32))
+        client.push("w", np.ones(2, np.float32))
+        client.send_command(5, "13")       # kDump
+        assert os.path.exists(out), "server profiler dump missing"
+        json.load(open(out))
+    finally:
+        client.close()
+        host.stop()

@@ -116,19 +116,28 @@ def test_maxpool_backward():
     for i in range(5):
         for j in range(5):
             w = pad[:, :, 2 * i:2 * i + 3, 2 * j:2 * j + 3]
-            m = w == w.max(axis=(2, 3), keepdims=True)
-            want[:, :, 2 * i:2 * i + 3, 2 * j:2 * j + 3] += m
+            # first-match argmax in row-major scan order (pool.h
+            # unpool_max_*_cpu), one winner per window
+            flat = w.reshape(2, 3, -1)
+            arg = flat.argmax(axis=-1)
+            m = np.zeros_like(flat)
+            for b in range(2):
+                for c in range(3):
+                    m[b, c, arg[b, c]] = 1.0
+            want[:, :, 2 * i:2 * i + 3, 2 * j:2 * j + 3] += m.reshape(w.shape)
     np.testing.assert_allclose(x.grad.asnumpy(), want[:, :, 1:10, 1:10])
 
-    # tie semantics: every position equal to the window max receives the
-    # full gradient (reference mshadow unpool, pooling-inl.h), unlike
-    # XLA select-and-scatter's first-match
+    # tie semantics: the whole gradient goes to the FIRST position equal
+    # to the window max (reference pool.h unpool_max routes to a single
+    # argmax; ties do NOT each receive the full gradient)
     t = nd.array(np.ones((1, 1, 2, 2), np.float32))
     t.attach_grad()
     with autograd.record():
         y = nd.Pooling(t, kernel=(2, 2), stride=(2, 2), pool_type="max")
         y.backward()
-    np.testing.assert_allclose(t.grad.asnumpy(), np.ones((1, 1, 2, 2)))
+    want_t = np.zeros((1, 1, 2, 2), np.float32)
+    want_t[0, 0, 0, 0] = 1.0
+    np.testing.assert_allclose(t.grad.asnumpy(), want_t)
 
 
 def test_batchnorm_inference_and_training():

@@ -302,3 +302,66 @@ def test_record_iter_review_pins(tmp_path):
     x8 = nd.array(np.zeros((2, 3, 8, 8), np.uint8))
     loss = step(x8, nd.zeros((2,)))
     assert np.isfinite(float(loss.asscalar()))
+
+
+def test_image_record_iter_decode_runs_on_pool_threads(tmp_path):
+    """The decode work must execute ON the preprocess_threads pool (not
+    the producer thread), i.e. the architecture scales by adding pool
+    workers exactly like the reference's iter_image_recordio_2.cc:28-76
+    — on a multi-core host the pool IS the scaling mechanism (measured
+    by tools/io_thread_scaling.py)."""
+    import threading
+
+    from incubator_mxnet_tpu.io import record_iter as ri
+
+    prefix = _write_rec(tmp_path, n=24, hw=32)
+    seen = set()
+    orig = ri.ImageRecordIter._decode_one
+
+    def spy(self, *a, **k):
+        seen.add(threading.current_thread().name)
+        return orig(self, *a, **k)
+
+    ri.ImageRecordIter._decode_one = spy
+    try:
+        it = mio.ImageRecordIter(path_imgrec=prefix + ".rec",
+                                 path_imgidx=prefix + ".idx",
+                                 data_shape=(3, 32, 32), batch_size=8,
+                                 preprocess_threads=3, prefetch_buffer=2)
+        for _ in it:
+            pass
+    finally:
+        ri.ImageRecordIter._decode_one = orig
+    # every decode ran on a ThreadPoolExecutor worker; with >= 2 distinct
+    # workers observed the fan-out is real, not serialized on one thread
+    assert seen and all("ThreadPoolExecutor" in n for n in seen), seen
+    assert len(seen) >= 2, "decode never fanned out: %s" % seen
+
+
+def test_image_record_iter_per_image_decode_cost(tmp_path):
+    """Records the per-image decode+augment cost the thread-scaling
+    model divides by (PERF.md 'Recordio-fed training'): a regression
+    guard, not a benchmark — the bound is ~6x the measured 1.4 ms/img
+    to stay robust on loaded CI hosts."""
+    prefix = str(tmp_path / "jpg")
+    rec = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    rng = np.random.RandomState(0)
+    for i in range(96):
+        img = rng.randint(0, 255, (224, 224, 3), dtype=np.uint8)
+        rec.write_idx(i, pack_img(IRHeader(0, float(i % 10), i, 0), img,
+                                  quality=90, img_fmt=".jpg"))
+    rec.close()
+    it = mio.ImageRecordIter(path_imgrec=prefix + ".rec",
+                             path_imgidx=prefix + ".idx",
+                             data_shape=(3, 224, 224), batch_size=32,
+                             preprocess_threads=1, prefetch_buffer=2)
+    next(it)  # pipeline warm
+    best = float("inf")
+    t0 = time.perf_counter()
+    for b in it:
+        t1 = time.perf_counter()
+        best = min(best, (t1 - t0) / b.data[0].shape[0] * 1e3)
+        t0 = t1
+    # min over batches rejects transient load on shared CI hosts; the
+    # true cost is ~1.4 ms/img (PERF.md), bound leaves ~6x headroom
+    assert best < 9.0, "decode cost regressed: %.2f ms/img" % best

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Per-kernel DMA-efficiency benchmark for the fused ghost-BN kernels.
+
+For every ResNet-50 BN shape (batch 256) this measures, on the chip:
+
+* ``copy``   — a Pallas copy kernel using the SAME (L, A, B) view,
+  BlockSpec blocks and grid as the fused fwd kernel: the pure-DMA
+  ceiling for that plan.  If ``copy`` sustains ~roofline but ``fwd``
+  doesn't, compute (VPU) binds; if ``copy`` itself is slow, the window
+  DMA pattern binds (strided runs / padding) — this is the measurement
+  VERDICT r4 asked for ("prove which Mosaic limit binds").
+* ``fwd``    — fused stats+normalize+ReLU(+residual), one read of X.
+* ``bwd``    — fused reductions+dX, one read of (dY, X[, Y]).
+* ``xla``    — the plain-jnp ghost BN (XLA's own fusions) on the same
+  shape, fwd and fwd+bwd, for the end-to-end comparison.
+
+Prints one JSON line per measurement:
+``{"shape": ..., "which": ..., "ms": ..., "gbs": ..., "pct_peak": ...}``
+
+Reference bar: docs/PERF.md roofline (819 GB/s HBM peak on v5e);
+the round-4 kernels sustained ~55 % — the round-5 full-C blocks must
+show >= 85 % on ``copy`` for the fused path to be viable.
+"""
+import argparse
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from incubator_mxnet_tpu.parallel import fused_bn as fb
+
+HBM_PEAK_GBS = 819.0
+
+SHAPES = [
+    # (N, C, H, W) — every distinct BN shape in ResNet-50 v1 at batch 256
+    (256, 64, 112, 112),
+    (256, 64, 56, 56),
+    (256, 256, 56, 56),
+    (256, 128, 28, 28),
+    (256, 512, 28, 28),
+    (256, 256, 14, 14),
+    (256, 1024, 14, 14),
+    (256, 512, 7, 7),
+    (256, 2048, 7, 7),
+]
+
+
+def _time(fn, *args, iters=20, warmup=3):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.tree_util.tree_map(
+        lambda a: a.block_until_ready() if hasattr(a, "block_until_ready")
+        else a, out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.tree_util.tree_map(
+        lambda a: a.block_until_ready() if hasattr(a, "block_until_ready")
+        else a, out)
+    return (time.perf_counter() - t0) / iters * 1e3  # ms
+
+
+def _copy_kernel(x_ref, y_ref, *, lc):
+    l = x_ref.shape[0]
+    k = l // lc
+
+    def body(i, _):
+        sl = fb.pl.ds(i * jnp.int32(lc), lc)
+        y_ref[sl] = x_ref[sl]
+        return jnp.int32(0)
+    jax.lax.fori_loop(jnp.int32(0), jnp.int32(k), body, jnp.int32(0))
+
+
+def _call_copy(x_v, ab, ch_axis):
+    l = x_v.shape[0]
+    n = x_v.shape[1] if ch_axis == 2 else x_v.shape[2]
+    c = x_v.shape[2] if ch_axis == 2 else x_v.shape[1]
+    xspec, _, _, ngroups, _, _ = fb._specs(l, n, c, ab, ch_axis)
+    grid = (ngroups, c // (ab[1] if ch_axis == 2 else ab[0]))
+    lc = fb._chunk(l, *ab)
+    kern = functools.partial(_copy_kernel, lc=lc)
+    return fb.pl.pallas_call(
+        kern, grid=grid, in_specs=[xspec], out_specs=[xspec],
+        out_shape=[jax.ShapeDtypeStruct(x_v.shape, x_v.dtype)],
+        compiler_params=fb.pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel"),
+            vmem_limit_bytes=fb._VMEM_KERNEL_LIMIT),
+        interpret=fb._use_interpret())(x_v)[0]
+
+
+def bench_shape(n, c, h, w, dtype, residual, emit):
+    shape = "%dx%dx%dx%d%s" % (n, c, h, w, "+res" if residual else "")
+    itemsize = jnp.dtype(dtype).itemsize
+    tensor_gb = n * c * h * w * itemsize / 1e9
+    plan = fb._plan(n, c, h * w, itemsize, 0, residual)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.normal(size=(n, c, h, w)).astype(np.float32),
+                    dtype=dtype)
+    gamma = jnp.ones((c,), jnp.float32)
+    beta = jnp.zeros((c,), jnp.float32)
+    res = x * 0.5 if residual else None
+
+    def row(which, ms, nbytes_gb):
+        gbs = nbytes_gb / (ms / 1e3)
+        emit({"shape": shape, "dtype": str(dtype), "which": which,
+              "plan": None if plan is None else
+              {"ch_axis": plan[0], "ab": list(plan[1]),
+               "bwd_pallas": plan[2]},
+              "ms": round(ms, 3), "gbs": round(gbs, 1),
+              "pct_peak": round(100 * gbs / HBM_PEAK_GBS, 1)})
+
+    # XLA baseline (always runs)
+    ref = jax.jit(functools.partial(fb._gbn_ref, eps=1e-3, act="relu",
+                                    group=16))
+    ms = _time(ref, x, gamma, beta, res)
+    row("xla_fwd", ms, tensor_gb * (3 if residual else 2) + tensor_gb)
+
+    def loss(xx, rr):
+        y, _, _ = fb._gbn_ref(xx, gamma, beta, rr, 1e-3, "relu", 16)
+        return (y.astype(jnp.float32) ** 2).sum()
+    gref = jax.jit(jax.grad(loss, argnums=(0, 1) if residual else (0,)))
+    ms = _time(gref, x, res) if residual else _time(lambda a: gref(a, None),
+                                                    x)
+    row("xla_fwd_bwd", ms, tensor_gb * (8 if residual else 6))
+
+    if plan is None:
+        emit({"shape": shape, "which": "pallas", "plan": None,
+              "note": "jnp fallback (no feasible VMEM plan)"})
+        return
+    ch_axis, ab, bwd_pallas = plan
+
+    # pure-copy ceiling with the identical view/blocks/grid
+    x_v = fb._to_view(x, ch_axis)
+    cp = jax.jit(functools.partial(_call_copy, ab=ab, ch_axis=ch_axis))
+    ms = _time(cp, x_v)
+    row("copy", ms, 2 * tensor_gb)
+
+    # fused fwd
+    fwd = jax.jit(functools.partial(
+        fb._call_fwd, eps=1e-3, act="relu", ab=ab, ch_axis=ch_axis))
+    ms = _time(lambda a, r: fwd(a, gamma, beta, r), x_v,
+               None if res is None else fb._to_view(res, ch_axis))
+    row("fwd", ms, tensor_gb * (3 if residual else 2))
+
+    if bwd_pallas:
+        y_v, m, v = fwd(x_v, gamma, beta,
+                        None if res is None else fb._to_view(res, ch_axis))
+        gy_v = x_v * 0.1
+        bwd = jax.jit(functools.partial(
+            fb._call_bwd, eps=1e-3, act="relu", ab=ab, ch_axis=ch_axis))
+        ms = _time(lambda: bwd(gy_v, x_v, y_v if residual else None,
+                               gamma, beta, m, v))
+        row("bwd", ms, tensor_gb * (5 if residual else 4))
+    else:
+        emit({"shape": shape, "which": "bwd", "note": "jnp hybrid bwd"})
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--out", default=None, help="also append JSON here")
+    ap.add_argument("--residual", action="store_true",
+                    help="bench the residual variants too")
+    args = ap.parse_args()
+    sink = open(args.out, "a") if args.out else None
+
+    def emit(obj):
+        line = json.dumps(obj)
+        print(line, flush=True)
+        if sink:
+            sink.write(line + "\n")
+            sink.flush()
+
+    backend = jax.default_backend()
+    emit({"backend": backend, "note": "interpret mode (numbers are NOT "
+          "kernel perf)" if backend != "tpu" else "on-chip"})
+    dtype = jnp.dtype(args.dtype)
+    for (n, c, h, w) in SHAPES:
+        for residual in ([False, True] if args.residual else [False]):
+            if residual and c < 128:
+                continue
+            try:
+                bench_shape(n, c, h, w, dtype, residual, emit)
+            except Exception as e:  # keep the sweep going; record why
+                emit({"shape": "%dx%dx%dx%d" % (n, c, h, w),
+                      "residual": residual, "error": repr(e)[:300]})
+    if sink:
+        sink.close()
+
+
+if __name__ == "__main__":
+    main()

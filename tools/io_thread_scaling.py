@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""ImageRecordIter thread-scaling benchmark.
+
+Reference: ``src/io/iter_image_recordio_2.cc:28-76`` scales JPEG decode
+by ``preprocess_threads`` across host cores.  This tool measures img/s
+at several thread counts on THIS host and prints one JSON line per
+point.  On a 1-core VM the curve is flat (decode is CPU-bound and the
+GIL-released Pillow decode still shares one core) — run it on a
+multi-core TPU host to see the real slope; the per-core decode cost it
+prints is host-invariant and is the number PERF.md tracks.
+
+Usage: python tools/io_thread_scaling.py [--images 512] [--threads 1,2,4,8]
+"""
+import argparse
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+
+
+def synth_shard(path, n=512, size=224):
+    from incubator_mxnet_tpu.recordio import IRHeader, MXIndexedRecordIO, \
+        pack_img
+
+    rng = np.random.RandomState(0)
+    rec = MXIndexedRecordIO(path + ".idx", path + ".rec", "w")
+    for i in range(n):
+        img = rng.randint(0, 255, (size, size, 3), dtype=np.uint8)
+        rec.write_idx(i, pack_img(IRHeader(0, float(i % 10), i, 0), img,
+                                  quality=90))
+    rec.close()
+
+
+def bench(prefix, threads, batch=64, size=224):
+    from incubator_mxnet_tpu.io import ImageRecordIter
+
+    it = ImageRecordIter(path_imgrec=prefix + ".rec",
+                         path_imgidx=prefix + ".idx",
+                         data_shape=(3, size, size), batch_size=batch,
+                         shuffle=True, preprocess_threads=threads,
+                         prefetch_buffer=4)
+    n = 0
+    next(it)  # warm the pipeline
+    t0 = time.perf_counter()
+    for b in it:
+        n += b.data[0].shape[0]
+    dt = time.perf_counter() - t0
+    return n / dt, dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--images", type=int, default=512)
+    ap.add_argument("--threads", default="1,2,4,8")
+    args = ap.parse_args()
+    with tempfile.TemporaryDirectory() as td:
+        prefix = os.path.join(td, "shard")
+        synth_shard(prefix, n=args.images)
+        ncpu = os.cpu_count()
+        for t in [int(x) for x in args.threads.split(",")]:
+            img_s, dt = bench(prefix, t)
+            print(json.dumps({
+                "metric": "imagerecorditer_img_per_sec", "value":
+                round(img_s, 1), "unit": "img/s", "preprocess_threads": t,
+                "host_cores": ncpu,
+                "ms_per_img_per_core": round(1e3 * min(t, ncpu) / img_s,
+                                             3)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
