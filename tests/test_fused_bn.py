@@ -179,3 +179,40 @@ def test_ghost_bn_export_symbol_parity():
     out = sym.bind(mx.cpu(), args=binds, aux_states=aux) \
         .forward(is_train=False)[0].asnumpy()
     np.testing.assert_allclose(ref, out, rtol=1e-4, atol=1e-4)
+
+
+def test_ghost_bn_hybrid_bwd_matches_pallas_bwd(monkeypatch):
+    """The fwd-only hybrid (Pallas fwd + jnp bwd over the same ghost
+    groups) must produce the same gradients as the fully-fused path —
+    it is what stage-2/3 residual exits run at batch 256 when the bwd
+    windows bust the VMEM budget."""
+    from incubator_mxnet_tpu.parallel import fused_bn as fb
+
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.normal(size=(8, 256, 6, 6)).astype(np.float32))
+    res = jnp.asarray(rng.normal(size=(8, 256, 6, 6)).astype(np.float32))
+    gamma = jnp.asarray(rng.uniform(0.5, 1.5, 256).astype(np.float32))
+    beta = jnp.asarray(rng.normal(size=256).astype(np.float32) * 0.2)
+
+    def loss(x, gamma, beta, r):
+        y, _, _ = fb.ghost_bn_act(x, gamma, beta, residual=r, group=4)
+        return (y * jnp.cos(jnp.arange(y.size).reshape(y.shape))).sum()
+
+    full_plan = fb._plan(8, 256, 36, 4, 4, True)
+    assert full_plan is not None and full_plan[2], "precondition: full fuse"
+    g_full = jax.grad(loss, argnums=(0, 1, 2, 3))(x, gamma, beta, res)
+
+    # shrink the budget so exactly the bwd (5 windows) no longer fits:
+    # fwd needs 3*2*padded, bwd 5*2*padded
+    itemsize = 4
+    padded = 36 * fb._rup(4, fb._sublane(itemsize)) * fb._rup(256, 128) \
+        * itemsize
+    monkeypatch.setattr(fb, "_WINDOW_BUDGET", 4 * 2 * padded)
+    hybrid_plan = fb._plan(8, 256, 36, itemsize, 4, True)
+    assert hybrid_plan is not None and not hybrid_plan[2], \
+        "budget shrink must force the fwd-only hybrid, got %r" % (
+            hybrid_plan,)
+    g_hyb = jax.grad(loss, argnums=(0, 1, 2, 3))(x, gamma, beta, res)
+    for a, b in zip(g_full, g_hyb):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)

@@ -243,3 +243,67 @@ def test_forward_low_precision_sweep(dtype):
     assert len(passed) >= 250, (
         "only %d ops passed the %s sweep; skips: %s"
         % (len(passed), dtype, skipped[:20]))
+
+
+@pytest.mark.parametrize("name,arrays,attrs", [
+    ("Convolution",
+     [np.random.RandomState(0).rand(1, 2, 5, 5), np.random.RandomState(1)
+      .rand(3, 2, 3, 3), np.random.RandomState(2).rand(3)],
+     {"kernel": (3, 3), "num_filter": 3, "pad": (1, 1)}),
+    ("FullyConnected",
+     [np.random.RandomState(0).rand(2, 4), np.random.RandomState(1)
+      .rand(3, 4), np.random.RandomState(2).rand(3)],
+     {"num_hidden": 3}),
+    # BatchNorm normalizes in f32 internally, so FD needs a bigger eps
+    # to dodge cancellation (5e-3 tol ≈ f32 eps / 2e-3)
+    ("BatchNorm",
+     [np.random.RandomState(0).rand(4, 3, 2, 2) + 0.1,
+      np.random.RandomState(1).rand(3) + 0.5,
+      np.random.RandomState(2).rand(3), np.zeros(3), np.ones(3)],
+     {"fix_gamma": False, "_eps": 1e-3, "_tol": 5e-3}),
+    ("Pooling",
+     [np.random.RandomState(0).rand(1, 2, 6, 6)],
+     {"kernel": (2, 2), "stride": (2, 2), "pool_type": "avg"}),
+    ("dot",
+     [np.random.RandomState(0).rand(3, 4), np.random.RandomState(1)
+      .rand(4, 2)], {}),
+])
+def test_full_jacobian_small_shapes(name, arrays, attrs):
+    """FULL Jacobian oracle at small shapes for the core hot ops — every
+    entry of d out/d in against central finite differences (the
+    reference's check_numeric_gradient sweeps complete Jacobians for
+    small shapes, test_utils.py:981; the registry-wide sweep above only
+    checks one random direction per op)."""
+    op = registry.get_op(name)
+    attrs = dict(attrs)
+    eps = attrs.pop("_eps", 1e-5)
+    tol = attrs.pop("_tol", 2e-4)
+    xs = [jnp.asarray(np.asarray(a, np.float64)) for a in arrays]
+
+    def f0(*fx):
+        out = op.fn(*fx, **attrs)
+        out = out[0] if isinstance(out, (tuple, list)) else out
+        return out.astype(jnp.float64)
+
+    jac = jax.jacrev(f0, argnums=tuple(range(len(xs))))(*xs)
+    for k in range(len(xs)):
+        an = np.asarray(jac[k])          # (*out.shape, *xs[k].shape)
+        flat = np.asarray(xs[k], np.float64).ravel()
+        fd_cols = []
+        for j in range(flat.size):
+            hi, lo = flat.copy(), flat.copy()
+            hi[j] += eps
+            lo[j] -= eps
+            args_hi = list(xs)
+            args_lo = list(xs)
+            args_hi[k] = jnp.asarray(hi.reshape(xs[k].shape))
+            args_lo[k] = jnp.asarray(lo.reshape(xs[k].shape))
+            fd_cols.append((np.asarray(f0(*args_hi), np.float64)
+                            - np.asarray(f0(*args_lo), np.float64))
+                           / (2 * eps))
+        out_shape = fd_cols[0].shape
+        fd = np.stack(fd_cols, axis=-1).reshape(
+            out_shape + np.asarray(xs[k]).shape)
+        np.testing.assert_allclose(
+            an, fd, rtol=tol, atol=tol / 10,
+            err_msg="%s: full Jacobian wrt input %d" % (name, k))

@@ -24,7 +24,12 @@ show >= 85 % on ``copy`` for the fused path to be viable.
 import argparse
 import functools
 import json
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
 
 import jax
 import jax.numpy as jnp
@@ -159,12 +164,21 @@ def bench_shape(n, c, h, w, dtype, residual, emit):
 
 
 def main():
+    global SHAPES
     ap = argparse.ArgumentParser()
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--out", default=None, help="also append JSON here")
     ap.add_argument("--residual", action="store_true",
                     help="bench the residual variants too")
+    ap.add_argument("--self-test", action="store_true",
+                    help="tiny shapes in interpret mode — validates the "
+                         "plumbing without a chip (timings meaningless)")
     args = ap.parse_args()
+    if args.self_test:
+        SHAPES = [(8, 64, 6, 6), (8, 256, 6, 6)]
+        # never touch the (shared) chip in self-test: pin the cpu
+        # backend so _use_interpret() routes every kernel to interpret
+        jax.config.update("jax_platforms", "cpu")
     sink = open(args.out, "a") if args.out else None
 
     def emit(obj):

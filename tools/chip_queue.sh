@@ -17,11 +17,11 @@ timeout 1200 python tools/bn_kernel_bench.py --residual \
     --out bn_kernel_results.jsonl
 
 echo "-- 3. perf variant sweep (absorb proven wins into the default)"
-timeout 580 python bench.py --chunks 3 --no-config --s2d-stem \
+timeout 900 python bench.py --chunks 3 --no-config --s2d-stem \
     | tee /tmp/bench_s2d.txt
-timeout 580 python bench.py --chunks 3 --no-config --ghost-bn 16 \
+timeout 900 python bench.py --chunks 3 --no-config --ghost-bn 16 \
     | tee /tmp/bench_gbn.txt
-timeout 580 python bench.py --chunks 3 --no-config --s2d-stem --ghost-bn 16 \
+timeout 1200 python bench.py --chunks 3 --no-config --s2d-stem --ghost-bn 16 \
     | tee /tmp/bench_both.txt
 
 echo "-- 4. pick the measured winner -> bench_config.json"
