@@ -29,11 +29,15 @@ python - <<'EOF'
 import json
 
 def best(path, **flags):
+    # compare only batch-256 rows: bench.py falls back to smaller
+    # batches on OOM, and img/s across batches is not comparable
     v = 0.0
     try:
         for line in open(path):
             if line.startswith('{"metric"'):
-                v = max(v, json.loads(line).get("value", 0.0))
+                row = json.loads(line)
+                if row.get("batch") == 256:
+                    v = max(v, row.get("value", 0.0))
     except OSError:
         pass
     return v, flags
