@@ -1,0 +1,229 @@
+// C custom operator registered through the struct-of-callbacks protocol
+// (L7/L9 binding path) — the scenario the reference enables with
+// MXCustomOpRegister (include/mxnet/c_api.h:3029, callback structs
+// :153-206; dispatch src/operator/custom/custom.cc:70-119).
+//
+// Registers op "csquare" (y = x*x, dy/dx = 2*x*g) entirely in C — prop
+// creator, list/infer callbacks, operator creation, forward/backward —
+// then trains a tiny 1-parameter model through autograd so both
+// directions execute.  No Python in this source; linked against
+// ../src/native/libmxtpu_capi.so.
+//
+// Build & run:  make run-custom
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+typedef void* NDArrayHandle;
+
+extern "C" {
+struct MXCallbackList {
+  int num_callbacks;
+  int (**callbacks)(void);
+  void** contexts;
+};
+
+const char* MXGetLastError();
+int MXCustomOpRegister(const char* op_type,
+                       int (*creator)(const char*, const int, const char**,
+                                      const char**, MXCallbackList*));
+int MXNDArrayCreateEx(const uint32_t* shape, uint32_t ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle* out);
+int MXNDArrayFree(NDArrayHandle h);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle h, const void* data, size_t n);
+int MXNDArraySyncCopyToCPU(NDArrayHandle h, void* data, size_t n);
+int MXNDArrayGetShape(NDArrayHandle h, uint32_t* out_dim,
+                      const uint32_t** out_pdata);
+int MXImperativeInvokeByName(const char* op, int num_inputs,
+                             NDArrayHandle* inputs, int* num_outputs,
+                             NDArrayHandle** outputs, int num_params,
+                             const char** keys, const char** vals);
+int MXAutogradSetIsRecording(int is_recording, int* prev);
+int MXAutogradMarkVariables(uint32_t num_var, NDArrayHandle* var_handles,
+                            uint32_t* grad_reqs,
+                            NDArrayHandle* grad_handles);
+int MXAutogradBackward(uint32_t num_output, NDArrayHandle* output_handles,
+                       NDArrayHandle* ograd_handles, int retain_graph);
+}
+
+namespace {
+
+void Check(int rc, const char* what) {
+  if (rc != 0) {
+    std::fprintf(stderr, "FAIL %s: %s\n", what, MXGetLastError());
+    std::exit(1);
+  }
+}
+
+size_t NumElems(NDArrayHandle h) {
+  uint32_t ndim = 0;
+  const uint32_t* shape = nullptr;
+  Check(MXNDArrayGetShape(h, &ndim, &shape), "GetShape");
+  size_t n = 1;
+  for (uint32_t i = 0; i < ndim; ++i) n *= shape[i];
+  return n;
+}
+
+// ---- op callbacks (CustomOpCallbacks order: delete, forward, backward)
+
+int Forward(int size, void** ptrs, int* tags, const int* /*reqs*/,
+            const int /*is_train*/, void* /*state*/) {
+  NDArrayHandle in = nullptr, out = nullptr;
+  for (int i = 0; i < size; ++i) {
+    if (tags[i] == 0) in = ptrs[i];
+    if (tags[i] == 1) out = ptrs[i];
+  }
+  size_t n = NumElems(in);
+  std::vector<float> x(n);
+  Check(MXNDArraySyncCopyToCPU(in, x.data(), n), "fwd CopyToCPU");
+  for (float& v : x) v = v * v;
+  Check(MXNDArraySyncCopyFromCPU(out, x.data(), n), "fwd CopyFromCPU");
+  return 1;
+}
+
+int Backward(int size, void** ptrs, int* tags, const int* /*reqs*/,
+             const int /*is_train*/, void* /*state*/) {
+  // bwd tags: 3=out_grad, 0=in_data, 2=in_grad (custom.cc:373)
+  NDArrayHandle og = nullptr, in = nullptr, ig = nullptr;
+  for (int i = 0; i < size; ++i) {
+    if (tags[i] == 3) og = ptrs[i];
+    if (tags[i] == 0) in = ptrs[i];
+    if (tags[i] == 2) ig = ptrs[i];
+  }
+  size_t n = NumElems(in);
+  std::vector<float> x(n), g(n);
+  Check(MXNDArraySyncCopyToCPU(in, x.data(), n), "bwd CopyToCPU x");
+  Check(MXNDArraySyncCopyToCPU(og, g.data(), n), "bwd CopyToCPU g");
+  for (size_t i = 0; i < n; ++i) g[i] = 2.0f * x[i] * g[i];
+  Check(MXNDArraySyncCopyFromCPU(ig, g.data(), n), "bwd CopyFromCPU");
+  return 1;
+}
+
+typedef int (*RawFn)(void);
+
+int CreateOperator(const char* /*ctx*/, int /*num_inputs*/,
+                   unsigned** /*shapes*/, const int* /*ndims*/,
+                   const int* /*dtypes*/, MXCallbackList* ret,
+                   void* /*state*/) {
+  static RawFn cbs[3] = {nullptr, reinterpret_cast<RawFn>(Forward),
+                         reinterpret_cast<RawFn>(Backward)};
+  static void* ctxs[3] = {nullptr, nullptr, nullptr};
+  ret->num_callbacks = 3;
+  ret->callbacks = cbs;
+  ret->contexts = ctxs;
+  return 1;
+}
+
+// ---- prop callbacks
+
+int ListArgs(char*** out, void* /*state*/) {
+  static const char* names[] = {"data", nullptr};
+  *out = const_cast<char**>(names);
+  return 1;
+}
+
+int ListOuts(char*** out, void* /*state*/) {
+  static const char* names[] = {"output", nullptr};
+  *out = const_cast<char**>(names);
+  return 1;
+}
+
+int ListAux(char*** out, void* /*state*/) {
+  static const char* names[] = {nullptr};
+  *out = const_cast<char**>(names);
+  return 1;
+}
+
+int InferShape(int /*num_input*/, int* ndims, int** shapes,
+               void* /*state*/) {
+  ndims[1] = ndims[0];  // output shape := input shape
+  shapes[1] = shapes[0];
+  return 1;
+}
+
+int BwdDep(const int* out_grad, const int* in_data, const int* /*out*/,
+           int* num_deps, int** rdeps, void* /*state*/) {
+  static int deps[2];
+  deps[0] = out_grad[0];
+  deps[1] = in_data[0];
+  *num_deps = 2;
+  *rdeps = deps;
+  return 1;
+}
+
+int PropCreator(const char* /*op_type*/, const int /*num_kwargs*/,
+                const char** /*keys*/, const char** /*vals*/,
+                MXCallbackList* ret) {
+  static RawFn cbs[8] = {nullptr,  // PropDelete
+                         reinterpret_cast<RawFn>(ListArgs),
+                         reinterpret_cast<RawFn>(ListOuts),
+                         reinterpret_cast<RawFn>(ListAux),
+                         reinterpret_cast<RawFn>(InferShape),
+                         reinterpret_cast<RawFn>(BwdDep),
+                         reinterpret_cast<RawFn>(CreateOperator),
+                         nullptr};  // InferType (defaulted)
+  static void* ctxs[8] = {nullptr};
+  ret->num_callbacks = 8;
+  ret->callbacks = cbs;
+  ret->contexts = ctxs;
+  return 1;
+}
+
+NDArrayHandle MakeND(const std::vector<float>& v) {
+  NDArrayHandle h = nullptr;
+  uint32_t shape[1] = {static_cast<uint32_t>(v.size())};
+  Check(MXNDArrayCreateEx(shape, 1, 1, 0, 0, 0, &h), "CreateEx");
+  Check(MXNDArraySyncCopyFromCPU(h, v.data(), v.size()), "CopyFromCPU");
+  return h;
+}
+
+}  // namespace
+
+int main() {
+  Check(MXCustomOpRegister("csquare", PropCreator), "MXCustomOpRegister");
+
+  // forward+backward through autograd: y = csquare(x), dy/dx == 2x
+  NDArrayHandle x = MakeND({1.0f, 2.0f, 3.0f, 4.0f});
+  NDArrayHandle gx = MakeND({0.0f, 0.0f, 0.0f, 0.0f});
+  uint32_t req[1] = {1};  // write
+  NDArrayHandle vars[1] = {x};
+  NDArrayHandle grads[1] = {gx};
+  Check(MXAutogradMarkVariables(1, vars, req, grads), "MarkVariables");
+  int prev = 0;
+  Check(MXAutogradSetIsRecording(1, &prev), "SetIsRecording");
+
+  int n_out = 0;
+  NDArrayHandle* outs = nullptr;
+  const char* keys[] = {"op_type"};
+  const char* vals[] = {"csquare"};
+  Check(MXImperativeInvokeByName("Custom", 1, vars, &n_out, &outs, 1, keys,
+                                 vals),
+        "Invoke Custom");
+  if (n_out != 1) {
+    std::fprintf(stderr, "expected 1 output, got %d\n", n_out);
+    return 1;
+  }
+  Check(MXAutogradBackward(1, outs, nullptr, 0), "Backward");
+  Check(MXAutogradSetIsRecording(0, &prev), "StopRecording");
+
+  float y[4] = {0}, g[4] = {0};
+  Check(MXNDArraySyncCopyToCPU(outs[0], y, 4), "read y");
+  Check(MXNDArraySyncCopyToCPU(gx, g, 4), "read grad");
+  const float want_y[4] = {1, 4, 9, 16};
+  const float want_g[4] = {2, 4, 6, 8};
+  for (int i = 0; i < 4; ++i) {
+    if (std::fabs(y[i] - want_y[i]) > 1e-5f ||
+        std::fabs(g[i] - want_g[i]) > 1e-5f) {
+      std::fprintf(stderr, "MISMATCH at %d: y=%f g=%f\n", i, y[i], g[i]);
+      return 1;
+    }
+  }
+  std::printf("csquare C custom op: forward %g %g %g %g, grad %g %g %g %g\n",
+              y[0], y[1], y[2], y[3], g[0], g[1], g[2], g[3]);
+  std::printf("PASS\n");
+  return 0;
+}

@@ -1,5 +1,6 @@
 """L9 binding path: a pure C++ consumer of the C ABI (cpp-package/),
 equivalent to the reference's cpp-package + predict-cpp example."""
+import functools
 import os
 import shutil
 import subprocess
@@ -11,17 +12,27 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _DIR = os.path.join(_REPO, "cpp-package")
 
 
-@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
-def test_cpp_predict_demo_builds_and_serves(tmp_path):
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    site = subprocess.run(
+@functools.lru_cache(maxsize=1)
+def _site_packages():
+    return subprocess.run(
         [sys.executable, "-c",
          "import site;print(site.getsitepackages()[0])"],
         capture_output=True, text=True).stdout.strip()
-    env["PYTHONPATH"] = os.pathsep.join(
-        [_REPO, site, env.get("PYTHONPATH", "")])
 
+
+def _cpp_env():
+    """Environment for building/running the demos: cpu-pinned jax and a
+    PYTHONPATH that lets the embedded runtime find the package."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [_REPO, _site_packages(), env.get("PYTHONPATH", "")])
+    return env
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+def test_cpp_predict_demo_builds_and_serves(tmp_path):
+    env = _cpp_env()
     build = subprocess.run(["make", "predict_demo"], cwd=_DIR, env=env,
                            capture_output=True, text=True, timeout=300)
     assert build.returncode == 0, build.stderr[-2000:]
@@ -48,15 +59,7 @@ def test_cpp_train_demo_learns(tmp_path):
     """Full TRAINING through the C++ binding package: symbolic MLP built
     with Operator/Symbol, Executor fwd+bwd, Optimizer in-place updates —
     the cpp-package/example/mlp.cpp analog."""
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    site = subprocess.run(
-        [sys.executable, "-c",
-         "import site;print(site.getsitepackages()[0])"],
-        capture_output=True, text=True).stdout.strip()
-    env["PYTHONPATH"] = os.pathsep.join(
-        [_REPO, site, env.get("PYTHONPATH", "")])
-
+    env = _cpp_env()
     build = subprocess.run(["make", "train_demo"], cwd=_DIR, env=env,
                            capture_output=True, text=True, timeout=300)
     assert build.returncode == 0, build.stderr[-2000:]
@@ -66,3 +69,20 @@ def test_cpp_train_demo_learns(tmp_path):
                          text=True, timeout=600)
     assert run.returncode == 0, run.stdout + run.stderr[-2000:]
     assert "TRAIN_DEMO_OK" in run.stdout
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+def test_cpp_custom_op_demo():
+    """A custom operator defined ENTIRELY in C through the
+    MXCustomOpRegister struct protocol (c_api.h:3029, custom.cc:70-119):
+    prop creator + list/infer/create callbacks + fwd/bwd kernels, driven
+    through MXImperativeInvokeByName('Custom') and MXAutogradBackward."""
+    env = _cpp_env()
+    build = subprocess.run(["make", "custom_op_demo"], cwd=_DIR, env=env,
+                           capture_output=True, text=True, timeout=300)
+    assert build.returncode == 0, build.stderr[-2000:]
+    run = subprocess.run([os.path.join(_DIR, "custom_op_demo")], cwd=_DIR,
+                         env=env, capture_output=True, text=True,
+                         timeout=300)
+    assert run.returncode == 0, run.stdout + run.stderr[-2000:]
+    assert "PASS" in run.stdout

@@ -125,3 +125,61 @@ def test_bind_with_arrays():
     exe.backward(nd.array([1.0, 1.0]))
     np.testing.assert_allclose(exe.grad_dict["a"].asnumpy(), [3.0, 4.0])
     np.testing.assert_allclose(exe.grad_dict["b"].asnumpy(), [1.0, 2.0])
+
+
+def test_thread_local_scopes():
+    """Context default, AttrScope and NameManager are per-THREAD state
+    (reference tests/python/unittest/test_thread_local.py): a scope
+    entered on one thread must never leak into another."""
+    import threading
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.attribute import AttrScope
+    from incubator_mxnet_tpu.context import Context
+
+    # default context set on a worker thread doesn't leak to main
+    seen = []
+
+    def f():
+        Context._default_ctx.value = mx.cpu(7)
+        seen.append(mx.current_context().device_id)
+
+    t = threading.Thread(target=f)
+    t.start()
+    t.join()
+    assert seen == [7]
+    assert mx.current_context().device_id != 7
+
+    # AttrScope entered on a worker thread stays on that thread
+    attrs = {}
+
+    def g():
+        with AttrScope(group="worker"):
+            s = mx.sym.var("wv")
+            attrs["worker"] = s.attr("group")
+
+    with AttrScope(group="main"):
+        t = threading.Thread(target=g)
+        t.start()
+        t.join()
+        attrs["main"] = mx.sym.var("mv").attr("group")
+    assert attrs == {"worker": "worker", "main": "main"}
+
+    # NameManager counters are independent per thread: two FRESH worker
+    # threads must generate the identical first auto-name (a shared
+    # counter would give the second worker a later sequence number),
+    # and the main thread's own counter advances independently
+    names = []
+
+    def h():
+        names.append(mx.sym.relu(mx.sym.var("a")).name)
+
+    main_first = mx.sym.relu(mx.sym.var("a")).name
+    for _ in range(2):
+        t = threading.Thread(target=h)
+        t.start()
+        t.join()
+    main_second = mx.sym.relu(mx.sym.var("a")).name
+    assert main_first != main_second, "main-thread counter must advance"
+    assert names[0] == names[1], \
+        "fresh worker threads must start fresh counters: %s" % names

@@ -28,18 +28,22 @@ echo "-- 4. pick the measured winner -> bench_config.json"
 python - <<'EOF'
 import json
 
-def best(path, **flags):
-    # compare only batch-256 rows: bench.py falls back to smaller
-    # batches on OOM, and img/s across batches is not comparable
-    v = 0.0
+def rows(path):
     try:
-        for line in open(path):
-            if line.startswith('{"metric"'):
-                row = json.loads(line)
-                if row.get("batch") == 256:
-                    v = max(v, row.get("value", 0.0))
+        return [json.loads(l) for l in open(path)
+                if l.startswith('{"metric"')]
     except OSError:
-        pass
+        return []
+
+# img/s across batches is not comparable (bench.py falls back
+# 256->128->... on OOM), so compare at the batch the STOCK run actually
+# achieved — same-batch guarantee without a hard 256 dependency
+stock_rows = rows("/tmp/bench_stock.txt")
+ref_batch = max((r.get("batch", 0) for r in stock_rows), default=256)
+
+def best(path, **flags):
+    v = max((r.get("value", 0.0) for r in rows(path)
+             if r.get("batch") == ref_batch), default=0.0)
     return v, flags
 
 runs = [
@@ -66,7 +70,7 @@ echo "-- 6. int8 inference through the wire"
 timeout 580 python bench.py --mode infer-int8
 
 echo "-- 7. TPU consistency gate (375-op sweep + int8-wire resnet)"
-timeout 1500 python -m pytest tests/ -m tpu -q
+timeout 2700 python -m pytest tests/ -m tpu -q
 
 echo "-- 8. recordio-fed training (host-core bound on 1-vCPU driver)"
 timeout 580 python bench.py --data recordio --record-format .npy --chunks 3
