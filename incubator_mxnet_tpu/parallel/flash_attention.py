@@ -333,7 +333,7 @@ def _make_attn(scale, causal, block_q, block_k, interpret):
 
 
 def flash_attention(q, k, v, causal=False, scale: Optional[float] = None,
-                    block_q=128, block_k=128, interpret=None,
+                    block_q=None, block_k=None, interpret=None,
                     use_pallas=None):
     """Flash attention over (B, H, S, D) tensors.
 
@@ -365,6 +365,14 @@ def flash_attention(q, k, v, causal=False, scale: Optional[float] = None,
             is_causal=bool(causal))
         return out.transpose(0, 2, 1, 3)
 
+    if block_q is None or block_k is None:
+        # defaults: 128x128; at long sequence bigger tiles amortize grid
+        # overhead and keep the MXU on larger products.  Explicit
+        # block_q/block_k always win (bench.py sweeps them).  _fit_block
+        # still clamps to divisors of the actual lengths.
+        bq_d, bk_d = ((256, 512) if sk >= 4096 else (128, 128))
+        block_q = bq_d if block_q is None else block_q
+        block_k = bk_d if block_k is None else block_k
     qf = q.reshape(b * h, s, d)
     kf = k.reshape(b * h, sk, d)
     vf = v.reshape(b * h, sk, d)
@@ -378,9 +386,11 @@ from ..ops.registry import register as _register_op  # noqa: E402
 
 
 @_register_op("_contrib_flash_attention", num_inputs=3)
-def _flash_attention_op(q, k, v, causal=False, scale=None, block_q=128,
-                        block_k=128):
+def _flash_attention_op(q, k, v, causal=False, scale=None, block_q=None,
+                        block_k=None):
     """Fused attention op (the TPU answer to
     _contrib_interleaved_matmul_selfatt_* in transformer.cc)."""
-    return flash_attention(q, k, v, causal=bool(causal), scale=scale,
-                           block_q=int(block_q), block_k=int(block_k))
+    return flash_attention(
+        q, k, v, causal=bool(causal), scale=scale,
+        block_q=None if block_q is None else int(block_q),
+        block_k=None if block_k is None else int(block_k))

@@ -127,3 +127,33 @@ def test_flash_causal_empty_rows():
         assert np.isfinite(np.asarray(g)).all()
     # empty rows contribute nothing to dq
     np.testing.assert_allclose(np.asarray(dq[:, :, :4]), 0.0, atol=1e-6)
+
+
+def test_flash_attention_long_seq_block_heuristic(monkeypatch):
+    """seq >= 4096 auto-selects 256x512 blocks on the Pallas path when
+    the caller leaves block sizes unset; explicit sizes always win; the
+    tiling change never changes semantics."""
+    import importlib
+
+    fa = importlib.import_module(
+        "incubator_mxnet_tpu.parallel.flash_attention")
+    picked = []
+    orig = fa._make_attn
+
+    def spy(scale, causal, block_q, block_k, interpret):
+        picked.append((block_q, block_k))
+        return orig(scale, causal, block_q, block_k, interpret)
+
+    monkeypatch.setattr(fa, "_make_attn", spy)
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 1, 4096, 8))
+                           .astype(np.float32)) * 0.1 for _ in range(3))
+    out = fa.flash_attention(q, k, v, causal=True, use_pallas=True)
+    assert picked[-1] == (256, 512), picked
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+    # explicit block sizes are never overridden (bench.py sweeps them)
+    fa.flash_attention(q, k, v, causal=True, use_pallas=True,
+                       block_q=128, block_k=128)
+    assert picked[-1] == (128, 128), picked
