@@ -92,11 +92,22 @@ def _sublane(itemsize):
 
 
 def _chunk(l, a, b, budget=1536 * 1024):
-    lc = max(1, budget // (a * b * 4))
-    lc = min(lc, l)
-    while l % lc:
-        lc -= 1
-    return lc
+    """Largest divisor of L within the f32-temp budget; a slightly
+    over-budget divisor beats degenerating to many 1-row loop iterations
+    (L=49 at the 7x7 stages has divisors {1,7,49} only).  The bwd kernel
+    keeps ~3 chunk-sized f32 temps live at once, so the over-budget
+    stretch is capped at 2x (3 x 3 MB = 9 MB, under the ~16 MB scoped-
+    VMEM stack limit); when even 2x can't reach a divisor (tiny caps
+    from very large A*B blocks) the degenerate small chunk stands —
+    slow-ish but VMEM-safe."""
+    cap = max(1, min(budget // (a * b * 4), l))
+    divs = [d for d in range(1, l + 1) if l % d == 0]
+    best = max((d for d in divs if d <= cap), default=1)
+    if best * 2 <= cap:
+        over = [d for d in divs if cap < d <= 2 * cap]
+        if over:
+            return min(over)
+    return best
 
 
 def _bshape(vec, ch_axis):
