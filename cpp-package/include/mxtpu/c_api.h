@@ -292,6 +292,15 @@ int MXCustomFunctionRecord(int num_inputs, NDArrayHandle* inputs,
                            int num_outputs, NDArrayHandle* outputs,
                            struct MXCallbackList* callbacks);
 
+/* Test hooks (reference include/mxnet/c_api_test.h) --------------------- */
+int MXBuildSubgraphByOpNames(SymbolHandle sym, const char* prop_name,
+                             const uint32_t num_ops, const char** op_names,
+                             SymbolHandle* ret);
+int MXSetSubgraphPropertyOpNames(const char* prop_name,
+                                 const uint32_t num_ops,
+                                 const char** op_names);
+int MXRemoveSubgraphPropertyOpNames(const char* prop_name);
+
 /* Misc runtime ---------------------------------------------------------- */
 int MXRandomSeed(int seed);
 int MXEngineWaitAll(void);

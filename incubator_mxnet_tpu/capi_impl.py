@@ -1705,3 +1705,21 @@ def custom_function_record(inputs, outputs, fn_capsule, trampoline) -> None:
 
     node = ag.TapeNode(vjp_fn, ins, outs, name="_CustomFunction")
     ag.attach_node(outs, node)
+
+
+# -- c_api_test.h hooks ------------------------------------------------------
+
+def build_subgraph_by_op_names(sym, prop_name: str, op_names):
+    from . import subgraph
+    return subgraph.build_subgraph_by_op_names(sym, prop_name,
+                                               list(op_names))
+
+
+def set_subgraph_property_op_names(prop_name: str, op_names) -> None:
+    from . import subgraph
+    subgraph.set_property_op_names(prop_name, list(op_names))
+
+
+def remove_subgraph_property_op_names(prop_name: str) -> None:
+    from . import subgraph
+    subgraph.remove_property_op_names(prop_name)

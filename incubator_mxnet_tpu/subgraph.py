@@ -234,13 +234,11 @@ def build_subgraph(symbol: Symbol, prop: SubgraphProperty) -> Symbol:
 # built-in backends
 # ---------------------------------------------------------------------------
 
-class _XlaSelector(SubgraphSelector):
-    """Capture every traceable registered op; leave unknown/custom nodes
-    outside (they run eagerly between fused programs)."""
+class _PredicateSelector(SubgraphSelector):
+    """Uniform-predicate selector: a node joins the group iff ``_ok``."""
 
     def _ok(self, node: _Node) -> bool:
-        op = _reg.OPS.get(node.op)
-        return op is not None and not getattr(op, "no_trace", False)
+        raise NotImplementedError
 
     def select(self, node):
         return self._ok(node)
@@ -250,6 +248,15 @@ class _XlaSelector(SubgraphSelector):
 
     def select_output(self, cur, output_node):
         return self._ok(output_node)
+
+
+class _XlaSelector(_PredicateSelector):
+    """Capture every traceable registered op; leave unknown/custom nodes
+    outside (they run eagerly between fused programs)."""
+
+    def _ok(self, node: _Node) -> bool:
+        op = _reg.OPS.get(node.op)
+        return op is not None and not getattr(op, "no_trace", False)
 
 
 @register_subgraph_backend
@@ -268,3 +275,55 @@ def partition(symbol: Symbol, backend: Optional[str] = None) -> Symbol:
     if not backend:
         return symbol
     return build_subgraph(symbol, get_subgraph_backend(backend))
+
+
+# ---------------------------------------------------------------------------
+# test hooks (include/mxnet/c_api_test.h): partition purely by op names
+# ---------------------------------------------------------------------------
+
+# prop-name -> op-name set overriding a property's own selection
+# (SubgraphPropertyOpNameSet in the reference's c_api_test.cc)
+_PROPERTY_OP_NAMES: Dict[str, set] = {}
+
+
+class _OpNameSelector(_PredicateSelector):
+    """Groups maximal connected regions of nodes whose op name is in the
+    given set (the DefaultSubgraphProperty the reference attaches for
+    MXBuildSubgraphByOpNames)."""
+
+    def __init__(self, names):
+        self._names = set(names)
+
+    def _ok(self, node):
+        return (not node.is_var) and node.op in self._names
+
+
+class _OpNameProperty(SubgraphProperty):
+    def __init__(self, prop_name, names):
+        self.name = prop_name
+        self._names = names
+
+    def create_subgraph_selector(self):
+        return _OpNameSelector(self._names)
+
+
+def set_property_op_names(prop_name: str, op_names) -> None:
+    """MXSetSubgraphPropertyOpNames: override the op set the named
+    property selects (testing hook)."""
+    _PROPERTY_OP_NAMES[str(prop_name)] = set(op_names)
+
+
+def remove_property_op_names(prop_name: str) -> None:
+    """MXRemoveSubgraphPropertyOpNames."""
+    _PROPERTY_OP_NAMES.pop(str(prop_name), None)
+
+
+def build_subgraph_by_op_names(symbol: Symbol, prop_name: str,
+                               op_names) -> Symbol:
+    """MXBuildSubgraphByOpNames: partition grouping exactly the listed
+    ops (or the registered override for ``prop_name``, if any) into
+    subgraph super-ops."""
+    names = _PROPERTY_OP_NAMES.get(str(prop_name))
+    if names is None:  # an EMPTY override means "select nothing"
+        names = set(op_names)
+    return build_subgraph(symbol, _OpNameProperty(str(prop_name), names))

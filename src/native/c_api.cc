@@ -5157,3 +5157,52 @@ MXTPU_API int MXCustomFunctionRecord(int num_inputs, NDArrayHandle* inputs,
   Py_DECREF(res);
   return 0;
 }
+
+// ---------------------------------------------------------------------------
+// Test hooks (include/mxnet/c_api_test.h): op-name-driven partitioning
+// ---------------------------------------------------------------------------
+
+MXTPU_API int MXBuildSubgraphByOpNames(SymbolHandle sym,
+                                       const char* prop_name,
+                                       const uint32_t num_ops,
+                                       const char** op_names,
+                                       SymbolHandle* ret) {
+  Gil gil;
+  PyObject* names = PyList_New(num_ops);
+  for (uint32_t i = 0; i < num_ops; ++i) {
+    PyList_SetItem(names, i, PyUnicode_FromString(op_names[i]));
+  }
+  PyObject* args = Py_BuildValue("(OsN)", static_cast<PyObject*>(sym),
+                                 prop_name, names);
+  PyObject* res = CallImpl("build_subgraph_by_op_names", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  *ret = res;
+  return 0;
+}
+
+MXTPU_API int MXSetSubgraphPropertyOpNames(const char* prop_name,
+                                           const uint32_t num_ops,
+                                           const char** op_names) {
+  Gil gil;
+  PyObject* names = PyList_New(num_ops);
+  for (uint32_t i = 0; i < num_ops; ++i) {
+    PyList_SetItem(names, i, PyUnicode_FromString(op_names[i]));
+  }
+  PyObject* args = Py_BuildValue("(sN)", prop_name, names);
+  PyObject* res = CallImpl("set_subgraph_property_op_names", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}
+
+MXTPU_API int MXRemoveSubgraphPropertyOpNames(const char* prop_name) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", prop_name);
+  PyObject* res = CallImpl("remove_subgraph_property_op_names", args);
+  Py_DECREF(args);
+  if (res == nullptr) return FailFromPython();
+  Py_DECREF(res);
+  return 0;
+}

@@ -1559,3 +1559,39 @@ def test_custom_function_record_abi(lib):
         y.backward(nd.ones_like(y))
     np.testing.assert_allclose(x.grad.asnumpy(),
                                np.full(4, 3.0, np.float32))
+
+
+def test_subgraph_test_hooks_abi(lib):
+    """c_api_test.h: MXBuildSubgraphByOpNames partitions by the given op
+    list; Set/RemoveSubgraphPropertyOpNames override a property's op set
+    (SubgraphPropertyOpNameSet semantics)."""
+    import incubator_mxnet_tpu.symbol as sym
+
+    s = sym.sin(sym.exp(sym.var("data")) + sym.var("b"))
+    h = ctypes.c_void_p()
+    _check(lib, lib.MXSymbolCreateFromJSON(s.tojson().encode(),
+                                           ctypes.byref(h)))
+    names = (ctypes.c_char_p * 2)(b"exp", b"elemwise_add")
+    out = ctypes.c_void_p()
+    _check(lib, lib.MXBuildSubgraphByOpNames(h, b"testprop", 2, names,
+                                             ctypes.byref(out)))
+    js = ctypes.c_char_p()
+    _check(lib, lib.MXSymbolSaveToJSON(out, ctypes.byref(js)))
+    first = bytes(js.value)  # SaveToJSON reuses a thread-local buffer
+    assert b"subgraph" in first, first
+
+    # the override hook replaces the op set for that property name
+    only_sin = (ctypes.c_char_p * 1)(b"sin",)
+    _check(lib, lib.MXSetSubgraphPropertyOpNames(b"testprop", 1, only_sin))
+    out2 = ctypes.c_void_p()
+    _check(lib, lib.MXBuildSubgraphByOpNames(h, b"testprop", 2, names,
+                                             ctypes.byref(out2)))
+    js2 = ctypes.c_char_p()
+    _check(lib, lib.MXSymbolSaveToJSON(out2, ctypes.byref(js2)))
+    second = bytes(js2.value)
+    _check(lib, lib.MXRemoveSubgraphPropertyOpNames(b"testprop"))
+    assert second != first  # different partitioning under the override
+    # sin is a TOP-LEVEL node in the first partition but moves inside
+    # the subgraph (escaped, embedded JSON) under the {"sin"} override
+    assert b'"op": "sin"' in first
+    assert b'"op": "sin"' not in second
