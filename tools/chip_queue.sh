@@ -66,7 +66,10 @@ EOF
 echo "-- 5. headline with the absorbed config (this is BENCH_r05's config)"
 timeout 580 python bench.py --chunks 3
 
-echo "-- 6. int8 inference through the wire"
+echo "-- 6. inference (bf16 batch-128 vs the V100 fp16 BASELINE row)"
+timeout 580 python bench.py --mode infer
+
+echo "-- 6b. int8 inference through the wire"
 timeout 580 python bench.py --mode infer-int8
 
 echo "-- 7. TPU consistency gate (375-op sweep + int8-wire resnet)"
