@@ -1,0 +1,179 @@
+/* XS glue for AI::MXNetTPU — binds the MXNet-compatible C ABI exported
+ * by src/native/libmxtpu_capi.so (reference analog: perl-package/
+ * AI-MXNetCAPI, the SWIG layer under AI::MXNet).  Only the core NDArray
+ * + imperative-invoke surface is wrapped; everything else composes from
+ * it in pure Perl, like the reference's AI::MXNet does over its CAPI.
+ */
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+/* the real ABI contract — signature drift in c_api.cc/c_api.h breaks
+ * this shim at COMPILE time instead of corrupting arguments */
+#include <mxtpu/c_api.h>
+
+/* ByName extension exported by the .so but not in the public header */
+extern int MXImperativeInvokeByName(const char* op, int num_inputs,
+                                    NDArrayHandle* inputs, int* num_outputs,
+                                    NDArrayHandle** outputs, int num_params,
+                                    const char** keys, const char** vals);
+
+static void croak_last(const char* what) {
+    croak("%s failed: %s", what, MXGetLastError());
+}
+
+static size_t nd_size(NDArrayHandle h) {
+    uint32_t ndim = 0;
+    const uint32_t* shape = NULL;
+    if (MXNDArrayGetShape(h, &ndim, &shape) != 0) {
+        croak_last("MXNDArrayGetShape");
+    }
+    size_t n = 1;
+    uint32_t i;
+    for (i = 0; i < ndim; ++i) n *= shape[i];
+    return n;
+}
+
+MODULE = AI::MXNetTPU    PACKAGE = AI::MXNetTPU
+
+PROTOTYPES: DISABLE
+
+BOOT:
+    /* perl dlopens this module RTLD_LOCAL, so the embedded runtime's
+     * libpython symbols would be invisible to Python's own C extension
+     * modules (undefined symbol: PyExc_*); promote them to global
+     * before the first C-ABI call initializes the interpreter.
+     * MXTPU_LIBPYTHON is derived by Makefile.PL from the python that
+     * built libmxtpu_capi.so. */
+#ifndef MXTPU_LIBPYTHON
+#define MXTPU_LIBPYTHON "libpython3.12.so.1.0"
+#endif
+    if (dlopen(MXTPU_LIBPYTHON, RTLD_NOW | RTLD_GLOBAL) == NULL
+        && dlopen("libpython3.so", RTLD_NOW | RTLD_GLOBAL) == NULL) {
+        warn("AI::MXNetTPU: could not promote %s to RTLD_GLOBAL (%s); "
+             "the embedded runtime's C extensions may fail to import",
+             MXTPU_LIBPYTHON, dlerror());
+    }
+
+int
+_version()
+  CODE:
+    int v = 0;
+    if (MXGetVersion(&v) != 0) croak_last("MXGetVersion");
+    RETVAL = v;
+  OUTPUT:
+    RETVAL
+
+void
+_seed(int s)
+  CODE:
+    if (MXRandomSeed(s) != 0) croak_last("MXRandomSeed");
+
+IV
+_nd_from_perl(AV* data, AV* shape)
+  CODE:
+    uint32_t ndim = (uint32_t)(av_len(shape) + 1);
+    uint32_t dims[16];
+    size_t n = 1;
+    uint32_t i;
+    if (ndim == 0 || ndim > 16) croak("bad ndim %u", (unsigned)ndim);
+    for (i = 0; i < ndim; ++i) {
+        SV** e = av_fetch(shape, i, 0);
+        dims[i] = (uint32_t)SvIV(*e);
+        n *= dims[i];
+    }
+    if ((size_t)(av_len(data) + 1) != n) {
+        croak("data length %ld != shape product %lu",
+              (long)(av_len(data) + 1), (unsigned long)n);
+    }
+    NDArrayHandle h = NULL;
+    if (MXNDArrayCreateEx(dims, ndim, 1, 0, 0, 0, &h) != 0) {
+        croak_last("MXNDArrayCreateEx");
+    }
+    float* buf = (float*)malloc(n * sizeof(float));
+    size_t j;
+    for (j = 0; j < n; ++j) {
+        SV** e = av_fetch(data, j, 0);
+        buf[j] = (float)SvNV(*e);
+    }
+    int rc = MXNDArraySyncCopyFromCPU(h, buf, n);
+    free(buf);
+    if (rc != 0) croak_last("MXNDArraySyncCopyFromCPU");
+    RETVAL = PTR2IV(h);
+  OUTPUT:
+    RETVAL
+
+void
+_nd_free(IV h)
+  CODE:
+    MXNDArrayFree(INT2PTR(NDArrayHandle, h));
+
+AV*
+_nd_shape(IV h)
+  CODE:
+    uint32_t ndim = 0;
+    const uint32_t* shape = NULL;
+    if (MXNDArrayGetShape(INT2PTR(NDArrayHandle, h), &ndim, &shape) != 0) {
+        croak_last("MXNDArrayGetShape");
+    }
+    AV* out = newAV();
+    uint32_t i;
+    for (i = 0; i < ndim; ++i) av_push(out, newSViv(shape[i]));
+    RETVAL = out;
+    sv_2mortal((SV*)RETVAL);
+  OUTPUT:
+    RETVAL
+
+AV*
+_nd_to_list(IV h)
+  CODE:
+    NDArrayHandle nd = INT2PTR(NDArrayHandle, h);
+    size_t n = nd_size(nd);
+    float* buf = (float*)malloc(n * sizeof(float));
+    if (MXNDArraySyncCopyToCPU(nd, buf, n) != 0) {
+        free(buf);
+        croak_last("MXNDArraySyncCopyToCPU");
+    }
+    AV* out = newAV();
+    size_t j;
+    for (j = 0; j < n; ++j) av_push(out, newSVnv(buf[j]));
+    free(buf);
+    RETVAL = out;
+    sv_2mortal((SV*)RETVAL);
+  OUTPUT:
+    RETVAL
+
+AV*
+_invoke(const char* op, AV* handles, AV* keys, AV* vals)
+  CODE:
+    int nin = (int)(av_len(handles) + 1);
+    int nparam = (int)(av_len(keys) + 1);
+    NDArrayHandle ins[64];
+    const char* ks[64];
+    const char* vs[64];
+    int i;
+    if (nin > 64 || nparam > 64) croak("too many inputs/params");
+    for (i = 0; i < nin; ++i) {
+        ins[i] = INT2PTR(NDArrayHandle, SvIV(*av_fetch(handles, i, 0)));
+    }
+    for (i = 0; i < nparam; ++i) {
+        ks[i] = SvPV_nolen(*av_fetch(keys, i, 0));
+        vs[i] = SvPV_nolen(*av_fetch(vals, i, 0));
+    }
+    int nout = 0;
+    NDArrayHandle* outs = NULL;
+    if (MXImperativeInvokeByName(op, nin, ins, &nout, &outs, nparam, ks,
+                                 vs) != 0) {
+        croak_last(op);
+    }
+    AV* out = newAV();
+    for (i = 0; i < nout; ++i) av_push(out, newSViv(PTR2IV(outs[i])));
+    RETVAL = out;
+    sv_2mortal((SV*)RETVAL);
+  OUTPUT:
+    RETVAL

@@ -1,0 +1,48 @@
+package AI::MXNetTPU;
+# Perl binding for the TPU-native MXNet-compatible framework, over the
+# C ABI in src/native/libmxtpu_capi.so.
+#
+# Reference analog: perl-package/AI-MXNet (the AI::MXNet distribution) —
+# this is the same layering at minimal scale: an XS CAPI shim
+# (AI-MXNetCAPI analog, MXNetTPU.xs) plus a pure-Perl NDArray class that
+# drives every operator through MXImperativeInvokeByName, exactly how
+# AI::MXNet::NDArray dispatches through the generated CAPI stubs.
+#
+# Runtime requirements (same as the cpp-package demos): the shared
+# library embeds the Python/JAX runtime, so PYTHONPATH must include the
+# repo root and site-packages, and JAX_PLATFORMS=cpu pins the backend.
+use strict;
+use warnings;
+
+our $VERSION = '0.01';
+
+require XSLoader;
+XSLoader::load('AI::MXNetTPU', $VERSION);
+
+use AI::MXNetTPU::NDArray;
+
+sub version { return _version(); }
+sub seed    { my ($s) = @_; _seed($s); }
+
+# nd factory namespace, AI::MXNet style: AI::MXNetTPU->nd_array(...)
+sub nd_array {
+    my ($class, $data, $shape) = @_;
+    return AI::MXNetTPU::NDArray->array($data, $shape);
+}
+
+1;
+__END__
+
+=head1 NAME
+
+AI::MXNetTPU - Perl interface to the TPU-native MXNet-compatible runtime
+
+=head1 SYNOPSIS
+
+  use AI::MXNetTPU;
+  my $a = AI::MXNetTPU::NDArray->array([1, 2, 3, 4], [2, 2]);
+  my $b = $a->add($a);            # any registered operator by name
+  my $c = $a->invoke('dot', $b);  # 390-op registry via imperative invoke
+  print join(',', @{ $c->aslist }), "\n";
+
+=cut

@@ -1,0 +1,88 @@
+package AI::MXNetTPU::NDArray;
+# NDArray over C-ABI handles (reference analog: AI::MXNet::NDArray,
+# perl-package/AI-MXNet/lib/AI/MXNet/NDArray.pm — same design: a blessed
+# handle wrapper whose every operator call goes through the imperative
+# C entry point).
+use strict;
+use warnings;
+use overload
+    '+' => sub { $_[0]->add($_[1]) },
+    '-' => sub { my ($a, $b, $swap) = @_;
+                 return $a->invoke('_rminus_scalar', scalar => $b)
+                     if $swap && !ref $b;
+                 $swap ? $b->sub_($a) : $a->sub_($b) },
+    '*' => sub { $_[0]->mul($_[1]) },
+    '""' => sub { 'NDArray(' . join('x', @{ $_[0]->shape }) . ')' };
+
+sub _wrap {
+    my ($class, $handle) = @_;
+    return bless { handle => $handle }, $class;
+}
+
+sub array {
+    my ($class, $data, $shape) = @_;
+    $shape ||= [scalar @$data];
+    my $h = AI::MXNetTPU::_nd_from_perl($data, $shape);
+    return $class->_wrap($h);
+}
+
+sub handle { return $_[0]->{handle} }
+
+sub shape { return AI::MXNetTPU::_nd_shape($_[0]->{handle}) }
+
+sub aslist { return AI::MXNetTPU::_nd_to_list($_[0]->{handle}) }
+
+sub asscalar {
+    my ($self) = @_;
+    my $l = $self->aslist;
+    die "asscalar on size-" . scalar(@$l) . " array" unless @$l == 1;
+    return $l->[0];
+}
+
+# generic operator dispatch: every one of the registry's ops is
+# reachable by name, attrs passed as key => value string pairs
+sub invoke {
+    my ($self, $op, @rest) = @_;
+    my (@handles, @keys, @vals);
+    push @handles, $self->{handle};
+    while (@rest && ref($rest[0])) {
+        push @handles, shift(@rest)->{handle};
+    }
+    while (@rest) {
+        push @keys, shift @rest;
+        push @vals, '' . shift @rest;
+    }
+    my $outs = AI::MXNetTPU::_invoke($op, \@handles, \@keys, \@vals);
+    my @wrapped = map { __PACKAGE__->_wrap($_) } @$outs;
+    return wantarray ? @wrapped : $wrapped[0];
+}
+
+# scalar operands promote to the *_scalar ops, AI::MXNet::NDArray style
+sub add {
+    my ($self, $o) = @_;
+    return ref $o ? $self->invoke('elemwise_add', $o)
+                  : $self->invoke('_plus_scalar', scalar => $o);
+}
+
+sub sub_ {
+    my ($self, $o) = @_;
+    return ref $o ? $self->invoke('elemwise_sub', $o)
+                  : $self->invoke('_minus_scalar', scalar => $o);
+}
+
+sub mul {
+    my ($self, $o) = @_;
+    return ref $o ? $self->invoke('elemwise_mul', $o)
+                  : $self->invoke('_mul_scalar', scalar => $o);
+}
+
+sub dot  { return $_[0]->invoke('dot', $_[1]) }
+sub relu { return $_[0]->invoke('relu') }
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::_nd_free($self->{handle}) if defined $self->{handle};
+    $self->{handle} = undef;
+}
+
+1;

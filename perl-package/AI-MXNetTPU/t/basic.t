@@ -1,0 +1,35 @@
+use strict;
+use warnings;
+use Test::More tests => 10;
+use AI::MXNetTPU;
+
+ok(AI::MXNetTPU::version() >= 10000, 'MXGetVersion');
+AI::MXNetTPU::seed(7);
+
+my $a = AI::MXNetTPU::NDArray->array([1, 2, 3, 4], [2, 2]);
+is_deeply($a->shape, [2, 2], 'shape round trip');
+is_deeply($a->aslist, [1, 2, 3, 4], 'data round trip');
+
+my $sum = $a + $a;
+is_deeply($sum->aslist, [2, 4, 6, 8], 'overloaded + (elemwise_add)');
+
+my $prod = $a * $a;
+is_deeply($prod->aslist, [1, 4, 9, 16], 'overloaded * (elemwise_mul)');
+
+my $d = $a->dot($a);   # [[1,2],[3,4]] @ [[1,2],[3,4]] = [[7,10],[15,22]]
+is_deeply($d->aslist, [7, 10, 15, 22], 'dot through imperative invoke');
+
+my $neg = AI::MXNetTPU::NDArray->array([-1, 2, -3], [3]);
+is_deeply($neg->relu->aslist, [0, 2, 0], 'relu');
+
+# arbitrary registry op by name with string attrs
+my $sm = $neg->invoke('softmax');
+my $l = $sm->aslist;
+my $tot = 0; $tot += $_ for @$l;
+ok(abs($tot - 1.0) < 1e-5, 'softmax via generic invoke sums to 1');
+
+# scalar operands promote to the *_scalar ops
+my $plus = $a + 1;
+is_deeply($plus->aslist, [2, 3, 4, 5], 'scalar + promotes to _plus_scalar');
+my $rsub = 10 - $a;
+is_deeply($rsub->aslist, [9, 8, 7, 6], 'swapped - uses _rminus_scalar');

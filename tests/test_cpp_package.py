@@ -86,3 +86,31 @@ def test_cpp_custom_op_demo():
                          timeout=300)
     assert run.returncode == 0, run.stdout + run.stderr[-2000:]
     assert "PASS" in run.stdout
+
+
+@pytest.mark.skipif(shutil.which("perl") is None
+                    or shutil.which("g++") is None
+                    or shutil.which("make") is None,
+                    reason="needs perl + g++ + make")
+def test_perl_binding():
+    """L9: the AI::MXNetTPU Perl binding (perl-package/ — the reference's
+    AI::MXNet analog at minimal scale): XS CAPI shim + pure-Perl NDArray
+    whose operators dispatch through MXImperativeInvokeByName."""
+    pdir = os.path.join(_REPO, "perl-package", "AI-MXNetTPU")
+    env = _cpp_env()
+    # the binding links libmxtpu_capi.so; build it first (fresh checkout)
+    so = subprocess.run(["make"], cwd=os.path.join(_REPO, "src", "native"),
+                        env=env, capture_output=True, text=True,
+                        timeout=600)
+    assert so.returncode == 0, so.stderr[-2000:]
+    cfg = subprocess.run(["perl", "Makefile.PL"], cwd=pdir, env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert cfg.returncode == 0, cfg.stderr[-2000:]
+    build = subprocess.run(["make"], cwd=pdir, env=env,
+                           capture_output=True, text=True, timeout=300)
+    assert build.returncode == 0, build.stderr[-2000:]
+    run = subprocess.run(["perl", "-Mblib", "t/basic.t"], cwd=pdir,
+                         env=env, capture_output=True, text=True,
+                         timeout=300)
+    assert run.returncode == 0, run.stdout + run.stderr[-2000:]
+    assert "ok 8" in run.stdout and "not ok" not in run.stdout, run.stdout
