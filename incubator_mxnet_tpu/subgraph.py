@@ -268,12 +268,20 @@ class _XlaProperty(SubgraphProperty):
 
 
 def partition(symbol: Symbol, backend: Optional[str] = None) -> Symbol:
-    """Apply a registered backend (default: $MXNET_SUBGRAPH_BACKEND)."""
+    """Apply a registered backend (default: $MXNET_SUBGRAPH_BACKEND).
+    An op-name override registered for the backend via
+    MXSetSubgraphPropertyOpNames restricts the selection to exactly
+    those ops (the reference's SubgraphPropertyOpNameSet is consulted by
+    normal partitioning too, not just MXBuildSubgraphByOpNames)."""
     from . import config
 
     backend = backend or config.get("MXNET_SUBGRAPH_BACKEND")
     if not backend:
         return symbol
+    override = _PROPERTY_OP_NAMES.get(str(backend))
+    if override is not None:
+        return build_subgraph(symbol, _OpNameProperty(str(backend),
+                                                      override))
     return build_subgraph(symbol, get_subgraph_backend(backend))
 
 

@@ -365,3 +365,27 @@ def test_image_record_iter_per_image_decode_cost(tmp_path):
     # min over batches rejects transient load on shared CI hosts; the
     # true cost is ~1.4 ms/img (PERF.md), bound leaves ~6x headroom
     assert best < 9.0, "decode cost regressed: %.2f ms/img" % best
+
+
+def test_rec2idx_tool(tmp_path):
+    """tools/rec2idx.py rebuilds a lost .idx from the .rec stream
+    (reference tools/rec2idx.py IndexCreator)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from rec2idx import create_index
+
+    rec_p = str(tmp_path / "t.rec")
+    w = MXIndexedRecordIO(str(tmp_path / "orig.idx"), rec_p, "w")
+    for i in range(7):
+        w.write_idx(i, b"payload-%d" % i)
+    w.close()
+    idx_p = str(tmp_path / "rebuilt.idx")
+    assert create_index(rec_p, idx_p) == 7
+    from incubator_mxnet_tpu.recordio import MXIndexedRecordIO as IR
+    r = IR(idx_p, rec_p, "r")
+    assert r.read_idx(4) == b"payload-4"
+    # rebuilt index matches the writer's own
+    orig = open(str(tmp_path / "orig.idx")).read().split()
+    new = open(idx_p).read().split()
+    assert orig == new

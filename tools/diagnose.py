@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Environment diagnostic (reference: tools/diagnose.py — python/pip/
+library/hardware/network checks for bug reports).  TPU-native version:
+python + package + jax/backend + device + feature + config report; the
+network section probes the TPU tunnel instead of package mirrors (this
+environment has no egress).
+
+Usage: python tools/diagnose.py [--probe-backend]
+"""
+import argparse
+import os
+import platform
+import socket
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def check_python():
+    print("----------Python Info----------")
+    print("Version      :", platform.python_version())
+    print("Compiler     :", platform.python_compiler())
+    print("Build        :", platform.python_build())
+    print("Arch         :", platform.architecture())
+
+
+def check_hardware():
+    print("----------Hardware Info----------")
+    print("Machine      :", platform.machine())
+    print("Platform     :", platform.platform())
+    print("Processor    :", platform.processor() or "?")
+    print("CPU cores    :", os.cpu_count())
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith(("MemTotal", "MemAvailable")):
+                    print(line.strip())
+    except OSError:
+        pass
+
+
+def check_package():
+    print("----------Framework Info----------")
+    import incubator_mxnet_tpu as mx
+    print("Version      :", getattr(mx, "__version__", "?"))
+    print("Location     :", os.path.dirname(mx.__file__))
+    from incubator_mxnet_tpu.runtime import feature_list
+    feats = [f.name for f in feature_list() if f.enabled]
+    print("Features     :", ", ".join(feats) if feats else "-")
+    from incubator_mxnet_tpu import config
+    print("Config vars  : %d declared MXNET_* variables" % len(config.VARS))
+    for name in sorted(config.VARS):
+        if os.environ.get(name) is not None:
+            print("Env          : %s=%s" % (name, os.environ[name]))
+
+
+def check_jax(probe_backend, user_platforms):
+    print("----------JAX Info----------")
+    import jax
+    print("jax          :", jax.__version__)
+    import jaxlib
+    print("jaxlib       :", jaxlib.__version__)
+    # the user's ORIGINAL env, not the cpu pin main() injects
+    print("JAX_PLATFORMS:", "<unset>" if user_platforms is None
+          else user_platforms)
+    if probe_backend:
+        t0 = time.time()
+        try:
+            devs = jax.devices()
+            print("Devices      : %s (init %.1fs)" % (devs,
+                                                      time.time() - t0))
+        except Exception as e:  # noqa: BLE001
+            print("Devices      : backend init FAILED: %r" % e)
+    else:
+        print("Devices      : (skipped; pass --probe-backend — a dead "
+              "TPU tunnel hangs the probe for minutes)")
+
+
+def check_tunnel(port=8083, timeout=5):
+    print("----------TPU Tunnel----------")
+    t0 = time.time()
+    try:
+        s = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+        s.close()
+        print("Port %d    : OPEN (%.2fs)" % (port, time.time() - t0))
+    except OSError as e:
+        print("Port %d    : unreachable (%r) — chip measurements are "
+              "blocked; see tools/chip_queue.sh" % (port, e))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--probe-backend", action="store_true",
+                    help="actually initialize the jax backend (slow / "
+                         "hangs if the TPU tunnel is down)")
+    args = ap.parse_args()
+    if "_MXTPU_DIAG_ORIG" in os.environ:
+        user_platforms = os.environ["_MXTPU_DIAG_ORIG"] or None
+    else:
+        user_platforms = os.environ.get("JAX_PLATFORMS")
+        if not args.probe_backend and user_platforms != "cpu":
+            # without --probe-backend this tool must NEVER touch a real
+            # backend (a dead TPU tunnel hangs the probe for minutes),
+            # but sitecustomize hooks backend selection at interpreter
+            # startup — so re-exec with a cpu env pin, remembering the
+            # user's original setting for the report
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            os.environ["_MXTPU_DIAG_ORIG"] = user_platforms or ""
+            os.execv(sys.executable, [sys.executable] + sys.argv)
+    check_python()
+    check_hardware()
+    check_tunnel()
+    check_package()
+    check_jax(args.probe_backend, user_platforms)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Parse a training log (the Speedometer/fit epoch lines) into a
+markdown table (reference: tools/parse_log.py — same regexes over
+``Epoch[N] Train-<metric>=V`` / ``Validation-<metric>=V`` /
+``Epoch[N] Time cost=V`` lines, which this framework's
+``mx.callback.Speedometer`` + ``module.fit`` logging also emits).
+
+Usage: python tools/parse_log.py train.log [--metric-names accuracy ...]
+"""
+import argparse
+import re
+
+
+def parse(lines, metric_names):
+    # anchor the metric name directly to '=' — a trailing wildcard would
+    # let 'accuracy' absorb 'accuracy_top5' lines
+    res = ([re.compile(r".*Epoch\[(\d+)\] Train-" + re.escape(s)
+                       + r"=([.\d]+)") for s in metric_names]
+           + [re.compile(r".*Epoch\[(\d+)\] Validation-" + re.escape(s)
+                         + r"=([.\d]+)") for s in metric_names]
+           + [re.compile(r".*Epoch\[(\d+)\] Time.*=([.\d]+)")])
+    data = {}
+    for line in lines:
+        for i, r in enumerate(res):
+            m = r.match(line)
+            if m is None:
+                continue
+            epoch, val = int(m.group(1)), float(m.group(2))
+            cnt_sum = data.setdefault(epoch, [[0, 0.0]
+                                              for _ in range(len(res))])
+            cnt_sum[i][0] += 1
+            cnt_sum[i][1] += val
+            break
+    return data, len(metric_names)
+
+
+def main():
+    ap = argparse.ArgumentParser(description="Parse a training log")
+    ap.add_argument("logfile")
+    ap.add_argument("--format", default="markdown",
+                    choices=["markdown", "none"])
+    ap.add_argument("--metric-names", nargs="+", default=["accuracy"])
+    args = ap.parse_args()
+    with open(args.logfile) as f:
+        data, nm = parse(f.readlines(), args.metric_names)
+
+    heads = (["epoch"] + ["train-" + s for s in args.metric_names]
+             + ["val-" + s for s in args.metric_names] + ["time"])
+    if args.format == "markdown":
+        print("| " + " | ".join(heads) + " |")
+        print("|" + " --- |" * len(heads))
+    for epoch in sorted(data):
+        row = [str(epoch)]
+        for cnt, tot in data[epoch]:
+            row.append("%.6g" % (tot / cnt) if cnt else "-")
+        sep = " | " if args.format == "markdown" else " "
+        line = sep.join(row)
+        print(("| %s |" % line) if args.format == "markdown" else line)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""Recreate the .idx random-access index for an existing .rec file
+(reference: tools/rec2idx.py IndexCreator over MXRecordIO).
+
+Usage: python tools/rec2idx.py data.rec data.idx
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from incubator_mxnet_tpu.recordio import MXRecordIO
+
+
+def create_index(rec_path: str, idx_path: str, key_type=int) -> int:
+    """Walk the record stream and write ``key\\tbyte-offset`` per record
+    (the MXIndexedRecordIO index contract); returns the record count."""
+    rec = MXRecordIO(rec_path, "r")
+    n = 0
+    with open(idx_path, "w") as fidx:
+        while True:
+            pos = rec.tell()
+            if rec.read() is None:
+                break
+            fidx.write("%s\t%d\n" % (key_type(n), pos))
+            n += 1
+    rec.close()
+    return n
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Create an index file for a RecordIO file")
+    ap.add_argument("record", help="path to the .rec file")
+    ap.add_argument("index", help="path to the .idx file to write")
+    args = ap.parse_args()
+    n = create_index(args.record, args.index)
+    print("wrote %d index entries to %s" % (n, args.index))
+
+
+if __name__ == "__main__":
+    main()
