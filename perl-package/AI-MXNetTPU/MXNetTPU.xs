@@ -17,12 +17,6 @@
  * this shim at COMPILE time instead of corrupting arguments */
 #include <mxtpu/c_api.h>
 
-/* ByName extension exported by the .so but not in the public header */
-extern int MXImperativeInvokeByName(const char* op, int num_inputs,
-                                    NDArrayHandle* inputs, int* num_outputs,
-                                    NDArrayHandle** outputs, int num_params,
-                                    const char** keys, const char** vals);
-
 static void croak_last(const char* what) {
     croak("%s failed: %s", what, MXGetLastError());
 }
