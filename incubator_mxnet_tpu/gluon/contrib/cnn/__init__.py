@@ -1,0 +1,81 @@
+"""``mx.gluon.contrib.cnn`` (reference: gluon/contrib/cnn/conv_layers.py
+— DeformableConvolution over src/operator/contrib/deformable_convolution
+.cc).  The offset branch is a plain convolution; the deformable sampling
+runs in the `_contrib_DeformableConvolution` op (bilinear gather —
+XLA-fused gathers, ops/contrib_tail.py)."""
+from __future__ import annotations
+
+from ...block import HybridBlock
+
+__all__ = ["DeformableConvolution"]
+
+
+class DeformableConvolution(HybridBlock):
+    """2-D deformable convolution (Dai et al. 2017; conv_layers.py:29).
+
+    A standard convolution produces per-position sampling offsets, then
+    the main convolution samples its input at those deformed positions.
+    """
+
+    def __init__(self, channels, kernel_size=(3, 3), strides=(1, 1),
+                 padding=(1, 1), dilation=(1, 1), groups=1,
+                 num_deformable_group=1, use_bias=True, in_channels=0,
+                 activation=None, weight_initializer=None,
+                 bias_initializer="zeros",
+                 offset_weight_initializer="zeros",
+                 offset_bias_initializer="zeros", **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size, kernel_size)
+        self._kernel = tuple(kernel_size)
+        self._strides = tuple(strides) if not isinstance(strides, int) \
+            else (strides, strides)
+        self._padding = tuple(padding) if not isinstance(padding, int) \
+            else (padding, padding)
+        self._dilation = tuple(dilation) if not isinstance(dilation, int) \
+            else (dilation, dilation)
+        self._channels = int(channels)
+        self._groups = int(groups)
+        self._ndg = int(num_deformable_group)
+        self._use_bias = bool(use_bias)
+        self._activation = activation
+        offset_channels = 2 * self._kernel[0] * self._kernel[1] * self._ndg
+        with self.name_scope():
+            self.offset_weight = self.params.get(
+                "offset_weight",
+                shape=(offset_channels, in_channels) + self._kernel,
+                init=offset_weight_initializer, allow_deferred_init=True)
+            self.offset_bias = self.params.get(
+                "offset_bias", shape=(offset_channels,),
+                init=offset_bias_initializer, allow_deferred_init=True)
+            self.weight = self.params.get(
+                "weight", shape=(channels, in_channels) + self._kernel,
+                init=weight_initializer, allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get(
+                    "bias", shape=(channels,), init=bias_initializer,
+                    allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        cin = x.shape[1]
+        self.offset_weight.shape = (self.offset_weight.shape[0],
+                                    cin) + self._kernel
+        self.weight.shape = (self._channels, cin) + self._kernel
+
+    def hybrid_forward(self, F, x, offset_weight, offset_bias, weight,
+                       bias=None):  # noqa: N803
+        offset = F.Convolution(x, offset_weight, offset_bias,
+                               kernel=self._kernel, stride=self._strides,
+                               pad=self._padding, dilate=self._dilation,
+                               num_filter=offset_weight.shape[0])
+        args = [x, offset, weight]
+        if bias is not None:
+            args.append(bias)
+        out = F.contrib.DeformableConvolution(
+            *args, kernel=self._kernel, stride=self._strides,
+            pad=self._padding, dilate=self._dilation,
+            num_filter=self._channels, num_group=self._groups,
+            num_deformable_group=self._ndg, no_bias=bias is None)
+        if self._activation:
+            out = F.Activation(out, act_type=self._activation)
+        return out

@@ -1,0 +1,34 @@
+"""``mx.gluon.contrib.data`` (reference: gluon/contrib/data/sampler.py).
+
+The reference also ships text datasets (contrib/data/text.py:
+WikiText-2/103) that download from the internet at construction time;
+this environment has no egress, so those are not reproduced — the
+dataset/vocab machinery they would use lives in
+``incubator_mxnet_tpu.text`` and ``gluon.data``.
+"""
+from __future__ import annotations
+
+from ...data.sampler import Sampler
+
+__all__ = ["IntervalSampler"]
+
+
+class IntervalSampler(Sampler):
+    """Samples ``0, interval, 2*interval, ..., 1, interval+1, ...``
+    (reference contrib/data/sampler.py:25) — interleaved strided order,
+    used for truncated-BPTT language-model batching."""
+
+    def __init__(self, length, interval, rollover=True):
+        self._length = int(length)
+        self._interval = int(interval)
+        self._rollover = bool(rollover)
+
+    def __iter__(self):
+        for start in range(self._interval if self._rollover else 1):
+            for i in range(start, self._length, self._interval):
+                yield i
+
+    def __len__(self):
+        if self._rollover:
+            return self._length
+        return len(range(0, self._length, self._interval))

@@ -1,0 +1,146 @@
+"""``mx.gluon.contrib.nn`` (reference: gluon/contrib/nn/basic_layers.py).
+
+TPU notes per layer are in the docstrings; the PixelShuffle family is
+pure reshape/transpose (free layout ops under XLA), SyncBatchNorm rides
+the GSPMD property that a batch-axis reduction inside one sharded
+program IS the cross-device reduction.
+"""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ...nn import BatchNorm, Embedding, HybridSequential, \
+    Sequential
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
+           "PixelShuffle3D"]
+
+
+class Concurrent(Sequential):
+    """Feeds the SAME input to every child and concatenates their
+    outputs along ``axis`` (basic_layers.py:31)."""
+
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import ndarray as F  # noqa: N812
+
+        return F.concat(*[child(x) for child in self._children.values()],
+                        dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (basic_layers.py:64)."""
+
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):  # noqa: N803
+        return F.concat(*[child(x) for child in self._children.values()],
+                        dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Pass-through block, for use in Concurrent branches
+    (basic_layers.py:97)."""
+
+    def hybrid_forward(self, F, x):  # noqa: N803
+        return x
+
+
+class SparseEmbedding(Embedding):
+    """API-compatible SparseEmbedding (basic_layers.py:118).
+
+    The reference stores a ``row_sparse`` gradient so only touched rows
+    update; under XLA the gradient of a gather is a dense scatter-add
+    that the compiler keeps fused on device, so the dense Embedding IS
+    the TPU-appropriate implementation — this subclass exists for API
+    parity and always reports ``sparse_grad=False`` semantics.
+    """
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(input_dim, output_dim, dtype=dtype,
+                         weight_initializer=weight_initializer, **kwargs)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device Batch Normalization (basic_layers.py:165,
+    src/operator/contrib/sync_batch_norm.cc).
+
+    The reference inserts an explicit key-slot all-reduce of the batch
+    statistics across ``ndev`` devices.  Under GSPMD the batch axis is
+    sharded over the mesh inside ONE program, so the plain BatchNorm's
+    ``jnp.mean`` over the batch axis already reduces across devices (the
+    partitioner inserts the collective): BatchNorm here IS synchronized.
+    ``num_devices``/``key`` are accepted for API parity.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, key=None, **kwargs):
+        super().__init__(momentum=momentum, epsilon=epsilon,
+                         in_channels=in_channels, **kwargs)
+        self._num_devices = num_devices
+        self._key = key  # the reference's comm key slot; unused here
+
+
+class _PixelShuffle(HybridBlock):
+    """Shared pixel-shuffle engine: split f-factors off the channel dim
+    and interleave them into the spatial dims (upsampling by reshape —
+    Shi et al. 2016; basic_layers.py:244/292/354)."""
+
+    def __init__(self, factor, ndim):
+        super().__init__()
+        if isinstance(factor, int):
+            factor = (factor,) * ndim
+        self._factors = tuple(int(f) for f in factor)
+        if len(self._factors) != ndim:
+            raise ValueError("factor must be an int or a %d-tuple" % ndim)
+
+    def hybrid_forward(self, F, x):  # noqa: N803
+        fs = self._factors
+        k = len(fs)
+        n, c = x.shape[0], x.shape[1]
+        spatial = x.shape[2:]
+        cout = c
+        for f in fs:
+            if cout % f:
+                raise ValueError(
+                    "channel dim %d not divisible by factor %d" % (c, f))
+            cout //= f
+        # (N, C*prod(f), *S) -> (N, C, f1..fk, *S)
+        y = x.reshape((n, cout) + fs + spatial)
+        # interleave: (N, C, s1, f1, s2, f2, ...)
+        perm = [0, 1]
+        for i in range(k):
+            perm.extend([2 + k + i, 2 + i])
+        y = y.transpose(tuple(perm))
+        out_spatial = tuple(s * f for s, f in zip(spatial, fs))
+        return y.reshape((n, cout) + out_spatial)
+
+    def __repr__(self):
+        return "%s(%s)" % (type(self).__name__, self._factors)
+
+
+class PixelShuffle1D(_PixelShuffle):
+    """(N, f*C, W) -> (N, C, f*W)."""
+
+    def __init__(self, factor):
+        super().__init__(factor, 1)
+
+
+class PixelShuffle2D(_PixelShuffle):
+    """(N, f1*f2*C, H, W) -> (N, C, f1*H, f2*W)."""
+
+    def __init__(self, factor):
+        super().__init__(factor, 2)
+
+
+class PixelShuffle3D(_PixelShuffle):
+    """(N, f1*f2*f3*C, D, H, W) -> (N, C, f1*D, f2*H, f3*W)."""
+
+    def __init__(self, factor):
+        super().__init__(factor, 3)

@@ -1,0 +1,215 @@
+"""gluon.contrib layers (reference: python/mxnet/gluon/contrib/ —
+nn/basic_layers.py, rnn/rnn_cell.py, rnn/conv_rnn_cell.py,
+cnn/conv_layers.py, data/sampler.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd
+from incubator_mxnet_tpu.gluon import contrib, nn, rnn
+
+
+def test_pixel_shuffle_matches_numpy():
+    """PixelShuffle{1,2,3}D == the reshape/transpose formulation
+    (basic_layers.py:244 — (N, f*C, W) -> (N, C, f*W) etc.)."""
+    rng = np.random.RandomState(0)
+    # 1D
+    x = rng.rand(2, 6, 4).astype(np.float32)
+    want = x.reshape(2, 3, 2, 4).transpose(0, 1, 3, 2).reshape(2, 3, 8)
+    got = contrib.nn.PixelShuffle1D(2)(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(got, want)
+    # 2D, distinct factors
+    x = rng.rand(1, 12, 3, 5).astype(np.float32)
+    want = (x.reshape(1, 2, 2, 3, 3, 5).transpose(0, 1, 4, 2, 5, 3)
+            .reshape(1, 2, 6, 15))
+    got = contrib.nn.PixelShuffle2D((2, 3))(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(got, want)
+    # 3D shape only
+    x = rng.rand(1, 8, 2, 2, 2).astype(np.float32)
+    assert contrib.nn.PixelShuffle3D(2)(
+        nd.array(x)).shape == (1, 1, 4, 4, 4)
+
+
+def test_concurrent_and_identity():
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    net = contrib.nn.HybridConcurrent(axis=1)
+    net.add(contrib.nn.Identity())
+    net.add(contrib.nn.Identity())
+    got = net(x).asnumpy()
+    np.testing.assert_allclose(
+        got, np.concatenate([x.asnumpy(), x.asnumpy()], axis=1))
+    # non-hybrid variant with a real layer
+    net2 = contrib.nn.Concurrent(axis=1)
+    d = nn.Dense(4, in_units=3)
+    d.initialize()
+    net2.add(d)
+    net2.add(contrib.nn.Identity())
+    assert net2(x).shape == (2, 7)
+
+
+def test_sync_batch_norm_equals_batch_norm_single_device():
+    """SyncBatchNorm == BatchNorm on one device; under GSPMD the batch
+    reduction inside one sharded program is already cross-device
+    (sync_batch_norm.cc analog documented in the block)."""
+    mx.random.seed(0)
+    sbn = contrib.nn.SyncBatchNorm(in_channels=3, num_devices=4)
+    bn = nn.BatchNorm(in_channels=3)
+    for b in (sbn, bn):
+        b.initialize()
+        b.shape_init((2, 3, 4, 4))
+    x = nd.random.uniform(shape=(8, 3, 4, 4))
+    with autograd.record():
+        y1 = sbn(x)
+    with autograd.record():
+        y2 = bn(x)
+    np.testing.assert_allclose(y1.asnumpy(), y2.asnumpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_variational_dropout_mask_fixed_across_time():
+    """The SAME dropout mask must apply at every time step until
+    reset() (Gal & Ghahramani; contrib/rnn/rnn_cell.py:27).  With an
+    Identity-like base cell the output mask pattern is directly
+    observable."""
+    mx.random.seed(0)
+    base = rnn.RNNCell(6, activation="relu", input_size=6)
+    cell = contrib.rnn.VariationalDropoutCell(base, drop_outputs=0.5)
+    cell.initialize()
+    x = nd.array(np.ones((2, 4, 6), np.float32))
+    out, _ = cell.unroll(4, x, merge_outputs=True)
+    o = out.asnumpy()
+    zero_pattern = (o == 0)
+    # identical zero pattern at every time step
+    for t in range(1, 4):
+        np.testing.assert_array_equal(zero_pattern[:, t], zero_pattern[:, 0])
+    # reset -> a fresh mask (overwhelmingly likely to differ)
+    cell.reset()
+    out2, _ = cell.unroll(4, x, merge_outputs=True)
+    assert not np.array_equal(out2.asnumpy() == 0, zero_pattern)
+
+
+def test_lstmp_cell_projection_and_grad():
+    """LSTMPCell (rnn_cell.py:197): recurrent state is projection-sized,
+    cell state keeps hidden_size; gradients flow to the projection."""
+    mx.random.seed(0)
+    cell = contrib.rnn.LSTMPCell(16, 8, input_size=4)
+    cell.initialize()
+    x = nd.random.uniform(shape=(2, 5, 4))
+    with autograd.record():
+        out, states = cell.unroll(5, x, merge_outputs=True)
+        loss = (out * out).sum()
+    loss.backward()
+    assert out.shape == (2, 5, 8)
+    assert states[0].shape == (2, 8) and states[1].shape == (2, 16)
+    g = cell.h2r_weight.grad().asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+@pytest.mark.parametrize("cls,x_shape,state_ndim", [
+    ("Conv1DRNNCell", (2, 3, 8), 3),
+    ("Conv2DRNNCell", (2, 3, 5, 5), 4),
+    ("Conv2DLSTMCell", (2, 3, 5, 5), 4),
+    ("Conv3DLSTMCell", (2, 3, 3, 4, 4), 5),
+    ("Conv2DGRUCell", (2, 3, 5, 5), 4),
+])
+def test_conv_rnn_cells_step_and_unroll(cls, x_shape, state_ndim):
+    """Conv RNN family (conv_rnn_cell.py): state keeps the spatial
+    shape, gates are convolutions; a 3-step unroll differentiates."""
+    mx.random.seed(0)
+    spatial = x_shape[2:]
+    cell = getattr(contrib.rnn, cls)((3,) + spatial, 5, (3,) * len(spatial),
+                                     (3,) * len(spatial))
+    cell.initialize()
+    x = nd.random.uniform(shape=x_shape)
+    # nonzero initial states: with the zero begin_state the first-step
+    # h2h gradient is legitimately zero (conv of h=0)
+    states = [nd.random.uniform(shape=s.shape)
+              for s in cell.begin_state(x_shape[0])]
+    with autograd.record():
+        out, new_states = cell(x, states)
+        loss = (out * out).sum()
+    loss.backward()
+    assert out.shape == (x_shape[0], 5) + spatial
+    assert all(s.shape == out.shape for s in new_states)
+    assert np.abs(cell.h2h_weight.grad().asnumpy()).sum() > 0
+    # unroll over time
+    seq = nd.random.uniform(shape=(x_shape[0], 3) + x_shape[1:])
+    outs, _ = cell.unroll(3, seq, merge_outputs=True)
+    assert outs.shape == (x_shape[0], 3, 5) + spatial
+
+
+def test_deformable_convolution_zero_offsets_equals_conv():
+    """With the offset branch at its zero init, DeformableConvolution
+    must equal a plain Convolution with the same weights (the sampling
+    grid degenerates to the regular one — deformable_convolution.cc)."""
+    mx.random.seed(0)
+    dc = contrib.cnn.DeformableConvolution(6, kernel_size=(3, 3),
+                                           padding=(1, 1), in_channels=4)
+    dc.initialize()
+    x = nd.random.uniform(shape=(2, 4, 7, 7))
+    got = dc(x).asnumpy()
+    want = nd.Convolution(x, dc.weight.data(), dc.bias.data(),
+                          kernel=(3, 3), pad=(1, 1),
+                          num_filter=6).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_interval_sampler():
+    """IntervalSampler (contrib/data/sampler.py:25): strided interleave;
+    rollover=False stops after the first pass."""
+    assert list(contrib.data.IntervalSampler(10, 3)) == \
+        [0, 3, 6, 9, 1, 4, 7, 2, 5, 8]
+    s = contrib.data.IntervalSampler(10, 3, rollover=False)
+    assert list(s) == [0, 3, 6, 9]
+    assert len(s) == 4
+    assert len(contrib.data.IntervalSampler(10, 3)) == 10
+
+
+def test_lstmp_deferred_input_size():
+    """LSTMPCell with input_size unset must defer i2h inference to the
+    first forward (the HybridBlock deferred-init path the dense cells
+    use)."""
+    mx.random.seed(0)
+    cell = contrib.rnn.LSTMPCell(12, 6)
+    cell.initialize()
+    out, states = cell(nd.random.uniform(shape=(3, 5)),
+                       cell.begin_state(3))
+    assert out.shape == (3, 6)
+    assert cell.i2h_weight.shape == (48, 5)
+
+
+def test_conv_cells_int_kernel_and_deferred():
+    """Int kernels broadcast to the cell's dimensionality, and in_channels
+    infers from the first input."""
+    mx.random.seed(0)
+    cell = contrib.rnn.Conv2DRNNCell((3, 5, 5), 4, 3, 3)
+    cell.initialize()
+    out, _ = cell(nd.random.uniform(shape=(2, 3, 5, 5)),
+                  cell.begin_state(2))
+    assert out.shape == (2, 4, 5, 5)
+    assert cell.i2h_weight.shape == (4, 3, 3, 3)
+
+
+def test_conv_gru_1x1_equals_dense_gru():
+    """A ConvGRU with 1x1 kernels on 1x1 spatial IS the dense GRU — the
+    candidate must be act(i2h_n + r * h2h_n) exactly like
+    gluon.rnn.GRUCell (the reset gate applies only to the recurrent
+    contribution)."""
+    mx.random.seed(0)
+    nh, nin = 4, 3
+    dense = rnn.GRUCell(nh, input_size=nin)
+    dense.initialize()
+    conv = contrib.rnn.Conv1DGRUCell((nin, 1), nh, (1,), (1,))
+    conv.initialize()
+    conv.i2h_weight.set_data(
+        dense.i2h_weight.data().reshape((3 * nh, nin, 1)))
+    conv.h2h_weight.set_data(
+        dense.h2h_weight.data().reshape((3 * nh, nh, 1)))
+    conv.i2h_bias.set_data(dense.i2h_bias.data())
+    conv.h2h_bias.set_data(dense.h2h_bias.data())
+    x = nd.random.uniform(shape=(2, nin))
+    h0 = nd.random.uniform(shape=(2, nh))
+    out_d, _ = dense(x, [h0])
+    out_c, _ = conv(x.reshape((2, nin, 1)), [h0.reshape((2, nh, 1))])
+    np.testing.assert_allclose(out_c.asnumpy().reshape(2, nh),
+                               out_d.asnumpy(), rtol=1e-5, atol=1e-6)
