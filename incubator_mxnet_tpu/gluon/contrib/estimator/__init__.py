@@ -1,4 +1,5 @@
 """Estimator API (reference: python/mxnet/gluon/contrib/estimator/)."""
+from .batch_processor import BatchProcessor
 from .estimator import Estimator
 from .event_handler import *  # noqa: F401,F403
 from . import event_handler

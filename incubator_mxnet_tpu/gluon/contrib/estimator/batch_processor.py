@@ -1,0 +1,44 @@
+"""Pluggable per-batch hooks (reference:
+gluon/contrib/estimator/batch_processor.py — BatchProcessor).
+
+Override ``fit_batch``/``evaluate_batch`` for custom minibatch handling
+(mixed tasks, multiple losses, custom gradient flows); the Estimator
+calls whichever processor it was constructed with.  The reference splits
+batches across a ctx list; one sharded program covers the device
+dimension here, so the hooks see the whole batch.
+"""
+from __future__ import annotations
+
+from .... import autograd
+
+__all__ = ["BatchProcessor"]
+
+
+class BatchProcessor:
+    """Plug-and-play fit_batch & evaluate_batch (batch_processor.py:27)."""
+
+    @staticmethod
+    def _get_data_and_label(batch):
+        if isinstance(batch, (list, tuple)):
+            return batch[0], batch[1]
+        return batch.data[0], batch.label[0]
+
+    def evaluate_batch(self, estimator, val_batch, batch_axis=0):
+        """Returns ``(data, labels, preds, losses)`` for one validation
+        batch — labels/preds/losses are SYMMETRIC lists so multi-task
+        processors can pair them element-wise."""
+        data, label = self._get_data_and_label(val_batch)
+        pred = estimator.net(data)
+        loss = estimator.loss(pred, label)
+        return data, [label], [pred], [loss]
+
+    def fit_batch(self, estimator, train_batch, batch_axis=0):
+        """Forward + backward for one training batch; the estimator
+        steps the trainer.  Returns ``(data, labels, preds, losses)``
+        with symmetric lists, like ``evaluate_batch``."""
+        data, label = self._get_data_and_label(train_batch)
+        with autograd.record():
+            pred = estimator.net(data)
+            loss = estimator.loss(pred, label)
+        loss.backward()
+        return data, [label], [pred], [loss]

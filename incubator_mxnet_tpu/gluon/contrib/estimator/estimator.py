@@ -10,6 +10,7 @@ from typing import List, Optional
 from .... import autograd, initializer as init_mod, metric as metric_mod
 from ....base import _as_list
 from ... import Trainer
+from .batch_processor import BatchProcessor
 from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
                             LoggingHandler, MetricHandler, StoppingHandler,
                             TrainBegin, TrainEnd, ValidationHandler)
@@ -21,13 +22,14 @@ class Estimator:
     """Keras-like fit/evaluate driver over a gluon net (estimator.py:42)."""
 
     def __init__(self, net, loss, metrics=None, initializer=None,
-                 trainer=None, context=None):
+                 trainer=None, context=None, batch_processor=None):
         self.net = net
         self.loss = loss
         self.train_metrics = _as_list(metrics) if metrics else []
         self.context = context
         self.stop_training = False
         self.resumed_epoch = 0
+        self.batch_processor = batch_processor or BatchProcessor()
 
         if initializer is not None:
             self.net.initialize(init=initializer, force_reinit=True)
@@ -48,21 +50,16 @@ class Estimator:
 
     # ------------------------------------------------------------------
     def evaluate(self, val_data, batch_axis=0):
-        """Run validation metrics over val_data (estimator.py:228)."""
+        """Run validation metrics over val_data (estimator.py:228),
+        through the pluggable batch processor."""
         for metric in self.val_metrics:
             metric.reset()
         for batch in val_data:
-            data, label = self._unpack(batch)
-            pred = self.net(data)
+            _, labels, preds, _ = self.batch_processor.evaluate_batch(
+                self, batch, batch_axis=batch_axis)
             for metric in self.val_metrics:
-                metric.update([label], [pred])
+                metric.update(labels, preds)
         return {m.get()[0]: m.get()[1] for m in self.val_metrics}
-
-    @staticmethod
-    def _unpack(batch):
-        if isinstance(batch, (list, tuple)):
-            return batch[0], batch[1]
-        return batch.data[0], batch.label[0]
 
     # ------------------------------------------------------------------
     def fit(self, train_data, val_data=None, epochs=None,
@@ -90,20 +87,22 @@ class Estimator:
             for batch in train_data:
                 if self.stop_training:
                     break
-                data, label = self._unpack(batch)
                 for h in batch_begin:
                     h.batch_begin(self, batch=batch)
-                with autograd.record():
-                    pred = self.net(data)
-                    loss = self.loss(pred, label)
-                loss.backward()
+                # per-batch work is pluggable (batch_processor.py):
+                # custom processors override fit_batch for multi-loss /
+                # custom-gradient schemes; labels/preds/losses are
+                # symmetric lists
+                data, labels, preds, losses = \
+                    self.batch_processor.fit_batch(self, batch,
+                                                   batch_axis=batch_axis)
                 bsz = data.shape[batch_axis]
                 self.trainer.step(bsz)
                 if self.train_loss_metric is not None:
-                    self.train_loss_metric.update(0, [loss])
+                    self.train_loss_metric.update(0, losses)
                 for h in batch_end:
-                    h.batch_end(self, batch=batch, pred=[pred],
-                                label=[label], loss=[loss])
+                    h.batch_end(self, batch=batch, pred=preds,
+                                label=labels, loss=losses)
             for h in epoch_end:
                 h.epoch_end(self)
         for h in train_end:

@@ -94,3 +94,43 @@ def test_early_stopping_handler():
     est.fit(_toy_data(), epochs=10, event_handlers=[early])
     # metric never improves after first epoch → stops well before 10
     assert early.current_epoch < 10
+
+
+def test_estimator_custom_batch_processor():
+    """BatchProcessor hook (reference batch_processor.py +
+    test_gluon_batch_processor.py): a custom fit_batch drives training;
+    the estimator steps the trainer around it."""
+    import numpy as np
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.gluon import loss as gloss, nn
+    from incubator_mxnet_tpu.gluon.contrib.estimator import (BatchProcessor,
+                                                             Estimator)
+
+    calls = {"fit": 0, "eval": 0}
+
+    class Double(BatchProcessor):
+        def fit_batch(self, estimator, batch, batch_axis=0):
+            calls["fit"] += 1
+            return super().fit_batch(estimator, batch, batch_axis)
+
+        def evaluate_batch(self, estimator, batch, batch_axis=0):
+            calls["eval"] += 1
+            return super().evaluate_batch(estimator, batch, batch_axis)
+
+    mx.random.seed(0)
+    net = nn.Dense(2, in_units=4)
+    net.initialize()
+    est = Estimator(net, gloss.SoftmaxCrossEntropyLoss(),
+                    batch_processor=Double())
+    rng = np.random.RandomState(0)
+    data = [(nd.array(rng.rand(8, 4).astype(np.float32)),
+             nd.array(rng.randint(0, 2, 8).astype(np.float32)))
+            for _ in range(3)]
+    est.fit(data, epochs=2)
+    assert calls["fit"] == 6
+    # validation must route through the processor too
+    est.val_metrics = [mx.metric.Accuracy()]
+    est.evaluate(data)
+    assert calls["eval"] == 3
