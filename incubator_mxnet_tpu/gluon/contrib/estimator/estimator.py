@@ -7,7 +7,7 @@ import copy
 import warnings
 from typing import List, Optional
 
-from .... import autograd, initializer as init_mod, metric as metric_mod
+from .... import initializer as init_mod, metric as metric_mod
 from ....base import _as_list
 from ... import Trainer
 from .batch_processor import BatchProcessor
@@ -55,10 +55,15 @@ class Estimator:
         for metric in self.val_metrics:
             metric.reset()
         for batch in val_data:
-            _, labels, preds, _ = self.batch_processor.evaluate_batch(
+            _, labels, preds, losses = self.batch_processor.evaluate_batch(
                 self, batch, batch_axis=batch_axis)
             for metric in self.val_metrics:
-                metric.update(labels, preds)
+                # the computed val loss feeds Loss metrics; everything
+                # else scores labels vs preds
+                if isinstance(metric, metric_mod.Loss):
+                    metric.update(0, losses)
+                else:
+                    metric.update(labels, preds)
         return {m.get()[0]: m.get()[1] for m in self.val_metrics}
 
     # ------------------------------------------------------------------
@@ -96,8 +101,13 @@ class Estimator:
                 data, labels, preds, losses = \
                     self.batch_processor.fit_batch(self, batch,
                                                    batch_axis=batch_axis)
-                bsz = data.shape[batch_axis]
-                self.trainer.step(bsz)
+                # batch size from the RAW batch, not the processor's
+                # return — a multi-task processor may return data as a
+                # list (labels/preds/losses are lists by contract)
+                raw = batch[0] if isinstance(batch, (list, tuple)) \
+                    else batch.data[0]
+                first = raw[0] if isinstance(raw, (list, tuple)) else raw
+                self.trainer.step(first.shape[batch_axis])
                 if self.train_loss_metric is not None:
                     self.train_loss_metric.update(0, losses)
                 for h in batch_end:

@@ -21,7 +21,7 @@ from ....ndarray import ndarray as _nd
 from ...block import Block
 
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
-           "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
+           "CenterCrop", "CropResize", "RandomResizedCrop", "RandomFlipLeftRight",
            "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
            "RandomSaturation", "RandomHue", "RandomColorJitter",
            "RandomLighting"]
@@ -108,6 +108,15 @@ def _resize_hwc(d, size, interpolation=1):
     return jax.image.resize(d.astype(jnp.float32), shape, method=method)
 
 
+def _resize_keep_dtype(d, size, interpolation, orig_dtype):
+    """Resize then restore a uint8 input's dtype (round + clip) — the
+    single implementation all crop/resize transforms share."""
+    out = _resize_hwc(d, size, interpolation)
+    if orig_dtype == jnp.uint8:
+        out = jnp.clip(jnp.round(out), 0, 255).astype(jnp.uint8)
+    return out
+
+
 class Resize(Block):
     """Resize to (w, h) (transforms.py:234)."""
 
@@ -128,9 +137,7 @@ class Resize(Block):
                 size = (size, int(size * hgt / wid))
             else:
                 size = (int(size * wid / hgt), size)
-        out = _resize_hwc(d, size, self._interpolation)
-        if orig_dtype == jnp.uint8:
-            out = jnp.clip(jnp.round(out), 0, 255).astype(jnp.uint8)
+        out = _resize_keep_dtype(d, size, self._interpolation, orig_dtype)
         return _wrap(out)
 
 
@@ -147,6 +154,40 @@ def _center_crop(d, size):
     return d[:, y0:y0 + h, x0:x0 + w, :]
 
 
+class CropResize(Block):
+    """Fixed-window crop at (x, y, width, height), optionally resized to
+    ``size`` (reference transforms.py:238 over the image.fixed_crop op).
+    Accepts (H, W, C) or (N, H, W, C)."""
+
+    def __init__(self, x, y, width, height, size=None, interpolation=1):
+        super().__init__()
+        self._x, self._y = int(x), int(y)
+        self._w, self._h = int(width), int(height)
+        self._size = size
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        d = _data(x)
+        H, W = (d.shape[0], d.shape[1]) if d.ndim == 3 else (d.shape[1],
+                                                             d.shape[2])
+        if (self._x < 0 or self._y < 0 or self._w <= 0 or self._h <= 0
+                or self._x + self._w > W or self._y + self._h > H):
+            # jnp slicing would silently clamp/empty; the reference's
+            # crop op raises on an out-of-range window
+            raise ValueError(
+                "crop window (x=%d, y=%d, w=%d, h=%d) out of range for "
+                "%dx%d image" % (self._x, self._y, self._w, self._h, W, H))
+        if d.ndim == 3:
+            out = d[self._y:self._y + self._h, self._x:self._x + self._w]
+        else:
+            out = d[:, self._y:self._y + self._h,
+                    self._x:self._x + self._w]
+        if self._size is not None:
+            out = _resize_keep_dtype(out, self._size, self._interpolation,
+                                     d.dtype)
+        return _wrap(out)
+
+
 class CenterCrop(Block):
     def __init__(self, size, interpolation=1):
         super().__init__()
@@ -161,10 +202,8 @@ class CenterCrop(Block):
         H, W = (out.shape[0], out.shape[1]) if out.ndim == 3 \
             else (out.shape[1], out.shape[2])
         if (W, H) != tuple(size):
-            orig_dtype = d.dtype
-            out = _resize_hwc(out, size, self._interpolation)
-            if orig_dtype == jnp.uint8:
-                out = jnp.clip(jnp.round(out), 0, 255).astype(jnp.uint8)
+            out = _resize_keep_dtype(out, size, self._interpolation,
+                                     d.dtype)
         return _wrap(out)
 
 
@@ -196,10 +235,8 @@ class RandomResizedCrop(Block):
                 break
         else:
             crop = _center_crop(d, min(H, W))
-        orig_dtype = d.dtype
-        out = _resize_hwc(crop, self._size, self._interpolation)
-        if orig_dtype == jnp.uint8:
-            out = jnp.clip(jnp.round(out), 0, 255).astype(jnp.uint8)
+        out = _resize_keep_dtype(crop, self._size, self._interpolation,
+                                  d.dtype)
         return _wrap(out)
 
 

@@ -209,3 +209,20 @@ def test_image_folder_dataset(tmp_path):
     assert len(ds) == 2
     img, label = ds[0]
     assert label == 0 and img.shape == (5, 5, 3)
+
+
+def test_crop_resize_transform():
+    """CropResize (reference transforms.py:238): exact fixed-window crop,
+    optional resize, batch passthrough."""
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.gluon.data.vision import transforms
+
+    rng = np.random.RandomState(0)
+    img = nd.array(rng.randint(0, 255, (32, 32, 3)).astype(np.uint8))
+    out = transforms.CropResize(2, 4, 10, 8)(img)
+    assert out.shape == (8, 10, 3) and out.dtype == np.uint8
+    np.testing.assert_array_equal(out.asnumpy(), img.asnumpy()[4:12, 2:12])
+    # resize + batch
+    t = transforms.CropResize(0, 0, 16, 16, size=(8, 8))
+    batch = nd.array(rng.randint(0, 255, (2, 32, 32, 3)).astype(np.uint8))
+    assert t(batch).shape == (2, 8, 8, 3)
