@@ -101,12 +101,11 @@ class Estimator:
                 data, labels, preds, losses = \
                     self.batch_processor.fit_batch(self, batch,
                                                    batch_axis=batch_axis)
-                # batch size from the RAW batch, not the processor's
-                # return — a multi-task processor may return data as a
-                # list (labels/preds/losses are lists by contract)
-                raw = batch[0] if isinstance(batch, (list, tuple)) \
-                    else batch.data[0]
-                first = raw[0] if isinstance(raw, (list, tuple)) else raw
+                # batch size from the processor's returned data —
+                # batch-format knowledge stays inside the processor; a
+                # multi-task processor may return data as a list
+                first = data[0] if isinstance(data, (list, tuple)) \
+                    else data
                 self.trainer.step(first.shape[batch_axis])
                 if self.train_loss_metric is not None:
                     self.train_loss_metric.update(0, losses)

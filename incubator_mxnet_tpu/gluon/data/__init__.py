@@ -1,10 +1,11 @@
 """``mx.gluon.data`` — datasets, samplers, DataLoader (gluon/data parity)."""
 from .dataset import ArrayDataset, Dataset, RecordFileDataset, SimpleDataset
-from .sampler import (BatchSampler, RandomSampler, Sampler,
-                      SequentialSampler, SplitSampler)
+from .sampler import (BatchSampler, FilterSampler, RandomSampler,
+                      Sampler, SequentialSampler, SplitSampler)
 from .dataloader import DataLoader, default_batchify_fn
 from . import vision
 
 __all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset",
-           "Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",
+           "Sampler", "SequentialSampler", "RandomSampler", "FilterSampler",
+           "BatchSampler",
            "SplitSampler", "DataLoader", "default_batchify_fn", "vision"]

@@ -39,6 +39,12 @@ class Dataset:
     def take(self, count):
         return _TakenDataset(self, count)
 
+    def sample(self, sampler):
+        """View of this dataset in ``sampler``'s index order
+        (dataset.py:119)."""
+        indices = list(sampler)
+        return _SampledDataset(self, indices)
+
     def transform(self, fn, lazy=True):
         """Map fn over samples (dataset.py:86)."""
         trans = _LazyTransformDataset(self, fn)
@@ -173,3 +179,15 @@ class RecordFileDataset(Dataset):
 
     def __len__(self):
         return len(self._record.keys)
+
+
+class _SampledDataset(Dataset):
+    def __init__(self, dataset, indices):
+        self._dataset = dataset
+        self._indices = indices
+
+    def __len__(self):
+        return len(self._indices)
+
+    def __getitem__(self, idx):
+        return self._dataset[self._indices[idx]]

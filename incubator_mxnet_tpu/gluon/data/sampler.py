@@ -8,8 +8,8 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",
-           "SplitSampler"]
+__all__ = ["Sampler", "SequentialSampler", "RandomSampler",
+           "FilterSampler", "BatchSampler", "SplitSampler"]
 
 
 class Sampler:
@@ -108,3 +108,18 @@ class BatchSampler(Sampler):
         raise ValueError(
             "last_batch must be one of keep/discard/rollover, got %s"
             % self._last_batch)
+
+
+class FilterSampler(Sampler):
+    """Indices of dataset elements for which ``fn`` is true
+    (sampler.py:73) — evaluated once at construction."""
+
+    def __init__(self, fn, dataset):
+        self._indices = [i for i in range(len(dataset))
+                         if fn(dataset[i])]
+
+    def __iter__(self):
+        return iter(self._indices)
+
+    def __len__(self):
+        return len(self._indices)

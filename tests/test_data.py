@@ -226,3 +226,17 @@ def test_crop_resize_transform():
     t = transforms.CropResize(0, 0, 16, 16, size=(8, 8))
     batch = nd.array(rng.randint(0, 255, (2, 32, 32, 3)).astype(np.uint8))
     assert t(batch).shape == (2, 8, 8, 3)
+
+
+def test_filter_sampler_and_dataset_sample():
+    """FilterSampler (sampler.py:73) + Dataset.sample (dataset.py:119):
+    predicate-selected indices, and a dataset view in sampler order."""
+    ds = gdata.SimpleDataset(list(range(10)))
+    s = gdata.FilterSampler(lambda x: x % 3 == 0, ds)
+    assert list(s) == [0, 3, 6, 9] and len(s) == 4
+    view = ds.sample(s)
+    assert len(view) == 4 and [view[i] for i in range(4)] == [0, 3, 6, 9]
+    # contrib IntervalSampler drives Dataset.sample too
+    from incubator_mxnet_tpu.gluon.contrib.data import IntervalSampler
+    view2 = ds.sample(IntervalSampler(10, 5))
+    assert [view2[i] for i in range(10)] == [0, 5, 1, 6, 2, 7, 3, 8, 4, 9]
