@@ -1,9 +1,10 @@
 """``mx.io`` — data iterators (python/mxnet/io/io.py parity)."""
 from .io import (DataBatch, DataDesc, DataIter, MXDataIter, NDArrayIter,
                  PrefetchingIter, ResizeIter, CSVIter)
-from .record_iter import (ImageRecordIter, ImageRecordUInt8Iter,
+from .record_iter import (ImageDetRecordIter, ImageRecordIter,
+                          ImageRecordUInt8Iter,
                           LibSVMIter, MNISTIter)
 
 __all__ = ["DataBatch", "DataDesc", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "MXDataIter", "ImageRecordIter", "ImageRecordUInt8Iter",
+           "PrefetchingIter", "CSVIter", "MXDataIter", "ImageRecordIter", "ImageRecordUInt8Iter", "ImageDetRecordIter",
            "MNISTIter", "LibSVMIter"]

@@ -34,8 +34,8 @@ from ..ndarray import ndarray as _nd
 from ..recordio import MXIndexedRecordIO, MXRecordIO, unpack, unpack_img
 from .io import DataBatch, DataDesc, DataIter
 
-__all__ = ["ImageRecordIter", "ImageRecordUInt8Iter", "MNISTIter",
-           "LibSVMIter"]
+__all__ = ["ImageRecordIter", "ImageRecordUInt8Iter",
+           "ImageDetRecordIter", "MNISTIter", "LibSVMIter"]
 
 
 class _Prefetcher:
@@ -201,11 +201,27 @@ class ImageRecordIter(DataIter):
         # stay uint8 HWC here: normalize/transpose run ONCE per batch
         # (vectorized) in _epoch — per-image float work dominated the
         # single-core pipeline cost
+        return eidx, np.ascontiguousarray(img[..., :c]), \
+            self._label_of(header)
+
+    # subclass knobs: det labels pad with -1 and refuse to drop objects
+    label_pad_value = 0.0
+    _label_overflow_fatal = False
+
+    def _label_of(self, header):
+        """Fixed-width label row (det subclass pads -1 / raises on
+        overflow via the class attributes above)."""
         label = np.asarray(header.label, np.float32).reshape(-1)
         if label.size < self.label_width:
-            label = np.pad(label, (0, self.label_width - label.size))
-        return eidx, np.ascontiguousarray(img[..., :c]), \
-            label[: self.label_width]
+            label = np.pad(label, (0, self.label_width - label.size),
+                           constant_values=self.label_pad_value)
+        elif label.size > self.label_width and self._label_overflow_fatal:
+            raise MXNetError(
+                "label_pad_width %d smaller than this record's label "
+                "width %d — objects would be silently dropped "
+                "(iter_image_det_recordio.cc:334 raises here too)"
+                % (self.label_width, label.size))
+        return label[: self.label_width]
 
     def _epoch(self):
         order = list(self._keys)
@@ -481,3 +497,59 @@ class LibSVMIter(DataIter):
         return DataBatch(data=[data], label=[_nd.array(lab)], pad=pad,
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)
+
+
+class ImageDetRecordIter(ImageRecordIter):
+    """Detection record iterator (reference:
+    src/io/iter_image_det_recordio.cc, registered as ImageDetRecordIter).
+
+    Records carry variable-length detection labels
+    ``[header_width, object_width, extra..., (id, xmin, ymin, xmax,
+    ymax, ...) * n_obj]`` (tools/im2rec det packing); batches pad each
+    label row to ``label_pad_width`` with ``label_pad_value`` (-1, the
+    reference's invalid-object marker) so downstream consumers
+    (``image.ImageDetIter``-style reshape, MultiBoxTarget) can mask
+    padded objects out.  Geometric augmentations that would invalidate
+    the boxes (rand_crop/rand_mirror) are rejected at construction —
+    the reference routes det augmentation through its det augmenter
+    list, which is the ``image.ImageDetIter`` layer here.
+    """
+
+    _label_overflow_fatal = True
+
+    def __init__(self, *args, label_pad_width=0, label_pad_value=-1.0,
+                 **kwargs):
+        self.label_pad_value = float(label_pad_value)
+        if not label_pad_width:
+            # reference behavior (iter_image_det_recordio.cc:337): when
+            # unset, estimate from the data — max label width over the
+            # first records; an under-estimate fails LOUDLY later via
+            # the overflow check in _label_of
+            label_pad_width = self._estimate_label_width(args, kwargs)
+        # must reach the base ctor: the prefetcher starts producing
+        # (with label buffers sized label_width) inside it
+        kwargs["label_width"] = int(label_pad_width)
+        super().__init__(*args, **kwargs)
+        # checked on self (not kwargs) so positional args can't slip by
+        if self.rand_crop or self.rand_mirror:
+            raise ValueError(
+                "ImageDetRecordIter does not geometric-augment: boxes "
+                "would be invalidated; use image.ImageDetIter's det "
+                "augmenters instead")
+
+    @staticmethod
+    def _estimate_label_width(args, kwargs, sample=256):
+        path = kwargs.get("path_imgrec", args[0] if args else None)
+        rec = MXRecordIO(path, "r")
+        width = 1
+        for _ in range(sample):
+            s = rec.read()
+            if s is None:
+                break
+            header, _ = unpack(s)
+            width = max(width,
+                        np.asarray(header.label).reshape(-1).size)
+        rec.close()
+        return width
+
+

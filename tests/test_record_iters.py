@@ -389,3 +389,54 @@ def test_rec2idx_tool(tmp_path):
     orig = open(str(tmp_path / "orig.idx")).read().split()
     new = open(idx_p).read().split()
     assert orig == new
+
+
+def test_image_det_record_iter(tmp_path):
+    """ImageDetRecordIter (iter_image_det_recordio.cc): variable-length
+    det labels padded with -1 to label_pad_width; geometric augment is
+    rejected (boxes would be invalidated)."""
+    prefix = str(tmp_path / "det")
+    rec = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    rng = np.random.RandomState(0)
+    # det label: [header_width=2, object_width=5, (id,x1,y1,x2,y2)*n]
+    labels = [
+        np.array([2, 5, 0, .1, .1, .5, .5], np.float32),
+        np.array([2, 5, 1, .2, .2, .6, .6, 0, .0, .0, .3, .3], np.float32),
+    ]
+    for i, lab in enumerate(labels):
+        img = rng.randint(0, 255, (24, 24, 3), dtype=np.uint8)
+        rec.write_idx(i, pack_img(IRHeader(0, lab, i, 0), img,
+                                  img_fmt=".png"))
+    rec.close()
+    it = mio.ImageDetRecordIter(path_imgrec=prefix + ".rec",
+                                path_imgidx=prefix + ".idx",
+                                data_shape=(3, 24, 24), batch_size=2,
+                                label_pad_width=12, shuffle=False)
+    b = next(it)
+    lab = b.label[0].asnumpy()
+    assert lab.shape == (2, 12)
+    np.testing.assert_allclose(lab[0][:7], labels[0])
+    assert (lab[0][7:] == -1).all()          # -1 padding marks no-object
+    np.testing.assert_allclose(lab[1], labels[1])
+    assert b.data[0].shape == (2, 3, 24, 24)
+    it.close()
+    with pytest.raises(ValueError):
+        mio.ImageDetRecordIter(path_imgrec=prefix + ".rec",
+                               data_shape=(3, 24, 24), batch_size=2,
+                               rand_mirror=True)
+    # label_pad_width unset: auto-estimated from the data (reference
+    # iter_image_det_recordio.cc:337) — max width over the records
+    it2 = mio.ImageDetRecordIter(path_imgrec=prefix + ".rec",
+                                 path_imgidx=prefix + ".idx",
+                                 data_shape=(3, 24, 24), batch_size=2,
+                                 shuffle=False)
+    assert next(it2).label[0].shape == (2, 12)
+    it2.close()
+    # a too-small explicit pad width fails LOUDLY (objects would drop)
+    it3 = mio.ImageDetRecordIter(path_imgrec=prefix + ".rec",
+                                 path_imgidx=prefix + ".idx",
+                                 data_shape=(3, 24, 24), batch_size=2,
+                                 label_pad_width=7, shuffle=False)
+    with pytest.raises(Exception, match="label_pad_width"):
+        next(it3)
+    it3.close()
