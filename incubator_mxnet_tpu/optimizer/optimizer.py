@@ -21,7 +21,8 @@ from ..ops import registry as _reg
 
 __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdaGrad", "RMSProp", "AdaDelta",
            "Ftrl", "Adamax", "Nadam", "Signum", "SignSGD", "FTML", "LAMB",
-           "DCASGD", "LBSGD", "AdamW", "Updater", "get_updater", "create",
+           "DCASGD", "LBSGD", "AdamW", "LARS", "SGLD", "ccSGD",
+           "Updater", "get_updater", "create",
            "register"]
 
 _OPT_REGISTRY: Dict[str, type] = {}
@@ -621,6 +622,98 @@ class AdamW(Optimizer):
 
 # Test/compat alias (reference optimizer.py registers 'test' in unittests)
 Test = SGD
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise Adaptive Rate Scaling (You et al. 2017,
+    arxiv 1708.03888; reference optimizer.py:797): SGD+momentum whose
+    per-layer lr is scaled by the trust ratio
+    ``eta * ||w|| / (||g|| + wd * ||w|| + eps)``.  Bias and norm-layer
+    parameters (name ending bias/gamma/beta) skip the scaling, like the
+    reference.  Large-batch training is the TPU-relevant use: the trust
+    ratio keeps layer updates proportioned when the global batch grows.
+    """
+
+    def __init__(self, momentum=0.0, eta=0.001, eps=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.eps = eps
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return _nd.zeros(weight.shape, dtype=weight.dtype)
+        return None
+
+    def _skip_scaling(self, index):
+        name = self.idx2name.get(index, str(index))
+        return name.endswith(("bias", "gamma", "beta"))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        if _is_row_sparse(grad):
+            raise ValueError(
+                "LARS is a dense large-batch optimizer; densify the "
+                "row_sparse gradient (tostype('default')) before update")
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = _reg.invoke("clip", [g], a_min=-self.clip_gradient,
+                            a_max=self.clip_gradient)
+        if not self._skip_scaling(index):
+            # trust ratio stays on device (scalar NDArray broadcast);
+            # selection must be a real where — arithmetic masking makes
+            # 0*inf = NaN when a gradient is all zeros
+            w_norm = _reg.invoke("norm", [weight])
+            g_norm = _reg.invoke("norm", [g])
+            ratio = (self.eta * w_norm
+                     / (g_norm + wd * w_norm + self.eps))
+            both = (w_norm > 0) * (g_norm > 0)
+            one = _nd.ones((1,), dtype=weight.dtype)
+            lr_t = lr * _reg.invoke("where", [both, ratio.reshape((1,)),
+                                              one])
+        else:
+            lr_t = lr
+        # lr rides INSIDE the momentum accumulator (reference LARS
+        # update_multi_precision): m = mu*m + lr_layer*(g + wd*w)
+        step = lr_t * (g + wd * weight)
+        if state is not None:
+            state._data = (self.momentum * state + step)._data
+            step = state
+        weight._data = (weight - step)._data
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (Welling & Teh 2011;
+    reference optimizer.py:1458): half-step SGD plus N(0, sqrt(lr))
+    noise, so iterates sample the posterior instead of converging."""
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = _reg.invoke("clip", [g], a_min=-self.clip_gradient,
+                            a_max=self.clip_gradient)
+        from .. import random as _random
+
+        noise = _random.normal(0, float(lr) ** 0.5, shape=weight.shape,
+                               dtype=weight.dtype)
+        weight._data = (weight - (lr / 2) * (g + wd * weight)
+                        + noise)._data
+
+
+@register
+class ccSGD(SGD):  # noqa: N801 - reference-parity name
+    """[DEPRECATED in the reference too] alias of SGD
+    (optimizer.py:1488), kept for checkpoint/config compatibility."""
 
 
 class Updater:

@@ -1,0 +1,80 @@
+"""Optimizer parity additions (reference: python/mxnet/optimizer/
+optimizer.py — LARS :797, SGLD :1458, ccSGD :1488; the rest of the
+optimizer battery lives in test_op_sweep + module/gluon training
+tests)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+def test_lars_trust_ratio_and_convergence():
+    """LARS (optimizer.py:797): per-layer lr scaled by
+    eta*||w||/(||g||+wd*||w||+eps); bias/gamma/beta names skip scaling;
+    lr rides inside the momentum accumulator."""
+    mx.random.seed(0)
+    opt = mx.optimizer.create("lars", learning_rate=1.0, eta=0.1,
+                              momentum=0.9,
+                              param_idx2name={0: "fc_weight", 1: "fc_bias"})
+    rng = np.random.RandomState(0)
+    w_true = rng.rand(4).astype(np.float32)
+    w = nd.array(np.full(4, 0.01, np.float32))
+    b = nd.array(np.zeros(1, np.float32))
+    states = {0: opt.create_state(0, w), 1: opt.create_state(1, b)}
+    X = rng.rand(64, 4).astype(np.float32)
+    y = X @ w_true + 0.5
+    first_err = None
+    for _ in range(300):
+        pred = nd.array(X).dot(w.reshape((4, 1))).reshape((64,)) + b
+        err = pred - nd.array(y)
+        gw = nd.array(X).transpose().dot(
+            err.reshape((64, 1))).reshape((4,)) / 64
+        gb = err.mean().reshape((1,))
+        if first_err is None:
+            first_err = float((err * err).mean().asscalar())
+        opt.update(0, w, gw, states[0])
+        opt.update(1, b, gb, states[1])
+    final = float(((w.asnumpy() - w_true) ** 2).sum()
+                  + (b.asnumpy()[0] - 0.5) ** 2)
+    assert final < 0.2, final
+    # the skip list: a 'bias' param updates as plain SGD (no ratio) —
+    # one step from zero weights moves by exactly lr*grad
+    opt2 = mx.optimizer.create("lars", learning_rate=0.5,
+                               param_idx2name={0: "x_bias"})
+    p = nd.array(np.zeros(3, np.float32))
+    g = nd.array(np.ones(3, np.float32))
+    opt2.update(0, p, g, opt2.create_state(0, p))
+    np.testing.assert_allclose(p.asnumpy(), -0.5 * np.ones(3), rtol=1e-6)
+
+
+def test_sgld_samples_around_optimum():
+    """SGLD (optimizer.py:1458): half-step gradient descent plus
+    N(0, sqrt(lr)) noise — iterates land NEAR the optimum, not on it."""
+    mx.random.seed(0)
+    opt = mx.optimizer.create("sgld", learning_rate=0.01)
+    w = nd.array(np.zeros(2, np.float32))
+    target = np.array([1.0, -2.0], np.float32)
+    for _ in range(400):
+        g = w - nd.array(target)  # quadratic bowl gradient
+        opt.update(0, w, g, None)
+    dist = float(((w.asnumpy() - target) ** 2).sum())
+    assert dist < 0.5, dist
+    # noise means it does NOT converge exactly
+    assert dist > 1e-8
+
+
+def test_ccsgd_is_sgd_alias():
+    opt = mx.optimizer.create("ccsgd", learning_rate=0.1, momentum=0.9)
+    assert isinstance(opt, mx.optimizer.SGD)
+
+
+def test_lars_zero_gradient_does_not_nan():
+    """An all-zero gradient must leave the weight finite (a where-style
+    selection, not arithmetic masking: 0*inf = NaN)."""
+    opt = mx.optimizer.create("lars", learning_rate=1.0, momentum=0.9)
+    w = nd.array(np.ones(3, np.float32))
+    g = nd.array(np.zeros(3, np.float32))
+    s = opt.create_state(0, w)
+    for _ in range(2):
+        opt.update(0, w, g, s)
+    assert np.isfinite(w.asnumpy()).all(), w.asnumpy()
+    np.testing.assert_allclose(w.asnumpy(), np.ones(3), rtol=1e-6)
