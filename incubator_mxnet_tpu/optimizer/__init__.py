@@ -1,3 +1,5 @@
 """``mx.optimizer`` package."""
 from .optimizer import *  # noqa: F401,F403
 from .optimizer import __all__  # noqa: F401
+from . import contrib  # noqa: F401
+from .contrib import GroupAdaGrad  # noqa: F401

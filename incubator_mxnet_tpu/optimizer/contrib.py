@@ -1,0 +1,46 @@
+"""``mx.optimizer.contrib`` (reference:
+python/mxnet/optimizer/contrib.py — GroupAdaGrad)."""
+from __future__ import annotations
+
+from ..ndarray import ndarray as _nd
+from ..ops import registry as _reg
+from .optimizer import Optimizer, _is_row_sparse, register
+
+__all__ = ["GroupAdaGrad"]
+
+
+@register
+class GroupAdaGrad(Optimizer):
+    """AdaGrad with ONE learning rate per ROW (contrib.py:31) — the
+    embedding-table optimizer: history accumulates the per-row mean of
+    squared gradients, so every element of a row shares its adaptive
+    rate.  Weight decay is not supported, like the reference.
+
+        history += mean(square(grad), axis=1, keepdims=True)
+        weight  -= lr * grad / sqrt(history + eps)
+    """
+
+    def __init__(self, eps=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        if len(weight.shape) != 2:
+            raise ValueError("GroupAdaGrad expects 2-D (row-grouped) "
+                             "weights, got %r" % (weight.shape,))
+        return _nd.zeros((weight.shape[0], 1), dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        if self._get_wd(index):
+            raise ValueError("GroupAdaGrad does not support weight decay")
+        lr = self._get_lr(index)
+        if _is_row_sparse(grad):
+            grad = grad.todense()
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = _reg.invoke("clip", [g], a_min=-self.clip_gradient,
+                            a_max=self.clip_gradient)
+        state._data = (state + (g * g).mean(axis=1, keepdims=True))._data
+        weight._data = (weight - lr * g /
+                        (state + self.float_stable_eps).sqrt())._data

@@ -78,3 +78,27 @@ def test_lars_zero_gradient_does_not_nan():
         opt.update(0, w, g, s)
     assert np.isfinite(w.asnumpy()).all(), w.asnumpy()
     np.testing.assert_allclose(w.asnumpy(), np.ones(3), rtol=1e-6)
+
+
+def test_group_adagrad_row_wise_rates():
+    """GroupAdaGrad (optimizer/contrib.py): the history is per-ROW, so
+    all elements of a row share one adaptive rate; wd is rejected."""
+    import pytest
+
+    opt = mx.optimizer.create("groupadagrad", learning_rate=1.0)
+    w = nd.array(np.zeros((2, 2), np.float32))
+    g = nd.array(np.array([[1.0, 1.0], [3.0, 4.0]], np.float32))
+    s = opt.create_state(0, w)
+    opt.update(0, w, g, s)
+    got = w.asnumpy()
+    # row history: mean(g^2, axis=1) = [1, 12.5]; step = g/sqrt(h+eps)
+    want = -g.asnumpy() / np.sqrt(
+        np.array([[1.0], [12.5]], np.float32) + 1e-5)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # one shared rate per row: row 0's two equal grads step equally
+    assert got[0, 0] == got[0, 1]
+    with pytest.raises(ValueError):
+        bad = mx.optimizer.create("groupadagrad", learning_rate=1.0, wd=0.1)
+        bad.update(0, w, g, s)
+    with pytest.raises(ValueError):
+        opt.create_state(0, nd.array(np.zeros(3, np.float32)))
