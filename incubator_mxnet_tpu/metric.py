@@ -14,7 +14,8 @@ import numpy as _numpy
 
 from .ndarray import NDArray
 
-__all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
+__all__ = ["EvalMetric", "CompositeEvalMetric", "PCC", "Caffe",
+           "Accuracy", "TopKAccuracy",
            "F1", "MCC", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
            "NegativeLogLikelihood", "PearsonCorrelation", "Loss", "Torch",
            "CustomMetric", "np", "create", "register"]
@@ -111,6 +112,78 @@ class EvalMetric:
 
     def __str__(self):
         return "EvalMetric: %s" % dict(self.get_name_value())
+
+
+@register
+class PCC(EvalMetric):
+    """Multiclass Pearson/Matthews correlation from a growing K x K
+    confusion matrix (reference metric.py:1528) — the multiclass MCC:
+    cov(x,y) / sqrt(cov(x,x) * cov(y,y)) over row/column marginals.
+    Local (lcm) and global (gcm) matrices track the base class's
+    local/global counter contract."""
+
+    def __init__(self, name="pcc", output_names=None, label_names=None):
+        self.k = 2
+        super().__init__(name, output_names, label_names)
+
+    def reset(self):
+        self.lcm = _numpy.zeros((getattr(self, "k", 2),) * 2)
+        self.gcm = _numpy.zeros((getattr(self, "k", 2),) * 2)
+        self.num_inst = 0
+        self.global_num_inst = 0
+        self.sum_metric = 0.0
+        self.global_sum_metric = 0.0
+
+    def reset_local(self):
+        self.lcm = _numpy.zeros((self.k,) * 2)
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def _grow(self, inc):
+        self.lcm = _numpy.pad(self.lcm, ((0, inc), (0, inc)))
+        self.gcm = _numpy.pad(self.gcm, ((0, inc), (0, inc)))
+        self.k += inc
+
+    @staticmethod
+    def _calc_mcc(cmat):
+        n = cmat.sum()
+        x = cmat.sum(axis=1)
+        y = cmat.sum(axis=0)
+        cov_xx = _numpy.sum(x * (n - x))
+        cov_yy = _numpy.sum(y * (n - y))
+        if cov_xx == 0 or cov_yy == 0:
+            return float("nan")
+        i = cmat.diagonal()
+        cov_xy = _numpy.sum(i * n - x * y)
+        return cov_xy / (cov_xx * cov_yy) ** 0.5
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            label = _numpy.asarray(_as_np(label), _numpy.int32)
+            pred = _as_np(pred)
+            # shape comparison BEFORE flattening (reference behavior):
+            # an (N, 1) pred of class ids must not be argmaxed away
+            if pred.shape != label.shape:
+                pred = pred.argmax(axis=1)
+            label = label.reshape(-1)
+            pred = _numpy.asarray(pred, _numpy.int32).reshape(-1)
+            hi = int(max(label.max(initial=0), pred.max(initial=0))) + 1
+            if hi > self.k:
+                self._grow(hi - self.k)
+            _numpy.add.at(self.lcm, (pred, label), 1)
+            _numpy.add.at(self.gcm, (pred, label), 1)
+            self.num_inst += label.size
+            self.global_num_inst += label.size
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self._calc_mcc(self.lcm))
+
+    def get_global(self):
+        if self.global_num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self._calc_mcc(self.gcm))
 
 
 @register
@@ -383,6 +456,14 @@ class Loss(EvalMetric):
 @register
 class Torch(Loss):
     def __init__(self, name="torch", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+
+@register
+class Caffe(Torch):
+    """Dummy metric slot for caffe criterion layers (metric.py:1704)."""
+
+    def __init__(self, name="caffe", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
 

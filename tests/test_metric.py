@@ -1,0 +1,55 @@
+"""Metric additions (reference: python/mxnet/metric.py — PCC :1528,
+Caffe :1704; the rest of the metric battery lives in
+test_observability)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+def test_pcc_multiclass_and_binary_matches_mcc():
+    """PCC (reference metric.py:1528): multiclass Matthews correlation
+    over a growing confusion matrix; on binary data it equals MCC."""
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, 2, 64).astype(np.float32)
+    scores = rng.rand(64, 2).astype(np.float32)
+    pcc = mx.metric.PCC()
+    mcc = mx.metric.MCC()
+    pcc.update([nd.array(labels)], [nd.array(scores)])
+    mcc.update([nd.array(labels)], [nd.array(scores)])
+    np.testing.assert_allclose(pcc.get()[1], mcc.get()[1], rtol=1e-6)
+    # multiclass: perfect prediction = +1, and the matrix grows past k=2
+    p2 = mx.metric.PCC()
+    lab = nd.array(np.array([0, 1, 2, 3, 2, 1], np.float32))
+    p2.update([lab], [nd.array(np.eye(4, dtype=np.float32)
+                               [[0, 1, 2, 3, 2, 1]])])
+    assert p2.get()[1] == 1.0 and p2.k == 4
+    p2.reset()
+    assert np.isnan(p2.get()[1])
+
+
+def test_caffe_metric_averages_losses():
+    m = mx.metric.Caffe()
+    m.update(None, [nd.array(np.array([2.0, 4.0], np.float32))])
+    assert m.get() == ("caffe", 3.0)
+
+
+def test_pcc_global_survives_local_reset():
+    """get_global must keep the epoch confusion matrix after
+    reset_local (the reference's separate gcm)."""
+    m = mx.metric.PCC()
+    lab = nd.array(np.array([0, 1, 1, 0], np.float32))
+    m.update([lab], [nd.array(np.eye(2, dtype=np.float32)[[0, 1, 1, 0]])])
+    g1 = m.get_global()[1]
+    m.reset_local()
+    assert np.isnan(m.get()[1])
+    assert m.get_global()[1] == g1 == 1.0
+    # (N,1) class-id preds are NOT argmaxed away (shape compare happens
+    # before flattening)
+    m2 = mx.metric.PCC()
+    m2.update([nd.array(np.array([[0], [1]], np.float32))],
+              [nd.array(np.array([[0], [1]], np.float32))])
+    assert m2.get()[1] == 1.0
+    # numpy inputs accepted like sibling metrics (_as_np path)
+    m3 = mx.metric.PCC()
+    m3.update([np.array([0, 1])], [np.array([[.9, .1], [.1, .9]])])
+    assert m3.get()[1] == 1.0
