@@ -19,7 +19,8 @@ from .ndarray import NDArray
 
 __all__ = ["InitDesc", "Initializer", "Zero", "One", "Constant", "Uniform",
            "Normal", "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear",
-           "LSTMBias", "Mixed", "register", "registry_create"]
+           "LSTMBias", "Mixed", "Load", "FusedRNN", "register",
+           "registry_create"]
 
 _REGISTRY = {}
 
@@ -252,3 +253,88 @@ class Mixed:
                 init(desc, arr)
                 return
         raise ValueError("Parameter name %s did not match any pattern" % desc)
+
+
+class Load:
+    """Initialize parameters from a ``.params`` file or a name->NDArray
+    dict (reference initializer.py:319); ``arg:``/``aux:`` prefixes are
+    stripped; unmatched names fall back to ``default_init`` or raise."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        if isinstance(param, str):
+            from .ndarray.utils import load as _load
+            param = _load(param)
+        self.param = {}
+        for name, arr in dict(param).items():
+            key = name[4:] if name.startswith(("arg:", "aux:")) else name
+            self.param[key] = arr
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, desc, arr):
+        name = str(desc)
+        if name in self.param:
+            src = self.param[name]
+            if tuple(arr.shape) != tuple(src.shape):
+                raise ValueError(
+                    "Parameter %s cannot be initialized from loading: "
+                    "target %s vs loaded %s"
+                    % (name, arr.shape, src.shape))
+            arr[:] = src
+            if self.verbose:
+                import logging
+                logging.info("Initialized %s by loading", name)
+        else:
+            if self.default_init is None:
+                raise ValueError(
+                    "Cannot initialize %s: not in the loaded params and "
+                    "no default initializer provided" % name)
+            self.default_init(desc, arr)
+
+
+@register
+class FusedRNN(Initializer):
+    """Initializer for the fused RNN's packed parameter blob (reference
+    initializer.py:720): unpack per-gate weights through FusedRNNCell,
+    apply ``init`` (or the LSTM forget-gate bias), repack."""
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        if isinstance(init, str):
+            init = registry_create(init)
+        super().__init__(init=None if init is None else
+                         type(init).__name__, num_hidden=num_hidden,
+                         num_layers=num_layers, mode=mode,
+                         bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        from .rnn import rnn_cell
+
+        cell = rnn_cell.FusedRNNCell(
+            self._num_hidden, self._num_layers, self._mode,
+            self._bidirectional, forget_bias=self._forget_bias, prefix="")
+        args = cell.unpack_weights({"parameters": arr})
+        h = self._num_hidden
+        gates = cell._gate_names
+        init = self._init if self._init is not None else Uniform(0.07)
+        for name in args:
+            # apply the init PER GATE slice, like the reference's
+            # per-gate unpack: shape-sensitive inits (Xavier fans,
+            # Orthogonal) must see the (h, in) gate matrix, not the
+            # stacked (ngates*h, in) block
+            for g, gate in enumerate(gates):
+                sl = args[name][g * h:(g + 1) * h]
+                init(InitDesc(name.replace("_weight", gate + "_weight")
+                              .replace("_bias", gate + "_bias")), sl)
+                args[name][g * h:(g + 1) * h] = sl
+            if self._mode == "lstm" and name.endswith("bias"):
+                f = gates.index("_f")
+                args[name][f * h:(f + 1) * h] = self._forget_bias
+        arr[:] = cell.pack_weights(args)["parameters"]

@@ -236,3 +236,44 @@ def test_split_and_load():
     data = nd.arange(0, 12).reshape(6, 2)
     slices = gluon.split_and_load(data, [mx.cpu(0), mx.cpu(0)])
     assert len(slices) == 2 and slices[0].shape == (3, 2)
+
+
+def test_load_and_fused_rnn_initializers():
+    """Load (initializer.py:319): init from a name->array dict with
+    arg:/aux: stripping and default fallback.  FusedRNN (:720): unpack
+    the packed blob, apply the inner init, pin the LSTM forget-gate
+    bias slice, repack."""
+    import numpy as np
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.rnn import rnn_cell
+
+    init = mx.init.Load({"arg:w": nd.array(np.full((2, 2), 7.0, np.float32))},
+                        default_init=mx.init.Zero())
+    w = nd.array(np.ones((2, 2), np.float32))
+    init("w", w)
+    np.testing.assert_array_equal(w.asnumpy(), np.full((2, 2), 7.0))
+    other = nd.array(np.ones(3, np.float32))
+    init("other", other)
+    np.testing.assert_array_equal(other.asnumpy(), np.zeros(3))
+
+    # FusedRNN: build a real packed blob via the cell, re-init it
+    cell = rnn_cell.FusedRNNCell(4, 1, "lstm", prefix="")
+    unpacked = {"l0_i2h_weight": nd.array(np.zeros((16, 3), np.float32)),
+                "l0_h2h_weight": nd.array(np.zeros((16, 4), np.float32)),
+                "l0_i2h_bias": nd.array(np.zeros(16, np.float32)),
+                "l0_h2h_bias": nd.array(np.zeros(16, np.float32))}
+    packed = cell.pack_weights(unpacked)["parameters"]
+    fr = mx.init.FusedRNN(mx.init.Constant(0.25), 4, 1, "lstm",
+                          forget_bias=2.0)
+    fr._init_weight(mx.init.InitDesc("parameters"), packed)
+    back = cell.unpack_weights({"parameters": packed})
+    np.testing.assert_allclose(back["l0_i2h_weight"].asnumpy(),
+                               np.full((16, 3), 0.25))
+    bias = back["l0_i2h_bias"].asnumpy()
+    # gate order (i, f, c, o): the f slice carries the forget bias; the
+    # other gates route through the suffix-based bias init (zeros),
+    # exactly like the reference's per-gate flow
+    np.testing.assert_allclose(bias[4:8], np.full(4, 2.0))
+    np.testing.assert_allclose(bias[:4], np.zeros(4))
