@@ -70,6 +70,16 @@ size_t NumElems(NDArrayHandle h) {
 
 // ---- op callbacks (CustomOpCallbacks order: delete, forward, backward)
 
+// Ownership of every handle transfers to the callback (the engine
+// allocates per-call NDArrays, custom.cc ForwardEx/BackwardEx); free
+// each one via MXNDArrayFree once done — the underlying buffers live on
+// in the graph's own NDArrays.
+void FreeAll(int size, void** ptrs) {
+  for (int i = 0; i < size; ++i) {
+    Check(MXNDArrayFree(ptrs[i]), "MXNDArrayFree(callback handle)");
+  }
+}
+
 int Forward(int size, void** ptrs, int* tags, const int* /*reqs*/,
             const int /*is_train*/, void* /*state*/) {
   NDArrayHandle in = nullptr, out = nullptr;
@@ -82,6 +92,7 @@ int Forward(int size, void** ptrs, int* tags, const int* /*reqs*/,
   Check(MXNDArraySyncCopyToCPU(in, x.data(), n), "fwd CopyToCPU");
   for (float& v : x) v = v * v;
   Check(MXNDArraySyncCopyFromCPU(out, x.data(), n), "fwd CopyFromCPU");
+  FreeAll(size, ptrs);
   return 1;
 }
 
@@ -100,6 +111,7 @@ int Backward(int size, void** ptrs, int* tags, const int* /*reqs*/,
   Check(MXNDArraySyncCopyToCPU(og, g.data(), n), "bwd CopyToCPU g");
   for (size_t i = 0; i < n; ++i) g[i] = 2.0f * x[i] * g[i];
   Check(MXNDArraySyncCopyFromCPU(ig, g.data(), n), "bwd CopyFromCPU");
+  FreeAll(size, ptrs);
   return 1;
 }
 

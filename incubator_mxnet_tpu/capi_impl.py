@@ -1577,9 +1577,12 @@ def custom_op_register_c(op_type: str, creator_capsule, tr: dict) -> None:
     class _CCustomOp(_op.CustomOp):
         """Stateful kernel driving the C forward/backward callbacks.
 
-        Handles passed to the callbacks are live NDArrays, borrowed for
-        the duration of the call; the callee mutates outputs through the
-        MXNDArray* C surface (fwd tags 0=in/1=out/4=aux, bwd tags
+        OWNERSHIP of every handle passed to a callback transfers to the
+        callee (the C trampoline INCREFs each one, matching the
+        reference's per-callback `new NDArray` — custom.cc ForwardEx/
+        BackwardEx); a conforming callee frees them via MXNDArrayFree.
+        The callee mutates outputs through the MXNDArray* C surface
+        before freeing (fwd tags 0=in/1=out/4=aux, bwd tags
         3=ograd/0=in/1=out/2=igrad/4=aux — custom.cc:308,373)."""
 
         def __init__(self, oph):
@@ -1683,7 +1686,9 @@ def custom_op_register_c(op_type: str, creator_capsule, tr: dict) -> None:
 def custom_function_record(inputs, outputs, fn_capsule, trampoline) -> None:
     """Record a C custom autograd function on the tape: the node's
     pullback calls CustomFunctionBackward with ptrs = [ograds..,
-    igrads..] and per-igrad write reqs (c_api_function.cc Backward)."""
+    igrads..] and per-igrad write reqs (c_api_function.cc Backward).
+    Handle ownership transfers to the callback (INCREF'd by the C
+    trampoline); conforming callees free each via MXNDArrayFree."""
     from . import autograd as ag
 
     if not ag.is_recording():

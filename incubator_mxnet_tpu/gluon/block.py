@@ -470,6 +470,7 @@ class HybridBlock(Block):
                 full[i] = NDArray(v)
             nd_params = {n: NDArray(v) for n, v in zip(pnames, pvals)}
             before = dict(tc.aux_writes)
+            n_aux_loss = len(tc.aux_losses)
             _REMAT_STATE.active = True
             try:
                 out = self.hybrid_forward(F, *full, **nd_params)
@@ -496,11 +497,18 @@ class HybridBlock(Block):
                     shape_meta["aux"].append(h)
                     writes.append(v)
                     tc.aux_writes[k] = before[k]
-            return outs, writes
+            # aux losses (MoE load balancing) registered inside the
+            # checkpoint also carry inner tracers: lift them out as
+            # outputs and re-register in the outer trace
+            losses = tc.aux_losses[n_aux_loss:]
+            del tc.aux_losses[n_aux_loss:]
+            return outs, writes, losses
 
-        outs, writes = jax.checkpoint(inner)(arr_vals, pvals)
+        outs, writes, losses = jax.checkpoint(inner)(arr_vals, pvals)
         for h, v in zip(shape_meta["aux"], writes):
             tc.write_aux(h, v)
+        for al in losses:
+            tc.add_aux_loss(al)
         return jax.tree.unflatten(shape_meta["treedef"],
                                   [NDArray(o) for o in outs])
 

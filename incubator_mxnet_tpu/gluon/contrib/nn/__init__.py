@@ -7,13 +7,14 @@ program IS the cross-device reduction.
 """
 from __future__ import annotations
 
+from .... import tracing
 from ...block import HybridBlock
 from ...nn import BatchNorm, Embedding, HybridSequential, \
     Sequential
 
 __all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
            "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
-           "PixelShuffle3D"]
+           "PixelShuffle3D", "MoEFFN"]
 
 
 class Concurrent(Sequential):
@@ -144,3 +145,92 @@ class PixelShuffle3D(_PixelShuffle):
 
     def __init__(self, factor):
         super().__init__(factor, 3)
+
+
+class MoEFFN(HybridBlock):
+    """Mixture-of-experts FFN layer (token-choice, top-k router) over
+    ``..parallel.moe.moe_ffn``.
+
+    Not in the reference (closest: group2ctx model parallelism); here the
+    expert dim is a first-class parameter axis, so sharding the expert
+    parameters with ``P('ep', ...)`` in ``make_train_step``'s
+    ``param_shardings`` turns the dispatch/combine einsums into
+    all-to-alls over the ``ep`` mesh axis (GSPMD).
+
+    During a traced training forward (the fused train step), the
+    Switch-style load-balancing loss — weighted by ``aux_loss_weight`` —
+    is registered on the trace context and added to the training
+    objective by the step, so router-balance gradients flow through the
+    SAME single XLA program.  ``capacity_factor`` bounds per-expert load;
+    overflowed routing decisions are dropped from the combine (the
+    pre-capacity decisions still feed the aux loss).
+    """
+
+    def __init__(self, hidden_size, num_experts, top_k=1,
+                 capacity_factor=None, aux_loss_weight=1e-2, in_units=0,
+                 dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        self._hidden = hidden_size
+        self._num_experts = num_experts
+        self._top_k = top_k
+        self._capacity_factor = capacity_factor
+        self._aux_loss_weight = aux_loss_weight
+        with self.name_scope():
+            self.gate_weight = self.params.get(
+                "gate_weight", shape=(in_units, num_experts), dtype=dtype,
+                allow_deferred_init=True)
+            self.expert_w1 = self.params.get(
+                "expert_w1", shape=(num_experts, in_units, hidden_size),
+                dtype=dtype, allow_deferred_init=True)
+            self.expert_b1 = self.params.get(
+                "expert_b1", shape=(num_experts, hidden_size), dtype=dtype,
+                init="zeros")
+            self.expert_w2 = self.params.get(
+                "expert_w2", shape=(num_experts, hidden_size, in_units),
+                dtype=dtype, allow_deferred_init=True)
+            self.expert_b2 = self.params.get(
+                "expert_b2", shape=(num_experts, in_units), dtype=dtype,
+                init="zeros", allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        d = int(x.shape[-1])
+        e, h = self._num_experts, self._hidden
+        self.gate_weight.shape = (d, e)
+        self.expert_w1.shape = (e, d, h)
+        self.expert_w2.shape = (e, h, d)
+        self.expert_b2.shape = (e, d)
+
+    def expert_shardings(self, axis_name="ep"):
+        """``param_shardings`` entries placing the expert dim on
+        ``axis_name`` (gate replicated) — pass to make_train_step."""
+        from ....parallel import P
+
+        return {self.expert_w1.name: P(axis_name, None, None),
+                self.expert_b1.name: P(axis_name, None),
+                self.expert_w2.name: P(axis_name, None, None),
+                self.expert_b2.name: P(axis_name, None)}
+
+    def hybrid_forward(self, F, x, gate_weight, expert_w1, expert_b1,
+                       expert_w2, expert_b2):  # noqa: N803
+        from ....ndarray import NDArray
+        from ....parallel.moe import moe_ffn
+
+        if not isinstance(x, NDArray):
+            raise NotImplementedError(
+                "MoEFFN has no symbolic (Symbol) path; hybridize via the "
+                "fused train step instead")
+        xv = x._data
+        lead = xv.shape[:-1]
+        tokens = xv.reshape((-1, xv.shape[-1]))
+        tc = tracing.current_trace()
+        want_aux = (tc is not None and tc.training
+                    and self._aux_loss_weight)
+        out = moe_ffn(tokens, gate_weight._data, expert_w1._data,
+                      expert_b1._data, expert_w2._data, expert_b2._data,
+                      top_k=self._top_k,
+                      capacity_factor=self._capacity_factor,
+                      return_aux=bool(want_aux))
+        if want_aux:
+            out, aux = out
+            tc.add_aux_loss(self._aux_loss_weight * aux)
+        return NDArray(out.reshape(lead + (out.shape[-1],)))

@@ -135,6 +135,70 @@ class Trainer:
             p.zero_grad()
 
     # ------------------------------------------------------------------
+    def make_fused_step(self, net, loss_fn, mesh=None, batch_axis="dp",
+                        param_shardings=None, compute_dtype=None,
+                        pipeline_stages=None, num_micro=1,
+                        pipeline_axis="pp", pipeline_remat=False):
+        """Build a fused XLA train step from this Trainer's optimizer.
+
+        The reference's Trainer.step chain (forward → backward → kvstore
+        push/pull → optimizer) becomes ONE jitted program (fwd+bwd+
+        allreduce+update, ..parallel.train_step).  ``pipeline_stages=K``
+        + ``num_micro=M`` additionally runs the stacked ``net`` as a
+        K-stage SPMD pipeline over the mesh's ``pipeline_axis`` with
+        microbatch gradient accumulation — the Gluon surface for
+        pipelined training::
+
+            trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                                    {'learning_rate': 0.1, 'momentum': .9})
+            step = trainer.make_fused_step(net, loss_fn, mesh=mesh,
+                                           pipeline_stages=4, num_micro=8)
+            loss = step(x, y)
+
+        The returned TrainStep owns its optimizer state; mixing its calls
+        with eager ``Trainer.step`` updates on the same params is
+        unsupported.
+        """
+        from ..parallel.train_step import FunctionalOptimizer, TrainStep
+
+        opt = self._optimizer
+        name = type(opt).__name__.lower()
+        # settings the fused step cannot honor must fail loudly, not
+        # silently diverge from Trainer.step semantics
+        if getattr(opt, "lr_scheduler", None) is not None:
+            raise ValueError(
+                "make_fused_step snapshots the learning rate at build "
+                "time; an lr_scheduler would be silently frozen — drive "
+                "the schedule by rebuilding the step or setting "
+                "step.opt.lr between epochs instead")
+        if self._scale != 1.0:
+            raise ValueError(
+                "rescale_grad is not applied by the fused step (its loss "
+                "is already a mean over the batch); remove it or scale "
+                "the loss function")
+        kw = dict(learning_rate=float(opt.learning_rate),
+                  wd=float(getattr(opt, "wd", 0.0) or 0.0),
+                  clip_gradient=float(
+                      getattr(opt, "clip_gradient", None) or -1.0))
+        if name == "sgd":
+            kw["momentum"] = float(getattr(opt, "momentum", 0.0) or 0.0)
+        elif name in ("adam", "lamb", "adamw"):
+            kw.update(beta1=float(getattr(opt, "beta1", 0.9)),
+                      beta2=float(getattr(opt, "beta2", 0.999)),
+                      epsilon=float(getattr(opt, "epsilon", 1e-8)))
+        else:
+            raise ValueError(
+                "no fused-step mapping for optimizer %r (supported: sgd, "
+                "adam, lamb, adamw)" % name)
+        fopt = FunctionalOptimizer(name, **kw)
+        return TrainStep(net, loss_fn, fopt, compute_dtype=compute_dtype,
+                         mesh=mesh, batch_axis=batch_axis,
+                         param_shardings=param_shardings,
+                         pipeline_stages=pipeline_stages,
+                         num_micro=num_micro, pipeline_axis=pipeline_axis,
+                         pipeline_remat=pipeline_remat)
+
+    # ------------------------------------------------------------------
     def save_states(self, fname):
         with open(fname, "wb") as f:
             f.write(self._updaters[0].get_states(dump_optimizer=False))

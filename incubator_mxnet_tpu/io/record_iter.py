@@ -31,7 +31,7 @@ import numpy as np
 from ..base import MXNetError
 from ..ndarray import NDArray
 from ..ndarray import ndarray as _nd
-from ..recordio import MXIndexedRecordIO, MXRecordIO, unpack, unpack_img
+from ..recordio import MXIndexedRecordIO, MXRecordIO, unpack_img
 from .io import DataBatch, DataDesc, DataIter
 
 __all__ = ["ImageRecordIter", "ImageRecordUInt8Iter",
@@ -522,9 +522,11 @@ class ImageDetRecordIter(ImageRecordIter):
         self.label_pad_value = float(label_pad_value)
         if not label_pad_width:
             # reference behavior (iter_image_det_recordio.cc:337): when
-            # unset, estimate from the data — max label width over the
-            # first records; an under-estimate fails LOUDLY later via
-            # the overflow check in _label_of
+            # unset, size from the data.  EVERY record header is scanned
+            # (header-only unpack — the image payload is never decoded),
+            # so a wide record late in the file cannot overflow
+            # mid-epoch; only an explicit too-small label_pad_width can
+            # still trip the fatal overflow check in _label_of
             label_pad_width = self._estimate_label_width(args, kwargs)
         # must reach the base ctor: the prefetcher starts producing
         # (with label buffers sized label_width) inside it
@@ -538,18 +540,41 @@ class ImageDetRecordIter(ImageRecordIter):
                 "augmenters instead")
 
     @staticmethod
-    def _estimate_label_width(args, kwargs, sample=256):
+    def _estimate_label_width(args, kwargs):
+        """Exact max label width over ALL records, so a wide record late
+        in the file cannot overflow mid-epoch.
+
+        The width is the IRHeader ``flag`` field (label count; 0 means a
+        scalar label), so only the record framing + the first 4 payload
+        bytes are read and the image payload is seek'd past — O(records)
+        small reads, not O(file bytes).  The on-disk format is plain
+        (recordio.py framing), so the scan opens the file directly
+        instead of going through a reader that materializes payloads."""
+        import struct as _struct
+
+        from ..recordio import _kMagic
+
         path = kwargs.get("path_imgrec", args[0] if args else None)
-        rec = MXRecordIO(path, "r")
         width = 1
-        for _ in range(sample):
-            s = rec.read()
-            if s is None:
-                break
-            header, _ = unpack(s)
-            width = max(width,
-                        np.asarray(header.label).reshape(-1).size)
-        rec.close()
+        with open(path, "rb") as fh:
+            while True:
+                head = fh.read(8)
+                if len(head) < 8:
+                    break
+                magic, lrec = _struct.unpack("<II", head)
+                if magic != _kMagic:
+                    raise IOError("invalid magic in %s" % path)
+                cflag = lrec >> 29
+                length = lrec & ((1 << 29) - 1)
+                pad = (4 - (length & 3)) & 3
+                skip = length + pad
+                if cflag in (0, 1) and length >= 4:
+                    # single record or FIRST part of a multi-part record:
+                    # the IR header (flag = label count) leads the payload
+                    flag = _struct.unpack("I", fh.read(4))[0]
+                    width = max(width, flag if flag > 0 else 1)
+                    skip -= 4
+                fh.seek(skip, 1)  # continuation parts / image bytes
         return width
 
 

@@ -172,8 +172,11 @@ class PCC(EvalMetric):
                 self._grow(hi - self.k)
             _numpy.add.at(self.lcm, (pred, label), 1)
             _numpy.add.at(self.gcm, (pred, label), 1)
-            self.num_inst += label.size
-            self.global_num_inst += label.size
+        # ONE instance per update() call (reference metric.py:1635) —
+        # num_inst gates nan-vs-value in get() and feeds composite/
+        # speedometer instance counts, which must match the reference
+        self.num_inst += 1
+        self.global_num_inst += 1
 
     def get(self):
         if self.num_inst == 0:

@@ -6,9 +6,12 @@ with named axes and XLA collectives over ICI/DCN.  Axis convention:
 
 - ``dp`` — data parallel (batch sharding; grads all-reduced by XLA)
 - ``tp`` — tensor parallel (weight sharding inside layers)
-- ``pp`` — pipeline parallel (stage sharding, see .pipeline)
+- ``pp`` — pipeline parallel (stage sharding, see .pipeline — forward
+  AND the 1F1B/GPipe backward training schedule with microbatch grad
+  accumulation, reachable via ``make_train_step(pipeline_stages=...)``)
 - ``sp`` — sequence/context parallel (ring attention, see .ring_attention)
-- ``ep`` — expert parallel (MoE expert sharding)
+- ``ep`` — expert parallel (MoE expert sharding, see .moe — aux
+  load-balancing loss + capacity factor route through the fused step)
 """
 from __future__ import annotations
 
@@ -18,8 +21,13 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+try:  # jax >= 0.5 exports it at top level
+    from jax import shard_map
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map
+
 __all__ = ["Mesh", "NamedSharding", "PartitionSpec", "P", "make_mesh",
-           "replicated", "shard_along", "current_devices"]
+           "replicated", "shard_along", "current_devices", "shard_map"]
 
 P = PartitionSpec
 

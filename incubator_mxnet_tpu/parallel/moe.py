@@ -5,23 +5,54 @@ Not present in the reference (its closest artifact is manual group2ctx model
 parallelism); on TPU this is a natural capability of the sharding layer:
 experts live on the leading (expert) dim, annotated with P('ep', ...), and
 GSPMD turns the dispatch/combine einsums into all-to-alls over ICI.
+
+Training: ``return_aux=True`` also returns the Switch-style load-balancing
+loss ``E * sum_e f_e * p_e`` (f_e = fraction of routing decisions sent to
+expert e, p_e = mean router probability), computed on the PRE-capacity
+router decisions so overflowed tokens still push the router toward
+balance.  ``capacity_factor`` drops routing decisions beyond
+``ceil(capacity_factor * T * top_k / E)`` per expert (GShard k-major
+priority: every rank-1 choice beats any rank-2 choice); dropped tokens
+pass through with zero expert contribution, exactly like the reference
+MoE systems' overflow path.
 """
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["moe_ffn", "moe_ffn_sharded"]
+__all__ = ["moe_ffn", "moe_ffn_sharded", "load_balancing_loss"]
 
 
-def moe_ffn(x, gate_w, w1, b1, w2, b2, top_k=1):
+def load_balancing_loss(probs, top_idx):
+    """Switch/GShard auxiliary loss over router decisions.
+
+    probs: (T, E) router softmax; top_idx: (T, K) selected experts.
+    Returns ``E * sum_e f_e * p_e`` — minimized (→ 1.0) by a uniform
+    router.  The f term is a hard count (no gradient); the p term pulls
+    router probabilities toward balance.
+    """
+    num_experts = probs.shape[-1]
+    sel = jax.nn.one_hot(top_idx, num_experts, dtype=probs.dtype)  # (T,K,E)
+    f = jnp.mean(jnp.sum(sel, axis=1), axis=0) / sel.shape[1]  # (E,)
+    p = jnp.mean(probs, axis=0)  # (E,)
+    return num_experts * jnp.sum(f * p)
+
+
+def moe_ffn(x, gate_w, w1, b1, w2, b2, top_k=1, capacity_factor=None,
+            return_aux=False):
     """Token-choice MoE FFN (dense math; shardable).
 
     x: (tokens, d); gate_w: (d, E); w1: (E, d, hidden); w2: (E, hidden, d).
-    Top-k gating with softmax-renormalized weights over the selected experts.
+    Top-k gating with softmax-renormalized weights over the selected
+    experts.  With ``capacity_factor``, each expert accepts at most
+    ``ceil(capacity_factor * T * top_k / E)`` routing decisions (k-major
+    priority); the rest are dropped from the combine.  With
+    ``return_aux``, also returns the load-balancing loss.
     """
     num_experts = gate_w.shape[-1]
     logits = x @ gate_w  # (T, E)
@@ -30,24 +61,41 @@ def moe_ffn(x, gate_w, w1, b1, w2, b2, top_k=1):
     top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
     # dispatch tensor: (T, K, E) one-hot -> (E, T) combine weights
     disp = jax.nn.one_hot(top_idx, num_experts, dtype=x.dtype)  # (T,K,E)
+    if return_aux:
+        # pre-capacity decisions: overflowed tokens still teach the router
+        aux = load_balancing_loss(probs, top_idx)
+    if capacity_factor is not None:
+        tokens = x.shape[0]
+        capacity = max(1, int(math.ceil(
+            capacity_factor * tokens * top_k / num_experts)))
+        # k-major priority (GShard): all rank-1 choices outrank rank-2
+        sel = jnp.swapaxes(disp, 0, 1).reshape(top_k * tokens, num_experts)
+        pos = jnp.cumsum(sel, axis=0) - sel  # earlier decisions per expert
+        sel = sel * (pos < capacity).astype(sel.dtype)
+        disp = jnp.swapaxes(sel.reshape(top_k, tokens, num_experts), 0, 1)
     combine = jnp.einsum("tk,tke->te", top_p.astype(x.dtype), disp)  # (T,E)
     # expert compute on all tokens, masked-combined (dense formulation —
     # efficient when E is sharded over ep: einsums become a2a + local ffn)
     h = jnp.einsum("td,edf->etf", x, w1) + b1[:, None, :]
     h = jax.nn.gelu(h)
     y = jnp.einsum("etf,efd->etd", h, w2) + b2[:, None, :]
-    return jnp.einsum("etd,te->td", y, combine)
+    out = jnp.einsum("etd,te->td", y, combine)
+    if return_aux:
+        return out, aux
+    return out
 
 
 def moe_ffn_sharded(x, gate_w, w1, b1, w2, b2, mesh: Mesh, top_k=1,
-                    axis_name="ep"):
+                    axis_name="ep", capacity_factor=None, return_aux=False):
     """Run moe_ffn with experts sharded over ``axis_name`` via GSPMD."""
     e_spec = NamedSharding(mesh, P(axis_name))
     repl = NamedSharding(mesh, P())
-    fn = jax.jit(functools.partial(moe_ffn, top_k=top_k),
+    fn = jax.jit(functools.partial(moe_ffn, top_k=top_k,
+                                   capacity_factor=capacity_factor,
+                                   return_aux=return_aux),
                  in_shardings=(repl, repl, NamedSharding(mesh, P(axis_name, None, None)),
                                e_spec,
                                NamedSharding(mesh, P(axis_name, None, None)),
                                e_spec),
-                 out_shardings=repl)
+                 out_shardings=(repl, repl) if return_aux else repl)
     return fn(x, gate_w, w1, b1, w2, b2)

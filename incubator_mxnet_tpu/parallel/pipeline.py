@@ -1,32 +1,50 @@
-"""Pipeline parallelism (GPipe-style) over a ``pp`` mesh axis.
+"""Pipeline parallelism over a ``pp`` mesh axis — forward AND backward.
 
 The reference's only model parallelism is manual ``group2ctx`` layer
 placement with engine-inserted copies (SURVEY.md §2.5).  TPU-native: stages
 are sharded over the ``pp`` axis inside one SPMD program; activations flow
 stage→stage via ``lax.ppermute`` (ICI neighbor hop) in a software-pipelined
 schedule of ``num_micro + num_stages - 1`` ticks.
+
+Training: the schedule is written as a ``lax.scan`` over ticks, so the
+whole pipeline is reverse-differentiable.  ``jax.grad`` of a loss over
+``spmd_pipeline`` yields the backward pipeline schedule as the transposed
+scan — ticks run in reverse, cotangents hop stage←stage through the
+inverted ``ppermute``, and each rank accumulates its stage's parameter
+gradients across microbatches in the scan-transpose carry (the GPipe
+fill/drain schedule; 1F1B's steady state is the same tick sequence
+executed from the transpose).  Activation stash: by default the scan
+saves each tick's residuals (GPipe memory profile, ``num_micro + n - 1``
+live stage activations per rank); ``remat=True`` checkpoints the stage
+function so only stage *inputs* are stashed and the stage recomputes in
+its backward tick — the 1F1B-style memory/compute trade.  Everything is
+one jitted XLA program: zero per-microbatch Python dispatch.
 """
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Sequence
 
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["spmd_pipeline", "pipeline_apply"]
+from .mesh import shard_map  # version-compat import, one home
+
+__all__ = ["spmd_pipeline", "pipeline_apply", "stack_stage_params"]
 
 
 def spmd_pipeline(stage_fn: Callable, stage_params, microbatches,
-                  axis_name="pp"):
-    """Run a uniform-stage pipeline inside shard_map.
+                  axis_name="pp", remat=False):
+    """Run a uniform-stage pipeline inside shard_map.  Differentiable.
 
     stage_fn(params, x) -> y with y.shape == x.shape (uniform widths).
     stage_params: this device's stage parameters (already sharded).
     microbatches: (num_micro, mb, feat) — identical on every stage (stage 0
     consumes them; later stages consume ppermuted activations).
+    remat: checkpoint ``stage_fn`` so the backward ticks recompute stage
+    activations from stashed stage inputs instead of stashing every
+    intermediate (GPipe stash → 1F1B-style memory profile).
     Returns (num_micro, mb, feat) — the final-stage outputs (valid on every
     device via a masked psum broadcast).
     """
@@ -35,6 +53,7 @@ def spmd_pipeline(stage_fn: Callable, stage_params, microbatches,
     num_micro = microbatches.shape[0]
     steps = num_micro + n - 1
     perm = [(i, i + 1) for i in range(n - 1)]
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
 
     buf0 = jnp.zeros_like(microbatches[0])
     outs0 = jnp.zeros_like(microbatches)
@@ -44,11 +63,11 @@ def spmd_pipeline(stage_fn: Callable, stage_params, microbatches,
     except AttributeError:
         pass
 
-    def body(t, carry):
+    def body(carry, t):
         buf, outs = carry
         inject = microbatches[jnp.clip(t, 0, num_micro - 1)]
         x = jnp.where(idx == 0, inject, buf)
-        y = stage_fn(stage_params, x)
+        y = fn(stage_params, x)
         # stage 0 only computes for t < num_micro; stage s for s <= t < s+num_micro
         active = (t >= idx) & (t < idx + num_micro)
         y = jnp.where(active, y, buf)
@@ -56,16 +75,46 @@ def spmd_pipeline(stage_fn: Callable, stage_params, microbatches,
         is_out = (idx == n - 1) & (t >= n - 1)
         outs = outs.at[out_slot].set(jnp.where(is_out, y, outs[out_slot]))
         buf = lax.ppermute(y, axis_name, perm)
-        return buf, outs
+        return (buf, outs), None
 
-    _, outs = lax.fori_loop(0, steps, body, (buf0, outs0))
+    # scan (not fori_loop): the transpose of this scan IS the backward
+    # pipeline schedule — reversed ticks, inverted ppermute, per-rank
+    # gradient accumulation in the transpose carry
+    (_, outs), _ = lax.scan(body, (buf0, outs0), jnp.arange(steps))
     # broadcast final-stage outputs to all stages (masked psum)
     outs = jnp.where(idx == n - 1, outs, jnp.zeros_like(outs))
     return lax.psum(outs, axis_name)
 
 
+def stack_stage_params(stage_param_lists: Sequence[Sequence]):
+    """Stack per-stage parameter lists along a new leading (pp) axis.
+
+    ``stage_param_lists[s][i]`` is stage ``s``'s i-th parameter array;
+    stages must be structurally congruent (same count, shapes, dtypes) —
+    the SPMD pipeline runs ONE stage program with per-rank parameter
+    values, so heterogeneous stages cannot be expressed.  Returns a list
+    of ``(num_stages, *param_shape)`` arrays.
+    """
+    first = stage_param_lists[0]
+    for s, plist in enumerate(stage_param_lists[1:], 1):
+        if len(plist) != len(first):
+            raise ValueError(
+                "pipeline stages must be structurally identical: stage 0 "
+                "has %d params, stage %d has %d" % (len(first), s,
+                                                    len(plist)))
+        for i, (a, b) in enumerate(zip(first, plist)):
+            if tuple(a.shape) != tuple(b.shape) or a.dtype != b.dtype:
+                raise ValueError(
+                    "pipeline stage %d param %d is %s%s; stage 0 has %s%s "
+                    "— uniform-stage SPMD pipelining needs congruent "
+                    "stages" % (s, i, b.dtype, tuple(b.shape), a.dtype,
+                                tuple(a.shape)))
+    return [jnp.stack([plist[i] for plist in stage_param_lists])
+            for i in range(len(first))]
+
+
 def pipeline_apply(stage_fn, all_stage_params, x, mesh: Mesh, num_micro=4,
-                   axis_name="pp"):
+                   axis_name="pp", remat=False):
     """Host-level: shard stage params over pp (leading axis) and run the
     pipeline on batch ``x`` split into ``num_micro`` microbatches."""
     assert x.shape[0] % num_micro == 0
@@ -73,7 +122,7 @@ def pipeline_apply(stage_fn, all_stage_params, x, mesh: Mesh, num_micro=4,
 
     def inner(params, mb):
         params = jax.tree.map(lambda p: p[0], params)  # local stage slice
-        return spmd_pipeline(stage_fn, params, mb, axis_name)
+        return spmd_pipeline(stage_fn, params, mb, axis_name, remat=remat)
 
     pspec = P(axis_name)
     mapped = shard_map(inner, mesh=mesh,

@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+from .mesh import shard_map  # version-compat import, one home
 
 __all__ = ["attention_reference", "ring_attention", "ulysses_attention",
            "sharded_self_attention"]
@@ -140,10 +141,19 @@ def sharded_self_attention(q, k, v, mesh: Mesh, seq_axis="sp", causal=False,
     chosen SP attention as one compiled SPMD program."""
     fn = ring_attention if impl == "ring" else ulysses_attention
     spec = P(None, None, seq_axis, None)
-    mapped = shard_map(
-        functools.partial(fn, axis_name=seq_axis, causal=causal, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        # pallas_call (flash kernel in the ulysses path) doesn't carry
-        # varying-mesh-axis metadata; skip the vma check
-        check_vma=False)
+    # pallas_call (flash kernel in the ulysses path) doesn't carry
+    # varying-mesh-axis metadata; skip the replication/vma check
+    # (named check_vma on jax >= 0.6, check_rep on 0.4.x)
+    try:
+        mapped = shard_map(
+            functools.partial(fn, axis_name=seq_axis, causal=causal,
+                              scale=scale),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+    except TypeError:
+        mapped = shard_map(
+            functools.partial(fn, axis_name=seq_axis, causal=causal,
+                              scale=scale),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_rep=False)
     return jax.jit(mapped)(q, k, v)

@@ -18,6 +18,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import autograd, rng, tracing
 from ..ndarray import NDArray
 from ..ops import optimizer_ops as _oops
+from .pipeline import shard_map, spmd_pipeline
 
 __all__ = ["FunctionalOptimizer", "make_train_step", "TrainStep"]
 
@@ -27,12 +28,15 @@ class FunctionalOptimizer:
     optimizer update ops composed into the jitted step)."""
 
     def __init__(self, name="sgd", learning_rate=0.01, momentum=0.9, wd=0.0,
-                 beta1=0.9, beta2=0.999, epsilon=1e-8):
+                 beta1=0.9, beta2=0.999, epsilon=1e-8, clip_gradient=-1.0):
         self.name = name
         self.lr = learning_rate
         self.momentum = momentum
         self.wd = wd
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        # per-element gradient clipping, as in the reference update ops;
+        # <= 0 disables
+        self.clip_gradient = float(clip_gradient or -1.0)
 
     def init(self, param_vals: List[Any]):
         if self.name == "sgd":
@@ -51,12 +55,13 @@ class FunctionalOptimizer:
                 if self.momentum:
                     w, m = _oops._sgd_mom_update(p, g, states[i], lr=self.lr,
                                                  momentum=self.momentum,
-                                                 wd=self.wd, clip_gradient=-1.0)
+                                                 wd=self.wd, clip_gradient=self.clip_gradient)
                     new_p.append(w)
                     new_s.append(m)
                 else:
-                    new_p.append(_oops._sgd_update(p, g, lr=self.lr, wd=self.wd,
-                                                   clip_gradient=-1.0))
+                    new_p.append(_oops._sgd_update(
+                        p, g, lr=self.lr, wd=self.wd,
+                        clip_gradient=self.clip_gradient))
             elif self.name == "adam":
                 mean, var = states[i]
                 t = step_count
@@ -64,7 +69,7 @@ class FunctionalOptimizer:
                 w, m2, v2 = _oops._adam_update(p, g, mean, var, lr=lr,
                                                beta1=self.beta1, beta2=self.beta2,
                                                epsilon=self.epsilon, wd=self.wd,
-                                               clip_gradient=-1.0)
+                                               clip_gradient=self.clip_gradient)
                 new_p.append(w)
                 new_s.append((m2, v2))
             elif self.name in ("lamb", "adamw"):
@@ -73,7 +78,7 @@ class FunctionalOptimizer:
                                                 beta2=self.beta2,
                                                 epsilon=self.epsilon,
                                                 t=step_count, wd=self.wd,
-                                                clip_gradient=-1.0)
+                                                clip_gradient=self.clip_gradient)
                 w = _oops._lamb_phase2(p, gw, None, lr=self.lr)
                 new_p.append(w)
                 new_s.append((m2, v2))
@@ -93,7 +98,9 @@ class TrainStep:
                  compute_dtype=None, mesh: Optional[Mesh] = None,
                  batch_axis: str = "dp",
                  param_shardings: Optional[Dict[str, Any]] = None,
-                 donate: bool = True):
+                 donate: bool = True, pipeline_stages: Optional[int] = None,
+                 num_micro: int = 1, pipeline_axis: str = "pp",
+                 pipeline_remat: bool = False):
         self.net = net
         self.loss_fn = loss_fn
         self.opt = opt
@@ -101,6 +108,29 @@ class TrainStep:
         self.mesh = mesh
         self.batch_axis = batch_axis
         self.param_shardings = param_shardings or {}
+        self.pipeline_stages = pipeline_stages
+        self.num_micro = num_micro
+        self.pipeline_axis = pipeline_axis
+        self.pipeline_remat = pipeline_remat
+        if pipeline_stages is not None:
+            if mesh is None:
+                raise ValueError("pipeline_stages requires a mesh with a "
+                                 "%r axis" % pipeline_axis)
+            if pipeline_axis not in mesh.axis_names:
+                raise ValueError("mesh %s has no %r axis for pipelining"
+                                 % (mesh, pipeline_axis))
+            if mesh.shape[pipeline_axis] != pipeline_stages:
+                raise ValueError(
+                    "pipeline_stages=%d but mesh axis %r has size %d"
+                    % (pipeline_stages, pipeline_axis,
+                       mesh.shape[pipeline_axis]))
+            if num_micro < 1:
+                raise ValueError("num_micro must be >= 1")
+        # stage partition: per-stage lists of indices into the gp list,
+        # plus the stage-0 blocks used to trace the (uniform) stage program
+        self._stage_idx = None
+        self._stage0_blocks = None
+        self._stage0_gp = None
         self._gp = None
         self._aux = None
         self._opt_state = None
@@ -121,11 +151,83 @@ class TrainStep:
         params = list(self.net.collect_params().values())
         self._gp = [p for p in params if p.grad_req != "null"]
         self._aux = [p for p in params if p.grad_req == "null"]
+        if self.pipeline_stages is not None:
+            self._collect_pipeline()
 
-    def _build(self):
+    def _collect_pipeline(self):
+        """Partition the net's children into ``pipeline_stages`` contiguous,
+        structurally congruent stages and map each stage's params back to
+        their positions in the flat gp list (so donation/optimizer layout
+        is identical to the non-pipelined step)."""
+        k = self.pipeline_stages
+        try:
+            children = list(self.net)
+        except TypeError:
+            raise ValueError(
+                "pipeline_stages needs an iterable stacked net "
+                "(e.g. HybridSequential); %r is not iterable"
+                % type(self.net).__name__)
+        if not children or len(children) % k != 0:
+            raise ValueError(
+                "cannot split %d child blocks into %d pipeline stages"
+                % (len(children), k))
+        per = len(children) // k
+        groups = [children[s * per:(s + 1) * per] for s in range(k)]
+        gp_pos = {id(p): i for i, p in enumerate(self._gp)}
+        stage_idx, stage_gp = [], []
+        for s, blocks in enumerate(groups):
+            ps = [p for b in blocks for p in b.collect_params().values()]
+            if any(p.grad_req == "null" for p in ps):
+                raise NotImplementedError(
+                    "pipeline stage %d carries auxiliary state (BatchNorm "
+                    "running stats etc.); aux writes cannot escape the "
+                    "pipelined scan — use LayerNorm/GroupNorm inside "
+                    "pipeline stages" % s)
+            gps = [p for p in ps if id(p) in gp_pos]
+            stage_gp.append(gps)
+            stage_idx.append([gp_pos[id(p)] for p in gps])
+        covered = {i for idx in stage_idx for i in idx}
+        if covered != set(range(len(self._gp))):
+            raise ValueError(
+                "net has trainable parameters outside its child blocks; "
+                "the SPMD pipeline owns the full parameter set")
+        first = stage_gp[0]
+        for s, ps in enumerate(stage_gp[1:], 1):
+            if len(ps) != len(first) or any(
+                    tuple(a.shape) != tuple(b.shape) or a.dtype != b.dtype
+                    for a, b in zip(first, ps)):
+                raise ValueError(
+                    "pipeline stages must be structurally congruent (same "
+                    "param count/shapes/dtypes per stage); stage %d "
+                    "differs from stage 0 — uniform-stage SPMD pipelining "
+                    "runs ONE stage program with per-rank values" % s)
+        self._stage_idx = stage_idx
+        self._stage0_blocks = groups[0]
+        self._stage0_gp = first
+
+    def _cast_inputs(self, pv, x):
+        """Shared dtype policy: params re-cast to the compute dtype;
+        unsigned-int inputs are raw image bytes (ImageRecordUInt8Iter) —
+        promote them so convs run in the compute dtype too."""
+        compute_dtype = self.compute_dtype
+        if compute_dtype is not None:
+            pv_c = [v.astype(compute_dtype)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v
+                    for v in pv]
+            if jnp.issubdtype(x.dtype, jnp.floating) or \
+                    jnp.issubdtype(x.dtype, jnp.unsignedinteger):
+                x_c = x.astype(compute_dtype)
+            else:
+                x_c = x
+        else:
+            pv_c = pv
+            x_c = x.astype(jnp.float32) \
+                if jnp.issubdtype(x.dtype, jnp.unsignedinteger) else x
+        return pv_c, x_c
+
+    def _make_plain_step(self):
         gp_list, aux_list = self._gp, self._aux
         net, loss_fn, opt = self.net, self.loss_fn, self.opt
-        compute_dtype = self.compute_dtype
 
         def step(p_vals, aux_vals, opt_state, x, y, key, step_count):
             # key/step_count are DEVICE-carried state (donated, updated in
@@ -135,23 +237,7 @@ class TrainStep:
             step_count = step_count + 1
             key, use_key = jax.random.split(key)
             def loss_of(pv):
-                if compute_dtype is not None:
-                    pv_c = [v.astype(compute_dtype)
-                            if jnp.issubdtype(v.dtype, jnp.floating) else v
-                            for v in pv]
-                    # floats re-cast to the compute dtype; unsigned ints are
-                    # raw image bytes (ImageRecordUInt8Iter) — promote them
-                    # so convs run in the compute dtype too
-                    if jnp.issubdtype(x.dtype, jnp.floating) or \
-                            jnp.issubdtype(x.dtype, jnp.unsignedinteger):
-                        x_c = x.astype(compute_dtype)
-                    else:
-                        x_c = x
-                else:
-                    pv_c = pv
-                    # raw image bytes must still become floats for the convs
-                    x_c = x.astype(jnp.float32) \
-                        if jnp.issubdtype(x.dtype, jnp.unsignedinteger) else x
+                pv_c, x_c = self._cast_inputs(pv, x)
                 tc = tracing.TraceContext(use_key, training=True)
                 for p, v in zip(gp_list, pv_c):
                     tc.bindings[id(p)] = v
@@ -173,13 +259,124 @@ class TrainStep:
                     w = tc.aux_writes.get(id(p))
                     new_aux.append(bound if w is None
                                    else w[1].astype(bound.dtype))
-                return loss._data.astype(jnp.float32), new_aux
+                loss_val = loss._data.astype(jnp.float32)
+                # aux losses registered during the forward (MoE load
+                # balancing etc.) join the objective here, so their
+                # gradients flow through the same fused program
+                for al in tc.aux_losses:
+                    loss_val = loss_val + al.astype(jnp.float32)
+                return loss_val, new_aux
 
             (loss_val, new_aux), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(p_vals)
             new_p, new_s = opt.apply(p_vals, grads, opt_state, step_count)
             return loss_val, new_p, list(new_aux), new_s, key, step_count
 
+        return step
+
+    def _make_pipeline_step(self):
+        """Pipelined fused step: forward microbatches through the SPMD
+        1F1B/GPipe schedule, backward via the scan transpose (cotangents
+        hop stage←stage through the inverted ppermute), microbatch
+        gradient accumulation on-rank, then the optimizer — ONE jitted,
+        donated XLA program, zero per-microbatch Python dispatch."""
+        loss_fn, opt = self.loss_fn, self.opt
+        mesh = self.mesh
+        pp_axis = self.pipeline_axis
+        num_micro = self.num_micro
+        remat = self.pipeline_remat
+        n_stage = self.pipeline_stages
+        stage_idx = self._stage_idx
+        stage0_blocks = self._stage0_blocks
+        stage0_gp = self._stage0_gp
+        # microbatches keep the batch sharding on their (second) batch dim
+        # when the mesh also has a dp axis — pp composes with dp/tp
+        mb_spec = P(None, self.batch_axis) \
+            if self.batch_axis in mesh.axis_names else P()
+
+        def stage_fn(sp, h):
+            # one uniform stage program, traced through stage 0's blocks
+            # with this rank's parameter values bound.  key=None: dropout
+            # inside pipeline stages would need per-stage key plumbing
+            # through the schedule — fail loudly instead of silently
+            # desynchronizing the stream
+            tc = tracing.TraceContext(None, training=True)
+            for p, v in zip(stage0_gp, sp):
+                tc.bindings[id(p)] = v
+            tracing.push_trace(tc)
+            try:
+                with autograd.pause():
+                    out = NDArray(h)
+                    for b in stage0_blocks:
+                        out = b._forward_impl(out)
+            finally:
+                tracing.pop_trace()
+            if tc.aux_losses:
+                raise NotImplementedError(
+                    "aux losses inside pipeline stages cannot escape the "
+                    "pipelined scan; place MoE blocks outside the "
+                    "pipelined net or train without pipeline_stages")
+            return out._data
+
+        def step(p_vals, aux_vals, opt_state, x, y, key, step_count):
+            step_count = step_count + 1
+            key, use_key = jax.random.split(key)
+
+            def loss_of(pv):
+                pv_c, x_c = self._cast_inputs(pv, x)
+                if x_c.shape[0] % num_micro:
+                    raise ValueError(
+                        "batch %d not divisible into num_micro=%d"
+                        % (x_c.shape[0], num_micro))
+                # per-stage params, stacked on a leading pp axis; built
+                # from the flat list so grads come back per-parameter
+                stacked = tuple(
+                    jnp.stack([pv_c[stage_idx[s][i]]
+                               for s in range(n_stage)])
+                    for i in range(len(stage0_gp)))
+                micro = x_c.reshape(
+                    (num_micro, x_c.shape[0] // num_micro) + x_c.shape[1:])
+
+                def inner(stk, mb):
+                    # stage params enter replicated and each rank slices
+                    # its own stage by axis index: feeding a jit-internal
+                    # stack into shard_map with a P(pp) in_spec miscompiles
+                    # on multi-axis meshes (jax 0.4.x GSPMD resharding);
+                    # the dynamic-slice form is exact on pp and dp x pp
+                    i = jax.lax.axis_index(pp_axis)
+                    local = [s_[i] for s_ in stk]
+                    return spmd_pipeline(stage_fn, local, mb,
+                                         axis_name=pp_axis, remat=remat)
+
+                outs = shard_map(
+                    inner, mesh=mesh,
+                    in_specs=(tuple(P() for _ in stacked), mb_spec),
+                    out_specs=mb_spec)(stacked, micro)
+                flat = outs.reshape((-1,) + outs.shape[2:])
+                tc = tracing.TraceContext(use_key, training=True)
+                tracing.push_trace(tc)
+                try:
+                    with autograd.pause():
+                        loss = loss_fn(NDArray(flat), NDArray(y))
+                        loss = loss.mean()
+                finally:
+                    tracing.pop_trace()
+                loss_val = loss._data.astype(jnp.float32)
+                for al in tc.aux_losses:
+                    loss_val = loss_val + al.astype(jnp.float32)
+                return loss_val, list(aux_vals)
+
+            (loss_val, new_aux), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(p_vals)
+            new_p, new_s = opt.apply(p_vals, grads, opt_state, step_count)
+            return loss_val, new_p, list(new_aux), new_s, key, step_count
+
+        return step
+
+    def _build(self):
+        gp_list, aux_list = self._gp, self._aux
+        step = self._make_pipeline_step() if self.pipeline_stages \
+            else self._make_plain_step()
         self._step_fn = step  # shared by the multi-step (scan) program
         donate = (0, 1, 2, 5, 6) if self._donate else ()
         if self.mesh is None:
@@ -194,7 +391,9 @@ class TrainStep:
 
         p_sh = [p_shard(p) for p in gp_list]
         aux_sh = [repl for _ in aux_list]
-        batch_sh = NamedSharding(mesh, P(self.batch_axis))
+        # a pp- or ep-only mesh has no batch axis: batches stay replicated
+        batch_sh = NamedSharding(mesh, P(self.batch_axis)) \
+            if self.batch_axis in mesh.axis_names else repl
         # opt state shards like its parameter
         if self.opt.name == "sgd" and self.opt.momentum:
             state_sh = list(p_sh)
@@ -356,7 +555,8 @@ class TrainStep:
         if self.mesh is None:
             return jax.jit(multi, donate_argnums=donate)
         p_sh, aux_sh, state_sh, batch_sh, repl = self._shardings
-        stack_sh = NamedSharding(self.mesh, P(None, self.batch_axis))
+        stack_sh = NamedSharding(self.mesh, P(None, self.batch_axis)) \
+            if self.batch_axis in self.mesh.axis_names else repl
         return jax.jit(multi, donate_argnums=donate,
                        in_shardings=(p_sh, aux_sh, state_sh, stack_sh,
                                      stack_sh, repl, repl),
@@ -387,7 +587,9 @@ class TrainStep:
                 p_vals, aux_vals = self._place_state(p_vals, aux_vals)
             from jax.sharding import NamedSharding as _NS
 
-            stack_sh = _NS(self.mesh, P(None, self.batch_axis))
+            stack_sh = _NS(self.mesh, P(None, self.batch_axis)) \
+                if self.batch_axis in self.mesh.axis_names \
+                else _NS(self.mesh, P())
             if self._multihost:
                 from jax.experimental import multihost_utils as mhu
 
@@ -443,8 +645,22 @@ class TrainStep:
 
 def make_train_step(net, loss_fn, optimizer="sgd", mesh=None, batch_axis="dp",
                     param_shardings=None, compute_dtype=None, donate=True,
-                    **opt_kwargs) -> TrainStep:
+                    pipeline_stages=None, num_micro=1, pipeline_axis="pp",
+                    pipeline_remat=False, **opt_kwargs) -> TrainStep:
+    """Build the fused train step (fwd+bwd+optimizer in one XLA program).
+
+    ``pipeline_stages=K`` + ``num_micro=M`` runs the net as a K-stage SPMD
+    pipeline over the mesh's ``pipeline_axis``: the (iterable, stacked)
+    net's children are split into K congruent stages, the batch into M
+    microbatches, and forward/backward run the software-pipelined 1F1B/
+    GPipe tick schedule with per-rank microbatch gradient accumulation —
+    still one jitted, donated program.  ``pipeline_remat=True`` recomputes
+    stage activations in the backward ticks instead of stashing them.
+    Composes with dp: a ``{'dp': d, 'pp': K}`` mesh shards microbatches
+    over dp while stages flow over pp."""
     opt = FunctionalOptimizer(optimizer, **opt_kwargs)
     return TrainStep(net, loss_fn, opt, compute_dtype=compute_dtype, mesh=mesh,
                      batch_axis=batch_axis, param_shardings=param_shardings,
-                     donate=donate)
+                     donate=donate, pipeline_stages=pipeline_stages,
+                     num_micro=num_micro, pipeline_axis=pipeline_axis,
+                     pipeline_remat=pipeline_remat)

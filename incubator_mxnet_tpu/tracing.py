@@ -40,6 +40,16 @@ class TraceContext:
         # parameter bindings: id(Parameter) -> traced array standing in for
         # the parameter's buffer inside this trace
         self.bindings: Dict[int, Any] = {}
+        # auxiliary scalar losses registered by blocks during the forward
+        # (MoE load-balancing loss etc.); the fused train step adds their
+        # sum to the task loss before differentiating
+        self.aux_losses: List[Any] = []
+
+    def add_aux_loss(self, value):
+        """Register a scalar auxiliary loss (e.g. an MoE load-balancing
+        term) to be added to the training objective by the enclosing
+        fused step."""
+        self.aux_losses.append(value)
 
     def next_key(self) -> jax.Array:
         if self.key is None:

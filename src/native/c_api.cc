@@ -4693,8 +4693,11 @@ MXTPU_API int MXKVStoreSendCommmandToServers(KVStoreHandle kv, int cmd_id,
 // src/operator/custom/custom.cc:70-119, src/c_api/c_api_function.cc:186).
 // The reference dispatches these callbacks on dedicated engine threads;
 // this runtime's host path is synchronous, so the async callback-thread
-// discipline collapses to direct calls.  Callbacks receive live NDArray
-// handles, valid for the duration of the call, and act on them through
+// discipline collapses to direct calls.  Ownership of every NDArray
+// handle passed to a forward/backward callback TRANSFERS to the callee
+// (the reference allocates `new NDArray` per handle in custom.cc
+// ForwardEx/BackwardEx and c_api_function.cc Backward); a conforming
+// callee frees each handle via MXNDArrayFree after acting on it through
 // the same MXNDArray* surface a reference custom-op library uses.
 // ---------------------------------------------------------------------------
 
@@ -5011,8 +5014,12 @@ PyObject* CCustomPropCreateOperator(PyObject*, PyObject* args) {
 }
 
 // (op_capsule, which, [handles], [tags], [reqs], is_train) — the
-// forward/backward CustomOpFBFunc call; handles are borrowed for the
-// duration of the call (the reference engine owns its copies likewise)
+// forward/backward CustomOpFBFunc call.  Ownership of each handle
+// transfers to the callee (reference custom.cc ForwardEx/BackwardEx
+// allocate per-callback NDArrays the callee frees via MXNDArrayFree),
+// so every handle is INCREF'd before the call; a callee that never
+// frees leaks the ref, exactly as it would leak the reference's
+// `new NDArray`.
 PyObject* CCustomOpCall(PyObject*, PyObject* args) {
   PyObject* cap = nullptr;
   int which = 0;
@@ -5030,7 +5037,9 @@ PyObject* CCustomOpCall(PyObject*, PyObject* args) {
   std::vector<void*> ptrs(n);
   std::vector<int> tagv(n);
   for (Py_ssize_t i = 0; i < n; ++i) {
-    ptrs[i] = PyList_GetItem(handles, i);
+    PyObject* h = PyList_GetItem(handles, i);
+    Py_INCREF(h);  // ownership transfers; callee frees via MXNDArrayFree
+    ptrs[i] = h;
     tagv[i] = static_cast<int>(PyLong_AsLong(PyList_GetItem(tags, i)));
   }
   Py_ssize_t nr = PyList_Size(reqs);
@@ -5049,7 +5058,9 @@ PyObject* CCustomOpCall(PyObject*, PyObject* args) {
   Py_RETURN_NONE;
 }
 
-// (fn_capsule, num_ograds, num_igrads, [handles], [reqs], is_train)
+// (fn_capsule, num_ograds, num_igrads, [handles], [reqs], is_train) —
+// handle ownership transfers to the callee exactly as in CCustomOpCall
+// (reference c_api_function.cc Backward allocates per-call NDArrays)
 PyObject* CCustomFunctionCall(PyObject*, PyObject* args) {
   PyObject* cap = nullptr;
   int n_og = 0;
@@ -5066,7 +5077,9 @@ PyObject* CCustomFunctionCall(PyObject*, PyObject* args) {
   Py_ssize_t n = PyList_Size(handles);
   std::vector<void*> ptrs(n);
   for (Py_ssize_t i = 0; i < n; ++i) {
-    ptrs[i] = PyList_GetItem(handles, i);
+    PyObject* h = PyList_GetItem(handles, i);
+    Py_INCREF(h);  // ownership transfers; callee frees via MXNDArrayFree
+    ptrs[i] = h;
   }
   Py_ssize_t nr = PyList_Size(reqs);
   std::vector<int> reqv(nr);

@@ -1444,12 +1444,19 @@ def test_custom_op_register_abi(lib):
         ctypes.memmove(ptr, buf.ctypes.data_as(ctypes.c_void_p),
                        buf.nbytes)
 
+    def _free_all(size, ptrs):
+        # handle ownership transferred to this callback (reference ABI:
+        # per-callback NDArrays, custom.cc ForwardEx) — free every one
+        for i in range(size):
+            _check(lib, lib.MXNDArrayFree(ctypes.c_void_p(ptrs[i])))
+
     @FB
     def forward(size, ptrs, tags, reqs, is_train, _state):
         ins = [ptrs[i] for i in range(size) if tags[i] == 0]
         outs = [ptrs[i] for i in range(size) if tags[i] == 1]
         _nd_scale(lib, ctypes.c_void_p(ins[0]), 2.0,
                   ctypes.c_void_p(outs[0]))
+        _free_all(size, ptrs)
         return 1
     keep.append(forward)
 
@@ -1459,6 +1466,7 @@ def test_custom_op_register_abi(lib):
         igs = [ptrs[i] for i in range(size) if tags[i] == 2]
         _nd_scale(lib, ctypes.c_void_p(ogs[0]), 2.0,
                   ctypes.c_void_p(igs[0]))
+        _free_all(size, ptrs)
         return 1
     keep.append(backward)
 
@@ -1536,6 +1544,9 @@ def test_custom_function_record_abi(lib):
         buf *= 3.0  # d/dx of the 'pretend' function y = 3x
         _check(lib, lib.MXNDArraySyncCopyFromCPU(
             ig, buf.ctypes.data_as(ctypes.c_void_p), 4))
+        # ownership of both handles transferred here; free per the ABI
+        _check(lib, lib.MXNDArrayFree(og))
+        _check(lib, lib.MXNDArrayFree(ig))
         return 1
     keep.append(backward)
 

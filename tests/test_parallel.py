@@ -159,7 +159,7 @@ def test_moe_sharded_matches_dense():
 
 
 def test_collectives_in_shard_map():
-    from jax import shard_map
+    from incubator_mxnet_tpu.parallel.pipeline import shard_map
     from incubator_mxnet_tpu.parallel import collectives as C
 
     mesh = make_mesh({"dp": -1})
