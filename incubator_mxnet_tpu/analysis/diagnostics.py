@@ -1,0 +1,141 @@
+"""Diagnostic report types for graftlint (docs/ANALYSIS.md).
+
+Every check in the analyzer — trace-time (``trace_lint``) or source-level
+(``source_lint``) — reports through the same :class:`Diagnostic` record
+with a stable ``GLxxx`` code, so suppression, severity policy and CI exit
+codes are uniform across both levels.  Codes are append-only: a code is
+never renumbered or reused once it has shipped, mirroring how the
+reference froze its ``MXNET_*`` env-var names (config.py).
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Iterable, List, Optional, Tuple
+
+__all__ = ["Severity", "Diagnostic", "LintReport", "LintError", "CODES"]
+
+
+class Severity(enum.IntEnum):
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self):
+        return self.name.lower()
+
+
+#: Stable catalog: code -> (default severity, one-line summary).
+#: GL0xx = trace-time (jaxpr) checks, GL1xx = source-level (AST) checks.
+CODES = {
+    "GL001": (Severity.ERROR,
+              "ppermute permutation malformed / non-bijective over the "
+              "named axis"),
+    "GL002": (Severity.ERROR,
+              "shard_map/pjit partition spec inconsistent with operand "
+              "rank, mesh axes, or fed a jit-internal stacked operand"),
+    "GL003": (Severity.ERROR,
+              "donated buffer aliased into multiple outputs / donation "
+              "wasted (read-after-donate hazard)"),
+    "GL004": (Severity.ERROR,
+              "aux-loss/aux-state effect registered inside a finalized "
+              "inner trace (jax.checkpoint/remat, scan, shard_map body) "
+              "would be silently dropped"),
+    "GL005": (Severity.WARNING,
+              "compile-cache-key instability (host scalars / weak types / "
+              "nondeterministic trace) — recompile hazard"),
+    "GL101": (Severity.ERROR,
+              "shard_map imported from jax directly instead of "
+              "parallel/mesh.py (the one version-compat home)"),
+    "GL102": (Severity.ERROR,
+              "side-effecting call (time.*, np.random.*, global PRNG) "
+              "lexically inside a jit-decorated function"),
+    "GL103": (Severity.ERROR,
+              "PartitionSpec built from an f-string or untyped integer "
+              "rank — axis names must be static string literals"),
+}
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One finding.  ``where`` is a human location: ``path:line`` for
+    source findings, an eqn/operand description for trace findings."""
+    code: str
+    severity: Severity
+    message: str
+    where: str = ""
+    hint: str = ""
+
+    def format(self) -> str:
+        loc = ("%s: " % self.where) if self.where else ""
+        s = "%s%s %s: %s" % (loc, self.code, self.severity, self.message)
+        if self.hint:
+            s += "\n    hint: %s" % self.hint
+        return s
+
+
+class LintReport:
+    """Ordered collection of diagnostics with severity accessors."""
+
+    def __init__(self, diagnostics: Optional[Iterable[Diagnostic]] = None,
+                 suppress: Tuple[str, ...] = ()):
+        self.suppressed: List[Diagnostic] = []
+        self._suppress = tuple(suppress)
+        self.diagnostics: List[Diagnostic] = []
+        for d in diagnostics or ():
+            self.add(d)
+
+    def add(self, diag: Diagnostic):
+        if diag.code in self._suppress:
+            self.suppressed.append(diag)
+        else:
+            self.diagnostics.append(diag)
+
+    def extend(self, diags: Iterable[Diagnostic]):
+        for d in diags:
+            self.add(d)
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity >= Severity.ERROR]
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics
+                if d.severity == Severity.WARNING]
+
+    def by_code(self, code: str) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.code == code]
+
+    def __len__(self):
+        return len(self.diagnostics)
+
+    def __iter__(self):
+        return iter(self.diagnostics)
+
+    def __bool__(self):
+        return bool(self.diagnostics)
+
+    def format(self, min_severity: Severity = Severity.INFO) -> str:
+        lines = [d.format() for d in self.diagnostics
+                 if d.severity >= min_severity]
+        return "\n".join(lines)
+
+    def raise_if_errors(self):
+        if self.errors:
+            raise LintError(self)
+
+    def __repr__(self):
+        return "LintReport(%d diagnostics, %d errors)" % (
+            len(self.diagnostics), len(self.errors))
+
+
+class LintError(ValueError):
+    """Raised by ``lint=\"error\"`` paths when error-severity findings
+    exist.  Carries the full report as ``.report``."""
+
+    def __init__(self, report: LintReport):
+        self.report = report
+        super().__init__(
+            "graftlint: %d error-severity finding(s)\n%s"
+            % (len(report.errors), report.format(Severity.WARNING)))

@@ -1,0 +1,259 @@
+"""graftlint Level 2: source-level (AST) idiom checks.
+
+These rules are lexical, not semantic: they catch the patterns that the
+trace-time linter cannot see because the damage happens before (or
+outside) tracing — a ``shard_map`` imported straight from jax bypasses
+the one version-compat shim in ``parallel/mesh.py`` (jax moved the
+import path between 0.4.x and 0.5); ``time.time()`` or a global-PRNG
+``np.random.*`` call inside a jit-decorated function bakes one
+trace-time value into the compiled program forever; a ``P(f"{ax}")``
+spec defeats static validation of axis names.
+
+No jax import here — this module is plain ``ast`` so ``tools/graftlint.py``
+stays fast as a CI gate.
+
+Suppression: append ``# graftlint: disable`` (optionally
+``# graftlint: disable=GL102``) to the offending line.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Tuple
+
+from .diagnostics import Diagnostic, LintReport, Severity
+
+__all__ = ["lint_source", "lint_paths", "iter_py_files"]
+
+#: call chains (resolved to their imported module path) that read ambient
+#: host state — poison inside a traced/jitted function
+_SIDE_EFFECT_PREFIXES = ("numpy.random.",)
+_SIDE_EFFECT_CALLS = {
+    "time.time", "time.time_ns", "time.perf_counter",
+    "time.perf_counter_ns", "time.monotonic", "time.monotonic_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow", "os.urandom",
+}
+#: stdlib ``random`` module functions (global PRNG). Resolved through the
+#: import map, so ``from jax import random`` does not collide.
+_STDLIB_RANDOM = "random."
+
+#: resolved (import-mapped) paths that mean "this function is jax-jitted";
+#: bare last-name matching would also catch numba.jit etc., which allow
+#: host side effects — resolution through the import map avoids that
+_JIT_RESOLVED = {"jax.jit", "jit", "pjit",
+                 "jax.experimental.pjit.pjit"}
+
+
+def _attr_chain(node) -> Optional[List[str]]:
+    """['np', 'random', 'rand'] for np.random.rand; None if not a pure
+    name/attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+class _ImportMap:
+    """name bound in this module -> dotted module/object path."""
+
+    def __init__(self):
+        self.map: Dict[str, str] = {}
+
+    def visit(self, node):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                self.map[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.level == 0:
+            for a in node.names:
+                if node.module:
+                    self.map[a.asname or a.name] = (
+                        node.module + "." + a.name)
+
+    def resolve(self, chain: List[str]) -> str:
+        """Dotted path with the base name substituted through imports."""
+        base = self.map.get(chain[0], chain[0])
+        return ".".join([base] + chain[1:])
+
+
+def _resolves_to_jax_jit(node, imports: _ImportMap) -> bool:
+    chain = _attr_chain(node)
+    if chain is None:
+        return False
+    return imports.resolve(chain) in _JIT_RESOLVED
+
+
+def _is_jit_decorator(dec, imports: _ImportMap) -> bool:
+    """jit / jax.jit / pjit / functools.partial(jax.jit, ...) — resolved
+    through the module's imports, so @numba.jit etc. do not match."""
+    if isinstance(dec, ast.Call):
+        chain = _attr_chain(dec.func)
+        if chain is not None and imports.resolve(chain).endswith(
+                "partial") and dec.args:
+            return _resolves_to_jax_jit(dec.args[0], imports)
+        return _resolves_to_jax_jit(dec.func, imports)
+    return _resolves_to_jax_jit(dec, imports)
+
+
+def _spec_ctor_names(imports: _ImportMap) -> set:
+    """Local names bound to PartitionSpec (P, PartitionSpec, ...)."""
+    names = set()
+    for local, path in imports.map.items():
+        if path.endswith("PartitionSpec") or path.split(".")[-1] == "P":
+            names.add(local)
+    return names
+
+
+def _suppressed(lines: List[str], lineno: int, code: str) -> bool:
+    if not (1 <= lineno <= len(lines)):
+        return False
+    line = lines[lineno - 1]
+    if "graftlint: disable" not in line:
+        return False
+    tail = line.split("graftlint: disable", 1)[1]
+    if tail.startswith("="):
+        codes = tail[1:].split()[0].split(",") if tail[1:] else []
+        return code in [c.strip() for c in codes]
+    return True
+
+
+def lint_source(text: str, path: str = "<string>") -> List[Diagnostic]:
+    """Lint one module's source text.  Returns raw diagnostics (the
+    caller wraps them in a LintReport)."""
+    diags: List[Diagnostic] = []
+    lines = text.splitlines()
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError as e:
+        return [Diagnostic("GL100", Severity.ERROR,
+                           "syntax error: %s" % e,
+                           where="%s:%s" % (path, e.lineno or 0))]
+    imports = _ImportMap()
+    for node in ast.walk(tree):
+        imports.visit(node)
+
+    def emit(code, severity, message, lineno, hint=""):
+        if not _suppressed(lines, lineno, code):
+            diags.append(Diagnostic(code, severity, message,
+                                    where="%s:%d" % (path, lineno),
+                                    hint=hint))
+
+    norm = path.replace(os.sep, "/")
+    is_compat_home = norm.endswith("parallel/mesh.py")
+
+    # GL101 — shard_map import origin
+    if not is_compat_home:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.level == 0 \
+                    and node.module in ("jax", "jax.experimental.shard_map",
+                                        "jax.experimental"):
+                for a in node.names:
+                    if a.name == "shard_map":
+                        emit("GL101", Severity.ERROR,
+                             "shard_map imported from %r — import it "
+                             "from incubator_mxnet_tpu.parallel.mesh, "
+                             "the one version-compat home (jax moved "
+                             "this symbol between 0.4.x and 0.5)"
+                             % node.module, node.lineno)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax.experimental.shard_map":
+                        emit("GL101", Severity.ERROR,
+                             "import jax.experimental.shard_map — use "
+                             "incubator_mxnet_tpu.parallel.mesh instead",
+                             node.lineno)
+
+    # GL102 — host side effects inside jit-decorated functions
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not any(_is_jit_decorator(d, imports)
+                   for d in node.decorator_list):
+            continue
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            chain = _attr_chain(call.func)
+            if chain is None:
+                continue
+            resolved = imports.resolve(chain)
+            bad = (resolved in _SIDE_EFFECT_CALLS
+                   or any(resolved.startswith(p)
+                          for p in _SIDE_EFFECT_PREFIXES)
+                   or (resolved.startswith(_STDLIB_RANDOM)
+                       and imports.map.get(chain[0], chain[0]) == "random"))
+            if bad:
+                emit("GL102", Severity.ERROR,
+                     "%s() inside jit-decorated function %r: the value "
+                     "is sampled ONCE at trace time and baked into the "
+                     "compiled program" % (resolved, node.name),
+                     call.lineno,
+                     hint="thread PRNG keys through "
+                          "tracing.TraceContext.next_key and timestamps "
+                          "through arguments")
+
+    # GL103 — PartitionSpec hygiene
+    ctors = _spec_ctor_names(imports)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Name):
+            if node.func.id not in ctors:
+                continue
+        else:
+            # attribute paths: jax.sharding.PartitionSpec(...),
+            # mesh_mod.P(...) — resolve through the import map
+            chain = _attr_chain(node.func)
+            if chain is None:
+                continue
+            resolved = imports.resolve(chain)
+            if not (resolved.endswith(".PartitionSpec")
+                    or resolved.endswith(".P")):
+                continue
+        for arg in node.args:
+            if isinstance(arg, ast.JoinedStr):
+                emit("GL103", Severity.ERROR,
+                     "PartitionSpec axis built from an f-string — "
+                     "axis names must be static string literals so "
+                     "trace-time lint (GL002) can validate them "
+                     "against the mesh", arg.lineno)
+            elif isinstance(arg, ast.Constant) \
+                    and isinstance(arg.value, (int,)) \
+                    and not isinstance(arg.value, bool):
+                emit("GL103", Severity.ERROR,
+                     "PartitionSpec entry is the integer %r — "
+                     "entries are axis *names* (strings) or None; "
+                     "an integer rank silently never matches a mesh "
+                     "axis" % arg.value, arg.lineno)
+    return diags
+
+
+def iter_py_files(paths, exclude: Tuple[str, ...] = ("__pycache__",)):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in exclude)
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+def lint_paths(paths, suppress: Tuple[str, ...] = ()) -> LintReport:
+    """Lint every ``.py`` file under the given paths."""
+    report = LintReport(suppress=suppress)
+    for f in iter_py_files(paths):
+        try:
+            with open(f, "r", encoding="utf-8", errors="replace") as fh:
+                text = fh.read()
+        except OSError as e:
+            report.add(Diagnostic("GL100", Severity.WARNING,
+                                  "unreadable: %s" % e, where=f))
+            continue
+        report.extend(lint_source(text, path=f))
+    return report

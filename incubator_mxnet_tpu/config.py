@@ -161,6 +161,12 @@ for _name, _doc in [
 ]:
     _decl(_name, str, "", "[compat] " + _doc)
 
+_decl("MXTPU_LINT", str, "warn",
+      "graftlint Level-1 mode for fused train steps (analysis/, "
+      "docs/ANALYSIS.md): 'error' raises on error-severity findings "
+      "before the first compile, 'warn' (default) warns, 'off' skips "
+      "the lint trace.  Overridden per step by make_train_step(lint=).")
+
 _decl("MXNET_BACKWARD_DO_MIRROR", str, "",
       "Gradient recompute (memory mirror, src/nnvm/gradient.cc): when "
       "truthy, every HybridBlock without a remat-active ancestor wraps its "

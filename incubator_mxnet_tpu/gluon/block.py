@@ -502,6 +502,9 @@ class HybridBlock(Block):
             # outputs and re-register in the outer trace
             losses = tc.aux_losses[n_aux_loss:]
             del tc.aux_losses[n_aux_loss:]
+            # keep the GL004 origin bookkeeping aligned (tracing.py);
+            # the lifted losses re-register below with the outer origin
+            del tc.aux_loss_origins[n_aux_loss:]
             return outs, writes, losses
 
         outs, writes, losses = jax.checkpoint(inner)(arr_vals, pvals)

@@ -232,5 +232,7 @@ class MoEFFN(HybridBlock):
                       return_aux=bool(want_aux))
         if want_aux:
             out, aux = out
-            tc.add_aux_loss(self._aux_loss_weight * aux)
+            tc.add_aux_loss(self._aux_loss_weight * aux,
+                            source=type(self).__name__ + "(" + self.name
+                            + ")")
         return NDArray(out.reshape(lead + (out.shape[-1],)))

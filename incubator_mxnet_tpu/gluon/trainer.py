@@ -138,7 +138,8 @@ class Trainer:
     def make_fused_step(self, net, loss_fn, mesh=None, batch_axis="dp",
                         param_shardings=None, compute_dtype=None,
                         pipeline_stages=None, num_micro=1,
-                        pipeline_axis="pp", pipeline_remat=False):
+                        pipeline_axis="pp", pipeline_remat=False,
+                        lint=None, lint_suppress=()):
         """Build a fused XLA train step from this Trainer's optimizer.
 
         The reference's Trainer.step chain (forward → backward → kvstore
@@ -165,6 +166,34 @@ class Trainer:
         name = type(opt).__name__.lower()
         # settings the fused step cannot honor must fail loudly, not
         # silently diverge from Trainer.step semantics
+        mine = {id(p) for p in self._params}
+        net_params = net.collect_params().values()
+        outside = [p.name for p in net_params
+                   if p.grad_req != "null" and id(p) not in mine]
+        if outside:
+            raise ValueError(
+                "the fused step trains every trainable parameter of the "
+                "net, but this Trainer was built without %s — it would "
+                "silently train parameters you excluded; pass the full "
+                "collect_params() or set grad_req='null' on the frozen "
+                "ones" % outside)
+        net_ids = {id(p) for p in net_params}
+        orphaned = [p.name for p in self._params
+                    if p.grad_req != "null" and id(p) not in net_ids]
+        if orphaned:
+            raise ValueError(
+                "this Trainer also owns %s, which are not part of the "
+                "given net — the fused step would silently never update "
+                "them; build the step from the net that reaches every "
+                "trained parameter" % orphaned)
+        mults = [p.name for p in self._params
+                 if getattr(p, "lr_mult", 1.0) != 1.0
+                 or getattr(p, "wd_mult", 1.0) != 1.0]
+        if mults:
+            raise ValueError(
+                "per-parameter lr_mult/wd_mult (%s) are not applied by "
+                "the fused step; reset them or use eager Trainer.step"
+                % mults)
         if getattr(opt, "lr_scheduler", None) is not None:
             raise ValueError(
                 "make_fused_step snapshots the learning rate at build "
@@ -196,7 +225,8 @@ class Trainer:
                          param_shardings=param_shardings,
                          pipeline_stages=pipeline_stages,
                          num_micro=num_micro, pipeline_axis=pipeline_axis,
-                         pipeline_remat=pipeline_remat)
+                         pipeline_remat=pipeline_remat, lint=lint,
+                         lint_suppress=lint_suppress)
 
     # ------------------------------------------------------------------
     def save_states(self, fname):

@@ -571,7 +571,7 @@ class ImageDetRecordIter(ImageRecordIter):
                 if cflag in (0, 1) and length >= 4:
                     # single record or FIRST part of a multi-part record:
                     # the IR header (flag = label count) leads the payload
-                    flag = _struct.unpack("I", fh.read(4))[0]
+                    flag = _struct.unpack("<I", fh.read(4))[0]
                     width = max(width, flag if flag > 0 else 1)
                     skip -= 4
                 fh.seek(skip, 1)  # continuation parts / image bytes

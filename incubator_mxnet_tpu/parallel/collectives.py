@@ -40,6 +40,23 @@ def alltoall(x, axis_name, split_axis, concat_axis, tiled=True):
 
 
 def ppermute(x, axis_name, perm):
+    """Collective permute with eager graftlint GL001 validation.
+
+    A malformed permutation (duplicated sources/destinations, ranks
+    outside the axis) deadlocks or silently drops a shard on hardware;
+    here it raises a ``ValueError`` naming the axis and the offending
+    ranks *at trace time*.  Partial (non-bijective) permutations are
+    legal — that is the pipeline fill/drain pattern.
+    """
+    perm = [(int(s), int(d)) for s, d in perm]
+    try:
+        n = lax.psum(1, axis_name)  # concrete int inside shard_map/pmap
+    except NameError:
+        n = None
+    if isinstance(n, int):
+        from ..analysis.trace_lint import validate_permutation
+
+        validate_permutation(perm, n, axis_name)
     return lax.ppermute(x, axis_name, perm)
 
 

@@ -68,9 +68,13 @@ def moe_ffn(x, gate_w, w1, b1, w2, b2, top_k=1, capacity_factor=None,
         tokens = x.shape[0]
         capacity = max(1, int(math.ceil(
             capacity_factor * tokens * top_k / num_experts)))
-        # k-major priority (GShard): all rank-1 choices outrank rank-2
+        # k-major priority (GShard): all rank-1 choices outrank rank-2.
+        # positions are COUNTS — computed in int32, not the activation
+        # dtype: a bf16 cumsum loses integer precision past 256 decisions
+        # and keeps/drops the wrong routing decisions at the boundary
         sel = jnp.swapaxes(disp, 0, 1).reshape(top_k * tokens, num_experts)
-        pos = jnp.cumsum(sel, axis=0) - sel  # earlier decisions per expert
+        sel_i = (sel > 0).astype(jnp.int32)
+        pos = jnp.cumsum(sel_i, axis=0) - sel_i  # earlier decisions/expert
         sel = sel * (pos < capacity).astype(sel.dtype)
         disp = jnp.swapaxes(sel.reshape(top_k, tokens, num_experts), 0, 1)
     combine = jnp.einsum("tk,tke->te", top_p.astype(x.dtype), disp)  # (T,E)
@@ -88,6 +92,24 @@ def moe_ffn(x, gate_w, w1, b1, w2, b2, top_k=1, capacity_factor=None,
 def moe_ffn_sharded(x, gate_w, w1, b1, w2, b2, mesh: Mesh, top_k=1,
                     axis_name="ep", capacity_factor=None, return_aux=False):
     """Run moe_ffn with experts sharded over ``axis_name`` via GSPMD."""
+    from ..analysis import LintReport, check_partition_spec
+
+    # eager GL002: a bad axis name or an expert tensor of unexpected
+    # rank would otherwise surface as a GSPMD mis-shard, not an error
+    diags = []
+    for name, arr, spec in (("w1", w1, P(axis_name, None, None)),
+                            ("w2", w2, P(axis_name, None, None)),
+                            ("b1", b1, P(axis_name)),
+                            ("b2", b2, P(axis_name))):
+        diags += check_partition_spec(spec, arr.ndim, mesh,
+                                      where="moe_ffn_sharded(%s)" % name,
+                                      operand=name)
+    if gate_w.shape[-1] % dict(mesh.shape).get(axis_name, 1):
+        raise ValueError(
+            "moe_ffn_sharded: %d experts do not divide over mesh axis "
+            "%r of size %d" % (gate_w.shape[-1], axis_name,
+                               dict(mesh.shape).get(axis_name, 1)))
+    LintReport(diags).raise_if_errors()
     e_spec = NamedSharding(mesh, P(axis_name))
     repl = NamedSharding(mesh, P())
     fn = jax.jit(functools.partial(moe_ffn, top_k=top_k,

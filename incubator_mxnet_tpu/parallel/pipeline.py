@@ -29,9 +29,29 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .collectives import ppermute  # eager GL001-validated collective
 from .mesh import shard_map  # version-compat import, one home
 
-__all__ = ["spmd_pipeline", "pipeline_apply", "stack_stage_params"]
+__all__ = ["spmd_pipeline", "pipeline_apply", "stack_stage_params",
+           "stage_congruence_mismatch"]
+
+
+def stage_congruence_mismatch(first, stage, idx):
+    """Shared congruence check for uniform-stage SPMD pipelining (used
+    by :func:`stack_stage_params` and ``TrainStep._collect_pipeline``).
+
+    ``first``/``stage``: per-parameter ``(shape, dtype)`` signatures of
+    stage 0 and stage ``idx``.  Returns a human reason string when the
+    stages are not structurally congruent, else None.
+    """
+    if len(stage) != len(first):
+        return ("stage 0 has %d params, stage %d has %d"
+                % (len(first), idx, len(stage)))
+    for i, (a, b) in enumerate(zip(first, stage)):
+        if tuple(a[0]) != tuple(b[0]) or a[1] != b[1]:
+            return ("stage %d param %d is %s%s; stage 0 has %s%s"
+                    % (idx, i, b[1], tuple(b[0]), a[1], tuple(a[0])))
+    return None
 
 
 def spmd_pipeline(stage_fn: Callable, stage_params, microbatches,
@@ -74,7 +94,7 @@ def spmd_pipeline(stage_fn: Callable, stage_params, microbatches,
         out_slot = jnp.clip(t - (n - 1), 0, num_micro - 1)
         is_out = (idx == n - 1) & (t >= n - 1)
         outs = outs.at[out_slot].set(jnp.where(is_out, y, outs[out_slot]))
-        buf = lax.ppermute(y, axis_name, perm)
+        buf = ppermute(y, axis_name, perm)
         return (buf, outs), None
 
     # scan (not fori_loop): the transpose of this scan IS the backward
@@ -96,19 +116,14 @@ def stack_stage_params(stage_param_lists: Sequence[Sequence]):
     of ``(num_stages, *param_shape)`` arrays.
     """
     first = stage_param_lists[0]
+    sig0 = [(tuple(a.shape), a.dtype) for a in first]
     for s, plist in enumerate(stage_param_lists[1:], 1):
-        if len(plist) != len(first):
+        reason = stage_congruence_mismatch(
+            sig0, [(tuple(b.shape), b.dtype) for b in plist], s)
+        if reason:
             raise ValueError(
-                "pipeline stages must be structurally identical: stage 0 "
-                "has %d params, stage %d has %d" % (len(first), s,
-                                                    len(plist)))
-        for i, (a, b) in enumerate(zip(first, plist)):
-            if tuple(a.shape) != tuple(b.shape) or a.dtype != b.dtype:
-                raise ValueError(
-                    "pipeline stage %d param %d is %s%s; stage 0 has %s%s "
-                    "— uniform-stage SPMD pipelining needs congruent "
-                    "stages" % (s, i, b.dtype, tuple(b.shape), a.dtype,
-                                tuple(a.shape)))
+                "pipeline stages must be structurally identical "
+                "(congruent): %s" % reason)
     return [jnp.stack([plist[i] for plist in stage_param_lists])
             for i in range(len(first))]
 

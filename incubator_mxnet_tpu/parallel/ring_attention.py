@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .collectives import ppermute  # eager GL001-validated collective
 from .mesh import shard_map  # version-compat import, one home
 
 __all__ = ["attention_reference", "ring_attention", "ulysses_attention",
@@ -103,8 +104,8 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
         m, l, o = _block_attn_update(qf, k_blk.astype(jnp.float32),
                                      v_blk.astype(jnp.float32),
                                      m, l, o, scale, mask)
-        k_blk = lax.ppermute(k_blk, axis_name, perm)
-        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        k_blk = ppermute(k_blk, axis_name, perm)
+        v_blk = ppermute(v_blk, axis_name, perm)
         return m, l, o, k_blk, v_blk
 
     m, l, o, _, _ = lax.fori_loop(0, n, body, (m0, l0, o0, k, v))

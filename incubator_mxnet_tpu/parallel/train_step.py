@@ -100,7 +100,8 @@ class TrainStep:
                  param_shardings: Optional[Dict[str, Any]] = None,
                  donate: bool = True, pipeline_stages: Optional[int] = None,
                  num_micro: int = 1, pipeline_axis: str = "pp",
-                 pipeline_remat: bool = False):
+                 pipeline_remat: bool = False, lint: Optional[str] = None,
+                 lint_suppress: Tuple[str, ...] = ()):
         self.net = net
         self.loss_fn = loss_fn
         self.opt = opt
@@ -112,6 +113,20 @@ class TrainStep:
         self.num_micro = num_micro
         self.pipeline_axis = pipeline_axis
         self.pipeline_remat = pipeline_remat
+        # graftlint Level 1 runs over the traced step before its first
+        # compile (docs/ANALYSIS.md): "error" raises on error-severity
+        # findings, "warn" prints them, "off" skips the lint trace.
+        # Resolution order: explicit arg > MXTPU_LINT env > "warn".
+        if lint is None:
+            from .. import config as _cfg
+
+            lint = str(_cfg.get("MXTPU_LINT", "warn") or "warn").lower()
+        if lint not in ("off", "warn", "error"):
+            raise ValueError("lint must be 'off', 'warn' or 'error', "
+                             "got %r" % (lint,))
+        self.lint = lint
+        self.lint_suppress = tuple(lint_suppress)
+        self._linted = False
         if pipeline_stages is not None:
             if mesh is None:
                 raise ValueError("pipeline_stages requires a mesh with a "
@@ -143,6 +158,10 @@ class TrainStep:
         self._compiled_key = None
         self._multihost = False
         self._donate = donate
+        # the ONE donation spec: state args of step(p_vals, aux_vals,
+        # opt_state, x, y, key, step_count) — jit, the multi-step scan
+        # program, and the GL003 lint all key off this tuple
+        self._donate_argnums = (0, 1, 2, 5, 6) if donate else ()
         self._placed = False
         self._shardings = None
 
@@ -191,16 +210,18 @@ class TrainStep:
             raise ValueError(
                 "net has trainable parameters outside its child blocks; "
                 "the SPMD pipeline owns the full parameter set")
+        from .pipeline import stage_congruence_mismatch
+
         first = stage_gp[0]
+        sig0 = [(tuple(p.shape), p.dtype) for p in first]
         for s, ps in enumerate(stage_gp[1:], 1):
-            if len(ps) != len(first) or any(
-                    tuple(a.shape) != tuple(b.shape) or a.dtype != b.dtype
-                    for a, b in zip(first, ps)):
+            reason = stage_congruence_mismatch(
+                sig0, [(tuple(p.shape), p.dtype) for p in ps], s)
+            if reason:
                 raise ValueError(
-                    "pipeline stages must be structurally congruent (same "
-                    "param count/shapes/dtypes per stage); stage %d "
-                    "differs from stage 0 — uniform-stage SPMD pipelining "
-                    "runs ONE stage program with per-rank values" % s)
+                    "pipeline stages must be structurally congruent "
+                    "(%s) — uniform-stage SPMD pipelining runs ONE "
+                    "stage program with per-rank values" % reason)
         self._stage_idx = stage_idx
         self._stage0_blocks = groups[0]
         self._stage0_gp = first
@@ -378,7 +399,7 @@ class TrainStep:
         step = self._make_pipeline_step() if self.pipeline_stages \
             else self._make_plain_step()
         self._step_fn = step  # shared by the multi-step (scan) program
-        donate = (0, 1, 2, 5, 6) if self._donate else ()
+        donate = self._donate_argnums
         if self.mesh is None:
             return jax.jit(step, donate_argnums=donate)
 
@@ -407,6 +428,59 @@ class TrainStep:
                                      batch_sh, repl, repl),
                        out_shardings=(repl, p_sh, aux_sh, state_sh, repl,
                                       repl))
+
+    # ------------------------------------------------------------------
+    def _maybe_lint(self, example_args):
+        """graftlint Level 1 over the step program, BEFORE its first XLA
+        compile: checks collective permutations (GL001), partition specs
+        incl. the jax 0.4.x stacked-operand GSPMD hazard (GL002),
+        donation aliasing against this step's donate_argnums (GL003),
+        and aux effects dropped by remat regions (GL004).  The lint
+        walks ``self._jit.trace(...)`` — the very trace jit caches for
+        the first call — so it costs one jaxpr walk, not an extra
+        trace; steady-state steps pay nothing."""
+        if self.lint == "off" or self._linted:
+            return
+        self._lint_trace(self._jit, tuple(example_args))
+
+    def _lint_trace(self, jit_obj, args):
+        """The one lint ritual: trace ``jit_obj`` (GL004 hooks active),
+        lint the jaxpr, and mark this step linted — only after a
+        non-raising lint, so in "error" mode a caught/retried LintError
+        re-lints (and re-raises) instead of compiling the flagged
+        program.  Returns the traced object (shared with the jit's
+        trace cache, so the first call/compile reuses it)."""
+        from contextlib import nullcontext
+
+        from ..analysis.trace_lint import capture_effect_diagnostics
+
+        lint_here = self.lint != "off" and not self._linted
+        cm = capture_effect_diagnostics() if lint_here else nullcontext([])
+        with cm as effects:
+            traced = jit_obj.trace(*args)
+        if lint_here:
+            self._finish_lint(traced.jaxpr, effects, args)
+            self._linted = True
+        return traced
+
+    def _finish_lint(self, closed_jaxpr, effect_diags, example_args):
+        from ..analysis import LintReport, Severity, lint_jaxpr
+        from ..analysis.trace_lint import donated_leaf_indices
+
+        report = LintReport(suppress=self.lint_suppress)
+        report.extend(effect_diags)
+        donated = donated_leaf_indices(tuple(example_args),
+                                       self._donate_argnums)
+        report.extend(lint_jaxpr(closed_jaxpr,
+                                 donated_leaves=donated).diagnostics)
+        if self.lint == "error":
+            report.raise_if_errors()
+        if report.errors or report.warnings:
+            import warnings as _warnings
+
+            _warnings.warn("graftlint: fused train step has findings\n"
+                           + report.format(Severity.WARNING),
+                           stacklevel=4)
 
     # ------------------------------------------------------------------
     def _ensure_built(self):
@@ -514,9 +588,12 @@ class TrainStep:
                 for p, v in zip(self._aux, aux_vals):
                     p._data._data = v
             xv, yv = self._place_batch(xv, yv)
+        # lint rides THIS trace — no separate lint trace, so the trace/
+        # compile split below stays honest (the jaxpr walk is ms-scale)
         t0 = _time.time()
-        traced = self._jit.trace(p_vals, aux_vals, self._opt_state, xv, yv,
-                                 self._key_dev, self._step_dev)
+        traced = self._lint_trace(self._jit,
+                                  (p_vals, aux_vals, self._opt_state, xv,
+                                   yv, self._key_dev, self._step_dev))
         lowered = traced.lower()
         t_trace = _time.time() - t0
         t0 = _time.time()
@@ -551,7 +628,7 @@ class TrainStep:
             p, a, st, k, c = carry
             return losses, p, a, st, k, c
 
-        donate = (0, 1, 2, 5, 6) if self._donate else ()
+        donate = self._donate_argnums
         if self.mesh is None:
             return jax.jit(multi, donate_argnums=donate)
         p_sh, aux_sh, state_sh, batch_sh, repl = self._shardings
@@ -601,6 +678,13 @@ class TrainStep:
                 xs = jax.device_put(xs, stack_sh)
                 ys = jax.device_put(ys, stack_sh)
         k = xs.shape[0]
+        if self.lint != "off" and not self._linted:
+            # lint rides the multi-step program's OWN trace (shared with
+            # the compile below via jit's trace cache) — the scan body
+            # is the step, so the walker sees the same hazards
+            self._lint_trace(self._multi_jit,
+                             (p_vals, aux_vals, self._opt_state, xs, ys,
+                              self._key_dev, self._step_dev))
         losses, new_p, new_aux, new_s, self._key_dev, self._step_dev = \
             self._multi_jit(p_vals, aux_vals, self._opt_state, xs, ys,
                             self._key_dev, self._step_dev)
@@ -623,6 +707,8 @@ class TrainStep:
             if not self._placed:
                 p_vals, aux_vals = self._place_state(p_vals, aux_vals)
             xv, yv = self._place_batch(xv, yv)
+        self._maybe_lint((p_vals, aux_vals, self._opt_state, xv, yv,
+                          self._key_dev, self._step_dev))
         # the AOT executable is shape-pinned; any other batch shape/dtype
         # falls back to the jit wrapper, which retraces transparently
         fn = self._jit
@@ -646,7 +732,8 @@ class TrainStep:
 def make_train_step(net, loss_fn, optimizer="sgd", mesh=None, batch_axis="dp",
                     param_shardings=None, compute_dtype=None, donate=True,
                     pipeline_stages=None, num_micro=1, pipeline_axis="pp",
-                    pipeline_remat=False, **opt_kwargs) -> TrainStep:
+                    pipeline_remat=False, lint=None, lint_suppress=(),
+                    **opt_kwargs) -> TrainStep:
     """Build the fused train step (fwd+bwd+optimizer in one XLA program).
 
     ``pipeline_stages=K`` + ``num_micro=M`` runs the net as a K-stage SPMD
@@ -657,10 +744,18 @@ def make_train_step(net, loss_fn, optimizer="sgd", mesh=None, batch_axis="dp",
     still one jitted, donated program.  ``pipeline_remat=True`` recomputes
     stage activations in the backward ticks instead of stashing them.
     Composes with dp: a ``{'dp': d, 'pp': K}`` mesh shards microbatches
-    over dp while stages flow over pp."""
+    over dp while stages flow over pp.
+
+    ``lint`` (default: env ``MXTPU_LINT``, else ``"warn"``) runs
+    graftlint Level 1 over the traced step before its first compile —
+    ``"error"`` raises :class:`~..analysis.LintError` on error-severity
+    findings, ``"warn"`` emits a warning, ``"off"`` disables.
+    ``lint_suppress`` drops the given ``GLxxx`` codes (docs/ANALYSIS.md).
+    """
     opt = FunctionalOptimizer(optimizer, **opt_kwargs)
     return TrainStep(net, loss_fn, opt, compute_dtype=compute_dtype, mesh=mesh,
                      batch_axis=batch_axis, param_shardings=param_shardings,
                      donate=donate, pipeline_stages=pipeline_stages,
                      num_micro=num_micro, pipeline_axis=pipeline_axis,
-                     pipeline_remat=pipeline_remat)
+                     pipeline_remat=pipeline_remat, lint=lint,
+                     lint_suppress=lint_suppress)

@@ -28,6 +28,31 @@ __all__ = ["TraceContext", "current_trace", "push_trace", "pop_trace"]
 
 _STATE = threading.local()
 
+def _pop_hooks() -> List[Any]:
+    """Per-thread observers called with the popped TraceContext on every
+    pop_trace — the graftlint GL004 check (analysis/trace_lint.py)
+    registers here for the duration of a lint trace to detect aux
+    effects registered inside inner trace regions that have already
+    been finalized.  Thread-local like the trace stack itself, so a
+    lint window never observes another thread's pops."""
+    if not hasattr(_STATE, "pop_hooks"):
+        _STATE.pop_hooks = []
+    return _STATE.pop_hooks
+
+
+def _dynamic_trace():
+    """The jax trace active right now (stackless tracing machinery,
+    jax >= 0.4.36); None when undeterminable.  Recorded per aux-effect
+    registration so graftlint can tell 'registered in the trace that
+    will consume it' from 'registered in an inner region that already
+    finalized' (GL004)."""
+    try:
+        from jax._src import core as _c
+
+        return _c.trace_ctx.trace
+    except Exception:
+        return None
+
 
 class TraceContext:
     def __init__(self, key: Optional[jax.Array], training: bool = True):
@@ -44,12 +69,27 @@ class TraceContext:
         # (MoE load-balancing loss etc.); the fused train step adds their
         # sum to the task loss before differentiating
         self.aux_losses: List[Any] = []
+        # jax trace active at each registration (parallel lists/dict;
+        # consumed by graftlint GL004, maintained by _forward_remat when
+        # it lifts effects out of a checkpoint region)
+        self.aux_loss_origins: List[Any] = []
+        self.aux_write_origins: Dict[int, Any] = {}
 
-    def add_aux_loss(self, value):
+    def add_aux_loss(self, value, source=None):
         """Register a scalar auxiliary loss (e.g. an MoE load-balancing
         term) to be added to the training objective by the enclosing
-        fused step."""
+        fused step.  ``source`` names the registering block for error
+        messages."""
+        shape = tuple(getattr(value, "shape", ()) or ())
+        if shape != ():
+            who = " registered by %s" % source if source else ""
+            raise ValueError(
+                "aux loss%s must be a scalar, got shape %s — a vector "
+                "aux loss silently corrupts the training objective when "
+                "the fused step sums it into the (scalar) task loss; "
+                "reduce it first (e.g. .mean() or .sum())" % (who, shape))
         self.aux_losses.append(value)
+        self.aux_loss_origins.append(_dynamic_trace())
 
     def next_key(self) -> jax.Array:
         if self.key is None:
@@ -64,6 +104,7 @@ class TraceContext:
         if oid not in self.aux_writes:
             self.aux_order.append(oid)
         self.aux_writes[oid] = (holder, value)
+        self.aux_write_origins[oid] = _dynamic_trace()
 
     def collect_aux(self):
         """Return ([holders], [values]) in deterministic write order.
@@ -98,4 +139,7 @@ def push_trace(ctx: TraceContext) -> TraceContext:
 
 
 def pop_trace() -> TraceContext:
-    return _stack().pop()
+    ctx = _stack().pop()
+    for hook in list(_pop_hooks()):
+        hook(ctx)
+    return ctx

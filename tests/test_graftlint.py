@@ -1,0 +1,229 @@
+"""graftlint Level 2 (source/AST) + CLI gate.
+
+Adversarial source fixtures for GL101/GL102/GL103, inline suppression,
+and — the CI gate — ``tools/graftlint.py`` over the whole
+``incubator_mxnet_tpu/`` package must exit 0: idiom violations fail
+tier-1 from now on."""
+import os
+import sys
+import textwrap
+
+import pytest
+
+from incubator_mxnet_tpu.analysis import Severity, lint_source
+from incubator_mxnet_tpu.analysis.source_lint import lint_paths
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint(src, path="pkg/mod.py"):
+    return lint_source(textwrap.dedent(src), path=path)
+
+
+# ---------------------------------------------------------------------------
+# GL101 — shard_map import origin
+# ---------------------------------------------------------------------------
+
+def test_gl101_shard_map_from_jax_experimental():
+    diags = _lint("""
+        from jax.experimental.shard_map import shard_map
+    """)
+    assert [d.code for d in diags] == ["GL101"]
+    assert "parallel.mesh" in diags[0].message
+
+
+def test_gl101_shard_map_from_jax_toplevel():
+    diags = _lint("""
+        from jax import shard_map
+    """)
+    assert [d.code for d in diags] == ["GL101"]
+
+
+def test_gl101_compat_home_exempt():
+    src = """
+        try:
+            from jax import shard_map
+        except ImportError:
+            from jax.experimental.shard_map import shard_map
+    """
+    assert not _lint(src, path="incubator_mxnet_tpu/parallel/mesh.py")
+    assert len(_lint(src, path="somewhere/else.py")) == 2
+
+
+def test_gl101_importing_the_compat_home_is_clean():
+    assert not _lint("""
+        from incubator_mxnet_tpu.parallel.mesh import shard_map
+        from .mesh import shard_map
+    """)
+
+
+# ---------------------------------------------------------------------------
+# GL102 — side effects inside jit
+# ---------------------------------------------------------------------------
+
+def test_gl102_time_and_np_random_in_jit():
+    diags = _lint("""
+        import time
+        import numpy as np
+        import jax
+
+        @jax.jit
+        def step(x):
+            t0 = time.time()
+            noise = np.random.rand(4)
+            return x + noise, t0
+    """)
+    assert sorted(d.code for d in diags) == ["GL102", "GL102"]
+    assert all(d.severity == Severity.ERROR for d in diags)
+    assert any("baked into" in d.message for d in diags)
+
+
+def test_gl102_stdlib_random_but_not_jax_random():
+    diags = _lint("""
+        import random
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnums=0)
+        def bad(n, x):
+            return x * random.random()
+    """)
+    assert [d.code for d in diags] == ["GL102"]
+    # `from jax import random` is NOT the stdlib PRNG — no finding
+    assert not _lint("""
+        import jax
+        from jax import random
+
+        @jax.jit
+        def ok(key, x):
+            return x + random.normal(key, x.shape)
+    """)
+
+
+def test_gl102_other_jits_not_flagged():
+    """numba-style JITs allow host side effects — resolved through the
+    import map, they must not match."""
+    assert not _lint("""
+        import time
+        import numpy as np
+        import numba
+        from numba import jit
+
+        @numba.jit
+        def a(x):
+            return np.random.rand(4) + time.time()
+
+        @jit
+        def b(x):
+            return np.random.rand(4)
+    """)
+
+
+def test_gl102_only_inside_jit_decorated():
+    assert not _lint("""
+        import time
+        import numpy as np
+
+        def eager_benchmark(x):
+            t0 = time.time()
+            return np.random.rand(4), t0
+    """)
+
+
+# ---------------------------------------------------------------------------
+# GL103 — PartitionSpec hygiene
+# ---------------------------------------------------------------------------
+
+def test_gl103_fstring_and_int_specs():
+    diags = _lint("""
+        from jax.sharding import PartitionSpec as P
+
+        def make(ax):
+            bad1 = P(f"{ax}")
+            bad2 = P(0, None)
+            ok = P("dp", None)
+            return bad1, bad2, ok
+    """)
+    assert sorted(d.code for d in diags) == ["GL103", "GL103"]
+    assert any("f-string" in d.message for d in diags)
+    assert any("integer" in d.message for d in diags)
+
+
+def test_gl103_attribute_path_partition_spec():
+    """PartitionSpec reached through an attribute chain is checked too."""
+    diags = _lint("""
+        import jax
+
+        def make(ax):
+            return jax.sharding.PartitionSpec(f"{ax}")
+    """)
+    assert [d.code for d in diags] == ["GL103"]
+
+
+def test_gl103_requires_spec_import_evidence():
+    """An unrelated local function named P is not a PartitionSpec."""
+    assert not _lint("""
+        def P(x):
+            return x
+
+        y = P(f"hello")
+    """)
+
+
+def test_inline_suppression():
+    diags = _lint("""
+        from jax import shard_map  # graftlint: disable=GL101
+    """)
+    assert not diags
+    diags = _lint("""
+        from jax import shard_map  # graftlint: disable
+    """)
+    assert not diags
+    diags = _lint("""
+        from jax import shard_map  # graftlint: disable=GL102
+    """)
+    assert [d.code for d in diags] == ["GL101"]
+
+
+# ---------------------------------------------------------------------------
+# the CI gate
+# ---------------------------------------------------------------------------
+
+def test_repo_package_is_idiom_clean():
+    """Level 2 over the real package: zero findings of any severity.
+    New code that imports shard_map from jax, calls time/np.random
+    inside jit, or builds f-string specs fails tier-1 here."""
+    report = lint_paths([os.path.join(ROOT, "incubator_mxnet_tpu")])
+    assert not report.errors, "\n" + report.format()
+    assert not report.warnings, "\n" + report.format()
+
+
+def test_cli_exit_codes(tmp_path):
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import graftlint
+    finally:
+        sys.path.pop(0)
+    # clean package -> 0
+    assert graftlint.main([os.path.join(ROOT, "incubator_mxnet_tpu",
+                                        "analysis")]) == 0
+    # a violating file -> 1
+    bad = tmp_path / "bad.py"
+    bad.write_text("from jax.experimental.shard_map import shard_map\n")
+    assert graftlint.main([str(tmp_path)]) == 1
+    # suppressed -> 0
+    assert graftlint.main([str(tmp_path), "--suppress", "GL101"]) == 0
+
+
+def test_cli_reports_with_location(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import graftlint
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\nfrom jax import shard_map\n")
+    rc = graftlint.main([str(bad)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "bad.py:2" in out and "GL101" in out

@@ -121,6 +121,58 @@ def test_pipeline_trainer_gluon_surface():
     np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-6)
 
 
+def test_make_fused_step_rejects_param_subset_and_mults():
+    """Fail-loudly contract: a Trainer built over a parameter subset
+    (frozen backbone) or with per-parameter lr_mult/wd_mult cannot be
+    honored by the fused step — it must raise, not silently train the
+    excluded params / drop the multipliers."""
+    net = _build()
+    head = dict(list(net.collect_params().items())[:2])  # proper subset
+    trainer = gluon.Trainer(head, "sgd", {"learning_rate": 0.1})
+    with pytest.raises(ValueError, match="without"):
+        trainer.make_fused_step(net, LOSS())
+
+    net2 = _build()
+    p = next(iter(net2.collect_params().values()))
+    p.lr_mult = 2.0
+    trainer2 = gluon.Trainer(net2.collect_params(), "sgd",
+                             {"learning_rate": 0.1})
+    with pytest.raises(ValueError, match="lr_mult"):
+        trainer2.make_fused_step(net2, LOSS())
+
+    # symmetric direction: Trainer owns params the net never reaches
+    net3, other = _build(), _build()
+    both = dict(net3.collect_params())
+    both.update(other.collect_params())
+    trainer3 = gluon.Trainer(both, "sgd", {"learning_rate": 0.1})
+    with pytest.raises(ValueError, match="not part of"):
+        trainer3.make_fused_step(net3, LOSS())
+
+
+def test_moe_capacity_count_exact_in_bf16():
+    """Capacity positions are integer counts: with bf16 activations the
+    cutoff must still keep exactly the first `capacity` decisions per
+    expert (a bf16 cumsum loses integer precision past 256)."""
+    from incubator_mxnet_tpu.parallel.moe import moe_ffn
+
+    rng = np.random.RandomState(0)
+    T, D, E, H = 600, 8, 2, 12
+    # positive features so x @ gate_w is positive in column 0 for every
+    # token: all 600 decisions route to expert 0, capacity = 150
+    x = jnp.asarray((np.abs(rng.normal(size=(T, D))) + 0.1)
+                    .astype(np.float32))
+    gate_w = jnp.zeros((D, E), jnp.float32).at[:, 0].set(5.0)
+    w1 = jnp.asarray(rng.normal(0, 0.3, (E, D, H)).astype(np.float32))
+    b1 = jnp.asarray(np.zeros((E, H), np.float32))
+    w2 = jnp.asarray(rng.normal(0, 0.3, (E, H, D)).astype(np.float32))
+    b2 = jnp.asarray(np.zeros((E, D), np.float32))
+    args16 = [a.astype(jnp.bfloat16) for a in (x, gate_w, w1, b1, w2, b2)]
+    y = moe_ffn(*args16, top_k=1, capacity_factor=0.5)
+    kept = int(np.sum(np.any(np.asarray(y.astype(jnp.float32)) != 0.0,
+                             axis=-1)))
+    assert kept == 150, kept
+
+
 def test_pipeline_stage_validation():
     """Uncongruent stages and aux-state (BN) stages fail loudly."""
     mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])

@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""graftlint CLI — source-level (Level 2) static analysis gate.
+
+Walks the given paths (default: ``incubator_mxnet_tpu/``) and reports
+idiom violations that break sharded-program discipline:
+
+- GL101  shard_map imported from jax directly (the one version-compat
+         home is ``incubator_mxnet_tpu/parallel/mesh.py``)
+- GL102  host side effects (time.*, np.random.*, stdlib random) inside
+         jit-decorated functions
+- GL103  PartitionSpec entries built from f-strings or integer ranks
+
+Exit status 1 when any error-severity finding remains (CI gate —
+``tests/test_graftlint.py`` runs this over the package in tier-1).
+Suppress a finding by appending ``# graftlint: disable[=GLxxx]`` to the
+offending line.  Trace-time (Level 1) checks run inside
+``make_train_step(lint=...)`` / ``MXTPU_LINT`` — see docs/ANALYSIS.md.
+
+Usage::
+
+    python tools/graftlint.py [paths...] [--min-severity warning]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(_ROOT, "incubator_mxnet_tpu")],
+                    help="files/directories to lint (default: the "
+                         "incubator_mxnet_tpu package)")
+    ap.add_argument("--min-severity", default="info",
+                    choices=["info", "warning", "error"],
+                    help="lowest severity to print (exit code always "
+                         "keys off errors)")
+    ap.add_argument("--suppress", default="",
+                    help="comma-separated GLxxx codes to suppress")
+    args = ap.parse_args(argv)
+
+    from incubator_mxnet_tpu.analysis.diagnostics import Severity
+    from incubator_mxnet_tpu.analysis.source_lint import lint_paths
+
+    suppress = tuple(c.strip() for c in args.suppress.split(",")
+                     if c.strip())
+    report = lint_paths(args.paths, suppress=suppress)
+    out = report.format(Severity[args.min_severity.upper()])
+    if out:
+        print(out)
+    n_err = len(report.errors)
+    print("graftlint: %d file finding(s), %d error(s)"
+          % (len(report), n_err))
+    return 1 if n_err else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
