@@ -7,21 +7,25 @@ semantics):
   walk a jaxpr for collective-permutation defects (GL001), partition-
   spec/mesh inconsistencies including the jax 0.4.x stacked-operand
   GSPMD miscompile (GL002), donation aliasing (GL003), aux effects
-  dropped by remat/inner-trace regions (GL004) and recompile hazards
-  (GL005).  Wired into every fused step via
-  ``make_train_step(..., lint="error"|"warn"|"off")`` / ``MXTPU_LINT``.
+  dropped by remat/inner-trace regions (GL004), recompile hazards
+  (GL005) and defeated ZeRO sharding — replicated optimizer state under
+  ``zero=1`` / redundant all-gathers (GL006).  Wired into every fused
+  step via ``make_train_step(..., lint="error"|"warn"|"off")`` /
+  ``MXTPU_LINT``.
 - **Level 2 (source)**: :mod:`.source_lint` + the ``tools/graftlint.py``
   CLI check repo idiom (GL101–GL103) and gate tier-1 CI.
 """
 from .diagnostics import CODES, Diagnostic, LintError, LintReport, Severity
 from .source_lint import lint_paths, lint_source
 from .trace_lint import (check_partition_spec, check_permutation,
-                         lint_jaxpr, lint_traceable, recompile_probe,
+                         check_zero_state_shardings, lint_jaxpr,
+                         lint_traceable, recompile_probe,
                          validate_permutation)
 
 __all__ = [
     "CODES", "Diagnostic", "LintError", "LintReport", "Severity",
-    "check_partition_spec", "check_permutation", "lint_jaxpr",
+    "check_partition_spec", "check_permutation",
+    "check_zero_state_shardings", "lint_jaxpr",
     "lint_paths", "lint_source", "lint_traceable", "recompile_probe",
     "validate_permutation",
 ]

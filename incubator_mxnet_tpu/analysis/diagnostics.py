@@ -44,6 +44,10 @@ CODES = {
     "GL005": (Severity.WARNING,
               "compile-cache-key instability (host scalars / weak types / "
               "nondeterministic trace) — recompile hazard"),
+    "GL006": (Severity.ERROR,
+              "ZeRO sharding defeated: optimizer-state leaf left "
+              "replicated over the dp axis under zero=1, or an "
+              "all-gather of an already-replicated operand (warning)"),
     "GL101": (Severity.ERROR,
               "shard_map imported from jax directly instead of "
               "parallel/mesh.py (the one version-compat home)"),

@@ -39,8 +39,8 @@ from .diagnostics import CODES, Diagnostic, LintError, LintReport, Severity
 
 __all__ = ["capture_effect_diagnostics", "check_permutation",
            "validate_permutation", "check_partition_spec",
-           "donated_leaf_indices", "lint_jaxpr", "lint_traceable",
-           "recompile_probe"]
+           "check_zero_state_shardings", "donated_leaf_indices",
+           "lint_jaxpr", "lint_traceable", "recompile_probe"]
 
 
 # ---------------------------------------------------------------------------
@@ -175,10 +175,9 @@ _LAYOUT_PRIMS = {"reshape", "transpose", "convert_element_type", "squeeze",
                  "expand_dims", "rev", "copy"}
 
 
-def _chase_producer(var, producers):
-    """Follow ``var`` back through layout-only ops to the primitive that
-    materialized it; returns the primitive name or None (top-level
-    input / constant)."""
+def _chase_var(var, producers):
+    """Follow ``var`` back through layout-only ops; returns the var at
+    the first non-layout producer (or the top-level input/constant)."""
     seen = 0
     while isinstance(var, jcore.Var) and var in producers and seen < 64:
         eqn = producers[var]
@@ -186,7 +185,17 @@ def _chase_producer(var, producers):
             var = eqn.invars[0]
             seen += 1
             continue
-        return eqn.primitive.name
+        break
+    return var
+
+
+def _chase_producer(var, producers):
+    """Follow ``var`` back through layout-only ops to the primitive that
+    materialized it; returns the primitive name or None (top-level
+    input / constant)."""
+    var = _chase_var(var, producers)
+    if isinstance(var, jcore.Var) and var in producers:
+        return producers[var].primitive.name
     return None
 
 
@@ -298,6 +307,48 @@ def _check_donation(jaxpr, donated_mask: Sequence[bool],
 
 
 # ---------------------------------------------------------------------------
+# GL006 — defeated ZeRO sharding
+# ---------------------------------------------------------------------------
+
+def check_zero_state_shardings(state_shardings, axis_name,
+                               where: str = "") -> List[Diagnostic]:
+    """GL006 core: every optimizer-state leaf of a ``zero=1`` step must
+    be sharded over the dp axis.
+
+    ``state_shardings`` is a pytree of sharding objects (``NamedSharding``
+    or bare ``PartitionSpec``) covering the ZeRO-eligible parameters; a
+    leaf whose spec never names ``axis_name`` keeps a full copy of the
+    accumulator on every dp replica — exactly the N× memory the feature
+    exists to remove.
+    """
+    diags: List[Diagnostic] = []
+    leaves = jax.tree_util.tree_leaves(
+        state_shardings,
+        is_leaf=lambda x: hasattr(x, "spec") or hasattr(x, "_partitions"))
+    for i, sh in enumerate(leaves):
+        spec = getattr(sh, "spec", sh)
+        axes = set()
+        for e in tuple(spec or ()):
+            if e is None:
+                continue
+            axes.update(e if isinstance(e, tuple) else (e,))
+        if axis_name not in axes:
+            how = "replicated" if not axes \
+                else "sharded only over %s" % sorted(axes)
+            diags.append(Diagnostic(
+                "GL006", Severity.ERROR,
+                "optimizer-state leaf %d is %s over the %r axis although "
+                "the step was built with zero=1 — every dp replica holds "
+                "the full accumulator, the N x memory the sharded update "
+                "was meant to remove" % (i, how, axis_name),
+                where=where,
+                hint="shard the state leaf over %r (pad-and-slice a "
+                     "leading dim that does not divide) or exclude the "
+                     "parameter from the zero plan" % (axis_name,)))
+    return diags
+
+
+# ---------------------------------------------------------------------------
 # jaxpr walker
 # ---------------------------------------------------------------------------
 
@@ -312,9 +363,12 @@ def _sub_jaxprs(params):
 
 
 def _walk(jaxpr, axis_sizes: Dict[str, int], diags: List[Diagnostic],
-          path: str = "jaxpr"):
+          path: str = "jaxpr", replicated_invars=frozenset()):
     """Recursive jaxpr walk.  Carries a producer map (var -> defining
-    eqn) within each jaxpr for the GL002 stacked-operand check."""
+    eqn) within each jaxpr for the GL002 stacked-operand check;
+    ``replicated_invars`` are shard_map-body invars whose in_spec is
+    fully replicated (empty names), for the GL006 redundant-all-gather
+    check."""
     producers: Dict[Any, Any] = {}
     for n, eqn in enumerate(jaxpr.eqns):
         prim = eqn.primitive.name
@@ -327,12 +381,30 @@ def _walk(jaxpr, axis_sizes: Dict[str, int], diags: List[Diagnostic],
                 label = axes[0] if len(axes) == 1 else tuple(axes)
                 diags.extend(check_permutation(
                     eqn.params.get("perm", ()), size, label, where=where))
+        elif prim == "all_gather" and replicated_invars:
+            src = _chase_var(eqn.invars[0], producers)
+            if src in replicated_invars:
+                diags.append(Diagnostic(
+                    "GL006", Severity.WARNING,
+                    "all_gather over axis %r of an operand that enters "
+                    "this shard_map replicated (in_spec P()) — the "
+                    "gather multiplies an already-full buffer by the "
+                    "axis size for no information"
+                    % (eqn.params.get("axis_name"),), where=where,
+                    hint="drop the all_gather, or shard the operand's "
+                         "in_spec over the axis so the gather "
+                         "re-materializes real shards"))
         elif prim == "shard_map":
             _check_shard_map_eqn(eqn, diags, producers, where)
             mesh = eqn.params["mesh"]
             inner_env = dict(axis_sizes)
             inner_env.update({k: int(v) for k, v in dict(mesh.shape).items()})
-            _walk(eqn.params["jaxpr"], inner_env, diags, path=where)
+            body = eqn.params["jaxpr"]
+            in_names = eqn.params.get("in_names", ())
+            repl = frozenset(v for v, names in zip(body.invars, in_names)
+                             if not names)
+            _walk(body, inner_env, diags, path=where,
+                  replicated_invars=repl)
         elif prim == "pjit":
             closed = eqn.params["jaxpr"]
             donated = eqn.params.get("donated_invars")

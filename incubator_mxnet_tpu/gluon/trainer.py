@@ -139,6 +139,7 @@ class Trainer:
                         param_shardings=None, compute_dtype=None,
                         pipeline_stages=None, num_micro=1,
                         pipeline_axis="pp", pipeline_remat=False,
+                        zero=0, multi_precision=None,
                         lint=None, lint_suppress=()):
         """Build a fused XLA train step from this Trainer's optimizer.
 
@@ -155,6 +156,17 @@ class Trainer:
             step = trainer.make_fused_step(net, loss_fn, mesh=mesh,
                                            pipeline_stages=4, num_micro=8)
             loss = step(x, y)
+
+        ``zero=1`` runs the ZeRO-1 weight-update sharding over the
+        mesh's ``batch_axis``: reduce-scattered grads, dp-sharded
+        optimizer state (1/N per device), all-gathered params.
+        ``multi_precision`` (default: the Optimizer's own
+        ``multi_precision`` flag) keeps f32 master weights in that state
+        for low-precision params.  A ``rescale_grad`` in the optimizer
+        params is applied by the fused update ops exactly as
+        ``Trainer.step`` would apply it (note the fused loss is already
+        a mean over the batch, so pass the extra scale only — not
+        ``1/batch_size``).
 
         The returned TrainStep owns its optimizer state; mixing its calls
         with eager ``Trainer.step`` updates on the same params is
@@ -200,15 +212,32 @@ class Trainer:
                 "time; an lr_scheduler would be silently frozen — drive "
                 "the schedule by rebuilding the step or setting "
                 "step.opt.lr between epochs instead")
-        if self._scale != 1.0:
-            raise ValueError(
-                "rescale_grad is not applied by the fused step (its loss "
-                "is already a mean over the batch); remove it or scale "
-                "the loss function")
+        if multi_precision is None:
+            multi_precision = bool(getattr(opt, "multi_precision", False))
+            if multi_precision and name not in ("sgd", "adam"):
+                # inherited flag the fused step cannot honor: fall back
+                # to the pre-mp behavior (mp was never plumbed through
+                # for these optimizers) instead of failing the build; an
+                # EXPLICIT multi_precision=True still raises below
+                import warnings as _warnings
+
+                _warnings.warn(
+                    "optimizer %r has multi_precision=True but the fused "
+                    "step implements master weights for sgd/adam only; "
+                    "building without master weights (pass make_fused_step"
+                    "(multi_precision=True) to force the error, or "
+                    "multi_precision=False to silence this)" % name,
+                    stacklevel=2)
+                multi_precision = False
         kw = dict(learning_rate=float(opt.learning_rate),
                   wd=float(getattr(opt, "wd", 0.0) or 0.0),
                   clip_gradient=float(
-                      getattr(opt, "clip_gradient", None) or -1.0))
+                      getattr(opt, "clip_gradient", None) or -1.0),
+                  # the fused loss is already a mean over the batch, so
+                  # only the user's extra scale is applied — parity with
+                  # the reference update ops for scaled losses
+                  rescale_grad=float(self._scale),
+                  multi_precision=multi_precision)
         if name == "sgd":
             kw["momentum"] = float(getattr(opt, "momentum", 0.0) or 0.0)
         elif name in ("adam", "lamb", "adamw"):
@@ -225,7 +254,7 @@ class Trainer:
                          param_shardings=param_shardings,
                          pipeline_stages=pipeline_stages,
                          num_micro=num_micro, pipeline_axis=pipeline_axis,
-                         pipeline_remat=pipeline_remat, lint=lint,
+                         pipeline_remat=pipeline_remat, zero=zero, lint=lint,
                          lint_suppress=lint_suppress)
 
     # ------------------------------------------------------------------

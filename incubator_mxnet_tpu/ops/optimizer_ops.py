@@ -55,6 +55,23 @@ def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0, wd=0.
     return w32.astype(weight.dtype), new_mom, w32
 
 
+@register("mp_adam_update", num_inputs=5, differentiable=False,
+          mutate_idx=(0, 2, 3, 4))
+def _mp_adam_update(weight, grad, mean, var, weight32, lr=0.001, beta1=0.9,
+                    beta2=0.999, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0, lazy_update=True):
+    """Adam on the f32 master copy: grad is promoted, mean/var/weight32
+    stay f32, and only the committed weight is cast back — the master-
+    weight analog of mp_sgd_mom_update for the adam family (the
+    reference grew the same shape as contrib mp adamw)."""
+    g = _apply_wd(weight32, grad.astype(jnp.float32), wd, rescale_grad,
+                  clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    w32 = weight32 - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return w32.astype(weight.dtype), new_mean, new_var, w32
+
+
 @register("nag_mom_update", num_inputs=3, differentiable=False, mutate_idx=(0, 2))
 def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                     rescale_grad=1.0, clip_gradient=-1.0):
@@ -99,6 +116,7 @@ def _ftml_update(weight, grad, d, v, z, lr=0.1, beta1=0.6, beta2=0.999,
     if clip_grad is not None and clip_grad >= 0:
         g = jnp.clip(g, -clip_grad, clip_grad)
     new_v = beta2 * v + (1 - beta2) * jnp.square(g)
+    t = jnp.asarray(t, jnp.float32)  # f32 bias correction (x64 is on)
     d_t = (1 - beta1 ** t) / lr * (jnp.sqrt(new_v / (1 - beta2 ** t)) + epsilon)
     sigma = d_t - beta1 * d
     new_z = beta1 * z + (1 - beta1) * g - sigma * weight
@@ -169,6 +187,9 @@ def _lamb_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999, epsilon=1e-6,
     new_mean = beta1 * mean + (1 - beta1) * g
     new_var = beta2 * var + (1 - beta2) * jnp.square(g)
     if bias_correction:
+        # f32 bias correction: python-float ** int array is weak f64
+        # under the package-wide x64 flag and would promote the weight
+        t = jnp.asarray(t, jnp.float32)
         mhat = new_mean / (1 - beta1 ** t)
         vhat = new_var / (1 - beta2 ** t)
     else:

@@ -23,18 +23,84 @@ def pmean(x, axis_name):
     return lax.pmean(x, axis_name)
 
 
+def _concrete_axis_size(axis_name):
+    """Axis size as a concrete int when available (inside shard_map/pmap
+    the named axis has a static size), else None — the same trick the
+    eager ppermute check uses."""
+    try:
+        n = lax.psum(1, axis_name)
+    except NameError:
+        return None
+    return n if isinstance(n, int) else None
+
+
+def _check_dim(x, dim, axis_name, op, role, extra=0):
+    """Eager shape validation for sharding collectives: ``dim`` must be a
+    real dimension of ``x`` (``extra=1`` admits one past the end — an
+    untiled all_gather stacks shards onto a NEW axis).  Raises ValueError
+    naming the axis instead of letting XLA surface a cryptic shape error
+    at compile time."""
+    ndim = getattr(x, "ndim", None)
+    if ndim is not None and not (0 <= dim < ndim + extra):
+        raise ValueError(
+            "%s over axis %r: %s %d is out of range for a %d-dimensional "
+            "operand (shape %s)"
+            % (op, axis_name, role, dim, ndim, tuple(x.shape)))
+
+
+def _check_divisible(x, dim, axis_name, n, op, role):
+    if n is None:
+        return
+    size = x.shape[dim]
+    if size % n:
+        raise ValueError(
+            "%s over axis %r (size %d): %s dimension %d has size %d, "
+            "which does not divide by the axis size — each rank must "
+            "receive an equal shard (pad the dimension to a multiple of "
+            "%d, or see the pad-and-slice path in "
+            "parallel/train_step.py zero=1)"
+            % (op, axis_name, n, role, dim, size, n))
+
+
 def allgather(x, axis_name, axis=0, tiled=True):
-    """Gather shards (ncclAllGather analog)."""
+    """Gather shards (ncclAllGather analog).
+
+    Eagerly validates that ``axis`` is a real dimension of ``x`` (the
+    concat dimension; untiled gathers may also name the one-past-the-end
+    position — they stack shards onto a NEW axis), raising a
+    ``ValueError`` naming the collective axis instead of a cryptic XLA
+    shape error.
+    """
+    _check_dim(x, axis, axis_name, "allgather", "concat",
+               extra=0 if tiled else 1)
     return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
 def reduce_scatter(x, axis_name, scatter_dimension=0, tiled=True):
-    """Sum then scatter (ncclReduceScatter analog; ZeRO grad sharding)."""
+    """Sum then scatter (ncclReduceScatter analog; ZeRO grad sharding).
+
+    Eagerly validates the scatter dimension: it must exist and its size
+    must divide the axis size (each rank receives an equal shard), else
+    a ``ValueError`` naming the axis is raised at trace time.
+    """
+    _check_dim(x, scatter_dimension, axis_name, "reduce_scatter", "scatter")
+    _check_divisible(x, scatter_dimension, axis_name,
+                     _concrete_axis_size(axis_name), "reduce_scatter",
+                     "scatter")
     return lax.psum_scatter(x, axis_name,
                             scatter_dimension=scatter_dimension, tiled=tiled)
 
 
 def alltoall(x, axis_name, split_axis, concat_axis, tiled=True):
+    """All-to-all (ncclAllToAll analog; MoE dispatch/combine).
+
+    Eagerly validates both dimensions and that the split dimension
+    divides the axis size, raising a ``ValueError`` naming the axis.
+    """
+    _check_dim(x, split_axis, axis_name, "alltoall", "split")
+    _check_dim(x, concat_axis, axis_name, "alltoall", "concat")
+    _check_divisible(x, split_axis, axis_name,
+                     _concrete_axis_size(axis_name), "alltoall", "split")
     return lax.all_to_all(x, axis_name, split_axis=split_axis,
                           concat_axis=concat_axis, tiled=tiled)
 
@@ -49,11 +115,8 @@ def ppermute(x, axis_name, perm):
     legal — that is the pipeline fill/drain pattern.
     """
     perm = [(int(s), int(d)) for s, d in perm]
-    try:
-        n = lax.psum(1, axis_name)  # concrete int inside shard_map/pmap
-    except NameError:
-        n = None
-    if isinstance(n, int):
+    n = _concrete_axis_size(axis_name)  # concrete inside shard_map/pmap
+    if n is not None:
         from ..analysis.trace_lint import validate_permutation
 
         validate_permutation(perm, n, axis_name)

@@ -19,6 +19,13 @@ live stage activations per rank); ``remat=True`` checkpoints the stage
 function so only stage *inputs* are stashed and the stage recomputes in
 its backward tick — the 1F1B-style memory/compute trade.  Everything is
 one jitted XLA program: zero per-microbatch Python dispatch.
+
+ZeRO interplay: on a dp x pp mesh, ``make_train_step(...,
+pipeline_stages=K, zero=1)`` composes with this schedule cleanly —
+microbatch gradients accumulate ON-RANK in the scan-transpose carry, so
+the ZeRO-1 dp grad reduction runs ONCE per step on the accumulated
+grads (never per microbatch), and the dp-sharded optimizer state/update
+live entirely outside the pipelined scan (train_step._apply_zero).
 """
 from __future__ import annotations
 

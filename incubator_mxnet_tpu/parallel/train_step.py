@@ -25,10 +25,24 @@ __all__ = ["FunctionalOptimizer", "make_train_step", "TrainStep"]
 
 class FunctionalOptimizer:
     """Pure-functional optimizer over parameter pytrees (the reference's
-    optimizer update ops composed into the jitted step)."""
+    optimizer update ops composed into the jitted step).
+
+    ``multi_precision=True`` keeps an f32 master copy of every parameter
+    in the optimizer state and routes the update through the ``mp_*``
+    master-weight ops: gradients are promoted to f32, momentum/mean/var
+    accumulate in f32, and only the committed weight is cast back to the
+    parameter dtype — fixing the bf16-param path where grads and
+    momentum otherwise accumulate in bf16.  Combined with ``zero=1`` on
+    the step, the master copy is dp-sharded, so it costs 1/N per device.
+
+    ``rescale_grad`` multiplies gradients before the update (the
+    reference update-op semantics), so ``Trainer(rescale_grad=...)``
+    parity holds for scaled losses.
+    """
 
     def __init__(self, name="sgd", learning_rate=0.01, momentum=0.9, wd=0.0,
-                 beta1=0.9, beta2=0.999, epsilon=1e-8, clip_gradient=-1.0):
+                 beta1=0.9, beta2=0.999, epsilon=1e-8, clip_gradient=-1.0,
+                 rescale_grad=1.0, multi_precision=False):
         self.name = name
         self.lr = learning_rate
         self.momentum = momentum
@@ -37,51 +51,142 @@ class FunctionalOptimizer:
         # per-element gradient clipping, as in the reference update ops;
         # <= 0 disables
         self.clip_gradient = float(clip_gradient or -1.0)
+        self.rescale_grad = float(rescale_grad)
+        self.multi_precision = bool(multi_precision)
+        if name not in ("sgd", "adam", "lamb", "adamw"):
+            raise ValueError("unsupported fused optimizer %r" % name)
+        if self.multi_precision and name not in ("sgd", "adam"):
+            raise ValueError(
+                "multi_precision master weights are implemented for "
+                "sgd/adam (the mp_* update ops); got %r" % name)
+
+    @property
+    def has_state(self):
+        """False only for plain sgd (no momentum, no master weights) —
+        the one optimizer whose state pytree is empty."""
+        return self.multi_precision or self.name != "sgd" \
+            or bool(self.momentum)
 
     def init(self, param_vals: List[Any]):
+        """Fresh per-parameter state.  With ``multi_precision`` every
+        parameter gains an f32 master copy as the LAST leaf of its state
+        tuple; accumulators are created in f32 regardless of the
+        parameter dtype."""
+        if self.multi_precision:
+            def w32(p):
+                return p.astype(jnp.float32)
+
+            def z32(p):
+                return jnp.zeros(p.shape, jnp.float32)
+
+            if self.name == "sgd":
+                if self.momentum:
+                    return [(z32(p), w32(p)) for p in param_vals]
+                return [w32(p) for p in param_vals]
+            return [(z32(p), z32(p), w32(p)) for p in param_vals]  # adam
         if self.name == "sgd":
             if self.momentum:
                 return [jnp.zeros_like(p) for p in param_vals]
             return []
-        if self.name in ("adam", "lamb", "adamw"):
-            return [(jnp.zeros_like(p), jnp.zeros_like(p)) for p in param_vals]
-        raise ValueError("unsupported fused optimizer %r" % self.name)
+        return [(jnp.zeros_like(p), jnp.zeros_like(p)) for p in param_vals]
+
+    def state_shardings(self, per_param):
+        """Mirror :meth:`init`'s per-parameter state structure with the
+        given sharding objects (one entry per parameter) — the single
+        place where step builders derive optimizer-state placement."""
+        if self.multi_precision:
+            if self.name == "sgd" and not self.momentum:
+                return list(per_param)
+            n = 2 if self.name == "sgd" else 3
+            return [(s,) * n for s in per_param]
+        if self.name == "sgd":
+            return list(per_param) if self.momentum else []
+        return [(s, s) for s in per_param]
+
+    def apply_single(self, p, g, s, step_count):
+        """One parameter's update: ``(weight, grad, state, step)`` →
+        ``(new_weight, new_state)``.
+
+        ``step_count`` is the 1-BASED step number: the fused step
+        increments its carried counter BEFORE applying, so adam's
+        ``1 - beta**t`` bias correction sees ``t=1`` on the first update
+        (``t=0`` would divide by zero — see the regression test in
+        tests/test_zero_sharding.py).
+
+        sgd/adam updates are elementwise, so this applies unchanged to
+        ZeRO shards; lamb's trust ratio is a global weight/update norm
+        and is excluded from sharded application by the caller.
+        """
+        mp = self.multi_precision
+        if not mp:
+            g = g.astype(jnp.float32) if p.dtype == jnp.float32 \
+                else g.astype(p.dtype)
+        if self.name == "sgd":
+            if mp:
+                if self.momentum:
+                    mom32, w32 = s
+                    w, m2, w32n = _oops._mp_sgd_mom_update(
+                        p, g, mom32, w32, lr=self.lr,
+                        momentum=self.momentum, wd=self.wd,
+                        rescale_grad=self.rescale_grad,
+                        clip_gradient=self.clip_gradient)
+                    return w, (m2, w32n)
+                w, w32n = _oops._mp_sgd_update(
+                    p, g, s, lr=self.lr, wd=self.wd,
+                    rescale_grad=self.rescale_grad,
+                    clip_gradient=self.clip_gradient)
+                return w, w32n
+            if self.momentum:
+                w, m = _oops._sgd_mom_update(
+                    p, g, s, lr=self.lr, momentum=self.momentum, wd=self.wd,
+                    rescale_grad=self.rescale_grad,
+                    clip_gradient=self.clip_gradient)
+                return w, m
+            return _oops._sgd_update(
+                p, g, lr=self.lr, wd=self.wd,
+                rescale_grad=self.rescale_grad,
+                clip_gradient=self.clip_gradient), None
+        if self.name == "adam":
+            # bias correction in f32: with the package-wide x64 flag on,
+            # `beta ** int32_t` promotes to f64 and the corrected lr
+            # would silently promote every updated PARAM to float64
+            # (defeating donation).  t is 1-based — see the docstring.
+            t = jnp.asarray(step_count, jnp.float32)
+            lr = self.lr * jnp.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
+            if mp:
+                mean, var, w32 = s
+                w, m2, v2, w32n = _oops._mp_adam_update(
+                    p, g, mean, var, w32, lr=lr, beta1=self.beta1,
+                    beta2=self.beta2, epsilon=self.epsilon, wd=self.wd,
+                    rescale_grad=self.rescale_grad,
+                    clip_gradient=self.clip_gradient)
+                return w, (m2, v2, w32n)
+            mean, var = s
+            w, m2, v2 = _oops._adam_update(
+                p, g, mean, var, lr=lr, beta1=self.beta1, beta2=self.beta2,
+                epsilon=self.epsilon, wd=self.wd,
+                rescale_grad=self.rescale_grad,
+                clip_gradient=self.clip_gradient)
+            return w, (m2, v2)
+        # lamb / adamw
+        mean, var = s
+        gw, m2, v2 = _oops._lamb_phase1(p, g, mean, var, beta1=self.beta1,
+                                        beta2=self.beta2,
+                                        epsilon=self.epsilon,
+                                        t=step_count, wd=self.wd,
+                                        rescale_grad=self.rescale_grad,
+                                        clip_gradient=self.clip_gradient)
+        w = _oops._lamb_phase2(p, gw, None, lr=self.lr)
+        return w, (m2, v2)
 
     def apply(self, param_vals, grads, states, step_count):
         new_p, new_s = [], []
         for i, (p, g) in enumerate(zip(param_vals, grads)):
-            g = g.astype(jnp.float32) if p.dtype == jnp.float32 else g.astype(p.dtype)
-            if self.name == "sgd":
-                if self.momentum:
-                    w, m = _oops._sgd_mom_update(p, g, states[i], lr=self.lr,
-                                                 momentum=self.momentum,
-                                                 wd=self.wd, clip_gradient=self.clip_gradient)
-                    new_p.append(w)
-                    new_s.append(m)
-                else:
-                    new_p.append(_oops._sgd_update(
-                        p, g, lr=self.lr, wd=self.wd,
-                        clip_gradient=self.clip_gradient))
-            elif self.name == "adam":
-                mean, var = states[i]
-                t = step_count
-                lr = self.lr * jnp.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
-                w, m2, v2 = _oops._adam_update(p, g, mean, var, lr=lr,
-                                               beta1=self.beta1, beta2=self.beta2,
-                                               epsilon=self.epsilon, wd=self.wd,
-                                               clip_gradient=self.clip_gradient)
-                new_p.append(w)
-                new_s.append((m2, v2))
-            elif self.name in ("lamb", "adamw"):
-                mean, var = states[i]
-                gw, m2, v2 = _oops._lamb_phase1(p, g, mean, var, beta1=self.beta1,
-                                                beta2=self.beta2,
-                                                epsilon=self.epsilon,
-                                                t=step_count, wd=self.wd,
-                                                clip_gradient=self.clip_gradient)
-                w = _oops._lamb_phase2(p, gw, None, lr=self.lr)
-                new_p.append(w)
-                new_s.append((m2, v2))
+            s = states[i] if self.has_state else None
+            w, s2 = self.apply_single(p, g, s, step_count)
+            new_p.append(w)
+            if self.has_state:
+                new_s.append(s2)
         return new_p, new_s
 
 
@@ -100,7 +205,8 @@ class TrainStep:
                  param_shardings: Optional[Dict[str, Any]] = None,
                  donate: bool = True, pipeline_stages: Optional[int] = None,
                  num_micro: int = 1, pipeline_axis: str = "pp",
-                 pipeline_remat: bool = False, lint: Optional[str] = None,
+                 pipeline_remat: bool = False, zero: int = 0,
+                 lint: Optional[str] = None,
                  lint_suppress: Tuple[str, ...] = ()):
         self.net = net
         self.loss_fn = loss_fn
@@ -113,6 +219,25 @@ class TrainStep:
         self.num_micro = num_micro
         self.pipeline_axis = pipeline_axis
         self.pipeline_remat = pipeline_remat
+        # ZeRO-1 weight-update sharding (arXiv:2004.13336): reduce-
+        # scatter grads over the dp axis, update 1/N of the weights per
+        # replica against dp-sharded optimizer state, all-gather the
+        # result.  0 = off (replicated update), 1 = ZeRO stage 1.
+        self.zero = int(zero or 0)
+        if self.zero not in (0, 1):
+            raise ValueError("zero must be 0 (off) or 1 (ZeRO-1 "
+                             "weight-update sharding), got %r" % (zero,))
+        if self.zero:
+            if mesh is None or batch_axis not in mesh.axis_names:
+                raise ValueError(
+                    "zero=1 shards the weight update over the %r mesh "
+                    "axis — pass a mesh that has it" % batch_axis)
+            if opt.name not in ("sgd", "adam"):
+                raise ValueError(
+                    "zero=1 needs an elementwise update (sgd/adam); "
+                    "%r's trust ratio is a global norm over the whole "
+                    "weight and cannot run on a 1/N shard" % opt.name)
+        self._zero_pad0 = None  # per-gp-param padded leading dim, or None
         # graftlint Level 1 runs over the traced step before its first
         # compile (docs/ANALYSIS.md): "error" raises on error-severity
         # findings, "warn" prints them, "off" skips the lint trace.
@@ -172,6 +297,156 @@ class TrainStep:
         self._aux = [p for p in params if p.grad_req == "null"]
         if self.pipeline_stages is not None:
             self._collect_pipeline()
+        if self.zero:
+            self._build_zero_plan()
+
+    def _build_zero_plan(self):
+        """Per-parameter ZeRO layout: the padded leading dim (a multiple
+        of the dp axis size — pad-and-slice, never silently replicate),
+        or None for params the dp-sharded update does not cover:
+
+        - params already sharded by ``param_shardings`` (tp/ep): their
+          optimizer state shards like the parameter, so it is already
+          distributed — ZeRO over dp would fight the existing layout;
+        - 0-d (scalar) params: nothing to slice.
+        """
+        n = self.mesh.shape[self.batch_axis]
+        plan = []
+        for p in self._gp:
+            spec = tuple(self.param_shardings.get(p.name, P()))
+            sharded = any(e is not None and e != () for e in spec)
+            if sharded or len(p.shape) < 1:
+                plan.append(None)
+            else:
+                plan.append(-(-p.shape[0] // n) * n)  # ceil to multiple
+        self._zero_pad0 = plan
+
+    @staticmethod
+    def _zero_padded(v, pad0):
+        """Pad the leading dim up to ``pad0`` (identity when it already
+        divides)."""
+        if pad0 is None or pad0 == v.shape[0]:
+            return v
+        return jnp.pad(v, [(0, pad0 - v.shape[0])]
+                       + [(0, 0)] * (v.ndim - 1))
+
+    # ------------------------------------------------------------------
+    def _apply_update(self, p_vals, grads, opt_state, step_count):
+        """The optimizer leg of the step program: plain replicated apply,
+        or the ZeRO-1 sharded update when ``zero=1``."""
+        if not self.zero:
+            return self.opt.apply(p_vals, grads, opt_state, step_count)
+        return self._apply_zero(p_vals, grads, opt_state, step_count)
+
+    def _apply_zero(self, p_vals, grads, opt_state, step_count):
+        """ZeRO-1 weight update over the dp axis (arXiv:2004.13336).
+
+        Inside a ``shard_map`` over the mesh's dp axis: each rank
+        consumes only its 1/N gradient and weight shard (sliced by
+        ``axis_index``), updates it against its dp-sharded optimizer-
+        state shard, and re-materializes the full parameter with
+        ``collectives.allgather``.  The grad slice — not an explicit
+        collective — is deliberate: on jax 0.4.x the grads reach this
+        point dp-replicated (GSPMD has already summed the per-replica
+        partials), so slicing is free and exact for ANY axis size, and
+        ``all-reduce + per-rank slice`` is precisely the pattern the
+        paper's XLA reduce-scatter-creation pass rewrites into a single
+        reduce-scatter on TPU; an explicit ``psum_scatter`` here would
+        be a REDUNDANT second collective (summing N identical copies,
+        with rounding drift for non-power-of-two N) — the waste class
+        graftlint GL006 flags for all_gather.  Params/grads enter the
+        body replicated and are sliced per rank inside it — also the
+        jax 0.4.x-safe pattern (a jit-internal padded operand fed to a
+        sharded in_spec risks the GSPMD stacked-operand miscompile,
+        graftlint GL002).  Ragged leading dims are padded to a multiple
+        of N and the padding is sliced back off after the gather.
+
+        With pipelined grad accumulation (dp×pp), the microbatch grads
+        are already summed by the scan transpose, so the grad reduction
+        happens ONCE at the end of the step, not per microbatch.
+        """
+        from . import collectives
+        from .mesh import shard_map as _shard_map
+
+        mesh, ax = self.mesh, self.batch_axis
+        n = mesh.shape[ax]
+        opt = self.opt
+        pad0s = self._zero_pad0
+        z_idx = [i for i, pad in enumerate(pad0s) if pad is not None]
+        r_idx = [i for i, pad in enumerate(pad0s) if pad is None]
+
+        new_p: List[Any] = [None] * len(p_vals)
+        new_s: List[Any] = [None] * len(p_vals) if opt.has_state else []
+        if r_idx:
+            # tp/ep-sharded and scalar params: plain update; their state
+            # already shards like the parameter
+            rp, rs = opt.apply(
+                [p_vals[i] for i in r_idx], [grads[i] for i in r_idx],
+                [opt_state[i] for i in r_idx] if opt.has_state else [],
+                step_count)
+            for j, i in enumerate(r_idx):
+                new_p[i] = rp[j]
+                if opt.has_state:
+                    new_s[i] = rs[j]
+        if not z_idx:
+            return new_p, new_s
+
+        z_p = [p_vals[i] for i in z_idx]
+        z_g = [grads[i] for i in z_idx]
+        z_s = [opt_state[i] for i in z_idx] if opt.has_state else []
+        z_pad = [pad0s[i] for i in z_idx]
+        shard_spec = P(ax)
+
+        def body(zp, zg, zs, c):
+            idx = jax.lax.axis_index(ax)
+            out_p, out_s = [], []
+            for k, (p, g) in enumerate(zip(zp, zg)):
+                pad0 = z_pad[k]
+                rows = pad0 // n
+                p_pad = self._zero_padded(p, pad0)
+                g_pad = self._zero_padded(g, pad0)
+                g_shard = jax.lax.dynamic_slice_in_dim(
+                    g_pad, idx * rows, rows, 0)
+                p_shard = jax.lax.dynamic_slice_in_dim(
+                    p_pad, idx * rows, rows, 0)
+                s_k = zs[k] if opt.has_state else None
+                w_shard, s_new = opt.apply_single(p_shard, g_shard, s_k, c)
+                w_full = collectives.allgather(w_shard, ax, axis=0,
+                                               tiled=True)
+                if pad0 != p.shape[0]:
+                    w_full = jax.lax.slice_in_dim(w_full, 0, p.shape[0],
+                                                  axis=0)
+                out_p.append(w_full)
+                out_s.append(s_new)
+            if opt.has_state:
+                return tuple(out_p), tuple(out_s)
+            return tuple(out_p)
+
+        repl = P()
+        in_specs = (tuple(repl for _ in z_p), tuple(repl for _ in z_g),
+                    jax.tree.map(lambda _: shard_spec, z_s), repl)
+        if opt.has_state:
+            out_specs = (tuple(repl for _ in z_p),
+                         tuple(jax.tree.map(lambda _: shard_spec, s)
+                               for s in z_s))
+        else:
+            out_specs = tuple(repl for _ in z_p)
+        # per-rank slices/shards differ across dp by construction and
+        # re-replicate via the all-gather; skip the conservative
+        # replication checker (check_vma on jax >= 0.6, check_rep on 0.4)
+        try:
+            mapped = _shard_map(body, mesh=mesh, in_specs=in_specs,
+                                out_specs=out_specs, check_vma=False)
+        except TypeError:
+            mapped = _shard_map(body, mesh=mesh, in_specs=in_specs,
+                                out_specs=out_specs, check_rep=False)
+        res = mapped(tuple(z_p), tuple(z_g), z_s, step_count)
+        zp_new, zs_new = res if opt.has_state else (res, None)
+        for j, i in enumerate(z_idx):
+            new_p[i] = zp_new[j]
+            if opt.has_state:
+                new_s[i] = zs_new[j]
+        return new_p, new_s
 
     def _collect_pipeline(self):
         """Partition the net's children into ``pipeline_stages`` contiguous,
@@ -290,7 +565,8 @@ class TrainStep:
 
             (loss_val, new_aux), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(p_vals)
-            new_p, new_s = opt.apply(p_vals, grads, opt_state, step_count)
+            new_p, new_s = self._apply_update(p_vals, grads, opt_state,
+                                              step_count)
             return loss_val, new_p, list(new_aux), new_s, key, step_count
 
         return step
@@ -389,7 +665,10 @@ class TrainStep:
 
             (loss_val, new_aux), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(p_vals)
-            new_p, new_s = opt.apply(p_vals, grads, opt_state, step_count)
+            # microbatch grads are already accumulated by the scan
+            # transpose; under zero=1 they reduce-scatter ONCE here
+            new_p, new_s = self._apply_update(p_vals, grads, opt_state,
+                                              step_count)
             return loss_val, new_p, list(new_aux), new_s, key, step_count
 
         return step
@@ -415,13 +694,16 @@ class TrainStep:
         # a pp- or ep-only mesh has no batch axis: batches stay replicated
         batch_sh = NamedSharding(mesh, P(self.batch_axis)) \
             if self.batch_axis in mesh.axis_names else repl
-        # opt state shards like its parameter
-        if self.opt.name == "sgd" and self.opt.momentum:
-            state_sh = list(p_sh)
-        elif self.opt.name in ("adam", "lamb", "adamw"):
-            state_sh = [(s, s) for s in p_sh]
+        # opt state shards like its parameter; under zero=1 the state of
+        # every dp-covered param is instead dp-sharded on its (padded)
+        # leading dim — the 1/N memory the feature exists for
+        if self.zero:
+            zsh = NamedSharding(mesh, P(self.batch_axis))
+            per_param = [zsh if pad is not None else s
+                         for s, pad in zip(p_sh, self._zero_pad0)]
         else:
-            state_sh = []
+            per_param = p_sh
+        state_sh = self.opt.state_shardings(per_param)
         self._shardings = (p_sh, aux_sh, state_sh, batch_sh, repl)
         return jax.jit(step, donate_argnums=donate,
                        in_shardings=(p_sh, aux_sh, state_sh, batch_sh,
@@ -473,6 +755,18 @@ class TrainStep:
                                        self._donate_argnums)
         report.extend(lint_jaxpr(closed_jaxpr,
                                  donated_leaves=donated).diagnostics)
+        if self.zero and self._shardings is not None:
+            # GL006: a zero=1 step whose optimizer state is still
+            # replicated over the dp axis keeps the N× memory the
+            # feature exists to remove
+            from ..analysis.trace_lint import check_zero_state_shardings
+
+            state_sh = self._shardings[2]
+            covered = [sh for sh, pad in zip(state_sh, self._zero_pad0)
+                       if pad is not None] if state_sh else []
+            report.extend(check_zero_state_shardings(
+                covered, self.batch_axis,
+                where="TrainStep(zero=1) optimizer state"))
         if self.lint == "error":
             report.raise_if_errors()
         if report.errors or report.warnings:
@@ -489,7 +783,14 @@ class TrainStep:
             if any(p._data is None for p in self._gp + self._aux):
                 raise RuntimeError("initialize() the net before make_train_step")
         if self._opt_state is None:
-            self._opt_state = self.opt.init([p._data._data for p in self._gp])
+            pv = [p._data._data for p in self._gp]
+            if self.zero:
+                # state is born PADDED (leading dim a multiple of the dp
+                # axis) so device_put onto the P(dp) shardings slices it
+                # evenly; master weights inherit the zero padding
+                pv = [self._zero_padded(v, pad)
+                      for v, pad in zip(pv, self._zero_pad0)]
+            self._opt_state = self.opt.init(pv)
         if self._jit is None:
             self._jit = self._build()
             self._multihost = self.mesh is not None and any(
@@ -732,7 +1033,7 @@ class TrainStep:
 def make_train_step(net, loss_fn, optimizer="sgd", mesh=None, batch_axis="dp",
                     param_shardings=None, compute_dtype=None, donate=True,
                     pipeline_stages=None, num_micro=1, pipeline_axis="pp",
-                    pipeline_remat=False, lint=None, lint_suppress=(),
+                    pipeline_remat=False, zero=0, lint=None, lint_suppress=(),
                     **opt_kwargs) -> TrainStep:
     """Build the fused train step (fwd+bwd+optimizer in one XLA program).
 
@@ -746,6 +1047,20 @@ def make_train_step(net, loss_fn, optimizer="sgd", mesh=None, batch_axis="dp",
     Composes with dp: a ``{'dp': d, 'pp': K}`` mesh shards microbatches
     over dp while stages flow over pp.
 
+    ``zero=1`` turns on ZeRO-1 weight-update sharding over the mesh's
+    ``batch_axis`` (arXiv:2004.13336): each replica consumes only its
+    1/N gradient shard (the all-reduce + per-rank-slice pattern XLA's
+    reduce-scatter-creation pass — the paper's contribution — compiles
+    into a reduce-scatter on TPU), optimizer state lives dp-sharded
+    (1/N per device, pad-and-slice for leading dims that don't divide),
+    each replica updates only its weight shard, and the updated params
+    all-gather back.  Composes with ``pipeline_stages`` on a dp×pp mesh
+    (the accumulated microbatch grads reduce once per step).  Pass
+    ``multi_precision=True`` (an optimizer kwarg) to keep f32 master
+    weights in the — now 1/N-cost — optimizer state for bf16 params,
+    and ``rescale_grad=`` to scale gradients as the reference update
+    ops do.
+
     ``lint`` (default: env ``MXTPU_LINT``, else ``"warn"``) runs
     graftlint Level 1 over the traced step before its first compile —
     ``"error"`` raises :class:`~..analysis.LintError` on error-severity
@@ -757,5 +1072,5 @@ def make_train_step(net, loss_fn, optimizer="sgd", mesh=None, batch_axis="dp",
                      batch_axis=batch_axis, param_shardings=param_shardings,
                      donate=donate, pipeline_stages=pipeline_stages,
                      num_micro=num_micro, pipeline_axis=pipeline_axis,
-                     pipeline_remat=pipeline_remat, lint=lint,
+                     pipeline_remat=pipeline_remat, zero=zero, lint=lint,
                      lint_suppress=lint_suppress)

@@ -432,3 +432,126 @@ def test_lint_suppress_per_call():
                             donate_argnums=(0,), suppress=("GL003",))
     assert not report.by_code("GL003")
     assert any(d.code == "GL003" for d in report.suppressed)
+
+
+# ---------------------------------------------------------------------------
+# eager sharding-collective validation (reduce_scatter / allgather /
+# alltoall — the PR-2 ppermute treatment)
+# ---------------------------------------------------------------------------
+
+def test_eager_reduce_scatter_divisibility():
+    """reduce_scatter raises at trace time, naming the axis, when the
+    scatter dimension does not divide the axis size — instead of a
+    cryptic XLA shape error at compile."""
+    from incubator_mxnet_tpu.parallel.collectives import reduce_scatter
+
+    mesh = _mesh_dp_pp()
+
+    def bad(x):
+        def body(xb):
+            return reduce_scatter(xb, "pp", scatter_dimension=0)
+        return shard_map(body, mesh=mesh, in_specs=(P(),),
+                         out_specs=P("pp"), check_rep=False)(x)
+
+    with pytest.raises(ValueError, match=r"reduce_scatter over axis 'pp' "
+                                         r"\(size 4\).*size 6.*not divide"):
+        jax.make_jaxpr(bad)(jnp.ones(6))
+
+    def bad_dim(x):
+        def body(xb):
+            return reduce_scatter(xb, "pp", scatter_dimension=2)
+        return shard_map(body, mesh=mesh, in_specs=(P(),),
+                         out_specs=P("pp"), check_rep=False)(x)
+
+    with pytest.raises(ValueError, match="scatter 2 is out of range"):
+        jax.make_jaxpr(bad_dim)(jnp.ones(8))
+
+
+def test_eager_allgather_and_alltoall_validation():
+    from incubator_mxnet_tpu.parallel.collectives import allgather, alltoall
+
+    mesh = _mesh_dp_pp()
+
+    def bad_gather(x):
+        def body(xb):
+            return allgather(xb, "pp", axis=3)
+        return shard_map(body, mesh=mesh, in_specs=(P("pp"),),
+                         out_specs=P("pp"), check_rep=False)(x)
+
+    with pytest.raises(ValueError, match="allgather over axis 'pp'.*"
+                                         "concat 3 is out of range"):
+        jax.make_jaxpr(bad_gather)(jnp.ones(8))
+
+    def bad_a2a(x):
+        def body(xb):
+            return alltoall(xb, "pp", split_axis=0, concat_axis=1)
+        return shard_map(body, mesh=mesh, in_specs=(P(),),
+                         out_specs=P("pp"), check_rep=False)(x)
+
+    with pytest.raises(ValueError, match=r"alltoall over axis 'pp' "
+                                         r"\(size 4\).*split dimension 0 "
+                                         r"has size 6"):
+        jax.make_jaxpr(bad_a2a)(jnp.ones((6, 2)))
+
+
+# ---------------------------------------------------------------------------
+# GL006 — defeated ZeRO sharding
+# ---------------------------------------------------------------------------
+
+def test_gl006_replicated_state_leaf_flagged():
+    """An optimizer-state sharding left replicated over dp under zero=1
+    is the N x memory the feature removes — ERROR, naming the axis."""
+    from jax.sharding import NamedSharding
+    from incubator_mxnet_tpu.analysis import check_zero_state_shardings
+
+    mesh = _mesh_dp_pp()
+    good = NamedSharding(mesh, P("dp"))
+    bad = NamedSharding(mesh, P())
+    diags = check_zero_state_shardings([good, (bad, good)], "dp")
+    assert [d.code for d in diags] == ["GL006"]
+    assert diags[0].severity == Severity.ERROR
+    assert "replicated" in diags[0].message and "'dp'" in diags[0].message
+    # sharded over the WRONG axis is also flagged (still replicated on dp)
+    diags = check_zero_state_shardings([NamedSharding(mesh, P("pp"))], "dp")
+    assert len(diags) == 1 and "sharded only over" in diags[0].message
+    assert not check_zero_state_shardings([good, (good, good)], "dp")
+
+
+def test_gl006_redundant_allgather_of_replicated_operand():
+    """all_gather of an operand that enters the shard_map replicated
+    (in_spec P()) multiplies a full buffer by the axis size — WARNING."""
+    mesh = _mesh_dp_pp()
+
+    def redundant(x):
+        def body(xb):
+            return lax.all_gather(xb, "dp", axis=0, tiled=True)
+        return shard_map(body, mesh=mesh, in_specs=(P(),),
+                         out_specs=P("dp"), check_rep=False)(x)
+
+    report = lint_traceable(redundant, (jnp.ones(4),))
+    hits = report.by_code("GL006")
+    assert len(hits) == 1 and hits[0].severity == Severity.WARNING
+    assert "already-full" in hits[0].message
+
+    def legitimate(x):
+        def body(xb):
+            return lax.all_gather(xb, "dp", axis=0, tiled=True)
+        return shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                         out_specs=P("dp"), check_rep=False)(x)
+
+    assert not lint_traceable(legitimate, (jnp.ones(4),)).by_code("GL006")
+
+
+def test_gl006_zero_step_lints_clean_and_detects_regression():
+    """The real zero=1 fused step passes lint="error" (its state IS
+    dp-sharded), and the shardings it builds are GL006-clean."""
+    from incubator_mxnet_tpu.analysis import check_zero_state_shardings
+
+    mesh = make_mesh({"dp": 8})
+    net = _build_net()
+    step = make_train_step(net, LOSS(), optimizer="sgd", learning_rate=0.1,
+                           momentum=0.9, mesh=mesh, zero=1, lint="error")
+    x, y = _batch()
+    assert np.isfinite(float(step(x, y).asscalar()))  # lint="error" passed
+    # the shardings the step actually built are GL006-clean
+    assert not check_zero_state_shardings(step._shardings[2], "dp")

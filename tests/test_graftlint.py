@@ -215,6 +215,44 @@ def test_cli_exit_codes(tmp_path):
     assert graftlint.main([str(tmp_path), "--suppress", "GL101"]) == 0
 
 
+def test_cli_select_ignore_filters(tmp_path):
+    """--select/--ignore code filters: CI can gate on a precise code set
+    while other codes stay advisory; ignored codes drop from the exit
+    status too."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import graftlint
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "bad.py"
+    bad.write_text("from jax import shard_map\n"
+                   "from jax.sharding import PartitionSpec as P\n"
+                   "s = P(0)\n")  # GL101 + GL103
+    # unfiltered: both errors gate
+    assert graftlint.main([str(tmp_path)]) == 1
+    # select only GL103 -> still 1 (GL103 is an error); GL101 dropped
+    assert graftlint.main([str(tmp_path), "--select", "GL103"]) == 1
+    # ignore both -> clean exit
+    assert graftlint.main([str(tmp_path), "--ignore", "GL101,GL103"]) == 0
+    # select a code the file does not violate -> clean exit
+    assert graftlint.main([str(tmp_path), "--select", "GL102"]) == 0
+    # --suppress stays an alias of --ignore
+    assert graftlint.main([str(tmp_path), "--suppress", "GL101",
+                           "--ignore", "GL103"]) == 0
+
+
+def test_cli_gate_over_package_with_select():
+    """Tier-1 wiring: the CLI gates the real package on the GL10x error
+    codes (the invocation CI runs)."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import graftlint
+    finally:
+        sys.path.pop(0)
+    assert graftlint.main([os.path.join(ROOT, "incubator_mxnet_tpu"),
+                           "--select", "GL101,GL102,GL103"]) == 0
+
+
 def test_cli_reports_with_location(tmp_path, capsys):
     sys.path.insert(0, os.path.join(ROOT, "tools"))
     try:

@@ -16,9 +16,15 @@ Suppress a finding by appending ``# graftlint: disable[=GLxxx]`` to the
 offending line.  Trace-time (Level 1) checks run inside
 ``make_train_step(lint=...)`` / ``MXTPU_LINT`` — see docs/ANALYSIS.md.
 
+``--select``/``--ignore`` filter by diagnostic code so CI can gate on a
+precise code set (e.g. ``--select GL101,GL102`` hard-fails import/side-
+effect idiom while other codes stay advisory); ``--ignore``d codes are
+dropped from both the report and the exit status.
+
 Usage::
 
     python tools/graftlint.py [paths...] [--min-severity warning]
+                              [--select GL101,GL103] [--ignore GL103]
 """
 from __future__ import annotations
 
@@ -44,15 +50,28 @@ def main(argv=None) -> int:
                     help="lowest severity to print (exit code always "
                          "keys off errors)")
     ap.add_argument("--suppress", default="",
-                    help="comma-separated GLxxx codes to suppress")
+                    help="comma-separated GLxxx codes to suppress "
+                         "(alias of --ignore, kept for compatibility)")
+    ap.add_argument("--select", default="",
+                    help="comma-separated GLxxx codes: report ONLY these "
+                         "(the exit code keys off errors among them)")
+    ap.add_argument("--ignore", default="",
+                    help="comma-separated GLxxx codes to drop from the "
+                         "report and the exit status")
     args = ap.parse_args(argv)
 
-    from incubator_mxnet_tpu.analysis.diagnostics import Severity
+    from incubator_mxnet_tpu.analysis.diagnostics import LintReport, Severity
     from incubator_mxnet_tpu.analysis.source_lint import lint_paths
 
-    suppress = tuple(c.strip() for c in args.suppress.split(",")
-                     if c.strip())
-    report = lint_paths(args.paths, suppress=suppress)
+    def _codes(s):
+        return tuple(c.strip() for c in s.split(",") if c.strip())
+
+    select = _codes(args.select)
+    ignore = _codes(args.ignore) + _codes(args.suppress)
+    report = lint_paths(args.paths)
+    kept = [d for d in report
+            if (not select or d.code in select) and d.code not in ignore]
+    report = LintReport(kept)
     out = report.format(Severity[args.min_severity.upper()])
     if out:
         print(out)
