@@ -8,8 +8,10 @@ semantics):
   spec/mesh inconsistencies including the jax 0.4.x stacked-operand
   GSPMD miscompile (GL002), donation aliasing (GL003), aux effects
   dropped by remat/inner-trace regions (GL004), recompile hazards
-  (GL005) and defeated ZeRO sharding — replicated optimizer state under
-  ``zero=1`` / redundant all-gathers (GL006).  Wired into every fused
+  (GL005), defeated ZeRO sharding — replicated optimizer state under
+  ``zero=1`` / redundant all-gathers (GL006) — and the legacy
+  ``Trainer.save_states`` checkpoint path left reachable beside
+  dp-sharded fused-step state (GL007).  Wired into every fused
   step via ``make_train_step(..., lint="error"|"warn"|"off")`` /
   ``MXTPU_LINT``.
 - **Level 2 (source)**: :mod:`.source_lint` + the ``tools/graftlint.py``
@@ -17,15 +19,16 @@ semantics):
 """
 from .diagnostics import CODES, Diagnostic, LintError, LintReport, Severity
 from .source_lint import lint_paths, lint_source
-from .trace_lint import (check_partition_spec, check_permutation,
+from .trace_lint import (check_legacy_checkpoint_path,
+                         check_partition_spec, check_permutation,
                          check_zero_state_shardings, lint_jaxpr,
                          lint_traceable, recompile_probe,
                          validate_permutation)
 
 __all__ = [
     "CODES", "Diagnostic", "LintError", "LintReport", "Severity",
-    "check_partition_spec", "check_permutation",
-    "check_zero_state_shardings", "lint_jaxpr",
+    "check_legacy_checkpoint_path", "check_partition_spec",
+    "check_permutation", "check_zero_state_shardings", "lint_jaxpr",
     "lint_paths", "lint_source", "lint_traceable", "recompile_probe",
     "validate_permutation",
 ]

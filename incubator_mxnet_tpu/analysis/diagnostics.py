@@ -48,6 +48,11 @@ CODES = {
               "ZeRO sharding defeated: optimizer-state leaf left "
               "replicated over the dp axis under zero=1, or an "
               "all-gather of an already-replicated operand (warning)"),
+    "GL007": (Severity.WARNING,
+              "legacy host-side checkpoint path (Trainer.save_states/"
+              "load_states) still reachable from a zero=1 fused-step "
+              "Trainer — dp-sharded optimizer state cannot round-trip "
+              "through it; use parallel.checkpoint"),
     "GL101": (Severity.ERROR,
               "shard_map imported from jax directly instead of "
               "parallel/mesh.py (the one version-compat home)"),

@@ -37,10 +37,11 @@ from jax import core as jcore
 
 from .diagnostics import CODES, Diagnostic, LintError, LintReport, Severity
 
-__all__ = ["capture_effect_diagnostics", "check_permutation",
-           "validate_permutation", "check_partition_spec",
-           "check_zero_state_shardings", "donated_leaf_indices",
-           "lint_jaxpr", "lint_traceable", "recompile_probe"]
+__all__ = ["capture_effect_diagnostics", "check_legacy_checkpoint_path",
+           "check_permutation", "validate_permutation",
+           "check_partition_spec", "check_zero_state_shardings",
+           "donated_leaf_indices", "lint_jaxpr", "lint_traceable",
+           "recompile_probe"]
 
 
 # ---------------------------------------------------------------------------
@@ -346,6 +347,34 @@ def check_zero_state_shardings(state_shardings, axis_name,
                      "leading dim that does not divide) or exclude the "
                      "parameter from the zero plan" % (axis_name,)))
     return diags
+
+
+# ---------------------------------------------------------------------------
+# GL007 — legacy checkpoint path reachable beside sharded state
+# ---------------------------------------------------------------------------
+
+def check_legacy_checkpoint_path(origin: str,
+                                 where: str = "") -> List[Diagnostic]:
+    """GL007 core: a ``zero=1`` fused step was built from a Trainer
+    (``origin`` — its class name) whose legacy host-side
+    ``save_states``/``load_states`` surface is still reachable.
+
+    That path serializes the *updater's* host state: it can neither see
+    the fused step's state at all nor represent a dp-SHARDED leaf —
+    calling it "works" and silently writes a checkpoint that misses or
+    truncates the optimizer state.  The Trainer raises at call time;
+    this diagnostic surfaces the hazard at lint time, before a long run
+    banks on a checkpoint it cannot restore from.
+    """
+    return [Diagnostic(
+        "GL007", Severity.WARNING,
+        "legacy %s.save_states/load_states cannot round-trip the "
+        "dp-sharded optimizer state of this zero=1 fused step (they "
+        "would silently save one rank's shard)" % origin,
+        where=where,
+        hint="checkpoint through the fused step instead: "
+             "step.save_checkpoint(dir) / step.restore_checkpoint(dir) "
+             "(parallel.checkpoint, docs/RESILIENCE.md)")]
 
 
 # ---------------------------------------------------------------------------

@@ -21,18 +21,27 @@ class LossScaler:
 
     def has_overflow(self, params):
         """True if any gradient is non-finite (all_finite op —
-        src/operator/contrib/all_finite.cc)."""
+        src/operator/contrib/all_finite.cc).
+
+        ONE fused ``multi_all_finite`` over the whole gradient list and
+        ONE device→host sync — not an ``asnumpy()`` round-trip per
+        parameter, which serialized N blocking transfers through the
+        runtime every step (the fused step's in-program guard shares
+        the same ``ops.optimizer_ops.tree_all_finite`` reduction and
+        pays zero syncs)."""
         from ...ndarray import NDArray
         from ...ops.registry import invoke
+        grads = []
         for p in params:
             if getattr(p, "grad_req", "write") == "null":
                 continue  # frozen params have no gradient buffer
             grad = p.grad() if callable(getattr(p, "grad", None)) else p
             if isinstance(grad, NDArray):
-                ok = invoke("all_finite", [grad])
-                if not bool(ok.asnumpy().item()):
-                    return True
-        return False
+                grads.append(grad)
+        if not grads:
+            return False
+        ok = invoke("multi_all_finite", grads, num_arrays=len(grads))
+        return not bool(ok.asnumpy().item())  # the single sync
 
     def update_scale(self, overflow):
         """Halve on overflow; double every scale_window clean steps

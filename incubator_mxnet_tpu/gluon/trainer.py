@@ -140,7 +140,8 @@ class Trainer:
                         pipeline_stages=None, num_micro=1,
                         pipeline_axis="pp", pipeline_remat=False,
                         zero=0, multi_precision=None,
-                        lint=None, lint_suppress=()):
+                        lint=None, lint_suppress=(),
+                        nonfinite=None, loss_scale=None):
         """Build a fused XLA train step from this Trainer's optimizer.
 
         The reference's Trainer.step chain (forward → backward → kvstore
@@ -168,9 +169,19 @@ class Trainer:
         a mean over the batch, so pass the extra scale only — not
         ``1/batch_size``).
 
+        ``nonfinite``/``loss_scale`` switch on the resilience layer of
+        the fused step — in-program non-finite step containment and the
+        functional (dynamic) loss scaler; see
+        ``parallel.make_train_step`` and ``docs/RESILIENCE.md``.
+
         The returned TrainStep owns its optimizer state; mixing its calls
         with eager ``Trainer.step`` updates on the same params is
-        unsupported.
+        unsupported.  Under ``zero=1`` that state is dp-SHARDED, so the
+        legacy ``save_states``/``load_states`` pair on this Trainer is
+        disabled (it would silently save one rank's shard) — use the
+        step's ``save_checkpoint``/``restore_checkpoint``
+        (``parallel/checkpoint.py``) instead; graftlint flags the
+        hazard as GL007.
         """
         from ..parallel.train_step import FunctionalOptimizer, TrainStep
 
@@ -249,19 +260,49 @@ class Trainer:
                 "no fused-step mapping for optimizer %r (supported: sgd, "
                 "adam, lamb, adamw)" % name)
         fopt = FunctionalOptimizer(name, **kw)
-        return TrainStep(net, loss_fn, fopt, compute_dtype=compute_dtype,
+        step = TrainStep(net, loss_fn, fopt, compute_dtype=compute_dtype,
                          mesh=mesh, batch_axis=batch_axis,
                          param_shardings=param_shardings,
                          pipeline_stages=pipeline_stages,
                          num_micro=num_micro, pipeline_axis=pipeline_axis,
                          pipeline_remat=pipeline_remat, zero=zero, lint=lint,
-                         lint_suppress=lint_suppress)
+                         lint_suppress=lint_suppress, nonfinite=nonfinite,
+                         loss_scale=loss_scale)
+        # the guard tracks EVERY live zero=1 step built from this
+        # Trainer (weakrefs: the guard must not pin params/optimizer
+        # state alive, and dies with its step) — the legacy host-side
+        # save_states path below cannot represent their dp-sharded
+        # state (graftlint GL007)
+        live = [r for r in getattr(self, "_fused_zero_steps", ())
+                if r() is not None]
+        if zero:
+            import weakref
+
+            live.append(weakref.ref(step))
+            step._legacy_state_origin = type(self).__name__
+        self._fused_zero_steps = live
+        return step
 
     # ------------------------------------------------------------------
+    def _check_legacy_states_usable(self, what):
+        if any(r() is not None
+               for r in getattr(self, "_fused_zero_steps", ())):
+            raise RuntimeError(
+                "Trainer.%s cannot represent the dp-SHARDED optimizer "
+                "state of the zero=1 fused step built from this Trainer "
+                "— it would silently save one rank's shard (and cannot "
+                "restore any).  Use the shard-aware checkpoint API "
+                "instead: step.save_checkpoint(dir) / "
+                "step.restore_checkpoint(dir) "
+                "(incubator_mxnet_tpu.parallel.checkpoint, "
+                "docs/RESILIENCE.md)" % what)
+
     def save_states(self, fname):
+        self._check_legacy_states_usable("save_states")
         with open(fname, "wb") as f:
             f.write(self._updaters[0].get_states(dump_optimizer=False))
 
     def load_states(self, fname):
+        self._check_legacy_states_usable("load_states")
         with open(fname, "rb") as f:
             self._updaters[0].set_states(f.read())

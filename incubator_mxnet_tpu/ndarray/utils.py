@@ -14,7 +14,9 @@ Two on-disk formats:
 from __future__ import annotations
 
 import json
+import os
 import struct
+import tempfile
 import zipfile
 from typing import Dict, List, Union
 
@@ -28,9 +30,42 @@ __all__ = ["save", "load", "load_frombuffer"]
 _FORMAT_KEY = "__mx_tpu_format__"
 
 
+def _atomic_write_via(fname: str, write_fn) -> None:
+    """Crash-safe file replace: stream via ``write_fn(file)`` into a
+    sibling temp file, fsync, then ``os.replace`` onto the target.  A
+    crash mid-write leaves either the previous complete file or nothing
+    new — never a torn ``.params`` blob that ``load`` half-parses
+    (docs/RESILIENCE.md)."""
+    d = os.path.dirname(os.path.abspath(fname)) or "."
+    fd, tmp = tempfile.mkstemp(prefix=os.path.basename(fname) + ".",
+                               suffix=".tmp", dir=d)
+    try:
+        # mkstemp creates 0600 regardless of umask; published files must
+        # keep the permissions a plain open() would have given them
+        umask = os.umask(0)
+        os.umask(umask)
+        os.chmod(tmp, 0o666 & ~umask)
+        with os.fdopen(fd, "wb") as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, fname)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _atomic_write(fname: str, buf: bytes) -> None:
+    _atomic_write_via(fname, lambda f: f.write(buf))
+
+
 def save(fname: str, data, format="params") -> None:  # noqa: A002
     """Save arrays; ``format='params'`` (default) writes the reference
-    binary container, ``format='npz'`` the numpy container."""
+    binary container, ``format='npz'`` the numpy container.  Both write
+    temp-then-rename, so an interrupted save never tears the file."""
     if isinstance(data, NDArray):
         data = [data]
     if format == "npz":
@@ -43,9 +78,7 @@ def save(fname: str, data, format="params") -> None:  # noqa: A002
         arrays = list(data)
     else:
         raise ValueError("data must be NDArray, list of NDArrays, or dict")
-    buf = legacy_io.save_legacy(arrays, names)
-    with open(fname, "wb") as f:
-        f.write(buf)
+    _atomic_write(fname, legacy_io.save_legacy(arrays, names))
 
 
 def _save_npz(fname: str, data) -> None:
@@ -60,12 +93,10 @@ def _save_npz(fname: str, data) -> None:
         raise ValueError("data must be NDArray, list of NDArrays, or dict")
     arrays[_FORMAT_KEY] = np.frombuffer(json.dumps(manifest).encode(),
                                         dtype=np.uint8)
-    np.savez(fname if fname.endswith(".npz") else fname, **arrays)
-    # np.savez appends .npz; rename back for exact-name parity
-    import os
-
-    if not fname.endswith(".npz") and os.path.exists(fname + ".npz"):
-        os.replace(fname + ".npz", fname)
+    # stream the zip straight into the temp file (no in-memory copy of
+    # the whole container) and commit atomically — this also keeps the
+    # exact target name, where np.savez on a path would append ".npz"
+    _atomic_write_via(fname, lambda f: np.savez(f, **arrays))
 
 
 def load(fname: str) -> Union[List[NDArray], Dict[str, NDArray]]:

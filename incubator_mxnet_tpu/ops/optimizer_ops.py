@@ -222,12 +222,30 @@ def _adamw_update(weight, grad, mean, var, rescale_grad_arr, lr=0.001, beta1=0.9
     return w, new_mean, new_var
 
 
+def tree_all_finite(leaves):
+    """ONE fused all-finite reduction over a list of arrays: a scalar
+    bool that is True iff every element of every leaf is finite.
+
+    The per-leaf ``jnp.all(isfinite(...))`` partials AND-reduce into a
+    single scalar inside one traced program — XLA fuses the whole
+    reduction, so there is exactly one device value to read (one
+    device→host sync for eager callers, zero for in-program users like
+    the fused step's non-finite guard).  Integer leaves are always
+    finite and skipped.
+    """
+    ok = jnp.array(True)
+    for a in leaves:
+        if not jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating):
+            continue
+        # isfinite runs in the leaf's own dtype: a downcast to f32
+        # would misread finite f64 values beyond f32 range as inf
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(a)))
+    return ok
+
+
 @register("all_finite", differentiable=False)
 def _all_finite(*arrays, init_output=True):
-    ok = jnp.array(True)
-    for a in arrays:
-        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(a.astype(jnp.float32))))
-    return ok.reshape(1).astype(jnp.float32)
+    return tree_all_finite(arrays).reshape(1).astype(jnp.float32)
 
 
 @register("multi_all_finite", differentiable=False)

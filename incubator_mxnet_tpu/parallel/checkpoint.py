@@ -1,0 +1,489 @@
+"""Atomic, shard-aware checkpoint/resume for fused training state.
+
+The legacy container paths (``ndarray/utils.save``, ``Trainer.save_states``,
+``Block.save_parameters``) assume a replicated, host-resident parameter
+set.  PR 3's ZeRO-1 sharding broke that assumption: optimizer state is
+dp-sharded and donated, so a naive save either gathers N× memory onto one
+host or silently writes one rank's shard.  This module is the durable
+half of the resilience layer (``docs/RESILIENCE.md``):
+
+- **per-array manifest** — dtype, shape, sharding and a checksum per
+  file, so restore can verify integrity *before* touching live state;
+- **per-shard files** — a dp-sharded leaf (ZeRO-1 optimizer state) is
+  written one file per distinct shard straight from its device buffer:
+  no all-gather, no N× host spike;
+- **atomic commit** — everything is written into a ``.tmp-step-*``
+  staging directory, fsync'd, and published with ONE ``os.replace``;
+  a crash mid-save leaves the previous checkpoint untouched;
+- **last-good fallback** — restore walks back to the newest intact
+  checkpoint when the latest fails checksum/manifest validation;
+- **bounded retry** — transient ``OSError`` s on reads/writes retry
+  with exponential backoff before giving up;
+- **preemption hook** — SIGTERM flips a flag; the train step saves at
+  the next step boundary (``TrainStep.attach_checkpoint``).
+
+Array payloads are raw little-endian bytes (``ndarray.tobytes``) rather
+than ``.npy``: it round-trips every dtype jax uses (including bfloat16
+via ml_dtypes) and keeps checksumming trivial.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import time
+import warnings
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["CheckpointError", "CheckpointCorruptError", "CheckpointManager",
+           "checkpoint_requested", "install_preemption_hook",
+           "request_checkpoint", "request_seq"]
+
+_FORMAT_VERSION = 1
+_MANIFEST = "manifest.json"
+_STEP_FMT = "step-%08d"
+_TMP_PREFIX = ".tmp-"
+_DISCARD_PREFIX = ".discard-"
+
+
+class CheckpointError(RuntimeError):
+    """No usable checkpoint (nothing saved yet, or every candidate is
+    corrupt)."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """A specific checkpoint failed integrity validation: missing file,
+    unparseable/mismatched manifest, or checksum mismatch."""
+
+
+# ---------------------------------------------------------------------------
+# integrity + I/O primitives (the fault-injection patch points)
+# ---------------------------------------------------------------------------
+
+def _checksum(data: bytes) -> str:
+    """``"algo:hex"`` over the payload.  crc32c (Castagnoli) when the
+    optional ``crc32c`` module is present, else zlib's crc32 — the algo
+    rides the manifest so verification always recomputes the same one."""
+    try:
+        import crc32c  # type: ignore
+
+        return "crc32c:%08x" % (crc32c.crc32c(data) & 0xFFFFFFFF)
+    except ImportError:
+        return "crc32:%08x" % (zlib.crc32(data) & 0xFFFFFFFF)
+
+
+def _verify_checksum(data: bytes, recorded: str,
+                     fallback_crc32: Optional[str] = None) -> bool:
+    """Verify against the recorded primary checksum; when its algorithm
+    is unavailable here (checkpoint written where ``crc32c`` was
+    installed, restored where it is not), fall back to the plain-crc32
+    digest every manifest also records — intact data must never be
+    rejected just because an optional module is missing."""
+    algo, _, hexval = recorded.partition(":")
+    if algo == "crc32":
+        return ("%08x" % (zlib.crc32(data) & 0xFFFFFFFF)) == hexval
+    if algo == "crc32c":
+        try:
+            import crc32c  # type: ignore
+        except ImportError:
+            if fallback_crc32 is not None:
+                return ("%08x" % (zlib.crc32(data) & 0xFFFFFFFF)) \
+                    == fallback_crc32
+            return False  # nothing verifiable -> fail safe
+        return ("%08x" % (crc32c.crc32c(data) & 0xFFFFFFFF)) == hexval
+    return False
+
+
+def _write_bytes(path: str, data: bytes) -> None:
+    """Write + flush + fsync one file.  Module-level so the fault
+    harness (``parallel/fault_injection.py``) can interpose failures."""
+    with open(path, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _read_bytes(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _with_retries(fn, retries: int, backoff: float, what: str):
+    """Run ``fn`` retrying transient ``OSError`` s with exponential
+    backoff; the LAST failure propagates."""
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except OSError:
+            if attempt == retries:
+                raise
+            time.sleep(backoff * (2 ** attempt))
+
+
+# ---------------------------------------------------------------------------
+# leaf (de)serialization
+# ---------------------------------------------------------------------------
+
+def _distinct_shards(leaf) -> Optional[List[Any]]:
+    """The distinct device shards of a jax.Array, or None when the leaf
+    is effectively replicated (every device holds the full value — one
+    file suffices).  On a dp×pp mesh a P('dp') leaf has one shard per
+    device but only ``dp`` distinct indices; duplicates are dropped so
+    each unique shard is written exactly once."""
+    shards = getattr(leaf, "addressable_shards", None)
+    if not shards or len(shards) < 2:
+        return None
+    seen: Dict[Tuple, Any] = {}
+    for s in shards:
+        key = tuple((sl.start, sl.stop, sl.step) for sl in s.index)
+        seen.setdefault(key, s)
+    if len(seen) < 2:
+        return None
+    return sorted(seen.values(),
+                  key=lambda s: tuple(sl.start or 0 for sl in s.index))
+
+
+def _index_to_json(index) -> List[List[Optional[int]]]:
+    return [[sl.start, sl.stop] for sl in index]
+
+
+def _index_from_json(spec, shape) -> Tuple[slice, ...]:
+    return tuple(slice(lo, hi) for (lo, hi) in spec)
+
+
+def _leaf_np(x) -> np.ndarray:
+    return np.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# the manager
+# ---------------------------------------------------------------------------
+
+class CheckpointManager:
+    """Atomic checkpoints of an arbitrary pytree of arrays under one
+    directory, newest-intact-wins restore.
+
+    ``save(step, state)`` stages every leaf (sharded leaves one file per
+    distinct shard, straight from the device buffers), writes the
+    manifest last, fsyncs, and commits with a single atomic rename —
+    then retires checkpoints beyond ``keep_last``.  ``restore(like)``
+    validates checksums/manifest and falls back to the next-older
+    checkpoint on corruption.  ``retries``/``backoff`` bound the
+    retry-with-backoff loop around every file read/write.
+    """
+
+    def __init__(self, directory: str, keep_last: int = 3, retries: int = 2,
+                 backoff: float = 0.05):
+        self.directory = str(directory)
+        if keep_last is not None and int(keep_last) < 1:
+            raise ValueError("keep_last must be >= 1 or None, got %r"
+                             % (keep_last,))
+        self.keep_last = None if keep_last is None else int(keep_last)
+        self.retries = int(retries)
+        self.backoff = float(backoff)
+
+    # -- layout ---------------------------------------------------------
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, _STEP_FMT % step)
+
+    def steps(self) -> List[int]:
+        """Committed step numbers, ascending."""
+        if not os.path.isdir(self.directory):
+            return []
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith("step-"):
+                try:
+                    out.append(int(name[5:]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    # -- save -----------------------------------------------------------
+    def save(self, step: int, state) -> str:
+        """Stage + atomically commit ``state`` as checkpoint ``step``.
+        Returns the committed directory path."""
+        step = int(step)
+        flat, _ = jax.tree_util.tree_flatten_with_path(state)
+        os.makedirs(self.directory, exist_ok=True)
+        tmp = os.path.join(self.directory, _TMP_PREFIX + (_STEP_FMT % step))
+        final = self._step_dir(step)
+        self._sweep_stale()
+        os.makedirs(tmp)
+        try:
+            entries = []
+            for i, (path, leaf) in enumerate(flat):
+                entries.append(self._save_leaf(
+                    tmp, "arr_%05d" % i, jax.tree_util.keystr(path), leaf))
+            manifest = {"format_version": _FORMAT_VERSION, "step": step,
+                        "arrays": entries}
+            # the manifest commits the content of the staging dir: it is
+            # written LAST, so a torn stage never looks complete
+            buf = json.dumps(manifest, indent=1).encode()
+            _with_retries(
+                lambda: _write_bytes(os.path.join(tmp, _MANIFEST), buf),
+                self.retries, self.backoff, _MANIFEST)
+            _fsync_dir(tmp)
+            discard = None
+            committed = False
+            try:
+                if os.path.isdir(final):
+                    # re-saving the same step: move the committed dir
+                    # ASIDE (never delete it before the new one is
+                    # committed — a crash here leaves the data on disk,
+                    # and every OTHER checkpoint untouched)
+                    discard = os.path.join(
+                        self.directory, _DISCARD_PREFIX + (_STEP_FMT % step))
+                    shutil.rmtree(discard, ignore_errors=True)
+                    os.replace(final, discard)
+                os.replace(tmp, final)  # THE commit point
+                committed = True
+            finally:
+                if discard is not None and os.path.isdir(discard):
+                    if committed:
+                        shutil.rmtree(discard, ignore_errors=True)
+                    elif not os.path.isdir(final):
+                        # the commit rename failed after the old dir
+                        # moved aside: roll it back so the previously
+                        # committed checkpoint is still restorable
+                        os.replace(discard, final)
+            _fsync_dir(self.directory)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        self._retire()
+        return final
+
+    def _sweep_stale(self):
+        """Remove staging/discard debris from crashed earlier saves.
+        Runs at save time: the manager is single-writer per directory,
+        so anything with a tmp/discard prefix is an orphan by now —
+        without this, every hard kill mid-save would leak one
+        full-state-sized directory forever."""
+        for name in os.listdir(self.directory):
+            if name.startswith(_TMP_PREFIX) or \
+                    name.startswith(_DISCARD_PREFIX):
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
+
+    def _save_leaf(self, tmp: str, name: str, key: str, leaf) -> Dict:
+        dtype = np.dtype(getattr(leaf, "dtype", None)
+                         or np.asarray(leaf).dtype)
+        shape = list(np.shape(leaf))
+        sharding = getattr(leaf, "sharding", None)
+        spec = getattr(sharding, "spec", None)
+        entry = {"key": key, "dtype": dtype.name, "shape": shape,
+                 "spec": None if spec is None else str(spec), "files": []}
+        shards = _distinct_shards(leaf) if isinstance(leaf, jax.Array) \
+            else None
+        if shards is None:
+            # replicated / host leaf: one device->host copy, one file
+            data = _leaf_np(leaf).tobytes()
+            entry["files"].append(self._write_payload(
+                tmp, name + ".bin", data, index=None, part_shape=shape))
+        else:
+            # sharded leaf (ZeRO-1 state): each distinct shard straight
+            # off its device buffer — never gathered
+            for k, s in enumerate(shards):
+                part = _leaf_np(s.data)
+                entry["files"].append(self._write_payload(
+                    tmp, "%s.shard%03d.bin" % (name, k), part.tobytes(),
+                    index=_index_to_json(s.index),
+                    part_shape=list(part.shape)))
+        return entry
+
+    def _write_payload(self, tmp, fname, data, index, part_shape) -> Dict:
+        _with_retries(
+            lambda: _write_bytes(os.path.join(tmp, fname), data),
+            self.retries, self.backoff, fname)
+        return {"file": fname, "checksum": _checksum(data),
+                # always-verifiable fallback digest (see _verify_checksum)
+                "crc32": "%08x" % (zlib.crc32(data) & 0xFFFFFFFF),
+                "nbytes": len(data), "index": index,
+                "part_shape": part_shape}
+
+    def _retire(self):
+        if self.keep_last is None:
+            return
+        steps = self.steps()
+        for s in steps[:-self.keep_last]:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+    # -- restore --------------------------------------------------------
+    def restore(self, like, step: Optional[int] = None, shardings=None):
+        """Load the newest intact checkpoint (or exactly ``step``) into
+        the structure of ``like``; returns ``(step, state)``.
+
+        ``shardings`` — an optional pytree congruent with ``like`` whose
+        leaves are placements (``NamedSharding``/device) — puts every
+        restored leaf straight back on its training layout.  Corrupt
+        candidates are skipped with a warning (last-good fallback)
+        unless ``step`` pinned one explicitly.
+        """
+        if step is not None:
+            return int(step), self._load(int(step), like, shardings)
+        candidates = list(reversed(self.steps()))
+        if not candidates:
+            raise CheckpointError(
+                "no checkpoints under %r" % self.directory)
+        last_err: Optional[Exception] = None
+        for s in candidates:
+            try:
+                return s, self._load(s, like, shardings)
+            except CheckpointCorruptError as e:
+                warnings.warn(
+                    "checkpoint %s is corrupt (%s); falling back to the "
+                    "previous one" % (_STEP_FMT % s, e), stacklevel=2)
+                last_err = e
+        raise CheckpointError(
+            "no intact checkpoint under %r (%d candidate(s), newest "
+            "error: %s)" % (self.directory, len(candidates), last_err))
+
+    def _load(self, step: int, like, shardings):
+        d = self._step_dir(step)
+        try:
+            raw = _with_retries(
+                lambda: _read_bytes(os.path.join(d, _MANIFEST)),
+                self.retries, self.backoff, _MANIFEST)
+            manifest = json.loads(raw.decode())
+        except FileNotFoundError as e:
+            raise CheckpointCorruptError("missing manifest: %s" % e)
+        except (OSError, ValueError) as e:
+            raise CheckpointCorruptError("unreadable manifest: %s" % e)
+        if manifest.get("format_version") != _FORMAT_VERSION:
+            raise CheckpointCorruptError(
+                "manifest format_version %r != %d"
+                % (manifest.get("format_version"), _FORMAT_VERSION))
+        flat, treedef = jax.tree_util.tree_flatten_with_path(like)
+        entries = manifest.get("arrays", [])
+        if len(entries) != len(flat):
+            raise CheckpointCorruptError(
+                "manifest has %d arrays, expected %d (training state "
+                "structure changed?)" % (len(entries), len(flat)))
+        flat_sh: List[Any] = [None] * len(flat)
+        if shardings is not None:
+            sh_flat, sh_def = jax.tree_util.tree_flatten_with_path(shardings)
+            if len(sh_flat) != len(flat):
+                raise ValueError("shardings tree is not congruent with "
+                                 "the state tree")
+            flat_sh = [s for _, s in sh_flat]
+        leaves = []
+        for (path, _), entry, sh in zip(flat, entries, flat_sh):
+            key = jax.tree_util.keystr(path)
+            if entry.get("key") != key:
+                raise CheckpointCorruptError(
+                    "manifest entry %r does not match state leaf %r"
+                    % (entry.get("key"), key))
+            try:
+                leaves.append(self._load_leaf(d, entry, sh))
+            except CheckpointCorruptError:
+                raise
+            except (KeyError, IndexError, TypeError, ValueError) as e:
+                # manifest content that parses as JSON but decodes to
+                # garbage (mangled dtype name, wrong part_shape/index):
+                # corruption, not a caller error — the last-good
+                # fallback in restore() must still engage
+                raise CheckpointCorruptError(
+                    "undecodable manifest entry %r: %s" % (key, e))
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def _load_leaf(self, d: str, entry: Dict, sharding):
+        dtype = np.dtype(entry["dtype"])
+        shape = tuple(entry["shape"])
+        files = entry["files"]
+        if len(files) == 1 and files[0].get("index") is None:
+            arr = self._read_part(d, files[0], dtype).reshape(shape)
+        else:
+            arr = np.empty(shape, dtype)
+            for f in files:
+                part = self._read_part(d, f, dtype) \
+                    .reshape(tuple(f["part_shape"]))
+                arr[_index_from_json(f["index"], shape)] = part
+        if sharding is not None:
+            return jax.device_put(arr, sharding)
+        return jnp.asarray(arr)
+
+    def _read_part(self, d: str, f: Dict, dtype) -> np.ndarray:
+        path = os.path.join(d, f["file"])
+        try:
+            buf = _with_retries(lambda: _read_bytes(path),
+                                self.retries, self.backoff, f["file"])
+        except FileNotFoundError as e:
+            raise CheckpointCorruptError("missing array file: %s" % e)
+        if len(buf) != int(f["nbytes"]):
+            raise CheckpointCorruptError(
+                "%s: %d bytes on disk, manifest says %d (torn write?)"
+                % (f["file"], len(buf), f["nbytes"]))
+        if not _verify_checksum(buf, f["checksum"], f.get("crc32")):
+            raise CheckpointCorruptError(
+                "%s: checksum mismatch (%s)" % (f["file"], f["checksum"]))
+        return np.frombuffer(buf, dtype)
+
+
+# ---------------------------------------------------------------------------
+# preemption: SIGTERM -> checkpoint at the next step boundary
+# ---------------------------------------------------------------------------
+
+# monotonically increasing request sequence (incrementing an int is
+# atomic under the GIL, safe from a signal handler).  Each consumer
+# (TrainStep._maybe_checkpoint) remembers the last sequence it honored,
+# so ONE request reaches EVERY attached step loop — a global clear
+# would let the first loop to hit a boundary steal the request from
+# the others.
+_CKPT_SEQ = 0
+
+
+def request_checkpoint() -> None:
+    """Ask every step loop with an attached manager to checkpoint at its
+    next step boundary (what the SIGTERM hook calls)."""
+    global _CKPT_SEQ
+    _CKPT_SEQ += 1
+
+
+def request_seq() -> int:
+    """Current request sequence number (consumers compare-and-store)."""
+    return _CKPT_SEQ
+
+
+def checkpoint_requested(since: int = 0) -> bool:
+    """True when a checkpoint request newer than ``since`` is pending."""
+    return _CKPT_SEQ > since
+
+
+def install_preemption_hook(signals=(signal.SIGTERM,), chain=True):
+    """Install handlers that flip the checkpoint-request flag on
+    preemption signals (must run on the main thread).  The handler is
+    async-signal-light — it only sets an event; the actual save happens
+    at the next step boundary on the training thread, where device
+    state is consistent.  ``chain=True`` forwards to any previously
+    installed handler.  Returns ``{signum: previous_handler}``."""
+    previous = {}
+
+    def _handler(signum, frame):
+        request_checkpoint()
+        prev = previous.get(signum)
+        if chain and callable(prev):
+            prev(signum, frame)
+
+    for s in signals:
+        previous[s] = signal.signal(s, _handler)
+    return previous

@@ -1,0 +1,135 @@
+"""Fault-injection harness for the resilience layer.
+
+Deterministic, test-grade fault injectors for the three failure classes
+``docs/RESILIENCE.md`` claims to survive:
+
+- **bad numerics** — :func:`poison_batch` / :class:`NaNInjector` make a
+  chosen step produce non-finite gradients (a NaN/inf planted in the
+  input propagates through the forward AND the backward pass, which is
+  exactly how a corrupt record or an fp16 overflow presents);
+- **failed writes** — :func:`fail_writes` interposes the checkpoint
+  module's byte-writer and raises ``OSError`` on selected writes
+  (transient by default, so retry-with-backoff is exercised; persistent
+  to prove a failed save never corrupts the last committed checkpoint);
+- **silent corruption** — :func:`corrupt_checkpoint` bit-flips or
+  truncates a *committed* array file, the torn-write/bit-rot case the
+  per-file checksums exist to catch.
+
+Everything here is process-local monkeypatching or direct file surgery:
+no real signals, no real device faults — cheap enough for tier-1.
+"""
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["NaNInjector", "corrupt_checkpoint", "fail_writes",
+           "poison_batch"]
+
+
+def poison_batch(x, value=float("nan"), index=0):
+    """Copy of batch ``x`` with ``value`` (NaN by default) planted at
+    flat position ``index`` — one poisoned element is enough to make
+    every gradient of a dense net non-finite."""
+    from ..ndarray import NDArray
+
+    arr = np.array(x.asnumpy() if isinstance(x, NDArray) else x)
+    flat = arr.reshape(-1)
+    flat[index] = value
+    return NDArray(arr) if isinstance(x, NDArray) else arr
+
+
+class NaNInjector:
+    """Wrap a train step so its ``at_steps``-th calls (0-based) see a
+    poisoned batch: ``inj = NaNInjector(step, at_steps=(2,))`` then call
+    ``inj(x, y)`` in place of ``step(x, y)``."""
+
+    def __init__(self, step, at_steps=(0,), value=float("nan")):
+        self.step = step
+        self.at_steps = set(int(s) for s in at_steps)
+        self.value = value
+        self.calls = 0
+
+    def __call__(self, x, y):
+        if self.calls in self.at_steps:
+            x = poison_batch(x, self.value)
+        self.calls += 1
+        return self.step(x, y)
+
+
+@contextmanager
+def fail_writes(at=0, count=1, exc: Optional[BaseException] = None):
+    """Make the checkpoint writer raise on selected file writes.
+
+    ``at`` — 0-based ordinal of the first write (within this context)
+    that fails; ``count`` — how many consecutive writes fail from there
+    (so the default ``at=0, count=1`` is one transient fault the
+    retry loop must absorb; a large ``count`` is a persistent outage).
+    Yields a stats object whose ``.failed`` counts injected faults.
+    """
+    from . import checkpoint as _ckpt
+
+    exc = exc or OSError("injected write failure")
+    real = _ckpt._write_bytes
+
+    class _Stats:
+        seen = 0
+        failed = 0
+
+    stats = _Stats()
+
+    def flaky(path, data):
+        i = stats.seen
+        stats.seen += 1
+        if at <= i < at + count:
+            stats.failed += 1
+            raise exc
+        return real(path, data)
+
+    _ckpt._write_bytes = flaky
+    try:
+        yield stats
+    finally:
+        _ckpt._write_bytes = real
+
+
+def corrupt_checkpoint(directory, step=None, what="bitflip", which=0):
+    """Damage a COMMITTED checkpoint in place; returns the path touched.
+
+    ``what``: ``"bitflip"`` flips one bit mid-payload of the
+    ``which``-th array file (silent corruption a checksum must catch);
+    ``"truncate"`` halves the file (torn write); ``"manifest"``
+    truncates the manifest itself.
+    """
+    from .checkpoint import _MANIFEST, _STEP_FMT, CheckpointManager
+
+    mgr = CheckpointManager(directory)
+    step = mgr.latest_step() if step is None else int(step)
+    if step is None:
+        raise ValueError("no committed checkpoint under %r" % (directory,))
+    d = os.path.join(str(directory), _STEP_FMT % step)
+    if what == "manifest":
+        path = os.path.join(d, _MANIFEST)
+        with open(path, "r+b") as f:
+            f.truncate(max(os.path.getsize(path) // 2, 1))
+        return path
+    names = sorted(n for n in os.listdir(d) if n.endswith(".bin"))
+    if not names:
+        raise ValueError("no array files in %r" % d)
+    path = os.path.join(d, names[int(which) % len(names)])
+    if what == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(max(os.path.getsize(path) // 2, 1))
+    elif what == "bitflip":
+        with open(path, "r+b") as f:
+            data = bytearray(f.read())
+            data[len(data) // 2] ^= 0x10
+            f.seek(0)
+            f.write(data)
+    else:
+        raise ValueError("what must be 'bitflip', 'truncate' or "
+                         "'manifest', got %r" % (what,))
+    return path

@@ -20,7 +20,40 @@ from ..ndarray import NDArray
 from ..ops import optimizer_ops as _oops
 from .pipeline import shard_map, spmd_pipeline
 
-__all__ = ["FunctionalOptimizer", "make_train_step", "TrainStep"]
+__all__ = ["DynamicLossScale", "FunctionalOptimizer", "make_train_step",
+           "TrainStep"]
+
+
+class DynamicLossScale:
+    """Functional dynamic loss-scaling policy — the jit-safe analog of
+    ``contrib/amp/loss_scaler.py``.
+
+    The mutable ``LossScaler`` adjusts a host float between steps; here
+    the scale and its clean-step counter are *carried device state* of
+    the fused step (donated, updated inside the program), so scaling
+    composes with donation, ``multi_precision`` and ``zero=1`` without
+    any per-step host sync.  Semantics match the reference scaler:
+    halve (down to ``min_loss_scale``) on an overflowing step, double
+    (up to ``max_loss_scale``) after ``scale_window`` consecutive clean
+    steps.
+    """
+
+    def __init__(self, init_scale=2.**16, scale_factor=2., scale_window=2000,
+                 max_loss_scale=2.**24, min_loss_scale=1.0):
+        if init_scale <= 0 or scale_factor <= 1:
+            raise ValueError("init_scale must be > 0 and scale_factor > 1")
+        if int(scale_window) < 1:
+            raise ValueError("scale_window must be >= 1")
+        self.init_scale = float(init_scale)
+        self.scale_factor = float(scale_factor)
+        self.scale_window = int(scale_window)
+        self.max_loss_scale = float(max_loss_scale)
+        self.min_loss_scale = float(min_loss_scale)
+
+    def __repr__(self):
+        return ("DynamicLossScale(init=%g, factor=%g, window=%d, max=%g)"
+                % (self.init_scale, self.scale_factor, self.scale_window,
+                   self.max_loss_scale))
 
 
 class FunctionalOptimizer:
@@ -207,7 +240,9 @@ class TrainStep:
                  num_micro: int = 1, pipeline_axis: str = "pp",
                  pipeline_remat: bool = False, zero: int = 0,
                  lint: Optional[str] = None,
-                 lint_suppress: Tuple[str, ...] = ()):
+                 lint_suppress: Tuple[str, ...] = (),
+                 nonfinite: Optional[str] = None,
+                 loss_scale=None):
         self.net = net
         self.loss_fn = loss_fn
         self.opt = opt
@@ -238,6 +273,57 @@ class TrainStep:
                     "%r's trust ratio is a global norm over the whole "
                     "weight and cannot run on a 1/N shard" % opt.name)
         self._zero_pad0 = None  # per-gp-param padded leading dim, or None
+        # ---- resilience: non-finite step containment + loss scaling ----
+        # loss_scale: None (off) | float (static) | "dynamic" |
+        # DynamicLossScale instance.  The scale and its counters are
+        # device-carried step state (see DynamicLossScale).
+        if loss_scale is None:
+            self._scale_cfg = None
+        elif isinstance(loss_scale, DynamicLossScale):
+            self._scale_cfg = loss_scale
+        elif isinstance(loss_scale, str):
+            if loss_scale != "dynamic":
+                raise ValueError("loss_scale must be None, a positive "
+                                 "number, 'dynamic' or a DynamicLossScale; "
+                                 "got %r" % (loss_scale,))
+            self._scale_cfg = DynamicLossScale()
+        elif isinstance(loss_scale, (int, float)):
+            if loss_scale <= 0:
+                raise ValueError("static loss_scale must be positive, "
+                                 "got %r" % (loss_scale,))
+            self._scale_cfg = float(loss_scale)
+        else:
+            raise ValueError("loss_scale must be None, a positive number, "
+                             "'dynamic' or a DynamicLossScale; got %r"
+                             % (loss_scale,))
+        self._dynamic_scale = isinstance(self._scale_cfg, DynamicLossScale)
+        # nonfinite: what a step with any non-finite gradient does.
+        # "skip"  — contain it: params, aux state, optimizer state and the
+        #           step counter stay bit-identical (one fused all-finite
+        #           reduction + a select guard, still one XLA program);
+        # "raise" — contain it AND raise FloatingPointError on the host;
+        # "off"   — no guard (the pre-resilience program, bit for bit).
+        # Default: "skip" when a dynamic scaler is on (its contract
+        # REQUIRES skipping overflowed steps), else "off".
+        if nonfinite is None:
+            nonfinite = "skip" if self._dynamic_scale else "off"
+        if nonfinite not in ("skip", "raise", "off"):
+            raise ValueError("nonfinite must be 'skip', 'raise' or 'off', "
+                             "got %r" % (nonfinite,))
+        if self._dynamic_scale and nonfinite == "off":
+            raise ValueError(
+                "a dynamic loss scale requires skipping overflowed steps "
+                "(they are how it detects the scale is too high) — use "
+                "nonfinite='skip' or 'raise', not 'off'")
+        self.nonfinite = nonfinite
+        self._scaler_dev = None  # (scale f32, unskipped i32, skipped i32)
+        # set by Trainer.make_fused_step so the lint pass can flag the
+        # legacy save_states path (GL007) still reachable on the object
+        self._legacy_state_origin = None
+        self._ckpt_manager = None
+        self._ckpt_every = None
+        self._ckpt_prev_count = 0
+        self._ckpt_seen_request = 0
         # graftlint Level 1 runs over the traced step before its first
         # compile (docs/ANALYSIS.md): "error" raises on error-severity
         # findings, "warn" prints them, "off" skips the lint trace.
@@ -284,9 +370,9 @@ class TrainStep:
         self._multihost = False
         self._donate = donate
         # the ONE donation spec: state args of step(p_vals, aux_vals,
-        # opt_state, x, y, key, step_count) — jit, the multi-step scan
-        # program, and the GL003 lint all key off this tuple
-        self._donate_argnums = (0, 1, 2, 5, 6) if donate else ()
+        # opt_state, x, y, key, step_count, scaler_state) — jit, the
+        # multi-step scan program, and the GL003 lint all key off this
+        self._donate_argnums = (0, 1, 2, 5, 6, 7) if donate else ()
         self._placed = False
         self._shardings = None
 
@@ -331,6 +417,73 @@ class TrainStep:
                        + [(0, 0)] * (v.ndim - 1))
 
     # ------------------------------------------------------------------
+    def _finish_step(self, loss_val, grads, p_vals, aux_vals, new_aux,
+                     opt_state, key, step_count, scaler):
+        """Shared tail of every step program: (un)scale, guard, update.
+
+        One fused global all-finite reduction over the whole grad tree
+        (``ops.optimizer_ops.tree_all_finite`` — a single scalar inside
+        the program, NOT per-param host syncs), then the optimizer leg,
+        then — when containment is on — a select guard: a step with any
+        non-finite gradient leaves params, aux state, optimizer state
+        (incl. pipeline/ZeRO shards: the select runs on the final,
+        full-tree outputs, so sharded layouts pass through untouched)
+        and the step counter bit-identical.  The select form is
+        donation-safe: both arms alias the same donated buffers and XLA
+        lowers it to a predicated copy.  The dynamic scaler (when
+        configured) halves on overflow and doubles after
+        ``scale_window`` clean steps, functionally, in the carried
+        ``(scale, unskipped, skipped)`` state.
+        """
+        scale, unskipped, skipped = scaler
+        scaling = self._scale_cfg is not None
+        guard = self.nonfinite != "off"
+        if guard:
+            # finiteness is checked on the RAW (still scaled) grads:
+            # that is where fp16 overflow appears, and unscaling an inf
+            # cannot rescue it anyway
+            ok = _oops.tree_all_finite(grads)
+        else:
+            ok = jnp.array(True)
+        if scaling:
+            # powers-of-two scales make the multiply exact; compute in
+            # the wider of (grad dtype, f32) so f16/bf16 grads unscale
+            # in f32 while f64 grads keep their full mantissa
+            inv = (1.0 / scale).astype(jnp.float32)
+
+            def unscale(g):
+                ct = jnp.promote_types(g.dtype, jnp.float32)
+                return (g.astype(ct) * inv.astype(ct)).astype(g.dtype)
+
+            grads = [unscale(g) for g in grads]
+            loss_val = loss_val * inv
+        c1 = step_count + 1
+        new_p, new_s = self._apply_update(p_vals, grads, opt_state, c1)
+        if guard:
+            def sel(n, o):
+                return jnp.where(ok, n, o)
+
+            new_p = [sel(n, o) for n, o in zip(new_p, p_vals)]
+            new_aux = [sel(n, o) for n, o in zip(new_aux, aux_vals)]
+            new_s = jax.tree.map(sel, new_s, opt_state)
+            c1 = sel(c1, step_count)
+            skipped = skipped + jnp.where(ok, jnp.int32(0), jnp.int32(1))
+            if self._dynamic_scale:
+                cfg = self._scale_cfg
+                unsk = jnp.where(ok, unskipped + 1, jnp.int32(0))
+                grow = unsk >= cfg.scale_window
+                scale = jnp.where(
+                    ok,
+                    jnp.where(grow,
+                              jnp.minimum(scale * cfg.scale_factor,
+                                          cfg.max_loss_scale),
+                              scale),
+                    jnp.maximum(scale / cfg.scale_factor,
+                                cfg.min_loss_scale)).astype(jnp.float32)
+                unskipped = jnp.where(grow, jnp.int32(0), unsk)
+        return (loss_val, new_p, list(new_aux), new_s, key, c1,
+                (scale, unskipped, skipped), ok)
+
     def _apply_update(self, p_vals, grads, opt_state, step_count):
         """The optimizer leg of the step program: plain replicated apply,
         or the ZeRO-1 sharded update when ``zero=1``."""
@@ -525,12 +678,11 @@ class TrainStep:
         gp_list, aux_list = self._gp, self._aux
         net, loss_fn, opt = self.net, self.loss_fn, self.opt
 
-        def step(p_vals, aux_vals, opt_state, x, y, key, step_count):
-            # key/step_count are DEVICE-carried state (donated, updated in
-            # program): a fresh host scalar or an eager key split per step
-            # costs ~10-100 ms of serialized host->device transfer through a
-            # tunneled runtime, which dominated the measured step gap
-            step_count = step_count + 1
+        def step(p_vals, aux_vals, opt_state, x, y, key, step_count, scaler):
+            # key/step_count/scaler are DEVICE-carried state (donated,
+            # updated in program): a fresh host scalar or an eager key split
+            # per step costs ~10-100 ms of serialized host->device transfer
+            # through a tunneled runtime, which dominated the measured gap
             key, use_key = jax.random.split(key)
             def loss_of(pv):
                 pv_c, x_c = self._cast_inputs(pv, x)
@@ -561,13 +713,18 @@ class TrainStep:
                 # gradients flow through the same fused program
                 for al in tc.aux_losses:
                     loss_val = loss_val + al.astype(jnp.float32)
+                if self._scale_cfg is not None:
+                    # the SCALED loss feeds the backward pass so fp16
+                    # grads overflow before they denormalize; the
+                    # reported loss is unscaled again in _finish_step
+                    loss_val = loss_val * scaler[0]
                 return loss_val, new_aux
 
             (loss_val, new_aux), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(p_vals)
-            new_p, new_s = self._apply_update(p_vals, grads, opt_state,
-                                              step_count)
-            return loss_val, new_p, list(new_aux), new_s, key, step_count
+            return self._finish_step(loss_val, grads, p_vals, aux_vals,
+                                     new_aux, opt_state, key, step_count,
+                                     scaler)
 
         return step
 
@@ -615,8 +772,7 @@ class TrainStep:
                     "pipelined net or train without pipeline_stages")
             return out._data
 
-        def step(p_vals, aux_vals, opt_state, x, y, key, step_count):
-            step_count = step_count + 1
+        def step(p_vals, aux_vals, opt_state, x, y, key, step_count, scaler):
             key, use_key = jax.random.split(key)
 
             def loss_of(pv):
@@ -661,15 +817,18 @@ class TrainStep:
                 loss_val = loss._data.astype(jnp.float32)
                 for al in tc.aux_losses:
                     loss_val = loss_val + al.astype(jnp.float32)
+                if self._scale_cfg is not None:
+                    loss_val = loss_val * scaler[0]
                 return loss_val, list(aux_vals)
 
             (loss_val, new_aux), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(p_vals)
             # microbatch grads are already accumulated by the scan
-            # transpose; under zero=1 they reduce-scatter ONCE here
-            new_p, new_s = self._apply_update(p_vals, grads, opt_state,
-                                              step_count)
-            return loss_val, new_p, list(new_aux), new_s, key, step_count
+            # transpose; under zero=1 they reduce-scatter ONCE here —
+            # and the non-finite guard sees the fully-accumulated tree
+            return self._finish_step(loss_val, grads, p_vals, aux_vals,
+                                     new_aux, opt_state, key, step_count,
+                                     scaler)
 
         return step
 
@@ -707,9 +866,9 @@ class TrainStep:
         self._shardings = (p_sh, aux_sh, state_sh, batch_sh, repl)
         return jax.jit(step, donate_argnums=donate,
                        in_shardings=(p_sh, aux_sh, state_sh, batch_sh,
-                                     batch_sh, repl, repl),
+                                     batch_sh, repl, repl, repl),
                        out_shardings=(repl, p_sh, aux_sh, state_sh, repl,
-                                      repl))
+                                      repl, repl, repl))
 
     # ------------------------------------------------------------------
     def _maybe_lint(self, example_args):
@@ -767,6 +926,15 @@ class TrainStep:
             report.extend(check_zero_state_shardings(
                 covered, self.batch_axis,
                 where="TrainStep(zero=1) optimizer state"))
+        if self.zero and self._legacy_state_origin:
+            # GL007: the Trainer this step was built from still exposes
+            # the legacy save_states/load_states path, which cannot
+            # represent dp-sharded optimizer state
+            from ..analysis.trace_lint import check_legacy_checkpoint_path
+
+            report.extend(check_legacy_checkpoint_path(
+                self._legacy_state_origin,
+                where="Trainer.make_fused_step(zero=1)"))
         if self.lint == "error":
             report.raise_if_errors()
         if report.errors or report.warnings:
@@ -813,6 +981,11 @@ class TrainStep:
                                                    self._shardings[4])
         if self._step_dev is None:
             self._step_dev = jnp.int32(self._step_count)
+        if self._scaler_dev is None:
+            init_scale = self._scale_cfg.init_scale if self._dynamic_scale \
+                else float(self._scale_cfg or 1.0)
+            self._scaler_dev = (jnp.float32(init_scale), jnp.int32(0),
+                                jnp.int32(0))
 
     def _place_state(self, p_vals, aux_vals):
         """One-time placement of params/opt-state on their target shardings
@@ -831,12 +1004,15 @@ class TrainStep:
             self._opt_state = jax.tree.map(
                 lambda v, s: mhu.host_local_array_to_global_array(
                     v, self.mesh, s.spec), self._opt_state, state_sh)
-            # carried key/step must be identical across hosts (same seed);
-            # promote the host-local replicas to replicated global arrays
+            # carried key/step/scaler must be identical across hosts (same
+            # seed); promote the host-local replicas to global arrays
             self._key_dev = mhu.host_local_array_to_global_array(
                 self._key_dev, self.mesh, repl.spec)
             self._step_dev = mhu.host_local_array_to_global_array(
                 self._step_dev, self.mesh, repl.spec)
+            self._scaler_dev = tuple(
+                mhu.host_local_array_to_global_array(v, self.mesh, repl.spec)
+                for v in self._scaler_dev)
         else:
             p_vals = [jax.device_put(v, s) for v, s in zip(p_vals, p_sh)]
             aux_vals = [jax.device_put(v, s)
@@ -845,6 +1021,8 @@ class TrainStep:
                 jax.device_put, self._opt_state, state_sh)
             self._key_dev = jax.device_put(self._key_dev, repl)
             self._step_dev = jax.device_put(self._step_dev, repl)
+            self._scaler_dev = tuple(jax.device_put(v, repl)
+                                     for v in self._scaler_dev)
         self._placed = True
         return p_vals, aux_vals
 
@@ -894,7 +1072,8 @@ class TrainStep:
         t0 = _time.time()
         traced = self._lint_trace(self._jit,
                                   (p_vals, aux_vals, self._opt_state, xv,
-                                   yv, self._key_dev, self._step_dev))
+                                   yv, self._key_dev, self._step_dev,
+                                   self._scaler_dev))
         lowered = traced.lower()
         t_trace = _time.time() - t0
         t0 = _time.time()
@@ -916,18 +1095,20 @@ class TrainStep:
         """
         step = self._step_fn
 
-        def multi(p_vals, aux_vals, opt_state, xs, ys, key, step_count):
+        def multi(p_vals, aux_vals, opt_state, xs, ys, key, step_count,
+                  scaler):
             def body(carry, xy):
-                p, a, st, k, c = carry
+                p, a, st, k, c, sc = carry
                 x, y = xy
-                loss, p2, a2, s2, k2, c2 = step(p, a, st, x, y, k, c)
-                return (p2, a2, s2, k2, c2), loss
+                loss, p2, a2, s2, k2, c2, sc2, ok = step(p, a, st, x, y,
+                                                         k, c, sc)
+                return (p2, a2, s2, k2, c2, sc2), (loss, ok)
 
-            carry, losses = jax.lax.scan(
-                body, (p_vals, aux_vals, opt_state, key, step_count),
-                (xs, ys))
-            p, a, st, k, c = carry
-            return losses, p, a, st, k, c
+            carry, (losses, oks) = jax.lax.scan(
+                body, (p_vals, aux_vals, opt_state, key, step_count,
+                       scaler), (xs, ys))
+            p, a, st, k, c, sc = carry
+            return losses, p, a, st, k, c, sc, oks
 
         donate = self._donate_argnums
         if self.mesh is None:
@@ -937,9 +1118,9 @@ class TrainStep:
             if self.batch_axis in self.mesh.axis_names else repl
         return jax.jit(multi, donate_argnums=donate,
                        in_shardings=(p_sh, aux_sh, state_sh, stack_sh,
-                                     stack_sh, repl, repl),
+                                     stack_sh, repl, repl, repl),
                        out_shardings=(repl, p_sh, aux_sh, state_sh, repl,
-                                      repl))
+                                      repl, repl, repl))
 
     def run_steps(self, xs, ys):
         """Run ``K = len(xs)`` steps as one program (see _build_multi).
@@ -985,16 +1166,33 @@ class TrainStep:
             # is the step, so the walker sees the same hazards
             self._lint_trace(self._multi_jit,
                              (p_vals, aux_vals, self._opt_state, xs, ys,
-                              self._key_dev, self._step_dev))
-        losses, new_p, new_aux, new_s, self._key_dev, self._step_dev = \
+                              self._key_dev, self._step_dev,
+                              self._scaler_dev))
+        (losses, new_p, new_aux, new_s, self._key_dev, self._step_dev,
+         self._scaler_dev, oks) = \
             self._multi_jit(p_vals, aux_vals, self._opt_state, xs, ys,
-                            self._key_dev, self._step_dev)
+                            self._key_dev, self._step_dev, self._scaler_dev)
+        # host mirror; with nonfinite containment the DEVICE counter is
+        # authoritative (skipped steps do not advance it)
         self._step_count += int(k)
         for pp, v in zip(self._gp, new_p):
             pp._data._data = v
         for pp, v in zip(self._aux, new_aux):
             pp._data._data = v
         self._opt_state = new_s
+        # boundary checkpoint BEFORE a possible raise: a pending
+        # preemption save must not be dropped by an overflowing stack
+        self._maybe_checkpoint()
+        if self.nonfinite == "raise":
+            import numpy as _np
+
+            bad = _np.flatnonzero(~_np.asarray(oks))
+            if bad.size:
+                raise FloatingPointError(
+                    "non-finite gradients in %d of %d scanned steps "
+                    "(offsets %s); params/optimizer state were left "
+                    "unchanged for those steps"
+                    % (bad.size, int(k), bad[:8].tolist()))
         return NDArray(losses)
 
     def __call__(self, x, y):
@@ -1009,31 +1207,186 @@ class TrainStep:
                 p_vals, aux_vals = self._place_state(p_vals, aux_vals)
             xv, yv = self._place_batch(xv, yv)
         self._maybe_lint((p_vals, aux_vals, self._opt_state, xv, yv,
-                          self._key_dev, self._step_dev))
+                          self._key_dev, self._step_dev, self._scaler_dev))
         # the AOT executable is shape-pinned; any other batch shape/dtype
         # falls back to the jit wrapper, which retraces transparently
         fn = self._jit
         if self._compiled is not None and self._compiled_key == (
                 (xv.shape, str(xv.dtype)), (yv.shape, str(yv.dtype))):
             fn = self._compiled
-        loss, new_p, new_aux, new_s, self._key_dev, self._step_dev = fn(
+        (loss, new_p, new_aux, new_s, self._key_dev, self._step_dev,
+         self._scaler_dev, ok) = fn(
             p_vals, aux_vals, self._opt_state, xv, yv, self._key_dev,
-            self._step_dev)
+            self._step_dev, self._scaler_dev)
         # host mirror of the device counter, advanced only on success so the
-        # two can't drift when a step raises (bad shapes, donation errors)
+        # two can't drift when a step raises (bad shapes, donation errors);
+        # with nonfinite containment the DEVICE counter is authoritative
+        # (a skipped step does not advance it)
         self._step_count += 1
         for p, v in zip(self._gp, new_p):
             p._data._data = v
         for p, v in zip(self._aux, new_aux):
             p._data._data = v
         self._opt_state = new_s
+        # the boundary checkpoint runs BEFORE a possible raise below: a
+        # pending preemption save must not be dropped because the final
+        # step happened to overflow
+        self._maybe_checkpoint()
+        if self.nonfinite == "raise" and not bool(ok):
+            # state is already installed — and provably unchanged, the
+            # guard selected the old buffers — so training CAN continue
+            # after catching this
+            raise FloatingPointError(
+                "non-finite gradients after %d applied updates (call %d "
+                "of this step); params/optimizer state were left "
+                "unchanged (nonfinite='raise')"
+                % (int(self._step_dev), self._step_count))
         return NDArray(loss)
+
+    # ------------------------------------------------------------------
+    @property
+    def loss_scale(self):
+        """The CURRENT loss scale (reads the carried device state)."""
+        if self._scaler_dev is None:
+            return self._scale_cfg.init_scale if self._dynamic_scale \
+                else float(self._scale_cfg or 1.0)
+        return float(self._scaler_dev[0])
+
+    @property
+    def skipped_steps(self):
+        """How many steps the non-finite guard has skipped so far."""
+        return 0 if self._scaler_dev is None else int(self._scaler_dev[2])
+
+    @property
+    def step_count(self):
+        """Applied-update count (device counter: skipped steps excluded)."""
+        return self._step_count if self._step_dev is None \
+            else int(self._step_dev)
+
+    # ------------------------------------------------------------------
+    # durable state (parallel/checkpoint.py)
+    def _checkpoint_state(self):
+        """The full training state as one pytree: params, aux state,
+        optimizer state (dp-sharded leaves stay sharded — the manager
+        saves per-rank shards without gathering), PRNG key, device step
+        counter and loss-scale state."""
+        self._ensure_built()
+        return {"params": [p._data._data for p in self._gp],
+                "aux": [p._data._data for p in self._aux],
+                "opt_state": self._opt_state,
+                "rng_key": self._key_dev,
+                "step": self._step_dev,
+                "loss_scale": self._scaler_dev}
+
+    def _checkpoint_shardings(self):
+        """Placement tree congruent with :meth:`_checkpoint_state` —
+        what restore uses to put every restored leaf back on its exact
+        device layout (None leaves mean default placement)."""
+        if self.mesh is None or self._shardings is None:
+            return None
+        p_sh, aux_sh, state_sh, _, repl = self._shardings
+        return {"params": list(p_sh), "aux": list(aux_sh),
+                "opt_state": state_sh, "rng_key": repl, "step": repl,
+                "loss_scale": (repl, repl, repl)}
+
+    def _as_manager(self, directory_or_manager, keep_last=3):
+        from .checkpoint import CheckpointManager
+
+        if isinstance(directory_or_manager, CheckpointManager):
+            return directory_or_manager
+        return CheckpointManager(directory_or_manager, keep_last=keep_last)
+
+    def save_checkpoint(self, directory_or_manager, keep_last=3):
+        """Atomically checkpoint the full training state (see
+        ``docs/RESILIENCE.md``).  Returns the committed directory."""
+        self._ensure_built()
+        if self._multihost:
+            raise NotImplementedError(
+                "multihost checkpointing needs per-process shard files; "
+                "save from a single-controller run")
+        mgr = self._as_manager(directory_or_manager, keep_last)
+        state = self._checkpoint_state()
+        return mgr.save(int(jax.device_get(self._step_dev)), state)
+
+    def restore_checkpoint(self, directory_or_manager, step=None):
+        """Restore params/optimizer state/RNG/step/loss-scale from the
+        newest intact checkpoint (or ``step=``), placing every leaf back
+        on its training sharding.  Returns the restored step number.
+        Training resumes bit-identically to the uninterrupted run."""
+        self._ensure_built()
+        mgr = self._as_manager(directory_or_manager)
+        like = self._checkpoint_state()
+        step_no, state = mgr.restore(like, step=step,
+                                     shardings=self._checkpoint_shardings())
+        for p, v in zip(self._gp, state["params"]):
+            p._data._data = v
+        for p, v in zip(self._aux, state["aux"]):
+            p._data._data = v
+        self._opt_state = state["opt_state"]
+        self._key_dev = state["rng_key"]
+        self._step_dev = state["step"]
+        self._scaler_dev = tuple(state["loss_scale"])
+        self._step_count = int(step_no)
+        # the restored key IS the training stream: suppress the fresh
+        # draw _ensure_built would otherwise do on a reseed epoch bump
+        self._key_epoch = rng.epoch()
+        if self.mesh is not None:
+            # every leaf was device_put onto its training sharding by
+            # the manager; skip the one-time placement pass
+            self._placed = True
+        return step_no
+
+    def attach_checkpoint(self, directory_or_manager, every=None,
+                          keep_last=3):
+        """Bind a checkpoint manager to the step loop: saves at the next
+        step boundary whenever a preemption/checkpoint request is
+        pending (``checkpoint.install_preemption_hook`` / SIGTERM), and
+        every ``every`` applied steps if given.  Returns the manager."""
+        from . import checkpoint as _ckpt
+
+        if every is not None and int(every) < 1:
+            raise ValueError("every must be >= 1 or None")
+        self._ckpt_manager = self._as_manager(directory_or_manager,
+                                              keep_last)
+        self._ckpt_every = int(every) if every else None
+        self._ckpt_prev_count = self._step_count
+        # requests predating the attach are not ours to honor
+        self._ckpt_seen_request = _ckpt.request_seq()
+        return self._ckpt_manager
+
+    def _maybe_checkpoint(self):
+        """Step-boundary hook: honor a pending preemption request (and
+        the periodic schedule) against the attached manager.  The
+        schedule runs off the HOST step mirror — never a per-step
+        device sync; the device counter is read only when a save
+        actually happens (inside save_checkpoint, which blocks anyway).
+        """
+        if self._ckpt_manager is None:
+            return
+        from . import checkpoint as _ckpt
+
+        # per-step request bookkeeping: one request_checkpoint() (the
+        # SIGTERM hook) must reach EVERY attached step loop, so each
+        # remembers the last sequence IT honored — no global clear
+        seq = _ckpt.request_seq()
+        due = seq > self._ckpt_seen_request
+        if self._ckpt_every:
+            # boundary CROSSING, not exact divisibility: run_steps
+            # advances the counter by k per call, so `% every == 0`
+            # would miss nearly every boundary for k > 1
+            prev, cur = self._ckpt_prev_count, self._step_count
+            self._ckpt_prev_count = cur
+            due = due or prev // self._ckpt_every != cur // self._ckpt_every
+        if due:
+            self.save_checkpoint(self._ckpt_manager)
+            self._ckpt_seen_request = seq
 
 
 def make_train_step(net, loss_fn, optimizer="sgd", mesh=None, batch_axis="dp",
                     param_shardings=None, compute_dtype=None, donate=True,
                     pipeline_stages=None, num_micro=1, pipeline_axis="pp",
                     pipeline_remat=False, zero=0, lint=None, lint_suppress=(),
+                    nonfinite=None, loss_scale=None,
                     **opt_kwargs) -> TrainStep:
     """Build the fused train step (fwd+bwd+optimizer in one XLA program).
 
@@ -1066,6 +1419,24 @@ def make_train_step(net, loss_fn, optimizer="sgd", mesh=None, batch_axis="dp",
     ``"error"`` raises :class:`~..analysis.LintError` on error-severity
     findings, ``"warn"`` emits a warning, ``"off"`` disables.
     ``lint_suppress`` drops the given ``GLxxx`` codes (docs/ANALYSIS.md).
+
+    ``nonfinite`` contains bad steps INSIDE the program: ``"skip"``
+    leaves params, aux state, optimizer state and the step counter
+    bit-identical when any gradient is non-finite (one fused all-finite
+    reduction + select guard — no per-param host syncs, donation-safe,
+    composes with pipelining and ``zero=1``); ``"raise"`` additionally
+    raises :class:`FloatingPointError` on the host (state still
+    protected); ``"off"`` (default without a dynamic scaler) keeps the
+    unguarded program.  ``loss_scale`` is ``None``, a static positive
+    scale, ``"dynamic"``, or a :class:`DynamicLossScale` policy — the
+    dynamic scale + counters ride the step's carried device state
+    (halve on overflow, double every ``scale_window`` clean steps,
+    matching ``contrib/amp/loss_scaler.py``) and are surfaced as
+    ``step.loss_scale`` / ``step.skipped_steps``.  See
+    ``docs/RESILIENCE.md`` for the policy matrix, and
+    ``step.save_checkpoint`` / ``step.restore_checkpoint`` /
+    ``step.attach_checkpoint`` for durable, shard-aware
+    checkpoint/resume (``parallel/checkpoint.py``).
     """
     opt = FunctionalOptimizer(optimizer, **opt_kwargs)
     return TrainStep(net, loss_fn, opt, compute_dtype=compute_dtype, mesh=mesh,
@@ -1073,4 +1444,5 @@ def make_train_step(net, loss_fn, optimizer="sgd", mesh=None, batch_axis="dp",
                      donate=donate, pipeline_stages=pipeline_stages,
                      num_micro=num_micro, pipeline_axis=pipeline_axis,
                      pipeline_remat=pipeline_remat, zero=zero, lint=lint,
-                     lint_suppress=lint_suppress)
+                     lint_suppress=lint_suppress, nonfinite=nonfinite,
+                     loss_scale=loss_scale)

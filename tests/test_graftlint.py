@@ -253,6 +253,58 @@ def test_cli_gate_over_package_with_select():
                            "--select", "GL101,GL102,GL103"]) == 0
 
 
+def test_gl007_legacy_save_states_from_zero1_fused_trainer():
+    """GL007 gate: a zero=1 fused step built from a Trainer warns that
+    the legacy save_states path is still reachable (it cannot round-trip
+    dp-sharded optimizer state), and the Trainer raises if it IS called
+    — pointing at the shard-aware checkpoint API."""
+    import warnings
+
+    import numpy as np
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, nd
+    from incubator_mxnet_tpu.analysis import (CODES, Severity as Sev,
+                                              check_legacy_checkpoint_path)
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.parallel import make_mesh
+
+    # the code is cataloged (append-only contract, docs/ANALYSIS.md)
+    assert CODES["GL007"][0] == Sev.WARNING
+    diags = check_legacy_checkpoint_path("Trainer", where="here")
+    assert [d.code for d in diags] == ["GL007"]
+    assert "save_states" in diags[0].message
+    assert "checkpoint" in diags[0].hint
+
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="tanh"), nn.Dense(8))
+    net.initialize(init=mx.init.Xavier())
+    net(nd.ones((2, 8)))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    step = trainer.make_fused_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                                   mesh=make_mesh({"dp": 8}), zero=1,
+                                   lint="warn")
+    x = nd.array(np.random.RandomState(0).rand(8, 8).astype(np.float32))
+    y = nd.array((np.arange(8) % 4).astype(np.float32))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        step(x, y)
+    assert any("GL007" in str(w.message) for w in caught), \
+        [str(w.message) for w in caught]
+    with pytest.raises(RuntimeError, match="save_checkpoint"):
+        trainer.save_states("/tmp/should_not_exist.states")
+    with pytest.raises(RuntimeError, match="restore_checkpoint"):
+        trainer.load_states("/tmp/should_not_exist.states")
+    # a plain (zero=0) fused-step Trainer keeps the legacy path
+    trainer2 = gluon.Trainer(net.collect_params(), "sgd",
+                             {"learning_rate": 0.1})
+    trainer2.make_fused_step(net, gluon.loss.SoftmaxCrossEntropyLoss())
+    trainer2.save_states("/tmp/gl007_plain.states")
+    os.unlink("/tmp/gl007_plain.states")
+
+
 def test_cli_reports_with_location(tmp_path, capsys):
     sys.path.insert(0, os.path.join(ROOT, "tools"))
     try:

@@ -49,6 +49,44 @@ def test_list_container_roundtrip(tmp_path):
     np.testing.assert_array_equal(loaded[0].asnumpy(), np.ones((2, 3)))
 
 
+def test_save_is_atomic_no_torn_file(tmp_path):
+    """A failed save leaves the PREVIOUS complete file, never a torn
+    one — and a torn container is rejected by load, not half-parsed."""
+    path = str(tmp_path / "atomic.params")
+    old = {"w": nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))}
+    nd.save(path, old)
+
+    # crash at the commit point: the rename fails AFTER the bytes are
+    # written; the target must still be the previous complete file and
+    # the staged temp file must be cleaned up
+    import incubator_mxnet_tpu.ndarray.utils as nd_utils
+
+    real_replace = os.replace
+
+    def boom(src, dst):
+        raise OSError("disk yanked (injected)")
+
+    nd_utils.os.replace = boom
+    try:
+        with pytest.raises(OSError, match="injected"):
+            nd.save(path, {"w": nd.ones((4, 4))})
+    finally:
+        nd_utils.os.replace = real_replace
+    loaded = nd.load(path)
+    np.testing.assert_array_equal(loaded["w"].asnumpy(),
+                                  old["w"].asnumpy())
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+
+    # regression: a TRUNCATED container raises instead of half-parsing
+    with open(path, "rb") as f:
+        full = f.read()
+    torn = str(tmp_path / "torn.params")
+    with open(torn, "wb") as f:
+        f.write(full[:len(full) // 2])
+    with pytest.raises(Exception):
+        nd.load(torn)
+
+
 def test_npz_back_compat(tmp_path):
     """Round-1/2 .npz checkpoints still load."""
     path = str(tmp_path / "old.params")

@@ -1,0 +1,244 @@
+"""Non-finite step containment + functional loss scaling in the fused
+step (docs/RESILIENCE.md).
+
+Headline acceptance: an injected NaN-grad step — on dp, dp×pp and
+zero=1 meshes, under ``lint="error"`` — provably leaves params, aux
+state, optimizer state and the step counter BIT-identical while the
+functional dynamic loss scaler halves; a clean window doubles the scale
+back (``contrib/amp/loss_scaler.py`` semantics, carried as device
+state).  Plus ``nonfinite="raise"``, static-scale invariance, scan
+(``run_steps``) carry, and the fused single-sync ``has_overflow``
+satellite.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, nd
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.parallel import (DynamicLossScale, make_mesh,
+                                          make_train_step)
+from incubator_mxnet_tpu.parallel.fault_injection import (NaNInjector,
+                                                          poison_batch)
+
+FEAT = 8
+LOSS = gluon.loss.SoftmaxCrossEntropyLoss
+
+
+def _build(seed=3, layers=2):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    for _ in range(layers):
+        net.add(nn.Dense(FEAT, activation="tanh"))
+    net.initialize(init=mx.init.Xavier())
+    net(nd.ones((2, FEAT)))
+    return net
+
+
+def _batch(batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    x = nd.array(rng.rand(batch, FEAT).astype(np.float32))
+    y = nd.array((np.arange(batch) % 4).astype(np.float32))
+    return x, y
+
+
+def _snapshot(step):
+    ps = [p.data().asnumpy().copy()
+          for p in step.net.collect_params().values()]
+    ss = [np.asarray(leaf).copy()
+          for leaf in jax.tree_util.tree_leaves(step._opt_state)]
+    return ps, ss
+
+
+MESHES = {
+    "dp": dict(axes={"dp": 8}),
+    "dp_pp": dict(axes={"dp": 2, "pp": 2}, pipeline=True),
+    "zero1": dict(axes={"dp": 8}, zero=1),
+}
+
+
+@pytest.mark.parametrize("mesh_kind", sorted(MESHES))
+def test_nan_step_contained_and_scaler_halves(mesh_kind):
+    """The acceptance case: NaN grads leave ALL training state
+    bit-identical, the scaler halves, and the run recovers."""
+    cfg = MESHES[mesh_kind]
+    ndev = int(np.prod(list(cfg["axes"].values())))
+    mesh = make_mesh(cfg["axes"], devices=jax.devices()[:ndev])
+    kw = dict(optimizer="adam", learning_rate=0.01, mesh=mesh,
+              lint="error", nonfinite="skip",
+              loss_scale=DynamicLossScale(init_scale=2.**10,
+                                          scale_window=1000))
+    if cfg.get("pipeline"):
+        kw.update(pipeline_stages=2, num_micro=2)
+    if cfg.get("zero"):
+        kw.update(zero=1)
+    step = make_train_step(_build(), LOSS(), **kw)
+    x, y = _batch()
+    inj = NaNInjector(step, at_steps=(1,))
+    inj(x, y)  # clean step 0
+    p0, s0 = _snapshot(step)
+    key0 = np.asarray(step._key_dev)
+    inj(x, y)  # poisoned step 1
+    p1, s1 = _snapshot(step)
+    for a, b in zip(p0 + s0, p1 + s1):
+        assert np.array_equal(a, b), \
+            "state changed on a non-finite step (%s)" % mesh_kind
+    assert step.skipped_steps == 1
+    assert step.step_count == 1  # the bad step did not count
+    assert step.loss_scale == 2.**9  # halved
+    # the PRNG stream still advanced (the key is not training state)
+    assert not np.array_equal(key0, np.asarray(step._key_dev))
+    loss = float(inj(x, y).asscalar())  # recovery
+    assert np.isfinite(loss)
+    assert step.step_count == 2 and step.skipped_steps == 1
+
+
+def test_raise_mode_protects_state_then_raises():
+    step = make_train_step(_build(), LOSS(), optimizer="sgd",
+                           learning_rate=0.1, momentum=0.9,
+                           nonfinite="raise")
+    x, y = _batch()
+    step(x, y)
+    p0, s0 = _snapshot(step)
+    with pytest.raises(FloatingPointError, match="unchanged"):
+        step(poison_batch(x, float("inf")), y)
+    p1, s1 = _snapshot(step)
+    for a, b in zip(p0 + s0, p1 + s1):
+        assert np.array_equal(a, b)
+    # training continues after catching: state was never poisoned
+    assert np.isfinite(float(step(x, y).asscalar()))
+
+
+def test_dynamic_scale_window_growth_and_floor():
+    """Double after scale_window clean steps (capped), halve on each
+    overflow down to the floor — the loss_scaler.py contract, jitted."""
+    scaler = DynamicLossScale(init_scale=4.0, scale_window=2,
+                              max_loss_scale=8.0)
+    step = make_train_step(_build(), LOSS(), optimizer="sgd",
+                           learning_rate=0.05, nonfinite="skip",
+                           loss_scale=scaler)
+    x, y = _batch()
+    step(x, y)
+    assert step.loss_scale == 4.0  # 1 clean step: window not reached
+    step(x, y)
+    assert step.loss_scale == 8.0  # window hit: doubled
+    step(x, y)
+    step(x, y)
+    assert step.loss_scale == 8.0  # capped at max_loss_scale
+    bad_x = poison_batch(x)
+    for expect in (4.0, 2.0, 1.0, 1.0):  # halves to the 1.0 floor
+        step(bad_x, y)
+        assert step.loss_scale == expect
+    assert step.skipped_steps == 4
+
+
+def test_static_scale_is_invariant():
+    """A static power-of-two loss_scale changes NOTHING numerically:
+    scaled loss, unscaled grads — parity with the unscaled step."""
+    x, y = _batch()
+    s_ref = make_train_step(_build(5), LOSS(), optimizer="sgd",
+                            learning_rate=0.1, momentum=0.9)
+    s_scaled = make_train_step(_build(5), LOSS(), optimizer="sgd",
+                               learning_rate=0.1, momentum=0.9,
+                               loss_scale=1024.0, nonfinite="skip")
+    ref = [float(s_ref(x, y).asscalar()) for _ in range(3)]
+    got = [float(s_scaled(x, y).asscalar()) for _ in range(3)]
+    np.testing.assert_allclose(ref, got, rtol=1e-6, atol=1e-7)
+    for p1, p2 in zip(s_ref.net.collect_params().values(),
+                      s_scaled.net.collect_params().values()):
+        np.testing.assert_allclose(p1.data().asnumpy(),
+                                   p2.data().asnumpy(),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_run_steps_carries_scaler_and_skips():
+    """The scanned multi-step program threads the scaler through the
+    carry: a poisoned batch inside the stack is skipped in-program."""
+    step = make_train_step(_build(7), LOSS(), optimizer="sgd",
+                           learning_rate=0.1, nonfinite="skip",
+                           loss_scale=DynamicLossScale(init_scale=8.0,
+                                                       scale_window=100))
+    x, y = _batch()
+    bad_x = poison_batch(x)
+    losses = step.run_steps([x, bad_x, x], [y, y, y])
+    arr = losses.asnumpy()
+    assert np.isfinite(arr[0]) and np.isfinite(arr[2])
+    assert not np.isfinite(arr[1])  # the bad step's loss IS nan...
+    assert step.step_count == 2     # ...but it did not update anything
+    assert step.skipped_steps == 1
+    assert step.loss_scale == 4.0
+
+    # raise mode over a scan reports the offending offsets
+    s2 = make_train_step(_build(7), LOSS(), optimizer="sgd",
+                         learning_rate=0.1, nonfinite="raise")
+    with pytest.raises(FloatingPointError, match="offsets \\[1\\]"):
+        s2.run_steps([x, bad_x], [y, y])
+
+
+def test_nonfinite_validation():
+    net = _build()
+    with pytest.raises(ValueError, match="skip"):
+        make_train_step(net, LOSS(), nonfinite="sometimes")
+    with pytest.raises(ValueError, match="dynamic"):
+        make_train_step(net, LOSS(), loss_scale="dynamic", nonfinite="off")
+    with pytest.raises(ValueError, match="positive"):
+        make_train_step(net, LOSS(), loss_scale=-2.0)
+    with pytest.raises(ValueError, match="scale_window"):
+        DynamicLossScale(scale_window=0)
+    # dynamic scaling implies skip by default
+    step = make_train_step(net, LOSS(), loss_scale="dynamic")
+    assert step.nonfinite == "skip"
+
+
+def test_tree_all_finite_respects_leaf_dtype():
+    """The fused reduction runs isfinite in each leaf's own dtype: a
+    finite f64 value beyond f32 range is NOT misread as inf, int leaves
+    are trivially finite, and real infs/NaNs in any float dtype trip."""
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.ops.optimizer_ops import tree_all_finite
+
+    assert bool(tree_all_finite([jnp.array([1e40], jnp.float64)]))
+    assert bool(tree_all_finite([jnp.arange(3), jnp.ones(2, jnp.float16)]))
+    assert not bool(tree_all_finite([jnp.ones(2),
+                                     jnp.array([np.inf], jnp.float64)]))
+    assert not bool(tree_all_finite([jnp.array([np.nan], jnp.bfloat16)]))
+    assert bool(tree_all_finite([]))
+
+
+def test_has_overflow_single_fused_sync():
+    """Satellite: LossScaler.has_overflow is ONE multi_all_finite invoke
+    (one device→host sync), not one asnumpy round-trip per param."""
+    from incubator_mxnet_tpu.contrib.amp import LossScaler
+    from incubator_mxnet_tpu.ops import registry
+
+    net = _build(layers=3)
+    params = list(net.collect_params().values())
+    for p in params:
+        p._grad._data = np.zeros(p.shape, np.float32) + 0.5
+        p._grad._data = jax.numpy.asarray(p._grad._data)
+
+    calls = []
+    real = registry.invoke
+
+    def counting(name, inputs, out=None, **attrs):
+        calls.append(name)
+        return real(name, inputs, out=out, **attrs)
+
+    registry.invoke = counting
+    try:
+        scaler = LossScaler()
+        assert scaler.has_overflow(params) is False
+        assert calls.count("multi_all_finite") == 1
+        assert "all_finite" not in calls
+        n_clean = len(calls)
+        # one poisoned grad anywhere → overflow, still one invoke
+        params[2]._grad._data = params[2]._grad._data.at[0].set(np.inf)
+        calls.clear()
+        assert scaler.has_overflow(params) is True
+        assert len(calls) == n_clean and \
+            calls.count("multi_all_finite") == 1
+    finally:
+        registry.invoke = real
