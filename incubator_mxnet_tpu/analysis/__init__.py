@@ -15,10 +15,14 @@ semantics):
   step via ``make_train_step(..., lint="error"|"warn"|"off")`` /
   ``MXTPU_LINT``.
 - **Level 2 (source)**: :mod:`.source_lint` + the ``tools/graftlint.py``
-  CLI check repo idiom (GL101–GL103) and gate tier-1 CI.
+  CLI check repo idiom (GL101–GL103) plus the checkpoint-without-
+  iterator-state pattern (GL008, a warning: a loop consuming a stateful
+  data iterator that checkpoints without ``data_iter=`` replays data on
+  resume) and gate tier-1 CI.
 """
 from .diagnostics import CODES, Diagnostic, LintError, LintReport, Severity
-from .source_lint import lint_paths, lint_source
+from .source_lint import (check_checkpoint_without_iter_state, lint_paths,
+                          lint_source)
 from .trace_lint import (check_legacy_checkpoint_path,
                          check_partition_spec, check_permutation,
                          check_zero_state_shardings, lint_jaxpr,
@@ -27,8 +31,9 @@ from .trace_lint import (check_legacy_checkpoint_path,
 
 __all__ = [
     "CODES", "Diagnostic", "LintError", "LintReport", "Severity",
-    "check_legacy_checkpoint_path", "check_partition_spec",
-    "check_permutation", "check_zero_state_shardings", "lint_jaxpr",
+    "check_checkpoint_without_iter_state", "check_legacy_checkpoint_path",
+    "check_partition_spec", "check_permutation",
+    "check_zero_state_shardings", "lint_jaxpr",
     "lint_paths", "lint_source", "lint_traceable", "recompile_probe",
     "validate_permutation",
 ]

@@ -53,6 +53,10 @@ CODES = {
               "load_states) still reachable from a zero=1 fused-step "
               "Trainer — dp-sharded optimizer state cannot round-trip "
               "through it; use parallel.checkpoint"),
+    "GL008": (Severity.WARNING,
+              "save_checkpoint/attach_checkpoint called from a loop "
+              "consuming a stateful data iterator without data_iter= — "
+              "a resumed run replays the epoch from batch 0"),
     "GL101": (Severity.ERROR,
               "shard_map imported from jax directly instead of "
               "parallel/mesh.py (the one version-compat home)"),

@@ -23,7 +23,8 @@ from typing import Dict, List, Optional, Tuple
 
 from .diagnostics import Diagnostic, LintReport, Severity
 
-__all__ = ["lint_source", "lint_paths", "iter_py_files"]
+__all__ = ["check_checkpoint_without_iter_state", "lint_source",
+           "lint_paths", "iter_py_files"]
 
 #: call chains (resolved to their imported module path) that read ambient
 #: host state — poison inside a traced/jitted function
@@ -121,6 +122,93 @@ def _suppressed(lines: List[str], lineno: int, code: str) -> bool:
     return True
 
 
+# ---------------------------------------------------------------------------
+# GL008 — checkpoint saved from a data loop without iterator state
+# ---------------------------------------------------------------------------
+
+#: checkpoint entry points whose saves can carry iterator state
+_CKPT_METHODS = ("save_checkpoint", "attach_checkpoint")
+
+
+def _iterates_stateful(node) -> bool:
+    """Heuristic: does a ``for`` loop's iterable look like a STATEFUL
+    iterator (one whose position is lost on crash)?  Literal
+    containers, constants, comprehensions and ``range()`` are position-
+    free (re-iterable from scratch by construction); a bare name,
+    attribute or other call (``train_iter``, ``loader.epoch()``,
+    ``iter(...)``) is treated as stateful.  ``enumerate``/``zip`` are
+    transparent: stateful iff any argument is."""
+    if isinstance(node, (ast.List, ast.Tuple, ast.Set, ast.Dict,
+                         ast.ListComp, ast.SetComp, ast.DictComp,
+                         ast.Constant)):
+        return False
+    if isinstance(node, ast.Call):
+        chain = _attr_chain(node.func)
+        name = chain[-1] if chain else None
+        if name == "range":
+            return False
+        if name in ("enumerate", "zip"):
+            return any(_iterates_stateful(a) for a in node.args)
+    return True
+
+
+def check_checkpoint_without_iter_state(tree_or_source,
+                                        path: str = "<string>"
+                                        ) -> List[Diagnostic]:
+    """GL008 core: ``save_checkpoint``/``attach_checkpoint`` called
+    inside a ``for`` loop that consumes a stateful data iterator,
+    without passing ``data_iter=``.
+
+    The training state round-trips bit-exactly, but the DATA stream's
+    position dies with the process: the resumed run replays the epoch
+    from batch 0 — double-training early batches and starving late
+    ones — which is silent (losses look plausible).  Passing
+    ``data_iter=`` rides the iterator-state protocol
+    (``io/io.py::DataIter.state_dict``) into the checkpoint manifest so
+    resume continues at the exact next batch (docs/RESILIENCE.md).
+    """
+    if isinstance(tree_or_source, str):
+        try:
+            tree = ast.parse(tree_or_source, filename=path)
+        except SyntaxError:
+            return []
+    else:
+        tree = tree_or_source
+    diags: List[Diagnostic] = []
+    flagged = set()  # call nodes already reported: nested stateful
+    # loops both reach the same call via ast.walk — one diagnostic
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.AsyncFor)):
+            continue
+        if not _iterates_stateful(loop.iter):
+            continue
+        for body_node in loop.body + loop.orelse:
+            for call in ast.walk(body_node):
+                if not isinstance(call, ast.Call) \
+                        or not isinstance(call.func, ast.Attribute) \
+                        or call.func.attr not in _CKPT_METHODS:
+                    continue
+                if any(kw.arg == "data_iter" for kw in call.keywords):
+                    continue
+                if id(call) in flagged:
+                    continue
+                flagged.add(id(call))
+                diags.append(Diagnostic(
+                    "GL008", Severity.WARNING,
+                    "%s() inside a loop consuming a stateful data "
+                    "iterator, without data_iter= — the checkpoint "
+                    "carries no iterator state, so a resumed run "
+                    "replays the epoch from batch 0 (double-training "
+                    "early batches, starving late ones)"
+                    % call.func.attr,
+                    where="%s:%d" % (path, call.lineno),
+                    hint="pass data_iter=<the iterator> so its "
+                         "state_dict() rides the checkpoint manifest "
+                         "and restore_checkpoint resumes mid-epoch "
+                         "(io.ResilientIter / docs/RESILIENCE.md)"))
+    return diags
+
+
 def lint_source(text: str, path: str = "<string>") -> List[Diagnostic]:
     """Lint one module's source text.  Returns raw diagnostics (the
     caller wraps them in a LintReport)."""
@@ -195,6 +283,11 @@ def lint_source(text: str, path: str = "<string>") -> List[Diagnostic]:
                      hint="thread PRNG keys through "
                           "tracing.TraceContext.next_key and timestamps "
                           "through arguments")
+
+    # GL008 — checkpoint saved from a data loop without iterator state
+    for d in check_checkpoint_without_iter_state(tree, path):
+        lineno = int(d.where.rsplit(":", 1)[1])
+        emit(d.code, d.severity, d.message, lineno, d.hint)
 
     # GL103 — PartitionSpec hygiene
     ctors = _spec_ctor_names(imports)

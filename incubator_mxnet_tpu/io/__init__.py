@@ -4,7 +4,11 @@ from .io import (DataBatch, DataDesc, DataIter, MXDataIter, NDArrayIter,
 from .record_iter import (ImageDetRecordIter, ImageRecordIter,
                           ImageRecordUInt8Iter,
                           LibSVMIter, MNISTIter)
+from .resilient import (DataTimeoutError, ResilientIter,
+                        SkipBudgetExceeded, WorkerDiedError)
 
 __all__ = ["DataBatch", "DataDesc", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "MXDataIter", "ImageRecordIter", "ImageRecordUInt8Iter", "ImageDetRecordIter",
-           "MNISTIter", "LibSVMIter"]
+           "PrefetchingIter", "CSVIter", "MXDataIter", "ImageRecordIter",
+           "ImageRecordUInt8Iter", "ImageDetRecordIter",
+           "MNISTIter", "LibSVMIter", "ResilientIter", "DataTimeoutError",
+           "SkipBudgetExceeded", "WorkerDiedError"]

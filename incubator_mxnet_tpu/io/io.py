@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import queue
 import threading
+import warnings
+import weakref
 from collections import namedtuple
 from typing import Any, Dict, List, Optional
 
@@ -21,6 +23,37 @@ from ..ndarray import ndarray as _nd
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "MXDataIter"]
+
+
+# ---------------------------------------------------------------------------
+# iterator-state protocol helpers (docs/RESILIENCE.md "Input pipeline")
+# ---------------------------------------------------------------------------
+
+def _rng_state_to_json(state):
+    """np.random.RandomState get_state() tuple -> JSON-safe list (the
+    state rides the checkpoint manifest, which is JSON)."""
+    if state is None:
+        return None
+    algo, keys, pos, has_gauss, cached = state
+    return [str(algo), np.asarray(keys).tolist(), int(pos), int(has_gauss),
+            float(cached)]
+
+
+def _rng_state_from_json(obj):
+    if obj is None:
+        return None
+    algo, keys, pos, has_gauss, cached = obj
+    return (str(algo), np.asarray(keys, np.uint32), int(pos),
+            int(has_gauss), float(cached))
+
+
+def _check_state_kind(state, kind):
+    got = (state or {}).get("iter")
+    if got != kind:
+        raise ValueError(
+            "iterator state was saved by %r, cannot load into %s — resume "
+            "with the same input pipeline the checkpoint was written with"
+            % (got, kind))
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -85,6 +118,24 @@ class DataIter:
     def getpad(self):
         raise NotImplementedError
 
+    # -- iterator-state protocol (mid-epoch checkpoint/resume) ---------
+    def state_dict(self) -> Dict[str, Any]:
+        """Position/RNG state of this iterator as a JSON-safe dict, so a
+        checkpoint can resume the data stream mid-epoch at the exact
+        next batch (``TrainStep.save_checkpoint(..., data_iter=)``,
+        docs/RESILIENCE.md)."""
+        raise NotImplementedError(
+            "%s does not implement the iterator-state protocol "
+            "(state_dict/load_state_dict); a resumed run would replay "
+            "the epoch from batch 0" % type(self).__name__)
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        """Restore the position saved by :meth:`state_dict`; the next
+        ``next()`` yields the batch after the one last consumed."""
+        raise NotImplementedError(
+            "%s does not implement the iterator-state protocol "
+            "(state_dict/load_state_dict)" % type(self).__name__)
+
 
 class NDArrayIter(DataIter):
     """Iterator over in-memory arrays (io.py:491).
@@ -105,6 +156,13 @@ class NDArrayIter(DataIter):
         self.idx = np.arange(self.num_data)
         self.cursor = -batch_size
         self._cache_idx = None
+        # instance RNG (not the global np.random stream): its state is
+        # part of the iterator-state protocol, so a resumed run shuffles
+        # the SAME epoch orders an uninterrupted run would have
+        self._shuffle_rng = np.random.RandomState(
+            np.random.randint(0, 2 ** 31)) if shuffle else None
+        self._epoch = -1
+        self._epoch_rng_state = None  # RNG state at the epoch's start
         self.reset()
 
     @staticmethod
@@ -139,8 +197,16 @@ class NDArrayIter(DataIter):
                 for k, v in self.label]
 
     def reset(self):
+        self._epoch += 1
         if self.shuffle:
-            np.random.shuffle(self.idx)
+            # fresh permutation from the epoch-start RNG state (the
+            # scheme ImageRecordIter uses): state_dict then carries
+            # only the O(1) RNG state and re-derives this epoch's order
+            # on resume, instead of embedding the O(num_data)
+            # permutation in every checkpoint manifest
+            self._epoch_rng_state = self._shuffle_rng.get_state()
+            self.idx = np.arange(self.num_data)
+            self._shuffle_rng.shuffle(self.idx)
         if self.last_batch_handle == "roll_over" and \
                 0 < self.cursor < self.num_data:
             self.cursor = -self.batch_size + (self.cursor % self.num_data) % \
@@ -182,6 +248,101 @@ class NDArrayIter(DataIter):
     def getindex(self):
         end = min(self.cursor + self.batch_size, self.num_data)
         return self.idx[self.cursor:end]
+
+    # -- iterator-state protocol ---------------------------------------
+    def state_dict(self):
+        """Epoch, cursor and the epoch-START shuffle-RNG state —
+        everything resume needs to re-derive this epoch's permutation
+        (O(1) in the manifest, not the O(num_data) index list) and
+        shuffle all later epochs identically."""
+        st = {"iter": "NDArrayIter", "epoch": self._epoch,
+              "shuffle": bool(self.shuffle),
+              "cursor": int(self.cursor),
+              "num_data": int(self.num_data),
+              "batch_size": int(self.batch_size),
+              "last_batch_handle": self.last_batch_handle}
+        if self.shuffle and self._epoch_rng_state is None:
+            # mid-epoch after a legacy idx-format restore: the
+            # epoch-start RNG state that would re-derive self.idx is
+            # unrecoverable, so re-emit the accurate legacy format
+            # (explicit permutation + CURRENT RNG state) — emitting the
+            # stale construction-time rng0 would resume a permutation
+            # this run never consumed.  The next reset() recaptures
+            # rng0 and the O(1) format takes back over.
+            st["idx"] = self.idx.tolist()
+            st["rng"] = _rng_state_to_json(self._shuffle_rng.get_state())
+        else:
+            st["rng0"] = _rng_state_to_json(self._epoch_rng_state)
+        return st
+
+    def load_state_dict(self, state):
+        _check_state_kind(state, "NDArrayIter")
+        # a shuffle-config mismatch silently breaks the bit-identical
+        # resume guarantee (the restored run shuffles orders the
+        # original never had, or stops shuffling) — refuse it; older
+        # states lack the flag, but an RNG state is present exactly
+        # when shuffle was on
+        saved_shuffle = bool(state.get(
+            "shuffle", state.get("rng") is not None
+            or state.get("rng0") is not None))
+        if saved_shuffle != bool(self.shuffle):
+            raise ValueError(
+                "iterator state was saved with shuffle=%s but this "
+                "NDArrayIter has shuffle=%s — resume needs the same "
+                "shuffle configuration for a bit-identical batch order"
+                % (saved_shuffle, self.shuffle))
+        # a cursor is only meaningful under the batching it was saved
+        # with: a different batch_size (or pad/roll_over mode) passes
+        # the cursor check but produces batch boundaries the original
+        # run never had (absent in older states — tolerated)
+        for key, have in (("batch_size", int(self.batch_size)),
+                          ("last_batch_handle", self.last_batch_handle)):
+            saved = state.get(key)
+            if saved is not None and saved != have:
+                raise ValueError(
+                    "iterator state was saved with %s=%r but this "
+                    "NDArrayIter has %s=%r — resume needs the same "
+                    "batching configuration for a bit-identical batch "
+                    "order" % (key, saved, key, have))
+        self._epoch = int(state["epoch"])
+        self.cursor = int(state["cursor"])
+        self._cache_idx = None
+        if "idx" in state:
+            # legacy O(num_data) format: explicit permutation plus the
+            # CURRENT (post-shuffle) RNG state
+            idx = np.asarray(state["idx"], dtype=self.idx.dtype)
+            if idx.shape != self.idx.shape:
+                raise ValueError(
+                    "iterator state has %d indices, this NDArrayIter "
+                    "holds %d samples — resume needs the same dataset"
+                    % (idx.size, self.num_data))
+            self.idx = idx
+            rng = _rng_state_from_json(state.get("rng"))
+            if rng is not None:
+                if self._shuffle_rng is None:
+                    self._shuffle_rng = np.random.RandomState(0)
+                self._shuffle_rng.set_state(rng)
+            # the epoch-start state for THIS permutation is unknown —
+            # None makes state_dict() fall back to the legacy format
+            # instead of emitting the stale construction-time snapshot
+            self._epoch_rng_state = None
+            return
+        if state.get("num_data") is not None \
+                and int(state["num_data"]) != self.num_data:
+            raise ValueError(
+                "iterator state was saved over %d samples, this "
+                "NDArrayIter holds %d — resume needs the same dataset"
+                % (int(state["num_data"]), self.num_data))
+        if self.shuffle:
+            # re-derive the epoch's permutation from its start state;
+            # the shuffle also advances the RNG to exactly the
+            # mid-epoch state the original run had
+            self._epoch_rng_state = _rng_state_from_json(state["rng0"])
+            self._shuffle_rng.set_state(self._epoch_rng_state)
+            self.idx = np.arange(self.num_data)
+            self._shuffle_rng.shuffle(self.idx)
+        else:
+            self.idx = np.arange(self.num_data)
 
 
 class ResizeIter(DataIter):
@@ -236,9 +397,133 @@ class ResizeIter(DataIter):
     def getindex(self):
         return self.current_batch.index
 
+    # -- iterator-state protocol ---------------------------------------
+    def state_dict(self):
+        return {"iter": "ResizeIter", "cur": int(self.cur),
+                "inner": self.data_iter.state_dict()}
 
-class PrefetchingIter(DataIter):
-    """Background-thread prefetcher (io.py:347; C++ iter_prefetcher.h)."""
+    def load_state_dict(self, state):
+        _check_state_kind(state, "ResizeIter")
+        self.cur = int(state["cur"])
+        self.current_batch = None
+        self.data_iter.load_state_dict(state["inner"])
+
+
+def _stop_aware_put(q, stop, msg, owner_ref=None) -> bool:
+    """Bounded queue put that observes the epoch's stop event — and,
+    when given, the owner's liveness — instead of blocking forever: a
+    producer stuck on a full queue must notice close()/reset(), and one
+    whose owner was dropped without close() (``owner_ref`` is a dead
+    weakref) must exit rather than spin against a consumer that no
+    longer exists.  Shared by PrefetchingIter and ResilientIter — ONE
+    copy of the subtlest loop in the module."""
+    while not stop.is_set():
+        if owner_ref is not None and owner_ref() is None:
+            return False
+        try:
+            q.put(msg, timeout=0.05)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def _drain_queue(q):
+    if q is None:
+        return
+    try:
+        while True:
+            q.get_nowait()
+    except queue.Empty:
+        pass
+
+
+def _drain_join_drain(q, stop, thread, join_timeout=5):
+    """The worker-shutdown dance shared by ``PrefetchingIter.close`` and
+    ``ResilientIter._shutdown_worker`` — ONE copy of the sequence, like
+    :func:`_stop_aware_put` is one copy of the put loop: signal stop,
+    drain the queue (a producer blocked in its bounded put wakes and
+    sees the stop flag), join, then drain AGAIN (the producer may have
+    completed one last put between the first drain and its exit — a
+    stale batch would leak into the next epoch).
+
+    Returns True when the worker exited within ``join_timeout``.  False
+    means the thread is STALE: still blocked inside the wrapped
+    iterator's read.  The epoch-local queue/stop guards keep the
+    WRAPPER's accounting clean, but nothing can cancel the hung call —
+    if the caller drives the same inner iterator again (``reset()`` /
+    ``load_state_dict()``) before that call returns, the two advance
+    its cursor concurrently and the batch order is no longer
+    deterministic, so a warning says the next epoch cannot be trusted
+    for bit-identical resume."""
+    if stop is not None:
+        stop.set()
+    _drain_queue(q)
+    joined = True
+    if thread is not None:
+        thread.join(timeout=join_timeout)
+        if thread.is_alive():
+            joined = False
+            warnings.warn(
+                "prefetch worker %r did not exit within %gs — it is "
+                "still blocked inside the wrapped iterator's read.  "
+                "Reusing that iterator (reset()/load_state_dict()) "
+                "before the hung read returns may advance its cursor "
+                "concurrently; the epoch order is then not "
+                "deterministic and mid-epoch resume cannot be trusted"
+                % (thread.name, join_timeout), RuntimeWarning,
+                stacklevel=3)
+    _drain_queue(q)
+    return joined
+
+
+class _CurrentBatchConsumer:
+    """Reference DataIter consumer protocol driven by one
+    ``current_batch`` slot that the subclass's ``_fetch_next()`` fills —
+    ONE copy of the six protocol methods shared by ``PrefetchingIter``
+    and ``ResilientIter`` (like :func:`_stop_aware_put` and
+    :func:`_drain_join_drain` above), so a fix to one wrapper's
+    accessor semantics cannot silently miss the other."""
+
+    current_batch = None
+
+    def next(self):  # noqa: A003
+        if not self.iter_next():
+            raise StopIteration
+        return self.current_batch
+
+    def iter_next(self):
+        """Reference DataIter protocol: advance to the next batch (the
+        accessors below then read it), False at epoch end."""
+        try:
+            self.current_batch = self._fetch_next()
+            return True
+        except StopIteration:
+            self.current_batch = None
+            return False
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getpad(self):
+        return getattr(self.current_batch, "pad", 0) or 0
+
+    def getindex(self):
+        return getattr(self.current_batch, "index", None)
+
+
+class PrefetchingIter(_CurrentBatchConsumer, DataIter):
+    """Background-thread prefetcher (io.py:347; C++ iter_prefetcher.h).
+
+    Reliability contract (docs/RESILIENCE.md "Input pipeline"): the
+    producer thread is JOINED on exhaustion, :meth:`close` and
+    ``__del__`` — it never leaks — and an exception raised by the inner
+    iterator is forwarded through the queue and re-raised in the
+    consumer (``next()``) instead of killing the producer silently and
+    hanging the training loop on an empty queue forever."""
 
     def __init__(self, iters, rename_data=None, rename_label=None,
                  prefetch_depth=2):
@@ -248,9 +533,11 @@ class PrefetchingIter(DataIter):
             raise NotImplementedError("multi-iter prefetch: combine upstream")
         self.iter = iters[0]
         super().__init__(self.iter.batch_size)
-        self._queue: "queue.Queue" = queue.Queue(maxsize=prefetch_depth)
-        self._stop = threading.Event()
+        self._prefetch_depth = prefetch_depth
+        self._queue: Optional["queue.Queue"] = None
+        self._stop: Optional[threading.Event] = None
         self._thread = None
+        self.current_batch = None
         self._start()
 
     @property
@@ -261,44 +548,79 @@ class PrefetchingIter(DataIter):
     def provide_label(self):
         return self.iter.provide_label
 
-    def _start(self):
-        def worker():
-            while not self._stop.is_set():
-                try:
-                    batch = self.iter.next()
-                except StopIteration:
-                    self._queue.put(None)
-                    return
-                self._queue.put(batch)
+    _put = staticmethod(_stop_aware_put)  # kept as a named hook
 
+    def _start(self):
+        # queue and stop event are EPOCH-LOCAL (captured by the worker,
+        # not read off self): a producer stuck in a slow inner read past
+        # close()'s join timeout holds only the abandoned epoch's queue
+        # and its already-set stop flag, so it can never deliver a stale
+        # batch or end-of-stream sentinel into the next epoch (the same
+        # lifetime discipline as record_iter._Prefetcher / ResilientIter)
+        q = queue.Queue(maxsize=self._prefetch_depth)
+        stop = threading.Event()
+        inner = self.iter
+        wref = weakref.ref(self)
+
+        def worker():
+            # deliberately NO strong reference to the wrapper (only the
+            # inner iterator): an abandoned PrefetchingIter stays
+            # collectable, its __del__ -> close() sets `stop`, and this
+            # thread exits instead of leaking for process lifetime
+            exc = None
+            while not stop.is_set():
+                try:
+                    batch = inner.next()
+                except StopIteration:
+                    break
+                except Exception as e:  # surface in the consumer thread
+                    exc = e
+                    break
+                if not _stop_aware_put(q, stop, batch, wref):
+                    return
+            if exc is not None:
+                _stop_aware_put(q, stop, exc, wref)
+            _stop_aware_put(q, stop, None, wref)  # end-of-stream sentinel
+
+        self._queue = q
+        self._stop = stop
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
 
-    def reset(self):
-        self._stop.set()
-        try:
-            while True:
-                self._queue.get_nowait()
-        except queue.Empty:
-            pass
+    def _join(self):
         if self._thread is not None:
             self._thread.join(timeout=5)
-        self._stop.clear()
+            self._thread = None
+
+    def close(self):
+        """Stop and join the producer thread (idempotent).  Thread count
+        after close() equals the count before construction."""
+        _drain_join_drain(self._queue, self._stop, self._thread)
+        self._thread = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def reset(self):
+        self.close()
+        self.current_batch = None
         self.iter.reset()
         self._start()
 
-    def next(self):  # noqa: A003
+    def _fetch_next(self):
+        if self._thread is None and self._queue.empty():
+            raise StopIteration  # exhausted/closed; producer already joined
         batch = self._queue.get()
         if batch is None:
+            self._join()  # epoch over: reap the producer now
             raise StopIteration
+        if isinstance(batch, Exception):
+            self._join()  # producer is done after forwarding its error
+            raise batch
         return batch
-
-    def iter_next(self):
-        try:
-            self._peek = self.next()
-            return True
-        except StopIteration:
-            return False
 
 
 class CSVIter(DataIter):
@@ -333,6 +655,13 @@ class CSVIter(DataIter):
 
     def iter_next(self):
         return self._inner.iter_next()
+
+    def state_dict(self):
+        return {"iter": "CSVIter", "inner": self._inner.state_dict()}
+
+    def load_state_dict(self, state):
+        _check_state_kind(state, "CSVIter")
+        self._inner.load_state_dict(state["inner"])
 
 
 class MXDataIter(DataIter):

@@ -32,7 +32,9 @@ from ..base import MXNetError
 from ..ndarray import NDArray
 from ..ndarray import ndarray as _nd
 from ..recordio import MXIndexedRecordIO, MXRecordIO, unpack_img
-from .io import DataBatch, DataDesc, DataIter
+from .io import (DataBatch, DataDesc, DataIter, NDArrayIter,
+                 _check_state_kind, _rng_state_from_json,
+                 _rng_state_to_json)
 
 __all__ = ["ImageRecordIter", "ImageRecordUInt8Iter",
            "ImageDetRecordIter", "MNISTIter", "LibSVMIter"]
@@ -117,6 +119,7 @@ class ImageRecordIter(DataIter):
             raise MXNetError("data_shape must be (C, H, W)")
         self._path_rec = path_imgrec
         self._path_idx = path_imgidx
+        self._part_index, self._num_parts = int(part_index), int(num_parts)
         self.data_shape = tuple(int(s) for s in data_shape)
         self.shuffle = shuffle
         self.rand_crop = rand_crop
@@ -164,6 +167,8 @@ class ImageRecordIter(DataIter):
         self._lock = threading.Lock()  # indexed reads seek a shared handle
         self._prefetcher = _Prefetcher(self._epoch, prefetch_buffer)
         self._current = None
+        self._epoch_num = -1
+        self._resume_consumed = 0
         self.reset()
 
     # -- decode + augment (the DefaultImageAugmenter subset used by the
@@ -224,13 +229,19 @@ class ImageRecordIter(DataIter):
         return label[: self.label_width]
 
     def _epoch(self):
+        # mid-epoch resume: batches before the resume point are
+        # FAST-FORWARDED — every producer-RNG draw still happens (so the
+        # shuffle order and per-batch aug seeds match the uninterrupted
+        # run bit for bit) but no record is read or decoded
+        skip = self._resume_skip
+        self._resume_skip = 0
         order = list(self._keys)
         if self.shuffle:
             self._rng.shuffle(order)
         n = len(order)
         bs = self.batch_size
         c, h, w = self.data_shape
-        for start in range(0, n, bs):
+        for bidx, start in enumerate(range(0, n, bs)):
             chunk = order[start:start + bs]
             pad = 0
             if len(chunk) < bs:
@@ -239,6 +250,12 @@ class ImageRecordIter(DataIter):
                 pad = bs - len(chunk)
                 while len(chunk) < bs:  # wrap repeatedly: shard may be tiny
                     chunk = chunk + order[: bs - len(chunk)]
+            aug_seed = int(self._rng.randint(0, 2**31))  # producer thread only
+            if bidx < skip:
+                # resume fast-forward: the RNG draws above still ran
+                # (bit-identical shuffle + aug seeds); no buffer is
+                # allocated and no record read or decoded
+                continue
             # staging dtype preserves payload values: uint8 only on the
             # raw-bytes path (JPEG/PNG always decode to uint8); float/other
             # payloads stage at the iterator dtype so nothing wraps mod 256
@@ -246,13 +263,30 @@ class ImageRecordIter(DataIter):
             stage = np.empty((bs, h, w, c),
                              np.uint8 if raw_bytes else self.dtype)
             label = np.empty((bs, self.label_width), np.float32)
-            aug_seed = int(self._rng.randint(0, 2**31))  # producer thread only
             futs = [self._pool.submit(self._decode_one, k, i, aug_seed)
                     for i, k in enumerate(chunk)]
-            for f in futs:
-                i, d, l = f.result()
+            err = None
+            for k0, f in zip(chunk, futs):
+                try:
+                    i, d, l = f.result()
+                except Exception as e:  # undecodable record
+                    if err is None:
+                        err = e
+                        err._mxtpu_batch_error = True  # read by iter_next
+                        err.path = self._path_rec
+                        if self._rec is not None:
+                            err.offset = self._rec.idx.get(k0)
+                    continue  # drain the rest of the pool's futures
                 stage[i] = d
                 label[i] = l
+            if err is not None:
+                # yield (don't raise): a raised exception kills this
+                # generator and with it the REST of the epoch — yielding
+                # keeps the stream alive so the consumer's bad-record
+                # policy (ResilientIter on_bad_record="skip") can skip
+                # THIS batch and continue with the next one
+                yield err
+                continue
             if raw_bytes:
                 # ImageRecordUInt8Iter contract: raw NCHW bytes; the
                 # consumer normalizes in its own device program
@@ -280,6 +314,22 @@ class ImageRecordIter(DataIter):
         return [DataDesc(self.label_name, shape, np.float32)]
 
     def reset(self):
+        # the OLD epoch's producer shares self._rng and draws from it
+        # until joined — stop it BEFORE touching RNG state, or a
+        # straggler advances the generator after the snapshot and the
+        # checkpointed epoch-start state silently diverges from the
+        # order the epoch actually plays
+        self._prefetcher.stop()
+        # epoch-START producer-RNG state: the checkpointable shuffle
+        # state.  The live self._rng races ahead of consumption (the
+        # producer thread prefetches), so resume restores THIS state and
+        # fast-forwards the consumed batches deterministically.
+        skip = self._resume_consumed
+        self._resume_consumed = 0
+        self._epoch_rng_state = self._rng.get_state()
+        self._epoch_num += 1
+        self._consumed = skip
+        self._resume_skip = skip  # read once by _epoch in the producer
         self._prefetcher.start()
         self._current = None
 
@@ -292,10 +342,21 @@ class ImageRecordIter(DataIter):
     def iter_next(self):
         """Advance and stage the next batch for getdata/getlabel/getpad
         (the reference DataIter protocol, io.py:180)."""
-        item = self._prefetcher.next()
+        try:
+            item = self._prefetcher.next()
+        except Exception as e:
+            if getattr(e, "_mxtpu_batch_error", False):
+                # a per-batch decode error: the epoch generator is still
+                # alive and the batch SLOT is consumed (resume must not
+                # re-play it) — count it, then surface for the caller's
+                # bad-record policy
+                self._consumed += 1
+                self._current = None
+            raise
         if item is None:
             self._current = None
             return False
+        self._consumed += 1
         data, label, pad = item
         if self.label_width == 1:
             label = label[:, 0]
@@ -316,6 +377,58 @@ class ImageRecordIter(DataIter):
 
     def getindex(self):
         return None
+
+    # -- iterator-state protocol (io/io.py DataIter) -------------------
+    def state_dict(self):
+        """Consumer-side position: epoch, batch slots the consumer moved
+        past — delivered batches AND per-batch decode errors it saw; the
+        producer thread's read-ahead is deliberately not counted (those
+        batches are re-produced on resume) — and the epoch-start RNG
+        state that deterministically regenerates this epoch's shuffle
+        order and augmentation seeds."""
+
+        return {"iter": type(self).__name__, "epoch": self._epoch_num,
+                "batch": int(self._consumed),
+                "shuffle": bool(self.shuffle),
+                "batch_size": int(self.batch_size),
+                "num_records": len(self._keys),
+                "part_index": self._part_index,
+                "num_parts": self._num_parts,
+                "rng": _rng_state_to_json(self._epoch_rng_state)}
+
+    def load_state_dict(self, state):
+
+        # subclass-keyed (type(self).__name__): ImageRecordUInt8Iter and
+        # ImageDetRecordIter emit differently shaped batches from the
+        # same record file, so their checkpoints must not cross-restore
+        _check_state_kind(state, type(self).__name__)
+        # reject configuration drift BEFORE touching any state: a
+        # different record set, shard, shuffle flag or batch size would
+        # fast-forward the wrong stream and resume on silently
+        # divergent data with plausible losses (the check NDArrayIter's
+        # load_state_dict makes for shuffle/dataset mismatch)
+        for key, have in (("shuffle", bool(self.shuffle)),
+                          ("batch_size", int(self.batch_size)),
+                          ("num_records", len(self._keys)),
+                          # equal-sized dp shards pass every count check,
+                          # so shard identity must be its own gate: rank
+                          # 3's checkpoint restored into rank 0 would
+                          # resume rank 3's shuffle/aug stream silently
+                          ("part_index", self._part_index),
+                          ("num_parts", self._num_parts)):
+            saved = state.get(key)
+            if saved is not None and saved != have:
+                raise ValueError(
+                    "iterator state was saved with %s=%r but this "
+                    "%s has %s=%r — resume needs the same "
+                    "dataset, shard and configuration for a "
+                    "bit-identical batch order"
+                    % (key, saved, type(self).__name__, key, have))
+        self._prefetcher.stop()  # no straggler draws after set_state
+        self._rng.set_state(_rng_state_from_json(state["rng"]))
+        self._epoch_num = int(state["epoch"]) - 1  # reset() bumps it back
+        self._resume_consumed = int(state["batch"])
+        self.reset()
 
     def close(self):
         self._prefetcher.stop()
@@ -384,7 +497,6 @@ class MNISTIter(DataIter):
             img = img.reshape(len(img), -1)
         else:
             img = img.reshape(len(img), 1, img.shape[1], img.shape[2])
-        from .io import NDArrayIter
 
         self._inner = NDArrayIter(
             {data_name: img}, {label_name: lab}, batch_size=batch_size,
@@ -406,6 +518,14 @@ class MNISTIter(DataIter):
 
     def iter_next(self):
         return self._inner.iter_next()
+
+    def state_dict(self):
+        return {"iter": "MNISTIter", "inner": self._inner.state_dict()}
+
+    def load_state_dict(self, state):
+
+        _check_state_kind(state, "MNISTIter")
+        self._inner.load_state_dict(state["inner"])
 
 
 class LibSVMIter(DataIter):
@@ -461,6 +581,14 @@ class LibSVMIter(DataIter):
 
     def reset(self):
         self._cursor = -self.batch_size
+
+    def state_dict(self):
+        return {"iter": "LibSVMIter", "cursor": int(self._cursor)}
+
+    def load_state_dict(self, state):
+
+        _check_state_kind(state, "LibSVMIter")
+        self._cursor = int(state["cursor"])
 
     def iter_next(self):
         self._cursor += self.batch_size
@@ -552,18 +680,29 @@ class ImageDetRecordIter(ImageRecordIter):
         instead of going through a reader that materializes payloads."""
         import struct as _struct
 
-        from ..recordio import _kMagic
+        from ..recordio import _corrupt_record_error, _kMagic, \
+            _torn_final_record
 
         path = kwargs.get("path_imgrec", args[0] if args else None)
         width = 1
         with open(path, "rb") as fh:
             while True:
+                offset = fh.tell()
                 head = fh.read(8)
+                if len(head) == 0:
+                    break
                 if len(head) < 8:
+                    # crash-torn final record: width from the intact part
+                    _torn_final_record(path, offset,
+                                       "only %d of 8 header bytes"
+                                       % len(head))
                     break
                 magic, lrec = _struct.unpack("<II", head)
                 if magic != _kMagic:
-                    raise IOError("invalid magic in %s" % path)
+                    raise _corrupt_record_error(
+                        path, offset,
+                        "invalid record magic 0x%08X (expected 0x%08X)"
+                        % (magic, _kMagic))
                 cflag = lrec >> 29
                 length = lrec & ((1 << 29) - 1)
                 pad = (4 - (length & 3)) & 3
@@ -571,7 +710,13 @@ class ImageDetRecordIter(ImageRecordIter):
                 if cflag in (0, 1) and length >= 4:
                     # single record or FIRST part of a multi-part record:
                     # the IR header (flag = label count) leads the payload
-                    flag = _struct.unpack("<I", fh.read(4))[0]
+                    buf = fh.read(4)
+                    if len(buf) < 4:  # torn mid-header, not a struct.error
+                        _torn_final_record(path, offset,
+                                           "payload cut inside the IR "
+                                           "header")
+                        break
+                    flag = _struct.unpack("<I", buf)[0]
                     width = max(width, flag if flag > 0 else 1)
                     skip -= 4
                 fh.seek(skip, 1)  # continuation parts / image bytes

@@ -219,9 +219,15 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     # -- save -----------------------------------------------------------
-    def save(self, step: int, state) -> str:
+    def save(self, step: int, state, meta: Optional[Dict] = None) -> str:
         """Stage + atomically commit ``state`` as checkpoint ``step``.
-        Returns the committed directory path."""
+        Returns the committed directory path.
+
+        ``meta`` — optional JSON-safe dict committed atomically with the
+        arrays (it rides the manifest, which is written last).  Used for
+        non-array sidecar state like the data-iterator position
+        (``TrainStep.save_checkpoint(data_iter=...)``); older manifests
+        without it restore fine (backward-compatible section)."""
         step = int(step)
         flat, _ = jax.tree_util.tree_flatten_with_path(state)
         os.makedirs(self.directory, exist_ok=True)
@@ -236,6 +242,8 @@ class CheckpointManager:
                     tmp, "arr_%05d" % i, jax.tree_util.keystr(path), leaf))
             manifest = {"format_version": _FORMAT_VERSION, "step": step,
                         "arrays": entries}
+            if meta is not None:
+                manifest["meta"] = meta
             # the manifest commits the content of the staging dir: it is
             # written LAST, so a torn stage never looks complete
             buf = json.dumps(manifest, indent=1).encode()
@@ -329,9 +337,13 @@ class CheckpointManager:
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
 
     # -- restore --------------------------------------------------------
-    def restore(self, like, step: Optional[int] = None, shardings=None):
+    def restore(self, like, step: Optional[int] = None, shardings=None,
+                return_meta: bool = False):
         """Load the newest intact checkpoint (or exactly ``step``) into
-        the structure of ``like``; returns ``(step, state)``.
+        the structure of ``like``; returns ``(step, state)`` — or
+        ``(step, state, meta)`` with ``return_meta=True``, where
+        ``meta`` is the manifest's sidecar dict (``None`` for
+        checkpoints written without one).
 
         ``shardings`` — an optional pytree congruent with ``like`` whose
         leaves are placements (``NamedSharding``/device) — puts every
@@ -339,8 +351,12 @@ class CheckpointManager:
         candidates are skipped with a warning (last-good fallback)
         unless ``step`` pinned one explicitly.
         """
+        def pack(s, loaded):
+            state, meta = loaded
+            return (s, state, meta) if return_meta else (s, state)
+
         if step is not None:
-            return int(step), self._load(int(step), like, shardings)
+            return pack(int(step), self._load(int(step), like, shardings))
         candidates = list(reversed(self.steps()))
         if not candidates:
             raise CheckpointError(
@@ -348,7 +364,7 @@ class CheckpointManager:
         last_err: Optional[Exception] = None
         for s in candidates:
             try:
-                return s, self._load(s, like, shardings)
+                return pack(s, self._load(s, like, shardings))
             except CheckpointCorruptError as e:
                 warnings.warn(
                     "checkpoint %s is corrupt (%s); falling back to the "
@@ -404,7 +420,8 @@ class CheckpointManager:
                 # fallback in restore() must still engage
                 raise CheckpointCorruptError(
                     "undecodable manifest entry %r: %s" % (key, e))
-        return jax.tree_util.tree_unflatten(treedef, leaves)
+        return (jax.tree_util.tree_unflatten(treedef, leaves),
+                manifest.get("meta"))
 
     def _load_leaf(self, d: str, entry: Dict, sharding):
         dtype = np.dtype(entry["dtype"])

@@ -1,6 +1,6 @@
 """Fault-injection harness for the resilience layer.
 
-Deterministic, test-grade fault injectors for the three failure classes
+Deterministic, test-grade fault injectors for the failure classes
 ``docs/RESILIENCE.md`` claims to survive:
 
 - **bad numerics** — :func:`poison_batch` / :class:`NaNInjector` make a
@@ -13,21 +13,30 @@ Deterministic, test-grade fault injectors for the three failure classes
   to prove a failed save never corrupts the last committed checkpoint);
 - **silent corruption** — :func:`corrupt_checkpoint` bit-flips or
   truncates a *committed* array file, the torn-write/bit-rot case the
-  per-file checksums exist to catch.
+  per-file checksums exist to catch;
+- **input-pipeline faults** — :func:`flaky_reads` / :func:`slow_reads` /
+  :func:`kill_worker` interpose the resilient loader's record puller
+  (``io/resilient.py::_pull``) with transient errnos, injected latency
+  and silent worker death, and :func:`truncate_record` tears a record
+  file at a byte offset exactly like a crash mid-write — together they
+  drive ``tests/test_resilient_io.py``.
 
 Everything here is process-local monkeypatching or direct file surgery:
 no real signals, no real device faults — cheap enough for tier-1.
 """
 from __future__ import annotations
 
+import errno as _errno
 import os
+import time
 from contextlib import contextmanager
 from typing import Optional
 
 import numpy as np
 
 __all__ = ["NaNInjector", "corrupt_checkpoint", "fail_writes",
-           "poison_batch"]
+           "flaky_reads", "kill_worker", "poison_batch", "slow_reads",
+           "truncate_record"]
 
 
 def poison_batch(x, value=float("nan"), index=0):
@@ -94,6 +103,112 @@ def fail_writes(at=0, count=1, exc: Optional[BaseException] = None):
         yield stats
     finally:
         _ckpt._write_bytes = real
+
+
+@contextmanager
+def _patched_pull(flaky):
+    """Interpose ``io/resilient.py::_pull`` (the one choke point every
+    resilient read goes through) with ``flaky(real_pull, next_fn)``."""
+    from ..io import resilient as _res
+
+    real = _res._pull
+    _res._pull = lambda next_fn: flaky(real, next_fn)
+    try:
+        yield
+    finally:
+        _res._pull = real
+
+
+@contextmanager
+def flaky_reads(every_k=3, errno=None, count=None):
+    """Make every ``every_k``-th resilient read raise a transient
+    ``OSError`` (default errno EIO) BEFORE touching the underlying
+    iterator — the retry immediately after targets the same record, so
+    retry-with-backoff must absorb the fault with no record lost.
+
+    ``count`` bounds the total number of injected faults (``None`` =
+    unbounded).  Yields a stats object whose ``.failed`` counts
+    injections and ``.seen`` all reads."""
+    eno = _errno.EIO if errno is None else int(errno)
+
+    class _Stats:
+        seen = 0
+        failed = 0
+
+    stats = _Stats()
+
+    def flaky(real, next_fn):
+        i = stats.seen
+        stats.seen += 1
+        if i % every_k == every_k - 1 and \
+                (count is None or stats.failed < count):
+            stats.failed += 1
+            raise OSError(eno, "injected flaky read (#%d)" % i)
+        return real(next_fn)
+
+    with _patched_pull(flaky):
+        yield stats
+
+
+@contextmanager
+def slow_reads(latency_s, at=0, count=None):
+    """Add ``latency_s`` seconds to resilient reads from the ``at``-th
+    onward (``count`` bounds how many; ``None`` = all) — the hung-read
+    case a per-read timeout must surface as an error instead of
+    blocking the training loop forever."""
+    class _Stats:
+        seen = 0
+        slowed = 0
+
+    stats = _Stats()
+
+    def slow(real, next_fn):
+        i = stats.seen
+        stats.seen += 1
+        if i >= at and (count is None or stats.slowed < count):
+            stats.slowed += 1
+            time.sleep(latency_s)
+        return real(next_fn)
+
+    with _patched_pull(slow):
+        yield stats
+
+
+@contextmanager
+def kill_worker(at=0, count=1):
+    """Silently kill the prefetch worker on selected reads: raises
+    ``SystemExit`` (a ``BaseException`` — it escapes the read-policy
+    ``except Exception`` and the thread machinery swallows it) BEFORE
+    the underlying iterator is touched, so no record is lost and the
+    respawned worker continues exactly where the dead one stood."""
+    class _Stats:
+        seen = 0
+        killed = 0
+
+    stats = _Stats()
+
+    def kill(real, next_fn):
+        i = stats.seen
+        stats.seen += 1
+        if at <= i < at + count:
+            stats.killed += 1
+            raise SystemExit("injected worker death (#%d)" % i)
+        return real(next_fn)
+
+    with _patched_pull(kill):
+        yield stats
+
+
+def truncate_record(path, offset):
+    """Tear a record file at byte ``offset`` — exactly what a crash
+    mid-write leaves behind.  Returns the number of bytes cut off."""
+    size = os.path.getsize(path)
+    if not 0 <= offset < size:
+        raise ValueError("offset %d outside file %r (size %d)"
+                         % (offset, path, size))
+    with open(path, "r+b") as f:
+        f.truncate(int(offset))
+    return size - int(offset)
 
 
 def corrupt_checkpoint(directory, step=None, what="bitflip", which=0):

@@ -324,6 +324,7 @@ class TrainStep:
         self._ckpt_every = None
         self._ckpt_prev_count = 0
         self._ckpt_seen_request = 0
+        self._ckpt_data_iter = None
         # graftlint Level 1 runs over the traced step before its first
         # compile (docs/ANALYSIS.md): "error" raises on error-severity
         # findings, "warn" prints them, "off" skips the lint trace.
@@ -1296,9 +1297,18 @@ class TrainStep:
             return directory_or_manager
         return CheckpointManager(directory_or_manager, keep_last=keep_last)
 
-    def save_checkpoint(self, directory_or_manager, keep_last=3):
+    def save_checkpoint(self, directory_or_manager, keep_last=3,
+                        data_iter=None):
         """Atomically checkpoint the full training state (see
-        ``docs/RESILIENCE.md``).  Returns the committed directory."""
+        ``docs/RESILIENCE.md``).  Returns the committed directory.
+
+        ``data_iter`` — an iterator implementing the iterator-state
+        protocol (``state_dict()``; ``io/io.py``): its mid-epoch
+        position rides the manifest, committed atomically with the
+        arrays, so ``restore_checkpoint(..., data_iter=)`` resumes the
+        data stream at the exact next batch instead of silently
+        replaying the epoch from batch 0.  Defaults to the iterator
+        bound by ``attach_checkpoint(data_iter=...)``."""
         self._ensure_built()
         if self._multihost:
             raise NotImplementedError(
@@ -1306,18 +1316,72 @@ class TrainStep:
                 "save from a single-controller run")
         mgr = self._as_manager(directory_or_manager, keep_last)
         state = self._checkpoint_state()
-        return mgr.save(int(jax.device_get(self._step_dev)), state)
+        if data_iter is None:
+            data_iter = self._ckpt_data_iter
+        meta = None
+        if data_iter is not None:
+            meta = {"data_iter": data_iter.state_dict()}
+        return mgr.save(int(jax.device_get(self._step_dev)), state,
+                        meta=meta)
 
-    def restore_checkpoint(self, directory_or_manager, step=None):
+    def restore_checkpoint(self, directory_or_manager, step=None,
+                           data_iter=None):
         """Restore params/optimizer state/RNG/step/loss-scale from the
         newest intact checkpoint (or ``step=``), placing every leaf back
         on its training sharding.  Returns the restored step number.
-        Training resumes bit-identically to the uninterrupted run."""
+        Training resumes bit-identically to the uninterrupted run.
+
+        ``data_iter`` — restore the data stream too: the iterator is
+        ``load_state_dict``-ed to the checkpointed mid-epoch position
+        (exact next batch, same shuffle order).  Raises
+        :class:`~.checkpoint.CheckpointError` when the checkpoint was
+        saved without iterator state — resuming would replay data.
+        Defaults to the iterator bound by
+        ``attach_checkpoint(data_iter=...)`` (symmetric with
+        ``save_checkpoint``); an implicitly-bound iterator facing a
+        checkpoint without iterator state warns instead of raising, so
+        attaching first and restoring second keeps working against
+        pre-protocol checkpoints.  The reverse mismatch — the
+        checkpoint carries iterator state but no iterator was passed
+        or attached — warns too: the restored run would silently
+        replay its epoch from batch 0."""
         self._ensure_built()
         mgr = self._as_manager(directory_or_manager)
         like = self._checkpoint_state()
-        step_no, state = mgr.restore(like, step=step,
-                                     shardings=self._checkpoint_shardings())
+        step_no, state, meta = mgr.restore(
+            like, step=step, shardings=self._checkpoint_shardings(),
+            return_meta=True)
+        explicit_iter = data_iter is not None
+        if data_iter is None:
+            data_iter = self._ckpt_data_iter
+        if data_iter is not None:
+            iter_state = (meta or {}).get("data_iter")
+            if iter_state is None:
+                msg = ("checkpoint step %d carries no data-iterator state "
+                       "(saved without data_iter=) — restoring this "
+                       "iterator would silently replay the epoch from "
+                       "batch 0; re-save with save_checkpoint(..., "
+                       "data_iter=it) or restore without data_iter"
+                       % step_no)
+                if explicit_iter:
+                    from .checkpoint import CheckpointError
+
+                    raise CheckpointError(msg)
+                import warnings
+
+                warnings.warn(msg + " (iterator left untouched)")
+            else:
+                data_iter.load_state_dict(iter_state)
+        elif (meta or {}).get("data_iter") is not None:
+            import warnings
+
+            warnings.warn(
+                "checkpoint step %d carries data-iterator state but no "
+                "data_iter was passed or attached — the data stream "
+                "will replay its epoch from batch 0; pass "
+                "restore_checkpoint(..., data_iter=it) (or "
+                "attach_checkpoint(data_iter=it)) to resume mid-epoch"
+                % step_no)
         for p, v in zip(self._gp, state["params"]):
             p._data._data = v
         for p, v in zip(self._aux, state["aux"]):
@@ -1337,18 +1401,42 @@ class TrainStep:
         return step_no
 
     def attach_checkpoint(self, directory_or_manager, every=None,
-                          keep_last=3):
+                          keep_last=3, data_iter=None):
         """Bind a checkpoint manager to the step loop: saves at the next
         step boundary whenever a preemption/checkpoint request is
         pending (``checkpoint.install_preemption_hook`` / SIGTERM), and
-        every ``every`` applied steps if given.  Returns the manager."""
+        every ``every`` applied steps if given.  Returns the manager.
+
+        ``data_iter`` — the training data iterator; every boundary save
+        then includes its mid-epoch state (see ``save_checkpoint``), so
+        a preemption-triggered checkpoint resumes the data stream at
+        the exact next batch.  Without it, a loop that consumes a
+        stateful iterator resumes by replaying data (graftlint GL008
+        flags that pattern)."""
         from . import checkpoint as _ckpt
 
         if every is not None and int(every) < 1:
             raise ValueError("every must be >= 1 or None")
+        if data_iter is not None:
+            # fail NOW, while the mistake is cheap: an iterator without
+            # the state protocol would otherwise surface as
+            # NotImplementedError from state_dict() at the SIGTERM
+            # boundary save — losing the preemption checkpoint entirely
+            from ..io.io import DataIter as _DataIter
+
+            sd = getattr(type(data_iter), "state_dict", None)
+            if sd is None or sd is _DataIter.state_dict:
+                raise ValueError(
+                    "data_iter=%r does not implement the iterator-state "
+                    "protocol (state_dict/load_state_dict) — wrap it in "
+                    "io.ResilientIter or use a protocol-aware iterator "
+                    "(NDArrayIter, ImageRecordIter, ...) so boundary "
+                    "saves can carry the data position"
+                    % type(data_iter).__name__)
         self._ckpt_manager = self._as_manager(directory_or_manager,
                                               keep_last)
         self._ckpt_every = int(every) if every else None
+        self._ckpt_data_iter = data_iter
         self._ckpt_prev_count = self._step_count
         # requests predating the attach are not ours to honor
         self._ckpt_seen_request = _ckpt.request_seq()

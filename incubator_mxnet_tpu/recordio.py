@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import os
 import struct
+import warnings
 from collections import namedtuple
 
 import numpy as np
@@ -28,6 +29,28 @@ __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
 
 _kMagic = 0xCED7230A
 _MAGIC_BYTES = struct.pack("<I", _kMagic)
+
+
+def _corrupt_record_error(uri, offset, why):
+    """A clear, locatable IOError for an unreadable record.  ``path``
+    and ``offset`` ride the exception as attributes so the resilient
+    reader (``io/resilient.py``) can quarantine the record by file
+    offset instead of parsing the message."""
+    err = IOError("%s at offset %d in %s" % (why, offset, uri))
+    err.path = uri
+    err.offset = int(offset)
+    return err
+
+
+def _torn_final_record(uri, offset, why):
+    """A file cut mid-write by a crash is readable up to the tear
+    (same policy as the atomic-save torn-file handling in
+    ``ndarray/utils.py``): warn once and report end-of-file instead of
+    raising on the final, partially-written record."""
+    warnings.warn(
+        "torn final record in %s at offset %d (%s) — file truncated "
+        "mid-write? Records up to the tear were read; stopping here."
+        % (uri, offset, why), stacklevel=3)
 
 
 class MXRecordIO:
@@ -167,6 +190,7 @@ class MXRecordIO:
         assert not self.writable
         if getattr(self, "_nh", None):
             import ctypes
+            offset = self.tell()
             out = ctypes.c_char_p()
             out_len = ctypes.c_size_t()
             rc = self._nlib.MXTRecordIOReaderRead(
@@ -174,28 +198,125 @@ class MXRecordIO:
             if rc == 0:
                 return None
             if rc < 0:
-                raise IOError("native recordio read failed (%d) in %s"
-                              % (rc, self.uri))
+                # classify through the python framing reader so the
+                # native fast path keeps the same contract: a crash-torn
+                # FINAL record warns and reads as end-of-file, real
+                # corruption raises an IOError naming file + offset
+                with open(self.uri, "rb") as fh:
+                    fh.seek(offset)
+                    try:
+                        return self._read_python(fh)
+                    finally:
+                        # keep the native cursor in step (incl. the
+                        # corrupt-record resync) so the NEXT read starts
+                        # at the next frame boundary, not back inside
+                        # the bad record.  Byte-seek explicitly —
+                        # MXIndexedRecordIO.seek overrides with
+                        # key-based semantics.
+                        MXRecordIO.seek(self, fh.tell())
             return ctypes.string_at(out, out_len.value)
+        return self._read_python(self.fh)
+
+    def _resync(self, fh, bad_offset):
+        """Scan forward from a corrupt frame for the next plausible
+        frame boundary — a 4-byte-aligned magic word (every frame is
+        padded to 4 bytes) — and leave ``fh`` there (EOF when none).
+        A false positive (payload bytes that happen to spell the magic)
+        just fails the next header check and resyncs again: progress is
+        monotonic either way."""
+        pos = (int(bad_offset) + 4 + 3) & ~3
+        while True:
+            fh.seek(pos)
+            buf = fh.read(1 << 16)
+            if not buf:
+                fh.seek(0, 2)
+                return
+            i = 0
+            while True:
+                i = buf.find(_MAGIC_BYTES, i)
+                if i == -1:
+                    break
+                if (pos + i) % 4 == 0:
+                    fh.seek(pos + i)
+                    return
+                i += 1
+            # keep a 3-byte overlap: an aligned magic can straddle the
+            # chunk boundary only when the chunk ends off-alignment (EOF)
+            pos += max(len(buf) - 3, 1)
+
+    def _read_python(self, fh):
+        """Python framing reader: validates magic/length per frame,
+        tolerates a torn final record (warn + stop — a file cut
+        mid-write by a crash is readable up to the tear) and raises a
+        locatable ``IOError`` (``.path``/``.offset``) on corruption."""
         out = bytearray()
         expect_more = False
         while True:
-            head = self.fh.read(8)
+            offset = fh.tell()
+            head = fh.read(8)
+            if len(head) == 0 and not expect_more:
+                return None  # clean end of file
             if len(head) < 8:
-                if expect_more:
-                    raise IOError("truncated multi-part record in %s" % self.uri)
+                # EOF inside a record frame: the crash-torn-final-record
+                # case — everything before this frame was intact
+                _torn_final_record(
+                    self.uri, offset,
+                    "partial continuation frame" if expect_more
+                    else "only %d of 8 header bytes" % len(head))
                 return None
             magic, lrec = struct.unpack("<II", head)
             if magic != _kMagic:
-                raise IOError("invalid magic in %s" % self.uri)
+                # resync BEFORE raising: leave the handle at the next
+                # plausible frame boundary so one corrupt record costs
+                # the caller one error (one skip-budget unit), not one
+                # per 4 bytes of its payload
+                self._resync(fh, offset)
+                raise _corrupt_record_error(
+                    self.uri, offset,
+                    "invalid record magic 0x%08X (expected 0x%08X)"
+                    % (magic, _kMagic))
             cflag = lrec >> 29
             length = lrec & ((1 << 29) - 1)
-            data = self.fh.read(length)
+            if cflag in (2, 3) and not expect_more:
+                # continuation frame with no begin: the begin frame was
+                # the corrupt one we resynced past.  The framing here is
+                # intact — skip the frame so the next read starts at the
+                # following boundary, and report this piece as corrupt.
+                fh.seek(length + ((4 - (length & 3)) & 3), 1)
+                raise _corrupt_record_error(
+                    self.uri, offset,
+                    "continuation frame (cflag %d) without a begin frame"
+                    % cflag)
+            data = fh.read(length)
             if len(data) < length:
-                raise IOError("truncated record in %s" % self.uri)
+                # Short payload: either a crash-torn FINAL record
+                # (header intact, payload cut at EOF) or a corrupt
+                # length field MID-file whose inflated value over-read
+                # into later, intact records.  Resync decides: a next
+                # aligned magic inside the over-read bytes means intact
+                # frames follow — cost the caller ONE error (like the
+                # bad-magic path) instead of silently dropping the file
+                # tail; a genuinely torn final record finds none and
+                # still reads as warn + end-of-file.
+                self._resync(fh, offset + 4)
+                next_frame = fh.tell()
+                fh.seek(0, 2)
+                if next_frame < fh.tell():
+                    fh.seek(next_frame)
+                    raise _corrupt_record_error(
+                        self.uri, offset,
+                        "record length %d over-reads into a later frame "
+                        "(only %d payload bytes before the next frame "
+                        "boundary) — corrupt length field?"
+                        % (length, len(data)))
+                _torn_final_record(
+                    self.uri, offset,
+                    "header promises %d payload bytes, only %d on disk"
+                    % (length, len(data)))
+                return None
             pad = (4 - (length & 3)) & 3
             if pad:
-                self.fh.read(pad)
+                fh.read(pad)
             if cflag == 0:
                 return bytes(data)
             if cflag == 1:

@@ -170,6 +170,77 @@ def test_gl103_requires_spec_import_evidence():
     """)
 
 
+# ---------------------------------------------------------------------------
+# GL008 — checkpoint from a data loop without iterator state
+# ---------------------------------------------------------------------------
+
+def test_gl008_save_in_stateful_loop_without_data_iter():
+    from incubator_mxnet_tpu.analysis import (
+        CODES, check_checkpoint_without_iter_state)
+
+    # cataloged (append-only contract, docs/ANALYSIS.md)
+    assert CODES["GL008"][0] == Severity.WARNING
+    src = """
+        def train(step, train_iter, d):
+            for batch in train_iter:
+                step(batch.data[0], batch.label[0])
+                step.save_checkpoint(d)
+    """
+    diags = _lint(src)
+    assert [d.code for d in diags] == ["GL008"]
+    assert diags[0].severity == Severity.WARNING
+    assert "replays the epoch" in diags[0].message
+    assert "data_iter" in diags[0].hint
+    # the named core is directly callable on source text
+    import textwrap
+
+    direct = check_checkpoint_without_iter_state(textwrap.dedent(src))
+    assert [d.code for d in direct] == ["GL008"]
+    # attach_checkpoint inside the loop is the same hazard
+    assert [d.code for d in _lint("""
+        def train(step, loader, d):
+            for i, batch in enumerate(loader):
+                step.attach_checkpoint(d, every=100)
+    """)] == ["GL008"]
+
+
+def test_gl008_nested_stateful_loops_one_diagnostic_per_call():
+    # ast.walk reaches the same call from BOTH enclosing stateful
+    # loops — still exactly one diagnostic per save site
+    diags = _lint("""
+        def train(step, loader, loader2, d):
+            for a in loader:
+                for b in loader2:
+                    step.save_checkpoint(d)
+    """)
+    assert [d.code for d in diags] == ["GL008"]
+
+
+def test_gl008_clean_patterns():
+    # data_iter= passed -> clean
+    assert not _lint("""
+        def train(step, train_iter, d):
+            for batch in train_iter:
+                step.save_checkpoint(d, data_iter=train_iter)
+    """)
+    # position-free iterables (literals, range) -> clean; call outside
+    # any loop -> clean
+    assert not _lint("""
+        def train(step, d, batches):
+            for batch in [1, 2, 3]:
+                step.save_checkpoint(d)
+            for i in range(10):
+                step.attach_checkpoint(d)
+            step.save_checkpoint(d)
+    """)
+    # inline suppression works for GL008 too
+    assert not _lint("""
+        def train(step, loader, d):
+            for batch in loader:
+                step.save_checkpoint(d)  # graftlint: disable=GL008
+    """)
+
+
 def test_inline_suppression():
     diags = _lint("""
         from jax import shard_map  # graftlint: disable=GL101

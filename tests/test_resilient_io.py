@@ -1,0 +1,1238 @@
+"""Resilient input pipeline (io/resilient.py, docs/RESILIENCE.md).
+
+Headline acceptance: kill-and-resume MID-EPOCH — the resumed run's
+batch sequence and per-step losses are bit-identical to an
+uninterrupted run, with shuffle enabled, on dp and zero=1 meshes.  Plus
+the fault drills through the injection harness: flaky reads absorbed by
+retry-with-backoff, a hung read surfaced as DataTimeoutError, bad
+records skipped within a bounded budget with every skip accounted for
+in the quarantine log, silent worker death respawned (bounded), and no
+leaked prefetch threads after close().
+"""
+import json
+import os
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, nd, recordio
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.io import (DataIter, DataTimeoutError, NDArrayIter,
+                                    PrefetchingIter, ResilientIter,
+                                    ResizeIter, SkipBudgetExceeded,
+                                    WorkerDiedError)
+from incubator_mxnet_tpu.parallel import make_mesh, make_train_step
+from incubator_mxnet_tpu.parallel import fault_injection as fi
+
+FEAT = 8
+N = 48
+BATCH = 8
+
+
+def _data():
+    rng = np.random.RandomState(3)
+    return (rng.rand(N, FEAT).astype(np.float32),
+            (np.arange(N) % 4).astype(np.float32))
+
+
+def _make_iter(np_seed, **kw):
+    X, Y = _data()
+    np.random.seed(np_seed)
+    return ResilientIter(NDArrayIter(X, Y, batch_size=BATCH, shuffle=True),
+                         **kw)
+
+
+# ---------------------------------------------------------------------------
+# fault drills (no train step: milliseconds each)
+# ---------------------------------------------------------------------------
+
+def test_flaky_reads_absorbed_by_retry():
+    """Transient errno-carrying OSErrors retry with backoff: every 3rd
+    read failing injects no skip and loses no batch."""
+    with fi.flaky_reads(every_k=3) as stats:
+        it = _make_iter(1, retries=2, backoff=0.001)
+        got = [b.index.copy() for b in it]
+    assert len(got) == N // BATCH
+    assert not it.quarantine
+    assert stats.failed >= 1
+    it.close()
+    # retries exhausted -> the OSError propagates (infra fault, not data)
+    with fi.flaky_reads(every_k=1) as stats:
+        it = _make_iter(1, retries=1, backoff=0.001)
+        with pytest.raises(OSError, match="injected flaky read"):
+            it.next()
+    assert stats.failed >= 2  # first try + retry both injected
+    it.close()
+
+
+def test_timeout_surfaced_as_error():
+    with fi.slow_reads(0.5):
+        it = _make_iter(1, timeout=0.05)
+        with pytest.raises(DataTimeoutError, match="no batch within"):
+            for _ in range(N // BATCH + 1):
+                it.next()
+    it.close(join_timeout=1)
+    time.sleep(0.6)  # let the stalled worker drain off before other tests
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_worker_death_detected_and_respawned():
+    it = _make_iter(1, max_respawns=2)
+    with fi.kill_worker(at=2, count=1) as stats:
+        it.reset()
+        got = [b.index.copy() for b in it]
+    assert len(got) == N // BATCH  # no record lost across the respawn
+    assert stats.killed == 1
+    it.close()
+    # respawn budget exhausted -> WorkerDiedError (not a hang)
+    with fi.kill_worker(at=0, count=100):
+        it = _make_iter(1, max_respawns=1)
+        with pytest.raises(WorkerDiedError, match="respawn budget"):
+            it.next()
+    it.close()
+
+
+class _RecordIter(DataIter):
+    """Indexed record reader, one record per next(): a bad record
+    raises but the cursor has advanced, so the stream can continue —
+    the skip-policy-friendly shape indexed readers naturally have."""
+
+    def __init__(self, idx_path, rec_path):
+        super().__init__(1)
+        self._reader = recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+        self._k = 0
+
+    def reset(self):
+        self._k = 0
+
+    def next(self):  # noqa: A003
+        if self._k >= len(self._reader.keys):
+            raise StopIteration
+        key = self._reader.keys[self._k]
+        self._k += 1
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # torn-record warning
+            payload = self._reader.read_idx(key)
+        if payload is None:  # torn final record reads as EOF
+            err = IOError("torn record %r" % key)
+            err.offset = self._reader.idx[key]
+            err.path = self._reader.uri
+            raise err
+        return np.frombuffer(payload, np.float32)
+
+
+def _write_records(tmp_path, n=10):
+    rec = str(tmp_path / "drill.rec")
+    idx = str(tmp_path / "drill.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(n):
+        w.write_idx(i, np.full(4, i, np.float32).tobytes())
+    w.close()
+    return idx, rec
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_skip_budget_and_quarantine_under_combined_faults(tmp_path):
+    """The acceptance drill: flaky reads every 3rd record, one corrupt
+    record, one crash-torn record and one worker death in ONE epoch —
+    the epoch completes within the skip budget, every skipped record is
+    accounted for in the quarantine log (file offset + exception), and
+    no prefetch thread leaks after close()."""
+    idx, rec = _write_records(tmp_path)
+    reader = recordio.MXIndexedRecordIO(idx, rec, "r")
+    off4, off9 = reader.idx[4], reader.idx[9]
+    reader.close()
+    with open(rec, "r+b") as f:  # corrupt record 4's magic
+        f.seek(off4)
+        f.write(b"\xde\xad\xbe\xef")
+    fi.truncate_record(rec, off9 + 10)  # tear the final record mid-write
+
+    base_threads = threading.active_count()
+    qlog = str(tmp_path / "quarantine.jsonl")
+    with fi.flaky_reads(every_k=3) as fstats, \
+            fi.kill_worker(at=7, count=1) as kstats:
+        it = ResilientIter(_RecordIter(idx, rec), on_bad_record="skip",
+                           skip_budget=3, quarantine_log=qlog,
+                           retries=2, backoff=0.001)
+        got = [float(a[0]) for a in it]
+    assert got == [0, 1, 2, 3, 5, 6, 7, 8]  # 4 and 9 skipped, rest intact
+    assert fstats.failed >= 2 and kstats.killed == 1
+    # every skip accounted for: offsets + exceptions in the log
+    assert sorted(q["offset"] for q in it.quarantine) == sorted([off4, off9])
+    assert all(q["path"] == rec and q["error"] for q in it.quarantine)
+    lines = [json.loads(line) for line in open(qlog)]
+    assert len(lines) == 2 and lines == it.quarantine
+    it.close()
+    time.sleep(0.05)
+    assert threading.active_count() == base_threads  # no leaked threads
+
+
+def test_skip_budget_exhaustion_raises(tmp_path):
+    idx, rec = _write_records(tmp_path)
+    reader = recordio.MXIndexedRecordIO(idx, rec, "r")
+    offs = [reader.idx[k] for k in (1, 3, 5)]
+    reader.close()
+    with open(rec, "r+b") as f:
+        for off in offs:
+            f.seek(off)
+            f.write(b"\xde\xad\xbe\xef")
+    it = ResilientIter(_RecordIter(idx, rec), on_bad_record="skip",
+                       skip_budget=2)
+    with pytest.raises(SkipBudgetExceeded, match="budget is 2"):
+        list(it)
+    it.close()
+    # on_bad_record="raise": first bad record propagates (and is logged)
+    it = ResilientIter(_RecordIter(idx, rec), on_bad_record="raise")
+    with pytest.raises(IOError):
+        list(it)
+    assert len(it.quarantine) == 1
+    it.close()
+
+
+def test_epoch_continues_after_propagated_error(tmp_path):
+    """on_bad_record="raise" delivers the error AND keeps the epoch
+    alive: an indexed reader's cursor already advanced past the bad
+    record, so a caller that catches the IOError and keeps consuming
+    gets every remaining batch — not a silent StopIteration truncating
+    the rest of the epoch."""
+    idx, rec = _write_records(tmp_path)
+    reader = recordio.MXIndexedRecordIO(idx, rec, "r")
+    off = reader.idx[4]
+    reader.close()
+    with open(rec, "r+b") as f:
+        f.seek(off)
+        f.write(b"\xde\xad\xbe\xef")
+    it = ResilientIter(_RecordIter(idx, rec), on_bad_record="raise")
+    got, errors = [], 0
+    while True:
+        try:
+            got.append(int(it.next()[0]))
+        except StopIteration:
+            break
+        except IOError:
+            errors += 1
+    it.close()
+    assert errors == 1
+    assert got == [0, 1, 2, 3, 5, 6, 7, 8, 9]
+    assert len(it.quarantine) == 1  # the propagated record is logged
+
+
+def test_resume_after_propagated_error_force_skips(tmp_path):
+    """A raise-policy run that continued past a corrupt record stays
+    checkpointable: the resume replay force-skips the
+    originally-quarantined seq (still corrupt on disk) instead of
+    re-raising at it and making the checkpoint unrestorable."""
+    idx, rec = _write_records(tmp_path)
+    reader = recordio.MXIndexedRecordIO(idx, rec, "r")
+    off = reader.idx[2]
+    reader.close()
+    with open(rec, "r+b") as f:
+        f.seek(off)
+        f.write(b"\xde\xad\xbe\xef")
+    it = ResilientIter(_RecordIter(idx, rec), on_bad_record="raise")
+    got = []
+    while len(got) < 4:
+        try:
+            got.append(int(it.next()[0]))
+        except IOError:
+            pass
+    assert got == [0, 1, 3, 4]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)  # no protocol
+        state = it.state_dict()
+    it.close()
+    assert [q["seq"] for q in state["quarantine"]] == [2]
+    it2 = ResilientIter(_RecordIter(idx, rec), on_bad_record="raise")
+    it2.load_state_dict(state)
+    rest = [int(x[0]) for x in it2]
+    it2.close()
+    assert rest == [5, 6, 7, 8, 9]
+    assert len(it2.quarantine) == 1  # restored entry, not re-logged
+
+
+def test_resume_replays_skips_deterministically(tmp_path):
+    """Mid-epoch resume ON a damaged file: the fast-forward replay
+    re-applies the same skips, so the resumed stream continues with the
+    exact post-crash batch sequence."""
+    idx, rec = _write_records(tmp_path)
+    reader = recordio.MXIndexedRecordIO(idx, rec, "r")
+    off2 = reader.idx[2]
+    reader.close()
+    with open(rec, "r+b") as f:
+        f.seek(off2)
+        f.write(b"\xde\xad\xbe\xef")
+    it1 = ResilientIter(_RecordIter(idx, rec), on_bad_record="skip",
+                        skip_budget=3)
+    head = [float(it1.next()[0]) for _ in range(4)]  # 0,1,3,4 (2 skipped)
+    assert head == [0, 1, 3, 4]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)  # _RecordIter
+        state = it1.state_dict()  # has no state protocol, on purpose
+    json.dumps(state)  # must be manifest (JSON) safe
+    it2 = ResilientIter(_RecordIter(idx, rec), on_bad_record="skip",
+                        skip_budget=3)
+    it2.load_state_dict(state)
+    assert [float(a[0]) for a in it2] == [float(a[0]) for a in it1] \
+        == [5, 6, 7, 8, 9]
+    # the restored quarantine still accounts for the pre-crash skip
+    assert [q["offset"] for q in it2.quarantine] == [off2]
+    it1.close()
+    it2.close()
+
+
+# ---------------------------------------------------------------------------
+# prefetch shutdown / PrefetchingIter regressions
+# ---------------------------------------------------------------------------
+
+def test_resilient_close_leaks_no_threads():
+    base = threading.active_count()
+    it = _make_iter(1)
+    for _ in range(2):
+        it.next()
+    assert threading.active_count() > base  # prefetch worker is live
+    it.close()
+    time.sleep(0.05)
+    assert threading.active_count() == base
+    with pytest.raises(StopIteration):  # closed == exhausted, not a hang
+        it.next()
+
+
+class _RaisingIter(DataIter):
+    def __init__(self, fail_at=3):
+        super().__init__(2)
+        self._n = 0
+        self._fail_at = fail_at
+
+    def reset(self):
+        self._n = 0
+
+    def next(self):  # noqa: A003
+        self._n += 1
+        if self._n == self._fail_at:
+            raise ValueError("inner iterator boom")
+        if self._n > 5:
+            raise StopIteration
+        return self._n
+
+
+def test_prefetching_iter_reraises_and_joins():
+    """Regression: a raising inner iterator used to kill the producer
+    thread silently and hang the consumer on an empty queue forever;
+    now the exception is re-raised in the consumer and the thread is
+    joined on exhaustion/close/__del__."""
+    base = threading.active_count()
+    p = PrefetchingIter(_RaisingIter(fail_at=3))
+    assert p.next() == 1 and p.next() == 2
+    with pytest.raises(ValueError, match="inner iterator boom"):
+        p.next()
+    time.sleep(0.05)
+    assert threading.active_count() == base  # joined after the error
+    p.close()
+    # clean exhaustion also joins
+    p = PrefetchingIter(_RaisingIter(fail_at=99))
+    got = []
+    with pytest.raises(StopIteration):
+        while True:
+            got.append(p.next())
+    assert got == [1, 2, 3, 4, 5]
+    time.sleep(0.05)
+    assert threading.active_count() == base
+    p.close()
+    # reset() mid-epoch restarts cleanly
+    p = PrefetchingIter(_RaisingIter(fail_at=99))
+    assert p.next() == 1
+    p.reset()
+    assert p.next() == 1
+    p.close()
+    time.sleep(0.05)
+    assert threading.active_count() == base
+
+
+# ---------------------------------------------------------------------------
+# iterator-state protocol units
+# ---------------------------------------------------------------------------
+
+def test_ndarray_iter_state_roundtrip_with_shuffle():
+    X, Y = _data()
+    np.random.seed(1)
+    ref = NDArrayIter(X, Y, batch_size=BATCH, shuffle=True)
+    seq = []
+    for _ in range(2):  # two epochs: shuffle state must carry over
+        ref.reset()
+        seq.extend(b.index.copy() for b in ref)
+    np.random.seed(1)
+    it = NDArrayIter(X, Y, batch_size=BATCH, shuffle=True)
+    it.reset()
+    got = [it.next().index.copy() for _ in range(2)]
+    state = it.state_dict()
+    json.dumps(state)
+    np.random.seed(99)  # restore must beat a different ambient seed
+    it2 = NDArrayIter(X, Y, batch_size=BATCH, shuffle=True)
+    it2.load_state_dict(state)
+    while True:
+        try:
+            got.append(it2.next().index.copy())
+        except StopIteration:
+            break
+    it2.reset()  # NEXT epoch must shuffle identically to ref's
+    got.extend(b.index.copy() for b in it2)
+    assert all(np.array_equal(a, b) for a, b in zip(seq, got))
+
+
+def test_ndarray_iter_state_shuffle_mismatch_rejected():
+    X, Y = _data()
+    plain = NDArrayIter(X, Y, batch_size=BATCH, shuffle=False)
+    shuf = NDArrayIter(X, Y, batch_size=BATCH, shuffle=True)
+    with pytest.raises(ValueError, match="shuffle"):
+        shuf.load_state_dict(plain.state_dict())
+    with pytest.raises(ValueError, match="shuffle"):
+        plain.load_state_dict(shuf.state_dict())
+    # pre-flag states: shuffle inferred from rng presence
+    legacy = shuf.state_dict()
+    del legacy["shuffle"]
+    shuf.load_state_dict(legacy)
+    with pytest.raises(ValueError, match="shuffle"):
+        plain.load_state_dict(legacy)
+
+
+def test_resize_iter_state_roundtrip():
+    X, Y = _data()
+    np.random.seed(1)
+    ref = ResizeIter(NDArrayIter(X, Y, batch_size=BATCH, shuffle=True), 4)
+    seq = [ref.next().index.copy() for _ in range(4)]
+    np.random.seed(1)
+    it = ResizeIter(NDArrayIter(X, Y, batch_size=BATCH, shuffle=True), 4)
+    it.next()
+    state = it.state_dict()
+    np.random.seed(7)
+    it2 = ResizeIter(NDArrayIter(X, Y, batch_size=BATCH, shuffle=True), 4)
+    it2.load_state_dict(state)
+    got = [it2.next().index.copy() for _ in range(3)]
+    assert all(np.array_equal(a, b) for a, b in zip(seq[1:], got))
+    with pytest.raises(ValueError, match="saved by"):
+        it2.load_state_dict({"iter": "NDArrayIter"})
+
+
+def test_image_record_iter_state_roundtrip(tmp_path):
+    """Mid-epoch resume of the threaded record iterator: consumed-batch
+    accounting (not producer read-ahead), shuffle order and per-batch
+    augmentation seeds all replay bit-identically."""
+    from incubator_mxnet_tpu.io import ImageRecordIter
+
+    rng = np.random.RandomState(0)
+    rec = str(tmp_path / "img.rec")
+    idx = str(tmp_path / "img.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(24):
+        img = rng.randint(0, 255, (10, 10, 3), np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 4), i, 0), img, img_fmt=".npy"))
+    w.close()
+
+    def make(seed):
+        return ImageRecordIter(
+            path_imgrec=rec, path_imgidx=idx, data_shape=(3, 8, 8),
+            batch_size=4, shuffle=True, rand_crop=True, rand_mirror=True,
+            preprocess_threads=2, prefetch_buffer=2, seed=seed)
+
+    ref = make(5)
+    seq = []
+    for _ in range(2):  # 12 batches = 2 epochs
+        ref.reset()
+        while ref.iter_next():
+            seq.append((ref.getdata()[0].asnumpy(),
+                        ref.getlabel()[0].asnumpy()))
+    ref.close()
+
+    it = make(5)
+    it.reset()
+    got = []
+    for _ in range(2):
+        it.iter_next()
+        got.append((it.getdata()[0].asnumpy(), it.getlabel()[0].asnumpy()))
+    state = it.state_dict()
+    json.dumps(state)
+    it.close()
+    it2 = make(17)  # different seed: the restored RNG state must win
+    it2.load_state_dict(state)
+    while it2.iter_next():
+        got.append((it2.getdata()[0].asnumpy(),
+                    it2.getlabel()[0].asnumpy()))
+    it2.reset()  # next epoch continues the restored stream
+    while it2.iter_next():
+        got.append((it2.getdata()[0].asnumpy(),
+                    it2.getlabel()[0].asnumpy()))
+    it2.close()
+    assert len(seq) == len(got)
+    for (rd, rl), (gd, gl) in zip(seq, got):
+        assert np.array_equal(rd, gd) and np.array_equal(rl, gl)
+    # configuration drift is rejected before any state is touched —
+    # a different batch size or shuffle flag would fast-forward the
+    # wrong stream and resume on silently divergent data
+    bad = ImageRecordIter(
+        path_imgrec=rec, path_imgidx=idx, data_shape=(3, 8, 8),
+        batch_size=6, shuffle=True, preprocess_threads=2, seed=5)
+    with pytest.raises(ValueError, match="batch_size"):
+        bad.load_state_dict(state)
+    bad.close()
+    bad = ImageRecordIter(
+        path_imgrec=rec, path_imgidx=idx, data_shape=(3, 8, 8),
+        batch_size=4, shuffle=False, preprocess_threads=2, seed=5)
+    with pytest.raises(ValueError, match="shuffle"):
+        bad.load_state_dict(state)
+    bad.close()
+
+
+# ---------------------------------------------------------------------------
+# the headline: kill-and-resume mid-epoch through the fused step
+# ---------------------------------------------------------------------------
+
+def _build_net(seed):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(FEAT, activation="tanh"), nn.Dense(4))
+    net.initialize(init=mx.init.Xavier())
+    net(nd.ones((2, FEAT)))
+    return net
+
+MESHES = {"dp": dict(), "zero1": dict(zero=1)}
+
+
+def _make_step(seed, cfg):
+    mesh = make_mesh({"dp": 8}, devices=jax.devices()[:8])
+    return make_train_step(_build_net(seed),
+                           gluon.loss.SoftmaxCrossEntropyLoss(),
+                           optimizer="adam", learning_rate=0.01,
+                           lint="error", mesh=mesh, **cfg)
+
+
+@pytest.mark.parametrize("mesh_kind", sorted(MESHES))
+def test_kill_and_resume_mid_epoch_parity(mesh_kind, tmp_path):
+    """6 shuffled batches straight ≡ 3 batches → crash → restore into
+    FRESH step + FRESH differently-seeded iterator → 3 batches: the
+    resumed batch sequence (indices) and per-step losses are
+    bit-identical, so no batch is double-trained or starved."""
+    cfg = MESHES[mesh_kind]
+    d = str(tmp_path / "ckpt")
+
+    ref_step = _make_step(5, cfg)
+    it = _make_iter(11)
+    ref_losses, ref_idx = [], []
+    for k in range(6):
+        b = it.next()
+        ref_idx.append(b.index.copy())
+        ref_losses.append(float(ref_step(b.data[0], b.label[0]).asscalar()))
+        if k == 2:  # the would-be crash point, mid-epoch
+            path = ref_step.save_checkpoint(d, data_iter=it)
+    it.close()
+    with open(os.path.join(path, "manifest.json")) as f:
+        meta = json.load(f)["meta"]
+    assert meta["data_iter"]["iter"] == "ResilientIter"
+    assert meta["data_iter"]["consumed"] == 3
+
+    res_step = _make_step(6, cfg)   # DIFFERENT init: restore must win
+    it2 = _make_iter(12)            # DIFFERENT shuffle: restore must win
+    assert res_step.restore_checkpoint(d, data_iter=it2) == 3
+    res_losses, res_idx = [], []
+    for _ in range(3):
+        b = it2.next()
+        res_idx.append(b.index.copy())
+        res_losses.append(float(res_step(b.data[0], b.label[0]).asscalar()))
+    it2.close()
+
+    # batch sequence continues where the kill landed — bit-identical
+    assert all(np.array_equal(a, b) for a, b in zip(ref_idx[3:], res_idx))
+    assert ref_losses[3:] == res_losses  # losses bit-identical (CPU f32)
+    for p1, p2 in zip(ref_step.net.collect_params().values(),
+                      res_step.net.collect_params().values()):
+        assert np.array_equal(p1.data().asnumpy(), p2.data().asnumpy())
+
+    # a checkpoint saved withOUT data_iter refuses to restore one
+    ref_step.save_checkpoint(str(tmp_path / "bare"))
+    with pytest.raises(Exception, match="no data-iterator state"):
+        res_step.restore_checkpoint(str(tmp_path / "bare"), data_iter=it2)
+
+
+def test_attach_checkpoint_binds_data_iter(tmp_path):
+    """attach_checkpoint(data_iter=) makes boundary/preemption saves
+    carry iterator state automatically."""
+    from incubator_mxnet_tpu.parallel import checkpoint as ckpt_mod
+
+    step = _make_step(5, MESHES["dp"])
+    it = _make_iter(11)
+    d = str(tmp_path / "ckpt")
+    mgr = step.attach_checkpoint(d, data_iter=it)
+    b = it.next()
+    ckpt_mod.request_checkpoint()  # what the SIGTERM hook does
+    step(b.data[0], b.label[0])    # boundary save fires here
+    assert mgr.steps()
+    with open(os.path.join(mgr.directory,
+                           "step-%08d" % mgr.latest_step(),
+                           "manifest.json")) as f:
+        meta = json.load(f)["meta"]
+    assert meta["data_iter"]["consumed"] == 1
+    it.close()
+    # an iterator withOUT the state protocol is rejected at attach time
+    # (NOT at the SIGTERM boundary save, where the failure would cost
+    # the preemption checkpoint)
+    class _Stateless(DataIter):
+        pass
+
+    with pytest.raises(ValueError, match="iterator-state protocol"):
+        step.attach_checkpoint(d, data_iter=_Stateless())
+
+
+def test_restore_without_iter_warns_when_state_saved(tmp_path):
+    """The reverse mismatch of the bare-checkpoint raise: the
+    checkpoint CARRIES mid-epoch iterator state but restore_checkpoint
+    gets no iterator (passed or attached) — warn, because the data
+    stream will silently replay its epoch from batch 0."""
+    d = str(tmp_path / "ckpt")
+    step = _make_step(5, MESHES["dp"])
+    it = _make_iter(11)
+    b = it.next()
+    step(b.data[0], b.label[0])
+    step.save_checkpoint(d, data_iter=it)
+    it.close()
+    res = _make_step(6, MESHES["dp"])
+    with pytest.warns(UserWarning,
+                      match="no data_iter was passed or attached"):
+        res.restore_checkpoint(d)
+
+
+# ---------------------------------------------------------------------------
+# review regressions: exhaustion, accounting, resync, straggler, protocol
+# ---------------------------------------------------------------------------
+
+class _BatchErrorIter(DataIter):
+    """Threaded-record-iterator shape: an errno-carrying OSError flagged
+    ``_mxtpu_batch_error`` AFTER the batch slot was consumed (the
+    ImageRecordIter per-batch decode-error contract)."""
+
+    def __init__(self, n=6, bad=2, fail=True):
+        super().__init__(1)
+        self.n, self.bad, self._fail = n, bad, fail
+        self.k = 0
+
+    def reset(self):
+        self.k = 0
+
+    def next(self):  # noqa: A003
+        if self.k >= self.n:
+            raise StopIteration
+        k = self.k
+        self.k += 1  # slot consumed BEFORE the error surfaces
+        if k == self.bad and self._fail:
+            self._fail = False  # once-transient: reads fine on replay
+            e = OSError(5, "transient decode fault mid-batch")
+            e._mxtpu_batch_error = True
+            raise e
+        return k
+
+
+def test_batch_error_never_retried_and_resume_stays_aligned(tmp_path):
+    """Regression: an errno-carrying error flagged _mxtpu_batch_error
+    used to be classified transient and retried — but the inner slot
+    was already consumed, so the retry pulled the NEXT batch in the
+    failed batch's place (lost unquarantined, consumed count off by
+    one).  It must quarantine/skip instead, and resume must force-skip
+    the quarantined seq even when the fault does not reproduce."""
+    it = ResilientIter(_BatchErrorIter(), retries=3, backoff=0.001,
+                       on_bad_record="skip", skip_budget=4)
+    got = [it.next() for _ in range(3)]
+    assert got == [0, 1, 3]  # slot 2 skipped, not silently replaced
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)  # _BatchErrorIter
+        state = it.state_dict()  # has no state protocol, on purpose
+    it.close()
+    assert state["consumed"] == 3 and state["skipped"] == 1
+    assert [q["seq"] for q in state["quarantine"]] == [2]
+    # resume into a copy where the once-transient fault does NOT recur:
+    # the replay must not count slot 2 (the original run skipped it) or
+    # every later batch shifts by one
+    it2 = ResilientIter(_BatchErrorIter(fail=False), retries=3,
+                        backoff=0.001, on_bad_record="skip", skip_budget=4)
+    it2.load_state_dict(state)
+    assert list(it2) == [4, 5]
+    it2.close()
+
+
+def test_prefetching_iter_epoch_local_lifetime():
+    """Regression: reset() used to reuse one queue + stop event across
+    epochs — a producer stuck past the join timeout could deliver a
+    stale batch or end-of-stream sentinel into the NEW epoch.  Each
+    epoch now gets its own queue/event; the zombie's view stays
+    stopped and its puts cannot land anywhere the consumer reads."""
+    X, Y = _data()
+    p = PrefetchingIter(NDArrayIter(X, Y, batch_size=BATCH))
+    q0, s0 = p._queue, p._stop
+    p.next()
+    p.reset()
+    assert p._queue is not q0 and p._stop is not s0
+    assert s0.is_set()  # the old epoch's flag stays set for its zombie
+    assert not PrefetchingIter._put(q0, s0, "stale")
+    assert q0.empty()  # nothing leaked where anyone could read it
+    assert len(list(p)) == N // BATCH  # fresh epoch unaffected
+    p.close()
+
+
+def test_next_after_exhaustion_raises_not_hangs():
+    """Regression: after the epoch ended (worker joined), another
+    next() used to busy-poll the dead queue forever with timeout=None;
+    it must keep raising StopIteration like any exhausted iterator."""
+    it = _make_iter(1)
+    assert len(list(it)) == N // BATCH
+    out = {}
+
+    def probe():
+        try:
+            it.next()
+        except StopIteration:
+            out["raised"] = True
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout=2)
+    assert not t.is_alive() and out.get("raised"), \
+        "next() after exhaustion hung instead of raising StopIteration"
+    it.close()
+    # reset() still starts the next epoch after exhaustion
+    it.reset()
+    assert len(list(it)) == N // BATCH
+    it.close()
+
+
+def test_readahead_skip_not_double_counted_on_resume(tmp_path):
+    """Regression: a bad record the worker's read-ahead already
+    quarantined — but the consumer never moved past — used to be saved
+    in state_dict() and then quarantined AGAIN after resume (double log
+    entry, double skip-budget charge).  The checkpoint must carry only
+    consumption-accurate accounting."""
+    idx, rec = _write_records(tmp_path)
+    reader = recordio.MXIndexedRecordIO(idx, rec, "r")
+    off6 = reader.idx[6]
+    reader.close()
+    with open(rec, "r+b") as f:  # corrupt record 6's magic
+        f.seek(off6)
+        f.write(b"\xde\xad\xbe\xef")
+    it = ResilientIter(_RecordIter(idx, rec), prefetch=4,
+                       on_bad_record="skip", skip_budget=3)
+    head = [float(it.next()[0]) for _ in range(4)]  # records 0-3
+    assert head == [0, 1, 2, 3]
+    deadline = time.monotonic() + 2  # let the read-ahead hit record 6
+    while not it.quarantine and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert it.quarantine  # the worker DID quarantine it already...
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)  # _RecordIter
+        state = it.state_dict()  # has no state protocol, on purpose
+    assert state["quarantine"] == [] and state["skipped"] == 0  # ...but
+    # the checkpoint only accounts for what the loop consumed
+    it.close()
+    it2 = ResilientIter(_RecordIter(idx, rec), prefetch=4,
+                        on_bad_record="skip", skip_budget=3)
+    it2.load_state_dict(state)
+    tail = [float(a[0]) for a in it2]
+    assert tail == [4, 5, 7, 8, 9]
+    assert [q["offset"] for q in it2.quarantine] == [off6]  # exactly once
+    it2.close()
+
+
+def test_sequential_corrupt_record_resyncs(tmp_path):
+    """Regression: a sequential (non-indexed) reader used to creep
+    through a corrupt record 4 bytes per error, burning ~frame_size/4
+    skip-budget units on ONE flipped byte; it must resync to the next
+    frame boundary so one bad record costs one error."""
+    idx, rec = _write_records(tmp_path, n=5)
+    reader = recordio.MXIndexedRecordIO(idx, rec, "r")
+    off2 = reader.idx[2]
+    reader.close()
+    with open(rec, "r+b") as f:
+        f.seek(off2)
+        f.write(b"\xde\xad\xbe\xef")
+    r = recordio.MXRecordIO(rec, "r")
+    out, errs = [], []
+    for _ in range(32):  # bounded: must terminate long before this
+        try:
+            s = r.read()
+        except IOError as e:
+            errs.append(e.offset)
+            continue
+        if s is None:
+            break
+        out.append(float(np.frombuffer(s, np.float32)[0]))
+    r.close()
+    assert out == [0, 1, 3, 4]  # records after the bad one still read
+    assert errs == [off2]       # ONE error, located at the bad record
+
+
+def test_corrupt_length_mid_file_resyncs_not_truncates(tmp_path):
+    """Regression: a corrupt LENGTH field mid-file (magic intact) used
+    to be misclassified as a crash-torn final record — warn + EOF,
+    silently dropping every intact record after the flipped byte.  It
+    must resync like the bad-magic path: one IOError, then the tail of
+    the file still reads."""
+    idx, rec = _write_records(tmp_path, n=5)
+    reader = recordio.MXIndexedRecordIO(idx, rec, "r")
+    off2 = reader.idx[2]
+    reader.close()
+    with open(rec, "r+b") as f:
+        f.seek(off2 + 4)  # the length word; magic stays valid
+        f.write(np.uint32((1 << 29) - 1).tobytes())  # absurdly inflated
+    r = recordio.MXRecordIO(rec, "r")
+    out, errs = [], []
+    for _ in range(32):
+        try:
+            s = r.read()
+        except IOError as e:
+            errs.append(e.offset)
+            continue
+        if s is None:
+            break
+        out.append(float(np.frombuffer(s, np.float32)[0]))
+    r.close()
+    assert out == [0, 1, 3, 4]  # the file TAIL survives the bad length
+    assert errs == [off2]
+    # a genuinely torn FINAL record (fresh file) still reads as
+    # warn + EOF — the resync probe finds no later frame
+    idx, rec = _write_records(tmp_path, n=4)
+    with open(rec, "r+b") as f:
+        f.truncate(os.path.getsize(rec) - 6)
+    r = recordio.MXRecordIO(rec, "r")
+    out = []
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        while True:
+            s = r.read()
+            if s is None:
+                break
+            out.append(float(np.frombuffer(s, np.float32)[0]))
+    r.close()
+    assert out == [0, 1, 2]  # readable up to the tear
+
+
+def test_abandoned_iterator_reaped_without_close():
+    """Regression: the prefetch worker used to hold a strong reference
+    to the iterator (bound-method thread target), so dropping a
+    mid-epoch ResilientIter without close() could never reach __del__
+    — the worker spun in its stop-aware put forever.  The worker holds
+    only a weakref now; GC reaps both."""
+    import gc
+
+    t0 = threading.active_count()
+    it = _make_iter(1, prefetch=1)
+    it.next()  # mid-epoch: worker parked on the full queue
+    wref = __import__("weakref").ref(it)
+    del it
+    gc.collect()
+    deadline = time.monotonic() + 3
+    while threading.active_count() > t0 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert wref() is None, "abandoned iterator was never collected"
+    assert threading.active_count() == t0, \
+        "abandoned iterator's prefetch worker leaked"
+    # same contract for the plain PrefetchingIter wrapper
+    X, Y = _data()
+    p = PrefetchingIter(NDArrayIter(X, Y, batch_size=BATCH),
+                        prefetch_depth=1)
+    p.next()
+    del p
+    gc.collect()
+    deadline = time.monotonic() + 3
+    while threading.active_count() > t0 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() == t0
+
+
+def test_quarantine_log_best_effort(tmp_path):
+    """The quarantine log creates its parent directory, and a log-write
+    failure degrades to in-memory-only (a failing LOG must not turn a
+    skippable record into a crash)."""
+    qlog = str(tmp_path / "sub" / "dir" / "q.jsonl")  # dirs don't exist
+    it = ResilientIter(_BatchErrorIter(), on_bad_record="skip",
+                       quarantine_log=qlog, backoff=0.001)
+    assert [it.next() for _ in range(3)] == [0, 1, 3]
+    it.close()
+    with open(qlog) as f:
+        assert json.loads(f.read().splitlines()[0])["seq"] == 2
+
+
+def test_ndarray_iter_state_is_o1_and_legacy_idx_loads():
+    """The manifest entry must not embed the O(num_data) permutation
+    (boundary saves json.dumps it on the SIGTERM path); pre-rework
+    states carrying an explicit idx list still load."""
+    X, Y = _data()
+    np.random.seed(4)
+    ref = NDArrayIter(X, Y, batch_size=BATCH, shuffle=True)
+    ref.next()  # consume batch 0; expect the rest + the next epoch
+    expect = []
+    while True:
+        try:
+            expect.append(ref.next().index.copy())
+        except StopIteration:
+            break
+    ref.reset()
+    expect.extend(b.index.copy() for b in ref)
+
+    np.random.seed(4)
+    it = NDArrayIter(X, Y, batch_size=BATCH, shuffle=True)
+    it.next()
+    state = it.state_dict()
+    assert "idx" not in state
+    # O(1): the ~4.5KB MT19937 state, never the num_data index list
+    assert len(json.dumps(state)) < 16384
+    from incubator_mxnet_tpu.io.io import _rng_state_to_json
+    legacy = {"iter": "NDArrayIter", "epoch": it._epoch,
+              "cursor": int(it.cursor), "idx": it.idx.tolist(),
+              "rng": _rng_state_to_json(it._shuffle_rng.get_state())}
+    for st in (state, legacy):
+        np.random.seed(9)  # restore must beat a different ambient seed
+        it2 = NDArrayIter(X, Y, batch_size=BATCH, shuffle=True)
+        it2.load_state_dict(st)
+        got = []
+        while True:
+            try:
+                got.append(it2.next().index.copy())
+            except StopIteration:
+                break
+        it2.reset()
+        got.extend(b.index.copy() for b in it2)
+        assert len(got) == len(expect)
+        assert all(np.array_equal(a, b) for a, b in zip(expect, got))
+
+
+def test_image_record_iter_resume_unaffected_by_straggler(tmp_path):
+    """Regression: load_state_dict/reset used to touch the shuffle RNG
+    while the PREVIOUS epoch's producer thread was still drawing from
+    it, so restoring into an iterator mid-epoch silently diverged the
+    resumed shuffle/augmentation order."""
+    from incubator_mxnet_tpu.io import ImageRecordIter
+
+    rng = np.random.RandomState(0)
+    rec = str(tmp_path / "img.rec")
+    idx = str(tmp_path / "img.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(24):
+        img = rng.randint(0, 255, (10, 10, 3), np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 4), i, 0), img, img_fmt=".npy"))
+    w.close()
+
+    def make(seed):
+        return ImageRecordIter(
+            path_imgrec=rec, path_imgidx=idx, data_shape=(3, 8, 8),
+            batch_size=4, shuffle=True, rand_crop=True, rand_mirror=True,
+            preprocess_threads=2, prefetch_buffer=2, seed=seed)
+
+    ref = make(5)
+    seq = []
+    while ref.iter_next():
+        seq.append(ref.getdata()[0].asnumpy())
+    ref.close()
+
+    it = make(5)
+    for _ in range(2):
+        it.iter_next()
+    state = it.state_dict()
+    it.close()
+
+    it2 = make(17)      # its ctor producer is already pulling batches
+    time.sleep(0.3)     # ...and is now blocked mid-epoch (straggler)
+    it2.load_state_dict(state)
+    got = []
+    while it2.iter_next():
+        got.append(it2.getdata()[0].asnumpy())
+    it2.close()
+    assert len(got) == len(seq) - 2
+    for a, b in zip(seq[2:], got):
+        assert np.array_equal(a, b), \
+            "resumed order diverged — straggler producer drew from the RNG"
+
+
+def test_iter_next_accessor_protocol():
+    """Regression: iter_next() used to fetch into a dead _peek slot and
+    the accessors raised NotImplementedError — the reference
+    `while it.iter_next(): it.getdata()` pattern dropped every batch."""
+    it = _make_iter(1)
+    seen = 0
+    while it.iter_next():
+        assert it.getdata() is not None and it.getlabel() is not None
+        assert it.getpad() == 0 and it.getindex() is not None
+        seen += 1
+    assert seen == N // BATCH
+    assert it._consumed == seen  # nothing double-fetched or dropped
+    it.close()
+    X, Y = _data()
+    p = PrefetchingIter(NDArrayIter(X, Y, batch_size=BATCH))
+    seen = 0
+    while p.iter_next():
+        assert p.getdata() is not None and p.getpad() == 0
+        seen += 1
+    assert seen == N // BATCH
+    p.close()
+
+
+def test_record_iter_subclass_state_not_cross_restorable(tmp_path):
+    """State kinds are stamped with type(self).__name__, so a checkpoint
+    written by an ImageRecordIter SUBCLASS (uint8 raw batches, det
+    labels) cannot be restored into the base class or a sibling — the
+    batch shapes differ even though the record file is the same."""
+    from incubator_mxnet_tpu.io import ImageRecordIter
+    from incubator_mxnet_tpu.io.record_iter import ImageRecordUInt8Iter
+
+    rng = np.random.RandomState(0)
+    rec = str(tmp_path / "img.rec")
+    idx = str(tmp_path / "img.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(8):
+        img = rng.randint(0, 255, (10, 10, 3), np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 4), i, 0), img, img_fmt=".npy"))
+    w.close()
+
+    def make(cls):
+        return cls(path_imgrec=rec, path_imgidx=idx, data_shape=(3, 10, 10),
+                   batch_size=4, preprocess_threads=1, seed=5)
+
+    u8 = make(ImageRecordUInt8Iter)
+    u8.iter_next()
+    state = u8.state_dict()
+    u8.close()
+    assert state["iter"] == "ImageRecordUInt8Iter"
+    plain = make(ImageRecordIter)
+    with pytest.raises(ValueError, match="ImageRecordUInt8Iter"):
+        plain.load_state_dict(state)
+    plain.close()
+    # same class still round-trips
+    u8b = make(ImageRecordUInt8Iter)
+    u8b.load_state_dict(state)
+    assert u8b.iter_next()
+    u8b.close()
+
+
+def test_state_dict_warns_when_inner_lacks_protocol(tmp_path):
+    """A wrapped DataIter WITHOUT state_dict() checkpoints only the
+    consumed cursor; resume degrades to reset()-and-replay.  That must
+    be said at save time, not discovered as a diverged loss curve."""
+    idx, rec = _write_records(tmp_path)
+    it = ResilientIter(_RecordIter(idx, rec))  # _RecordIter: no protocol
+    it.next()
+    with pytest.warns(RuntimeWarning, match="no state_dict"):
+        state = it.state_dict()
+    assert "inner" not in state
+    it.close()
+    # a plain iterable is replay-by-contract — no warning
+    it = ResilientIter([np.zeros(2)] * 4)
+    it.next()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        it.state_dict()
+    it.close()
+
+
+def test_legacy_restore_then_resave_stays_accurate():
+    """Regression: after restoring a legacy idx-format state, a second
+    save emitted the stale construction-time rng0 — the resumed
+    permutation was one this run never consumed.  Post-legacy-restore
+    saves must re-emit the accurate legacy format until the next
+    reset() recaptures an epoch-start state."""
+    from incubator_mxnet_tpu.io.io import _rng_state_to_json
+
+    X, Y = _data()
+    np.random.seed(4)
+    ref = NDArrayIter(X, Y, batch_size=BATCH, shuffle=True)
+    ref.next()
+    legacy = {"iter": "NDArrayIter", "epoch": ref._epoch,
+              "cursor": int(ref.cursor), "idx": ref.idx.tolist(),
+              "rng": _rng_state_to_json(ref._shuffle_rng.get_state())}
+    expect = [ref.next().index.copy() for _ in range(2)]
+    ref.reset()
+    expect.append(ref.next().index.copy())  # next epoch's first batch
+
+    np.random.seed(9)
+    it = NDArrayIter(X, Y, batch_size=BATCH, shuffle=True)
+    it.load_state_dict(legacy)
+    got = [it.next().index.copy()]
+    resaved = it.state_dict()
+    assert "idx" in resaved  # legacy fallback, not the stale rng0
+    np.random.seed(23)
+    it2 = NDArrayIter(X, Y, batch_size=BATCH, shuffle=True)
+    it2.load_state_dict(resaved)
+    got.append(it2.next().index.copy())
+    it2.reset()  # epoch boundary: O(1) format takes back over
+    assert "rng0" in it2.state_dict()
+    got.append(it2.next().index.copy())
+    assert all(np.array_equal(a, b) for a, b in zip(expect, got))
+
+
+def test_image_record_iter_shard_mismatch_rejected(tmp_path):
+    """Equal-sized dp shards pass every count check, so shard identity
+    is its own gate: rank 1's checkpoint must not restore into rank
+    0's iterator (wrong shuffle/aug stream, silently)."""
+    from incubator_mxnet_tpu.io import ImageRecordIter
+
+    rng = np.random.RandomState(0)
+    rec = str(tmp_path / "img.rec")
+    idx = str(tmp_path / "img.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(8):
+        img = rng.randint(0, 255, (10, 10, 3), np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 4), i, 0), img, img_fmt=".npy"))
+    w.close()
+
+    def make(part):
+        return ImageRecordIter(
+            path_imgrec=rec, path_imgidx=idx, data_shape=(3, 10, 10),
+            batch_size=2, preprocess_threads=1, seed=5,
+            part_index=part, num_parts=2)
+
+    r1 = make(1)
+    r1.iter_next()
+    state = r1.state_dict()
+    r1.close()
+    r0 = make(0)
+    with pytest.raises(ValueError, match="part_index"):
+        r0.load_state_dict(state)
+    r0.close()
+
+
+def test_ndarray_iter_batching_mismatch_rejected():
+    """A cursor is only meaningful under the batching it was saved
+    with: a different batch_size passes the cursor check but resumes on
+    batch boundaries the original run never had."""
+    X, Y = _data()
+    it = NDArrayIter(X, Y, batch_size=BATCH)
+    it.next()
+    state = it.state_dict()
+    bad = NDArrayIter(X, Y, batch_size=BATCH * 2)
+    with pytest.raises(ValueError, match="batch_size"):
+        bad.load_state_dict(state)
+    bad = NDArrayIter(X, Y, batch_size=BATCH, last_batch_handle="discard")
+    with pytest.raises(ValueError, match="last_batch_handle"):
+        bad.load_state_dict(state)
+
+
+def test_resume_replay_honors_timeout(tmp_path):
+    """A hung read during the resume replay surfaces as
+    DataTimeoutError (plus a RuntimeWarning naming the abandoned
+    replay thread) instead of blocking restore_checkpoint forever;
+    the abandoned thread mutates no cursor once its hung read
+    returns, so a retry after it drains resumes bit-identically."""
+    idx, rec = _write_records(tmp_path)
+    it = ResilientIter(_RecordIter(idx, rec))
+    it.next(); it.next()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)  # no protocol
+        state = it.state_dict()
+    it.close()
+    it2 = ResilientIter(_RecordIter(idx, rec), timeout=0.05)
+    # let the construction-time prefetch fill its queue: _RecordIter's
+    # per-read catch_warnings in the worker thread would otherwise race
+    # the recorder installed below (catch_warnings is not thread-safe)
+    time.sleep(0.3)
+    with fi.slow_reads(1.0, count=1):  # first replay pull hangs
+        with warnings.catch_warnings(record=True) as ws:
+            warnings.simplefilter("always")
+            with pytest.raises(DataTimeoutError, match="resume replay"):
+                it2.load_state_dict(state)
+    assert any("replay abandoned" in str(w.message) for w in ws)
+    time.sleep(1.2)  # let the abandoned replay thread wake and exit
+    assert it2._consumed == 0  # the zombie mutated nothing on wake
+    it2.load_state_dict(state)  # retry after the drain: clean resume
+    np.testing.assert_array_equal(it2.next(), np.full(4, 2, np.float32))
+    it2.close()
+
+
+def test_resume_delegates_fast_forward_to_inner(tmp_path):
+    """On a clean epoch (no skips) the resume hands the consumed count
+    to the inner iterator's OWN load_state_dict fast-forward
+    (ImageRecordIter replays RNG draws but skips reads/decodes) instead
+    of re-pulling every pre-crash batch through the full pipeline —
+    and the resumed stream still matches bit-identically."""
+    from incubator_mxnet_tpu.io import ImageRecordIter
+
+    rng = np.random.RandomState(0)
+    rec = str(tmp_path / "img.rec")
+    idx = str(tmp_path / "img.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(24):
+        img = rng.randint(0, 255, (10, 10, 3), np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 4), i, 0), img, img_fmt=".npy"))
+    w.close()
+
+    def make(seed):
+        return ImageRecordIter(
+            path_imgrec=rec, path_imgidx=idx, data_shape=(3, 8, 8),
+            batch_size=4, shuffle=True, rand_crop=True, rand_mirror=True,
+            preprocess_threads=2, prefetch_buffer=2, seed=seed)
+
+    ref = ResilientIter(make(5))
+    seq = [b.data[0].asnumpy() for b in ref]
+    ref.close()
+
+    it = ResilientIter(make(5))
+    got = [it.next().data[0].asnumpy() for _ in range(2)]
+    state = it.state_dict()
+    it.close()
+
+    inner2 = make(17)
+    loaded = {}
+    orig_load = inner2.load_state_dict
+    inner2.load_state_dict = lambda st: (loaded.update(st), orig_load(st))
+    it2 = ResilientIter(inner2)
+    it2.load_state_dict(state)
+    assert loaded.get("batch") == 2, \
+        "resume replayed through the pipeline instead of delegating"
+    got += [b.data[0].asnumpy() for b in it2]
+    it2.close()
+    assert len(got) == len(seq)
+    assert all(np.array_equal(a, b) for a, b in zip(seq, got))
+
+
+def test_csv_iter_state_roundtrip(tmp_path):
+    """CSVIter delegates the state protocol to its inner NDArrayIter."""
+    from incubator_mxnet_tpu.io.io import CSVIter
+
+    X, Y = _data()
+    dcsv, lcsv = str(tmp_path / "d.csv"), str(tmp_path / "l.csv")
+    np.savetxt(dcsv, X, delimiter=",")
+    np.savetxt(lcsv, Y, delimiter=",")
+
+    def make():
+        return CSVIter(dcsv, (FEAT,), label_csv=lcsv, label_shape=(1,),
+                       batch_size=BATCH)
+
+    ref = make()
+    ref.next()
+    expect = [b.data[0].asnumpy() for b in ref]
+    it = make()
+    it.next()
+    state = it.state_dict()
+    json.dumps(state)
+    it2 = make()
+    it2.load_state_dict(state)
+    got = [b.data[0].asnumpy() for b in it2]
+    assert len(got) == len(expect)
+    assert all(np.array_equal(a, b) for a, b in zip(expect, got))
+
+
+def test_close_join_timeout_warns_stale_worker():
+    """close() that cannot join the worker (still blocked inside the
+    wrapped iterator's read) warns instead of silently leaving a stale
+    thread racing the inner iterator's cursor."""
+    with fi.slow_reads(0.8):
+        it = _make_iter(1, timeout=10)
+        time.sleep(0.1)  # let the worker enter the slow read
+        with pytest.warns(RuntimeWarning, match="did not exit"):
+            it.close(join_timeout=0.05)
+    time.sleep(0.9)  # drain the stalled worker before other tests
