@@ -138,9 +138,12 @@ def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
     log("shape_init (abstract deferred init) %.1fs" % (time.time() - t))
 
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    # cost="report": the graftcost roofline prediction rides the same
+    # pre-compile trace and lands in the JSON line next to the measured
+    # number, so every BENCH round logs predicted-vs-measured drift
     step = make_train_step(net, loss_fn, optimizer="sgd", learning_rate=0.1,
                            momentum=0.9, wd=1e-4,
-                           compute_dtype=compute_dtype)
+                           compute_dtype=compute_dtype, cost="report")
 
     if data == "recordio":
         # recordio feeds raw uint8 batches (ImageRecordUInt8Iter) — compile
@@ -169,6 +172,25 @@ def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
     loss.wait_to_read()
     log("warmup step %.2fs (loss=%.3f)" % (time.time() - t,
                                            float(loss.asscalar())))
+
+    # graftcost prediction (computed at trace time by cost="report")
+    pred = {}
+    try:
+        rep = step.cost_report
+        if rep is not None:
+            rf = rep.roofline()
+            pred = {"pred_bytes_per_img": round(rep.hbm_bytes / batch_size),
+                    "pred_hbm_gib_step": round(rep.hbm_bytes / 2**30, 2),
+                    "pred_ms_per_step": round(1e3 * rf["step_s"], 2),
+                    "pred_img_per_sec": round(batch_size / rf["step_s"], 1)
+                    if rf["step_s"] else 0.0,
+                    "pred_peak_mb": round(rep.peak_bytes / 1e6, 1)}
+            log("graftcost: %.1f GiB/step HBM -> >= %.1f ms/step "
+                "(%.0f img/s roofline), peak %.0f MB"
+                % (rep.hbm_bytes / 2**30, 1e3 * rf["step_s"],
+                   pred["pred_img_per_sec"], rep.peak_bytes / 1e6))
+    except Exception as e:  # noqa: BLE001 — prediction must never kill bench
+        log("graftcost prediction unavailable: %r" % e)
 
     batch_src = None
     if data == "recordio":
@@ -208,16 +230,17 @@ def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
         best = max(best, img_s)
         log("chunk %d: %d iters in %.3fs -> %.1f img/s (step %.1f ms)"
             % (c, chunk_iters, dt, img_s, 1e3 * dt / chunk_iters))
-        emit(metric, best, "img/s", BASELINE_IMG_S,
-             {"batch": batch_size, "dtype": compute_dtype, "data": data,
-              "s2d_stem": bool(s2d_stem),
-              "bn": ("ghost%d" % ghost_bn) if ghost_bn else "batch",
-              "step_ms": round(1e3 / (best / batch_size), 2),
-              "mfu_bf16": round(best * TRAIN_FLOPS_PER_IMG /
-                                V5E_PEAK_FLOPS, 4),
-              "trace_s": round(times["trace"], 1),
-              "compile_s": round(times["compile"], 1),
-              "chunks_done": c + 1})
+        extra = {"batch": batch_size, "dtype": compute_dtype, "data": data,
+                 "s2d_stem": bool(s2d_stem),
+                 "bn": ("ghost%d" % ghost_bn) if ghost_bn else "batch",
+                 "step_ms": round(1e3 / (best / batch_size), 2),
+                 "mfu_bf16": round(best * TRAIN_FLOPS_PER_IMG /
+                                   V5E_PEAK_FLOPS, 4),
+                 "trace_s": round(times["trace"], 1),
+                 "compile_s": round(times["compile"], 1),
+                 "chunks_done": c + 1}
+        extra.update(pred)
+        emit(metric, best, "img/s", BASELINE_IMG_S, extra)
     return best
 
 

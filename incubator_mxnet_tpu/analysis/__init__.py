@@ -19,8 +19,20 @@ semantics):
   iterator-state pattern (GL008, a warning: a loop consuming a stateful
   data iterator that checkpoints without ``data_iter=`` replays data on
   resume) and gate tier-1 CI.
+- **graftcost (trace-time cost model)**: :mod:`.cost_model` predicts
+  per-category FLOPs / fusion-aware HBM bytes / peak live-buffer memory
+  / per-axis comm volume from the jaxpr alone and checks them as the
+  GL2xx family — GL201 (over ``hbm_budget``: the eager infeasibility
+  gate, raised before any compile), GL202 (multi-pass re-reads, the BN
+  pattern), GL203 (comm-dominated roofline), GL204 (remat/donation
+  config without a memory win).  Wired into every fused step via
+  ``make_train_step(..., cost="report"|"check", hbm_budget=)`` /
+  ``MXTPU_COST``, plus the ``tools/graftcost.py`` CLI.
 """
-from .diagnostics import CODES, Diagnostic, LintError, LintReport, Severity
+from .cost_model import (DEVICE_SPECS, CostReport, DeviceSpec,
+                         analyze_jaxpr, analyze_traceable, check_cost)
+from .diagnostics import (CODES, Diagnostic, LintError, LintReport,
+                          Severity, code_matches)
 from .source_lint import (check_checkpoint_without_iter_state, lint_paths,
                           lint_source)
 from .trace_lint import (check_legacy_checkpoint_path,
@@ -30,10 +42,13 @@ from .trace_lint import (check_legacy_checkpoint_path,
                          validate_permutation)
 
 __all__ = [
-    "CODES", "Diagnostic", "LintError", "LintReport", "Severity",
-    "check_checkpoint_without_iter_state", "check_legacy_checkpoint_path",
+    "CODES", "CostReport", "DEVICE_SPECS", "DeviceSpec", "Diagnostic",
+    "LintError", "LintReport", "Severity", "analyze_jaxpr",
+    "analyze_traceable",
+    "check_checkpoint_without_iter_state", "check_cost",
+    "check_legacy_checkpoint_path",
     "check_partition_spec", "check_permutation",
-    "check_zero_state_shardings", "lint_jaxpr",
+    "check_zero_state_shardings", "code_matches", "lint_jaxpr",
     "lint_paths", "lint_source", "lint_traceable", "recompile_probe",
     "validate_permutation",
 ]

@@ -11,9 +11,11 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass, field
+from fnmatch import fnmatchcase
 from typing import Iterable, List, Optional, Tuple
 
-__all__ = ["Severity", "Diagnostic", "LintReport", "LintError", "CODES"]
+__all__ = ["Severity", "Diagnostic", "LintReport", "LintError", "CODES",
+           "code_matches"]
 
 
 class Severity(enum.IntEnum):
@@ -57,6 +59,21 @@ CODES = {
               "save_checkpoint/attach_checkpoint called from a loop "
               "consuming a stateful data iterator without data_iter= — "
               "a resumed run replays the epoch from batch 0"),
+    "GL201": (Severity.ERROR,
+              "graftcost: predicted peak live-buffer memory exceeds the "
+              "HBM budget — the program is infeasible at this config; "
+              "rejected at trace time, before any compile"),
+    "GL202": (Severity.WARNING,
+              "graftcost: multi-pass re-read of a large intermediate "
+              "(a materialized tensor read by 2+ fusion groups — the "
+              "BN stats/normalize pattern; a fusion opportunity)"),
+    "GL203": (Severity.WARNING,
+              "graftcost: comm-dominated step — per-axis collective "
+              "wire time exceeds the compute/HBM roofline time"),
+    "GL204": (Severity.WARNING,
+              "graftcost: pipeline_remat/donation config that raises "
+              "peak memory (or pays recompute bytes) without a "
+              "matching memory win"),
     "GL101": (Severity.ERROR,
               "shard_map imported from jax directly instead of "
               "parallel/mesh.py (the one version-compat home)"),
@@ -67,6 +84,13 @@ CODES = {
               "PartitionSpec built from an f-string or untyped integer "
               "rank — axis names must be static string literals"),
 }
+
+
+def code_matches(code: str, pattern: str) -> bool:
+    """True when ``pattern`` selects ``code``.  Patterns are exact codes
+    (``GL002``) or ``fnmatch``-style prefix globs (``GL2*``, ``GL?03``)
+    — the grammar ``--select``/``--ignore``/``lint_suppress`` share."""
+    return code == pattern or fnmatchcase(code, pattern)
 
 
 @dataclass(frozen=True)
@@ -86,6 +110,14 @@ class Diagnostic:
             s += "\n    hint: %s" % self.hint
         return s
 
+    def to_dict(self) -> dict:
+        """The stable JSON schema (``tools/graftlint.py --format=json``,
+        ``CostReport.diagnostics``): severity serialized by NAME so
+        consumers never depend on enum integer values."""
+        return {"code": self.code, "severity": str(self.severity),
+                "message": self.message, "where": self.where,
+                "hint": self.hint}
+
 
 class LintReport:
     """Ordered collection of diagnostics with severity accessors."""
@@ -99,7 +131,7 @@ class LintReport:
             self.add(d)
 
     def add(self, diag: Diagnostic):
-        if diag.code in self._suppress:
+        if any(code_matches(diag.code, pat) for pat in self._suppress):
             self.suppressed.append(diag)
         else:
             self.diagnostics.append(diag)

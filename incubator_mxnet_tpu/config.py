@@ -167,6 +167,14 @@ _decl("MXTPU_LINT", str, "warn",
       "before the first compile, 'warn' (default) warns, 'off' skips "
       "the lint trace.  Overridden per step by make_train_step(lint=).")
 
+_decl("MXTPU_COST", str, "off",
+      "graftcost trace-time cost model for fused train steps "
+      "(analysis/cost_model.py, docs/ANALYSIS.md GL2xx): 'report' "
+      "computes the CostReport (step.cost_report) on the pre-compile "
+      "trace, 'check' additionally raises on GL201 (predicted peak "
+      "memory over hbm_budget) before any compile, 'off' (default) "
+      "skips the walk.  Overridden per step by make_train_step(cost=).")
+
 _decl("MXNET_BACKWARD_DO_MIRROR", str, "",
       "Gradient recompute (memory mirror, src/nnvm/gradient.cc): when "
       "truthy, every HybridBlock without a remat-active ancestor wraps its "

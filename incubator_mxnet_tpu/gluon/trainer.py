@@ -141,7 +141,8 @@ class Trainer:
                         pipeline_axis="pp", pipeline_remat=False,
                         zero=0, multi_precision=None,
                         lint=None, lint_suppress=(),
-                        nonfinite=None, loss_scale=None):
+                        nonfinite=None, loss_scale=None, cost=None,
+                        hbm_budget=None, cost_device="tpu-v5e"):
         """Build a fused XLA train step from this Trainer's optimizer.
 
         The reference's Trainer.step chain (forward → backward → kvstore
@@ -173,6 +174,12 @@ class Trainer:
         the fused step — in-program non-finite step containment and the
         functional (dynamic) loss scaler; see
         ``parallel.make_train_step`` and ``docs/RESILIENCE.md``.
+
+        ``cost``/``hbm_budget``/``cost_device`` switch on the graftcost
+        trace-time cost model (``"report"`` fills ``step.cost_report``;
+        ``"check"`` rejects a config whose predicted peak memory
+        exceeds ``hbm_budget`` — GL201 — before any compile); see
+        ``parallel.make_train_step`` and ``docs/ANALYSIS.md``.
 
         The returned TrainStep owns its optimizer state; mixing its calls
         with eager ``Trainer.step`` updates on the same params is
@@ -267,7 +274,8 @@ class Trainer:
                          num_micro=num_micro, pipeline_axis=pipeline_axis,
                          pipeline_remat=pipeline_remat, zero=zero, lint=lint,
                          lint_suppress=lint_suppress, nonfinite=nonfinite,
-                         loss_scale=loss_scale)
+                         loss_scale=loss_scale, cost=cost,
+                         hbm_budget=hbm_budget, cost_device=cost_device)
         # the guard tracks EVERY live zero=1 step built from this
         # Trainer (weakrefs: the guard must not pin params/optimizer
         # state alive, and dies with its step) — the legacy host-side

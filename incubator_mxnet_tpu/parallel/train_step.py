@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Tuple
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -242,7 +244,9 @@ class TrainStep:
                  lint: Optional[str] = None,
                  lint_suppress: Tuple[str, ...] = (),
                  nonfinite: Optional[str] = None,
-                 loss_scale=None):
+                 loss_scale=None, cost: Optional[str] = None,
+                 hbm_budget: Optional[float] = None,
+                 cost_device: str = "tpu-v5e"):
         self.net = net
         self.loss_fn = loss_fn
         self.opt = opt
@@ -339,6 +343,31 @@ class TrainStep:
         self.lint = lint
         self.lint_suppress = tuple(lint_suppress)
         self._linted = False
+        # graftcost rides the same pre-compile trace (analysis/
+        # cost_model.py, docs/ANALYSIS.md): "report" computes the
+        # CostReport (surfaced as step.cost_report), "check" additionally
+        # raises on GL2xx errors — GL201 rejects an over-budget config
+        # at trace time, before any compile.  Resolution order: explicit
+        # arg > MXTPU_COST env > "off".
+        if cost is None:
+            from .. import config as _cfg
+
+            cost = str(_cfg.get("MXTPU_COST", "off") or "off").lower()
+        if cost not in ("off", "report", "check"):
+            raise ValueError("cost must be 'off', 'report' or 'check', "
+                             "got %r" % (cost,))
+        self.cost = cost
+        if hbm_budget is not None and float(hbm_budget) <= 0:
+            raise ValueError("hbm_budget must be positive bytes, got %r"
+                             % (hbm_budget,))
+        self.hbm_budget = float(hbm_budget) if hbm_budget else None
+        from ..analysis.cost_model import DEVICE_SPECS as _SPECS
+
+        if cost_device not in _SPECS:
+            raise ValueError("unknown cost_device %r (registry: %s)"
+                             % (cost_device, sorted(_SPECS)))
+        self.cost_device = cost_device
+        self.cost_report = None  # set by the cost pass (cost != "off")
         if pipeline_stages is not None:
             if mesh is None:
                 raise ValueError("pipeline_stages requires a mesh with a "
@@ -881,7 +910,7 @@ class TrainStep:
         walks ``self._jit.trace(...)`` — the very trace jit caches for
         the first call — so it costs one jaxpr walk, not an extra
         trace; steady-state steps pay nothing."""
-        if self.lint == "off" or self._linted:
+        if self._linted or (self.lint == "off" and self.cost == "off"):
             return
         self._lint_trace(self._jit, tuple(example_args))
 
@@ -897,11 +926,17 @@ class TrainStep:
         from ..analysis.trace_lint import capture_effect_diagnostics
 
         lint_here = self.lint != "off" and not self._linted
+        cost_here = self.cost != "off" and not self._linted
         cm = capture_effect_diagnostics() if lint_here else nullcontext([])
         with cm as effects:
             traced = jit_obj.trace(*args)
         if lint_here:
             self._finish_lint(traced.jaxpr, effects, args)
+        if cost_here:
+            # same trace, one more walk: the cost model's GL201 gate
+            # fires HERE — before lower/compile ever run
+            self._finish_cost(traced.jaxpr, args)
+        if lint_here or cost_here:
             self._linted = True
         return traced
 
@@ -944,6 +979,164 @@ class TrainStep:
             _warnings.warn("graftlint: fused train step has findings\n"
                            + report.format(Severity.WARNING),
                            stacklevel=4)
+
+    # ------------------------------------------------------------------
+    # graftcost (analysis/cost_model.py, docs/ANALYSIS.md GL2xx)
+    def _cost_shard_factors(self, example_args):
+        """Per-flat-invar shard divisors congruent with the step's
+        argument pytree — the resident-bytes model's view of the
+        in_shardings (a ``P('dp')`` ZeRO state leaf on dp=8 costs 1/8
+        per device)."""
+        if self.mesh is None or self._shardings is None:
+            return None
+
+        from ..analysis.cost_model import shard_factor
+
+        p_sh, aux_sh, state_sh, batch_sh, repl = self._shardings
+        sh_args = (list(p_sh), list(aux_sh), state_sh, batch_sh, batch_sh,
+                   repl, repl, (repl, repl, repl))
+        is_sh = lambda s: hasattr(s, "spec") or hasattr(s, "_partitions")  # noqa: E731
+        flat_sh = jax.tree_util.tree_leaves(sh_args, is_leaf=is_sh)
+        flat_args = jax.tree_util.tree_leaves(tuple(example_args))
+        if len(flat_sh) != len(flat_args):
+            return None  # structure drifted; fall back to unsharded bytes
+        return [shard_factor(s) for s in flat_sh]
+
+    def _cost_analyze(self, closed_jaxpr, example_args, device=None,
+                      hbm_budget=None):
+        """One CostReport for the traced step program, with this step's
+        donation spec, shardings and knob metadata applied."""
+        from ..analysis.cost_model import analyze_jaxpr, shard_factor
+        from ..analysis.trace_lint import donated_leaf_indices
+
+        device = device or self.cost_device
+        if hbm_budget is None:
+            hbm_budget = self.hbm_budget
+        donated = donated_leaf_indices(tuple(example_args),
+                                       self._donate_argnums)
+        factors = self._cost_shard_factors(example_args)
+        axis_sizes, n_dev = None, 1
+        if self.mesh is not None:
+            axis_sizes = {k: int(v) for k, v in dict(self.mesh.shape).items()}
+            n_dev = int(self.mesh.size)
+        # optimizer-state bytes: exact, from the state leaves and their
+        # placements (the ZeRO-1 1/N figures test_zero_sharding measures)
+        is_sh = lambda s: hasattr(s, "spec") or hasattr(s, "_partitions")  # noqa: E731
+        state_leaves = jax.tree_util.tree_leaves(self._opt_state)
+        opt_total = float(sum(
+            int(np.prod(v.shape)) * np.dtype(v.dtype).itemsize
+            for v in state_leaves))
+        if self.mesh is not None and self._shardings is not None:
+            sh_leaves = jax.tree_util.tree_leaves(self._shardings[2],
+                                                  is_leaf=is_sh)
+            opt_dev = float(sum(
+                int(np.prod(v.shape)) * np.dtype(v.dtype).itemsize
+                / shard_factor(s)
+                for v, s in zip(state_leaves, sh_leaves))) \
+                if len(sh_leaves) == len(state_leaves) else opt_total
+        else:
+            opt_dev = opt_total
+        p_bytes = float(sum(
+            int(np.prod(p._data._data.shape))
+            * np.dtype(p._data._data.dtype).itemsize
+            for p in (self._gp or []) + (self._aux or [])))
+        report = analyze_jaxpr(
+            closed_jaxpr, axis_sizes=axis_sizes, donated_leaves=donated,
+            invar_shard_factors=factors, device=device, n_devices=n_dev,
+            hbm_budget=hbm_budget,
+            meta={"zero": self.zero,
+                  "pipeline_stages": self.pipeline_stages,
+                  "num_micro": self.num_micro,
+                  "pipeline_remat": bool(self.pipeline_remat),
+                  "donate": bool(self._donate),
+                  "optimizer": self.opt.name,
+                  "multi_precision": bool(self.opt.multi_precision),
+                  "batch_axis": self.batch_axis})
+        report.opt_state_bytes = opt_total
+        report.opt_state_bytes_per_device = opt_dev
+        report.param_bytes = p_bytes
+        report.diagnostics.extend(self._cost_config_diags(report))
+        return report
+
+    def _cost_config_diags(self, report):
+        """GL204: knob settings that pay memory or recompute for
+        nothing — donation off (peak raised by a full param/state copy,
+        zero traffic saved), or pipeline_remat recompute while peak sits
+        far under the budget."""
+        from ..analysis import Diagnostic, Severity as Sev
+
+        diags = []
+        if not self._donate:
+            diags.append(Diagnostic(
+                "GL204", Sev.WARNING,
+                "donate=False: peak memory carries a second full copy of "
+                "params and optimizer state (%.1f MB) and saves zero HBM "
+                "traffic in exchange"
+                % ((report.param_bytes + report.opt_state_bytes_per_device)
+                   / 1e6),
+                where="TrainStep(donate=False)",
+                hint="leave donation on unless you must re-read the old "
+                     "params after the step"))
+        if self.pipeline_remat:
+            cap = report.hbm_budget or report.spec().hbm_bytes
+            if report.peak_bytes < 0.5 * cap:
+                diags.append(Diagnostic(
+                    "GL204", Sev.WARNING,
+                    "pipeline_remat=True pays recompute HBM traffic while "
+                    "predicted peak memory (%.1f MB) sits under half the "
+                    "budget (%.1f MB) — the stash it avoids would have fit"
+                    % (report.peak_bytes / 1e6, cap / 1e6),
+                    where="TrainStep(pipeline_remat=True)",
+                    hint="drop pipeline_remat (or lower hbm_budget if the "
+                         "headroom is intentional)"))
+        return diags
+
+    def _finish_cost(self, closed_jaxpr, example_args):
+        """The in-step cost pass: store the report; ``cost=\"check\"``
+        raises :class:`~..analysis.LintError` on error-severity GL2xx
+        findings (GL201 over-budget) BEFORE lower/compile, and warns the
+        advisory ones.  ``cost=\"report\"`` is silent — read
+        ``step.cost_report``."""
+        from ..analysis import LintReport, Severity
+
+        report = self._cost_analyze(closed_jaxpr, example_args)
+        rep = LintReport(suppress=self.lint_suppress)
+        rep.extend(report.diagnostics)
+        report.diagnostics = list(rep.diagnostics)
+        self.cost_report = report
+        if self.cost == "check":
+            rep.raise_if_errors()
+            if rep.warnings:
+                import warnings as _warnings
+
+                _warnings.warn("graftcost: fused train step has findings\n"
+                               + rep.format(Severity.WARNING),
+                               stacklevel=4)
+
+    def analyze_cost(self, x, y, device=None, hbm_budget=None):
+        """Cost the step for the given batch WITHOUT compiling or
+        running it: traces abstractly (``jit.trace`` on avals — the
+        trace the first real call would reuse) and returns the
+        :class:`~..analysis.cost_model.CostReport`.  ``x``/``y`` may be
+        arrays, NDArrays or ``jax.ShapeDtypeStruct``s."""
+        self._ensure_built()
+
+        def aval(a):
+            if isinstance(a, jax.ShapeDtypeStruct):
+                return a
+            if isinstance(a, NDArray):
+                a = a._data
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        pv = [aval(p._data._data) for p in self._gp]
+        av = [aval(p._data._data) for p in self._aux]
+        sv = jax.tree_util.tree_map(aval, self._opt_state)
+        args = (pv, av, sv, aval(x), aval(y), aval(self._key_dev),
+                aval(self._step_dev),
+                tuple(aval(v) for v in self._scaler_dev))
+        traced = self._jit.trace(*args)
+        return self._cost_analyze(traced.jaxpr, args, device=device,
+                                  hbm_budget=hbm_budget)
 
     # ------------------------------------------------------------------
     def _ensure_built(self):
@@ -1161,7 +1354,7 @@ class TrainStep:
                 xs = jax.device_put(xs, stack_sh)
                 ys = jax.device_put(ys, stack_sh)
         k = xs.shape[0]
-        if self.lint != "off" and not self._linted:
+        if not self._linted and (self.lint != "off" or self.cost != "off"):
             # lint rides the multi-step program's OWN trace (shared with
             # the compile below via jit's trace cache) — the scan body
             # is the step, so the walker sees the same hazards
@@ -1474,7 +1667,8 @@ def make_train_step(net, loss_fn, optimizer="sgd", mesh=None, batch_axis="dp",
                     param_shardings=None, compute_dtype=None, donate=True,
                     pipeline_stages=None, num_micro=1, pipeline_axis="pp",
                     pipeline_remat=False, zero=0, lint=None, lint_suppress=(),
-                    nonfinite=None, loss_scale=None,
+                    nonfinite=None, loss_scale=None, cost=None,
+                    hbm_budget=None, cost_device="tpu-v5e",
                     **opt_kwargs) -> TrainStep:
     """Build the fused train step (fwd+bwd+optimizer in one XLA program).
 
@@ -1506,7 +1700,22 @@ def make_train_step(net, loss_fn, optimizer="sgd", mesh=None, batch_axis="dp",
     graftlint Level 1 over the traced step before its first compile —
     ``"error"`` raises :class:`~..analysis.LintError` on error-severity
     findings, ``"warn"`` emits a warning, ``"off"`` disables.
-    ``lint_suppress`` drops the given ``GLxxx`` codes (docs/ANALYSIS.md).
+    ``lint_suppress`` drops the given ``GLxxx`` codes, or ``GL2*``-style
+    prefix globs (docs/ANALYSIS.md).
+
+    ``cost`` (default: env ``MXTPU_COST``, else ``"off"``) runs the
+    graftcost trace-time cost model over the same pre-compile trace
+    (``analysis/cost_model.py``): predicted FLOPs / fusion-aware HBM
+    bytes / peak live-buffer memory / per-axis comm volume, surfaced as
+    ``step.cost_report`` (a JSON-serializable
+    :class:`~..analysis.cost_model.CostReport`).  ``"check"``
+    additionally enforces the GL2xx diagnostics: GL201 — predicted peak
+    memory over ``hbm_budget`` (bytes) — raises *at trace time, before
+    any compile*; GL202/GL203/GL204 (multi-pass re-reads, comm-dominated
+    roofline, remat/donation config without a memory win) warn.
+    ``cost_device`` picks the roofline denominators from the device-spec
+    registry (``tpu-v5e`` default; ``cpu-proxy`` for relative numbers
+    off-chip).
 
     ``nonfinite`` contains bad steps INSIDE the program: ``"skip"``
     leaves params, aux state, optimizer state and the step counter
@@ -1533,4 +1742,5 @@ def make_train_step(net, loss_fn, optimizer="sgd", mesh=None, batch_axis="dp",
                      num_micro=num_micro, pipeline_axis=pipeline_axis,
                      pipeline_remat=pipeline_remat, zero=zero, lint=lint,
                      lint_suppress=lint_suppress, nonfinite=nonfinite,
-                     loss_scale=loss_scale)
+                     loss_scale=loss_scale, cost=cost, hbm_budget=hbm_budget,
+                     cost_device=cost_device)

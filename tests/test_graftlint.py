@@ -388,3 +388,112 @@ def test_cli_reports_with_location(tmp_path, capsys):
     out = capsys.readouterr().out
     assert rc == 1
     assert "bad.py:2" in out and "GL101" in out
+
+
+# ---------------------------------------------------------------------------
+# --format=json + prefix globs (stable machine schema for CI/autotuner)
+# ---------------------------------------------------------------------------
+
+def _tools_import(name):
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+def test_cli_json_format_stable_schema(tmp_path, capsys):
+    import json
+
+    graftlint = _tools_import("graftlint")
+    bad = tmp_path / "bad.py"
+    bad.write_text("from jax import shard_map\n"
+                   "from jax.sharding import PartitionSpec as P\n"
+                   "s = P(0)\n")  # GL101 + GL103
+    rc = graftlint.main([str(bad), "--format", "json"])
+    obj = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert obj["version"] == 1 and obj["tool"] == "graftlint"
+    assert obj["summary"]["errors"] == 2 and obj["summary"]["total"] == 2
+    codes = sorted(f["code"] for f in obj["findings"])
+    assert codes == ["GL101", "GL103"]
+    for f in obj["findings"]:
+        # the stable Diagnostic schema: severity serialized by NAME
+        assert set(f) == {"code", "severity", "message", "where", "hint"}
+        assert f["severity"] == "error"
+        assert "bad.py" in f["where"]
+    # clean run: empty findings, exit 0, still valid JSON
+    rc = graftlint.main([os.path.join(ROOT, "incubator_mxnet_tpu",
+                                      "analysis"), "--format", "json"])
+    obj = json.loads(capsys.readouterr().out)
+    assert rc == 0 and obj["findings"] == []
+
+
+def test_cli_select_ignore_prefix_globs(tmp_path):
+    graftlint = _tools_import("graftlint")
+    bad = tmp_path / "bad.py"
+    bad.write_text("from jax import shard_map\n"
+                   "from jax.sharding import PartitionSpec as P\n"
+                   "s = P(0)\n")  # GL101 + GL103
+    # GL1* selects both -> still errors
+    assert graftlint.main([str(bad), "--select", "GL1*"]) == 1
+    # GL2* selects neither -> clean
+    assert graftlint.main([str(bad), "--select", "GL2*"]) == 0
+    # ignoring the whole GL1xx family silences the gate
+    assert graftlint.main([str(bad), "--ignore", "GL1*"]) == 0
+    # --suppress alias takes globs too
+    assert graftlint.main([str(bad), "--suppress", "GL10*"]) == 0
+
+
+def test_lint_suppress_accepts_globs():
+    """make_train_step(lint_suppress=("GL2*",)) and LintReport share
+    the same glob grammar as the CLI filters."""
+    from incubator_mxnet_tpu.analysis import (Diagnostic, LintReport,
+                                              Severity as Sev)
+
+    rep = LintReport(suppress=("GL00?", "GL2*"))
+    rep.add(Diagnostic("GL002", Sev.ERROR, "a"))
+    rep.add(Diagnostic("GL203", Sev.WARNING, "b"))
+    rep.add(Diagnostic("GL101", Sev.ERROR, "c"))
+    assert [d.code for d in rep] == ["GL101"]
+    assert sorted(d.code for d in rep.suppressed) == ["GL002", "GL203"]
+
+
+# ---------------------------------------------------------------------------
+# graftcost CLI gate (CI: feasible -> 0, infeasible budget -> 1, JSON
+# parses against the schema)
+# ---------------------------------------------------------------------------
+
+def test_graftcost_cli_gate_and_json(capsys):
+    import json
+
+    graftcost = _tools_import("graftcost")
+    # feasible: the dense test net fits any real device -> exit 0
+    assert graftcost.main(["--model", "dense", "--batch", "16"]) == 0
+    capsys.readouterr()
+    # infeasible --hbm-budget: GL201 -> exit 1
+    assert graftcost.main(["--model", "dense", "--batch", "16",
+                           "--hbm-budget", "1KiB"]) == 1
+    out = capsys.readouterr().out
+    assert "GL201" in out
+    # JSON output parses against the CostReport schema
+    assert graftcost.main(["--model", "dense", "--batch", "16",
+                           "--format", "json"]) == 0
+    obj = json.loads(capsys.readouterr().out)
+    assert obj["version"] == 1
+    for key in ("device", "categories", "totals", "peak_bytes",
+                "opt_state_bytes", "comm", "roofline", "diagnostics"):
+        assert key in obj, key
+    assert obj["totals"]["hbm_bytes"] > 0
+    assert obj["categories"]["conv"]["flops"] > 0
+    assert set(obj["roofline"]) == {"compute_s", "hbm_s", "comm_s",
+                                    "step_s"}
+    # diagnostics ride the same stable Diagnostic schema
+    assert graftcost.main(["--model", "dense", "--batch", "16",
+                           "--hbm-budget", "1KiB", "--format",
+                           "json"]) == 1
+    obj = json.loads(capsys.readouterr().out)
+    codes = [d["code"] for d in obj["diagnostics"]]
+    assert "GL201" in codes
+    for d in obj["diagnostics"]:
+        assert set(d) == {"code", "severity", "message", "where", "hint"}

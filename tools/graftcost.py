@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""graftcost CLI — trace-time cost report for a model + mesh + knob set.
+
+Builds the requested model, constructs the fused train step with the
+given parallelism knobs, and costs its traced program WITHOUT compiling
+or running a step (``analysis/cost_model.py``; catalog and field
+reference in docs/ANALYSIS.md): per-category FLOPs / fusion-aware HBM
+bytes, peak live-buffer memory (donation-, remat- and ZeRO-sharding-
+aware), per-mesh-axis collective volume, and the roofline step-time
+estimate for a registry device (``tpu-v5e`` default, ``cpu-proxy`` for
+off-chip relative numbers).
+
+Exit status 1 when any error-severity GL2xx diagnostic fires — with
+``--hbm-budget`` this is the eager infeasibility gate (GL201) the
+autotuner (ROADMAP item 4) uses to reject configs before paying a
+compile.
+
+Usage::
+
+    python tools/graftcost.py --model dense --batch 16
+    python tools/graftcost.py --model resnet50 --batch 256 --compute-dtype
+        bfloat16 --format json
+    python tools/graftcost.py --model dense --mesh dp=8 --zero 1
+        --hbm-budget 16GiB
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+def _parse_mesh(spec):
+    """'dp=8' / 'dp=2,pp=4' -> ordered dict of axis sizes."""
+    axes = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, size = part.partition("=")
+        if not size:
+            raise SystemExit("--mesh entries are axis=size, got %r" % part)
+        axes[name.strip()] = int(size)
+    return axes
+
+
+def _parse_bytes(s):
+    """'16GiB' / '8GB' / '1048576' -> bytes."""
+    if s is None:
+        return None
+    s = str(s).strip()
+    units = {"kib": 2**10, "mib": 2**20, "gib": 2**30, "tib": 2**40,
+             "kb": 10**3, "mb": 10**6, "gb": 10**9, "tb": 10**12,
+             "b": 1}
+    low = s.lower()
+    for u in sorted(units, key=len, reverse=True):
+        if low.endswith(u):
+            return float(low[: -len(u)]) * units[u]
+    return float(s)
+
+
+def _build_model(name, feat=16, layers=4):
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.gluon import nn
+
+    mx.random.seed(0)
+    if name == "dense":
+        # the tests/test_zero_sharding.py net: 4 x Dense(16)
+        net = nn.HybridSequential()
+        for _ in range(layers):
+            net.add(nn.Dense(feat, activation="tanh"))
+        net.initialize(init=mx.init.Xavier())
+        net(nd.ones((2, feat)))
+        return net, (feat,), "dense"
+    if name == "conv-bn":
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(16, 3, padding=1, in_channels=3))
+        net.add(nn.BatchNorm())
+        net.add(nn.Activation("relu"))
+        net.add(nn.Conv2D(16, 3, padding=1, in_channels=16))
+        net.add(nn.BatchNorm())
+        net.add(nn.Activation("relu"))
+        net.initialize(init=mx.init.Xavier())
+        net(nd.ones((2, 3, 16, 16)))
+        return net, (3, 16, 16), "conv"
+    if name == "resnet50":
+        from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+        net = vision.resnet50_v1(classes=1000)
+        net.initialize(init=mx.init.Zero())
+        net.shape_init((1, 3, 224, 224))
+        return net, (3, 224, 224), "conv"
+    raise SystemExit("unknown --model %r (dense, conv-bn, resnet50)" % name)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftcost", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--model", default="dense",
+                    choices=["dense", "conv-bn", "resnet50"])
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--mesh", default="",
+                    help="mesh axes, e.g. dp=8 or dp=2,pp=4 (devices are "
+                         "CPU-forged off-chip)")
+    ap.add_argument("--optimizer", default="sgd",
+                    choices=["sgd", "adam"])
+    ap.add_argument("--momentum", type=float, default=0.9)
+    ap.add_argument("--zero", type=int, default=0, choices=[0, 1])
+    ap.add_argument("--multi-precision", action="store_true")
+    ap.add_argument("--pipeline-stages", type=int, default=None)
+    ap.add_argument("--num-micro", type=int, default=1)
+    ap.add_argument("--pipeline-remat", action="store_true")
+    ap.add_argument("--no-donate", action="store_true")
+    ap.add_argument("--compute-dtype", default=None,
+                    help="e.g. bfloat16 (default: f32)")
+    ap.add_argument("--device", default="tpu-v5e",
+                    help="roofline device-spec registry key")
+    ap.add_argument("--hbm-budget", default=None,
+                    help="peak-memory budget (bytes; 16GiB / 8GB forms "
+                         "accepted) — GL201 errors over it, exit 1")
+    ap.add_argument("--format", dest="fmt", default="table",
+                    choices=["table", "json"])
+    args = ap.parse_args(argv)
+
+    mesh_axes = _parse_mesh(args.mesh)
+    ndev = 1
+    for v in mesh_axes.values():
+        ndev *= v
+    if mesh_axes and "XLA_FLAGS" not in os.environ:
+        # forge enough host devices for the mesh BEFORE jax initializes
+        os.environ["XLA_FLAGS"] = \
+            "--xla_force_host_platform_device_count=%d" % max(ndev, 2)
+
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.analysis import DEVICE_SPECS, Severity
+    from incubator_mxnet_tpu.parallel import make_train_step
+    from incubator_mxnet_tpu import gluon
+
+    if args.device not in DEVICE_SPECS:
+        raise SystemExit("unknown --device %r (registry: %s)"
+                         % (args.device, sorted(DEVICE_SPECS)))
+    net, in_shape, kind = _build_model(args.model)
+    budget = _parse_bytes(args.hbm_budget)
+
+    mesh = None
+    if mesh_axes:
+        from incubator_mxnet_tpu.parallel import make_mesh
+
+        mesh = make_mesh(mesh_axes, devices=jax.devices()[:ndev])
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss() if kind == "dense" \
+        or args.model == "resnet50" else gluon.loss.L2Loss()
+    kw = dict(optimizer=args.optimizer, learning_rate=0.1)
+    if args.optimizer == "sgd":
+        kw["momentum"] = args.momentum
+    if args.multi_precision:
+        kw["multi_precision"] = True
+    step = make_train_step(
+        net, loss_fn, mesh=mesh, zero=args.zero,
+        pipeline_stages=args.pipeline_stages, num_micro=args.num_micro,
+        pipeline_remat=args.pipeline_remat, donate=not args.no_donate,
+        compute_dtype=args.compute_dtype, lint="off", cost="off",
+        hbm_budget=budget, cost_device=args.device, **kw)
+
+    x = jax.ShapeDtypeStruct((args.batch,) + in_shape, jnp.float32)
+    if args.model == "conv-bn":
+        y = jax.ShapeDtypeStruct((args.batch, 16, 16, 16), jnp.float32)
+    else:
+        y = jax.ShapeDtypeStruct((args.batch,), jnp.float32)
+    report = step.analyze_cost(x, y, device=args.device, hbm_budget=budget)
+
+    if args.fmt == "json":
+        print(report.to_json(indent=2))
+    else:
+        print(report.format())
+    errors = [d for d in report.diagnostics
+              if d.severity >= Severity.ERROR]
+    if errors and args.fmt != "json":
+        print("graftcost: %d error(s) — infeasible config" % len(errors),
+              file=sys.stderr)
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

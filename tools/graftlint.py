@@ -19,16 +19,27 @@ offending line.  Trace-time (Level 1) checks run inside
 ``--select``/``--ignore`` filter by diagnostic code so CI can gate on a
 precise code set (e.g. ``--select GL101,GL102`` hard-fails import/side-
 effect idiom while other codes stay advisory); ``--ignore``d codes are
-dropped from both the report and the exit status.
+dropped from both the report and the exit status.  Both accept
+``GL2*``-style prefix globs (``fnmatch``), the same grammar
+``lint_suppress=`` honors, so a whole code family can be gated or
+silenced at once.
+
+``--format=json`` prints the stable machine schema (one object:
+``{"version", "tool", "findings": [{code, severity, message, where,
+hint}], "summary": {total, errors, warnings}}``) so CI and the future
+autotuner consume lint output programmatically; severity is serialized
+by NAME.
 
 Usage::
 
     python tools/graftlint.py [paths...] [--min-severity warning]
-                              [--select GL101,GL103] [--ignore GL103]
+                              [--select GL101,GL103] [--ignore GL2*]
+                              [--format json]
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -53,14 +64,21 @@ def main(argv=None) -> int:
                     help="comma-separated GLxxx codes to suppress "
                          "(alias of --ignore, kept for compatibility)")
     ap.add_argument("--select", default="",
-                    help="comma-separated GLxxx codes: report ONLY these "
-                         "(the exit code keys off errors among them)")
+                    help="comma-separated GLxxx codes or GL2*-style "
+                         "prefix globs: report ONLY these (the exit "
+                         "code keys off errors among them)")
     ap.add_argument("--ignore", default="",
-                    help="comma-separated GLxxx codes to drop from the "
-                         "report and the exit status")
+                    help="comma-separated GLxxx codes or prefix globs to "
+                         "drop from the report and the exit status")
+    ap.add_argument("--format", dest="fmt", default="text",
+                    choices=["text", "json"],
+                    help="json: the stable Diagnostic schema for CI / "
+                         "autotuner consumption")
     args = ap.parse_args(argv)
 
-    from incubator_mxnet_tpu.analysis.diagnostics import LintReport, Severity
+    from incubator_mxnet_tpu.analysis.diagnostics import (LintReport,
+                                                          Severity,
+                                                          code_matches)
     from incubator_mxnet_tpu.analysis.source_lint import lint_paths
 
     def _codes(s):
@@ -70,12 +88,22 @@ def main(argv=None) -> int:
     ignore = _codes(args.ignore) + _codes(args.suppress)
     report = lint_paths(args.paths)
     kept = [d for d in report
-            if (not select or d.code in select) and d.code not in ignore]
+            if (not select or any(code_matches(d.code, p) for p in select))
+            and not any(code_matches(d.code, p) for p in ignore)]
     report = LintReport(kept)
+    n_err = len(report.errors)
+    if args.fmt == "json":
+        print(json.dumps({
+            "version": 1,
+            "tool": "graftlint",
+            "findings": [d.to_dict() for d in report],
+            "summary": {"total": len(report), "errors": n_err,
+                        "warnings": len(report.warnings)},
+        }, indent=2))
+        return 1 if n_err else 0
     out = report.format(Severity[args.min_severity.upper()])
     if out:
         print(out)
-    n_err = len(report.errors)
     print("graftlint: %d file finding(s), %d error(s)"
           % (len(report), n_err))
     return 1 if n_err else 0

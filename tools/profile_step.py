@@ -129,6 +129,11 @@ def main():
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--top", type=int, default=25)
     ap.add_argument("--xplane", help="skip capture; parse this xplane.pb")
+    ap.add_argument("--out", help="also write the hlo_stats category "
+                                  "breakdown as JSON to this path — the "
+                                  "measured ground truth graftcost's "
+                                  "fusion heuristics diff against "
+                                  "(analysis/cost_model.py)")
     args = ap.parse_args()
 
     if args.xplane:
@@ -161,6 +166,38 @@ def main():
     for name, t in sorted(by_op.items(), key=lambda kv: -kv[1])[:args.top]:
         print("  %7.2f ms/step  %5.1f%%  %s"
               % (t / args.iters / 1e3, 100 * t / total, name))
+
+    if args.out:
+        # machine-readable category breakdown: the measured counterpart
+        # of graftcost's predicted CostReport categories (same
+        # "category -> time" shape PERF.md tables use), so the cost
+        # model's fusion heuristics can be diffed against reality
+        payload = {
+            "version": 1,
+            "tool": "profile_step",
+            "iters": args.iters,
+            "batch": args.batch,
+            "dtype": args.dtype,
+            "xplane": xp,
+            "total_self_us": total,
+            "per_step_ms": round(per_step_us / 1e3, 3),
+            "categories": {
+                cat: {"total_self_us": round(t, 1),
+                      "ms_per_step": round(t / args.iters / 1e3, 3),
+                      "fraction": round(t / total, 4) if total else 0.0}
+                for cat, t in sorted(by_cat.items(),
+                                     key=lambda kv: -kv[1])},
+            "top_ops": [
+                {"name": name, "ms_per_step":
+                 round(t / args.iters / 1e3, 3)}
+                for name, t in sorted(by_op.items(),
+                                      key=lambda kv: -kv[1])[:args.top]],
+        }
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=2)
+        os.replace(tmp, args.out)
+        print("wrote %s" % args.out, file=sys.stderr)
 
 
 if __name__ == "__main__":
