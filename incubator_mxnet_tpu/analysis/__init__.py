@@ -13,7 +13,11 @@ semantics):
   ``Trainer.save_states`` checkpoint path left reachable beside
   dp-sharded fused-step state (GL007).  Wired into every fused
   step via ``make_train_step(..., lint="error"|"warn"|"off")`` /
-  ``MXTPU_LINT``.
+  ``MXTPU_LINT``.  GL009 (a warning, emitted at ``CheckpointManager``
+  construction) flags a process-local checkpoint directory — ``/tmp``,
+  ``$TMPDIR``, a relative path — while ``jax.distributed`` spans
+  multiple processes: the coordinated multi-process commit needs one
+  shared directory.
 - **Level 2 (source)**: :mod:`.source_lint` + the ``tools/graftlint.py``
   CLI check repo idiom (GL101–GL103) plus the checkpoint-without-
   iterator-state pattern (GL008, a warning: a loop consuming a stateful
@@ -37,6 +41,7 @@ from .source_lint import (check_checkpoint_without_iter_state, lint_paths,
                           lint_source)
 from .trace_lint import (check_legacy_checkpoint_path,
                          check_partition_spec, check_permutation,
+                         check_process_local_ckpt_dir,
                          check_zero_state_shardings, lint_jaxpr,
                          lint_traceable, recompile_probe,
                          validate_permutation)
@@ -48,6 +53,7 @@ __all__ = [
     "check_checkpoint_without_iter_state", "check_cost",
     "check_legacy_checkpoint_path",
     "check_partition_spec", "check_permutation",
+    "check_process_local_ckpt_dir",
     "check_zero_state_shardings", "code_matches", "lint_jaxpr",
     "lint_paths", "lint_source", "lint_traceable", "recompile_probe",
     "validate_permutation",

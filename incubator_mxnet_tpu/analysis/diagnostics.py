@@ -59,6 +59,12 @@ CODES = {
               "save_checkpoint/attach_checkpoint called from a loop "
               "consuming a stateful data iterator without data_iter= — "
               "a resumed run replays the epoch from batch 0"),
+    "GL009": (Severity.WARNING,
+              "CheckpointManager pointed at a process-local directory "
+              "(/tmp, $TMPDIR, a relative path) while jax.distributed "
+              "spans multiple processes — the coordinated multi-process "
+              "commit needs one shared directory and can never complete "
+              "on per-host storage"),
     "GL201": (Severity.ERROR,
               "graftcost: predicted peak live-buffer memory exceeds the "
               "HBM budget — the program is infeasible at this config; "

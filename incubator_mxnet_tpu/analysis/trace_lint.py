@@ -26,6 +26,7 @@ Entry points:
 """
 from __future__ import annotations
 
+import os
 from collections import Counter
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -375,6 +376,47 @@ def check_legacy_checkpoint_path(origin: str,
         hint="checkpoint through the fused step instead: "
              "step.save_checkpoint(dir) / step.restore_checkpoint(dir) "
              "(parallel.checkpoint, docs/RESILIENCE.md)")]
+
+
+def check_process_local_ckpt_dir(directory: str,
+                                 process_count: int) -> List[Diagnostic]:
+    """GL009 core: a multi-process (``jax.distributed``) run pointed its
+    ``CheckpointManager`` at a process-LOCAL directory (``/tmp``,
+    ``$TMPDIR``, a relative path).
+
+    The coordinated commit protocol assumes every process stages into
+    the SAME directory: on per-host tmp storage each process writes a
+    private, incomplete stage, process 0's marker wait times out (or
+    worse, a single-host test "passes"), and the job has no restorable
+    checkpoint at all.  Emitted at manager construction — before a long
+    run banks on it.
+    """
+    import tempfile
+
+    if int(process_count) <= 1:
+        return []
+    path = os.path.abspath(str(directory))
+    locals_ = {os.path.abspath(tempfile.gettempdir())}
+    for env in ("TMPDIR", "TMP", "TEMP"):
+        v = os.environ.get(env)
+        if v:
+            locals_.add(os.path.abspath(v))
+    hit = next((t for t in sorted(locals_)
+                if path == t or path.startswith(t + os.sep)), None)
+    if hit is None and os.path.isabs(str(directory)):
+        return []
+    what = "process-local temp dir %s" % hit if hit is not None else \
+        "relative path (resolves per-process working dir)"
+    return [Diagnostic(
+        "GL009", Severity.WARNING,
+        "CheckpointManager directory %r is a %s while jax.distributed "
+        "spans %d processes — each host would stage a private, "
+        "incomplete checkpoint and the multi-process commit can never "
+        "complete" % (str(directory), what, int(process_count)),
+        where="CheckpointManager(directory=%r)" % str(directory),
+        hint="point every process at the same shared filesystem "
+             "(NFS/GCS-fuse/lustre) path; docs/RESILIENCE.md "
+             "'Multi-host & elastic'")]
 
 
 # ---------------------------------------------------------------------------

@@ -35,15 +35,15 @@ from .kvstore import KVStore
 
 __all__ = ["DistKVStore", "init_process_group", "is_initialized"]
 
-_INITIALIZED = False
-
 
 def _env_world() -> int:
     return int(os.environ.get("DMLC_NUM_WORKER", "1"))
 
 
 def is_initialized() -> bool:
-    return _INITIALIZED
+    from ..parallel import distributed as _dist
+
+    return _dist.is_initialized()
 
 
 def init_process_group(coordinator: Optional[str] = None,
@@ -53,26 +53,14 @@ def init_process_group(coordinator: Optional[str] = None,
 
     Arguments default to the ``DMLC_*`` environment exported by
     ``tools/launch.py`` (reference ``tools/launch.py:71-113`` contract).
-    Returns the world size.
-    """
-    global _INITIALIZED
-    if _INITIALIZED:
-        return jax.process_count()
-    num_workers = num_workers if num_workers is not None else _env_world()
-    if num_workers <= 1:
-        # no rendezvous needed/possible — deliberately do NOT latch
-        # _INITIALIZED, so a later call with a real world size still works
-        return 1
-    if coordinator is None:
-        uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
-        port = os.environ.get("DMLC_PS_ROOT_PORT", "9091")
-        coordinator = "%s:%s" % (uri, port)
-    rank = rank if rank is not None else int(
-        os.environ.get("DMLC_WORKER_ID", "0"))
-    jax.distributed.initialize(coordinator_address=coordinator,
-                               num_processes=num_workers, process_id=rank)
-    _INITIALIZED = True
-    return num_workers
+    Returns the world size.  Delegates to the one bootstrap home,
+    ``parallel/distributed.py::initialize`` — the kvstore and the
+    elastic checkpoint layer must agree on whether this process is
+    distributed."""
+    from ..parallel import distributed as _dist
+
+    return _dist.initialize(coordinator=coordinator,
+                            num_processes=num_workers, process_id=rank)
 
 
 class DistKVStore(KVStore):

@@ -3,20 +3,25 @@
 Replaces the reference's KVStore comm trees / NCCL / ps-lite stack
 (SURVEY.md §2.5, §5.8) with jax.sharding + XLA collectives.
 """
-from .mesh import Mesh, NamedSharding, P, PartitionSpec, make_mesh, replicated, shard_along
+from .mesh import (Mesh, NamedSharding, P, PartitionSpec, global_devices,
+                   make_mesh, replicated, shard_along, spans_processes)
 from .train_step import DynamicLossScale, FunctionalOptimizer, TrainStep, make_train_step
 from .flash_attention import flash_attention
 from .pipeline import pipeline_apply, spmd_pipeline, stack_stage_params
 from .moe import load_balancing_loss, moe_ffn, moe_ffn_sharded
 from .checkpoint import (CheckpointError, CheckpointCorruptError,
-                         CheckpointManager, install_preemption_hook,
-                         request_checkpoint)
+                         CheckpointManager, CheckpointTopologyError,
+                         install_preemption_hook, request_checkpoint,
+                         uninstall_preemption_hook)
+from . import distributed
 
 __all__ = ["Mesh", "NamedSharding", "P", "PartitionSpec", "make_mesh",
-           "replicated", "shard_along", "DynamicLossScale",
-           "FunctionalOptimizer", "TrainStep", "make_train_step",
-           "flash_attention", "pipeline_apply", "spmd_pipeline",
-           "stack_stage_params", "load_balancing_loss", "moe_ffn",
-           "moe_ffn_sharded", "CheckpointError", "CheckpointCorruptError",
+           "replicated", "shard_along", "global_devices", "spans_processes",
+           "DynamicLossScale", "FunctionalOptimizer", "TrainStep",
+           "make_train_step", "flash_attention", "pipeline_apply",
+           "spmd_pipeline", "stack_stage_params", "load_balancing_loss",
+           "moe_ffn", "moe_ffn_sharded", "CheckpointError",
+           "CheckpointCorruptError", "CheckpointTopologyError",
            "CheckpointManager", "install_preemption_hook",
-           "request_checkpoint"]
+           "uninstall_preemption_hook", "request_checkpoint",
+           "distributed"]

@@ -25,11 +25,41 @@ half of the resilience layer (``docs/RESILIENCE.md``):
 Array payloads are raw little-endian bytes (``ndarray.tobytes``) rather
 than ``.npy``: it round-trips every dtype jax uses (including bfloat16
 via ml_dtypes) and keeps checksumming trivial.
+
+**Multi-process (multi-host) checkpoints.**  When the manager detects a
+``jax.distributed`` world (or is constructed with ``process_count>1``)
+it runs a coordinated commit protocol over the shared directory:
+
+1. every process stages only the shards IT owns (lowest-ranked owning
+   process per distinct shard — nothing is written twice, nothing is
+   gathered) into the shared ``.tmp-step-N/``;
+2. each process then writes a ``done-pNNNNN.json`` marker carrying its
+   file list + checksums (and its per-process ``meta``, e.g. the data
+   iterator state), fsyncs;
+3. process 0 waits for every marker, verifies the merged shard set
+   covers every array completely, writes the SINGLE ``manifest.json``
+   last, and publishes with the same atomic rename — so a half-written
+   multi-host checkpoint (a host died mid-save) is **never visible**:
+   ``steps()`` only ever lists committed directories;
+4. the other processes block until the commit appears (bounded by
+   ``commit_timeout``) so a save returning means the checkpoint is
+   durable on every host.
+
+**Elastic restore.**  ``restore(like, elastic=...)`` accepts a policy
+pytree marking which leaves may be re-shaped across a topology change:
+a leaf marked with its LOGICAL leading dim (a ZeRO-1 optimizer-state
+leaf padded to a multiple of the saved dp width) is re-sliced to the
+logical rows and re-padded to the restoring width — so a checkpoint
+saved at ``dp=N`` restores onto a ``dp=M`` mesh.  Every other shape
+mismatch raises :class:`CheckpointTopologyError` naming the saved and
+current topologies (never the corrupt-fallback path: a topology
+mismatch is a configuration condition, not bit rot).
 """
 from __future__ import annotations
 
 import json
 import os
+import random
 import shutil
 import signal
 import time
@@ -42,15 +72,18 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["CheckpointError", "CheckpointCorruptError", "CheckpointManager",
+__all__ = ["CheckpointError", "CheckpointCorruptError",
+           "CheckpointTopologyError", "CheckpointManager",
            "checkpoint_requested", "install_preemption_hook",
-           "request_checkpoint", "request_seq"]
+           "request_checkpoint", "request_seq",
+           "uninstall_preemption_hook"]
 
 _FORMAT_VERSION = 1
 _MANIFEST = "manifest.json"
 _STEP_FMT = "step-%08d"
 _TMP_PREFIX = ".tmp-"
 _DISCARD_PREFIX = ".discard-"
+_DONE_FMT = "done-p%05d.json"
 
 
 class CheckpointError(RuntimeError):
@@ -61,6 +94,14 @@ class CheckpointError(RuntimeError):
 class CheckpointCorruptError(CheckpointError):
     """A specific checkpoint failed integrity validation: missing file,
     unparseable/mismatched manifest, or checksum mismatch."""
+
+
+class CheckpointTopologyError(CheckpointError):
+    """The checkpoint is intact but was saved under a training topology
+    (mesh widths, pipeline stages, data split) this run cannot re-shard
+    onto.  Deliberately NOT a :class:`CheckpointCorruptError`: restore
+    must refuse immediately with the two topologies named, not walk
+    back to an older checkpoint with the same mismatch."""
 
 
 # ---------------------------------------------------------------------------
@@ -125,14 +166,17 @@ def _fsync_dir(path: str) -> None:
 
 def _with_retries(fn, retries: int, backoff: float, what: str):
     """Run ``fn`` retrying transient ``OSError`` s with exponential
-    backoff; the LAST failure propagates."""
+    backoff; the LAST failure propagates.  The sleep is jittered
+    (0.5–1.5× the nominal backoff): N processes of a preempted job all
+    hit the shared filesystem at the same instant, and synchronized
+    retries would re-collide every round (thundering herd)."""
     for attempt in range(retries + 1):
         try:
             return fn()
         except OSError:
             if attempt == retries:
                 raise
-            time.sleep(backoff * (2 ** attempt))
+            time.sleep(backoff * (2 ** attempt) * (0.5 + random.random()))
 
 
 # ---------------------------------------------------------------------------
@@ -170,6 +214,32 @@ def _leaf_np(x) -> np.ndarray:
     return np.asarray(x)
 
 
+def _topology_mismatch(saved: Dict, current: Dict) -> Optional[str]:
+    """What — beyond an ELASTIC change — differs between two topology
+    stamps (``TrainStep._topology()`` dicts).  Elastic changes, the
+    ones restore re-shards by construction, are: the batch-axis (dp)
+    width, the process count, and the ZeRO mode (state re-pads either
+    way).  Everything else — pipeline staging, non-dp mesh axes, the
+    batch axis name — changes the training program or the state layout
+    in ways no re-shard covers, and must refuse."""
+    for key in ("batch_axis", "pipeline_stages"):
+        if saved.get(key) != current.get(key):
+            return "%s %r != %r" % (key, saved.get(key), current.get(key))
+    sm, cm = saved.get("mesh"), current.get("mesh")
+    if (sm is None) != (cm is None):
+        return "mesh %r != %r" % (sm, cm)
+    if sm:
+        if set(sm) != set(cm):
+            return "mesh axes %s != %s" % (sorted(sm), sorted(cm))
+        ba = current.get("batch_axis")
+        for a in sorted(sm):
+            if a != ba and sm[a] != cm[a]:
+                return ("mesh axis %r width %s != %s (only the %r batch "
+                        "axis re-shards elastically)" % (a, sm[a], cm[a],
+                                                         ba))
+    return None
+
+
 # ---------------------------------------------------------------------------
 # the manager
 # ---------------------------------------------------------------------------
@@ -185,10 +255,24 @@ class CheckpointManager:
     validates checksums/manifest and falls back to the next-older
     checkpoint on corruption.  ``retries``/``backoff`` bound the
     retry-with-backoff loop around every file read/write.
+
+    ``process_index``/``process_count`` default to the live
+    ``jax.distributed`` topology: in a multi-process world every
+    process must call ``save``/``restore`` cooperatively on the SAME
+    (shared-filesystem) directory, and the module docstring's
+    marker-based commit protocol runs.  ``commit_timeout`` bounds how
+    long any process waits for its peers at the commit point;
+    ``stale_grace`` is how old (seconds since last write) staging
+    debris or a retired step directory must be before a multi-process
+    sweep may delete it — a peer's FRESH temp files are never deleted
+    out from under it (single-process managers keep the original
+    single-writer semantics: debris is swept unconditionally).
     """
 
     def __init__(self, directory: str, keep_last: int = 3, retries: int = 2,
-                 backoff: float = 0.05):
+                 backoff: float = 0.05, process_index: Optional[int] = None,
+                 process_count: Optional[int] = None,
+                 commit_timeout: float = 120.0, stale_grace: float = 300.0):
         self.directory = str(directory)
         if keep_last is not None and int(keep_last) < 1:
             raise ValueError("keep_last must be >= 1 or None, got %r"
@@ -196,6 +280,38 @@ class CheckpointManager:
         self.keep_last = None if keep_last is None else int(keep_last)
         self.retries = int(retries)
         self.backoff = float(backoff)
+        if process_count is None:
+            # prefer the bootstrap module's latch (no backend touch);
+            # fall back to jax for processes that called
+            # jax.distributed.initialize directly
+            from . import distributed as _dist
+
+            if _dist.is_initialized():
+                process_count = _dist.process_count()
+            else:
+                try:
+                    process_count = jax.process_count()
+                except Exception:
+                    process_count = 1
+        if process_index is None:
+            process_index = jax.process_index() if int(process_count) > 1 \
+                else 0
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        if not 0 <= self.process_index < max(self.process_count, 1):
+            raise ValueError("process_index %d outside process_count %d"
+                             % (self.process_index, self.process_count))
+        self.commit_timeout = float(commit_timeout)
+        self.stale_grace = float(stale_grace)
+        if self.process_count > 1:
+            # GL009: a process-local directory cannot hold a coordinated
+            # multi-process checkpoint — every process would commit a
+            # private, incomplete copy (docs/ANALYSIS.md)
+            from ..analysis.trace_lint import check_process_local_ckpt_dir
+
+            for d in check_process_local_ckpt_dir(self.directory,
+                                                  self.process_count):
+                warnings.warn(d.format(), stacklevel=3)
 
     # -- layout ---------------------------------------------------------
     def _step_dir(self, step: int) -> str:
@@ -233,6 +349,11 @@ class CheckpointManager:
         os.makedirs(self.directory, exist_ok=True)
         tmp = os.path.join(self.directory, _TMP_PREFIX + (_STEP_FMT % step))
         final = self._step_dir(step)
+        if self.process_count > 1:
+            return self._save_multiprocess(step, flat, meta, tmp, final)
+        # single-writer: nobody else can own staging debris, including a
+        # crashed earlier attempt at THIS step — sweep unconditionally
+        # (or the makedirs below would fail on the leftover dir)
         self._sweep_stale()
         os.makedirs(tmp)
         try:
@@ -244,54 +365,260 @@ class CheckpointManager:
                         "arrays": entries}
             if meta is not None:
                 manifest["meta"] = meta
-            # the manifest commits the content of the staging dir: it is
-            # written LAST, so a torn stage never looks complete
-            buf = json.dumps(manifest, indent=1).encode()
-            _with_retries(
-                lambda: _write_bytes(os.path.join(tmp, _MANIFEST), buf),
-                self.retries, self.backoff, _MANIFEST)
-            _fsync_dir(tmp)
-            discard = None
-            committed = False
-            try:
-                if os.path.isdir(final):
-                    # re-saving the same step: move the committed dir
-                    # ASIDE (never delete it before the new one is
-                    # committed — a crash here leaves the data on disk,
-                    # and every OTHER checkpoint untouched)
-                    discard = os.path.join(
-                        self.directory, _DISCARD_PREFIX + (_STEP_FMT % step))
-                    shutil.rmtree(discard, ignore_errors=True)
-                    os.replace(final, discard)
-                os.replace(tmp, final)  # THE commit point
-                committed = True
-            finally:
-                if discard is not None and os.path.isdir(discard):
-                    if committed:
-                        shutil.rmtree(discard, ignore_errors=True)
-                    elif not os.path.isdir(final):
-                        # the commit rename failed after the old dir
-                        # moved aside: roll it back so the previously
-                        # committed checkpoint is still restorable
-                        os.replace(discard, final)
-            _fsync_dir(self.directory)
+            self._write_manifest_and_commit(tmp, final, manifest)
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
         self._retire()
         return final
 
-    def _sweep_stale(self):
+    def _write_manifest_and_commit(self, tmp: str, final: str,
+                                   manifest: Dict) -> None:
+        """The shared commit tail: write ``manifest.json`` LAST (a torn
+        stage never looks complete), fsync the staging dir, and publish
+        with one atomic rename — rolling a same-step re-save's old dir
+        back into place if the rename fails after it moved aside."""
+        buf = json.dumps(manifest, indent=1).encode()
+        _with_retries(
+            lambda: _write_bytes(os.path.join(tmp, _MANIFEST), buf),
+            self.retries, self.backoff, _MANIFEST)
+        _fsync_dir(tmp)
+        discard = None
+        committed = False
+        try:
+            if os.path.isdir(final):
+                # re-saving the same step: move the committed dir
+                # ASIDE (never delete it before the new one is
+                # committed — a crash here leaves the data on disk,
+                # and every OTHER checkpoint untouched)
+                discard = os.path.join(
+                    os.path.dirname(final),
+                    _DISCARD_PREFIX + os.path.basename(final))
+                shutil.rmtree(discard, ignore_errors=True)
+                os.replace(final, discard)
+            os.replace(tmp, final)  # THE commit point
+            committed = True
+        finally:
+            if discard is not None and os.path.isdir(discard):
+                if committed:
+                    shutil.rmtree(discard, ignore_errors=True)
+                elif not os.path.isdir(final):
+                    # the commit rename failed after the old dir
+                    # moved aside: roll it back so the previously
+                    # committed checkpoint is still restorable
+                    os.replace(discard, final)
+        _fsync_dir(self.directory)
+
+    # -- multi-process commit protocol ----------------------------------
+    def _save_multiprocess(self, step: int, flat, meta, tmp: str,
+                           final: str) -> str:
+        """Coordinated save: this process stages only the shards it
+        owns plus a done-marker; process 0 verifies every marker and
+        publishes the single manifest atomically (module docstring)."""
+        # a re-save of an ALREADY-committed step must not let the old
+        # commit satisfy the non-coordinators' wait: remember what the
+        # committed manifest looked like before this attempt started
+        pre_stat = self._manifest_stat(final)
+        if self.process_index == 0:
+            self._sweep_stale(keep=os.path.basename(tmp))
+            # a crashed EARLIER attempt at this same step may have left
+            # done-markers in the (grace-protected, unswept) staging
+            # dir; merging one would commit a checkpoint mixing two
+            # attempts' files.  Drop markers older than stale_grace —
+            # a CURRENT attempt's marker (a peer that reached the step
+            # boundary just before us) is seconds old and survives.
+            if os.path.isdir(tmp):
+                now = time.time()
+                for name in os.listdir(tmp):
+                    if not name.startswith("done-"):
+                        continue
+                    path = os.path.join(tmp, name)
+                    try:
+                        if now - os.path.getmtime(path) > self.stale_grace:
+                            os.unlink(path)
+                    except OSError:
+                        continue
+        os.makedirs(tmp, exist_ok=True)
+        # deliberately NO rmtree-on-failure here: peers may still be
+        # writing into the shared staging dir, and an uncommitted stage
+        # is invisible anyway — it ages out through _sweep_stale
+        skeletons = []
+        mine: Dict[str, List] = {}
+        for i, (path, leaf) in enumerate(flat):
+            name = "arr_%05d" % i
+            entry, owned, expected = self._save_leaf_owned(
+                tmp, name, jax.tree_util.keystr(path), leaf)
+            skeletons.append((entry, expected))
+            if owned:
+                mine[name] = owned
+        marker = {"format_version": _FORMAT_VERSION, "step": step,
+                  "process": self.process_index, "files": mine,
+                  # launcher-managed elastic jobs bump
+                  # MXNET_RESTART_COUNT per relaunch (tools/launch.py
+                  # --max-restarts): stamping it rejects a crashed
+                  # EARLIER attempt's marker even inside the
+                  # stale_grace window.  None (no launcher) degrades to
+                  # the age heuristic alone.
+                  "attempt": os.environ.get("MXNET_RESTART_COUNT"),
+                  "meta": meta}
+        _with_retries(
+            lambda: _write_bytes(
+                os.path.join(tmp, _DONE_FMT % self.process_index),
+                json.dumps(marker).encode()),
+            self.retries, self.backoff, "done-marker")
+        _fsync_dir(tmp)
+        if self.process_index != 0:
+            self._wait_commit(step, final, pre_stat)
+            return final
+        markers = self._wait_markers(tmp, step)
+        arrays = []
+        for i, (entry, expected) in enumerate(skeletons):
+            name = "arr_%05d" % i
+            collected: List = []
+            for r in sorted(markers):
+                collected.extend(markers[r]["files"].get(name, []))
+            collected.sort(key=lambda kf: kf[0])
+            if [k for k, _ in collected] != list(range(expected)):
+                raise CheckpointError(
+                    "multi-process checkpoint step %d: array %s has "
+                    "shard files %s from the %d done-markers, expected "
+                    "exactly shards 0..%d — a process staged an "
+                    "inconsistent state tree; NOT committing"
+                    % (step, name, [k for k, _ in collected],
+                       len(markers), expected - 1))
+            entry["files"] = [f for _, f in collected]
+            arrays.append(entry)
+        manifest = {"format_version": _FORMAT_VERSION, "step": step,
+                    "arrays": arrays}
+        merged_meta = self._merge_meta(markers)
+        if merged_meta is not None:
+            manifest["meta"] = merged_meta
+        self._write_manifest_and_commit(tmp, final, manifest)
+        self._retire()
+        return final
+
+    def _merge_meta(self, markers: Dict[int, Dict]) -> Optional[Dict]:
+        """Process 0's meta is the base; every process's ``data_iter``
+        state (its shard of the input stream) is collected under
+        ``data_iter_parts`` so elastic restore can re-split the stream
+        across a different process count."""
+        base = markers[0].get("meta")
+        merged = dict(base) if base else {}
+        parts = {str(r): m["meta"]["data_iter"] for r, m in markers.items()
+                 if m.get("meta") and m["meta"].get("data_iter") is not None}
+        if parts:
+            merged["data_iter_parts"] = parts
+        return merged or None
+
+    def _wait_markers(self, tmp: str, step: int) -> Dict[int, Dict]:
+        """Process 0: wait for every peer's done-marker (bounded by
+        ``commit_timeout``).  A torn marker (peer died mid-write) never
+        parses and therefore never commits a torn checkpoint — the wait
+        times out and the stage stays invisible."""
+        deadline = time.monotonic() + self.commit_timeout
+        need = set(range(self.process_count))
+        got: Dict[int, Dict] = {}
+        while True:
+            for r in sorted(need - set(got)):
+                path = os.path.join(tmp, _DONE_FMT % r)
+                if not os.path.exists(path):
+                    continue
+                try:
+                    m = json.loads(_read_bytes(path).decode())
+                except (OSError, ValueError):
+                    continue  # torn/in-flight marker: keep waiting
+                if m.get("step") == step and m.get("process") == r \
+                        and m.get("attempt") == os.environ.get(
+                            "MXNET_RESTART_COUNT"):
+                    # attempt mismatch = a crashed earlier attempt's
+                    # leftover: keep waiting for THIS attempt's marker
+                    got[r] = m
+            if len(got) == len(need):
+                return got
+            if time.monotonic() > deadline:
+                raise CheckpointError(
+                    "multi-process checkpoint step %d: process 0 timed "
+                    "out after %.0fs waiting for done-marker(s) from "
+                    "process(es) %s under %s — a host was likely lost "
+                    "mid-save; the half-written stage was NOT committed "
+                    "and the last committed checkpoint is untouched"
+                    % (step, self.commit_timeout,
+                       sorted(need - set(got)), tmp))
+            time.sleep(0.05)
+
+    @staticmethod
+    def _manifest_stat(final: str) -> Optional[Tuple[int, int]]:
+        """Identity ``(st_ino, st_mtime_ns)`` of a committed manifest,
+        or None when the step is not committed — how a non-coordinator
+        tells a FRESH commit from a pre-existing one when a step is
+        re-saved (the atomic rename gives the manifest a new inode)."""
+        try:
+            st = os.stat(os.path.join(final, _MANIFEST))
+            return (st.st_ino, st.st_mtime_ns)
+        except OSError:
+            return None
+
+    def _wait_commit(self, step: int, final: str,
+                     pre_stat: Optional[Tuple[int, int]] = None) -> None:
+        """Processes != 0: block until the coordinator publishes a
+        manifest NEWER than ``pre_stat`` (the commit state observed
+        before this save attempt — a re-saved step's OLD commit must
+        not count), so ``save`` returning means THIS checkpoint is
+        durable everywhere.  ``commit_timeout=0`` skips the wait
+        (fire-and-forget staging — how single-process tests drive one
+        rank of the protocol at a time)."""
+        if self.commit_timeout == 0:
+            return
+        deadline = time.monotonic() + self.commit_timeout
+        while self._manifest_stat(final) in (None, pre_stat):
+            if time.monotonic() > deadline:
+                raise CheckpointError(
+                    "multi-process checkpoint step %d: process %d timed "
+                    "out after %.0fs waiting for process 0 to commit %s "
+                    "— the coordinator was likely lost mid-save; the "
+                    "last committed checkpoint is untouched"
+                    % (step, self.process_index, self.commit_timeout,
+                       final))
+            time.sleep(0.05)
+
+    def _newest_mtime(self, path: str) -> float:
+        """Newest mtime of ``path`` or anything directly inside it —
+        how fresh a peer's activity in the directory can be."""
+        try:
+            newest = os.path.getmtime(path)
+            for name in os.listdir(path):
+                try:
+                    newest = max(newest, os.path.getmtime(
+                        os.path.join(path, name)))
+                except OSError:
+                    continue
+            return newest
+        except OSError:
+            return 0.0
+
+    def _sweep_stale(self, keep: Optional[str] = None):
         """Remove staging/discard debris from crashed earlier saves.
-        Runs at save time: the manager is single-writer per directory,
-        so anything with a tmp/discard prefix is an orphan by now —
+
+        Single-process: the manager is single-writer per directory, so
+        anything with a tmp/discard prefix is an orphan by now —
         without this, every hard kill mid-save would leak one
-        full-state-sized directory forever."""
+        full-state-sized directory forever.  Multi-process: only
+        process 0 sweeps, never the current save's own staging dir
+        (``keep``), and never a directory written to within
+        ``stale_grace`` seconds — a slow peer's in-flight stage must
+        not be deleted out from under it (the thundering-herd case:
+        N preempted processes all restart and save at once)."""
         for name in os.listdir(self.directory):
-            if name.startswith(_TMP_PREFIX) or \
-                    name.startswith(_DISCARD_PREFIX):
-                shutil.rmtree(os.path.join(self.directory, name),
-                              ignore_errors=True)
+            if not (name.startswith(_TMP_PREFIX)
+                    or name.startswith(_DISCARD_PREFIX)):
+                continue
+            if name == keep:
+                continue
+            path = os.path.join(self.directory, name)
+            if self.process_count > 1 and \
+                    time.time() - self._newest_mtime(path) < self.stale_grace:
+                continue
+            shutil.rmtree(path, ignore_errors=True)
 
     def _save_leaf(self, tmp: str, name: str, key: str, leaf) -> Dict:
         dtype = np.dtype(getattr(leaf, "dtype", None)
@@ -319,6 +646,78 @@ class CheckpointManager:
                     part_shape=list(part.shape)))
         return entry
 
+    def _save_leaf_owned(self, tmp: str, name: str, key: str,
+                         leaf) -> Tuple[Dict, List, int]:
+        """Multi-process leaf writer: stage only the distinct shards
+        THIS process owns (the lowest-ranked process holding a shard
+        writes it — nothing is written twice across hosts, nothing is
+        gathered).  Returns ``(manifest-entry skeleton, [[shard_k,
+        payload-entry], ...] written here, expected total shard
+        count)`` — shard ordinals are derived from the GLOBAL
+        device→index map, so every process numbers the same shard the
+        same way without communicating."""
+        dtype = np.dtype(getattr(leaf, "dtype", None)
+                         or np.asarray(leaf).dtype)
+        shape = list(np.shape(leaf))
+        sharding = getattr(leaf, "sharding", None)
+        spec = getattr(sharding, "spec", None)
+        entry = {"key": key, "dtype": dtype.name, "shape": shape,
+                 "spec": None if spec is None else str(spec), "files": []}
+        groups = None  # [(index_key, owner_process, index)] sorted
+        if isinstance(leaf, jax.Array) and sharding is not None:
+            by_key: Dict[Tuple, Tuple[int, Any]] = {}
+            procs = set()
+            for dev, idx in sharding.devices_indices_map(
+                    tuple(shape)).items():
+                procs.add(dev.process_index)
+                k = tuple((sl.start, sl.stop, sl.step) for sl in idx)
+                owner, _ = by_key.get(k, (dev.process_index, idx))
+                by_key[k] = (min(owner, dev.process_index), idx)
+            if procs == {self.process_index}:
+                # a leaf whose mesh does not span processes at all
+                # (per-process replicated training, e.g. on a backend
+                # without multi-process compute): identical on every
+                # process by SPMD construction, so — like host leaves —
+                # process 0 writes the one copy.  (On a SPANNING mesh a
+                # NamedSharding enumerates every mesh device, so a
+                # single-process owner set can only mean a local mesh.)
+                by_key = {k: (0, idx) for k, (_, idx) in by_key.items()}
+            groups = sorted(
+                ((k, owner, idx) for k, (owner, idx) in by_key.items()),
+                key=lambda g: tuple(sl.start or 0 for sl in g[2]))
+        if groups is None or len(groups) < 2:
+            # replicated (or host) leaf: ONE file, written by the
+            # lowest-ranked owning process (process 0 for host leaves —
+            # they must be identical everywhere by SPMD construction)
+            owner = groups[0][1] if groups else 0
+            if owner != self.process_index:
+                return entry, [], 1
+            data = _leaf_np(leaf).tobytes()
+            payload = self._write_payload(tmp, name + ".bin", data,
+                                          index=None, part_shape=shape)
+            return entry, [[0, payload]], 1
+        local = {}
+        for s in getattr(leaf, "addressable_shards", ()):
+            local[tuple((sl.start, sl.stop, sl.step)
+                        for sl in s.index)] = s
+        owned = []
+        for k, (ikey, owner, idx) in enumerate(groups):
+            if owner != self.process_index:
+                continue
+            shard = local.get(ikey)
+            if shard is None:
+                raise CheckpointError(
+                    "process %d owns shard %d of %s but holds no "
+                    "addressable copy — mesh/sharding disagree about "
+                    "device placement" % (self.process_index, k, key))
+            part = _leaf_np(shard.data)
+            payload = self._write_payload(
+                tmp, "%s.shard%03d.bin" % (name, k), part.tobytes(),
+                index=_index_to_json(shard.index),
+                part_shape=list(part.shape))
+            owned.append([k, payload])
+        return entry, owned, len(groups)
+
     def _write_payload(self, tmp, fname, data, index, part_shape) -> Dict:
         _with_retries(
             lambda: _write_bytes(os.path.join(tmp, fname), data),
@@ -330,15 +729,27 @@ class CheckpointManager:
                 "part_shape": part_shape}
 
     def _retire(self):
+        """Retention beyond ``keep_last``.  Multi-process: only process
+        0 retires (N processes racing rmtree on a shared filesystem
+        half-delete each other's candidates), and a step directory
+        anybody wrote to within ``stale_grace`` seconds is left alone —
+        a straggler may still be reading/re-staging it (the cross-host
+        retention race)."""
         if self.keep_last is None:
+            return
+        if self.process_count > 1 and self.process_index != 0:
             return
         steps = self.steps()
         for s in steps[:-self.keep_last]:
-            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+            d = self._step_dir(s)
+            if self.process_count > 1 and \
+                    time.time() - self._newest_mtime(d) < self.stale_grace:
+                continue
+            shutil.rmtree(d, ignore_errors=True)
 
     # -- restore --------------------------------------------------------
     def restore(self, like, step: Optional[int] = None, shardings=None,
-                return_meta: bool = False):
+                return_meta: bool = False, elastic=None, topology=None):
         """Load the newest intact checkpoint (or exactly ``step``) into
         the structure of ``like``; returns ``(step, state)`` — or
         ``(step, state, meta)`` with ``return_meta=True``, where
@@ -350,13 +761,25 @@ class CheckpointManager:
         restored leaf straight back on its training layout.  Corrupt
         candidates are skipped with a warning (last-good fallback)
         unless ``step`` pinned one explicitly.
+
+        ``elastic`` — an optional pytree congruent with ``like`` whose
+        leaves are ``None`` (the leaf's saved shape must match exactly)
+        or an ``int``: the LOGICAL leading dim of a leaf whose stored
+        leading dim is padding-dependent (ZeRO-1 optimizer state padded
+        to a multiple of the dp width).  A shape mismatch on such a
+        leaf is resolved by slicing the saved array to the logical rows
+        and zero-re-padding to this run's expectation — the elastic
+        dp=N→dp=M re-shard.  Any other shape mismatch raises
+        :class:`CheckpointTopologyError` naming the saved topology
+        (from the manifest meta) and ``topology`` (this run's).
         """
         def pack(s, loaded):
             state, meta = loaded
             return (s, state, meta) if return_meta else (s, state)
 
         if step is not None:
-            return pack(int(step), self._load(int(step), like, shardings))
+            return pack(int(step), self._load(int(step), like, shardings,
+                                              elastic, topology))
         candidates = list(reversed(self.steps()))
         if not candidates:
             raise CheckpointError(
@@ -364,7 +787,8 @@ class CheckpointManager:
         last_err: Optional[Exception] = None
         for s in candidates:
             try:
-                return pack(s, self._load(s, like, shardings))
+                return pack(s, self._load(s, like, shardings, elastic,
+                                          topology))
             except CheckpointCorruptError as e:
                 warnings.warn(
                     "checkpoint %s is corrupt (%s); falling back to the "
@@ -374,7 +798,8 @@ class CheckpointManager:
             "no intact checkpoint under %r (%d candidate(s), newest "
             "error: %s)" % (self.directory, len(candidates), last_err))
 
-    def _load(self, step: int, like, shardings):
+    def _load(self, step: int, like, shardings, elastic=None,
+              topology=None):
         d = self._step_dir(step)
         try:
             raw = _with_retries(
@@ -391,7 +816,34 @@ class CheckpointManager:
                 % (manifest.get("format_version"), _FORMAT_VERSION))
         flat, treedef = jax.tree_util.tree_flatten_with_path(like)
         entries = manifest.get("arrays", [])
+        meta_topo = manifest.get("meta", {}).get("topology") \
+            if isinstance(manifest.get("meta"), dict) else None
+        if meta_topo is not None and topology is not None:
+            mismatch = _topology_mismatch(meta_topo, topology)
+            if mismatch:
+                raise CheckpointTopologyError(
+                    "checkpoint step %d cannot be re-sharded onto this "
+                    "run's topology: %s (saved topology: %s; current "
+                    "topology: %s)"
+                    % (step, mismatch, json.dumps(meta_topo,
+                                                  sort_keys=True),
+                       json.dumps(topology, sort_keys=True)))
         if len(entries) != len(flat):
+            if meta_topo is not None and topology is not None \
+                    and meta_topo != topology:
+                # a different training topology produces a different
+                # state-tree shape (a pipeline width change re-stacks
+                # the stage params): refuse with the topologies named,
+                # don't walk back to an older checkpoint with the same
+                # mismatch
+                raise CheckpointTopologyError(
+                    "checkpoint step %d has %d state leaves but this "
+                    "run expects %d — it was saved under a different "
+                    "training topology that cannot be re-sharded "
+                    "(saved topology: %s; current topology: %s)"
+                    % (step, len(entries), len(flat),
+                       json.dumps(meta_topo, sort_keys=True),
+                       json.dumps(topology, sort_keys=True)))
             raise CheckpointCorruptError(
                 "manifest has %d arrays, expected %d (training state "
                 "structure changed?)" % (len(entries), len(flat)))
@@ -402,16 +854,31 @@ class CheckpointManager:
                 raise ValueError("shardings tree is not congruent with "
                                  "the state tree")
             flat_sh = [s for _, s in sh_flat]
+        flat_el: List[Any] = [None] * len(flat)
+        if elastic is not None:
+            # None marks "exact shape required" and must survive the
+            # flatten (jax drops bare None subtrees), hence is_leaf
+            el_flat, _ = jax.tree_util.tree_flatten(
+                elastic, is_leaf=lambda x: x is None)
+            if len(el_flat) != len(flat):
+                raise ValueError("elastic policy tree is not congruent "
+                                 "with the state tree")
+            flat_el = el_flat
+        saved_topo = meta_topo
         leaves = []
-        for (path, _), entry, sh in zip(flat, entries, flat_sh):
+        for (path, lk), entry, sh, el in zip(flat, entries, flat_sh,
+                                             flat_el):
             key = jax.tree_util.keystr(path)
             if entry.get("key") != key:
                 raise CheckpointCorruptError(
                     "manifest entry %r does not match state leaf %r"
                     % (entry.get("key"), key))
             try:
-                leaves.append(self._load_leaf(d, entry, sh))
-            except CheckpointCorruptError:
+                leaves.append(self._load_leaf(
+                    d, entry, sh, want_shape=tuple(np.shape(lk)),
+                    elastic_dim=el, saved_topology=saved_topo,
+                    topology=topology))
+            except (CheckpointCorruptError, CheckpointTopologyError):
                 raise
             except (KeyError, IndexError, TypeError, ValueError) as e:
                 # manifest content that parses as JSON but decodes to
@@ -423,7 +890,9 @@ class CheckpointManager:
         return (jax.tree_util.tree_unflatten(treedef, leaves),
                 manifest.get("meta"))
 
-    def _load_leaf(self, d: str, entry: Dict, sharding):
+    def _load_leaf(self, d: str, entry: Dict, sharding,
+                   want_shape: Optional[Tuple] = None, elastic_dim=None,
+                   saved_topology=None, topology=None):
         dtype = np.dtype(entry["dtype"])
         shape = tuple(entry["shape"])
         files = entry["files"]
@@ -435,9 +904,59 @@ class CheckpointManager:
                 part = self._read_part(d, f, dtype) \
                     .reshape(tuple(f["part_shape"]))
                 arr[_index_from_json(f["index"], shape)] = part
-        if sharding is not None:
+        if want_shape is not None and shape != want_shape:
+            arr = self._elastic_reshape(entry, arr, want_shape,
+                                        elastic_dim, saved_topology,
+                                        topology)
+        return self._place(arr, sharding)
+
+    def _elastic_reshape(self, entry: Dict, arr: np.ndarray,
+                         want_shape: Tuple, elastic_dim,
+                         saved_topology, topology) -> np.ndarray:
+        """Re-shard a topology-dependent leaf: slice its leading dim to
+        the logical rows and zero-re-pad to this run's padded width.
+        The pad rows are inert under the (elementwise) ZeRO-1 update,
+        so the logical state stays bit-identical across widths.  Any
+        shape change the policy does not cover is a topology refusal,
+        not corruption."""
+        shape = tuple(arr.shape)
+        ok = (elastic_dim is not None and len(shape) == len(want_shape)
+              and len(shape) >= 1 and shape[1:] == want_shape[1:]
+              and shape[0] >= int(elastic_dim)
+              and want_shape[0] >= int(elastic_dim))
+        if not ok:
+            topo = ""
+            if saved_topology is not None or topology is not None:
+                topo = " (saved topology: %s; current topology: %s)" % (
+                    json.dumps(saved_topology, sort_keys=True),
+                    json.dumps(topology, sort_keys=True))
+            raise CheckpointTopologyError(
+                "checkpoint leaf %r was saved with shape %s but this "
+                "run expects %s — only the padded leading dim of a "
+                "ZeRO-sharded optimizer-state leaf can be re-sharded "
+                "across topologies%s" % (entry.get("key"), list(shape),
+                                         list(want_shape), topo))
+        logical = int(elastic_dim)
+        out = arr[:logical]
+        if want_shape[0] > logical:
+            pad = np.zeros((want_shape[0] - logical,) + tuple(want_shape[1:]),
+                           arr.dtype)
+            out = np.concatenate([out, pad], axis=0)
+        return np.ascontiguousarray(out)
+
+    @staticmethod
+    def _place(arr: np.ndarray, sharding):
+        """Put a restored host array back on its training placement.
+        A sharding spanning processes (multihost restore) cannot go
+        through ``device_put`` — each process supplies its addressable
+        shards through the callback and jax assembles the global
+        array."""
+        if sharding is None:
+            return jnp.asarray(arr)
+        if getattr(sharding, "is_fully_addressable", True):
             return jax.device_put(arr, sharding)
-        return jnp.asarray(arr)
+        return jax.make_array_from_callback(
+            tuple(arr.shape), sharding, lambda idx: arr[idx])
 
     def _read_part(self, d: str, f: Dict, dtype) -> np.ndarray:
         path = os.path.join(d, f["file"])
@@ -486,21 +1005,84 @@ def checkpoint_requested(since: int = 0) -> bool:
     return _CKPT_SEQ > since
 
 
+# signum -> the handler we displaced; the presence of a key means OUR
+# hook currently owns that signal (the idempotency token)
+_HOOK_PREVIOUS: Dict[int, Any] = {}
+
+
 def install_preemption_hook(signals=(signal.SIGTERM,), chain=True):
     """Install handlers that flip the checkpoint-request flag on
     preemption signals (must run on the main thread).  The handler is
     async-signal-light — it only sets an event; the actual save happens
     at the next step boundary on the training thread, where device
     state is consistent.  ``chain=True`` forwards to any previously
-    installed handler.  Returns ``{signum: previous_handler}``."""
-    previous = {}
+    installed handler.  Returns ``{signum: previous_handler}``.
 
-    def _handler(signum, frame):
-        request_checkpoint()
-        prev = previous.get(signum)
-        if chain and callable(prev):
-            prev(signum, frame)
+    Idempotent: a signal already carrying this hook is left untouched
+    (re-installing never chains the hook onto itself, which would
+    multiply every request).  Exception-safe: if installing the k-th
+    handler raises (bad signal number, non-main thread), the handlers
+    already swapped in are rolled back before the error propagates —
+    the process is never left half-hooked."""
+    installed_now = {}
+    try:
+        for s in signals:
+            s = int(s)
+            if s in _HOOK_PREVIOUS and getattr(
+                    signal.getsignal(s), "_mxtpu_preemption_hook", False):
+                # the LIVE handler is ours: idempotent no-op.  (The
+                # latch alone is not enough — third-party code may have
+                # displaced the handler since; then we must re-install,
+                # chaining to the displacer.)
+                continue
 
-    for s in signals:
-        previous[s] = signal.signal(s, _handler)
-    return previous
+            def _handler(signum, frame):
+                request_checkpoint()
+                prev = _HOOK_PREVIOUS.get(signum)
+                if chain and callable(prev):
+                    prev(signum, frame)
+
+            _handler._mxtpu_preemption_hook = True
+            prev = signal.signal(s, _handler)
+            installed_now[s] = prev
+            if not getattr(prev, "_mxtpu_preemption_hook", False):
+                # never record our own (stale) hook as the previous
+                # handler — chaining onto ourselves would multiply
+                # every request
+                _HOOK_PREVIOUS[s] = prev
+            elif s not in _HOOK_PREVIOUS:
+                _HOOK_PREVIOUS[s] = None
+    except BaseException:
+        for s, prev in installed_now.items():
+            _HOOK_PREVIOUS.pop(s, None)
+            try:
+                signal.signal(
+                    s, prev if prev is not None else signal.SIG_DFL)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+        raise
+    return {int(s): _HOOK_PREVIOUS[int(s)] for s in signals}
+
+
+def uninstall_preemption_hook(signals=None):
+    """Restore the dispositions :func:`install_preemption_hook`
+    displaced (all of them with ``signals=None``).  Returns the
+    restored ``{signum: handler}`` map.  Called by the step loop when a
+    preemption-triggered save FAILS: leaving the hook installed would
+    swallow every further SIGTERM into another doomed save request —
+    after this, a repeated signal terminates the process normally."""
+    sigs = list(_HOOK_PREVIOUS) if signals is None else \
+        [int(s) for s in signals]
+    restored = {}
+    for s in sigs:
+        if s not in _HOOK_PREVIOUS:
+            continue
+        prev = _HOOK_PREVIOUS.pop(s)
+        try:
+            signal.signal(s, prev if prev is not None else signal.SIG_DFL)
+        except (ValueError, OSError) as e:  # non-main thread / bad signum
+            warnings.warn("could not restore handler for signal %d: %s"
+                          % (s, e))
+            continue
+        restored[s] = prev
+    return restored

@@ -1,0 +1,265 @@
+"""Multi-process (multi-host) bootstrap for elastic training.
+
+The reference framework's distributed substrate is ps-lite: a scheduler
+process rendezvouses N workers and ``tools/launch.py`` exports the
+``DMLC_*`` environment that names it (SURVEY.md §2.9).  The TPU-native
+substrate is ``jax.distributed``: every process dials the coordinator
+(process 0), after which ``jax.devices()`` returns the GLOBAL device
+list and one GSPMD program spans all hosts.  This module is the one
+home for that bootstrap plus the process-topology helpers the elastic
+checkpoint layer (``parallel/checkpoint.py``) builds on:
+
+- :func:`initialize` — idempotent rendezvous from explicit args or the
+  ``DMLC_*`` launcher environment (same contract ``kvstore/dist.py``
+  has always consumed; that module now delegates here);
+- :func:`barrier` — a named cross-process sync point;
+- :func:`make_process_mesh` — a process-spanning ``dp×pp×...`` mesh
+  with a deterministic global device order, so every process builds
+  the IDENTICAL mesh object;
+- :func:`resplit_iter_state` — the elastic data-stream half: re-split
+  the PR-5 per-process iterator states saved at N data shards onto M
+  restarted processes (reusing the ``part_index``/``num_parts``
+  stamping), refusing loudly when the parts have diverged.
+
+Everything is importable and callable in a plain single-process run:
+``initialize`` is a no-op at world size 1, ``barrier`` returns
+immediately, and ``make_process_mesh`` degrades to ``make_mesh``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence
+
+import jax
+
+from .mesh import Mesh, global_devices, make_mesh
+
+__all__ = ["DistributedInitError", "barrier", "collectives_supported",
+           "initialize", "is_initialized", "make_process_mesh",
+           "process_count", "process_index", "resplit_iter_state"]
+
+
+class DistributedInitError(RuntimeError):
+    """The multi-process rendezvous failed (coordinator unreachable,
+    world-size/rank mismatch, double-init with different topology)."""
+
+
+_INITIALIZED = False
+_BARRIER_COUNT = 0
+
+
+def _env_world() -> int:
+    return int(os.environ.get("DMLC_NUM_WORKER", "1"))
+
+
+def _raw_initialize(coordinator: str, num_processes: int, rank: int,
+                    timeout: Optional[float]) -> None:
+    """The actual ``jax.distributed.initialize`` call — module-level so
+    the fault harness (``fault_injection.coordinator_unreachable``) can
+    interpose a failing coordinator without real sockets/timeouts."""
+    kwargs = {}
+    if timeout is not None:
+        kwargs["initialization_timeout"] = int(timeout)
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=rank, **kwargs)
+
+
+def is_initialized() -> bool:
+    """True once this process has rendezvoused with its peers."""
+    return _INITIALIZED
+
+
+def process_index() -> int:
+    """This process's rank (0 in a single-process run)."""
+    return jax.process_index() if _INITIALIZED else 0
+
+
+def process_count() -> int:
+    """World size (1 in a single-process run)."""
+    return jax.process_count() if _INITIALIZED else 1
+
+
+def initialize(coordinator: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None,
+               timeout: Optional[float] = None) -> int:
+    """Rendezvous this process with its peers (idempotent).
+
+    Arguments default to the ``DMLC_*`` environment exported by
+    ``tools/launch.py`` (the reference launcher contract:
+    ``DMLC_PS_ROOT_URI``/``PORT`` name the coordinator,
+    ``DMLC_NUM_WORKER`` the world size, ``DMLC_WORKER_ID`` this rank).
+    Returns the world size.  A world size of 1 returns immediately
+    WITHOUT latching, so a later call with a real topology still works.
+
+    Failures surface as :class:`DistributedInitError` naming the
+    coordinator and rank — the raw backend error (a gRPC deadline, a
+    refused connection) rides along as ``__cause__``.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return jax.process_count()
+    num_processes = num_processes if num_processes is not None \
+        else _env_world()
+    if num_processes <= 1:
+        return 1
+    if coordinator is None:
+        uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        port = os.environ.get("DMLC_PS_ROOT_PORT", "9091")
+        coordinator = "%s:%s" % (uri, port)
+    rank = process_id if process_id is not None else int(
+        os.environ.get("DMLC_WORKER_ID", "0"))
+    try:
+        _raw_initialize(coordinator, int(num_processes), int(rank), timeout)
+    except Exception as e:
+        raise DistributedInitError(
+            "jax.distributed rendezvous failed: process %d/%d could not "
+            "join coordinator %s (%s).  Check that the coordinator "
+            "process is up, the DMLC_* environment matches on every "
+            "host, and no stale process holds the port."
+            % (rank, num_processes, coordinator, e)) from e
+    _INITIALIZED = True
+    return int(num_processes)
+
+
+def barrier(tag: Optional[str] = None) -> None:
+    """Block until every process reaches this barrier (no-op at world
+    size 1).  ``tag`` names the sync point in errors/traces; untagged
+    barriers auto-number so two different call sites can never pair up
+    with each other across processes."""
+    global _BARRIER_COUNT
+    if process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    _BARRIER_COUNT += 1
+    multihost_utils.sync_global_devices(
+        "mxtpu_barrier_%s" % (tag or _BARRIER_COUNT))
+
+
+_COLLECTIVES_OK: Optional[bool] = None
+
+
+def collectives_supported() -> bool:
+    """Whether the backend can COMPILE cross-process computations.
+
+    Some CPU jaxlib builds rendezvous fine (``jax.distributed`` init,
+    process indices, shared-filesystem protocols all work) but refuse
+    multi-process programs ("Multiprocess computations aren't
+    implemented on the CPU backend").  Probed once with a barrier and
+    cached; trivially True at world size 1.  Callers that can degrade —
+    per-process replicated training instead of one GSPMD program — use
+    this to choose (``tests/elastic_worker.py``)."""
+    global _COLLECTIVES_OK
+    if process_count() <= 1:
+        return True
+    if _COLLECTIVES_OK is None:
+        try:
+            barrier("collectives-probe")
+        except Exception:
+            _COLLECTIVES_OK = False
+        else:
+            _COLLECTIVES_OK = True
+    return _COLLECTIVES_OK
+
+
+def make_process_mesh(axes: Dict[str, int],
+                      devices: Optional[Sequence] = None) -> Mesh:
+    """A process-spanning mesh over the GLOBAL device list.
+
+    Like :func:`~.mesh.make_mesh` (``-1`` axis inference included) but
+    the default device list is every process's devices in the
+    deterministic ``(process_index, device id)`` order — so every
+    process constructs the IDENTICAL mesh, which GSPMD requires for a
+    multi-process program.  On a single process this is exactly
+    ``make_mesh``.
+    """
+    if devices is None:
+        devices = global_devices()
+    return make_mesh(axes, devices=devices)
+
+
+# ---------------------------------------------------------------------------
+# elastic data-stream re-split
+# ---------------------------------------------------------------------------
+
+_PART_KEYS = ("part_index", "num_parts")
+
+
+def _strip_part_stamps(state):
+    """Copy of an iterator-state tree with every ``part_index``/
+    ``num_parts`` stamp removed (recursively) — the part-invariant
+    core two shards of the same stream must agree on."""
+    if isinstance(state, dict):
+        return {k: _strip_part_stamps(v) for k, v in state.items()
+                if k not in _PART_KEYS}
+    if isinstance(state, (list, tuple)):
+        return [_strip_part_stamps(v) for v in state]
+    return state
+
+
+def _restamp_parts(state, part_index: int, num_parts: int):
+    """Copy of an iterator-state tree with every dict that carries the
+    part stamping re-stamped to the new shard identity."""
+    if isinstance(state, dict):
+        out = {k: _restamp_parts(v, part_index, num_parts)
+               for k, v in state.items()}
+        if all(k in state for k in _PART_KEYS):
+            out["part_index"] = int(part_index)
+            out["num_parts"] = int(num_parts)
+        return out
+    if isinstance(state, (list, tuple)):
+        return [_restamp_parts(v, part_index, num_parts) for v in state]
+    return state
+
+
+def resplit_iter_state(parts: Dict, part_index: int, num_parts: int):
+    """Re-split per-process iterator states saved at N data shards onto
+    the ``part_index``-th of ``num_parts`` restarted shards.
+
+    ``parts`` is the checkpoint's ``data_iter_parts`` section: saved
+    rank (int or str — JSON keys) → that rank's ``state_dict()``.
+
+    Policy (the docs/RESILIENCE.md re-shard matrix):
+
+    - **same width** (``num_parts == len(parts)``): each restarted
+      process takes its own saved part verbatim — nothing to re-split;
+    - **changed width**: only possible when every saved part carries
+      the SAME part-invariant state (identical epoch/cursor/RNG once
+      the ``part_index``/``num_parts`` stamps are stripped) — i.e. the
+      processes iterated replicated data, or a sharded reader at an
+      epoch boundary.  The surviving state is re-stamped with the new
+      shard identity.  Parts that have diverged (a sharded record
+      reader mid-epoch: each shard holds different records and a
+      different RNG) CANNOT be re-split bit-exactly, and this raises
+      ``ValueError`` naming the saved-vs-requested split instead of
+      silently replaying or skipping data.
+    """
+    if not parts:
+        raise ValueError("no saved iterator parts to re-split")
+    by_rank = {int(k): v for k, v in parts.items()}
+    saved_n = len(by_rank)
+    if sorted(by_rank) != list(range(saved_n)):
+        raise ValueError(
+            "saved iterator parts are not contiguous ranks: %r"
+            % (sorted(by_rank),))
+    if not 0 <= int(part_index) < int(num_parts):
+        raise ValueError("part_index %d outside num_parts %d"
+                         % (part_index, num_parts))
+    if int(num_parts) == saved_n:
+        return by_rank[int(part_index)]
+    import json as _json
+
+    cores = [_json.dumps(_strip_part_stamps(by_rank[r]), sort_keys=True)
+             for r in range(saved_n)]
+    if any(c != cores[0] for c in cores[1:]):
+        diverged = [r for r in range(1, saved_n) if cores[r] != cores[0]]
+        raise ValueError(
+            "iterator state saved at num_parts=%d cannot be re-split to "
+            "num_parts=%d: parts %s diverged from part 0 (a sharded "
+            "record stream mid-epoch holds different records per part). "
+            "Resume at the saved width, or restart the epoch with fresh "
+            "iterators at the new width."
+            % (saved_n, num_parts, diverged))
+    return _restamp_parts(by_rank[0], int(part_index), int(num_parts))

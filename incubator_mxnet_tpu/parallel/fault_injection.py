@@ -19,24 +19,37 @@ Deterministic, test-grade fault injectors for the failure classes
   (``io/resilient.py::_pull``) with transient errnos, injected latency
   and silent worker death, and :func:`truncate_record` tears a record
   file at a byte offset exactly like a crash mid-write — together they
-  drive ``tests/test_resilient_io.py``.
+  drive ``tests/test_resilient_io.py``;
+- **host loss** — :func:`kill_process` is a REAL ungraceful process
+  death (SIGKILL: no atexit, no flushes — what a preempted VM looks
+  like), :func:`host_loss_during_save` arms it on the N-th checkpoint
+  write so a host dies exactly mid-stage (the torn multi-process
+  checkpoint the commit protocol must never publish),
+  :func:`coordinator_unreachable` makes the ``jax.distributed``
+  rendezvous fail like a dead coordinator, and
+  :func:`straggler_process` delays this process's done-marker so the
+  commit coordinator's bounded wait is exercised — together they drive
+  ``tests/test_elastic.py``.
 
-Everything here is process-local monkeypatching or direct file surgery:
-no real signals, no real device faults — cheap enough for tier-1.
+Everything here is process-local monkeypatching or direct file surgery
+(plus the one genuinely lethal :func:`kill_process`, used only in
+spawned subprocess tests): cheap enough for tier-1.
 """
 from __future__ import annotations
 
 import errno as _errno
 import os
+import signal as _signal
 import time
 from contextlib import contextmanager
 from typing import Optional
 
 import numpy as np
 
-__all__ = ["NaNInjector", "corrupt_checkpoint", "fail_writes",
-           "flaky_reads", "kill_worker", "poison_batch", "slow_reads",
-           "truncate_record"]
+__all__ = ["NaNInjector", "coordinator_unreachable", "corrupt_checkpoint",
+           "fail_writes", "flaky_reads", "host_loss_during_save",
+           "kill_process", "kill_worker", "poison_batch", "slow_reads",
+           "straggler_process", "truncate_record"]
 
 
 def poison_batch(x, value=float("nan"), index=0):
@@ -217,19 +230,31 @@ def corrupt_checkpoint(directory, step=None, what="bitflip", which=0):
     ``what``: ``"bitflip"`` flips one bit mid-payload of the
     ``which``-th array file (silent corruption a checksum must catch);
     ``"truncate"`` halves the file (torn write); ``"manifest"``
-    truncates the manifest itself.
+    truncates the manifest itself; ``"torn_manifest"`` reproduces a
+    crash in the middle of the manifest commit itself — the manifest
+    is cut mid-JSON *and* a half-renamed ``manifest.json.tmp`` twin is
+    left beside it, exactly what a host loss between the manifest
+    write and the directory fsync can leave on some filesystems.
+    ``restore`` must treat both the same way: unparseable manifest →
+    corrupt candidate → fall back to the last fully-committed step.
     """
     from .checkpoint import _MANIFEST, _STEP_FMT, CheckpointManager
 
-    mgr = CheckpointManager(directory)
+    mgr = CheckpointManager(directory, process_count=1)
     step = mgr.latest_step() if step is None else int(step)
     if step is None:
         raise ValueError("no committed checkpoint under %r" % (directory,))
     d = os.path.join(str(directory), _STEP_FMT % step)
-    if what == "manifest":
+    if what in ("manifest", "torn_manifest"):
         path = os.path.join(d, _MANIFEST)
+        data = open(path, "rb").read()
+        if what == "torn_manifest":
+            # the half-renamed twin: full content under the pre-rename
+            # name, torn content under the committed name
+            with open(path + ".tmp", "wb") as f:
+                f.write(data)
         with open(path, "r+b") as f:
-            f.truncate(max(os.path.getsize(path) // 2, 1))
+            f.truncate(max(len(data) // 2, 1))
         return path
     names = sorted(n for n in os.listdir(d) if n.endswith(".bin"))
     if not names:
@@ -245,6 +270,105 @@ def corrupt_checkpoint(directory, step=None, what="bitflip", which=0):
             f.seek(0)
             f.write(data)
     else:
-        raise ValueError("what must be 'bitflip', 'truncate' or "
-                         "'manifest', got %r" % (what,))
+        raise ValueError("what must be 'bitflip', 'truncate', 'manifest' "
+                         "or 'torn_manifest', got %r" % (what,))
     return path
+
+
+# ---------------------------------------------------------------------------
+# host-loss scenarios (multi-process / elastic training)
+# ---------------------------------------------------------------------------
+
+def kill_process():
+    """Ungraceful death of THIS process — SIGKILL to self, the closest
+    userspace analog of a preempted VM or a kernel panic: no atexit
+    hooks, no buffer flushes, no signal handlers, collectives on peers
+    hang until their own timeouts.  Only for spawned subprocess tests
+    (``tests/elastic_worker.py``); it does not return."""
+    os.kill(os.getpid(), _signal.SIGKILL)
+    time.sleep(60)  # pragma: no cover — the signal wins
+
+
+@contextmanager
+def host_loss_during_save(at=1):
+    """Arm :func:`kill_process` on the ``at``-th (0-based) checkpoint
+    file write inside this context: the process dies exactly mid-stage,
+    leaving torn shard files / a torn done-marker in the shared staging
+    directory — the half-written multi-host checkpoint the commit
+    protocol must never publish.  Yields a stats object counting writes
+    seen before the kill."""
+    from . import checkpoint as _ckpt
+
+    real = _ckpt._write_bytes
+
+    class _Stats:
+        seen = 0
+
+    stats = _Stats()
+
+    def lethal(path, data):
+        i = stats.seen
+        stats.seen += 1
+        if i == at:
+            # tear the file first: a real host loss interrupts write(2)
+            # mid-buffer, so successors must cope with partial bytes
+            with open(path, "wb") as f:
+                f.write(data[:max(len(data) // 2, 1)])
+            kill_process()
+        return real(path, data)
+
+    _ckpt._write_bytes = lethal
+    try:
+        yield stats
+    finally:
+        _ckpt._write_bytes = real
+
+
+@contextmanager
+def coordinator_unreachable(message="connection refused (injected)"):
+    """Make the ``jax.distributed`` rendezvous fail as if the
+    coordinator host is gone: ``parallel/distributed.py``'s backend
+    call raises immediately instead of blocking out a real gRPC
+    deadline.  The bootstrap must surface a clear
+    ``DistributedInitError`` naming coordinator and rank."""
+    from . import distributed as _dist
+
+    real = _dist._raw_initialize
+
+    def refuse(coordinator, num_processes, rank, timeout):
+        raise ConnectionError("%s [coordinator %s]" % (message, coordinator))
+
+    _dist._raw_initialize = refuse
+    try:
+        yield
+    finally:
+        _dist._raw_initialize = real
+
+
+@contextmanager
+def straggler_process(delay_s):
+    """Delay THIS process's done-marker by ``delay_s`` seconds during a
+    multi-process checkpoint save — the straggling-host case the commit
+    coordinator's bounded ``commit_timeout`` wait must either absorb
+    (slow peer) or abort on (lost peer) without ever publishing a
+    partial checkpoint."""
+    from . import checkpoint as _ckpt
+
+    real = _ckpt._write_bytes
+
+    class _Stats:
+        delayed = 0
+
+    stats = _Stats()
+
+    def slow(path, data):
+        if os.path.basename(path).startswith("done-"):
+            stats.delayed += 1
+            time.sleep(delay_s)
+        return real(path, data)
+
+    _ckpt._write_bytes = slow
+    try:
+        yield stats
+    finally:
+        _ckpt._write_bytes = real

@@ -27,7 +27,8 @@ except ImportError:  # jax 0.4.x
     from jax.experimental.shard_map import shard_map
 
 __all__ = ["Mesh", "NamedSharding", "PartitionSpec", "P", "make_mesh",
-           "replicated", "shard_along", "current_devices", "shard_map"]
+           "replicated", "shard_along", "current_devices", "shard_map",
+           "global_devices", "spans_processes"]
 
 P = PartitionSpec
 
@@ -37,6 +38,24 @@ def current_devices(platform=None):
     if platform:
         devs = [d for d in devs if d.platform == platform]
     return devs
+
+
+def global_devices(platform=None):
+    """Every process's devices in deterministic ``(process_index, id)``
+    order — the canonical device list for a process-spanning mesh
+    (every process must enumerate identically for one GSPMD program to
+    span them; ``parallel/distributed.py::make_process_mesh`` builds on
+    this)."""
+    return sorted(current_devices(platform),
+                  key=lambda d: (d.process_index, d.id))
+
+
+def spans_processes(mesh: Mesh) -> bool:
+    """True when the mesh contains devices of more than one process —
+    the multihost/multi-process regime where state arrays are global
+    and checkpoints need the per-process commit protocol."""
+    return any(d.process_index != jax.process_index()
+               for d in mesh.devices.flat)
 
 
 def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:

@@ -1155,9 +1155,10 @@ class TrainStep:
             self._opt_state = self.opt.init(pv)
         if self._jit is None:
             self._jit = self._build()
-            self._multihost = self.mesh is not None and any(
-                d.process_index != jax.process_index()
-                for d in self.mesh.devices.flat)
+            from .mesh import spans_processes
+
+            self._multihost = self.mesh is not None \
+                and spans_processes(self.mesh)
         if self._key_dev is None or self._key_epoch != rng.epoch():
             # (re)draw the carried key — also when the user reseeded after
             # steps already ran (mx.random.seed / rng.set_state must keep
@@ -1189,24 +1190,27 @@ class TrainStep:
         process's devices (SURVEY §5.8)."""
         p_sh, aux_sh, state_sh, _, repl = self._shardings
         if self._multihost:
-            from jax.experimental import multihost_utils as mhu
+            # every host holds the FULL state value (identical after
+            # seeded init / broadcast); each device fetches its slice of
+            # it through the callback.  NOT host_local_array_to_global:
+            # that treats the local value as this host's SHARD, which
+            # would stack N full copies of a dp-sharded ZeRO-1 state
+            # leaf into an N×-too-tall global array.
+            def _globalize(v, s):
+                host = np.asarray(v)
+                return jax.make_array_from_callback(
+                    host.shape, s, lambda idx: host[idx])
 
-            p_vals = [mhu.host_local_array_to_global_array(
-                v, self.mesh, s.spec) for v, s in zip(p_vals, p_sh)]
-            aux_vals = [mhu.host_local_array_to_global_array(
-                v, self.mesh, s.spec) for v, s in zip(aux_vals, aux_sh)]
-            self._opt_state = jax.tree.map(
-                lambda v, s: mhu.host_local_array_to_global_array(
-                    v, self.mesh, s.spec), self._opt_state, state_sh)
-            # carried key/step/scaler must be identical across hosts (same
-            # seed); promote the host-local replicas to global arrays
-            self._key_dev = mhu.host_local_array_to_global_array(
-                self._key_dev, self.mesh, repl.spec)
-            self._step_dev = mhu.host_local_array_to_global_array(
-                self._step_dev, self.mesh, repl.spec)
-            self._scaler_dev = tuple(
-                mhu.host_local_array_to_global_array(v, self.mesh, repl.spec)
-                for v in self._scaler_dev)
+            p_vals = [_globalize(v, s) for v, s in zip(p_vals, p_sh)]
+            aux_vals = [_globalize(v, s) for v, s in zip(aux_vals, aux_sh)]
+            self._opt_state = jax.tree.map(_globalize, self._opt_state,
+                                           state_sh)
+            # carried key/step/scaler must be identical across hosts
+            # (same seed); promote the host-local replicas too
+            self._key_dev = _globalize(self._key_dev, repl)
+            self._step_dev = _globalize(self._step_dev, repl)
+            self._scaler_dev = tuple(_globalize(v, repl)
+                                     for v in self._scaler_dev)
         else:
             p_vals = [jax.device_put(v, s) for v, s in zip(p_vals, p_sh)]
             aux_vals = [jax.device_put(v, s)
@@ -1490,6 +1494,58 @@ class TrainStep:
             return directory_or_manager
         return CheckpointManager(directory_or_manager, keep_last=keep_last)
 
+    @staticmethod
+    def _host_int(x) -> int:
+        """Host value of a replicated device scalar — via the first
+        addressable shard, which works for multihost global arrays
+        (``device_get`` would demand full addressability)."""
+        if hasattr(x, "addressable_data"):
+            return int(np.asarray(x.addressable_data(0)))
+        return int(jax.device_get(x))
+
+    def _topology(self):
+        """JSON description of this step's training topology — stamped
+        into every checkpoint's meta so an elastic restore can name
+        saved-vs-current in its refusals."""
+        mesh = None if self.mesh is None else \
+            {a: int(s) for a, s in self.mesh.shape.items()}
+        return {"mesh": mesh, "batch_axis": self.batch_axis,
+                "zero": self.zero,
+                "pipeline_stages": self.pipeline_stages,
+                "processes": jax.process_count()}
+
+    def _elastic_policy(self):
+        """Pytree congruent with :meth:`_checkpoint_state` marking what
+        an elastic (changed-dp-width) restore may re-shape: ``None``
+        leaves demand the exact saved shape; an ``int`` is the LOGICAL
+        leading dim of a ZeRO-1 optimizer-state leaf whose stored dim
+        is padded to a multiple of the dp width — the manager re-slices
+        and re-pads those (``CheckpointManager.restore(elastic=)``).
+        Everything else — params, aux, RNG key, step counter,
+        loss-scale state — is topology-independent by construction.
+
+        The marks are computed for every ZeRO-ELIGIBLE param (≥1-d, not
+        tp/ep-sharded) regardless of this step's own ``zero`` mode: a
+        ZeRO-mode change is itself elastic (the state re-pads either
+        way, ``checkpoint._topology_mismatch``), so a ``zero=0`` run
+        must still be able to un-pad a ``zero=1`` checkpoint's
+        optimizer state."""
+        if self.zero and self._zero_pad0 is not None:
+            covered = [pad is not None for pad in self._zero_pad0]
+        else:
+            covered = []
+            for p in self._gp:
+                spec = tuple(self.param_shardings.get(p.name, P()))
+                sharded = any(e is not None and e != () for e in spec)
+                covered.append(not sharded and len(p.shape) >= 1)
+        marks = [int(p.shape[0]) if c else None
+                 for p, c in zip(self._gp, covered)]
+        return {"params": [None] * len(self._gp),
+                "aux": [None] * len(self._aux),
+                "opt_state": self.opt.state_shardings(marks),
+                "rng_key": None, "step": None,
+                "loss_scale": (None, None, None)}
+
     def save_checkpoint(self, directory_or_manager, keep_last=3,
                         data_iter=None):
         """Atomically checkpoint the full training state (see
@@ -1501,21 +1557,22 @@ class TrainStep:
         arrays, so ``restore_checkpoint(..., data_iter=)`` resumes the
         data stream at the exact next batch instead of silently
         replaying the epoch from batch 0.  Defaults to the iterator
-        bound by ``attach_checkpoint(data_iter=...)``."""
+        bound by ``attach_checkpoint(data_iter=...)``.
+
+        On a process-spanning (multihost) mesh every process must call
+        this cooperatively with the same shared directory: each stages
+        only its addressable shards plus a done-marker, and process 0
+        verifies all markers before atomically publishing the single
+        manifest (``parallel/checkpoint.py``'s commit protocol)."""
         self._ensure_built()
-        if self._multihost:
-            raise NotImplementedError(
-                "multihost checkpointing needs per-process shard files; "
-                "save from a single-controller run")
         mgr = self._as_manager(directory_or_manager, keep_last)
         state = self._checkpoint_state()
         if data_iter is None:
             data_iter = self._ckpt_data_iter
-        meta = None
+        meta = {"topology": self._topology()}
         if data_iter is not None:
-            meta = {"data_iter": data_iter.state_dict()}
-        return mgr.save(int(jax.device_get(self._step_dev)), state,
-                        meta=meta)
+            meta["data_iter"] = data_iter.state_dict()
+        return mgr.save(self._host_int(self._step_dev), state, meta=meta)
 
     def restore_checkpoint(self, directory_or_manager, step=None,
                            data_iter=None):
@@ -1537,18 +1594,33 @@ class TrainStep:
         pre-protocol checkpoints.  The reverse mismatch — the
         checkpoint carries iterator state but no iterator was passed
         or attached — warns too: the restored run would silently
-        replay its epoch from batch 0."""
+        replay its epoch from batch 0.
+
+        **Elastic restore**: a checkpoint saved on a different dp width
+        (e.g. dp=8 → this step's dp=4) restores bit-exactly — the
+        dp-padded ZeRO-1 optimizer-state leaves are re-sliced/re-padded
+        to this width, per-process iterator states are re-split across
+        the new process count, and everything else (params, RNG key,
+        step counter, loss-scale state) is topology-independent.  What
+        CANNOT be re-sharded (a pipeline width change, a diverged
+        sharded data stream, a different batching) raises
+        :class:`~.checkpoint.CheckpointTopologyError` naming the saved
+        and current topologies."""
+        from .checkpoint import CheckpointTopologyError
+
         self._ensure_built()
         mgr = self._as_manager(directory_or_manager)
         like = self._checkpoint_state()
         step_no, state, meta = mgr.restore(
             like, step=step, shardings=self._checkpoint_shardings(),
-            return_meta=True)
+            return_meta=True, elastic=self._elastic_policy(),
+            topology=self._topology())
+        saved_topo = (meta or {}).get("topology")
         explicit_iter = data_iter is not None
         if data_iter is None:
             data_iter = self._ckpt_data_iter
         if data_iter is not None:
-            iter_state = (meta or {}).get("data_iter")
+            iter_state = self._resolve_iter_state(meta, saved_topo)
             if iter_state is None:
                 msg = ("checkpoint step %d carries no data-iterator state "
                        "(saved without data_iter=) — restoring this "
@@ -1564,7 +1636,18 @@ class TrainStep:
 
                 warnings.warn(msg + " (iterator left untouched)")
             else:
-                data_iter.load_state_dict(iter_state)
+                try:
+                    data_iter.load_state_dict(iter_state)
+                except (ValueError, KeyError) as e:
+                    # batching/shuffle/dataset drift: the iterator names
+                    # the exact field; wrap it with the topologies so an
+                    # elastic restart knows WHICH run disagrees
+                    raise CheckpointTopologyError(
+                        "checkpoint step %d: the data iterator refused "
+                        "the checkpointed stream state: %s (saved "
+                        "topology: %s; current topology: %s)"
+                        % (step_no, e, saved_topo, self._topology())) \
+                        from e
         elif (meta or {}).get("data_iter") is not None:
             import warnings
 
@@ -1592,6 +1675,27 @@ class TrainStep:
             # the manager; skip the one-time placement pass
             self._placed = True
         return step_no
+
+    def _resolve_iter_state(self, meta, saved_topo):
+        """This process's share of the checkpointed data-stream state.
+        A multi-process save carries one state per saved process under
+        ``data_iter_parts``; they are re-split across the CURRENT
+        process count (``distributed.resplit_iter_state`` — verbatim at
+        the same width, re-stamped when every part agrees, refused with
+        the topologies named when the shards diverged)."""
+        parts = (meta or {}).get("data_iter_parts")
+        if not parts:
+            return (meta or {}).get("data_iter")
+        from . import distributed as _dist
+        from .checkpoint import CheckpointTopologyError
+
+        try:
+            return _dist.resplit_iter_state(
+                parts, jax.process_index(), jax.process_count())
+        except ValueError as e:
+            raise CheckpointTopologyError(
+                "%s (saved topology: %s; current topology: %s)"
+                % (e, saved_topo, self._topology())) from e
 
     def attach_checkpoint(self, directory_or_manager, every=None,
                           keep_last=3, data_iter=None):
@@ -1650,7 +1754,8 @@ class TrainStep:
         # SIGTERM hook) must reach EVERY attached step loop, so each
         # remembers the last sequence IT honored — no global clear
         seq = _ckpt.request_seq()
-        due = seq > self._ckpt_seen_request
+        requested = seq > self._ckpt_seen_request
+        due = requested
         if self._ckpt_every:
             # boundary CROSSING, not exact divisibility: run_steps
             # advances the counter by k per call, so `% every == 0`
@@ -1659,7 +1764,37 @@ class TrainStep:
             self._ckpt_prev_count = cur
             due = due or prev // self._ckpt_every != cur // self._ckpt_every
         if due:
-            self.save_checkpoint(self._ckpt_manager)
+            try:
+                self.save_checkpoint(self._ckpt_manager)
+            except BaseException as e:
+                import warnings
+
+                if requested:
+                    # a PREEMPTION-requested save failed (disk full,
+                    # lost peer): log, restore the pre-hook signal
+                    # disposition, and re-raise.  Leaving the hook
+                    # installed would swallow every further SIGTERM
+                    # into another doomed save request — after this, a
+                    # repeated signal terminates the process normally
+                    # and the last COMMITTED checkpoint is what resume
+                    # sees.  A purely PERIODIC save failing (no signal
+                    # involved) keeps the hook: the next boundary may
+                    # well succeed, and graceful preemption must not be
+                    # silently disabled by one transient blip.
+                    warnings.warn(
+                        "preemption checkpoint save failed (%s: %s); "
+                        "restoring the previous signal disposition so a "
+                        "repeated preemption signal terminates instead "
+                        "of re-requesting a save that cannot succeed"
+                        % (type(e).__name__, e))
+                    _ckpt.uninstall_preemption_hook()
+                else:
+                    warnings.warn(
+                        "periodic checkpoint save failed (%s: %s); the "
+                        "last committed checkpoint is unchanged and the "
+                        "schedule will retry at the next boundary"
+                        % (type(e).__name__, e))
+                raise
             self._ckpt_seen_request = seq
 
 

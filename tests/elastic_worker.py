@@ -1,0 +1,155 @@
+"""Worker body for the elastic kill-and-rejoin smoke test
+(tests/test_elastic.py — the multi-process half of docs/RESILIENCE.md
+"Multi-host & elastic").
+
+Driven through the same subprocess harness as tests/dist_worker.py
+(tools/launch.py launch_local → fresh interpreters, jax.distributed
+rendezvous from the DMLC_* env).  Two modes:
+
+- ``train`` (N processes): build a process-spanning dp mesh through
+  ``parallel.distributed``, train with zero=1 on rank-sliced global
+  batches, commit a coordinated multi-process checkpoint at step 2,
+  then suffer a fault-injected host loss DURING the step-4 save:
+  rank 1 SIGKILLs itself mid-stage (``host_loss_during_save``), rank 0
+  times out waiting for its done-marker and exits nonzero — leaving a
+  torn, uncommitted stage beside the intact step-2 checkpoint.
+- ``resume`` (M processes, the test uses 1): restore from the last
+  COMMITTED checkpoint (the torn step-4 stage must never be selected),
+  elastically re-sharding the dp=2-padded ZeRO state onto the dp=1
+  mesh and re-splitting the 2-part iterator state, then continue and
+  dump the observed losses for the parent to compare.
+
+Each rank appends its observations to <outdir>/<mode>_rank<r>.json.
+"""
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# the parent test process forces 8 virtual cpu devices via XLA_FLAGS;
+# each elastic worker must be a 1-device host (the mesh spans PROCESSES)
+os.environ["XLA_FLAGS"] = " ".join(
+    f for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f)
+
+import numpy as np
+
+GLOBAL_BATCH = 8
+
+
+def _dump(outdir, mode, rank, **obs):
+    path = os.path.join(outdir, "%s_rank%d.json" % (mode, rank))
+    with open(path, "w") as f:
+        json.dump(obs, f)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def main():
+    outdir, mode = sys.argv[1], sys.argv[2]
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, nd
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.io import NDArrayIter, ResilientIter
+    from incubator_mxnet_tpu.parallel import (CheckpointManager,
+                                              distributed,
+                                              make_train_step)
+    from incubator_mxnet_tpu.parallel import fault_injection as fi
+
+    from incubator_mxnet_tpu.parallel import make_mesh
+
+    distributed.initialize()  # DMLC_* env; no-op at world size 1
+    rank = distributed.process_index()
+    nproc = distributed.process_count()
+    # some CPU jaxlib builds rendezvous fine but cannot COMPILE
+    # multi-process programs; degrade to per-process replicated
+    # training (identical global batches on every rank → bitwise
+    # identical state, no collectives) — the multi-process CHECKPOINT
+    # protocol (markers, commit, kill, rejoin) is filesystem-only and
+    # runs for real either way
+    spmd = nproc > 1 and distributed.collectives_supported()
+    if spmd or nproc == 1:
+        mesh = distributed.make_process_mesh({"dp": -1})
+    else:
+        mesh = make_mesh({"dp": 1}, devices=jax.local_devices()[:1])
+
+    # resume mode initializes DIFFERENTLY on purpose: restore must win
+    mx.random.seed(0 if mode == "train" else 9)
+    net = nn.HybridSequential()
+    for _ in range(2):
+        net.add(nn.Dense(16, activation="tanh"))
+    net.add(nn.Dense(13))  # ragged head: real re-pad across dp widths
+    net.initialize(init=mx.init.Xavier())
+    net(nd.ones((2, 16)))
+    step = make_train_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           optimizer="adam", learning_rate=0.01, mesh=mesh,
+                           batch_axis="dp", zero=1, lint="error")
+    mgr = CheckpointManager(os.path.join(outdir, "ckpt"),
+                            commit_timeout=10.0)
+
+    # deterministic GLOBAL stream: every process holds the full data and
+    # feeds only its row slice of each global batch (the host-local
+    # shard the multihost step expects); identical iterator state on
+    # every rank → elastically re-splittable across process counts
+    rngd = np.random.RandomState(5)
+    X = rngd.rand(64, 16).astype(np.float32)
+    Y = rngd.randint(0, 4, 64).astype(np.float32)
+    np.random.seed(3)
+    it = ResilientIter(NDArrayIter(X, Y, batch_size=GLOBAL_BATCH,
+                                   shuffle=True))
+    if spmd:  # each process feeds its row slice of the global batch
+        lo = rank * GLOBAL_BATCH // nproc
+        hi = (rank + 1) * GLOBAL_BATCH // nproc
+    else:  # replicated: every process computes the full global batch
+        lo, hi = 0, GLOBAL_BATCH
+
+    def one_step(batch):
+        x = nd.array(np.ascontiguousarray(batch.data[0].asnumpy()[lo:hi]))
+        y = nd.array(np.ascontiguousarray(batch.label[0].asnumpy()[lo:hi]))
+        return float(step(x, y).asscalar())
+
+    losses = []
+    if mode == "train":
+        for k in range(4):
+            losses.append(one_step(it.next()))
+            _dump(outdir, mode, rank, losses=losses, steps=mgr.steps(),
+                  spmd=spmd)
+            if k == 1:
+                step.save_checkpoint(mgr, data_iter=it)  # commits step-2
+                _dump(outdir, mode, rank, losses=losses,
+                      steps=mgr.steps(), spmd=spmd)
+        # fault-injected host loss during the step-4 save: rank 1 dies
+        # mid-stage; rank 0's marker wait times out; the torn stage is
+        # never committed and the job exits nonzero
+        if rank == 1:
+            with fi.host_loss_during_save(at=0):
+                step.save_checkpoint(mgr, data_iter=it)
+            _dump(outdir, mode, rank, losses=losses, steps=mgr.steps(),
+                  spmd=spmd, error="host_loss_did_not_fire")
+            sys.exit(4)  # the kill must not be survivable
+        try:
+            step.save_checkpoint(mgr, data_iter=it)
+        except Exception as e:
+            _dump(outdir, mode, rank, losses=losses, steps=mgr.steps(),
+                  spmd=spmd, error=type(e).__name__)
+            sys.exit(3)  # the expected path: peer lost, save refused
+        _dump(outdir, mode, rank, losses=losses, steps=mgr.steps(),
+              spmd=spmd, error="commit_unexpectedly_succeeded")
+        sys.exit(5)
+    else:  # resume
+        restored = step.restore_checkpoint(mgr, data_iter=it)
+        for _ in range(2):
+            losses.append(one_step(it.next()))
+        _dump(outdir, mode, rank, losses=losses, steps=mgr.steps(),
+              spmd=spmd, restored=restored,
+              loss_scale=step.loss_scale, step_count=step.step_count)
+        print("elastic resume worker ok (rank %d/%d, restored step %d)"
+              % (rank, nproc, restored), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -205,9 +205,13 @@ def test_resave_same_step_and_stale_staging_sweep(tmp_path):
     step_no, got = mgr.restore(_tree(0))
     assert step_no == 1
     assert np.array_equal(np.asarray(got["w"]), np.asarray(_tree(2)["w"]))
-    # a crashed save left staging debris: the next save removes it
+    # a crashed save left staging debris: the next save removes it —
+    # INCLUDING debris for the very step being re-saved (a restarted
+    # deterministic run re-reaches the same step number; makedirs must
+    # not trip over the orphan)
     os.makedirs(os.path.join(d, ".tmp-step-00000099"))
     os.makedirs(os.path.join(d, ".discard-step-00000001"))
+    os.makedirs(os.path.join(d, ".tmp-step-00000002"), exist_ok=True)
     mgr.save(2, _tree(3))
     left = [n for n in os.listdir(d)
             if n.startswith(".tmp") or n.startswith(".discard")]
@@ -274,7 +278,143 @@ def test_preemption_and_periodic_saves_at_step_boundary(tmp_path):
         assert ckpt_mod.request_seq() == seq0 + 1
         assert ckpt_mod.checkpoint_requested(since=seq0)
     finally:
-        signal.signal(signal.SIGUSR1, prev[signal.SIGUSR1])
+        restored = ckpt_mod.uninstall_preemption_hook(
+            signals=(signal.SIGUSR1,))
+        assert restored == {signal.SIGUSR1: prev[signal.SIGUSR1]}
+
+
+def test_torn_manifest_falls_back_to_last_committed(tmp_path):
+    """A crash in the middle of the manifest commit itself (truncated
+    manifest + a half-renamed .tmp twin) must read as a corrupt
+    candidate: restore falls back to the last FULLY-committed step."""
+    d = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(d, keep_last=3)
+    s1, s2 = _tree(1), _tree(2)
+    mgr.save(1, s1)
+    mgr.save(2, s2)
+    path = fi.corrupt_checkpoint(d, step=2, what="torn_manifest")
+    assert os.path.exists(path + ".tmp")  # the half-renamed twin
+    with pytest.warns(UserWarning, match="corrupt"):
+        step_no, got = mgr.restore(s1)
+    assert step_no == 1  # the last fully-committed step wins
+    assert np.array_equal(np.asarray(got["w"]), np.asarray(s1["w"]))
+    # pinning the torn step explicitly still refuses loudly
+    with pytest.raises(ckpt_mod.CheckpointCorruptError):
+        mgr._load(2, s1, None)
+
+
+def test_retry_backoff_is_jittered(monkeypatch):
+    """The retry backoff must be jittered (0.5–1.5× nominal): N
+    preempted processes retrying a shared filesystem in lockstep
+    re-collide every round without it."""
+    sleeps = []
+    monkeypatch.setattr(ckpt_mod.time, "sleep", sleeps.append)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 4:
+            raise OSError("transient")
+        return "ok"
+
+    assert ckpt_mod._with_retries(flaky, retries=3, backoff=0.1,
+                                  what="t") == "ok"
+    assert len(sleeps) == 3
+    for i, s in enumerate(sleeps):
+        nominal = 0.1 * (2 ** i)
+        assert 0.5 * nominal <= s <= 1.5 * nominal, (i, s)
+    # jitter means two retry sequences almost surely differ
+    sleeps2 = []
+    monkeypatch.setattr(ckpt_mod.time, "sleep", sleeps2.append)
+    calls.clear()
+    ckpt_mod._with_retries(flaky, retries=3, backoff=0.1, what="t")
+    assert sleeps != sleeps2
+
+
+def test_preemption_hook_idempotent_and_exception_safe():
+    """Re-installing never chains the hook onto itself (one signal →
+    ONE request); a failed install rolls back the handlers it already
+    swapped in."""
+    import signal
+
+    seq0 = ckpt_mod.request_seq()
+    before = signal.getsignal(signal.SIGUSR1)
+    try:
+        ckpt_mod.install_preemption_hook(signals=(signal.SIGUSR1,))
+        installed = signal.getsignal(signal.SIGUSR1)
+        ckpt_mod.install_preemption_hook(signals=(signal.SIGUSR1,))
+        # idempotent: the SAME handler object, not a chained wrapper
+        assert signal.getsignal(signal.SIGUSR1) is installed
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert ckpt_mod.request_seq() == seq0 + 1  # exactly ONE request
+        # a third party displacing the handler must not be masked by
+        # the idempotency latch: re-install takes the signal back and
+        # chains to the displacer
+        hits = []
+        signal.signal(signal.SIGUSR1, lambda s, f: hits.append(s))
+        ckpt_mod.install_preemption_hook(signals=(signal.SIGUSR1,))
+        assert getattr(signal.getsignal(signal.SIGUSR1),
+                       "_mxtpu_preemption_hook", False)
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert ckpt_mod.request_seq() == seq0 + 2
+        assert hits == [signal.SIGUSR1]  # displacer still chained
+    finally:
+        ckpt_mod.uninstall_preemption_hook(signals=(signal.SIGUSR1,))
+        signal.signal(signal.SIGUSR1, before)
+    # exception safety: an invalid signal in the list rolls back the
+    # valid one installed just before it
+    with pytest.raises((ValueError, OSError)):
+        ckpt_mod.install_preemption_hook(signals=(signal.SIGUSR1, 99999))
+    assert signal.getsignal(signal.SIGUSR1) == before
+    assert signal.SIGUSR1 not in ckpt_mod._HOOK_PREVIOUS
+
+
+def test_failed_preemption_save_restores_disposition(tmp_path):
+    """A preemption-triggered save that FAILS logs, uninstalls the hook
+    (so a repeated SIGTERM terminates instead of looping into doomed
+    saves), and re-raises — the last committed checkpoint stays the
+    resume point."""
+    import signal
+
+    d = str(tmp_path / "ckpt")
+    step = _make(MESHES["dp"])
+    step.attach_checkpoint(d)
+    x, y = _batches(1)[0]
+    step(x, y)
+    before = signal.getsignal(signal.SIGUSR1)
+    ckpt_mod.install_preemption_hook(signals=(signal.SIGUSR1,))
+    try:
+        os.kill(os.getpid(), signal.SIGUSR1)  # request a boundary save
+        with pytest.raises(OSError, match="injected"):
+            with pytest.warns(UserWarning, match="restoring the previous "
+                                                 "signal disposition"):
+                with fi.fail_writes(at=0, count=10000):
+                    step(x, y)  # the boundary save fails persistently
+    finally:
+        ckpt_mod.uninstall_preemption_hook(signals=(signal.SIGUSR1,))
+    # the hook was uninstalled by the failure path itself
+    assert signal.getsignal(signal.SIGUSR1) == before
+    # nothing half-written became visible and no staging leaked
+    assert CheckpointManager(d).steps() == []
+    if os.path.isdir(d):
+        assert not [f for f in os.listdir(d) if f.startswith(".tmp")]
+    # a purely PERIODIC save failing (no preemption signal involved)
+    # must NOT disable the hook — the schedule retries next boundary
+    step2 = _make(MESHES["dp"])
+    step2.attach_checkpoint(str(tmp_path / "c2"), every=1)
+    ckpt_mod.install_preemption_hook(signals=(signal.SIGUSR1,))
+    try:
+        with pytest.raises(OSError, match="injected"):
+            with pytest.warns(UserWarning, match="periodic checkpoint "
+                                                 "save failed"):
+                with fi.fail_writes(at=0, count=10000):
+                    step2(x, y)
+        assert getattr(signal.getsignal(signal.SIGUSR1),
+                       "_mxtpu_preemption_hook", False)
+        step2(x, y)  # the outage healed: the schedule saves normally
+        assert CheckpointManager(str(tmp_path / "c2")).steps() != []
+    finally:
+        ckpt_mod.uninstall_preemption_hook(signals=(signal.SIGUSR1,))
 
 
 def test_explicit_step_restore_and_missing(tmp_path):

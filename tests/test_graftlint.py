@@ -241,6 +241,47 @@ def test_gl008_clean_patterns():
     """)
 
 
+# ---------------------------------------------------------------------------
+# GL009 — process-local checkpoint dir in a jax.distributed world
+# ---------------------------------------------------------------------------
+
+def test_gl009_process_local_ckpt_dir():
+    import tempfile
+
+    from incubator_mxnet_tpu.analysis import (
+        CODES, check_process_local_ckpt_dir)
+
+    assert CODES["GL009"][0] == Severity.WARNING
+    tmp = tempfile.gettempdir()
+    diags = check_process_local_ckpt_dir(os.path.join(tmp, "ckpts"), 4)
+    assert [d.code for d in diags] == ["GL009"]
+    assert diags[0].severity == Severity.WARNING
+    assert "4 processes" in diags[0].message
+    assert "shared filesystem" in diags[0].hint
+    # relative paths resolve per-process working dirs: flagged too
+    assert [d.code for d in check_process_local_ckpt_dir("ckpts", 2)] \
+        == ["GL009"]
+    # a shared absolute path is clean; so is any dir at world size 1
+    assert check_process_local_ckpt_dir("/shared/nfs/ckpts", 4) == []
+    assert check_process_local_ckpt_dir(os.path.join(tmp, "c"), 1) == []
+
+
+def test_gl009_fires_at_manager_construction(tmp_path):
+    """The one wired emission point: constructing a CheckpointManager
+    with process_count > 1 over a process-local directory warns with
+    the GL009 diagnostic; a single-process manager never does."""
+    import warnings as _w
+
+    from incubator_mxnet_tpu.parallel import CheckpointManager
+
+    with pytest.warns(UserWarning, match="GL009"):
+        CheckpointManager(str(tmp_path / "c"), process_index=0,
+                          process_count=2)
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        CheckpointManager(str(tmp_path / "c"), process_count=1)
+
+
 def test_inline_suppression():
     diags = _lint("""
         from jax import shard_map  # graftlint: disable=GL101

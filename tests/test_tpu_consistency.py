@@ -20,10 +20,20 @@ import pytest
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+_TPU_PROBE = None  # memo: one probe per session, not one per test
+
+
 def _tpu_available():
     # the axon terminal exports a TPU via the default backend; probe cheaply.
     # A hung probe (tunnel down mid-handshake) means NOT available — these
-    # tests must skip, not error, when the chip is unreachable.
+    # tests must skip, not error, when the chip is unreachable.  The result
+    # is memoized: with the tunnel down each probe burns its full timeout,
+    # and paying that once per @tpu TEST (a `-m 'not slow'` run overrides
+    # the addopts `-m "not tpu"`, so these tests reach their skip guards in
+    # tier-1) wasted minutes of the tier-1 budget.
+    global _TPU_PROBE
+    if _TPU_PROBE is not None:
+        return _TPU_PROBE
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
     try:
         probe = subprocess.run(
@@ -33,8 +43,10 @@ def _tpu_available():
              " else 1)"],
             env=env, capture_output=True, timeout=120)
     except subprocess.TimeoutExpired:
+        _TPU_PROBE = False
         return False
-    return probe.returncode == 0
+    _TPU_PROBE = probe.returncode == 0
+    return _TPU_PROBE
 
 
 @pytest.mark.tpu
