@@ -247,6 +247,53 @@ def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
 BASELINE_INFER_IMG_S = 2355.04  # V100 fp16 batch-128 inference (perf.md:210)
 
 
+def run_serve(batch_bucket=64, image_size=224, qps=400.0, n_requests=200,
+              max_delay_ms=10.0):
+    """Serving leg: ResNet-50 through serve/ (AOT bucketed engine +
+    continuous batcher) under open-loop Poisson traffic — the
+    `serve_qps`/`serve_p99_ms` metrics logged beside the training
+    throughput each BENCH round (ROADMAP item 2; docs/SERVING.md)."""
+    jax = setup_jax()
+    import numpy as np
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    from incubator_mxnet_tpu.serve import (ContinuousBatcher, ServeEngine,
+                                           poisson_loadtest)
+
+    log("devices: %s" % (jax.devices(),))
+    mx.random.seed(0)
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(init=mx.init.Xavier())
+    net.shape_init((1, 3, image_size, image_size))  # no eager pass
+    buckets = tuple(sorted({max(1, batch_bucket // 4), batch_bucket}))
+    eng = ServeEngine(net, buckets=buckets, lint="error", cost="report")
+    t = eng.warmup(np.zeros((3, image_size, image_size), np.float32))
+    log("serve warmup: %d buckets, trace %.1fs + compile %.1fs"
+        % (len(buckets), t["trace"], t["compile"]))
+    pool = np.random.RandomState(0).rand(
+        8, 3, image_size, image_size).astype(np.float32)
+    batcher = ContinuousBatcher(eng, max_delay=max_delay_ms / 1e3)
+    try:
+        rep = poisson_loadtest(batcher, lambda i, rng: pool[i % 8],
+                               qps=qps, n_requests=n_requests, seed=0)
+    finally:
+        batcher.close()
+    log(rep.format())
+    extra = {"p50_ms": round(rep.p50_ms, 2), "p95_ms": round(rep.p95_ms, 2),
+             "p99_ms": round(rep.p99_ms, 2), "qps_offered": qps,
+             "ok": rep.ok, "errors": rep.errors, "shed": rep.shed,
+             "recompiles": rep.recompiles, "buckets": list(buckets),
+             "occupancy": {str(k): v for k, v in
+                           sorted(rep.occupancy.items())},
+             "warmup_compile_s": round(t["compile"], 1)}
+    emit("serve_qps", rep.qps_sustained, "req/s", 0.0, extra)
+    emit("serve_p99_ms", rep.p99_ms, "ms", 0.0,
+         {"p50_ms": round(rep.p50_ms, 2),
+          "recompiles": rep.recompiles})
+    return rep
+
+
 def run_infer_int8(batch_size=128, image_size=224, iters=20):
     """INT8 ResNet-50 inference through the round-4 int8 wire
     (fold_batch_norm + requantize chaining + quantized residual adds,
@@ -536,7 +583,10 @@ def _backend_alive(timeout_s=240):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="train",
-                    choices=["train", "infer", "infer-int8", "attention"])
+                    choices=["train", "infer", "infer-int8", "attention",
+                             "serve"])
+    ap.add_argument("--no-serve", action="store_true",
+                    help="skip the serving leg after the training run")
     ap.add_argument("--batch", type=int, default=0)
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--chunks", type=int, default=8)
@@ -576,6 +626,10 @@ def main():
         run_infer_int8(batch_size=args.batch or 128,
                        image_size=args.image_size)
         return
+    if args.mode == "serve":
+        run_serve(batch_bucket=args.batch or 64,
+                  image_size=args.image_size)
+        return
 
     # bench_config.json records the best MEASURED headline configuration
     # (written by tools/chip_queue.sh after its variant sweep); the
@@ -606,6 +660,16 @@ def main():
                       chunks=args.chunks, data=args.data,
                       record_format=args.record_format,
                       s2d_stem=s2d_stem, ghost_bn=ghost_bn)
+            if not args.no_serve:
+                # the serving leg rides every BENCH round beside the
+                # training number (best-effort: a serve failure must
+                # not void a measured training result)
+                try:
+                    run_serve(image_size=args.image_size)
+                except Exception as e:  # noqa: BLE001
+                    log("serve leg failed: %r" % e)
+                    emit("serve_qps", 0.0, "req/s", 0.0,
+                         {"error": str(e)[:200]})
             return
         except Exception as e:  # noqa: BLE001 - report best-effort
             err = e

@@ -17,7 +17,11 @@ semantics):
   construction) flags a process-local checkpoint directory — ``/tmp``,
   ``$TMPDIR``, a relative path — while ``jax.distributed`` spans
   multiple processes: the coordinated multi-process commit needs one
-  shared directory.
+  shared directory.  GL010 (error, checked by the serving engine's lint
+  pass) flags an *inference* program built with model parameters in the
+  donated argnums — a served model's weights must survive the call
+  (``check_inference_param_donation``; the serving-side complement of
+  GL003).
 - **Level 2 (source)**: :mod:`.source_lint` + the ``tools/graftlint.py``
   CLI check repo idiom (GL101–GL103) plus the checkpoint-without-
   iterator-state pattern (GL008, a warning: a loop consuming a stateful
@@ -39,7 +43,8 @@ from .diagnostics import (CODES, Diagnostic, LintError, LintReport,
                           Severity, code_matches)
 from .source_lint import (check_checkpoint_without_iter_state, lint_paths,
                           lint_source)
-from .trace_lint import (check_legacy_checkpoint_path,
+from .trace_lint import (check_inference_param_donation,
+                         check_legacy_checkpoint_path,
                          check_partition_spec, check_permutation,
                          check_process_local_ckpt_dir,
                          check_zero_state_shardings, lint_jaxpr,
@@ -51,6 +56,7 @@ __all__ = [
     "LintError", "LintReport", "Severity", "analyze_jaxpr",
     "analyze_traceable",
     "check_checkpoint_without_iter_state", "check_cost",
+    "check_inference_param_donation",
     "check_legacy_checkpoint_path",
     "check_partition_spec", "check_permutation",
     "check_process_local_ckpt_dir",

@@ -65,6 +65,10 @@ CODES = {
               "spans multiple processes — the coordinated multi-process "
               "commit needs one shared directory and can never complete "
               "on per-host storage"),
+    "GL010": (Severity.ERROR,
+              "inference program built with model parameters in the "
+              "donated argnums — a served model's weights must survive "
+              "the call; the second request would read freed buffers"),
     "GL201": (Severity.ERROR,
               "graftcost: predicted peak live-buffer memory exceeds the "
               "HBM budget — the program is infeasible at this config; "

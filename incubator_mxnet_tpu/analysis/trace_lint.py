@@ -38,7 +38,8 @@ from jax import core as jcore
 
 from .diagnostics import CODES, Diagnostic, LintError, LintReport, Severity
 
-__all__ = ["capture_effect_diagnostics", "check_legacy_checkpoint_path",
+__all__ = ["capture_effect_diagnostics", "check_inference_param_donation",
+           "check_legacy_checkpoint_path",
            "check_permutation", "validate_permutation",
            "check_partition_spec", "check_zero_state_shardings",
            "donated_leaf_indices", "lint_jaxpr", "lint_traceable",
@@ -376,6 +377,43 @@ def check_legacy_checkpoint_path(origin: str,
         hint="checkpoint through the fused step instead: "
              "step.save_checkpoint(dir) / step.restore_checkpoint(dir) "
              "(parallel.checkpoint, docs/RESILIENCE.md)")]
+
+
+def check_inference_param_donation(donated_leaves, param_leaves,
+                                   where: str = "") -> List[Diagnostic]:
+    """GL010 core: an *inference* program whose donated flat invars
+    intersect its model-parameter invars.
+
+    Donation is the right call for per-request state (a decode cache, a
+    scratch input buffer): those buffers are dead after the call.  The
+    parameters are the opposite — they are the server's long-lived,
+    device-resident state, reused by every request.  Donating them
+    invalidates the host handles after the FIRST call; the second
+    request reads freed (or recycled) buffers — silently wrong numerics
+    on some backends, a crash on others.  The training analog is GL003
+    (donation aliasing); this is its serving-side complement, caught at
+    trace time like GL003, before the program ever compiles.
+
+    ``donated_leaves`` / ``param_leaves`` are flat invar indices of the
+    traced program (``donated_leaf_indices`` maps jit-style positional
+    argnums to them).
+    """
+    overlap = sorted(set(donated_leaves) & set(param_leaves))
+    if not overlap:
+        return []
+    show = overlap[:8]
+    more = "" if len(overlap) <= 8 else " (+%d more)" % (len(overlap) - 8)
+    return [Diagnostic(
+        "GL010", Severity.ERROR,
+        "%d model-parameter leaves (flat invars %s%s) are in the donated "
+        "argnums of an inference program — a served model's weights must "
+        "survive the call, and XLA will reuse their buffers for outputs: "
+        "every request after the first computes on freed memory"
+        % (len(overlap), show, more),
+        where=where,
+        hint="donate only per-request state (the input buffer, the decode "
+             "cache); keep params device-resident and un-donated "
+             "(serve/engine.py holds them for the life of the engine)")]
 
 
 def check_process_local_ckpt_dir(directory: str,

@@ -34,7 +34,46 @@ from .parameter import DeferredInitializationError, Parameter, ParameterDict
 _REMAT_STATE = threading.local()
 _REMAT_STATE.active = False
 
-__all__ = ["Block", "HybridBlock", "SymbolBlock", "CachedOp"]
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "CachedOp",
+           "pure_forward"]
+
+
+def pure_forward(block, params, param_vals, inputs, training=False,
+                 key=None):
+    """Run ``block``'s forward as a pure function of explicit buffers:
+    bind values in place of the Parameters inside a fresh TraceContext,
+    run ``_forward_impl``, unwrap the outputs.  The serving engine
+    (``serve/engine.py``) builds its inference programs on this;
+    :class:`CachedOp` and the fused train step keep their own inlined
+    copies of the ritual because they consume the trace context
+    mid-flight (aux-write outputs, aux losses, the scaled-loss hook) —
+    if the binding protocol ever changes, change all three.
+
+    ``params`` are the Parameter objects (gradient AND aux), and
+    ``param_vals`` the congruent raw arrays bound in their place inside
+    a fresh :class:`~..tracing.TraceContext`; ``inputs`` is one raw
+    array or a tuple of them.  Returns ``(out_vals, tc)``: the raw
+    output value(s) in the block's own output structure (NDArray leaves
+    unwrapped), and the trace context — callers that run with
+    ``training=True`` read ``tc.aux_writes`` / ``tc.aux_losses`` from
+    it; inference callers (``training=False``: BatchNorm uses running
+    stats, dropout is identity) can ignore it.
+    """
+    tc = tracing.TraceContext(key, training=training)
+    for p, v in zip(params, param_vals):
+        tc.bindings[id(p)] = v
+    tracing.push_trace(tc)
+    try:
+        with autograd.pause():
+            args = inputs if isinstance(inputs, (list, tuple)) \
+                else (inputs,)
+            outs = block._forward_impl(*[NDArray(v) for v in args])
+    finally:
+        tracing.pop_trace()
+    out_vals = jax.tree.map(
+        lambda o: o._data if isinstance(o, NDArray) else o, outs,
+        is_leaf=lambda x: isinstance(x, NDArray))
+    return out_vals, tc
 
 
 class _BlockScope:

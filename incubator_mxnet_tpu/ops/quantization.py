@@ -18,7 +18,30 @@ from jax import lax
 
 from .registry import register
 
-__all__ = []
+__all__ = ["dequantize_tensor", "quantize_tensor"]
+
+
+def quantize_tensor(w):
+    """Symmetric per-tensor int8 of one weight: ``(q_int8, amax_f32)``.
+
+    The serving engine's weight-only int8 tier (``serve/engine.py``
+    ``dtype="int8"``) quantizes eligible parameters ONCE at load with
+    exactly the ``_contrib_quantize_v2`` convention (scale =
+    127/amax, zero-point free), so a tensor round-tripped through the
+    engine and one through the reference-parity ops land on identical
+    codes.  Returns float32 ``amax`` so ``dequantize_tensor`` is
+    dtype-stable regardless of the input precision."""
+    amax = jnp.max(jnp.abs(w)).astype(jnp.float32)
+    scale = jnp.where(amax > 0, 127.0 / amax, 1.0)
+    q = jnp.clip(jnp.rint(w.astype(jnp.float32) * scale),
+                 -127, 127).astype(jnp.int8)
+    return q, amax
+
+
+def dequantize_tensor(q, amax, dtype=jnp.float32):
+    """Inverse of :func:`quantize_tensor`: ``real = q * amax / 127``
+    (the ``_contrib_dequantize`` convention), cast to ``dtype``."""
+    return (q.astype(jnp.float32) * (amax / 127.0)).astype(dtype)
 
 
 def _range_scale(min_r, max_r):

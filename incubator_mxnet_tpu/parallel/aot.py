@@ -1,0 +1,133 @@
+"""Shared AOT-compile + lint plumbing for compiled-program builders.
+
+Two independent builders assemble long-lived XLA programs from gluon
+nets — the fused training step (``parallel/train_step.py``) and the
+serving engine (``serve/engine.py``) — and both follow the same ritual:
+
+1. trace the jitted callable ONCE with the GL004 effect hooks active
+   (:func:`traced_with_effects` — the very trace jit caches for the
+   first call, so the lint costs one jaxpr walk, not an extra trace);
+2. assemble a :class:`~..analysis.LintReport` from the effect
+   diagnostics + the jaxpr walk + any builder-specific checks and apply
+   the ``"error"``/``"warn"``/``"off"`` policy (:func:`finish_lint`);
+3. lower + compile with a timed phase split (:func:`compile_timed`) so
+   benchmarks can report where startup time goes — the reference's
+   analog is cuDNN autotune + InitCachedOps cost at bind
+   (``src/executor/graph_executor.cc:1220``).
+
+This module is the ONE copy of that ritual.  The builders keep their
+own policy (what counts as an extra diagnostic, when to mark
+themselves linted); the mechanics live here.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+__all__ = ["compile_timed", "finish_lint", "lint_served_program",
+           "resolve_mode", "traced_with_effects"]
+
+
+def resolve_mode(value: Optional[str], env_var: str, default: str,
+                 allowed: Sequence[str], what: str) -> str:
+    """The shared knob-resolution order: explicit argument > env var
+    (``config.py``) > ``default``.  Raises ``ValueError`` naming the
+    knob on anything outside ``allowed``."""
+    if value is None:
+        from .. import config as _cfg
+
+        value = str(_cfg.get(env_var, default) or default).lower()
+    if value not in allowed:
+        raise ValueError("%s must be one of %s, got %r"
+                         % (what, "/".join(repr(a) for a in allowed),
+                            value))
+    return value
+
+
+def traced_with_effects(jit_obj, args: tuple, capture: bool = True):
+    """Trace ``jit_obj`` (via ``.trace(*args)`` — the trace the first
+    call reuses) with the GL004 effect-capture hooks active.  Returns
+    ``(traced, effect_diagnostics)``; ``capture=False`` skips the hook
+    (an empty diagnostics list comes back)."""
+    from contextlib import nullcontext
+
+    from ..analysis.trace_lint import capture_effect_diagnostics
+
+    cm = capture_effect_diagnostics() if capture else nullcontext([])
+    with cm as effects:
+        traced = jit_obj.trace(*args)
+    return traced, list(effects)
+
+
+def finish_lint(closed_jaxpr, *, mode: str, effects: Iterable = (),
+                donated_leaves: Sequence[int] = (), extra: Iterable = (),
+                suppress: Tuple[str, ...] = (),
+                what: str = "compiled program", stacklevel: int = 5):
+    """Assemble and enforce one lint report over a traced program.
+
+    ``effects`` are GL004 diagnostics captured during the trace,
+    ``donated_leaves`` flat invar indices for the GL003 walk, ``extra``
+    builder-specific diagnostics (GL006/GL007 for the train step,
+    GL010 for the serving engine).  ``mode="error"`` raises
+    :class:`~..analysis.LintError` on error-severity findings; any
+    findings at all are warned (so ``"warn"`` mode surfaces them and
+    ``"error"`` mode surfaces the non-fatal ones).  Returns the report.
+    """
+    from ..analysis import LintReport, Severity, lint_jaxpr
+
+    report = LintReport(suppress=suppress)
+    report.extend(effects)
+    report.extend(lint_jaxpr(closed_jaxpr,
+                             donated_leaves=donated_leaves).diagnostics)
+    report.extend(extra)
+    if mode == "error":
+        report.raise_if_errors()
+    if report.errors or report.warnings:
+        import warnings as _warnings
+
+        _warnings.warn("graftlint: %s has findings\n%s"
+                       % (what, report.format(Severity.WARNING)),
+                       stacklevel=stacklevel)
+    return report
+
+
+def lint_served_program(traced, effects, args: tuple,
+                        donate_argnums: Sequence[int], *, mode: str,
+                        suppress: Tuple[str, ...] = (),
+                        what: str = "inference program",
+                        param_argnum: int = 0, stacklevel: int = 6):
+    """The serving-side lint ritual shared by ``serve/engine.py`` and
+    ``serve/cache.py``: GL001–GL004 over the traced program plus GL010
+    (``check_inference_param_donation``) against the builder's own
+    donation spec — the params argument (``param_argnum``) must never
+    be donated.  ONE copy, like :func:`finish_lint` for the generic
+    half."""
+    import jax
+
+    from ..analysis.trace_lint import (check_inference_param_donation,
+                                       donated_leaf_indices)
+
+    donated = donated_leaf_indices(args, donate_argnums)
+    off = sum(len(jax.tree_util.tree_leaves(a))
+              for a in args[:param_argnum])
+    n_param = len(jax.tree_util.tree_leaves(args[param_argnum]))
+    extra = check_inference_param_donation(
+        donated, range(off, off + n_param), where=what)
+    return finish_lint(traced.jaxpr, mode=mode, effects=effects,
+                       donated_leaves=donated, extra=extra,
+                       suppress=suppress, what=what,
+                       stacklevel=stacklevel)
+
+
+def compile_timed(traced, t_trace: float = 0.0) -> Tuple[object,
+                                                         Dict[str, float]]:
+    """Lower + compile an already-traced program, returning
+    ``(compiled, {"trace": s, "compile": s})``.  ``t_trace`` is the
+    wall time the caller already spent tracing (lowering is part of
+    the trace phase — it is Python/JAX work, not XLA)."""
+    t0 = time.time()
+    lowered = traced.lower()
+    t_trace = t_trace + (time.time() - t0)
+    t0 = time.time()
+    compiled = lowered.compile()
+    return compiled, {"trace": t_trace, "compile": time.time() - t0}

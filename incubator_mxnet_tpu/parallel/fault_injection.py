@@ -20,6 +20,14 @@ Deterministic, test-grade fault injectors for the failure classes
   and silent worker death, and :func:`truncate_record` tears a record
   file at a byte offset exactly like a crash mid-write — together they
   drive ``tests/test_resilient_io.py``;
+- **request-level faults** — :func:`malformed_request` builds payloads
+  the batcher must reject per-request (wrong rank/shape/dtype,
+  unconvertible objects) without killing the batch or the queue,
+  :func:`slow_client` stalls request admission (the trickling-client
+  case the deadline-triggered flush exists for) by interposing
+  ``serve/batcher.py::_admit``, and :func:`burst_arrivals` submits a
+  thundering herd the bounded queue must absorb or shed as
+  ``Backpressure`` — together they drive ``tests/test_serve.py``;
 - **host loss** — :func:`kill_process` is a REAL ungraceful process
   death (SIGKILL: no atexit, no flushes — what a preempted VM looks
   like), :func:`host_loss_during_save` arms it on the N-th checkpoint
@@ -46,9 +54,11 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["NaNInjector", "coordinator_unreachable", "corrupt_checkpoint",
+__all__ = ["NaNInjector", "burst_arrivals", "coordinator_unreachable",
+           "corrupt_checkpoint",
            "fail_writes", "flaky_reads", "host_loss_during_save",
-           "kill_process", "kill_worker", "poison_batch", "slow_reads",
+           "kill_process", "kill_worker", "malformed_request",
+           "poison_batch", "slow_client", "slow_reads",
            "straggler_process", "truncate_record"]
 
 
@@ -273,6 +283,92 @@ def corrupt_checkpoint(directory, step=None, what="bitflip", which=0):
         raise ValueError("what must be 'bitflip', 'truncate', 'manifest' "
                          "or 'torn_manifest', got %r" % (what,))
     return path
+
+
+# ---------------------------------------------------------------------------
+# request-level scenarios (serving: serve/batcher.py)
+# ---------------------------------------------------------------------------
+
+class _BadPayload:
+    """An object whose array conversion raises — a request body that is
+    not even parseable, the worst malformed-request class."""
+
+    def __array__(self, *a, **k):
+        raise ValueError("injected unconvertible request payload")
+
+
+def malformed_request(sample_shape, kind="rank"):
+    """A request payload that must be REJECTED per-request by the
+    batcher — and must never kill the batch it rode in, the worker
+    thread, or the queue (the graceful-degradation contract,
+    ``tests/test_serve.py``).
+
+    ``kind``: ``"rank"`` — an extra dimension (wrong shape);
+    ``"shape"`` — right rank, wrong extents; ``"dtype"`` — object/str
+    payload that cannot cast to the engine's sample dtype;
+    ``"unconvertible"`` — ``np.asarray`` itself raises.
+    """
+    shape = tuple(int(s) for s in sample_shape)
+    if kind == "rank":
+        return np.zeros((2,) + shape, np.float32)
+    if kind == "shape":
+        return np.zeros(tuple(s + 1 for s in shape) or (3,), np.float32)
+    if kind == "dtype":
+        return np.full(shape, "poison", dtype=object)
+    if kind == "unconvertible":
+        return _BadPayload()
+    raise ValueError("kind must be 'rank', 'shape', 'dtype' or "
+                     "'unconvertible', got %r" % (kind,))
+
+
+@contextmanager
+def slow_client(delay_s, at=0, count=None):
+    """Stall request ADMISSION by ``delay_s`` seconds from the ``at``-th
+    submit onward (``count`` bounds how many; ``None`` = all) — the
+    trickling-client case: requests arrive slower than a bucket fills,
+    so the batcher's deadline-triggered flush (not the size trigger)
+    must bound every admitted request's wait.  Interposes
+    ``serve/batcher.py::_admit``, the admission choke point, exactly
+    like ``flaky_reads`` interposes ``io/resilient.py::_pull``."""
+    from ..serve import batcher as _batcher
+
+    class _Stats:
+        seen = 0
+        slowed = 0
+
+    stats = _Stats()
+    real = _batcher._admit
+
+    def slow(req):
+        i = stats.seen
+        stats.seen += 1
+        if i >= at and (count is None or stats.slowed < count):
+            stats.slowed += 1
+            time.sleep(delay_s)
+        return real(req)
+
+    _batcher._admit = slow
+    try:
+        yield stats
+    finally:
+        _batcher._admit = real
+
+
+def burst_arrivals(batcher, payloads, block=False):
+    """Submit every payload back-to-back with NO pacing — the thundering
+    herd a bounded queue must absorb (or shed as ``Backpressure``, never
+    grow without bound).  Returns ``(futures, shed_count)``; with
+    ``block=False`` (default) a full queue sheds instead of waiting,
+    which is what an open-loop burst looks like."""
+    from ..serve.batcher import Backpressure
+
+    futures, shed = [], 0
+    for p in payloads:
+        try:
+            futures.append(batcher.submit(p, block=block))
+        except Backpressure:
+            shed += 1
+    return futures, shed
 
 
 # ---------------------------------------------------------------------------

@@ -333,14 +333,10 @@ class TrainStep:
         # compile (docs/ANALYSIS.md): "error" raises on error-severity
         # findings, "warn" prints them, "off" skips the lint trace.
         # Resolution order: explicit arg > MXTPU_LINT env > "warn".
-        if lint is None:
-            from .. import config as _cfg
+        from .aot import resolve_mode as _resolve_mode
 
-            lint = str(_cfg.get("MXTPU_LINT", "warn") or "warn").lower()
-        if lint not in ("off", "warn", "error"):
-            raise ValueError("lint must be 'off', 'warn' or 'error', "
-                             "got %r" % (lint,))
-        self.lint = lint
+        self.lint = _resolve_mode(lint, "MXTPU_LINT", "warn",
+                                  ("off", "warn", "error"), "lint")
         self.lint_suppress = tuple(lint_suppress)
         self._linted = False
         # graftcost rides the same pre-compile trace (analysis/
@@ -349,14 +345,8 @@ class TrainStep:
         # raises on GL2xx errors — GL201 rejects an over-budget config
         # at trace time, before any compile.  Resolution order: explicit
         # arg > MXTPU_COST env > "off".
-        if cost is None:
-            from .. import config as _cfg
-
-            cost = str(_cfg.get("MXTPU_COST", "off") or "off").lower()
-        if cost not in ("off", "report", "check"):
-            raise ValueError("cost must be 'off', 'report' or 'check', "
-                             "got %r" % (cost,))
-        self.cost = cost
+        self.cost = _resolve_mode(cost, "MXTPU_COST", "off",
+                                  ("off", "report", "check"), "cost")
         if hbm_budget is not None and float(hbm_budget) <= 0:
             raise ValueError("hbm_budget must be positive bytes, got %r"
                              % (hbm_budget,))
@@ -921,15 +911,12 @@ class TrainStep:
         re-lints (and re-raises) instead of compiling the flagged
         program.  Returns the traced object (shared with the jit's
         trace cache, so the first call/compile reuses it)."""
-        from contextlib import nullcontext
-
-        from ..analysis.trace_lint import capture_effect_diagnostics
+        from .aot import traced_with_effects
 
         lint_here = self.lint != "off" and not self._linted
         cost_here = self.cost != "off" and not self._linted
-        cm = capture_effect_diagnostics() if lint_here else nullcontext([])
-        with cm as effects:
-            traced = jit_obj.trace(*args)
+        traced, effects = traced_with_effects(jit_obj, tuple(args),
+                                              capture=lint_here)
         if lint_here:
             self._finish_lint(traced.jaxpr, effects, args)
         if cost_here:
@@ -941,15 +928,12 @@ class TrainStep:
         return traced
 
     def _finish_lint(self, closed_jaxpr, effect_diags, example_args):
-        from ..analysis import LintReport, Severity, lint_jaxpr
         from ..analysis.trace_lint import donated_leaf_indices
+        from .aot import finish_lint
 
-        report = LintReport(suppress=self.lint_suppress)
-        report.extend(effect_diags)
         donated = donated_leaf_indices(tuple(example_args),
                                        self._donate_argnums)
-        report.extend(lint_jaxpr(closed_jaxpr,
-                                 donated_leaves=donated).diagnostics)
+        extra = []
         if self.zero and self._shardings is not None:
             # GL006: a zero=1 step whose optimizer state is still
             # replicated over the dp axis keeps the N× memory the
@@ -959,7 +943,7 @@ class TrainStep:
             state_sh = self._shardings[2]
             covered = [sh for sh, pad in zip(state_sh, self._zero_pad0)
                        if pad is not None] if state_sh else []
-            report.extend(check_zero_state_shardings(
+            extra.extend(check_zero_state_shardings(
                 covered, self.batch_axis,
                 where="TrainStep(zero=1) optimizer state"))
         if self.zero and self._legacy_state_origin:
@@ -968,17 +952,13 @@ class TrainStep:
             # represent dp-sharded optimizer state
             from ..analysis.trace_lint import check_legacy_checkpoint_path
 
-            report.extend(check_legacy_checkpoint_path(
+            extra.extend(check_legacy_checkpoint_path(
                 self._legacy_state_origin,
                 where="Trainer.make_fused_step(zero=1)"))
-        if self.lint == "error":
-            report.raise_if_errors()
-        if report.errors or report.warnings:
-            import warnings as _warnings
-
-            _warnings.warn("graftlint: fused train step has findings\n"
-                           + report.format(Severity.WARNING),
-                           stacklevel=4)
+        finish_lint(closed_jaxpr, mode=self.lint, effects=effect_diags,
+                    donated_leaves=donated, extra=extra,
+                    suppress=self.lint_suppress,
+                    what="fused train step", stacklevel=5)
 
     # ------------------------------------------------------------------
     # graftcost (analysis/cost_model.py, docs/ANALYSIS.md GL2xx)
@@ -1267,20 +1247,18 @@ class TrainStep:
             xv, yv = self._place_batch(xv, yv)
         # lint rides THIS trace — no separate lint trace, so the trace/
         # compile split below stays honest (the jaxpr walk is ms-scale)
+        from .aot import compile_timed
+
         t0 = _time.time()
         traced = self._lint_trace(self._jit,
                                   (p_vals, aux_vals, self._opt_state, xv,
                                    yv, self._key_dev, self._step_dev,
                                    self._scaler_dev))
-        lowered = traced.lower()
-        t_trace = _time.time() - t0
-        t0 = _time.time()
-        compiled = lowered.compile()
-        t_compile = _time.time() - t0
+        compiled, times = compile_timed(traced, t_trace=_time.time() - t0)
         self._compiled = compiled
         self._compiled_key = ((xv.shape, str(xv.dtype)),
                               (yv.shape, str(yv.dtype)))
-        return {"trace": t_trace, "compile": t_compile}
+        return times
 
     def _build_multi(self):
         """K steps in ONE compiled program: lax.scan over stacked batches.

@@ -1,0 +1,34 @@
+"""``mx.serve`` — TPU-native inference: AOT engine, continuous
+batching, O(1) decode cache, open-loop loadtest.
+
+The training stack (``parallel/``) is request-free; this package is
+the serving layer ROADMAP item 2 calls for — the analog of the
+reference's CachedOp + C predict API (SURVEY.md §L5c,
+``MXPredCreate/Forward``), rebuilt TPU-native:
+
+- :class:`~.engine.ServeEngine` — AOT-compiled donated-buffer
+  inference programs per bucketed batch shape; params device-resident
+  and never donated (GL010 enforces it at trace time);
+- :class:`~.batcher.ContinuousBatcher` — bounded async request queue
+  with size- and deadline-triggered flush and per-request error
+  isolation;
+- :class:`~.cache.CachedDecoder` / :func:`~.cache.init_cache` —
+  device-carried ring-slot KV cache with O(1) per-token in-place
+  update (arXiv:2603.09555), exercised by
+  :class:`~.cache.TinyDecoderLM`;
+- :func:`~.loadtest.poisson_loadtest` — open-loop Poisson traffic
+  reporting p50/p95/p99, sustained QPS, batch occupancy and the
+  post-warmup recompile count (must be 0).
+
+See ``docs/SERVING.md`` for architecture, bucket policy, cache layout
+and loadtest methodology.
+"""
+from .batcher import (Backpressure, ContinuousBatcher, RequestError,
+                      ServeStats)
+from .cache import CachedDecoder, TinyDecoderLM, init_cache
+from .engine import ServeEngine
+from .loadtest import LoadReport, poisson_loadtest
+
+__all__ = ["Backpressure", "CachedDecoder", "ContinuousBatcher",
+           "LoadReport", "RequestError", "ServeEngine", "ServeStats",
+           "TinyDecoderLM", "init_cache", "poisson_loadtest"]

@@ -1,0 +1,314 @@
+"""Continuous batcher: an async request queue in front of a ServeEngine.
+
+The throughput of a bucketed AOT engine comes from batch occupancy; the
+latency of an interactive service comes from NOT waiting for full
+batches.  The continuous batcher holds both ends:
+
+- requests enter a **bounded** queue (``queue.Full`` surfaces as
+  :class:`Backpressure` — overload is the caller's signal, never an
+  unbounded memory ramp) with a per-request admission timestamp;
+- one worker thread assembles flushes, triggered by **size** (the batch
+  reached ``max_batch``) or by **deadline** (the OLDEST admitted
+  request has waited ``max_delay`` — nobody's latency is held hostage
+  to fill a bucket);
+- a malformed request (wrong shape/dtype, unconvertible payload) is
+  rejected with a **per-request** error on its own future — it never
+  kills the batch it rode in, the worker, or the queue
+  (``parallel/fault_injection.py`` ``malformed_request`` drives the
+  regression);
+- shutdown follows the ``io/resilient.py`` drain-join discipline:
+  ``close()`` refuses new submits, the worker drains and serves what
+  is already queued, the join is bounded and WARNS on timeout, and any
+  request still unserved after the join fails loudly on its future —
+  nothing is silently dropped and nothing hangs.
+
+Submissions pass through the module-level :func:`_admit` hook so the
+fault harness can interpose request-level scenarios (``slow_client``)
+without touching batcher internals — the same pattern as
+``io/resilient.py::_pull`` and ``checkpoint._write_bytes``.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import warnings
+from collections import Counter
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import jax
+
+__all__ = ["Backpressure", "ContinuousBatcher", "RequestError",
+           "ServeStats"]
+
+#: worker poll period while waiting for the first request of a batch
+_POLL = 0.01
+
+
+class Backpressure(RuntimeError):
+    """The bounded request queue is full — the service is overloaded;
+    shed or retry with backoff."""
+
+
+class RequestError(ValueError):
+    """This request was rejected (malformed payload); the batch it
+    arrived with was served normally."""
+
+
+def _admit(req):
+    """Admission choke point for every submitted request.  Module-level
+    so the fault harness (``parallel/fault_injection.py::slow_client``)
+    can interpose latency/faults without touching internals."""
+    return req
+
+
+class _Request:
+    __slots__ = ("payload", "future", "t_submit")
+
+    def __init__(self, payload, future, t_submit):
+        self.payload = payload
+        self.future = future
+        self.t_submit = t_submit
+
+
+class ServeStats:
+    """Rolling serving statistics (reset between loadtest windows).
+
+    ``window`` bounds the latency record — a long-lived server appends
+    one float per request, so an unbounded list would be a slow leak;
+    percentiles are computed over the most recent ``window`` requests.
+    """
+
+    def __init__(self, window: int = 65536):
+        self._window = int(window)
+        self.reset()
+
+    def reset(self):
+        from collections import deque
+
+        self.latencies = deque(maxlen=self._window)
+        self.occupancy: Counter = Counter()   # rows actually served
+        self.flush_full = 0                   # size-triggered flushes
+        self.flush_deadline = 0               # deadline-triggered flushes
+        self.flush_drain = 0                  # shutdown-drain flushes
+        self.rejected = 0                     # malformed requests
+        self.failed = 0                       # requests failed by engine errors
+
+    def percentiles(self, qs=(50, 95, 99)) -> Dict[str, float]:
+        if not self.latencies:
+            return {"p%d" % q: float("nan") for q in qs}
+        arr = np.asarray(self.latencies)
+        return {"p%d" % q: float(np.percentile(arr, q)) for q in qs}
+
+    def summary(self) -> Dict[str, Any]:
+        out = {"served": len(self.latencies),
+               "rejected": self.rejected, "failed": self.failed,
+               "flush_full": self.flush_full,
+               "flush_deadline": self.flush_deadline,
+               "flush_drain": self.flush_drain,
+               "occupancy": dict(sorted(self.occupancy.items()))}
+        out.update({k: v * 1e3 for k, v in self.percentiles().items()})
+        return out
+
+
+class ContinuousBatcher:
+    """Dynamic batcher over a warmed :class:`~.engine.ServeEngine`.
+
+    ``max_batch`` defaults to the engine's largest bucket; ``max_delay``
+    (seconds) bounds how long an admitted request may wait for
+    batchmates; ``max_queue`` bounds admission (``Backpressure``).
+    """
+
+    def __init__(self, engine, max_batch: Optional[int] = None,
+                 max_delay: float = 0.005, max_queue: int = 1024):
+        if engine.sample_shape is None:
+            raise ValueError("warmup() the engine before attaching a "
+                             "batcher (it pins the request signature "
+                             "submits are validated against)")
+        if max_delay <= 0:
+            raise ValueError("max_delay must be positive seconds")
+        self.engine = engine
+        self.max_batch = int(max_batch or engine.max_bucket)
+        if self.max_batch < 1 or self.max_batch > engine.max_bucket:
+            raise ValueError("max_batch must be in [1, %d] (the engine's "
+                             "largest bucket), got %d"
+                             % (engine.max_bucket, self.max_batch))
+        self.max_delay = float(max_delay)
+        if int(max_queue) < 1:
+            # queue.Queue(0) is UNBOUNDED in the stdlib — the opposite
+            # of the backpressure contract this class promises
+            raise ValueError("max_queue must be >= 1 (a bounded queue is "
+                             "the backpressure mechanism), got %r"
+                             % (max_queue,))
+        self.stats = ServeStats()
+        self._q: "queue.Queue[_Request]" = queue.Queue(maxsize=int(max_queue))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._worker,
+                                        name="serve-batcher", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    def submit(self, payload, block: bool = True,
+               timeout: Optional[float] = None) -> Future:
+        """Enqueue one request (a single sample, no batch dim); returns
+        a ``concurrent.futures.Future`` resolving to its output row.
+        Raises :class:`Backpressure` when the bounded queue is full
+        (``block=False`` or ``timeout`` elapsed) and ``RuntimeError``
+        after ``close()``."""
+        if self._stop.is_set():
+            raise RuntimeError("batcher is closed")
+        fut: Future = Future()
+        req = _admit(_Request(payload, fut, time.monotonic()))
+        try:
+            self._q.put(req, block=block, timeout=timeout)
+        except queue.Full:
+            raise Backpressure(
+                "request queue full (%d pending) — the service is "
+                "saturated; shed load or retry with backoff"
+                % self._q.qsize()) from None
+        # close-race seal: a submit that passed the stop check before
+        # close() set the flag can land its put after the worker is
+        # gone.  If that happened, nobody will ever serve the queue —
+        # fail it (including our own request) instead of hanging the
+        # caller's future.result() forever.  While the worker is still
+        # alive its stop-drain loop serves everything queued, and
+        # close()'s post-join drain covers anything it left behind.
+        if self._stop.is_set() and not self._thread.is_alive():
+            self._fail_queued()
+        return fut
+
+    # ------------------------------------------------------------------
+    def _gather(self) -> Optional[List[_Request]]:
+        """Block for the first request, then fill until ``max_batch``
+        rows or the first request's deadline — whichever comes first.
+        Returns None when stopped and drained."""
+        while True:
+            try:
+                first = self._q.get(timeout=_POLL)
+                break
+            except queue.Empty:
+                if self._stop.is_set():
+                    return None
+        batch = [first]
+        deadline = first.t_submit + self.max_delay
+        while len(batch) < self.max_batch:
+            rem = deadline - time.monotonic()
+            if rem <= 0:
+                # deadline hit: scoop everything already queued (a
+                # backlogged worker must not degrade to batches of 1 —
+                # the whole point of CONTINUOUS batching), then flush
+                while len(batch) < self.max_batch:
+                    try:
+                        batch.append(self._q.get_nowait())
+                    except queue.Empty:
+                        break
+                self.stats.flush_deadline += 1
+                return batch
+            if self._stop.is_set():
+                # draining: serve everything immediately-available, but
+                # never sit out a deadline nobody else will feed (its
+                # own stat — a drain flush is not deadline pressure)
+                try:
+                    batch.append(self._q.get_nowait())
+                    continue
+                except queue.Empty:
+                    self.stats.flush_drain += 1
+                    return batch
+            try:
+                batch.append(self._q.get(timeout=min(rem, _POLL)))
+            except queue.Empty:
+                continue
+        self.stats.flush_full += 1
+        return batch
+
+    def _flush(self, reqs: List[_Request]):
+        eng = self.engine
+        rows, good = [], []
+        for r in reqs:
+            try:
+                a = np.asarray(r.payload)
+                if tuple(a.shape) != eng.sample_shape:
+                    raise ValueError(
+                        "request shape %s, engine serves %s"
+                        % (tuple(a.shape), eng.sample_shape))
+                a = np.ascontiguousarray(a, dtype=eng.sample_dtype)
+            except Exception as e:  # noqa: BLE001 — per-request isolation
+                self.stats.rejected += 1
+                r.future.set_exception(RequestError(
+                    "malformed request: %s: %s" % (type(e).__name__, e)))
+                continue
+            rows.append(a)
+            good.append(r)
+        if not good:
+            return
+        try:
+            out = eng.infer(np.stack(rows))
+            # ONE transfer for the whole batch, then host-side scatter
+            out = jax.tree.map(np.asarray, jax.device_get(out))
+        except Exception as e:  # noqa: BLE001 — fail the batch, not the loop
+            self.stats.failed += len(good)
+            for r in good:
+                r.future.set_exception(e)
+            return
+        t_done = time.monotonic()
+        self.stats.occupancy[len(good)] += 1
+        for i, r in enumerate(good):
+            self.stats.latencies.append(t_done - r.t_submit)
+            r.future.set_result(jax.tree.map(lambda a: a[i], out))
+
+    def _worker(self):
+        while True:
+            batch = self._gather()
+            if batch is None:
+                return
+            try:
+                self._flush(batch)
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+
+    # ------------------------------------------------------------------
+    def _fail_queued(self):
+        """Fail every request still sitting in the queue (nobody will
+        serve it).  Shared by ``close()`` and the submit-side
+        close-race seal; idempotent."""
+        while True:
+            try:
+                r = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if not r.future.done():
+                r.future.set_exception(
+                    RuntimeError("batcher closed before this request "
+                                 "was served"))
+
+    def close(self, join_timeout: float = 5.0):
+        """Stop admission, serve what is queued, join the worker.
+
+        The ``io/resilient.py`` drain-join discipline: stop is
+        signalled first (pending submits wake), the worker drains the
+        queue (every already-admitted request is served or failed),
+        the bounded join WARNS when the worker is stale, and anything
+        the stale worker left behind is failed on its future — no
+        request is ever silently dropped.  A submit that raced the
+        stop flag and landed after this drain is failed by the
+        submit-side seal (see :meth:`submit`)."""
+        self._stop.set()
+        self._thread.join(timeout=join_timeout)
+        if self._thread.is_alive():
+            warnings.warn(
+                "serve batcher worker did not exit within %gs — it is "
+                "still blocked inside the engine; queued requests are "
+                "being failed and the thread abandoned" % join_timeout)
+        self._fail_queued()
+
+    def __del__(self):
+        try:
+            if not self._stop.is_set():
+                self.close(join_timeout=1.0)
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass

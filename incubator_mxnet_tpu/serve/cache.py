@@ -1,0 +1,323 @@
+"""Device-carried decode cache with O(1) per-token in-place update.
+
+Autoregressive decode is the pathological case for a shape-bucketed
+engine: the attention context grows every token, so the naive program
+(recompute the whole prefix) is O(T^2) FLOPs per sequence AND a new
+program shape per length — a recompile per token.  The fix, per
+PAPERS.md's "Compiler-First State Space Duality and Portable O(1)
+Autoregressive Caching for Inference" (arXiv:2603.09555), is a
+**device-resident, fixed-shape, donated** cache:
+
+- the K/V context lives on device in ring-slot layout — per layer one
+  ``(batch, max_len, heads, head_dim)`` buffer, the write slot is
+  ``pos % max_len`` (a pure function of the carried position, so the
+  program is position-agnostic: ONE compiled step serves every token);
+- the per-token update is ``lax.dynamic_update_slice`` of one row —
+  O(1) bytes touched, and because the cache buffers are **donated**
+  XLA performs it in place: no O(T) copy, no reallocation;
+- ``max_len`` is bucketed like the engine's batch dim
+  (``seq_buckets``), so a short chat and a long document each get a
+  right-sized cache without new programs per length;
+- the carried position is a device ``int32`` (never a host scalar —
+  exactly the GL005 recompile hazard the train step's carried counter
+  avoids).
+
+Beyond ``max_len`` the ring overwrites the oldest slot: attention
+degrades to a sliding window (the validity mask keeps all slots).
+Within ``max_len`` — the regime the equivalence tests pin — cached
+decode is step-for-step identical to full recompute.
+
+:class:`TinyDecoderLM` is the small pure-functional decoder LM that
+exercises the cache (pre-LN transformer, learned positions); the
+gluon CNNs exercise the batch engine (``serve/engine.py``).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel.aot import (lint_served_program, resolve_mode,
+                            traced_with_effects)
+
+__all__ = ["CachedDecoder", "TinyDecoderLM", "init_cache"]
+
+
+def init_cache(n_layers: int, batch: int, max_len: int, n_heads: int,
+               head_dim: int, dtype=jnp.float32) -> Dict[str, Any]:
+    """Fresh decode cache: per-layer K/V ring buffers + the carried
+    position scalar.  A plain pytree, so it jits/donates/shards like
+    any other step state."""
+    shape = (batch, max_len, n_heads, head_dim)
+    return {"k": [jnp.zeros(shape, dtype) for _ in range(n_layers)],
+            "v": [jnp.zeros(shape, dtype) for _ in range(n_layers)],
+            "pos": jnp.int32(0)}
+
+
+def _ring_write(buf, row, pos):
+    """O(1) in-place ring write: ``row`` (batch, heads, head_dim) lands
+    at slot ``pos % max_len`` of ``buf`` (batch, max_len, heads,
+    head_dim).  With the cache donated, XLA lowers this to an in-place
+    row store — the whole point of the layout."""
+    slot = jnp.mod(pos, buf.shape[1]).astype(jnp.int32)
+    z = jnp.int32(0)
+    return lax.dynamic_update_slice(buf, row[:, None], (z, slot, z, z))
+
+
+class TinyDecoderLM:
+    """Minimal pre-LN causal transformer decoder, pure-functional.
+
+    Small enough to compile in milliseconds on the CPU mesh, real
+    enough to make cached-vs-recompute equivalence a meaningful test:
+    multi-head causal attention, learned positions, GELU MLP, weight-
+    tied readout is deliberately NOT used (an explicit head keeps the
+    logits-parity test sensitive to the full parameter set).
+    """
+
+    def __init__(self, vocab: int = 64, d_model: int = 32, n_heads: int = 2,
+                 n_layers: int = 2, d_ff: int = 64, max_len: int = 64):
+        if d_model % n_heads:
+            raise ValueError("d_model %d not divisible by n_heads %d"
+                             % (d_model, n_heads))
+        self.vocab = vocab
+        self.d_model = d_model
+        self.n_heads = n_heads
+        self.n_layers = n_layers
+        self.d_ff = d_ff
+        self.max_len = max_len
+        self.head_dim = d_model // n_heads
+
+    # -- params --------------------------------------------------------
+    def init(self, key) -> Dict[str, Any]:
+        d, f, v = self.d_model, self.d_ff, self.vocab
+        keys = iter(jax.random.split(key, 4 + 6 * self.n_layers))
+
+        def mat(shape, scale=None):
+            # np.float32: a bare np.float64 scale would silently promote
+            # every weight to f64 under the package-wide x64 flag
+            scale = np.float32(scale or 1.0 / np.sqrt(shape[0]))
+            return (jax.random.normal(next(keys), shape, jnp.float32)
+                    * scale)
+
+        blocks = []
+        for _ in range(self.n_layers):
+            blocks.append({
+                "ln1": jnp.ones((d,), jnp.float32),
+                "wq": mat((d, d)), "wk": mat((d, d)), "wv": mat((d, d)),
+                "wo": mat((d, d)),
+                "ln2": jnp.ones((d,), jnp.float32),
+                "w1": mat((d, f)), "b1": jnp.zeros((f,), jnp.float32),
+                "w2": mat((f, d)), "b2": jnp.zeros((d,), jnp.float32)})
+        return {"embed": mat((v, d), scale=0.02),
+                "pos": mat((self.max_len, d), scale=0.02),
+                "blocks": blocks,
+                "ln_f": jnp.ones((d,), jnp.float32),
+                "head": mat((d, v))}
+
+    # -- shared pieces -------------------------------------------------
+    @staticmethod
+    def _ln(x, scale):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+        return (x - mu) * lax.rsqrt(var + 1e-6) * scale
+
+    def _heads(self, x, w):
+        # (..., d) @ (d, d) -> (..., heads, head_dim)
+        y = x @ w
+        return y.reshape(y.shape[:-1] + (self.n_heads, self.head_dim))
+
+    def _mlp(self, blk, x):
+        return jax.nn.gelu(x @ blk["w1"] + blk["b1"]) @ blk["w2"] + blk["b2"]
+
+    # -- full recompute (the parity reference + the prefill path) ------
+    def apply_tokens(self, params, tokens, return_kv: bool = False):
+        """Full-context causal forward: ``tokens`` (B, T) -> logits
+        (B, T, V).  ``return_kv=True`` also returns the per-layer K/V
+        ``(B, T, H, Dh)`` so prefill can seed the decode cache from the
+        SAME computation it returns logits from."""
+        B, T = tokens.shape
+        x = params["embed"][tokens] + params["pos"][:T][None]
+        scale = np.float32(1.0 / np.sqrt(self.head_dim))
+        causal = jnp.tril(jnp.ones((T, T), bool))
+        kvs = []
+        for blk in params["blocks"]:
+            h = self._ln(x, blk["ln1"])
+            q = self._heads(h, blk["wq"])          # (B, T, H, Dh)
+            k = self._heads(h, blk["wk"])
+            v = self._heads(h, blk["wv"])
+            kvs.append((k, v))
+            att = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            att = jnp.where(causal[None, None], att, -jnp.inf)
+            att = jax.nn.softmax(att, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", att, v)
+            x = x + o.reshape(B, T, self.d_model) @ blk["wo"]
+            x = x + self._mlp(blk, self._ln(x, blk["ln2"]))
+        logits = self._ln(x, params["ln_f"]) @ params["head"]
+        return (logits, kvs) if return_kv else logits
+
+    # -- O(1) cached step ----------------------------------------------
+    def apply_step(self, params, token, cache):
+        """One decode step: ``token`` (B,) int32 + cache -> (logits
+        (B, V), cache').  Touches O(1) cache bytes: one ring-slot write
+        per layer, one read pass of the fixed-shape buffers for
+        attention."""
+        pos = cache["pos"]
+        S = cache["k"][0].shape[1]
+        B = token.shape[0]
+        # learned position, clamped into the table (past max_len the
+        # ring serves a sliding window; positions saturate)
+        p_idx = jnp.minimum(pos, params["pos"].shape[0] - 1)
+        x = params["embed"][token] + params["pos"][p_idx][None]
+        scale = np.float32(1.0 / np.sqrt(self.head_dim))
+        # slots ever written: ring-full means everything is context
+        valid = jnp.arange(S) < jnp.minimum(pos + 1, S)
+        new_k, new_v = [], []
+        for li, blk in enumerate(params["blocks"]):
+            h = self._ln(x, blk["ln1"])
+            q = self._heads(h, blk["wq"])          # (B, H, Dh)
+            k1 = self._heads(h, blk["wk"])
+            v1 = self._heads(h, blk["wv"])
+            kbuf = _ring_write(cache["k"][li], k1, pos)
+            vbuf = _ring_write(cache["v"][li], v1, pos)
+            new_k.append(kbuf)
+            new_v.append(vbuf)
+            att = jnp.einsum("bhd,bshd->bhs", q, kbuf) * scale
+            att = jnp.where(valid[None, None], att, -jnp.inf)
+            att = jax.nn.softmax(att, axis=-1)
+            o = jnp.einsum("bhs,bshd->bhd", att, vbuf)
+            x = x + o.reshape(B, self.d_model) @ blk["wo"]
+            x = x + self._mlp(blk, self._ln(x, blk["ln2"]))
+        logits = self._ln(x, params["ln_f"]) @ params["head"]
+        return logits, {"k": new_k, "v": new_v, "pos": pos + 1}
+
+    def prefill_into_cache(self, params, tokens, cache):
+        """Full-recompute forward over the prompt whose per-layer K/V
+        seed the cache in one program: returns ``(logits (B, T, V),
+        cache')`` with the cache position advanced past the prompt."""
+        T = tokens.shape[1]
+        S = cache["k"][0].shape[1]
+        if T > S:
+            # trace-time check, BEFORE the update-slices that would
+            # otherwise fail with an opaque shape error
+            raise ValueError("prompt length %d exceeds cache max_len %d"
+                             % (T, S))
+        logits, kvs = self.apply_tokens(params, tokens, return_kv=True)
+        new_k, new_v = [], []
+        for li, (k, v) in enumerate(kvs):
+            new_k.append(lax.dynamic_update_slice(
+                cache["k"][li], k.astype(cache["k"][li].dtype),
+                (0, 0, 0, 0)))
+            new_v.append(lax.dynamic_update_slice(
+                cache["v"][li], v.astype(cache["v"][li].dtype),
+                (0, 0, 0, 0)))
+        return logits, {"k": new_k, "v": new_v,
+                        "pos": cache["pos"] + jnp.int32(T)}
+
+
+class CachedDecoder:
+    """Compiled decode loop over a :class:`TinyDecoderLM` (or any
+    object with the same ``apply_step``/``prefill_into_cache``
+    surface): the serving-side driver that owns the program table and
+    the donated cache.
+
+    Programs: one prefill program per (batch, prompt-length) and ONE
+    step program per (batch, seq bucket) — every generated token reuses
+    the same executable because the position is carried device state.
+    The cache argnum is donated (in-place O(1) update); the params
+    argnum is NOT, and the lint pass proves it with GL010.
+    """
+
+    def __init__(self, lm, params, seq_buckets: Sequence[int] = (64,),
+                 lint: Optional[str] = None,
+                 lint_suppress: Tuple[str, ...] = ()):
+        self.lm = lm
+        self.params = params
+        self.seq_buckets = tuple(sorted(int(b) for b in seq_buckets))
+        if not self.seq_buckets or any(b < 1 for b in self.seq_buckets):
+            raise ValueError("seq_buckets must be positive lengths, got %r"
+                             % (seq_buckets,))
+        if self.seq_buckets[-1] > lm.max_len:
+            raise ValueError(
+                "seq bucket %d exceeds the LM's position table (%d)"
+                % (self.seq_buckets[-1], lm.max_len))
+        self.lint = resolve_mode(lint, "MXTPU_LINT", "warn",
+                                 ("off", "warn", "error"), "lint")
+        self.lint_suppress = tuple(lint_suppress)
+        self._linted = False
+        # args are (params, token(s), cache); the CACHE is the donated
+        # per-request state, the params must survive every call (GL010)
+        self._step_jit = jax.jit(lm.apply_step, donate_argnums=(2,))
+        self._prefill_jit = jax.jit(lm.prefill_into_cache,
+                                    donate_argnums=(2,))
+        self._programs: Dict[tuple, Any] = {}
+        self.compiles = 0
+        self.cache = None
+        self.max_len = None
+
+    def seq_bucket_for(self, total_len: int) -> int:
+        for b in self.seq_buckets:
+            if total_len <= b:
+                return b
+        raise ValueError("sequence of %d tokens exceeds the largest seq "
+                         "bucket %d" % (total_len, self.seq_buckets[-1]))
+
+    # ------------------------------------------------------------------
+    def _lint_program(self, jit_obj, args, what):
+        if self.lint == "off" or self._linted:
+            return jit_obj.trace(*args)
+        traced, effects = traced_with_effects(jit_obj, args)
+        lint_served_program(traced, effects, args, (2,), mode=self.lint,
+                            suppress=self.lint_suppress, what=what)
+        self._linted = True
+        return traced
+
+    def _compiled(self, kind, jit_obj, args, key):
+        prog = self._programs.get(key)
+        if prog is None:
+            traced = self._lint_program(
+                jit_obj, args, "CachedDecoder %s %r" % (kind, key))
+            prog = traced.lower().compile()
+            self._programs[key] = prog
+            self.compiles += 1
+        return prog
+
+    # ------------------------------------------------------------------
+    def start(self, tokens, max_new: int):
+        """Begin decoding: pick the seq bucket for ``prompt + max_new``,
+        allocate the cache, run the prefill program.  ``tokens`` is the
+        prompt (B, T0) int32.  Returns the prompt logits (B, T0, V)."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        B, T0 = tokens.shape
+        self.max_len = self.seq_bucket_for(T0 + int(max_new))
+        lm = self.lm
+        self.cache = init_cache(lm.n_layers, B, self.max_len, lm.n_heads,
+                                lm.head_dim)
+        prog = self._compiled(
+            "prefill", self._prefill_jit,
+            (self.params, tokens, self.cache),
+            ("prefill", B, T0, self.max_len))
+        logits, self.cache = prog(self.params, tokens, self.cache)
+        return logits
+
+    def step(self, token):
+        """Decode one token for every sequence: ``token`` (B,) int32 ->
+        logits (B, V).  Every call after the first reuses the SAME
+        executable (position is device state; the cache is donated and
+        updated in place)."""
+        if self.cache is None:
+            raise RuntimeError("start() a sequence before step()")
+        token = jnp.asarray(token, jnp.int32)
+        B = token.shape[0]
+        prog = self._compiled("step", self._step_jit,
+                              (self.params, token, self.cache),
+                              ("step", B, self.max_len))
+        logits, self.cache = prog(self.params, token, self.cache)
+        return logits
+
+    @property
+    def pos(self) -> int:
+        return 0 if self.cache is None else int(self.cache["pos"])

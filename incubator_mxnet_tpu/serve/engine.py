@@ -1,0 +1,440 @@
+"""ServeEngine: AOT-compiled, bucketed-batch-shape inference programs.
+
+The training stack compiles ONE program per batch shape and reuses it
+forever (``parallel/train_step.py``); a server cannot do that naively —
+request batches arrive at every size, and "retrace per size" is a
+recompile storm (the hazard GL005 exists for).  The reference solved
+this with CachedOp + the C predict API's fixed-shape binds (SURVEY.md
+§L5c, ``MXPredCreate/Forward``); the TPU-native answer is **shape
+buckets**:
+
+- inference programs are AOT-compiled per *bucket* batch shape
+  (pad-to-bucket, slice-back), so the program table is small and the
+  steady state compiles NOTHING — ``recompile_count`` counts any
+  post-warmup compile and surfaces it as a GL005 diagnostic;
+- parameters are **device-resident and never donated** — they are the
+  server's long-lived state, reused by every request.  The engine's
+  lint pass enforces this at trace time with GL010
+  (``analysis.check_inference_param_donation``), the serving-side
+  complement of GL003; per-request buffers (a decode cache —
+  ``serve/cache.py``) are the legitimate donation targets;
+- on a mesh the engine serves dp-replicated: params replicated (or
+  per ``param_shardings``), the padded batch sharded over the batch
+  axis, so one program spans every replica;
+- ``dtype="int8"`` is the weight-only quantized tier: eligible
+  parameters (floating, ndim >= 2) are quantized ONCE at load with the
+  symmetric int8 convention of ``ops/quantization.py``
+  (``quantize_tensor``) and dequantized inside the compiled program —
+  4x smaller resident weights, the memory-bound decode win;
+- the ``lint=`` / ``cost=`` trace hooks ride the same pre-compile
+  ``jit.trace()`` the first call reuses, exactly like the fused train
+  step (shared plumbing: ``parallel/aot.py``).
+
+Padding is exact, not approximate: every op in an inference forward
+(conv, dense, pooling, inference-mode BatchNorm over *running* stats)
+is row-independent, so the rows of a padded bucket are bit-identical
+to the same requests evaluated unpadded — ``tests/test_serve.py``
+asserts this, and the zero rows cost only the bucket-granularity
+compute the batcher's occupancy histogram makes visible.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ndarray import NDArray
+from ..ops.quantization import dequantize_tensor, quantize_tensor
+from ..parallel.aot import (compile_timed, lint_served_program,
+                            resolve_mode, traced_with_effects)
+
+__all__ = ["ServeEngine"]
+
+
+class ServeEngine:
+    """AOT-compiled bucketed inference over a gluon net.
+
+    Usage::
+
+        engine = ServeEngine(net, buckets=(8, 32), mesh=mesh)
+        engine.warmup(np.zeros((3, 32, 32), np.float32))  # one sample
+        out = engine.infer(batch)      # any batch size <= max bucket
+
+    ``buckets`` are the batch sizes programs exist for, ascending; a
+    request batch of ``n`` rows runs in the smallest bucket >= n
+    (zero-padded, sliced back), and a batch larger than the biggest
+    bucket is served in bucket-sized chunks.  ``warmup`` precompiles
+    every bucket; after it, ``recompile_count`` must stay 0 — any miss
+    is counted and warned as a GL005 finding.
+
+    ``donate_argnums`` is the program's donation spec over the
+    ``(params, x)`` argument list.  Argnum 1 (the padded input buffer)
+    is the only legitimate entry; argnum 0 is the parameter pytree and
+    is rejected at trace time by GL010 under ``lint="error"`` — a
+    served model's weights must survive the call.
+    """
+
+    def __init__(self, net, buckets: Sequence[int] = (1, 8, 32),
+                 mesh=None, batch_axis: str = "dp", dtype: Optional[str] = None,
+                 param_shardings: Optional[Dict[str, Any]] = None,
+                 donate_argnums: Tuple[int, ...] = (),
+                 lint: Optional[str] = None,
+                 lint_suppress: Tuple[str, ...] = (),
+                 cost: Optional[str] = None,
+                 hbm_budget: Optional[float] = None,
+                 cost_device: str = "tpu-v5e"):
+        self.net = net
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        if not self.buckets or any(b < 1 for b in self.buckets):
+            raise ValueError("buckets must be positive batch sizes, got %r"
+                             % (buckets,))
+        if len(set(self.buckets)) != len(self.buckets):
+            raise ValueError("duplicate buckets in %r" % (buckets,))
+        self.mesh = mesh
+        self.batch_axis = batch_axis
+        self.param_shardings = param_shardings or {}
+        if mesh is not None and batch_axis in mesh.axis_names:
+            n = mesh.shape[batch_axis]
+            bad = [b for b in self.buckets if b % n]
+            if bad:
+                raise ValueError(
+                    "buckets %s do not divide the %r mesh axis (size %d) — "
+                    "a padded bucket must shard evenly over the replicas"
+                    % (bad, batch_axis, n))
+        if dtype is not None and dtype != "int8":
+            # a float dtype is a compute cast (the bf16 serving tier);
+            # validate it eagerly
+            np.dtype(dtype)
+        self.dtype = dtype
+        self._int8 = dtype == "int8"
+        self._donate_argnums = tuple(int(a) for a in donate_argnums)
+        if any(a not in (0, 1) for a in self._donate_argnums):
+            raise ValueError("donate_argnums index the (params, x) "
+                             "argument list; got %r" % (donate_argnums,))
+        self.lint = resolve_mode(lint, "MXTPU_LINT", "warn",
+                                 ("off", "warn", "error"), "lint")
+        self.lint_suppress = tuple(lint_suppress)
+        self.cost = resolve_mode(cost, "MXTPU_COST", "off",
+                                 ("off", "report", "check"), "cost")
+        if hbm_budget is not None and float(hbm_budget) <= 0:
+            raise ValueError("hbm_budget must be positive bytes, got %r"
+                             % (hbm_budget,))
+        self.hbm_budget = float(hbm_budget) if hbm_budget else None
+        self.cost_device = cost_device
+        self.cost_report = None       # most recently analyzed bucket
+        self.cost_reports: Dict[tuple, Any] = {}  # per program key
+        self._linted = False
+        # the persistent program table: (bucket, sample shape, dtype) ->
+        # compiled executable — the engine-lifetime analog of the
+        # reference's CachedOp bind cache
+        self._programs: Dict[tuple, Any] = {}
+        self.compile_log: Dict[tuple, Dict[str, float]] = {}
+        self._params: List[Any] = []       # Parameter objects
+        self._p_vals: List[Any] = []       # device-resident values
+        self._quantized: List[bool] = []   # per-param int8 marker
+        self._placed = False
+        self._warm = False
+        self._jit = None
+        self.sample_shape: Optional[tuple] = None
+        self.sample_dtype = None
+        # serving counters (the loadtest report reads these)
+        self.recompile_count = 0
+        self.infer_calls = 0
+        self.rows_served = 0
+        self.padded_rows = 0
+
+    # ------------------------------------------------------------------
+    @property
+    def max_bucket(self) -> int:
+        return self.buckets[-1]
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket that fits ``n`` rows (the padding target)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.max_bucket
+
+    # ------------------------------------------------------------------
+    def _collect(self):
+        if self._params:
+            return
+        self._params = list(self.net.collect_params().values())
+        if any(p._data is None for p in self._params):
+            raise RuntimeError("initialize() the net (and run one forward "
+                               "for deferred shapes) before serving it")
+        compute = None if (self._int8 or self.dtype is None) else self.dtype
+        vals, quant = [], []
+        for p in self._params:
+            v = p._data._data
+            if self._int8 and jnp.issubdtype(v.dtype, jnp.floating) \
+                    and v.ndim >= 2:
+                # weight-only int8: matrices/filters carry the bytes;
+                # vectors (biases, BN stats/scales) stay in float —
+                # their error would be per-channel, their size is noise
+                vals.append(quantize_tensor(v))
+                quant.append(True)
+            else:
+                if compute is not None and \
+                        jnp.issubdtype(v.dtype, jnp.floating):
+                    v = v.astype(compute)
+                vals.append(v)
+                quant.append(False)
+        self._p_vals = vals
+        self._quantized = quant
+
+    def _param_dtype(self):
+        """The dtype params are bound as inside the program (and the
+        dtype int8 weights dequantize back to)."""
+        if self.dtype is not None and not self._int8:
+            return jnp.dtype(self.dtype)
+        for p, q in zip(self._params, self._quantized):
+            v = p._data._data
+            if jnp.issubdtype(v.dtype, jnp.floating):
+                return jnp.dtype(v.dtype)
+        return jnp.dtype(jnp.float32)
+
+    def _infer_fn(self):
+        from ..gluon.block import pure_forward
+
+        params = self._params
+        quant = self._quantized
+        pdt = self._param_dtype()
+
+        def infer(p_vals, x):
+            vals = [dequantize_tensor(v[0], v[1], dtype=pdt) if q else v
+                    for v, q in zip(p_vals, quant)]
+            if jnp.issubdtype(x.dtype, jnp.unsignedinteger):
+                # raw image bytes (the uint8 record path): promote like
+                # the train step does
+                x = x.astype(pdt)
+            elif self.dtype is not None and not self._int8 \
+                    and jnp.issubdtype(x.dtype, jnp.floating):
+                x = x.astype(pdt)
+            out, _tc = pure_forward(self.net, params, vals, x,
+                                    training=False)
+            return out
+
+        return infer
+
+    def _build_jit(self):
+        if self._jit is not None:
+            return self._jit
+        infer = self._infer_fn()
+        if self.mesh is None:
+            self._jit = jax.jit(infer, donate_argnums=self._donate_argnums)
+            return self._jit
+        mesh = self.mesh
+        repl = NamedSharding(mesh, P())
+
+        def p_shard(p):
+            return NamedSharding(mesh, self.param_shardings.get(p.name, P()))
+
+        p_sh = [((p_shard(p), repl) if q else p_shard(p))
+                for p, q in zip(self._params, self._quantized)]
+        self._batch_sh = NamedSharding(mesh, P(self.batch_axis)) \
+            if self.batch_axis in mesh.axis_names else repl
+        self._jit = jax.jit(infer, donate_argnums=self._donate_argnums,
+                            in_shardings=(p_sh, self._batch_sh))
+        return self._jit
+
+    def _place(self):
+        if self._placed or self.mesh is None:
+            return
+        mesh = self.mesh
+        repl = NamedSharding(mesh, P())
+
+        def put(v, p):
+            sh = NamedSharding(mesh, self.param_shardings.get(p.name, P()))
+            return (jax.device_put(v[0], sh), jax.device_put(v[1], repl)) \
+                if isinstance(v, tuple) else jax.device_put(v, sh)
+
+        self._p_vals = [put(v, p)
+                        for v, p in zip(self._p_vals, self._params)]
+        self._placed = True
+
+    # ------------------------------------------------------------------
+    def _maybe_lint(self, traced, effects, args, bucket):
+        """graftlint over the FIRST bucket's trace (the program is the
+        same modulo the batch extent), graftcost over EVERY bucket's —
+        peak memory scales with the bucket, so the GL201 budget gate
+        must see each program it could reject (shared ritual:
+        ``parallel/aot.py``).  GL010 runs against this engine's own
+        donation spec — an engine built with the params argnum in
+        ``donate_argnums`` refuses to compile under ``lint="error"``."""
+        if self.lint != "off" and not self._linted:
+            lint_served_program(
+                traced, effects, args, self._donate_argnums,
+                mode=self.lint, suppress=self.lint_suppress,
+                what="ServeEngine(%s, bucket=%d)" % (self.net.name,
+                                                     bucket))
+            self._linted = True
+        if self.cost != "off":
+            self._finish_cost(traced.jaxpr, args, bucket)
+
+    def _finish_cost(self, closed_jaxpr, args, bucket):
+        from ..analysis import LintReport, Severity
+        from ..analysis.cost_model import analyze_jaxpr
+        from ..analysis.trace_lint import donated_leaf_indices
+
+        axis_sizes, n_dev = None, 1
+        if self.mesh is not None:
+            axis_sizes = {k: int(v) for k, v in dict(self.mesh.shape).items()}
+            n_dev = int(self.mesh.size)
+        report = analyze_jaxpr(
+            closed_jaxpr, axis_sizes=axis_sizes,
+            donated_leaves=donated_leaf_indices(args, self._donate_argnums),
+            device=self.cost_device, n_devices=n_dev,
+            hbm_budget=self.hbm_budget,
+            meta={"serve": True, "bucket": bucket,
+                  "dtype": self.dtype or "net", "batch_axis": self.batch_axis})
+        rep = LintReport(suppress=self.lint_suppress)
+        rep.extend(report.diagnostics)
+        report.diagnostics = list(rep.diagnostics)
+        self.cost_report = report
+        self.cost_reports[self._program_key(bucket)] = report
+        if self.cost == "check":
+            rep.raise_if_errors()
+            if rep.warnings:
+                import warnings as _warnings
+
+                _warnings.warn("graftcost: inference program has findings\n"
+                               + rep.format(Severity.WARNING), stacklevel=5)
+
+    # ------------------------------------------------------------------
+    def _program_key(self, bucket):
+        return (bucket, self.sample_shape, str(np.dtype(self.sample_dtype)),
+                self.dtype or "net")
+
+    def _ensure_program(self, bucket, warming=False):
+        key = self._program_key(bucket)
+        prog = self._programs.get(key)
+        if prog is not None:
+            return prog
+        if self._warm and not warming:
+            # the GL005 regime: a steady-state server must never
+            # compile — count it AND say it the way the lint would
+            self.recompile_count += 1
+            from ..analysis import Diagnostic, Severity
+            import warnings as _warnings
+
+            _warnings.warn(Diagnostic(
+                "GL005", Severity.WARNING,
+                "post-warmup compile for bucket %d (key %r) — the "
+                "request path hit a shape the warmup never compiled; "
+                "steady-state serving must be compile-free"
+                % (bucket, key),
+                where="ServeEngine(%s)" % self.net.name,
+                hint="warmup() every bucket/dtype the batcher can emit "
+                     "before opening traffic").format(), stacklevel=4)
+        self._place()
+        jit_obj = self._build_jit()
+        x_aval = jax.ShapeDtypeStruct((bucket,) + tuple(self.sample_shape),
+                                      np.dtype(self.sample_dtype))
+        args = (self._p_vals, x_aval)
+        t0 = time.time()
+        traced, effects = traced_with_effects(
+            jit_obj, args, capture=self.lint != "off" and not self._linted)
+        self._maybe_lint(traced, effects, args, bucket)
+        prog, times = compile_timed(traced, t_trace=time.time() - t0)
+        self._programs[key] = prog
+        self.compile_log[key] = times
+        return prog
+
+    def warmup(self, sample, buckets: Optional[Sequence[int]] = None
+               ) -> Dict[str, float]:
+        """Precompile the program table for ``buckets`` (default: all).
+
+        ``sample`` is ONE request payload (no batch dim) — it pins the
+        per-sample shape and dtype every later request must match (the
+        batcher validates against it).  Returns accumulated
+        ``{"trace": s, "compile": s}`` wall seconds.  After warmup the
+        engine is in the steady-state regime: ``recompile_count``
+        starts, and must stay, at 0.
+        """
+        sample = np.asarray(sample.asnumpy() if isinstance(sample, NDArray)
+                            else sample)
+        if self.sample_shape is not None and (
+                tuple(sample.shape) != self.sample_shape
+                or np.dtype(sample.dtype) != np.dtype(self.sample_dtype)):
+            raise ValueError(
+                "warmup sample %s/%s disagrees with the engine's pinned "
+                "sample %s/%s — one engine serves one signature"
+                % (sample.shape, sample.dtype, self.sample_shape,
+                   self.sample_dtype))
+        self.sample_shape = tuple(sample.shape)
+        self.sample_dtype = np.dtype(sample.dtype)
+        self._collect()
+        total = {"trace": 0.0, "compile": 0.0}
+        for b in (self.buckets if buckets is None
+                  else sorted(set(int(x) for x in buckets))):
+            if b not in self.buckets:
+                raise ValueError("bucket %d is not in this engine's "
+                                 "buckets %s" % (b, self.buckets))
+            fresh = self._program_key(b) not in self._programs
+            # a staged warmup (a second call covering buckets the first
+            # skipped) is still WARMUP, not a steady-state recompile
+            self._ensure_program(b, warming=True)
+            if fresh:
+                # only work THIS call did — an already-compiled bucket
+                # must not re-bill its original compile seconds
+                t = self.compile_log[self._program_key(b)]
+                total["trace"] += t["trace"]
+                total["compile"] += t["compile"]
+        self._warm = True
+        return total
+
+    # ------------------------------------------------------------------
+    def _run_bucket(self, xv: np.ndarray):
+        """One padded-bucket execution; returns device output(s) for the
+        first ``n`` rows still padded (the caller slices)."""
+        n = xv.shape[0]
+        bucket = self.bucket_for(n)
+        prog = self._ensure_program(bucket)
+        if n != bucket:
+            pad = np.zeros((bucket - n,) + xv.shape[1:], xv.dtype)
+            xv = np.concatenate([xv, pad], axis=0)
+            self.padded_rows += bucket - n
+        # ONE sharded transfer straight from host memory — an
+        # intermediate jnp.asarray would pay a second, resharding copy
+        # on the per-request hot path
+        x_dev = jax.device_put(xv, self._batch_sh) \
+            if self.mesh is not None else jnp.asarray(xv)
+        return prog(self._p_vals, x_dev)
+
+    def infer(self, x):
+        """Serve one request batch ``(n, *sample_shape)`` — padded into
+        its bucket, sliced back to ``n`` rows; batches over the largest
+        bucket run as chunks.  Output structure follows the net (each
+        leaf's leading axis is the batch)."""
+        if self.sample_shape is None:
+            raise RuntimeError("warmup() the engine before serving "
+                               "(it pins the request signature)")
+        xv = np.asarray(x.asnumpy() if isinstance(x, NDArray) else x)
+        if tuple(xv.shape[1:]) != self.sample_shape:
+            raise ValueError("request rows have shape %s, engine serves %s"
+                             % (tuple(xv.shape[1:]), self.sample_shape))
+        if np.dtype(xv.dtype) != self.sample_dtype:
+            raise ValueError("request dtype %s, engine serves %s"
+                             % (xv.dtype, self.sample_dtype))
+        n = xv.shape[0]
+        if n == 0:
+            raise ValueError("empty request batch")
+        self.infer_calls += 1
+        self.rows_served += n
+        mb = self.max_bucket
+        outs = []
+        for off in range(0, n, mb):
+            chunk = xv[off:off + mb]
+            out = self._run_bucket(chunk)
+            k = chunk.shape[0]
+            outs.append(jax.tree.map(lambda a: a[:k], out))
+        if len(outs) == 1:
+            return outs[0]
+        return jax.tree.map(lambda *leaves: jnp.concatenate(leaves, axis=0),
+                            *outs)

@@ -1,0 +1,122 @@
+"""Open-loop synthetic traffic harness for the serving stack.
+
+Closed-loop load generators (send, wait, send) measure the server's
+*convenience*: they slow down exactly when the server does, hiding
+queueing collapse.  An **open-loop** generator submits on its own
+clock — Poisson arrivals at a target rate, like independent users —
+so saturation shows up where it belongs: in the latency tail.  This
+harness is the acceptance instrument of ROADMAP item 2:
+
+- Poisson arrivals (exponential inter-arrival gaps from a seeded RNG —
+  deterministic per seed, so CI thresholds are stable);
+- per-request latency from admission to completed scatter, reported as
+  p50/p95/p99 + sustained QPS over the measurement window;
+- the batcher's occupancy histogram (how full the buckets really ran)
+  and flush-trigger split (size- vs deadline-triggered);
+- the engine's ``recompile_count`` delta across the window — the GL005
+  steady-state contract: after warmup it must be 0.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .batcher import Backpressure, ContinuousBatcher
+
+__all__ = ["LoadReport", "poisson_loadtest"]
+
+
+@dataclass
+class LoadReport:
+    """One open-loop run's results (JSON-serializable via ``to_dict``)."""
+    n_requests: int = 0
+    ok: int = 0
+    errors: int = 0
+    shed: int = 0                  # Backpressure rejections at submit
+    wall_s: float = 0.0
+    qps_offered: float = 0.0
+    qps_sustained: float = 0.0
+    p50_ms: float = 0.0
+    p95_ms: float = 0.0
+    p99_ms: float = 0.0
+    occupancy: Dict[int, int] = field(default_factory=dict)
+    flush_full: int = 0
+    flush_deadline: int = 0
+    recompiles: int = 0
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dict(self.__dict__)
+        d["occupancy"] = {str(k): v for k, v in self.occupancy.items()}
+        return d
+
+    def format(self) -> str:
+        occ = " ".join("%d:%d" % kv for kv in sorted(self.occupancy.items()))
+        return ("loadtest: %d req in %.2fs — %.1f qps sustained "
+                "(%.1f offered), p50 %.2f / p95 %.2f / p99 %.2f ms, "
+                "%d err, %d shed, occupancy {%s}, flushes %d full / %d "
+                "deadline, %d recompiles"
+                % (self.n_requests, self.wall_s, self.qps_sustained,
+                   self.qps_offered, self.p50_ms, self.p95_ms, self.p99_ms,
+                   self.errors, self.shed, occ, self.flush_full,
+                   self.flush_deadline, self.recompiles))
+
+
+def poisson_loadtest(batcher: ContinuousBatcher,
+                     payload_fn: Callable[[int, np.random.RandomState], Any],
+                     qps: float, n_requests: int = 200, seed: int = 0,
+                     timeout: float = 30.0,
+                     extra: Optional[Dict[str, Any]] = None) -> LoadReport:
+    """Drive ``batcher`` with open-loop Poisson traffic.
+
+    ``payload_fn(i, rng)`` builds the i-th request payload (one sample);
+    ``qps`` is the offered rate — inter-arrival gaps are Exp(1/qps).
+    Submission never waits for completion (open loop; a full queue is
+    recorded as shed load, not waited out).  Returns a
+    :class:`LoadReport`; the batcher's stats window is reset at start,
+    so one batcher can serve several measured legs back to back.
+    """
+    if qps <= 0:
+        raise ValueError("qps must be positive")
+    rng = np.random.RandomState(seed)
+    gaps = rng.exponential(1.0 / qps, size=n_requests)
+    batcher.stats.reset()
+    recompiles0 = batcher.engine.recompile_count
+    futures = []
+    shed = 0
+    t0 = time.monotonic()
+    next_t = t0
+    for i in range(n_requests):
+        next_t += gaps[i]
+        delay = next_t - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            futures.append(batcher.submit(payload_fn(i, rng), block=False))
+        except Backpressure:
+            shed += 1
+    ok = errors = 0
+    deadline = time.monotonic() + timeout
+    for f in futures:
+        try:
+            f.result(timeout=max(0.0, deadline - time.monotonic()))
+            ok += 1
+        except Exception:  # noqa: BLE001 — per-request failures are counted
+            errors += 1
+    wall = time.monotonic() - t0
+    pct = batcher.stats.percentiles()
+    report = LoadReport(
+        n_requests=n_requests, ok=ok, errors=errors, shed=shed,
+        wall_s=wall, qps_offered=qps,
+        qps_sustained=ok / wall if wall > 0 else 0.0,
+        p50_ms=pct["p50"] * 1e3, p95_ms=pct["p95"] * 1e3,
+        p99_ms=pct["p99"] * 1e3,
+        occupancy=dict(batcher.stats.occupancy),
+        flush_full=batcher.stats.flush_full,
+        flush_deadline=batcher.stats.flush_deadline,
+        recompiles=batcher.engine.recompile_count - recompiles0,
+        extra=dict(extra or {}))
+    return report

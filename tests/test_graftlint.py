@@ -417,6 +417,28 @@ def test_gl007_legacy_save_states_from_zero1_fused_trainer():
     os.unlink("/tmp/gl007_plain.states")
 
 
+def test_gl010_inference_param_donation():
+    """GL010 gate: the check names overlapping param leaves as an
+    error; disjoint donation (cache/input argnums) is clean.  The
+    engine-level integration — ``ServeEngine(donate_argnums=(0,))``
+    refused at trace time — lives in tests/test_serve.py."""
+    from incubator_mxnet_tpu.analysis import (
+        CODES, Severity as Sev, check_inference_param_donation)
+
+    # the code is cataloged (append-only contract, docs/ANALYSIS.md)
+    assert CODES["GL010"][0] == Sev.ERROR
+    diags = check_inference_param_donation([0, 1, 5], range(4),
+                                           where="ServeEngine(net)")
+    assert [d.code for d in diags] == ["GL010"]
+    assert diags[0].severity == Sev.ERROR
+    assert "[0, 1]" in diags[0].message
+    assert "decode cache" in diags[0].hint
+    # donated per-request state outside the param leaves is the
+    # LEGITIMATE pattern (serve/cache.py donates the cache argnum)
+    assert check_inference_param_donation([5, 6], range(4)) == []
+    assert check_inference_param_donation([], range(4)) == []
+
+
 def test_cli_reports_with_location(tmp_path, capsys):
     sys.path.insert(0, os.path.join(ROOT, "tools"))
     try:

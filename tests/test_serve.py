@@ -1,0 +1,523 @@
+"""serve/: AOT inference engine, continuous batcher, O(1) decode cache.
+
+The acceptance surface of ROADMAP item 2 (docs/SERVING.md):
+
+- bucket selection + pad-to-bucket is EXACT — a padded bucket's rows
+  are bit-identical to the same requests evaluated unpadded (MLP and
+  CNN-with-BatchNorm, off-mesh and on the 8-device dp mesh);
+- after warmup the engine never compiles (``recompile_count == 0``;
+  a post-warmup miss is counted and warned as GL005);
+- the batcher's deadline-triggered flush fires without a full batch,
+  its size trigger fires without waiting the deadline, malformed
+  requests fail per-request without killing batch/queue/worker, a full
+  bounded queue sheds as ``Backpressure``, and concurrent
+  submit/shutdown joins cleanly (the ``ResilientIter`` drain-join
+  discipline);
+- cached decode matches full recompute step-for-step with ONE step
+  program reused for every token (the O(1) cache contract);
+- the int8 weight-only tier tracks fp32 within tolerance;
+- GL010 refuses an engine built with params in the donated argnums.
+
+Budget discipline: tiny nets, warmups of 1-2 buckets, no sleep > 0.2 s.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.analysis import LintError
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.parallel import fault_injection as fi
+from incubator_mxnet_tpu.parallel import make_mesh
+from incubator_mxnet_tpu.serve import (Backpressure, CachedDecoder,
+                                       ContinuousBatcher, RequestError,
+                                       ServeEngine, TinyDecoderLM,
+                                       poisson_loadtest)
+
+SAMPLE = (16,)
+
+
+def _mlp():
+    mx.random.seed(7)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(10))
+    net.initialize(init=mx.init.Xavier())
+    net(nd.ones((2,) + SAMPLE))
+    return net
+
+
+def _cnn():
+    mx.random.seed(8)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1), nn.BatchNorm(),
+            nn.Activation("relu"), nn.MaxPool2D(2),
+            nn.GlobalAvgPool2D(), nn.Flatten(), nn.Dense(6))
+    net.initialize(init=mx.init.Xavier())
+    net(nd.random.uniform(shape=(2, 3, 12, 12)))  # shapes + BN stats
+    return net
+
+
+def _warm_engine(net, buckets=(4, 8), sample=SAMPLE, **kw):
+    eng = ServeEngine(net, buckets=buckets, lint="error", **kw)
+    eng.warmup(np.zeros(sample, np.float32))
+    return eng
+
+
+# ---------------------------------------------------------------------------
+# engine: buckets, padding exactness, program table
+# ---------------------------------------------------------------------------
+
+def test_bucket_selection():
+    eng = ServeEngine(_mlp(), buckets=(4, 16, 8))
+    assert eng.buckets == (4, 8, 16)
+    assert eng.max_bucket == 16
+    assert [eng.bucket_for(n) for n in (1, 4, 5, 8, 9, 16, 40)] == \
+        [4, 4, 8, 8, 16, 16, 16]
+
+
+def test_padded_bucket_bitwise_equals_unpadded():
+    """The acceptance bit: requests served through a padded bucket are
+    BIT-identical to the same requests evaluated unpadded (their own
+    exact-size program)."""
+    net = _mlp()
+    eng = _warm_engine(net, buckets=(8,))
+    x = np.random.RandomState(0).rand(5, *SAMPLE).astype(np.float32)
+    padded = np.asarray(eng.infer(x))
+    exact = _warm_engine(net, buckets=(5,))
+    unpadded = np.asarray(exact.infer(x))
+    assert padded.shape == (5, 10)
+    np.testing.assert_array_equal(padded, unpadded)
+
+
+def test_cnn_bn_padded_on_mesh_bitwise():
+    """CNN with inference-mode BatchNorm, dp-replicated on the 8-device
+    mesh: padding rows and sharding the bucket must both be invisible
+    bit-for-bit (running stats make BN row-independent)."""
+    net = _cnn()
+    mesh = make_mesh({"dp": 8})
+    eng = ServeEngine(net, buckets=(8,), mesh=mesh, lint="error",
+                      cost="check")
+    eng.warmup(np.zeros((3, 12, 12), np.float32))
+    x = np.random.RandomState(1).rand(3, 3, 12, 12).astype(np.float32)
+    on_mesh = np.asarray(eng.infer(x))
+    exact = ServeEngine(net, buckets=(3,), lint="error")
+    exact.warmup(np.zeros((3, 12, 12), np.float32))
+    np.testing.assert_array_equal(on_mesh, np.asarray(exact.infer(x)))
+    # the cost pass rode the same trace (cost="check" ran clean)
+    assert eng.cost_report is not None
+    assert eng.cost_report.meta["serve"] is True
+
+
+def test_zero_recompiles_after_warmup_and_gl005_on_miss():
+    eng = ServeEngine(_mlp(), buckets=(2, 4), lint="error")
+    eng.warmup(np.zeros(SAMPLE, np.float32))  # all buckets
+    rs = np.random.RandomState(2)
+    for n in (1, 2, 3, 4, 2, 1):
+        eng.infer(rs.rand(n, *SAMPLE).astype(np.float32))
+    assert eng.recompile_count == 0
+    assert eng.padded_rows > 0
+    # a bucket the warmup skipped is a steady-state compile: counted
+    # AND warned as GL005
+    part = ServeEngine(_mlp(), buckets=(2, 4), lint="error")
+    part.warmup(np.zeros(SAMPLE, np.float32), buckets=(4,))
+    with pytest.warns(UserWarning, match="GL005"):
+        part.infer(rs.rand(2, *SAMPLE).astype(np.float32))
+    assert part.recompile_count == 1
+
+
+def test_staged_warmup_is_not_a_recompile():
+    """warmup(buckets=...) in stages is still warmup: the second call
+    must neither count as a steady-state recompile nor warn GL005."""
+    import warnings
+
+    eng = ServeEngine(_mlp(), buckets=(2, 4), lint="error")
+    eng.warmup(np.zeros(SAMPLE, np.float32), buckets=(2,))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        eng.warmup(np.zeros(SAMPLE, np.float32), buckets=(4,))
+    assert eng.recompile_count == 0
+    assert not any("GL005" in str(w.message) for w in caught)
+    eng.infer(np.zeros((3,) + SAMPLE, np.float32))
+    assert eng.recompile_count == 0
+
+
+def test_cost_gate_checks_every_bucket():
+    """GL201 must see EVERY bucket's program — peak memory scales with
+    the bucket, so a budget that fits the small bucket but not the big
+    one is caught during warmup, before the big program compiles."""
+    net = _mlp()
+    probe = ServeEngine(net, buckets=(4,), cost="report", lint="off")
+    probe.warmup(np.zeros(SAMPLE, np.float32))
+    small_peak = probe.cost_report.peak_bytes
+    # budget above the 4-bucket peak but below the 64-bucket one
+    eng = ServeEngine(net, buckets=(4, 64), cost="check", lint="off",
+                      hbm_budget=small_peak * 2)
+    with pytest.raises(LintError, match="GL201"):
+        eng.warmup(np.zeros(SAMPLE, np.float32))
+    # the small bucket itself passed (its report exists, error-free)
+    assert probe.cost_report is not None
+    with pytest.raises(ValueError, match="hbm_budget"):
+        ServeEngine(net, buckets=(4,), hbm_budget=0)
+
+
+def test_chunking_over_max_bucket():
+    eng = _warm_engine(_mlp(), buckets=(4,))
+    x = np.random.RandomState(3).rand(10, *SAMPLE).astype(np.float32)
+    out = np.asarray(eng.infer(x))
+    assert out.shape == (10, 10)
+    exact = _warm_engine(_mlp(), buckets=(4,))
+    row = np.asarray(exact.infer(x[:4]))
+    np.testing.assert_array_equal(out[:4], row)
+
+
+def test_engine_validation():
+    net = _mlp()
+    with pytest.raises(ValueError, match="positive"):
+        ServeEngine(net, buckets=(0, 4))
+    with pytest.raises(ValueError, match="duplicate"):
+        ServeEngine(net, buckets=(4, 4))
+    mesh = make_mesh({"dp": 8})
+    with pytest.raises(ValueError, match="divide"):
+        ServeEngine(net, buckets=(4,), mesh=mesh)
+    eng = _warm_engine(net, buckets=(4,))
+    with pytest.raises(ValueError, match="engine serves"):
+        eng.infer(np.zeros((2, 7), np.float32))
+    with pytest.raises(ValueError, match="dtype"):
+        eng.infer(np.zeros((2,) + SAMPLE, np.float64))
+    with pytest.raises(ValueError, match="empty"):
+        eng.infer(np.zeros((0,) + SAMPLE, np.float32))
+    with pytest.raises(RuntimeError, match="warmup"):
+        ServeEngine(net, buckets=(4,)).infer(
+            np.zeros((1,) + SAMPLE, np.float32))
+
+
+def test_gl010_params_in_donated_argnums_refused():
+    """The GL010 gate: an engine whose donation spec covers the params
+    argnum refuses at TRACE time under lint=\"error\" — before any
+    compile.  Donating only the input buffer stays legal (GL003 may
+    warn about the wasted donation, but nothing errors)."""
+    net = _mlp()
+    bad = ServeEngine(net, buckets=(4,), donate_argnums=(0,), lint="error")
+    with pytest.raises(LintError, match="GL010"):
+        bad.warmup(np.zeros(SAMPLE, np.float32))
+    with pytest.warns(UserWarning):
+        ok = ServeEngine(net, buckets=(4,), donate_argnums=(1,),
+                         lint="error")
+        ok.warmup(np.zeros(SAMPLE, np.float32))
+    with pytest.raises(ValueError, match="donate_argnums"):
+        ServeEngine(net, buckets=(4,), donate_argnums=(2,))
+
+
+# ---------------------------------------------------------------------------
+# int8 quantized serving tier
+# ---------------------------------------------------------------------------
+
+def test_int8_tier_parity_vs_fp32():
+    net = _mlp()
+    x = np.random.RandomState(4).rand(6, *SAMPLE).astype(np.float32)
+    fp32 = np.asarray(_warm_engine(net, buckets=(8,)).infer(x))
+    e8 = ServeEngine(net, buckets=(8,), dtype="int8", lint="error")
+    e8.warmup(np.zeros(SAMPLE, np.float32))
+    got = np.asarray(e8.infer(x))
+    # weight-only symmetric int8: ~0.4% of scale per matmul on this net
+    tol = 0.02 * np.abs(fp32).max()
+    np.testing.assert_allclose(got, fp32, atol=tol)
+    assert np.argmax(got, 1).tolist() == np.argmax(fp32, 1).tolist()
+    # the resident weights really are int8 (the 4x memory story)
+    quant = [v for v, q in zip(e8._p_vals, e8._quantized) if q]
+    assert quant and all(v[0].dtype == np.int8 for v in quant)
+
+
+def test_int8_parity_cnn_argmax():
+    net = _cnn()
+    x = np.random.RandomState(5).rand(4, 3, 12, 12).astype(np.float32)
+    fp32 = np.asarray(
+        _warm_engine(net, buckets=(4,), sample=(3, 12, 12)).infer(x))
+    e8 = ServeEngine(net, buckets=(4,), dtype="int8", lint="error")
+    e8.warmup(np.zeros((3, 12, 12), np.float32))
+    got = np.asarray(e8.infer(x))
+    np.testing.assert_allclose(got, fp32, atol=0.05 * np.abs(fp32).max())
+
+
+# ---------------------------------------------------------------------------
+# continuous batcher
+# ---------------------------------------------------------------------------
+
+def test_deadline_flush_fires_without_full_batch():
+    eng = _warm_engine(_mlp(), buckets=(8,))
+    b = ContinuousBatcher(eng, max_delay=0.05)
+    try:
+        x = np.random.RandomState(6).rand(3, *SAMPLE).astype(np.float32)
+        t0 = time.monotonic()
+        futs = [b.submit(x[i]) for i in range(3)]
+        rows = [np.asarray(f.result(timeout=5)) for f in futs]
+        waited = time.monotonic() - t0
+        # 3 requests never fill the 8-bucket: only the deadline can
+        # have flushed them
+        assert b.stats.flush_deadline >= 1 and b.stats.flush_full == 0
+        assert waited < 3.0
+        ref = np.asarray(eng.infer(x))
+        np.testing.assert_array_equal(np.stack(rows), ref)
+        assert sum(k * v for k, v in b.stats.occupancy.items()) == 3
+    finally:
+        b.close()
+
+
+def test_size_flush_fires_before_deadline():
+    eng = _warm_engine(_mlp(), buckets=(4,))
+    # generous deadline: only the size trigger can explain a fast flush
+    b = ContinuousBatcher(eng, max_batch=4, max_delay=5.0)
+    try:
+        x = np.random.RandomState(7).rand(4, *SAMPLE).astype(np.float32)
+        t0 = time.monotonic()
+        futs = [b.submit(x[i]) for i in range(4)]
+        for f in futs:
+            f.result(timeout=5)
+        assert time.monotonic() - t0 < 4.0
+        assert b.stats.flush_full >= 1
+    finally:
+        b.close()
+
+
+def test_malformed_requests_fail_alone_batch_survives():
+    """The graceful-degradation contract: poisoned requests of every
+    kind get a per-request error; the good requests in the SAME batch
+    are served; the queue accepts more work afterwards."""
+    eng = _warm_engine(_mlp(), buckets=(8,))
+    b = ContinuousBatcher(eng, max_delay=0.05)
+    try:
+        x = np.random.RandomState(8).rand(2, *SAMPLE).astype(np.float32)
+        good1 = b.submit(x[0])
+        bad = [b.submit(fi.malformed_request(SAMPLE, kind=k))
+               for k in ("rank", "shape", "dtype", "unconvertible")]
+        good2 = b.submit(x[1])
+        for f in bad:
+            with pytest.raises(RequestError, match="malformed request"):
+                f.result(timeout=5)
+        ref = np.asarray(eng.infer(x))
+        np.testing.assert_array_equal(np.asarray(good1.result(timeout=5)),
+                                      ref[0])
+        np.testing.assert_array_equal(np.asarray(good2.result(timeout=5)),
+                                      ref[1])
+        assert b.stats.rejected == 4
+        # the worker/queue survived: a fresh request still serves
+        again = b.submit(x[0])
+        np.testing.assert_array_equal(np.asarray(again.result(timeout=5)),
+                                      ref[0])
+    finally:
+        b.close()
+
+
+def test_backpressure_bounded_queue_sheds():
+    eng = _warm_engine(_mlp(), buckets=(4,))
+    # wedge the worker so the queue can actually fill
+    real_infer, gate = eng.infer, threading.Event()
+
+    def slow_infer(x):
+        gate.wait(timeout=5)
+        return real_infer(x)
+
+    eng.infer = slow_infer
+    b = ContinuousBatcher(eng, max_delay=0.01, max_queue=4)
+    try:
+        x = np.zeros(SAMPLE, np.float32)
+        futs, shed = fi.burst_arrivals(b, [x] * 32)
+        assert shed > 0  # the herd was shed, not buffered unboundedly
+        assert len(futs) + shed == 32
+        with pytest.raises(Backpressure):
+            while True:  # anything not yet shed fills the queue now
+                b.submit(x, block=False)
+    finally:
+        gate.set()
+        b.close()
+    # every admitted request was resolved (served or failed at close)
+    assert all(f.done() for f in futs)
+
+
+def test_slow_client_is_deadline_bounded():
+    """Trickling submissions (admission stalled by the fault harness)
+    must ride deadline flushes — nobody waits for batchmates that are
+    not coming."""
+    eng = _warm_engine(_mlp(), buckets=(8,))
+    b = ContinuousBatcher(eng, max_delay=0.03)
+    try:
+        x = np.random.RandomState(9).rand(3, *SAMPLE).astype(np.float32)
+        with fi.slow_client(0.05) as stats:
+            futs = [b.submit(x[i]) for i in range(3)]
+        assert stats.slowed == 3
+        rows = [np.asarray(f.result(timeout=5)) for f in futs]
+        np.testing.assert_array_equal(np.stack(rows),
+                                      np.asarray(eng.infer(x)))
+        assert b.stats.flush_deadline >= 1
+    finally:
+        b.close()
+
+
+def test_concurrent_submit_shutdown_joins_cleanly():
+    """The ResilientIter drain-join discipline: close() during a
+    submission storm joins the worker within its timeout, serves or
+    fails every admitted request, and never hangs a caller."""
+    eng = _warm_engine(_mlp(), buckets=(8,))
+    b = ContinuousBatcher(eng, max_delay=0.01, max_queue=64)
+    x = np.zeros(SAMPLE, np.float32)
+    futs, stop = [], threading.Event()
+
+    def pound():
+        while not stop.is_set():
+            try:
+                futs.append(b.submit(x, block=False))
+            except (Backpressure, RuntimeError):
+                time.sleep(0.001)
+
+    threads = [threading.Thread(target=pound) for _ in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    b.close(join_timeout=5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not b._thread.is_alive()
+    with pytest.raises(RuntimeError, match="closed"):
+        b.submit(x)
+    # nothing hangs: every admitted future resolves one way or the other
+    for f in futs:
+        assert f.done() or f.exception(timeout=1) is not None
+
+
+def test_batch_failure_fails_batch_not_loop():
+    """An engine-side error fails that batch's futures; the worker loop
+    survives and serves the next batch."""
+    eng = _warm_engine(_mlp(), buckets=(4,))
+    real_infer = eng.infer
+    boom = {"n": 0}
+
+    def flaky(xv):
+        if boom["n"] == 0:
+            boom["n"] += 1
+            raise RuntimeError("injected engine failure")
+        return real_infer(xv)
+
+    eng.infer = flaky
+    b = ContinuousBatcher(eng, max_delay=0.02)
+    try:
+        x = np.zeros(SAMPLE, np.float32)
+        f1 = b.submit(x)
+        with pytest.raises(RuntimeError, match="injected engine failure"):
+            f1.result(timeout=5)
+        f2 = b.submit(x)
+        assert np.asarray(f2.result(timeout=5)).shape == (10,)
+        assert b.stats.failed == 1
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# loadtest harness
+# ---------------------------------------------------------------------------
+
+def test_poisson_loadtest_report():
+    eng = _warm_engine(_mlp(), buckets=(4, 8))
+    b = ContinuousBatcher(eng, max_delay=0.01)
+    try:
+        x = np.random.RandomState(10).rand(8, *SAMPLE).astype(np.float32)
+        rep = poisson_loadtest(b, lambda i, rng: x[i % 8], qps=800,
+                               n_requests=60, seed=3)
+        assert rep.ok == 60 and rep.errors == 0
+        assert rep.recompiles == 0  # the steady-state contract
+        assert rep.qps_sustained > 0
+        assert rep.p50_ms <= rep.p95_ms <= rep.p99_ms
+        assert sum(k * v for k, v in rep.occupancy.items()) == 60
+        d = rep.to_dict()
+        import json
+
+        json.dumps(d)  # JSON-serializable report
+        assert "loadtest:" in rep.format()
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# O(1) decode cache
+# ---------------------------------------------------------------------------
+
+def test_cached_decode_matches_full_recompute_step_for_step():
+    import jax
+    import jax.numpy as jnp
+
+    lm = TinyDecoderLM(vocab=32, d_model=16, n_heads=2, n_layers=2,
+                       d_ff=32, max_len=32)
+    params = lm.init(jax.random.PRNGKey(0))
+    dec = CachedDecoder(lm, params, seq_buckets=(16,), lint="error")
+    prompt = np.array([[1, 2, 3, 4], [5, 6, 7, 8]], np.int32)
+    logits0 = np.asarray(dec.start(prompt, max_new=6))
+    assert dec.pos == 4
+    seq = prompt.copy()
+    nxt = np.argmax(logits0[:, -1], axis=-1).astype(np.int32)
+    step_logits = []
+    for _ in range(6):
+        seq = np.concatenate([seq, nxt[:, None]], axis=1)
+        lg = np.asarray(dec.step(nxt))
+        step_logits.append(lg)
+        nxt = np.argmax(lg, axis=-1).astype(np.int32)
+    # ONE prefill + ONE step program for all 6 tokens: O(1) decode,
+    # position carried as device state (no per-pos retrace)
+    assert dec.compiles == 2
+    assert dec.pos == 10
+    full = np.asarray(lm.apply_tokens(params, jnp.asarray(seq, jnp.int32)))
+    np.testing.assert_allclose(logits0, full[:, :4], rtol=1e-5, atol=1e-6)
+    for i, lg in enumerate(step_logits):
+        np.testing.assert_allclose(lg, full[:, 4 + i], rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_decode_seq_buckets_and_refusals():
+    import jax
+
+    lm = TinyDecoderLM(vocab=16, d_model=8, n_heads=2, n_layers=1,
+                       d_ff=16, max_len=32)
+    params = lm.init(jax.random.PRNGKey(1))
+    dec = CachedDecoder(lm, params, seq_buckets=(8, 16), lint="error")
+    assert dec.seq_bucket_for(5) == 8
+    assert dec.seq_bucket_for(9) == 16
+    with pytest.raises(ValueError, match="seq bucket"):
+        dec.seq_bucket_for(17)
+    with pytest.raises(RuntimeError, match="start"):
+        CachedDecoder(lm, params, seq_buckets=(8,)).step(
+            np.zeros((1,), np.int32))
+    with pytest.raises(ValueError, match="position table"):
+        CachedDecoder(lm, params, seq_buckets=(64,))
+
+
+def test_decode_ring_wraparound_is_sliding_window():
+    """Past max_len the ring overwrites the oldest slot: decode keeps
+    running (finite logits, pos advances) as a sliding-window model."""
+    import jax
+
+    lm = TinyDecoderLM(vocab=16, d_model=8, n_heads=2, n_layers=1,
+                       d_ff=16, max_len=8)
+    params = lm.init(jax.random.PRNGKey(2))
+    dec = CachedDecoder(lm, params, seq_buckets=(8,), lint="error")
+    dec.start(np.array([[1, 2, 3]], np.int32), max_new=5)
+    tok = np.array([4], np.int32)
+    for _ in range(9):  # runs past the 8-slot ring
+        lg = np.asarray(dec.step(tok))
+        assert np.isfinite(lg).all()
+    assert dec.pos == 12
+    assert dec.compiles == 2  # still the same step program
+
+
+def test_gl010_decoder_cache_donation_is_clean():
+    """The decoder donates its CACHE argnum — the legitimate donation —
+    and GL010 stays quiet under lint=\"error\"."""
+    import jax
+
+    lm = TinyDecoderLM(vocab=16, d_model=8, n_heads=2, n_layers=1,
+                       d_ff=16, max_len=8)
+    params = lm.init(jax.random.PRNGKey(3))
+    dec = CachedDecoder(lm, params, seq_buckets=(8,), lint="error")
+    logits = np.asarray(dec.start(np.array([[1, 2]], np.int32), max_new=2))
+    assert logits.shape == (1, 2, 16)

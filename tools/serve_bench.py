@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Open-loop serving benchmark: ServeEngine + ContinuousBatcher under
+Poisson traffic (docs/SERVING.md "Loadtest methodology").
+
+Reports, as JSON lines (the bench.py convention), per measured leg:
+
+  {"metric": "serve_qps", "value": ..., "p50_ms": ..., "p99_ms": ...,
+   "occupancy": {...}, "recompiles": 0, ...}
+
+Legs: fp32 (always) and, with ``--int8``, the weight-only quantized
+tier — the same traffic replayed (same seed, same arrival process) so
+the latency delta is the tier, not the noise.  The run FAILS (exit 1)
+if any post-warmup recompile happened: steady-state serving must be
+compile-free (the GL005 contract the loadtest counter enforces).
+
+Examples::
+
+  JAX_PLATFORMS=cpu python tools/serve_bench.py --model mlp --qps 500
+  python tools/serve_bench.py --model resnet50 --buckets 32,128 \
+      --qps 200 --requests 400 --int8
+  python tools/serve_bench.py --model mlp --dp 8 --qps 1000
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+T0 = time.time()
+
+
+def log(msg):
+    print("[serve_bench %6.1fs] %s" % (time.time() - T0, msg),
+          file=sys.stderr, flush=True)
+
+
+def build_model(name, image_size):
+    import numpy as np
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.gluon import nn
+
+    mx.random.seed(0)
+    if name == "mlp":
+        net = nn.HybridSequential()
+        net.add(nn.Dense(256, activation="relu"),
+                nn.Dense(256, activation="relu"), nn.Dense(64))
+        net.initialize(init=mx.init.Xavier())
+        net(nd.ones((2, 128)))
+        return net, (128,)
+    if name == "cnn":
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(16, 3, padding=1), nn.BatchNorm(),
+                nn.Activation("relu"), nn.MaxPool2D(2),
+                nn.Conv2D(32, 3, padding=1), nn.BatchNorm(),
+                nn.Activation("relu"), nn.GlobalAvgPool2D(),
+                nn.Flatten(), nn.Dense(10))
+        net.initialize(init=mx.init.Xavier())
+        net(nd.random.uniform(shape=(2, 3, image_size, image_size)))
+        return net, (3, image_size, image_size)
+    if name == "resnet50":
+        from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+        net = vision.resnet50_v1(classes=1000)
+        net.initialize(init=mx.init.Xavier())
+        net(nd.random.uniform(shape=(1, 3, image_size, image_size)))
+        return net, (3, image_size, image_size)
+    raise SystemExit("unknown --model %r" % name)
+
+
+def run_leg(tag, net, sample_shape, args, mesh, dtype=None):
+    import numpy as np
+
+    from incubator_mxnet_tpu.serve import (ContinuousBatcher, ServeEngine,
+                                           poisson_loadtest)
+
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    eng = ServeEngine(net, buckets=buckets, mesh=mesh, dtype=dtype,
+                      lint="error", cost=args.cost)
+    t = eng.warmup(np.zeros(sample_shape, np.float32))
+    log("%s: warmed %d buckets (trace %.2fs, compile %.2fs)"
+        % (tag, len(buckets), t["trace"], t["compile"]))
+    rs = np.random.RandomState(args.seed)
+    pool = rs.rand(64, *sample_shape).astype(np.float32)
+    batcher = ContinuousBatcher(eng, max_delay=args.max_delay / 1e3,
+                                max_queue=args.max_queue)
+    try:
+        rep = poisson_loadtest(batcher, lambda i, rng: pool[i % 64],
+                               qps=args.qps, n_requests=args.requests,
+                               seed=args.seed,
+                               extra={"leg": tag, "model": args.model,
+                                      "buckets": list(buckets),
+                                      "warmup_compile_s":
+                                          round(t["compile"], 2)})
+    finally:
+        batcher.close()
+    log(rep.format())
+    rec = {"metric": "serve_qps", "value": round(rep.qps_sustained, 2),
+           "unit": "req/s", "leg": tag, "model": args.model,
+           "qps_offered": args.qps,
+           "p50_ms": round(rep.p50_ms, 3), "p95_ms": round(rep.p95_ms, 3),
+           "p99_ms": round(rep.p99_ms, 3),
+           "ok": rep.ok, "errors": rep.errors, "shed": rep.shed,
+           "occupancy": {str(k): v for k, v in
+                         sorted(rep.occupancy.items())},
+           "flush_full": rep.flush_full,
+           "flush_deadline": rep.flush_deadline,
+           "recompiles": rep.recompiles,
+           "buckets": list(buckets), "max_delay_ms": args.max_delay}
+    print(json.dumps(rec), flush=True)
+    return rep
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--model", default="mlp",
+                    choices=["mlp", "cnn", "resnet50"])
+    ap.add_argument("--buckets", default="8,32",
+                    help="comma-separated batch buckets (default 8,32)")
+    ap.add_argument("--qps", type=float, default=500.0,
+                    help="offered open-loop rate (Poisson)")
+    ap.add_argument("--requests", type=int, default=300)
+    ap.add_argument("--max-delay", type=float, default=5.0,
+                    help="batcher deadline, milliseconds")
+    ap.add_argument("--max-queue", type=int, default=1024)
+    ap.add_argument("--image-size", type=int, default=32)
+    ap.add_argument("--dp", type=int, default=0,
+                    help="serve dp-replicated over this many devices")
+    ap.add_argument("--int8", action="store_true",
+                    help="add the weight-only int8 leg (same traffic)")
+    ap.add_argument("--cost", default="report",
+                    choices=["off", "report", "check"])
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+
+    log("devices: %s" % (jax.devices(),))
+    mesh = None
+    if args.dp:
+        from incubator_mxnet_tpu.parallel import make_mesh
+
+        mesh = make_mesh({"dp": args.dp},
+                         devices=jax.devices()[:args.dp])
+        log("serving dp-replicated over %s" % (mesh,))
+    net, sample_shape = build_model(args.model, args.image_size)
+    rep = run_leg("fp32", net, sample_shape, args, mesh)
+    bad = rep.recompiles
+    if args.int8:
+        rep8 = run_leg("int8", net, sample_shape, args, mesh, dtype="int8")
+        bad += rep8.recompiles
+        delta = rep8.p99_ms - rep.p99_ms
+        print(json.dumps({"metric": "serve_int8_p99_delta_ms",
+                          "value": round(delta, 3), "unit": "ms",
+                          "fp32_p99_ms": round(rep.p99_ms, 3),
+                          "int8_p99_ms": round(rep8.p99_ms, 3)}),
+              flush=True)
+    if bad:
+        log("FAIL: %d post-warmup recompile(s) — steady-state serving "
+            "must be compile-free" % bad)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
