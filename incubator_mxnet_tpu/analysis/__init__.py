@@ -21,7 +21,11 @@ semantics):
   pass) flags an *inference* program built with model parameters in the
   donated argnums — a served model's weights must survive the call
   (``check_inference_param_donation``; the serving-side complement of
-  GL003).
+  GL003).  GL011 (error, checked eagerly by
+  ``ServeEngine.update_params``) flags a hot-weight-swap candidate
+  whose tree/shape/dtype drifts from the served signature — same avals
+  are the zero-recompile contract of a live swap; drift would recompile
+  every bucket program under traffic (``check_swap_compatibility``).
 - **Level 2 (source)**: :mod:`.source_lint` + the ``tools/graftlint.py``
   CLI check repo idiom (GL101–GL103) plus the checkpoint-without-
   iterator-state pattern (GL008, a warning: a loop consuming a stateful
@@ -47,6 +51,7 @@ from .trace_lint import (check_inference_param_donation,
                          check_legacy_checkpoint_path,
                          check_partition_spec, check_permutation,
                          check_process_local_ckpt_dir,
+                         check_swap_compatibility,
                          check_zero_state_shardings, lint_jaxpr,
                          lint_traceable, recompile_probe,
                          validate_permutation)
@@ -59,7 +64,7 @@ __all__ = [
     "check_inference_param_donation",
     "check_legacy_checkpoint_path",
     "check_partition_spec", "check_permutation",
-    "check_process_local_ckpt_dir",
+    "check_process_local_ckpt_dir", "check_swap_compatibility",
     "check_zero_state_shardings", "code_matches", "lint_jaxpr",
     "lint_paths", "lint_source", "lint_traceable", "recompile_probe",
     "validate_permutation",

@@ -69,6 +69,12 @@ CODES = {
               "inference program built with model parameters in the "
               "donated argnums — a served model's weights must survive "
               "the call; the second request would read freed buffers"),
+    "GL011": (Severity.ERROR,
+              "hot weight swap candidate drifts from the served param "
+              "signature (tree/shape/dtype) — same shapes mean the "
+              "existing AOT programs serve the new version with ZERO "
+              "recompiles; drift forces a recompile storm across every "
+              "bucket, an outage, not a swap"),
     "GL201": (Severity.ERROR,
               "graftcost: predicted peak live-buffer memory exceeds the "
               "HBM budget — the program is infeasible at this config; "

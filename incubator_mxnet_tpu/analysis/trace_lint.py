@@ -41,7 +41,8 @@ from .diagnostics import CODES, Diagnostic, LintError, LintReport, Severity
 __all__ = ["capture_effect_diagnostics", "check_inference_param_donation",
            "check_legacy_checkpoint_path",
            "check_permutation", "validate_permutation",
-           "check_partition_spec", "check_zero_state_shardings",
+           "check_partition_spec", "check_swap_compatibility",
+           "check_zero_state_shardings",
            "donated_leaf_indices", "lint_jaxpr", "lint_traceable",
            "recompile_probe"]
 
@@ -414,6 +415,60 @@ def check_inference_param_donation(donated_leaves, param_leaves,
         hint="donate only per-request state (the input buffer, the decode "
              "cache); keep params device-resident and un-donated "
              "(serve/engine.py holds them for the life of the engine)")]
+
+
+def check_swap_compatibility(served, candidate, missing=(), extra=(),
+                             where: str = "") -> List[Diagnostic]:
+    """GL011 core: a hot weight swap whose candidate param set drifts
+    from the served signature.
+
+    ``served`` / ``candidate`` are aligned sequences of ``(name, shape,
+    dtype)`` descriptors (``ServeEngine.param_signature`` shape);
+    ``missing`` / ``extra`` name tree-level drift (params absent from /
+    foreign to the served tree).  The zero-recompile contract of a hot
+    swap is *same avals ⇒ same AOT programs*: any shape or dtype drift
+    re-keys every bucket program and turns the swap into a compile
+    storm under live traffic — the GL005 hazard at its worst, so the
+    swap path rejects it eagerly at swap time, before anything is
+    staged (``serve/engine.py::update_params``).  One aggregated
+    diagnostic names the first few drifts.
+    """
+    served = list(served)
+    candidate = list(candidate)
+    drifts = []
+    if len(candidate) != len(served):
+        # never zip-truncate a tree drift into a clean verdict: a
+        # standalone caller may not pre-pad the way the engine does
+        drifts.append("param count %d -> %d" % (len(served),
+                                                len(candidate)))
+    for (name, s_shape, s_dtype), (_n, c_shape, c_dtype) in zip(served,
+                                                                candidate):
+        if c_shape is None:
+            continue  # tree-level drift, reported via missing/extra
+        if tuple(c_shape) != tuple(s_shape):
+            drifts.append("%s: shape %s -> %s"
+                          % (name, tuple(s_shape), tuple(c_shape)))
+        if c_dtype != s_dtype:
+            drifts.append("%s: dtype %s -> %s" % (name, s_dtype, c_dtype))
+    for n in missing:
+        drifts.append("%s: missing from candidate" % n)
+    for n in extra:
+        drifts.append("%s: not in the served tree" % n)
+    if not drifts:
+        return []
+    show = "; ".join(drifts[:6])
+    more = "" if len(drifts) <= 6 else " (+%d more)" % (len(drifts) - 6)
+    return [Diagnostic(
+        "GL011", Severity.ERROR,
+        "swap candidate drifts from the served param signature in %d "
+        "place(s): %s%s — same shapes/dtypes are the zero-recompile "
+        "contract; this swap would re-key and recompile every bucket "
+        "program under live traffic" % (len(drifts), show, more),
+        where=where,
+        hint="export the candidate from the same architecture and "
+             "precision as the served version (engine.param_signature "
+             "is the pinned contract); for an architecture change, "
+             "stand up a new engine and cut traffic over instead")]
 
 
 def check_process_local_ckpt_dir(directory: str,

@@ -28,6 +28,19 @@ Deterministic, test-grade fault injectors for the failure classes
   ``serve/batcher.py::_admit``, and :func:`burst_arrivals` submits a
   thundering herd the bounded queue must absorb or shed as
   ``Backpressure`` — together they drive ``tests/test_serve.py``;
+- **serving chaos** — :func:`kill_batcher_worker` silently kills the
+  continuous batcher's worker thread mid-batch (the watchdog must fail
+  the lost batch and respawn within budget),
+  :func:`engine_failure_burst` makes the next N engine executions
+  raise (retry-with-backoff absorbs a short burst; a long one trips
+  the circuit breaker into the degradation ladder), :func:`nan_params`
+  builds a poisoned hot-weight-swap candidate (the canary must reject
+  it and roll back), and :func:`deadline_storm` submits a burst whose
+  SLO deadlines expire in the queue (shed before compute, never served
+  dead) — together they drive ``tests/test_serve_resilience.py`` and
+  the ``tools/serve_bench.py --chaos`` leg.  The first two interpose
+  ``serve/batcher.py::_serve_batch``, the engine-execution choke
+  point, exactly like ``slow_client`` interposes ``_admit``;
 - **host loss** — :func:`kill_process` is a REAL ungraceful process
   death (SIGKILL: no atexit, no flushes — what a preempted VM looks
   like), :func:`host_loss_during_save` arms it on the N-th checkpoint
@@ -55,10 +68,11 @@ from typing import Optional
 import numpy as np
 
 __all__ = ["NaNInjector", "burst_arrivals", "coordinator_unreachable",
-           "corrupt_checkpoint",
+           "corrupt_checkpoint", "deadline_storm", "engine_failure_burst",
            "fail_writes", "flaky_reads", "host_loss_during_save",
+           "kill_batcher_worker",
            "kill_process", "kill_worker", "malformed_request",
-           "poison_batch", "slow_client", "slow_reads",
+           "nan_params", "poison_batch", "slow_client", "slow_reads",
            "straggler_process", "truncate_record"]
 
 
@@ -352,6 +366,123 @@ def slow_client(delay_s, at=0, count=None):
         yield stats
     finally:
         _batcher._admit = real
+
+
+@contextmanager
+def _patched_serve(flaky):
+    """Interpose ``serve/batcher.py::_serve_batch`` (the engine-
+    execution choke point every flushed batch goes through) with
+    ``flaky(real_serve, engine, xv)``."""
+    from ..serve import batcher as _batcher
+
+    real = _batcher._serve_batch
+    _batcher._serve_batch = lambda engine, xv: flaky(real, engine, xv)
+    try:
+        yield
+    finally:
+        _batcher._serve_batch = real
+
+
+@contextmanager
+def kill_batcher_worker(at=0, count=1):
+    """Silently kill the continuous batcher's worker thread on selected
+    batch executions: raises ``SystemExit`` (a ``BaseException`` — it
+    escapes the worker loop's ``except Exception`` and the thread
+    machinery swallows it, exactly how a C-extension abort or an
+    injected thread death presents).  The batch's futures are in
+    nobody's queue anymore: the watchdog must fail them loudly AND
+    respawn the worker within its bounded budget — no request may hang
+    and later traffic must serve again.  Yields a stats object whose
+    ``.killed`` counts injections."""
+    class _Stats:
+        seen = 0
+        killed = 0
+
+    stats = _Stats()
+
+    def kill(real, engine, xv):
+        i = stats.seen
+        stats.seen += 1
+        if at <= i < at + count:
+            stats.killed += 1
+            raise SystemExit("injected batcher worker death (#%d)" % i)
+        return real(engine, xv)
+
+    with _patched_serve(kill):
+        yield stats
+
+
+@contextmanager
+def engine_failure_burst(n=3, exc=None, engine=None):
+    """Make the next ``n`` engine executions fail with a transient
+    ``RuntimeError`` (or ``exc``) — a device runtime hiccup burst.  A
+    short burst is absorbed by per-batch retry-with-backoff; a long one
+    must trip the circuit breaker into the degradation ladder (int8
+    fallback tier, then priority-aware shedding) instead of failing
+    every request slowly.  ``engine`` restricts the fault to ONE
+    engine's executions (so a fallback tier stays healthy while the
+    primary burns); ``None`` faults every engine.  Yields a stats
+    object whose ``.failed`` counts injections."""
+    class _Stats:
+        seen = 0
+        failed = 0
+
+    stats = _Stats()
+
+    def burst(real, eng, xv):
+        i = stats.seen
+        stats.seen += 1
+        if stats.failed < n and (engine is None or eng is engine):
+            stats.failed += 1
+            raise exc or RuntimeError(
+                "injected engine failure burst (#%d)" % i)
+        return real(eng, xv)
+
+    with _patched_serve(burst):
+        yield stats
+
+
+def nan_params(engine, value=float("nan"), index=0):
+    """A poisoned hot-weight-swap candidate: the ENGINE's currently
+    pinned param signature, copied host-side, with ``value`` (NaN by
+    default) planted at flat position ``index`` of the first floating
+    parameter — what a torn weight export or a diverged training run
+    hands the swap path.  ``update_params`` must reject it on the
+    canary batch (non-finite output) and roll back automatically; the
+    old version keeps serving.  Returns the candidate as a list in the
+    engine's parameter order."""
+    if not getattr(engine, "_params", None):
+        raise ValueError("engine has no collected params — warmup() it "
+                         "first (the swap path requires it anyway)")
+    raw = [np.array(p._data._data) for p in engine._params]
+    for a in raw:
+        if np.issubdtype(a.dtype, np.floating):
+            a.reshape(-1)[index] = value
+            break
+    else:
+        raise ValueError("engine has no floating parameter to poison")
+    return raw
+
+
+def deadline_storm(batcher, payloads, deadline=1e-4, priority=0):
+    """Submit every payload back-to-back with an SLO deadline so tight
+    it expires while the request sits in the queue — the storm of
+    already-dead work an overloaded service must shed BEFORE compute
+    (``DeadlineExceeded``), never serve dead and never hang.  Returns
+    ``(futures, shed_count)`` like :func:`burst_arrivals`; every future
+    is guaranteed (by the batcher's reaper) to resolve within
+    deadline + grace + one watchdog tick."""
+    from ..serve.batcher import Backpressure
+
+    futures, shed = [], 0
+    for p in payloads:
+        try:
+            futures.append(batcher.submit(p, block=False,
+                                          deadline=deadline,
+                                          priority=priority))
+        except Backpressure:
+            shed += 1
+    return futures, shed
 
 
 def burst_arrivals(batcher, payloads, block=False):

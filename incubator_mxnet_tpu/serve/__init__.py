@@ -11,7 +11,18 @@ reference's CachedOp + C predict API (SURVEY.md §L5c,
   and never donated (GL010 enforces it at trace time);
 - :class:`~.batcher.ContinuousBatcher` — bounded async request queue
   with size- and deadline-triggered flush and per-request error
-  isolation;
+  isolation, per-request SLO deadlines (shed-before-compute + the
+  watchdog reaper's no-hang guarantee), a worker watchdog with bounded
+  respawn, per-batch transient retry and circuit-breaker degradation
+  to the int8 tier / priority-aware shedding;
+- :mod:`~.resilience` — the serving-failure policy layer
+  (:class:`~.resilience.RetryPolicy`,
+  :class:`~.resilience.CircuitBreaker`, the
+  :class:`~.resilience.DeadlineExceeded` / :class:`~.resilience.Shed` /
+  :class:`~.resilience.SwapRejected` request outcomes), plus
+  :meth:`~.engine.ServeEngine.update_params` — the canaried hot weight
+  swap (GL011 drift gate, canary rollback, exactly-one-version
+  attribution) — docs/RESILIENCE.md §6;
 - :class:`~.cache.CachedDecoder` / :func:`~.cache.init_cache` —
   device-carried ring-slot KV cache with O(1) per-token in-place
   update (arXiv:2603.09555), exercised by
@@ -28,7 +39,11 @@ from .batcher import (Backpressure, ContinuousBatcher, RequestError,
 from .cache import CachedDecoder, TinyDecoderLM, init_cache
 from .engine import ServeEngine
 from .loadtest import LoadReport, poisson_loadtest
+from .resilience import (CircuitBreaker, DeadlineExceeded, RetryPolicy,
+                         Shed, SwapRejected)
 
-__all__ = ["Backpressure", "CachedDecoder", "ContinuousBatcher",
-           "LoadReport", "RequestError", "ServeEngine", "ServeStats",
+__all__ = ["Backpressure", "CachedDecoder", "CircuitBreaker",
+           "ContinuousBatcher", "DeadlineExceeded",
+           "LoadReport", "RequestError", "RetryPolicy", "ServeEngine",
+           "ServeStats", "Shed", "SwapRejected",
            "TinyDecoderLM", "init_cache", "poisson_loadtest"]

@@ -28,7 +28,12 @@ buckets**:
   4x smaller resident weights, the memory-bound decode win;
 - the ``lint=`` / ``cost=`` trace hooks ride the same pre-compile
   ``jit.trace()`` the first call reuses, exactly like the fused train
-  step (shared plumbing: ``parallel/aot.py``).
+  step (shared plumbing: ``parallel/aot.py``);
+- params are **versioned**: :meth:`ServeEngine.update_params` swaps the
+  device-resident version under live traffic with zero recompiles
+  (same shapes ⇒ same AOT programs; GL011 eagerly rejects drift),
+  validated on a canary batch with automatic rollback — every request
+  is served by exactly one version (docs/RESILIENCE.md §6).
 
 Padding is exact, not approximate: every op in an inference forward
 (conv, dense, pooling, inference-mode BatchNorm over *running* stats)
@@ -39,6 +44,7 @@ compute the batcher's occupancy histogram makes visible.
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -135,11 +141,16 @@ class ServeEngine:
         self._programs: Dict[tuple, Any] = {}
         self.compile_log: Dict[tuple, Dict[str, float]] = {}
         self._params: List[Any] = []       # Parameter objects
-        self._p_vals: List[Any] = []       # device-resident values
+        # the LIVE param state: (version, device-resident values),
+        # published as ONE tuple so a hot swap is atomic — a request
+        # snapshots it once and is served by exactly that version
+        self._live: Tuple[int, List[Any]] = (0, [])
+        self._param_sig: List[tuple] = []  # (name, shape, dtype) pinned
         self._quantized: List[bool] = []   # per-param int8 marker
         self._placed = False
         self._warm = False
         self._jit = None
+        self._swap_lock = threading.Lock()
         self.sample_shape: Optional[tuple] = None
         self.sample_dtype = None
         # serving counters (the loadtest report reads these)
@@ -147,11 +158,34 @@ class ServeEngine:
         self.infer_calls = 0
         self.rows_served = 0
         self.padded_rows = 0
+        # hot-swap counters (docs/RESILIENCE.md §6: swap/canary/rollback)
+        self.swap_count = 0
+        self.rollback_count = 0
+        self.swap_log: List[Dict[str, Any]] = []
+        self.last_version_served: Optional[int] = None
 
     # ------------------------------------------------------------------
     @property
     def max_bucket(self) -> int:
         return self.buckets[-1]
+
+    @property
+    def params_version(self) -> int:
+        """The currently-served param version (1 after load; +1 per
+        committed :meth:`update_params`)."""
+        return self._live[0]
+
+    @property
+    def _p_vals(self) -> List[Any]:
+        """The currently-served device-resident values (read-only view
+        of the live version; swaps publish a whole new list)."""
+        return self._live[1]
+
+    @property
+    def param_signature(self) -> List[tuple]:
+        """``(name, shape, dtype)`` per served parameter — the pinned
+        signature every swap candidate must match (GL011)."""
+        return list(self._param_sig)
 
     def bucket_for(self, n: int) -> int:
         """Smallest bucket that fits ``n`` rows (the padding target)."""
@@ -161,17 +195,16 @@ class ServeEngine:
         return self.max_bucket
 
     # ------------------------------------------------------------------
-    def _collect(self):
-        if self._params:
-            return
-        self._params = list(self.net.collect_params().values())
-        if any(p._data is None for p in self._params):
-            raise RuntimeError("initialize() the net (and run one forward "
-                               "for deferred shapes) before serving it")
+    def _prepare_vals(self, raw: Sequence[Any]):
+        """Turn one version's raw host/device arrays into the served
+        representation: int8-quantize eligible weights, apply the
+        compute-dtype cast.  ONE copy of the load-time transform, shared
+        by :meth:`_collect` and :meth:`update_params` — a swapped-in
+        version must be shaped exactly like the one it replaces."""
         compute = None if (self._int8 or self.dtype is None) else self.dtype
         vals, quant = [], []
-        for p in self._params:
-            v = p._data._data
+        for v in raw:
+            v = jnp.asarray(v)
             if self._int8 and jnp.issubdtype(v.dtype, jnp.floating) \
                     and v.ndim >= 2:
                 # weight-only int8: matrices/filters carry the bytes;
@@ -185,8 +218,21 @@ class ServeEngine:
                     v = v.astype(compute)
                 vals.append(v)
                 quant.append(False)
-        self._p_vals = vals
+        return vals, quant
+
+    def _collect(self):
+        if self._params:
+            return
+        self._params = list(self.net.collect_params().values())
+        if any(p._data is None for p in self._params):
+            raise RuntimeError("initialize() the net (and run one forward "
+                               "for deferred shapes) before serving it")
+        raw = [p._data._data for p in self._params]
+        self._param_sig = [(p.name, tuple(v.shape), np.dtype(v.dtype))
+                           for p, v in zip(self._params, raw)]
+        vals, quant = self._prepare_vals(raw)
         self._quantized = quant
+        self._live = (1, vals)
 
     def _param_dtype(self):
         """The dtype params are bound as inside the program (and the
@@ -243,9 +289,9 @@ class ServeEngine:
                             in_shardings=(p_sh, self._batch_sh))
         return self._jit
 
-    def _place(self):
-        if self._placed or self.mesh is None:
-            return
+    def _place_vals(self, vals: Sequence[Any]) -> List[Any]:
+        """Device-place one version's values under the engine's param
+        shardings (mesh mode only)."""
         mesh = self.mesh
         repl = NamedSharding(mesh, P())
 
@@ -254,8 +300,13 @@ class ServeEngine:
             return (jax.device_put(v[0], sh), jax.device_put(v[1], repl)) \
                 if isinstance(v, tuple) else jax.device_put(v, sh)
 
-        self._p_vals = [put(v, p)
-                        for v, p in zip(self._p_vals, self._params)]
+        return [put(v, p) for v, p in zip(vals, self._params)]
+
+    def _place(self):
+        if self._placed or self.mesh is None:
+            return
+        ver, vals = self._live
+        self._live = (ver, self._place_vals(vals))
         self._placed = True
 
     # ------------------------------------------------------------------
@@ -390,9 +441,17 @@ class ServeEngine:
         return total
 
     # ------------------------------------------------------------------
-    def _run_bucket(self, xv: np.ndarray):
-        """One padded-bucket execution; returns device output(s) for the
-        first ``n`` rows still padded (the caller slices)."""
+    def _put_batch(self, xv: np.ndarray):
+        """ONE sharded transfer straight from host memory — an
+        intermediate jnp.asarray would pay a second, resharding copy
+        on the per-request hot path."""
+        return jax.device_put(xv, self._batch_sh) \
+            if self.mesh is not None else jnp.asarray(xv)
+
+    def _run_bucket(self, xv: np.ndarray, p_vals):
+        """One padded-bucket execution against ``p_vals`` (the caller's
+        version snapshot); returns device output(s) for the first ``n``
+        rows still padded (the caller slices)."""
         n = xv.shape[0]
         bucket = self.bucket_for(n)
         prog = self._ensure_program(bucket)
@@ -400,18 +459,19 @@ class ServeEngine:
             pad = np.zeros((bucket - n,) + xv.shape[1:], xv.dtype)
             xv = np.concatenate([xv, pad], axis=0)
             self.padded_rows += bucket - n
-        # ONE sharded transfer straight from host memory — an
-        # intermediate jnp.asarray would pay a second, resharding copy
-        # on the per-request hot path
-        x_dev = jax.device_put(xv, self._batch_sh) \
-            if self.mesh is not None else jnp.asarray(xv)
-        return prog(self._p_vals, x_dev)
+        return prog(p_vals, self._put_batch(xv))
 
     def infer(self, x):
         """Serve one request batch ``(n, *sample_shape)`` — padded into
         its bucket, sliced back to ``n`` rows; batches over the largest
         bucket run as chunks.  Output structure follows the net (each
-        leaf's leading axis is the batch)."""
+        leaf's leading axis is the batch).
+
+        The live param version is snapshotted ONCE per call — every row
+        of this batch (chunks included) is served by exactly one
+        version even while :meth:`update_params` swaps under traffic;
+        the version is recorded in ``last_version_served`` for the
+        batcher's attribution counters."""
         if self.sample_shape is None:
             raise RuntimeError("warmup() the engine before serving "
                                "(it pins the request signature)")
@@ -425,16 +485,174 @@ class ServeEngine:
         n = xv.shape[0]
         if n == 0:
             raise ValueError("empty request batch")
+        ver, p_vals = self._live   # ONE atomic snapshot per request
         self.infer_calls += 1
         self.rows_served += n
         mb = self.max_bucket
         outs = []
         for off in range(0, n, mb):
             chunk = xv[off:off + mb]
-            out = self._run_bucket(chunk)
+            out = self._run_bucket(chunk, p_vals)
             k = chunk.shape[0]
             outs.append(jax.tree.map(lambda a: a[:k], out))
+        self.last_version_served = ver
         if len(outs) == 1:
             return outs[0]
         return jax.tree.map(lambda *leaves: jnp.concatenate(leaves, axis=0),
                             *outs)
+
+    # ------------------------------------------------------------------
+    # canaried hot weight swap (docs/RESILIENCE.md §6)
+    # ------------------------------------------------------------------
+    def _normalize_candidate(self, new_params):
+        """Candidate → ordered raw arrays + ``(name, shape, dtype)``
+        descriptors.  Accepts a list/tuple in the engine's param order
+        or a dict keyed by parameter name; conversion failures and
+        missing/extra names surface as GL011 tree drift."""
+        names = [s[0] for s in self._param_sig]
+        extra = []
+        if isinstance(new_params, dict):
+            name_set = set(names)
+            extra = [n for n in new_params if n not in name_set]
+            ordered = [new_params.get(n) for n in names]
+        else:
+            ordered = list(new_params)
+            if len(ordered) > len(names):
+                extra = ["<positional %d..%d>" % (len(names),
+                                                  len(ordered))]
+            ordered = (ordered + [None] * len(names))[:len(names)]
+        # a None — absent key, short list, OR an explicit None value —
+        # is tree drift; it must hit GL011, never jnp.asarray(None)
+        missing = [n for n, v in zip(names, ordered) if v is None]
+        raw, cand_sig = [], []
+        for name, v in zip(names, ordered):
+            if v is None:
+                raw.append(None)
+                cand_sig.append((name, None, None))
+                continue
+            a = np.asarray(v.asnumpy() if isinstance(v, NDArray) else v)
+            raw.append(a)
+            cand_sig.append((name, tuple(a.shape), np.dtype(a.dtype)))
+        return raw, cand_sig, missing, extra
+
+    def update_params(self, new_params, canary=None,
+                      canary_tol: Optional[float] = None) -> int:
+        """Atomically swap the served param version under live traffic.
+
+        ``new_params`` — a list of arrays in the engine's parameter
+        order, or a dict keyed by parameter name (e.g. fresh values
+        exported from a training run of the SAME architecture).  The
+        swap is the zero-recompile contract of steady-state serving:
+        same shapes/dtypes ⇒ the existing AOT programs serve the new
+        version unchanged.  **GL011** rejects any shape/dtype/tree
+        drift BEFORE anything is staged — a drifted candidate would
+        force a recompile storm across every bucket, which is an outage,
+        not a swap (the gate is eager like the collective validators:
+        it fires even under ``lint="off"``).
+
+        The candidate is then **canaried**: the smallest compiled
+        bucket's program runs it on ``canary`` (rows of sample shape;
+        default zeros) next to the live version.  Non-finite canary
+        output — or, with ``canary_tol``, max-abs drift beyond
+        ``canary_tol * max|live output|`` — triggers an automatic
+        rollback: :class:`~.resilience.SwapRejected` is raised and the
+        old version keeps serving, invisible to traffic.
+
+        On success the new version is published ATOMICALLY (one tuple
+        write): every in-flight request keeps the snapshot it started
+        with, every later request sees the new version — each request
+        is served by exactly one version, attributable via
+        ``last_version_served``.  Returns the new version number.
+        """
+        from ..analysis import LintReport
+        from ..analysis.trace_lint import check_swap_compatibility
+        from .resilience import SwapRejected
+
+        with self._swap_lock:
+            if not self._params or self.sample_shape is None:
+                raise RuntimeError(
+                    "warmup() the engine before update_params() — the "
+                    "canary replays a compiled bucket program, and the "
+                    "pinned signature is what GL011 validates against")
+            raw, cand_sig, missing, extra = \
+                self._normalize_candidate(new_params)
+            diags = check_swap_compatibility(
+                self._param_sig, cand_sig, missing=missing, extra=extra,
+                where="ServeEngine(%s).update_params" % self.net.name)
+            if diags:
+                # eager gate: suppression deliberately NOT honored — an
+                # incompatible swap cannot proceed at any lint level
+                LintReport(diags).raise_if_errors()
+            vals, quant = self._prepare_vals(raw)
+            if quant != self._quantized:
+                raise RuntimeError(  # unreachable post-GL011; belt+braces
+                    "candidate quantization layout drifted from the "
+                    "served one")
+            if self.mesh is not None:
+                vals = self._place_vals(vals)
+            # --- canary: replay an EXISTING program (no compile, no
+            # recompile_count motion) with the candidate next to live
+            warmed = [b for b in self.buckets
+                      if self._program_key(b) in self._programs]
+            if not warmed:
+                raise RuntimeError("no compiled bucket program to canary "
+                                   "on — warmup() first")
+            bucket = warmed[0]
+            prog = self._programs[self._program_key(bucket)]
+            if canary is None:
+                cx = np.zeros((bucket,) + self.sample_shape,
+                              self.sample_dtype)
+                n_canary = bucket
+            else:
+                cx = np.asarray(canary.asnumpy()
+                                if isinstance(canary, NDArray) else canary)
+                if cx.ndim == len(self.sample_shape):
+                    cx = cx[None]
+                if tuple(cx.shape[1:]) != self.sample_shape or \
+                        np.dtype(cx.dtype) != self.sample_dtype:
+                    raise ValueError(
+                        "canary rows %s/%s do not match the engine's "
+                        "sample %s/%s" % (tuple(cx.shape[1:]), cx.dtype,
+                                          self.sample_shape,
+                                          self.sample_dtype))
+                n_canary = min(cx.shape[0], bucket)
+                pad = np.zeros((bucket - n_canary,) + self.sample_shape,
+                               self.sample_dtype)
+                cx = np.concatenate([cx[:n_canary], pad], axis=0)
+            old_ver, old_vals = self._live
+            new_out = jax.device_get(prog(vals, self._put_batch(cx)))
+            reason = None
+            new_leaves = [np.asarray(l)[:n_canary]
+                          for l in jax.tree_util.tree_leaves(new_out)]
+            if not all(np.isfinite(l).all() for l in new_leaves):
+                reason = ("canary produced non-finite output "
+                          "(poisoned/corrupt candidate weights)")
+            elif canary_tol is not None:
+                # the live-version reference run (a second transfer: an
+                # input-donating program consumed the first buffer) is
+                # only paid when a drift check actually reads it
+                ref_out = jax.device_get(prog(old_vals,
+                                              self._put_batch(cx)))
+                ref_leaves = [np.asarray(l)[:n_canary]
+                              for l in jax.tree_util.tree_leaves(ref_out)]
+                drift = max(float(np.max(np.abs(n - r), initial=0.0))
+                            for n, r in zip(new_leaves, ref_leaves))
+                scale = max(float(np.max(np.abs(r), initial=0.0))
+                            for r in ref_leaves)
+                if drift > float(canary_tol) * (scale + 1e-12):
+                    reason = ("canary drift %.3g exceeds tolerance %.3g "
+                              "x live-output scale %.3g"
+                              % (drift, float(canary_tol), scale))
+            if reason is not None:
+                self.rollback_count += 1
+                self.swap_log.append({"version": old_ver + 1, "ok": False,
+                                      "reason": reason,
+                                      "t": time.time()})
+                raise SwapRejected(reason)
+            # --- publish: one atomic tuple write; old buffers stay
+            # alive until the last in-flight snapshot drops them
+            self._live = (old_ver + 1, vals)
+            self.swap_count += 1
+            self.swap_log.append({"version": old_ver + 1, "ok": True,
+                                  "reason": "", "t": time.time()})
+            return old_ver + 1

@@ -14,17 +14,29 @@ harness is the acceptance instrument of ROADMAP item 2:
 - the batcher's occupancy histogram (how full the buckets really ran)
   and flush-trigger split (size- vs deadline-triggered);
 - the engine's ``recompile_count`` delta across the window — the GL005
-  steady-state contract: after warmup it must be 0.
+  steady-state contract: after warmup it must be 0;
+- the resilience ledger (docs/RESILIENCE.md §6): every future's
+  terminal outcome is classified — ok / engine error / SLO-expired
+  (``DeadlineExceeded``) / breaker-shed (``Shed``) / **hung** (the
+  no-hang-invariant breach counter: a future that failed to resolve
+  inside the collection bound; must be 0) — plus degraded-tier,
+  retry, respawn and per-param-version served counters, so a chaos leg
+  can assert the whole failure story from one report.
+
+Every wait is BOUNDED: a dead worker or a wedged engine turns into
+``hung`` counts and a finite report, never a loadtest that blocks
+forever.
 """
 from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
 from .batcher import Backpressure, ContinuousBatcher
+from .resilience import classify_future
 
 __all__ = ["LoadReport", "poisson_loadtest"]
 
@@ -36,6 +48,13 @@ class LoadReport:
     ok: int = 0
     errors: int = 0
     shed: int = 0                  # Backpressure rejections at submit
+    expired: int = 0               # SLO deadline passed (DeadlineExceeded)
+    breaker_shed: int = 0          # dropped by the open circuit breaker
+    hung: int = 0                  # futures that never resolved in bound
+    degraded: int = 0              # requests served by the fallback tier
+    retried: int = 0               # per-batch retry attempts
+    respawns: int = 0              # watchdog worker respawns
+    versions: Dict[str, int] = field(default_factory=dict)  # tier:vN -> rows
     wall_s: float = 0.0
     qps_offered: float = 0.0
     qps_sustained: float = 0.0
@@ -55,29 +74,46 @@ class LoadReport:
 
     def format(self) -> str:
         occ = " ".join("%d:%d" % kv for kv in sorted(self.occupancy.items()))
-        return ("loadtest: %d req in %.2fs — %.1f qps sustained "
-                "(%.1f offered), p50 %.2f / p95 %.2f / p99 %.2f ms, "
-                "%d err, %d shed, occupancy {%s}, flushes %d full / %d "
-                "deadline, %d recompiles"
-                % (self.n_requests, self.wall_s, self.qps_sustained,
-                   self.qps_offered, self.p50_ms, self.p95_ms, self.p99_ms,
-                   self.errors, self.shed, occ, self.flush_full,
-                   self.flush_deadline, self.recompiles))
+        s = ("loadtest: %d req in %.2fs — %.1f qps sustained "
+             "(%.1f offered), p50 %.2f / p95 %.2f / p99 %.2f ms, "
+             "%d err, %d shed, occupancy {%s}, flushes %d full / %d "
+             "deadline, %d recompiles"
+             % (self.n_requests, self.wall_s, self.qps_sustained,
+                self.qps_offered, self.p50_ms, self.p95_ms, self.p99_ms,
+                self.errors, self.shed, occ, self.flush_full,
+                self.flush_deadline, self.recompiles))
+        if (self.expired or self.breaker_shed or self.hung
+                or self.degraded or self.retried or self.respawns):
+            s += (", %d expired, %d breaker-shed, %d hung, %d degraded, "
+                  "%d retried, %d respawns"
+                  % (self.expired, self.breaker_shed, self.hung,
+                     self.degraded, self.retried, self.respawns))
+        if self.versions:
+            s += ", versions {%s}" % " ".join(
+                "%s:%d" % kv for kv in sorted(self.versions.items()))
+        return s
 
 
 def poisson_loadtest(batcher: ContinuousBatcher,
                      payload_fn: Callable[[int, np.random.RandomState], Any],
                      qps: float, n_requests: int = 200, seed: int = 0,
                      timeout: float = 30.0,
+                     deadline: Optional[float] = None,
+                     priority: int = 0,
                      extra: Optional[Dict[str, Any]] = None) -> LoadReport:
     """Drive ``batcher`` with open-loop Poisson traffic.
 
     ``payload_fn(i, rng)`` builds the i-th request payload (one sample);
     ``qps`` is the offered rate — inter-arrival gaps are Exp(1/qps).
+    ``deadline``/``priority`` ride every submit (the per-request SLO;
+    ``None`` falls back to the batcher's ``default_deadline``).
     Submission never waits for completion (open loop; a full queue is
-    recorded as shed load, not waited out).  Returns a
-    :class:`LoadReport`; the batcher's stats window is reset at start,
-    so one batcher can serve several measured legs back to back.
+    recorded as shed load, not waited out), and collection is bounded
+    by ``timeout``: a future that fails to resolve inside the bound is
+    a ``hung`` count — the no-hang-invariant breach a chaos run exits
+    1 on — never an indefinite block.  Returns a :class:`LoadReport`;
+    the batcher's stats window is reset at start, so one batcher can
+    serve several measured legs back to back.
     """
     if qps <= 0:
         raise ValueError("qps must be positive")
@@ -87,6 +123,7 @@ def poisson_loadtest(batcher: ContinuousBatcher,
     recompiles0 = batcher.engine.recompile_count
     futures = []
     shed = 0
+    submit_errors = 0
     t0 = time.monotonic()
     next_t = t0
     for i in range(n_requests):
@@ -95,21 +132,37 @@ def poisson_loadtest(batcher: ContinuousBatcher,
         if delay > 0:
             time.sleep(delay)
         try:
-            futures.append(batcher.submit(payload_fn(i, rng), block=False))
+            futures.append(batcher.submit(payload_fn(i, rng), block=False,
+                                          deadline=deadline,
+                                          priority=priority))
         except Backpressure:
             shed += 1
-    ok = errors = 0
-    deadline = time.monotonic() + timeout
+        except RuntimeError:
+            # batcher broken mid-window (respawn budget spent under
+            # chaos): the remaining offered load is an error, not a hang
+            submit_errors += 1
+    counts = {"ok": 0, "error": 0, "expired": 0, "shed": 0, "hung": 0}
+    versions: Dict[str, int] = {}
+    hard_deadline = time.monotonic() + timeout
     for f in futures:
-        try:
-            f.result(timeout=max(0.0, deadline - time.monotonic()))
-            ok += 1
-        except Exception:  # noqa: BLE001 — per-request failures are counted
-            errors += 1
+        outcome = classify_future(f, hard_deadline - time.monotonic())
+        counts[outcome] += 1
+        if outcome == "ok":
+            tier = getattr(f, "_mxtpu_tier", None)
+            if tier is not None:
+                key = "%s:v%s" % (tier, getattr(f, "_mxtpu_version", None))
+                versions[key] = versions.get(key, 0) + 1
+    ok, errors = counts["ok"], counts["error"]
+    expired, breaker_shed, hung = (counts["expired"], counts["shed"],
+                                   counts["hung"])
     wall = time.monotonic() - t0
     pct = batcher.stats.percentiles()
     report = LoadReport(
-        n_requests=n_requests, ok=ok, errors=errors, shed=shed,
+        n_requests=n_requests, ok=ok, errors=errors + submit_errors,
+        shed=shed,
+        expired=expired, breaker_shed=breaker_shed, hung=hung,
+        degraded=batcher.stats.degraded, retried=batcher.stats.retried,
+        respawns=batcher.stats.respawns, versions=versions,
         wall_s=wall, qps_offered=qps,
         qps_sustained=ok / wall if wall > 0 else 0.0,
         p50_ms=pct["p50"] * 1e3, p95_ms=pct["p95"] * 1e3,

@@ -439,6 +439,43 @@ def test_gl010_inference_param_donation():
     assert check_inference_param_donation([], range(4)) == []
 
 
+def test_gl011_swap_compatibility():
+    """GL011 gate: shape/dtype/tree drift between the served param
+    signature and a hot-swap candidate is an aggregated error; an
+    identical candidate is clean.  The engine-level integration —
+    ``ServeEngine.update_params`` refusing a drifted candidate before
+    staging anything — lives in tests/test_serve_resilience.py."""
+    import numpy as np
+
+    from incubator_mxnet_tpu.analysis import (
+        CODES, Severity as Sev, check_swap_compatibility)
+
+    # the code is cataloged (append-only contract, docs/ANALYSIS.md)
+    assert CODES["GL011"][0] == Sev.ERROR
+    served = [("w", (4, 4), np.dtype(np.float32)),
+              ("b", (4,), np.dtype(np.float32))]
+    # identical candidate: clean
+    assert check_swap_compatibility(served, list(served)) == []
+    # shape + dtype drift: ONE aggregated error naming both
+    cand = [("w", (4, 5), np.dtype(np.float32)),
+            ("b", (4,), np.dtype(np.float64))]
+    diags = check_swap_compatibility(served, cand, where="update_params")
+    assert [d.code for d in diags] == ["GL011"]
+    assert diags[0].severity == Sev.ERROR
+    assert "shape (4, 4) -> (4, 5)" in diags[0].message
+    assert "dtype float32 -> float64" in diags[0].message
+    assert "recompile" in diags[0].message
+    assert "param_signature" in diags[0].hint
+    # tree drift: missing + foreign names
+    diags = check_swap_compatibility(served, list(served),
+                                     missing=("b",), extra=("c",))
+    assert len(diags) == 1 and "missing from candidate" in diags[0].message
+    assert "not in the served tree" in diags[0].message
+    # tree drift: raw length mismatch is NEVER zip-truncated to clean
+    diags = check_swap_compatibility(served, served[:1])
+    assert len(diags) == 1 and "param count 2 -> 1" in diags[0].message
+
+
 def test_cli_reports_with_location(tmp_path, capsys):
     sys.path.insert(0, os.path.join(ROOT, "tools"))
     try:

@@ -1,0 +1,721 @@
+"""serve/resilience.py + batcher/engine resilience surgery.
+
+The acceptance surface of docs/RESILIENCE.md §6 "Serving":
+
+- **no future left behind** — under EVERY chaos scenario
+  (worker kill, engine failure burst, deadline storm, wedged engine,
+  close-under-load) every submitted future resolves within its bound
+  with exactly one of: result, ``RequestError``, ``DeadlineExceeded``,
+  ``Shed``, or the engine/worker error — nothing hangs;
+- **per-request SLO deadlines** — work that expired in the queue is
+  shed BEFORE compute (never served dead), the reaper backstop fires
+  by deadline+ε even when the engine itself is wedged;
+- **watchdog** — a silently-died worker is respawned within its
+  bounded budget (lost in-flight batch failed loudly), an exhausted
+  budget breaks the batcher instead of hanging callers;
+- **circuit breaker** — closed→open→half_open→closed transitions under
+  a failure burst; open degrades to the int8 fallback tier when
+  loaded, else priority-aware shedding; half-open probes recovery;
+- **canaried hot swap** — zero recompiles across a live swap, each
+  response attributable to exactly one param version, NaN canary rolls
+  back automatically, GL011 rejects drifted candidates before staging.
+
+Budget discipline: tiny nets, 1-2 warmed buckets, deadlines/waits in
+the tens of milliseconds; the open-ended soak is marked ``slow``.
+"""
+import threading
+import time
+from concurrent.futures import TimeoutError as FutureTimeout
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.analysis import LintError
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.parallel import fault_injection as fi
+from incubator_mxnet_tpu.serve import (Backpressure, CircuitBreaker,
+                                       ContinuousBatcher, DeadlineExceeded,
+                                       RequestError, RetryPolicy,
+                                       ServeEngine, Shed, SwapRejected,
+                                       poisson_loadtest)
+
+SAMPLE = (16,)
+
+
+def _mlp(seed=7):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(10))
+    net.initialize(init=mx.init.Xavier())
+    net(nd.ones((2,) + SAMPLE))
+    return net
+
+
+def _warm_engine(net=None, buckets=(4, 8), **kw):
+    eng = ServeEngine(net or _mlp(), buckets=buckets, lint="error", **kw)
+    eng.warmup(np.zeros(SAMPLE, np.float32))
+    return eng
+
+
+def _x(n, seed=0):
+    return np.random.RandomState(seed).rand(n, *SAMPLE).astype(np.float32)
+
+
+def _drain(futures, bound=10.0):
+    """Bounded wait for every future; returns the list of outcomes
+    (``"ok"`` or the exception instance).  Raises on a hang — the one
+    thing no scenario is allowed to produce."""
+    out = []
+    end = time.monotonic() + bound
+    for f in futures:
+        try:
+            f.result(timeout=max(0.0, end - time.monotonic()))
+            out.append("ok")
+        except FutureTimeout:
+            if not f.done():
+                raise AssertionError("future never resolved: the no-hang "
+                                     "invariant is broken")
+            out.append(f.exception())
+        except Exception as e:  # noqa: BLE001 — outcomes are the point
+            out.append(e)
+    return out
+
+
+def _wedged_engine(gate=None):
+    """An engine whose infer blocks until ``gate`` is set — the wedged-
+    device case only the reaper can bound."""
+    eng = _warm_engine()
+    gate = gate or threading.Event()
+    real = eng.infer
+
+    def wedged(xv):
+        gate.wait(timeout=10)
+        return real(xv)
+
+    eng.infer = wedged
+    return eng, gate
+
+
+# ---------------------------------------------------------------------------
+# per-request SLO deadlines
+# ---------------------------------------------------------------------------
+
+def test_expired_in_queue_is_shed_before_compute():
+    """A request whose SLO passed while it sat behind a slow batch gets
+    DeadlineExceeded and NEVER reaches the engine (served-dead is a
+    correctness bug, not just wasted compute)."""
+    eng, gate = _wedged_engine()
+    b = ContinuousBatcher(eng, max_delay=0.01, grace=10.0)  # reaper idle
+    try:
+        f1 = b.submit(_x(1)[0])           # wedges the worker
+        time.sleep(0.03)                  # f1's batch is in flight
+        rows0 = eng.rows_served
+        f2 = b.submit(_x(1)[0], deadline=0.02)
+        time.sleep(0.05)                  # f2 expires while queued
+        gate.set()                        # unwedge: worker drains
+        with pytest.raises(DeadlineExceeded, match="before compute"):
+            f2.result(timeout=5)
+        assert np.asarray(f1.result(timeout=5)).shape == (10,)
+        # f2 never burned a bucket slot: only f1's row was served
+        assert eng.rows_served == rows0 + 1
+        assert b.stats.expired == 1
+    finally:
+        gate.set()
+        b.close()
+
+
+def test_reaper_bounds_wedged_engine():
+    """The no-hang backstop: the engine never returns, yet the future
+    resolves by deadline + grace + one watchdog tick."""
+    eng, gate = _wedged_engine()
+    b = ContinuousBatcher(eng, max_delay=0.005, grace=0.05)
+    try:
+        t0 = time.monotonic()
+        f = b.submit(_x(1)[0], deadline=0.05)
+        with pytest.raises(DeadlineExceeded, match="reaped"):
+            f.result(timeout=5)
+        waited = time.monotonic() - t0
+        assert waited < 2.0, "reaper took %.2fs" % waited
+        assert b.stats.expired == 1
+    finally:
+        gate.set()
+        b.close()
+
+
+def test_default_deadline_and_validation():
+    eng = _warm_engine()
+    b = ContinuousBatcher(eng, max_delay=0.01, default_deadline=5.0)
+    try:
+        f = b.submit(_x(1)[0])  # inherits the default SLO
+        assert np.asarray(f.result(timeout=5)).shape == (10,)
+        with pytest.raises(ValueError, match="deadline"):
+            b.submit(_x(1)[0], deadline=-1.0)
+    finally:
+        b.close()
+    with pytest.raises(ValueError, match="default_deadline"):
+        ContinuousBatcher(eng, default_deadline=0.0)
+    with pytest.raises(ValueError, match="grace"):
+        ContinuousBatcher(eng, grace=-1.0)
+
+
+def test_deadline_storm_all_resolve_fast():
+    """The fault-injection storm: every future resolves (shed, not
+    served and not hung) and the flush never waits out max_delay for
+    work that is already dead.  The worker is wedged on a prior batch
+    so the storm's deadlines deterministically expire in the queue."""
+    eng, gate = _wedged_engine()
+    b = ContinuousBatcher(eng, max_delay=0.5, grace=0.02)
+    try:
+        f0 = b.submit(_x(1)[0])   # wedges the worker
+        time.sleep(0.02)
+        calls0 = eng.infer_calls
+        futs, _ = fi.deadline_storm(b, [_x(1)[0]] * 12, deadline=1e-4)
+        time.sleep(0.01)          # every storm deadline is now past
+        gate.set()
+        t0 = time.monotonic()
+        out = _drain(futs, bound=5.0)
+        assert time.monotonic() - t0 < 2.0
+        assert all(isinstance(o, DeadlineExceeded) for o in out), out
+        assert np.asarray(f0.result(timeout=5)).shape == (10,)
+        # only f0's row was ever computed — no dead storm row was served
+        assert eng.rows_served == 1 and eng.infer_calls == calls0 + 1
+    finally:
+        gate.set()
+        b.close()
+
+
+def test_tight_slo_on_idle_engine_is_served_not_shed():
+    """A deadline tighter than max_delay must make the flush fire
+    EARLY (deadline minus the service margin), not at the deadline —
+    flushing at the deadline would guarantee the shed-before-compute
+    check kills a request an idle engine could trivially serve."""
+    eng = _warm_engine()
+    b = ContinuousBatcher(eng, max_delay=0.5, grace=0.05)
+    try:
+        t0 = time.monotonic()
+        f = b.submit(_x(1)[0], deadline=0.1)
+        row = np.asarray(f.result(timeout=5))
+        waited = time.monotonic() - t0
+        assert row.shape == (10,)
+        assert waited < 0.4, "flush waited out max_delay: %.2fs" % waited
+        assert b.stats.expired == 0
+    finally:
+        b.close()
+
+
+def test_blocking_submit_not_wedged_by_reaped_tombstones():
+    """Admission capacity counts UNRESOLVED work: when the queue is
+    full of requests the reaper has expired (their tombstones undrained
+    by a wedged worker), a blocking submit gets the freed slot instead
+    of hanging in the enqueue forever — the no-hang guarantee covers
+    the submitter, not just the future."""
+    eng, gate = _wedged_engine()
+    b = ContinuousBatcher(eng, max_delay=0.005, max_queue=2, grace=0.01)
+    try:
+        f0 = b.submit(_x(1)[0])                  # in-flight, wedged
+        time.sleep(0.03)
+        f1 = b.submit(_x(1)[0], deadline=0.03)   # capacity now full
+        t0 = time.monotonic()
+        f2 = b.submit(_x(1)[0], deadline=5.0)    # blocks for a slot
+        waited = time.monotonic() - t0
+        assert waited < 2.0, "blocking submit wedged %.2fs" % waited
+        with pytest.raises(DeadlineExceeded):
+            f1.result(timeout=5)
+        gate.set()
+        assert np.asarray(f0.result(timeout=5)).shape == (10,)
+        assert np.asarray(f2.result(timeout=5)).shape == (10,)
+    finally:
+        gate.set()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# worker watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_respawns_killed_worker():
+    """A silent worker death (BaseException out of the engine) fails
+    its lost in-flight batch loudly and respawns the worker; later
+    traffic is served by the replacement."""
+    eng = _warm_engine()
+    b = ContinuousBatcher(eng, max_delay=0.01)
+    try:
+        with fi.kill_batcher_worker(at=0) as ks:
+            f1 = b.submit(_x(1)[0])
+            with pytest.raises(RuntimeError, match="died mid-batch"):
+                f1.result(timeout=5)
+        assert ks.killed == 1
+        assert b.stats.worker_deaths == 1 and b.stats.respawns == 1
+        f2 = b.submit(_x(1)[0])
+        np.testing.assert_array_equal(np.asarray(f2.result(timeout=5)),
+                                      np.asarray(eng.infer(_x(1)))[0])
+    finally:
+        b.close()
+
+
+def test_respawn_budget_exhausted_breaks_loudly():
+    """Past max_respawns the batcher is BROKEN: pending requests fail,
+    new submits are refused, nothing hangs."""
+    import warnings as _warnings
+
+    eng = _warm_engine()
+    b = ContinuousBatcher(eng, max_delay=0.01, max_respawns=1)
+    try:
+        with _warnings.catch_warnings(record=True) as caught:
+            _warnings.simplefilter("always")
+            with fi.kill_batcher_worker(at=0, count=2):
+                # two separate batches -> two worker deaths: the first
+                # spends the budget, the second breaks the batcher
+                outs = _drain([b.submit(_x(1)[0])], bound=10.0)
+                outs += _drain([b.submit(_x(1)[0])], bound=10.0)
+                assert all(isinstance(o, RuntimeError) for o in outs)
+                # give the watchdog time to observe the second death
+                t_end = time.monotonic() + 5
+                while b._broken is None and time.monotonic() < t_end:
+                    time.sleep(0.01)
+                time.sleep(0.05)  # let the watchdog's warn land
+        assert b._broken is not None
+        assert any("max_respawns" in str(w.message) for w in caught), \
+            [str(w.message) for w in caught]
+        with pytest.raises(RuntimeError, match="broken"):
+            b.submit(_x(1)[0])
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# retry + circuit breaker
+# ---------------------------------------------------------------------------
+
+def test_retry_absorbs_transient_failure():
+    eng = _warm_engine()
+    b = ContinuousBatcher(eng, max_delay=0.01,
+                          retry=RetryPolicy(max_retries=2, backoff=0.001))
+    try:
+        with fi.engine_failure_burst(1):
+            f = b.submit(_x(1)[0])
+            np.testing.assert_array_equal(np.asarray(f.result(timeout=5)),
+                                          np.asarray(eng.infer(_x(1)))[0])
+        assert b.stats.retried == 1 and b.stats.failed == 0
+    finally:
+        b.close()
+
+
+def test_retry_never_past_deadline_and_policy_validation():
+    """A backoff that would sleep past the batch's tightest SLO fails
+    fast instead — the deadline machinery sheds, the retry must not
+    serve dead either."""
+    eng = _warm_engine()
+    b = ContinuousBatcher(eng, max_delay=0.005, grace=0.5,
+                          retry=RetryPolicy(max_retries=5, backoff=0.2))
+    try:
+        with fi.engine_failure_burst(1):
+            f = b.submit(_x(1)[0], deadline=0.05)
+            with pytest.raises(RuntimeError, match="injected engine"):
+                f.result(timeout=5)
+        assert b.stats.retried == 0  # refused: backoff > remaining SLO
+    finally:
+        b.close()
+    pol = RetryPolicy()
+    assert pol.is_transient(RuntimeError("x"))
+    assert not pol.is_transient(ValueError("malformed"))
+    assert not pol.is_transient(Shed("policy"))
+    assert not pol.is_transient(Backpressure("full"))
+    with pytest.raises(ValueError, match="max_retries"):
+        RetryPolicy(max_retries=-1)
+    with pytest.raises(ValueError, match="backoff"):
+        RetryPolicy(backoff=-0.1)
+
+
+def test_breaker_transitions_and_shedding_without_fallback():
+    """closed -> open after the failure threshold; open sheds (Shed,
+    microseconds, not engine timeouts); priority > 0 still probes the
+    primary; recovery via half_open -> closed."""
+    eng = _warm_engine()
+    brk = CircuitBreaker(failure_threshold=2, recovery_time=0.08)
+    b = ContinuousBatcher(eng, max_delay=0.005, breaker=brk)
+    try:
+        with fi.engine_failure_burst(2):
+            # two separate batches -> two consecutive failures
+            outs = _drain([b.submit(_x(1)[0])])
+            outs += _drain([b.submit(_x(1)[0])])
+        assert all(isinstance(o, RuntimeError) and "injected" in str(o)
+                   for o in outs)
+        assert brk.state == CircuitBreaker.OPEN
+        # open: low-priority work is shed without touching the engine
+        calls0 = eng.infer_calls
+        f = b.submit(_x(1)[0])
+        with pytest.raises(Shed, match="breaker open"):
+            f.result(timeout=5)
+        assert eng.infer_calls == calls0
+        assert b.stats.breaker_shed == 1
+        # open: priority > 0 is still attempted (and heals the breaker,
+        # since the burst is over)
+        f = b.submit(_x(1)[0], priority=1)
+        assert np.asarray(f.result(timeout=5)).shape == (10,)
+        assert brk.state == CircuitBreaker.CLOSED
+        seq = [(a, c) for (_t, a, c) in brk.transitions]
+        assert ("closed", "open") in seq and ("open", "closed") in seq
+    finally:
+        b.close()
+
+
+def test_breaker_half_open_probe_recovery():
+    eng = _warm_engine()
+    brk = CircuitBreaker(failure_threshold=1, recovery_time=0.05)
+    b = ContinuousBatcher(eng, max_delay=0.005, breaker=brk)
+    try:
+        with fi.engine_failure_burst(1):
+            _drain([b.submit(_x(1)[0])])
+        assert brk.state == CircuitBreaker.OPEN
+        time.sleep(0.06)  # past recovery_time: next batch is the probe
+        f = b.submit(_x(1)[0])
+        assert np.asarray(f.result(timeout=5)).shape == (10,)
+        seq = [(a, c) for (_t, a, c) in brk.transitions]
+        assert ("open", "half_open") in seq and \
+            ("half_open", "closed") in seq
+    finally:
+        b.close()
+
+
+def test_breaker_degrades_to_int8_fallback_and_recovers():
+    """The degradation ladder: the primary burns, the breaker opens,
+    traffic serves from the int8 tier (counted + attributed), and the
+    half-open probe brings the primary back."""
+    net = _mlp()
+    eng = _warm_engine(net)
+    fb = ServeEngine(net, buckets=(4, 8), dtype="int8", lint="error")
+    fb.warmup(np.zeros(SAMPLE, np.float32))
+    brk = CircuitBreaker(failure_threshold=1, recovery_time=0.08)
+    b = ContinuousBatcher(eng, max_delay=0.005, breaker=brk, fallback=fb)
+    x = _x(4, seed=3)
+    ref8 = np.asarray(fb.infer(x))
+    try:
+        with fi.engine_failure_burst(4, engine=eng):
+            # batch 1 fails over immediately; later batches route to the
+            # fallback while the breaker is open — all served, degraded
+            futs = [b.submit(x[i]) for i in range(2)]
+            rows = [np.asarray(f.result(timeout=5)) for f in futs]
+            for f in futs:
+                assert f._mxtpu_tier == "fallback"
+            time.sleep(0.1)  # probe fires into the still-burning burst
+        assert b.stats.degraded >= 2
+        for i, r in enumerate(rows):
+            np.testing.assert_array_equal(r, ref8[i])
+        # burst over: the probe (or the next one) closes the breaker
+        time.sleep(0.1)
+        f = b.submit(x[0])
+        f.result(timeout=5)
+        deadline = time.monotonic() + 5
+        while brk.state != CircuitBreaker.CLOSED and \
+                time.monotonic() < deadline:
+            f = b.submit(x[0])
+            f.result(timeout=5)
+            time.sleep(0.02)
+        assert brk.state == CircuitBreaker.CLOSED
+        assert f._mxtpu_tier == "primary"
+    finally:
+        b.close()
+    with pytest.raises(ValueError, match="failure_threshold"):
+        CircuitBreaker(failure_threshold=0)
+    with pytest.raises(ValueError, match="recovery_time"):
+        CircuitBreaker(recovery_time=0)
+
+
+def test_fallback_signature_validated():
+    eng = _warm_engine()
+    cold = ServeEngine(_mlp(), buckets=(4,))
+    with pytest.raises(ValueError, match="warmup.*fallback"):
+        ContinuousBatcher(eng, fallback=cold)
+    mx.random.seed(3)
+    net8 = nn.HybridSequential()
+    net8.add(nn.Dense(4))
+    net8.initialize(init=mx.init.Xavier())
+    net8(nd.ones((2, 8)))
+    other = ServeEngine(net8, buckets=(4,), lint="error")
+    other.warmup(np.zeros((8,), np.float32))
+    with pytest.raises(ValueError, match="same requests"):
+        ContinuousBatcher(eng, fallback=other)
+
+
+# ---------------------------------------------------------------------------
+# canaried hot weight swap
+# ---------------------------------------------------------------------------
+
+def test_hot_swap_under_live_traffic_exactly_one_version():
+    """The acceptance bit: a swap under live traffic commits with ZERO
+    recompiles, and every response is attributable to exactly one param
+    version whose reference output it matches bit-for-bit."""
+    eng = _warm_engine()
+    x = _x(4, seed=5)
+    ref1 = np.asarray(eng.infer(x))
+    b = ContinuousBatcher(eng, max_delay=0.005)
+    recomp0 = eng.recompile_count
+    stop, futs = threading.Event(), []
+    lock = threading.Lock()
+
+    def pound():
+        i = 0
+        while not stop.is_set():
+            try:
+                f = b.submit(x[i % 4])
+                with lock:
+                    futs.append((i % 4, f))
+            except (Backpressure, RuntimeError):
+                pass
+            i += 1
+            time.sleep(0.001)
+
+    t = threading.Thread(target=pound)
+    t.start()
+    try:
+        time.sleep(0.03)  # traffic on v1
+        v1 = eng.params_version
+        v2 = eng.update_params(
+            [np.array(p._data._data) * 1.5 for p in eng._params])
+        time.sleep(0.03)  # traffic on v2
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        b.close()
+    ref2 = np.asarray(eng.infer(x))
+    assert v2 == v1 + 1 and eng.swap_count == 1
+    assert eng.recompile_count == recomp0, "the swap recompiled"
+    assert not np.allclose(ref1, ref2)  # the swap actually took
+    seen = set()
+    for i, f in futs:
+        if f.cancelled() or f.exception(timeout=5) is not None:
+            continue  # close() failed the tail of the stream
+        ver = f._mxtpu_version
+        seen.add(ver)
+        assert ver in (v1, v2), ver
+        expect = ref1[i] if ver == v1 else ref2[i]
+        np.testing.assert_array_equal(np.asarray(f.result()), expect)
+    assert seen == {v1, v2}, ("both versions must have served traffic",
+                              seen)
+
+
+def test_swap_canary_nan_rollback():
+    eng = _warm_engine()
+    x = _x(2, seed=6)
+    ref = np.asarray(eng.infer(x))
+    with pytest.raises(SwapRejected, match="non-finite"):
+        eng.update_params(fi.nan_params(eng))
+    assert eng.params_version == 1 and eng.rollback_count == 1
+    assert eng.swap_count == 0
+    assert not eng.swap_log[-1]["ok"]
+    # the old version is genuinely still serving, bit-identical
+    np.testing.assert_array_equal(np.asarray(eng.infer(x)), ref)
+
+
+def test_swap_canary_drift_tolerance():
+    eng = _warm_engine()
+    big = [np.array(p._data._data) * 100.0 for p in eng._params]
+    with pytest.raises(SwapRejected, match="drift"):
+        eng.update_params(big, canary=_x(2, seed=7), canary_tol=0.5)
+    assert eng.params_version == 1
+    # without a tolerance the same candidate commits (finite output)
+    assert eng.update_params(big, canary=_x(2, seed=7)) == 2
+
+
+def test_swap_int8_tier_requantizes():
+    """A swap on the int8 tier requantizes the candidate with the same
+    layout — same program keys, zero recompiles, parity holds."""
+    net = _mlp()
+    e8 = ServeEngine(net, buckets=(8,), dtype="int8", lint="error")
+    e8.warmup(np.zeros(SAMPLE, np.float32))
+    recomp0 = e8.recompile_count
+    x = _x(4, seed=8)
+    new = [np.array(p._data._data) * 0.5 for p in e8._params]
+    assert e8.update_params(new) == 2
+    assert e8.recompile_count == recomp0
+    quant = [v for v, q in zip(e8._p_vals, e8._quantized) if q]
+    assert quant and all(v[0].dtype == np.int8 for v in quant)
+    fp = ServeEngine(net, buckets=(8,), lint="error")
+    fp.warmup(np.zeros(SAMPLE, np.float32))
+    fp.update_params(new)
+    ref = np.asarray(fp.infer(x))
+    got = np.asarray(e8.infer(x))
+    np.testing.assert_allclose(got, ref, atol=0.02 * np.abs(ref).max())
+
+
+def test_gl011_rejects_drift_before_staging():
+    """Shape, dtype and tree drift are all refused with GL011 and the
+    served version never moves — the zero-recompile contract."""
+    eng = _warm_engine()
+    good = [np.array(p._data._data) for p in eng._params]
+    # shape drift
+    bad = [np.zeros((3, 3), np.float32)] + good[1:]
+    with pytest.raises(LintError, match="GL011"):
+        eng.update_params(bad)
+    # dtype drift
+    bad = [good[0].astype(np.float64)] + good[1:]
+    with pytest.raises(LintError, match="GL011"):
+        eng.update_params(bad)
+    # tree drift: wrong length
+    with pytest.raises(LintError, match="GL011"):
+        eng.update_params(good[:-1])
+    # tree drift: dict with a missing + a foreign name
+    d = {name: v for (name, _s, _d), v in zip(eng.param_signature, good)}
+    first = next(iter(d))
+    d["not_a_param"] = d.pop(first)
+    with pytest.raises(LintError, match="GL011"):
+        eng.update_params(d)
+    # tree drift: an explicit None value is missing, not a NaN scalar
+    d = {name: v for (name, _s, _d), v in zip(eng.param_signature, good)}
+    d[next(iter(d))] = None
+    with pytest.raises(LintError, match="GL011"):
+        eng.update_params(d)
+    assert eng.params_version == 1 and eng.swap_count == 0
+    # a dict keyed correctly commits
+    d = {name: v for (name, _s, _d), v in zip(eng.param_signature, good)}
+    assert eng.update_params(d) == 2
+
+
+def test_swap_requires_warmup():
+    eng = ServeEngine(_mlp(), buckets=(4,))
+    with pytest.raises(RuntimeError, match="warmup"):
+        eng.update_params([])
+
+
+# ---------------------------------------------------------------------------
+# shutdown + loadtest ledger
+# ---------------------------------------------------------------------------
+
+def test_submit_after_close_raises_and_pending_fail():
+    """Satellite 1: submit after close() raises immediately, and a
+    request stranded inside a stale (wedged) worker is failed by
+    close() instead of leaking — no caller ever hangs."""
+    eng, gate = _wedged_engine()
+    b = ContinuousBatcher(eng, max_delay=0.005)
+    f = b.submit(_x(1)[0])
+    time.sleep(0.03)  # the batch is in flight inside the wedged engine
+    with pytest.warns(UserWarning, match="did not exit"):
+        b.close(join_timeout=0.1)
+    with pytest.raises(RuntimeError, match="closed"):
+        b.submit(_x(1)[0])
+    with pytest.raises(RuntimeError, match="closed"):
+        f.result(timeout=5)
+    gate.set()
+
+
+def test_loadtest_resilience_ledger():
+    """The extended LoadReport: version attribution on the happy path,
+    expired/hung accounting under deadlines, JSON-serializability."""
+    import json
+
+    eng = _warm_engine()
+    b = ContinuousBatcher(eng, max_delay=0.005)
+    try:
+        x = _x(8, seed=9)
+        rep = poisson_loadtest(b, lambda i, rng: x[i % 8], qps=800,
+                               n_requests=40, seed=4, deadline=10.0)
+        assert rep.ok == 40 and rep.errors == 0 and rep.hung == 0
+        assert rep.expired == 0 and rep.breaker_shed == 0
+        assert rep.versions == {"primary:v1": 40}
+        json.dumps(rep.to_dict())
+        assert "versions" in rep.format() or rep.versions
+        # a storm leg on the same batcher: expired counted, none hung
+        with fi.slow_client(0.0):  # no-op interpose keeps the hook warm
+            rep2 = poisson_loadtest(b, lambda i, rng: x[i % 8], qps=2000,
+                                    n_requests=20, seed=5, deadline=1e-4)
+        assert rep2.hung == 0
+        assert rep2.ok + rep2.expired == 20
+        assert rep2.expired > 0
+    finally:
+        b.close()
+
+
+def test_no_future_left_behind_matrix():
+    """ONE sweep over every chaos scenario: whatever the fault, every
+    admitted future resolves within its bound."""
+    x = _x(4, seed=10)
+
+    def fresh(**kw):
+        eng = _warm_engine()
+        return eng, ContinuousBatcher(eng, max_delay=0.005, **kw)
+
+    # worker kill
+    eng, b = fresh()
+    with fi.kill_batcher_worker(at=0):
+        outs = _drain([b.submit(x[i % 4]) for i in range(4)])
+    assert all(o == "ok" or isinstance(o, Exception) for o in outs)
+    b.close()
+    # failure burst, no breaker
+    eng, b = fresh(retry=RetryPolicy(max_retries=1, backoff=0.001))
+    with fi.engine_failure_burst(4):
+        outs = _drain([b.submit(x[i % 4]) for i in range(4)])
+    assert all(o == "ok" or isinstance(o, RuntimeError) for o in outs)
+    b.close()
+    # failure burst behind an open breaker: the first batch trips it
+    # (threshold 1), everything after is shed in microseconds
+    eng, b = fresh(breaker=CircuitBreaker(failure_threshold=1,
+                                          recovery_time=5.0))
+    with fi.engine_failure_burst(8):
+        outs = _drain([b.submit(x[0])])
+        outs += _drain([b.submit(x[i % 4]) for i in range(5)])
+    assert any(isinstance(o, Shed) for o in outs)
+    b.close()
+    # deadline storm (worker wedged so expiry-in-queue is deterministic)
+    eng, gate = _wedged_engine()
+    b = ContinuousBatcher(eng, max_delay=0.005, grace=0.02)
+    f0 = b.submit(x[0])
+    time.sleep(0.02)
+    futs, _ = fi.deadline_storm(b, [x[0]] * 8, deadline=1e-4)
+    time.sleep(0.01)
+    gate.set()
+    outs = _drain(futs + [f0])
+    assert all(isinstance(o, DeadlineExceeded) for o in outs[:-1])
+    assert outs[-1] == "ok"
+    b.close()
+    # malformed riders under chaos
+    eng, b = fresh()
+    with fi.engine_failure_burst(1):
+        good = b.submit(x[0])
+        bad = b.submit(fi.malformed_request(SAMPLE, kind="rank"))
+        outs = _drain([good, bad])
+    assert isinstance(outs[1], RequestError)
+    b.close()
+
+
+@pytest.mark.slow
+def test_chaos_soak_open_loop():
+    """Soak: open-loop traffic while faults fire back-to-back — kill,
+    burst, storm, swap, rollback — every future resolves, the engine
+    returns to serving, zero recompiles post-warmup.  Marked slow:
+    tier-1 runs the fast deterministic variants above."""
+    net = _mlp()
+    eng = _warm_engine(net)
+    fb = ServeEngine(net, buckets=(4, 8), dtype="int8", lint="error")
+    fb.warmup(np.zeros(SAMPLE, np.float32))
+    b = ContinuousBatcher(eng, max_delay=0.005,
+                          retry=RetryPolicy(max_retries=1, backoff=0.002),
+                          breaker=CircuitBreaker(failure_threshold=3,
+                                                 recovery_time=0.1),
+                          fallback=fb, grace=0.05)
+    x = _x(16, seed=11)
+    recomp0 = eng.recompile_count + fb.recompile_count
+    try:
+        for round_ in range(3):
+            with fi.kill_batcher_worker(at=2):
+                _drain([b.submit(x[i % 16], deadline=5.0)
+                        for i in range(16)], bound=20.0)
+            with fi.engine_failure_burst(6, engine=eng):
+                _drain([b.submit(x[i % 16], deadline=5.0)
+                        for i in range(16)], bound=20.0)
+            futs, _ = fi.deadline_storm(b, [x[0]] * 16, deadline=1e-4)
+            _drain(futs, bound=20.0)
+            eng.update_params(
+                [np.array(p._data._data) * (1.0 + 0.01 * round_)
+                 for p in eng._params])
+            with pytest.raises(SwapRejected):
+                eng.update_params(fi.nan_params(eng))
+            time.sleep(0.12)
+        # the engine returned to serving after every fault cleared
+        outs = _drain([b.submit(x[i % 16]) for i in range(8)], bound=20.0)
+        assert outs.count("ok") == 8
+        assert (eng.recompile_count + fb.recompile_count) == recomp0
+    finally:
+        b.close()

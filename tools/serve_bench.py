@@ -13,12 +13,23 @@ the latency delta is the tier, not the noise.  The run FAILS (exit 1)
 if any post-warmup recompile happened: steady-state serving must be
 compile-free (the GL005 contract the loadtest counter enforces).
 
+``--chaos`` adds the resilience leg (docs/RESILIENCE.md §6): the same
+model behind a batcher configured with retry + circuit breaker + int8
+fallback tier, driven through the fault_injection serving scenarios —
+worker kill (watchdog respawn), engine failure burst (breaker
+degradation + recovery), deadline storm (shed-before-compute), and a
+canaried hot weight swap incl. a poisoned candidate (rollback).  The
+leg FAILS (exit 1) on any hung future (a future that did not resolve
+within its bound — the no-hang invariant) or any post-warmup
+recompile (a hot swap must reuse every AOT program).
+
 Examples::
 
   JAX_PLATFORMS=cpu python tools/serve_bench.py --model mlp --qps 500
   python tools/serve_bench.py --model resnet50 --buckets 32,128 \
       --qps 200 --requests 400 --int8
   python tools/serve_bench.py --model mlp --dp 8 --qps 1000
+  JAX_PLATFORMS=cpu python tools/serve_bench.py --model mlp --chaos
 """
 from __future__ import annotations
 
@@ -116,6 +127,115 @@ def run_leg(tag, net, sample_shape, args, mesh, dtype=None):
     return rep
 
 
+def run_chaos(net, sample_shape, args, mesh):
+    """The resilience leg: chaos scenarios against a breaker+fallback
+    batcher.  Returns the number of FAILURES (hung futures + post-
+    warmup recompiles) — 0 is the contract."""
+    import numpy as np
+
+    from incubator_mxnet_tpu.parallel import fault_injection as fi
+    from incubator_mxnet_tpu.serve import (CircuitBreaker,
+                                           ContinuousBatcher, RetryPolicy,
+                                           ServeEngine, SwapRejected)
+    from incubator_mxnet_tpu.serve.resilience import classify_future
+
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    eng = ServeEngine(net, buckets=buckets, mesh=mesh,
+                      lint="error", cost=args.cost)
+    eng.warmup(np.zeros(sample_shape, np.float32))
+    fb = ServeEngine(net, buckets=buckets, mesh=mesh, dtype="int8",
+                     lint="error")
+    fb.warmup(np.zeros(sample_shape, np.float32))
+    recompiles0 = eng.recompile_count + fb.recompile_count
+    rs = np.random.RandomState(args.seed)
+    pool = rs.rand(64, *sample_shape).astype(np.float32)
+    batcher = ContinuousBatcher(
+        eng, max_delay=args.max_delay / 1e3, max_queue=args.max_queue,
+        retry=RetryPolicy(max_retries=1, backoff=0.002),
+        breaker=CircuitBreaker(failure_threshold=3, recovery_time=0.1),
+        fallback=fb, grace=0.05)
+    hung = served = expired = shed = degraded = failed = 0
+    poison_accepted = False
+
+    def drain(futures, bound=15.0):
+        nonlocal hung, served, expired, shed, degraded, failed
+        import time as _time
+
+        end = _time.monotonic() + bound  # wall-clock steps must not
+        for f in futures:                # corrupt the no-hang bound
+            outcome = classify_future(f, end - _time.monotonic())
+            if outcome == "ok":
+                served += 1
+                if getattr(f, "_mxtpu_tier", None) == "fallback":
+                    degraded += 1
+            elif outcome == "expired":
+                expired += 1
+            elif outcome == "shed":
+                shed += 1
+            elif outcome == "hung":
+                hung += 1  # the no-hang invariant breach
+            else:
+                failed += 1
+
+    try:
+        # 1. worker kill mid-traffic: watchdog fails the lost batch,
+        # respawns, later traffic serves again
+        with fi.kill_batcher_worker(at=0):
+            drain([batcher.submit(pool[i % 64]) for i in range(8)])
+        log("chaos: worker kill — respawns=%d worker_deaths=%d"
+            % (batcher.stats.respawns, batcher.stats.worker_deaths))
+        # 2. engine failure burst on the PRIMARY only: retry absorbs the
+        # head, the breaker opens and degrades to the int8 tier, then
+        # half-opens and recovers
+        with fi.engine_failure_burst(8, engine=eng):
+            drain([batcher.submit(pool[i % 64]) for i in range(12)])
+        time.sleep(0.15)  # past recovery_time: next batch probes
+        drain([batcher.submit(pool[0])])
+        log("chaos: failure burst — breaker=%s degraded=%d retried=%d"
+            % (batcher.breaker.state, batcher.stats.degraded,
+               batcher.stats.retried))
+        # 3. deadline storm: already-dead work shed BEFORE compute
+        futs, _ = fi.deadline_storm(batcher, [pool[0]] * 16,
+                                    deadline=1e-4)
+        drain(futs)
+        log("chaos: deadline storm — expired=%d" % batcher.stats.expired)
+        # 4. canaried hot swap under the same engine: a legitimate
+        # candidate commits with zero recompiles; a poisoned one rolls
+        # back (SwapRejected) with the old version still serving
+        new = [np.array(p._data._data) for p in eng._params]
+        v = eng.update_params(new)
+        try:
+            eng.update_params(fi.nan_params(eng))
+            log("chaos: FAIL — poisoned swap was accepted")
+            poison_accepted = True
+        except SwapRejected:
+            pass
+        drain([batcher.submit(pool[i % 64]) for i in range(4)])
+        log("chaos: hot swap — version=%d rollbacks=%d"
+            % (v, eng.rollback_count))
+    finally:
+        batcher.close()
+    recompiles = (eng.recompile_count + fb.recompile_count) - recompiles0
+    rec = {"metric": "serve_chaos", "value": hung, "unit": "hung_futures",
+           "served": served, "failed": failed, "expired": expired,
+           "breaker_shed": shed, "degraded": degraded,
+           "retried": batcher.stats.retried,
+           "respawns": batcher.stats.respawns,
+           "worker_deaths": batcher.stats.worker_deaths,
+           "breaker_state": batcher.breaker.state,
+           "swap_version": eng.params_version,
+           "rollbacks": eng.rollback_count,
+           "poison_accepted": poison_accepted,
+           "recompiles": recompiles}
+    print(json.dumps(rec), flush=True)
+    if hung or recompiles or poison_accepted:
+        log("chaos: FAIL — %d hung future(s), %d recompile(s), "
+            "poison_accepted=%s" % (hung, recompiles, poison_accepted))
+        return 1
+    log("chaos: ok — every future resolved, 0 recompiles")
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--model", default="mlp",
@@ -133,6 +253,10 @@ def main():
                     help="serve dp-replicated over this many devices")
     ap.add_argument("--int8", action="store_true",
                     help="add the weight-only int8 leg (same traffic)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="add the resilience leg (worker kill, failure "
+                         "burst, deadline storm, hot swap); exit 1 on "
+                         "any hung future or recompile")
     ap.add_argument("--cost", default="report",
                     choices=["off", "report", "check"])
     ap.add_argument("--seed", type=int, default=0)
@@ -160,9 +284,12 @@ def main():
                           "fp32_p99_ms": round(rep.p99_ms, 3),
                           "int8_p99_ms": round(rep8.p99_ms, 3)}),
               flush=True)
+    if args.chaos:
+        bad += run_chaos(net, sample_shape, args, mesh)
     if bad:
-        log("FAIL: %d post-warmup recompile(s) — steady-state serving "
-            "must be compile-free" % bad)
+        log("FAIL: %d post-warmup recompile(s) / chaos failure(s) — "
+            "steady-state serving must be compile-free and hang-free"
+            % bad)
         sys.exit(1)
 
 
