@@ -112,7 +112,8 @@ def _synth_recordio(image_size, n=512, img_fmt=".jpg"):
 
 def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
               compute_dtype="bfloat16", data="synthetic",
-              record_format=".jpg", s2d_stem=False, ghost_bn=0):
+              record_format=".jpg", s2d_stem=False, ghost_bn=0,
+              cost_device="tpu-v5e", proxy_extra=None):
     jax = setup_jax()
     import numpy as np
 
@@ -143,7 +144,8 @@ def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
     # number, so every BENCH round logs predicted-vs-measured drift
     step = make_train_step(net, loss_fn, optimizer="sgd", learning_rate=0.1,
                            momentum=0.9, wd=1e-4,
-                           compute_dtype=compute_dtype, cost="report")
+                           compute_dtype=compute_dtype, cost="report",
+                           cost_device=cost_device)
 
     if data == "recordio":
         # recordio feeds raw uint8 batches (ImageRecordUInt8Iter) — compile
@@ -231,6 +233,7 @@ def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
         log("chunk %d: %d iters in %.3fs -> %.1f img/s (step %.1f ms)"
             % (c, chunk_iters, dt, img_s, 1e3 * dt / chunk_iters))
         extra = {"batch": batch_size, "dtype": compute_dtype, "data": data,
+                 "backend": jax.default_backend(),
                  "s2d_stem": bool(s2d_stem),
                  "bn": ("ghost%d" % ghost_bn) if ghost_bn else "batch",
                  "step_ms": round(1e3 / (best / batch_size), 2),
@@ -240,6 +243,11 @@ def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
                  "compile_s": round(times["compile"], 1),
                  "chunks_done": c + 1}
         extra.update(pred)
+        if proxy_extra:
+            # CPU-proxy mode (TPU unreachable): the record says so
+            # EXPLICITLY — relative numbers, never bare zeros that read
+            # as a 100 % regression (the BENCH r04/r05 failure mode)
+            extra.update(proxy_extra)
         emit(metric, best, "img/s", BASELINE_IMG_S, extra)
     return best
 
@@ -607,14 +615,45 @@ def main():
     setup_jax()
     log("probing backend...")
     devices, backend_err = _backend_alive()
+    proxy_extra = None
     if devices is None:
+        # TPU unreachable (dead tunnel, stolen chip): degrade to the
+        # CPU-mesh PROXY mode — relative numbers with an explicit
+        # backend/tpu_unavailable stamp, never silent zeros (BENCH
+        # r04/r05 recorded 0 during the tunnel outage and looked like a
+        # 100 % regression).  docs/PERF.md §Autotuning "CPU-proxy".
         log("backend probe failed: %s" % backend_err)
+        log("falling back to the CPU-proxy backend (relative numbers)")
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        except Exception as e:  # noqa: BLE001
+            log("could not force the cpu platform: %r" % e)
+        devices, cpu_err = _backend_alive(timeout_s=120)
+        if devices is None:
+            # even the CPU backend is gone: the explicit-error record
+            # is all that is left — still stamped, still parseable
+            metric = ("flash_attention_ms" if args.mode == "attention"
+                      else "resnet50_train_img_per_sec")
+            emit(metric, 0.0, "ms" if args.mode == "attention" else "img/s",
+                 BASELINE_IMG_S, {"error": backend_err,
+                                  "cpu_proxy_error": cpu_err,
+                                  "backend": "none",
+                                  "tpu_unavailable": True})
+            sys.exit(1)
+        proxy_extra = {"backend": "cpu-proxy", "tpu_unavailable": True,
+                       "relative_only": True,
+                       "tpu_error": str(backend_err)[:200]}
+    log("backend ok: %s" % (devices,))
+    if proxy_extra and args.mode != "train":
+        # non-train modes have no reduced proxy leg: emit the explicit
+        # unavailability record instead of burning the budget on CPU
         metric = ("flash_attention_ms" if args.mode == "attention"
                   else "resnet50_train_img_per_sec")
         emit(metric, 0.0, "ms" if args.mode == "attention" else "img/s",
-             BASELINE_IMG_S, {"error": backend_err})
+             BASELINE_IMG_S, dict(proxy_extra, error=backend_err))
         sys.exit(1)
-    log("backend ok: %s" % (devices,))
 
     if args.mode == "attention":
         run_attention()
@@ -651,6 +690,24 @@ def main():
                                 cfg.get("measured", "?")))
         except Exception as e:  # noqa: BLE001
             log("bench_config.json unreadable (%r) — stock config" % e)
+
+    if proxy_extra:
+        # reduced proxy workload: same model/step wiring, sized so a
+        # CPU can finish it — the drift fields (graftcost cost="report"
+        # against the cpu-proxy device spec) stay populated
+        try:
+            run_train(batch_size=args.batch or 16,
+                      image_size=min(args.image_size, 64),
+                      chunks=min(args.chunks, 2), chunk_iters=2,
+                      data="synthetic", s2d_stem=s2d_stem,
+                      ghost_bn=ghost_bn, cost_device="cpu-proxy",
+                      proxy_extra=proxy_extra)
+        except Exception as e:  # noqa: BLE001
+            log("cpu-proxy train leg failed: %r" % e)
+            emit("resnet50_train_img_per_sec", 0.0, "img/s",
+                 BASELINE_IMG_S, dict(proxy_extra, error=str(e)[:200]))
+            sys.exit(1)
+        return
 
     batches = (args.batch,) if args.batch else (256, 128, 64, 32)
     err = None

@@ -40,7 +40,17 @@ semantics):
   config without a memory win).  Wired into every fused step via
   ``make_train_step(..., cost="report"|"check", hbm_budget=)`` /
   ``MXTPU_COST``, plus the ``tools/graftcost.py`` CLI.
+- **autotune (the search on top)**: :mod:`.autotune` closes the loop —
+  cost-model-ranked candidate search over the train-step knob space or
+  the serving (bucket set, flush deadline) policies, GL201 eager
+  rejection with zero compiles, top-K measured refinement through the
+  persistent compile cache (``parallel/aot.py``), and a learned
+  residual re-ranking on predicted-vs-measured drift
+  (:func:`autotune_train`, :func:`autotune_serve`,
+  ``tools/autotune.py``; docs/PERF.md §Autotuning).
 """
+from .autotune import (Candidate, TuningResult, autotune_serve,
+                       autotune_train, fit_residual, spearman)
 from .cost_model import (DEVICE_SPECS, CostReport, DeviceSpec,
                          analyze_jaxpr, analyze_traceable, check_cost)
 from .diagnostics import (CODES, Diagnostic, LintError, LintReport,
@@ -57,15 +67,17 @@ from .trace_lint import (check_inference_param_donation,
                          validate_permutation)
 
 __all__ = [
-    "CODES", "CostReport", "DEVICE_SPECS", "DeviceSpec", "Diagnostic",
+    "CODES", "Candidate", "CostReport", "DEVICE_SPECS", "DeviceSpec",
+    "Diagnostic",
     "LintError", "LintReport", "Severity", "analyze_jaxpr",
-    "analyze_traceable",
+    "analyze_traceable", "autotune_serve", "autotune_train",
     "check_checkpoint_without_iter_state", "check_cost",
     "check_inference_param_donation",
     "check_legacy_checkpoint_path",
     "check_partition_spec", "check_permutation",
     "check_process_local_ckpt_dir", "check_swap_compatibility",
-    "check_zero_state_shardings", "code_matches", "lint_jaxpr",
+    "check_zero_state_shardings", "code_matches", "fit_residual",
+    "lint_jaxpr",
     "lint_paths", "lint_source", "lint_traceable", "recompile_probe",
-    "validate_permutation",
+    "spearman", "validate_permutation",
 ]

@@ -175,6 +175,19 @@ _decl("MXTPU_COST", str, "off",
       "memory over hbm_budget) before any compile, 'off' (default) "
       "skips the walk.  Overridden per step by make_train_step(cost=).")
 
+_decl("MXTPU_COMPILE_CACHE", str, "",
+      "Directory for the persistent compiled-executable cache "
+      "(parallel/aot.py CompileCache): every AOT build through "
+      "compile_timed consults it before paying lowered.compile(), so a "
+      "restart or retune pays trace-but-not-compile across processes. "
+      "Keyed by (lowered program, mesh shape+axes, knobs, jax/jaxlib "
+      "version, backend); corrupt entries recompile with a warning. "
+      "Empty (default) = off.  Entries are pickles — trusted dirs only.")
+
+_decl("MXTPU_COMPILE_CACHE_MB", int, 512,
+      "Size cap (MiB) for MXTPU_COMPILE_CACHE; least-recently-used "
+      "entries are swept past it (parallel/aot.py CompileCache._sweep).")
+
 _decl("MXNET_BACKWARD_DO_MIRROR", str, "",
       "Gradient recompute (memory mirror, src/nnvm/gradient.cc): when "
       "truthy, every HybridBlock without a remat-active ancestor wraps its "

@@ -18,14 +18,59 @@ serving engine (``serve/engine.py``) — and both follow the same ritual:
 This module is the ONE copy of that ritual.  The builders keep their
 own policy (what counts as an extra diagnostic, when to mark
 themselves linted); the mechanics live here.
+
+It also owns the **persistent on-disk compile cache**
+(:class:`CompileCache`): every AOT build routed through
+:func:`compile_timed` can consult a directory of serialized XLA
+executables keyed by (lowered-program hash, mesh shape + axis names,
+builder knobs, jax/jaxlib version, backend + device count) before
+paying ``lowered.compile()`` — so a retune or a restart pays
+trace-but-not-compile across *processes*, not just within one.  Writes
+are atomic (temp + fsync + rename, the ``CheckpointManager``
+discipline, through the same ``checkpoint._write_bytes`` choke point
+``fault_injection.fail_writes`` interposes); corrupt or stale entries
+degrade to a recompile with a warning, never a crash and never a wrong
+executable; the directory is LRU-swept to a byte cap.  Resolution:
+explicit ``cache=`` argument > ``MXTPU_COMPILE_CACHE`` env
+(``config.py``) > off.  :data:`XLA_COMPILES` counts real
+``lowered.compile()`` invocations — the "0 XLA compiles on a warm
+cache" contract the autotuner's tests assert.
 """
 from __future__ import annotations
 
+import os
 import time
-from typing import Dict, Iterable, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterable, Optional, Sequence, Tuple
 
-__all__ = ["compile_timed", "finish_lint", "lint_served_program",
+__all__ = ["CompileCache", "XLA_COMPILES", "compile_timed",
+           "default_compile_cache", "finish_lint", "lint_served_program",
            "resolve_mode", "traced_with_effects"]
+
+
+class _CompileCounter:
+    """Process-wide count of real XLA ``lowered.compile()`` calls made
+    through :func:`compile_timed` (cache hits do NOT increment it).
+    Incremented under a lock — batcher workers compile post-warmup
+    bucket programs concurrently with main-thread builds, and a lost
+    increment would let a real compile escape the warm-cache "0 XLA
+    compiles" assertions (the same hazard serve/batcher.py's stats
+    counters lock against)."""
+
+    __slots__ = ("count", "_lock")
+
+    def __init__(self):
+        import threading
+
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+
+
+#: the one instance every builder shares
+XLA_COMPILES = _CompileCounter()
 
 
 def resolve_mode(value: Optional[str], env_var: str, default: str,
@@ -119,15 +164,255 @@ def lint_served_program(traced, effects, args: tuple,
                        stacklevel=stacklevel)
 
 
-def compile_timed(traced, t_trace: float = 0.0) -> Tuple[object,
-                                                         Dict[str, float]]:
+class CompileCache:
+    """Persistent on-disk cache of compiled XLA executables.
+
+    Entries are pickled ``jax.experimental.serialize_executable``
+    payloads under ``<directory>/<key>.xc``; the key (sha256) covers
+    the LOWERED program text (which embeds shapes, dtypes and GSPMD
+    shardings), the caller's ``extra`` tuple (mesh shape + axis names,
+    builder knobs), the jax + jaxlib versions, and the backend platform
+    / device-count / device-kind — anything that could make a stored
+    executable wrong for the process loading it.  A key-or-version
+    mismatch inside a loaded entry, an unpicklable blob, or a torn file
+    all take the same path: warn, drop the entry, recompile.
+
+    Entries are pickles: point the cache only at directories you trust
+    (the same standing as ``.jax_cache/`` and checkpoint dirs).
+    """
+
+    #: bump to orphan every existing entry on a format change
+    VERSION = 1
+    _SUFFIX = ".xc"
+
+    def __init__(self, directory: str, max_bytes: int = 512 << 20):
+        import threading
+
+        self.directory = str(directory)
+        self.max_bytes = int(max_bytes)
+        self.hits = 0
+        self.misses = 0
+        self.dropped = 0       # corrupt/stale entries evicted on load
+        self.store_failures = 0
+        self._unsupported = False  # backend refused serialization
+        # the env-default instance is shared across builder threads
+        # (batcher workers compile buckets concurrently)
+        self._lock = threading.Lock()
+
+    def _count(self, attr: str):
+        with self._lock:
+            setattr(self, attr, getattr(self, attr) + 1)
+
+    # -- key -----------------------------------------------------------
+    def key_for(self, lowered, extra: Sequence[Any] = ()) -> str:
+        """Cache key for one lowered program under the current backend."""
+        import hashlib
+
+        import jax
+        import jaxlib
+
+        h = hashlib.sha256()
+        h.update(lowered.as_text().encode())
+        devs = jax.devices()
+        h.update(repr((self.VERSION, jax.__version__, jaxlib.__version__,
+                       jax.default_backend(), len(devs),
+                       getattr(devs[0], "device_kind", "?"),
+                       tuple(extra))).encode())
+        return h.hexdigest()
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.directory, key + self._SUFFIX)
+
+    # -- load ----------------------------------------------------------
+    def load(self, key: str):
+        """The compiled executable for ``key``, or None (miss / corrupt
+        entry — corrupt entries are warned about and deleted so the
+        recompile's store can replace them)."""
+        import pickle
+
+        path = self._path(key)
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError:
+            self._count("misses")
+            return None
+        try:
+            from jax.experimental import serialize_executable as _se
+
+            payload = pickle.loads(blob)
+            if payload.get("key") != key \
+                    or payload.get("version") != self.VERSION:
+                raise ValueError("entry key/version mismatch")
+            compiled = _se.deserialize_and_load(
+                payload["exec"], payload["in_tree"], payload["out_tree"])
+        except Exception as e:  # noqa: BLE001 — ANY bad entry => recompile
+            import warnings
+
+            warnings.warn(
+                "compile cache: corrupt or stale entry %s (%s: %s) — "
+                "dropping it and recompiling" % (os.path.basename(path),
+                                                 type(e).__name__, e),
+                stacklevel=3)
+            self._count("dropped")
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return None
+        try:  # refresh LRU recency
+            os.utime(path)
+        except OSError:
+            pass
+        self._count("hits")
+        return compiled
+
+    # -- store ---------------------------------------------------------
+    def store(self, key: str, compiled) -> bool:
+        """Serialize + publish one entry atomically (temp + fsync +
+        rename through ``checkpoint._write_bytes`` — the choke point
+        ``fault_injection.fail_writes`` interposes).  Best-effort: any
+        failure warns and returns False; the caller already holds the
+        freshly-compiled executable."""
+        import pickle
+
+        if self._unsupported:
+            return False
+        try:
+            import jax
+            from jax.experimental import serialize_executable as _se
+
+            payload, in_tree, out_tree = _se.serialize(compiled)
+            blob = pickle.dumps({"version": self.VERSION, "key": key,
+                                 "jax": jax.__version__,
+                                 "exec": payload, "in_tree": in_tree,
+                                 "out_tree": out_tree})
+        except Exception as e:  # noqa: BLE001 — some backends can't serialize
+            import warnings
+
+            self._unsupported = True
+            self._count("store_failures")
+            warnings.warn("compile cache: this backend cannot serialize "
+                          "executables (%s: %s) — cache disabled for "
+                          "stores this process" % (type(e).__name__, e),
+                          stacklevel=3)
+            return False
+        from .checkpoint import _write_bytes
+
+        path = self._path(key)
+        tmp = path + ".tmp.%d" % os.getpid()
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            _write_bytes(tmp, blob)
+            os.replace(tmp, path)
+        except OSError as e:
+            import warnings
+
+            self._count("store_failures")
+            warnings.warn("compile cache: failed to store %s (%s) — "
+                          "continuing uncached" % (os.path.basename(path),
+                                                   e), stacklevel=3)
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return False
+        self._sweep()
+        return True
+
+    def _sweep(self):
+        """Size-capped LRU: drop oldest-touched entries (and stray temp
+        files) until the directory fits ``max_bytes``."""
+        try:
+            entries = []
+            with os.scandir(self.directory) as it:
+                for de in it:
+                    if de.name.endswith(self._SUFFIX):
+                        st = de.stat()
+                        entries.append((st.st_mtime, st.st_size, de.path))
+                    elif ".tmp." in de.name:
+                        # a crashed writer's stage file: never visible as
+                        # an entry, reap it past a grace period
+                        st = de.stat()
+                        if time.time() - st.st_mtime > 300:
+                            os.remove(de.path)
+        except OSError:
+            return
+        total = sum(s for _, s, _ in entries)
+        if total <= self.max_bytes:
+            return
+        for _, size, path in sorted(entries):
+            try:
+                os.remove(path)
+            except OSError:
+                continue
+            total -= size
+            if total <= self.max_bytes:
+                break
+
+
+_DEFAULT_CACHES: Dict[Tuple[str, int], CompileCache] = {}
+
+
+def default_compile_cache() -> Optional[CompileCache]:
+    """The env-configured cache (``MXTPU_COMPILE_CACHE`` directory,
+    ``MXTPU_COMPILE_CACHE_MB`` cap), or None when unset.  One
+    :class:`CompileCache` instance per (dir, cap) so hit/miss counters
+    aggregate across builders."""
+    from .. import config as _cfg
+
+    directory = str(_cfg.get("MXTPU_COMPILE_CACHE", "") or "").strip()
+    if not directory:
+        return None
+    cap = int(_cfg.get("MXTPU_COMPILE_CACHE_MB", 512)) << 20
+    key = (os.path.abspath(os.path.expanduser(directory)), cap)
+    cache = _DEFAULT_CACHES.get(key)
+    if cache is None:
+        cache = _DEFAULT_CACHES[key] = CompileCache(key[0], max_bytes=cap)
+    return cache
+
+
+def compile_timed(traced, t_trace: float = 0.0, *,
+                  cache: Optional[CompileCache] = None,
+                  cache_extra: Sequence[Any] = ()) -> Tuple[object,
+                                                            Dict[str, Any]]:
     """Lower + compile an already-traced program, returning
-    ``(compiled, {"trace": s, "compile": s})``.  ``t_trace`` is the
-    wall time the caller already spent tracing (lowering is part of
-    the trace phase — it is Python/JAX work, not XLA)."""
+    ``(compiled, {"trace": s, "compile": s, "cache": ...})``.
+    ``t_trace`` is the wall time the caller already spent tracing
+    (lowering is part of the trace phase — it is Python/JAX work, not
+    XLA).
+
+    When a :class:`CompileCache` is active (explicit ``cache=`` or the
+    ``MXTPU_COMPILE_CACHE`` env), the lowered program is looked up
+    first: a hit deserializes the stored executable and reports
+    ``compile: 0.0, cache: "hit"`` without touching XLA; a miss
+    compiles, bumps :data:`XLA_COMPILES` and stores the result
+    (``cache: "stored"``, or ``"store-failed"`` when serialization is
+    unavailable).  ``cache_extra`` feeds the key — pass mesh shape +
+    axis names and builder knobs so distinct configs can never collide.
+    """
     t0 = time.time()
     lowered = traced.lower()
     t_trace = t_trace + (time.time() - t0)
+    if cache is None:
+        cache = default_compile_cache()
+    times: Dict[str, Any] = {"trace": t_trace}
+    key = None
+    if cache is not None:
+        key = cache.key_for(lowered, extra=cache_extra)
+        times["cache_key"] = key
+        hit = cache.load(key)
+        if hit is not None:
+            times["cache"] = "hit"
+            times["compile"] = 0.0
+            return hit, times
     t0 = time.time()
     compiled = lowered.compile()
-    return compiled, {"trace": t_trace, "compile": time.time() - t0}
+    XLA_COMPILES.bump()
+    times["compile"] = time.time() - t0
+    if cache is not None:
+        times["cache"] = "stored" if cache.store(key, compiled) \
+            else "store-failed"
+    else:
+        times["cache"] = "off"
+    return compiled, times

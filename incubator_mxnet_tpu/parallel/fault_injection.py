@@ -68,7 +68,8 @@ from typing import Optional
 import numpy as np
 
 __all__ = ["NaNInjector", "burst_arrivals", "coordinator_unreachable",
-           "corrupt_checkpoint", "deadline_storm", "engine_failure_burst",
+           "corrupt_checkpoint", "corrupt_compile_cache", "deadline_storm",
+           "engine_failure_burst",
            "fail_writes", "flaky_reads", "host_loss_during_save",
            "kill_batcher_worker",
            "kill_process", "kill_worker", "malformed_request",
@@ -296,6 +297,40 @@ def corrupt_checkpoint(directory, step=None, what="bitflip", which=0):
     else:
         raise ValueError("what must be 'bitflip', 'truncate', 'manifest' "
                          "or 'torn_manifest', got %r" % (what,))
+    return path
+
+
+def corrupt_compile_cache(directory, what="truncate", which=0):
+    """Damage a persistent compile-cache entry (``parallel/aot.py``
+    ``CompileCache``) in place; returns the path touched.
+
+    ``what``: ``"truncate"`` halves the entry (torn write — what a
+    crash mid-publish would leave if the atomic rename discipline were
+    ever broken); ``"bitflip"`` flips one bit mid-payload (silent
+    corruption); ``"garbage"`` replaces the whole entry with
+    non-pickle bytes.  Every case must degrade to
+    recompile-with-warning: never a crash, never a wrong executable.
+    """
+    names = sorted(n for n in os.listdir(str(directory))
+                   if n.endswith(".xc"))
+    if not names:
+        raise ValueError("no compile-cache entries under %r" % (directory,))
+    path = os.path.join(str(directory), names[int(which) % len(names)])
+    if what == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(max(os.path.getsize(path) // 2, 1))
+    elif what == "bitflip":
+        with open(path, "r+b") as f:
+            data = bytearray(f.read())
+            data[len(data) // 2] ^= 0x10
+            f.seek(0)
+            f.write(data)
+    elif what == "garbage":
+        with open(path, "wb") as f:
+            f.write(b"not a cache entry")
+    else:
+        raise ValueError("what must be 'truncate', 'bitflip' or "
+                         "'garbage', got %r" % (what,))
     return path
 
 

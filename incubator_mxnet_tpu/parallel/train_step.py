@@ -109,7 +109,12 @@ class FunctionalOptimizer:
         parameter dtype."""
         if self.multi_precision:
             def w32(p):
-                return p.astype(jnp.float32)
+                # force a DISTINCT buffer: astype is a no-op for f32
+                # params, and a master weight aliasing the param buffer
+                # makes the donated step execute-fail ("attempt to
+                # donate the same buffer twice" — both live in the
+                # donated argnums)
+                return jnp.array(p, dtype=jnp.float32, copy=True)
 
             def z32(p):
                 return jnp.zeros(p.shape, jnp.float32)
@@ -1217,7 +1222,21 @@ class TrainStep:
                         yv, self.mesh, batch_sh.spec))
         return jax.device_put(xv, batch_sh), jax.device_put(yv, batch_sh)
 
-    def aot_compile(self, x, y):
+    def _cache_extra(self):
+        """This step's contribution to the compile-cache key (beyond the
+        lowered program itself): mesh shape + axis names and the knob
+        set, so two configs that somehow lower alike still key apart."""
+        mesh = None if self.mesh is None else \
+            tuple(sorted((str(a), int(s))
+                         for a, s in dict(self.mesh.shape).items()))
+        return ("train_step", mesh, self.batch_axis, self.zero,
+                self.pipeline_stages, self.num_micro,
+                bool(self.pipeline_remat), bool(self._donate),
+                self.opt.name, bool(self.opt.multi_precision),
+                str(self.compute_dtype), self.nonfinite,
+                self._dynamic_scale)
+
+    def aot_compile(self, x, y, cache=None):
         """Ahead-of-time trace + lower + compile the fused step for the given
         batch, returning per-phase wall seconds ``{"trace": s, "compile": s}``.
 
@@ -1227,6 +1246,11 @@ class TrainStep:
         where startup time goes.  The compiled executable is installed as
         this step's callable, so subsequent ``step(x, y)`` calls with the
         same shapes skip compilation.
+
+        ``cache`` is an optional :class:`~.aot.CompileCache` (default:
+        the ``MXTPU_COMPILE_CACHE`` env) — on a warm cache the XLA
+        compile is skipped entirely (``times["cache"] == "hit"``,
+        ``times["compile"] == 0.0``), even in a fresh process.
         """
         import time as _time
 
@@ -1254,7 +1278,9 @@ class TrainStep:
                                   (p_vals, aux_vals, self._opt_state, xv,
                                    yv, self._key_dev, self._step_dev,
                                    self._scaler_dev))
-        compiled, times = compile_timed(traced, t_trace=_time.time() - t0)
+        compiled, times = compile_timed(traced, t_trace=_time.time() - t0,
+                                        cache=cache,
+                                        cache_extra=self._cache_extra())
         self._compiled = compiled
         self._compiled_key = ((xv.shape, str(xv.dtype)),
                               (yv.shape, str(yv.dtype)))

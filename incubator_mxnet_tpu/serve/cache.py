@@ -278,9 +278,17 @@ class CachedDecoder:
     def _compiled(self, kind, jit_obj, args, key):
         prog = self._programs.get(key)
         if prog is None:
+            from ..parallel.aot import compile_timed
+
             traced = self._lint_program(
                 jit_obj, args, "CachedDecoder %s %r" % (kind, key))
-            prog = traced.lower().compile()
+            # routed through the shared AOT choke point so the
+            # persistent compile cache (MXTPU_COMPILE_CACHE) covers the
+            # prefill/step programs too; self.compiles keeps counting
+            # PROGRAM builds (the compiles==2 contract), cache-hit or not
+            prog, _ = compile_timed(traced,
+                                    cache_extra=("cached_decoder", kind,
+                                                 key))
             self._programs[key] = prog
             self.compiles += 1
         return prog

@@ -392,7 +392,15 @@ class ServeEngine:
         traced, effects = traced_with_effects(
             jit_obj, args, capture=self.lint != "off" and not self._linted)
         self._maybe_lint(traced, effects, args, bucket)
-        prog, times = compile_timed(traced, t_trace=time.time() - t0)
+        # the persistent compile cache (MXTPU_COMPILE_CACHE) rides the
+        # same choke point the train step uses: a warmed server restart
+        # pays trace-but-not-compile per bucket program
+        mesh_desc = None if self.mesh is None else \
+            tuple(sorted((str(a), int(s))
+                         for a, s in dict(self.mesh.shape).items()))
+        prog, times = compile_timed(traced, t_trace=time.time() - t0,
+                                    cache_extra=("serve_engine", mesh_desc,
+                                                 key))
         self._programs[key] = prog
         self.compile_log[key] = times
         return prog

@@ -72,6 +72,18 @@ class LoadReport:
         d["occupancy"] = {str(k): v for k, v in self.occupancy.items()}
         return d
 
+    def objective(self) -> float:
+        """The autotuner's scalar score for one measured serving policy
+        (``analysis/autotune.py`` serve target; seconds, lower is
+        better): the p99 latency, with a 1 s penalty per failed-service
+        outcome (error / expired / hung / breaker-shed — a policy that
+        drops work must never look "fast") and 100 ms per
+        submit-shed request (offered load the queue refused).  Relative
+        numbers on a CPU mesh — compare within one run only."""
+        failures = self.errors + self.expired + self.hung \
+            + self.breaker_shed
+        return (self.p99_ms / 1e3) + 1.0 * failures + 0.1 * self.shed
+
     def format(self) -> str:
         occ = " ".join("%d:%d" % kv for kv in sorted(self.occupancy.items()))
         s = ("loadtest: %d req in %.2fs — %.1f qps sustained "

@@ -1,0 +1,413 @@
+"""Search-based autotuner + persistent compile cache (ROADMAP item 2).
+
+Contracts under test (analysis/autotune.py, parallel/aot.py):
+
+- candidate RANKING is pure graftcost: the tuner's predicted
+  seconds-per-sample equal an independent ``analyze_cost`` of the same
+  config (golden agreement, Dense model);
+- GL201-infeasible candidates are pruned EAGERLY: zero XLA compiles
+  spent, the built step's ``_compiled is None``, the rejection reason
+  names GL201;
+- measured refinement touches exactly ``budget_compiles`` candidates
+  and the JSON tuning log accounts for 100 % of the space;
+- the learned residual strictly improves rank correlation on a
+  synthetic drift set whose roofline ranking is wrong;
+- a warm persistent compile cache makes an identical (lowered program,
+  mesh, knobs) build perform 0 XLA compiles — in-process AND from a
+  fresh subprocess — with bit-identical results;
+- a torn/corrupt/garbage cache entry degrades to recompile-with-warning
+  (never a crash, never a wrong executable), and a failed store
+  (``fault_injection.fail_writes`` riding the CheckpointManager
+  byte-writer) leaves the step working uncached.
+
+Measured-refinement soaks beyond the minimal contract are marked
+``slow`` — tier-1 is at its 870 s budget ceiling.
+"""
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, nd
+from incubator_mxnet_tpu.analysis import (autotune_serve, autotune_train,
+                                          fit_residual, spearman)
+from incubator_mxnet_tpu.analysis.autotune import (apply_residual,
+                                                   backend_status,
+                                                   default_serve_space,
+                                                   default_train_space,
+                                                   dense_workload)
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.parallel import aot, make_train_step
+from incubator_mxnet_tpu.parallel import fault_injection as fi
+from incubator_mxnet_tpu.parallel.distributed import collectives_supported
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: a budget between the Dense workload's batch-8 peak (~15.5 KB) and its
+#: batch-32 peak (~24.6 KB): splits the space into feasible + GL201
+SPLIT_BUDGET = 20_000
+
+
+def _dense_step(batch=8, optimizer="sgd", **kw):
+    mk, mb, loss_fn = dense_workload()
+    knobs = {"batch": batch}
+    net = mk(knobs)
+    if optimizer == "sgd":
+        kw.setdefault("momentum", 0.9)
+    step = make_train_step(net, loss_fn, optimizer=optimizer,
+                           learning_rate=0.1, lint="off",
+                           cost="off", **kw)
+    x, y = mb(knobs)
+    return step, x, y
+
+
+# ---------------------------------------------------------------------------
+# ranking + pruning + accounting
+# ---------------------------------------------------------------------------
+
+def test_ranking_matches_graftcost_golden():
+    """The tuner's predicted score is exactly graftcost's roofline
+    step-time over the batch — computed independently per config."""
+    space = [{"batch": b, "zero": 0, "multi_precision": False,
+              "loss_scale": None, "pipeline_stages": None,
+              "num_micro": 1, "pipeline_remat": False}
+             for b in (8, 16, 32)]
+    res = autotune_train(space=space, device="cpu-proxy",
+                         budget_compiles=0)
+    assert [c.status for c in res.candidates] == ["predicted"] * 3
+    for c in res.candidates:
+        step, x, y = _dense_step(batch=c.knobs["batch"])
+        rep = step.analyze_cost(x, y, device="cpu-proxy")
+        golden = rep.roofline()["step_s"] / c.knobs["batch"]
+        assert c.pred_sps == pytest.approx(golden, rel=1e-9), c.knobs
+    # and the ranking follows: bigger batch amortizes better per sample
+    scores = [c.pred_sps for c in res.candidates]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_conv_bn_ranking_matches_golden():
+    """Same golden agreement on the conv-bn model (the second
+    graftcost test net) through the CLI's workload builder."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from autotune import _conv_bn_workload
+    finally:
+        sys.path.pop(0)
+    mk, mb, loss_fn = _conv_bn_workload()
+    space = [{"batch": b, "zero": 0, "multi_precision": False,
+              "loss_scale": None, "pipeline_stages": None,
+              "num_micro": 1, "pipeline_remat": False} for b in (4, 8)]
+    res = autotune_train(mk, mb, loss_fn, space=space, device="cpu-proxy",
+                         budget_compiles=0)
+    from incubator_mxnet_tpu.parallel import make_train_step as mts
+
+    for c in res.candidates:
+        assert c.status == "predicted"
+        net = mk(c.knobs)
+        step = mts(net, loss_fn, optimizer="sgd", learning_rate=0.1,
+                   momentum=0.9, lint="off", cost="off")
+        x, y = mb(c.knobs)
+        rep = step.analyze_cost(x, y, device="cpu-proxy")
+        golden = rep.roofline()["step_s"] / c.knobs["batch"]
+        assert c.pred_sps == pytest.approx(golden, rel=1e-9)
+
+
+def test_gl201_pruned_with_zero_compiles():
+    """Infeasible candidates are rejected at trace time: no compile is
+    ever paid for them, and the step they were costed on never owned an
+    executable (``_compiled is None``)."""
+    c0 = aot.XLA_COMPILES.count
+    res = autotune_train(device="cpu-proxy", hbm_budget=SPLIT_BUDGET,
+                         budget_compiles=0)
+    rejected = [c for c in res.candidates
+                if c.status == "rejected-infeasible"]
+    feasible = [c for c in res.candidates if c.status == "predicted"]
+    assert rejected and feasible, \
+        [c.status for c in res.candidates]  # the budget splits the space
+    assert aot.XLA_COMPILES.count == c0  # ZERO compiles spent
+    for c in rejected:
+        assert c.zero_compile is True
+        assert "GL201" in c.reason
+        assert c.pred["peak_bytes"] > SPLIT_BUDGET
+    # the direct form: an over-budget step is rejected pre-compile
+    step, x, y = _dense_step(batch=32)
+    rep = step.analyze_cost(x, y, device="cpu-proxy",
+                            hbm_budget=SPLIT_BUDGET)
+    assert any(d.code == "GL201" for d in rep.diagnostics)
+    assert step._compiled is None
+    assert aot.XLA_COMPILES.count == c0
+
+
+def test_measured_refinement_budget_and_log_accounting(tmp_path):
+    """budget_compiles bounds the measured set; every candidate lands
+    in the JSON log with a prediction and a measurement-or-reason."""
+    log = str(tmp_path / "tuning.json")
+    c0 = aot.XLA_COMPILES.count
+    res = autotune_train(device="cpu-proxy", hbm_budget=SPLIT_BUDGET,
+                         budget_compiles=2, warmup=1, iters=1,
+                         log_path=log)
+    measured = [c for c in res.candidates if c.status == "measured"]
+    assert len(measured) == 2
+    assert res.compiles_spent == aot.XLA_COMPILES.count - c0 <= 2
+    assert res.accounted()
+    assert res.winner is not None and res.winner in measured
+    assert res.winner.measured_sps == min(c.measured_sps for c in measured)
+    d = json.loads(open(log).read())
+    assert d["accounted"] is True
+    assert d["space_size"] == len(res.candidates)
+    statuses = {c["status"] for c in d["candidates"]}
+    assert "pending" not in statuses
+    for c in d["candidates"]:
+        if c["status"].startswith("rejected"):
+            assert c["reason"]
+        if c["status"] == "measured":
+            assert c["measured_s_per_sample"] is not None
+    # the never-silence stamp: off-TPU results say so explicitly
+    backend, unavailable = backend_status()
+    assert d["backend"] == backend
+    assert d["tpu_unavailable"] is unavailable is True  # CPU suite
+    assert d["relative_only"] is True
+
+
+# ---------------------------------------------------------------------------
+# the learned residual
+# ---------------------------------------------------------------------------
+
+def test_residual_improves_rank_correlation_on_synthetic_drift():
+    """A drift set whose true cost model (measured = 0.2*compute +
+    3*hbm + const) disagrees with the max() roofline ranking: the
+    fitted per-category correction must strictly improve Spearman."""
+    rng = np.random.RandomState(7)
+    preds, measured, naive = [], [], []
+    # compute-heavy candidates look slow to the roofline but are cheap
+    # in truth; hbm-heavy ones the reverse
+    for compute_ms, hbm_ms in [(10, 1), (8, 2), (6, 3), (1, 8), (2, 7),
+                               (3, 6), (5, 4), (4, 5)]:
+        p = {"compute_s": compute_ms / 1e3, "hbm_s": hbm_ms / 1e3,
+             "comm_s": 0.0}
+        preds.append(p)
+        naive.append(max(p["compute_s"], p["hbm_s"]))
+        measured.append(0.2 * p["compute_s"] + 3.0 * p["hbm_s"] + 1e-3
+                        + rng.uniform(0, 1e-5))
+    beta = fit_residual(preds, measured)
+    assert beta is not None
+    corrected = [apply_residual(beta, p) for p in preds]
+    s_naive = spearman(naive, measured)
+    s_corr = spearman(corrected, measured)
+    assert s_corr > s_naive, (s_naive, s_corr)
+    assert s_corr > 0.95
+
+
+def test_residual_underdetermined_returns_none():
+    assert fit_residual([{"compute_s": 1.0, "hbm_s": 1.0, "comm_s": 0.0}],
+                        [1.0]) is None
+    assert apply_residual(None, {"compute_s": 1.0}) is None
+
+
+def test_spearman_basics():
+    assert spearman([1, 2, 3], [10, 20, 30]) == pytest.approx(1.0)
+    assert spearman([1, 2, 3], [30, 20, 10]) == pytest.approx(-1.0)
+    assert spearman([1.0, 1.0, 1.0], [1, 2, 3]) == 0.0  # degenerate
+    assert spearman([1], [1]) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# persistent compile cache
+# ---------------------------------------------------------------------------
+
+def test_warm_cache_zero_compiles_in_process(tmp_path):
+    """Second build of an identical (lowered program, mesh, knobs) key:
+    0 XLA compiles, bit-identical results."""
+    cache = aot.CompileCache(str(tmp_path))
+    step, x, y = _dense_step()
+    t1 = step.aot_compile(x, y, cache=cache)
+    assert t1["cache"] == "stored"
+    loss_ref = float(step(x, y).asscalar())
+
+    step2, x2, y2 = _dense_step()
+    c0 = aot.XLA_COMPILES.count
+    t2 = step2.aot_compile(x2, y2, cache=cache)
+    assert t2["cache"] == "hit"
+    assert t2["compile"] == 0.0
+    assert aot.XLA_COMPILES.count == c0  # 0 XLA compiles
+    assert float(step2(x2, y2).asscalar()) == loss_ref  # bit-identical
+    assert cache.hits == 1
+
+
+def test_warm_cache_zero_compiles_cross_process(tmp_path):
+    """A fresh PROCESS rebuilding the same key performs 0 XLA compiles
+    and returns bit-identical results (the restart/retune contract)."""
+    if not collectives_supported():
+        pytest.skip("backend cannot run the subprocess leg")
+    cache = aot.CompileCache(str(tmp_path))
+    step, x, y = _dense_step()
+    assert step.aot_compile(x, y, cache=cache)["cache"] == "stored"
+    loss_ref = float(step(x, y).asscalar())
+
+    child = subprocess.run(
+        [sys.executable, "-c", """
+import sys, json
+sys.path.insert(0, %r)
+from _platform_pin import pin_cpu
+jax = pin_cpu(8)
+# conftest.py sets this in the parent; the lowered text (and so the
+# cache key) depends on it
+jax.config.update("jax_default_matmul_precision", "highest")
+from tests.test_autotune import _dense_step
+from incubator_mxnet_tpu.parallel import aot
+step, x, y = _dense_step()
+t = step.aot_compile(x, y)
+print(json.dumps({"cache": t["cache"], "compiles": aot.XLA_COMPILES.count,
+                  "loss": float(step(x, y).asscalar())}))
+""" % REPO],
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 MXTPU_COMPILE_CACHE=str(tmp_path)),
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert child.returncode == 0, child.stderr[-2000:]
+    rec = json.loads(child.stdout.strip().splitlines()[-1])
+    assert rec["cache"] == "hit"
+    assert rec["compiles"] == 0  # ZERO XLA compiles in the new process
+    assert rec["loss"] == loss_ref  # bit-identical across processes
+
+
+def test_corrupt_cache_entry_recompiles_with_warning(tmp_path):
+    """Torn, bit-flipped and garbage entries: recompile-with-warning,
+    bit-identical results, never a crash, never a wrong executable."""
+    cache = aot.CompileCache(str(tmp_path))
+    step, x, y = _dense_step()
+    step.aot_compile(x, y, cache=cache)
+    loss_ref = float(step(x, y).asscalar())
+    for what in ("truncate", "garbage"):
+        fi.corrupt_compile_cache(tmp_path, what=what)
+        step2, x2, y2 = _dense_step()
+        c0 = aot.XLA_COMPILES.count
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            t = step2.aot_compile(x2, y2, cache=cache)
+        assert any("corrupt or stale" in str(x.message) for x in w), \
+            (what, [str(x.message) for x in w])
+        assert aot.XLA_COMPILES.count == c0 + 1  # really recompiled
+        assert t["cache"] == "stored"  # the bad entry was replaced
+        assert float(step2(x2, y2).asscalar()) == loss_ref
+
+
+def test_cache_store_failure_degrades_to_uncached(tmp_path):
+    """fail_writes through the CheckpointManager byte-writer: the store
+    fails loudly-but-harmlessly; the freshly-compiled step still runs."""
+    cache = aot.CompileCache(str(tmp_path))
+    step, x, y = _dense_step()
+    with fi.fail_writes(at=0, count=10):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            t = step.aot_compile(x, y, cache=cache)
+    assert t["cache"] == "store-failed"
+    assert any("failed to store" in str(x.message) for x in w)
+    assert np.isfinite(float(step(x, y).asscalar()))
+    assert not [n for n in os.listdir(tmp_path) if n.endswith(".xc")]
+
+
+def test_cache_lru_sweep_is_size_capped(tmp_path):
+    """Entries past max_bytes are LRU-swept (oldest mtime first)."""
+    cache = aot.CompileCache(str(tmp_path))  # generous: both fit
+    step, x, y = _dense_step()
+    step.aot_compile(x, y, cache=cache)
+    entry = [n for n in os.listdir(tmp_path) if n.endswith(".xc")]
+    assert len(entry) == 1
+    size = os.path.getsize(tmp_path / entry[0])
+    # re-cap under one entry and store a DIFFERENT program (adam)
+    os.utime(tmp_path / entry[0], (1, 1))  # make the first entry oldest
+    cache.max_bytes = size
+    step2, x2, y2 = _dense_step(optimizer="adam")
+    step2.aot_compile(x2, y2, cache=cache)
+    left = [n for n in os.listdir(tmp_path) if n.endswith(".xc")]
+    assert entry[0] not in left  # the old entry was evicted
+    total = sum(os.path.getsize(tmp_path / n) for n in left)
+    assert total <= size
+
+
+def test_cache_key_separates_knobs(tmp_path):
+    """Different knob sets never collide: sgd and adam steps of the
+    same net produce distinct entries."""
+    cache = aot.CompileCache(str(tmp_path))
+    step, x, y = _dense_step()
+    step.aot_compile(x, y, cache=cache)
+    step2, x2, y2 = _dense_step(optimizer="adam")
+    t = step2.aot_compile(x2, y2, cache=cache)
+    assert t["cache"] == "stored"  # not a (wrong) hit
+    assert len([n for n in os.listdir(tmp_path)
+                if n.endswith(".xc")]) == 2
+
+
+def test_multi_precision_f32_master_weights_distinct_buffer():
+    """Regression: multi_precision with f32 params used to alias the
+    master weight onto the param buffer (astype no-op), making every
+    donated step fail at execute with 'donate the same buffer twice'."""
+    step, x, y = _dense_step(multi_precision=True)
+    step.aot_compile(x, y)
+    loss = step(x, y)  # raised XlaRuntimeError before the fix
+    assert np.isfinite(float(loss.asscalar()))
+
+
+def test_loadtest_objective_penalizes_failures():
+    from incubator_mxnet_tpu.serve.loadtest import LoadReport
+
+    clean = LoadReport(p99_ms=50.0)
+    assert clean.objective() == pytest.approx(0.05)
+    dirty = LoadReport(p99_ms=50.0, errors=1, expired=1, shed=2)
+    assert dirty.objective() == pytest.approx(0.05 + 2.0 + 0.2)
+    assert dirty.objective() > clean.objective()
+
+
+def test_default_spaces_shape():
+    assert len(default_train_space({"dp": 8})) == 24
+    assert len(default_train_space({})) == 12  # no dp => no zero knobs
+    pp = default_train_space({"dp": 2, "pp": 4})
+    assert any(c["pipeline_stages"] == 4 for c in pp)
+    assert all(len(set(c["buckets"])) == len(c["buckets"])
+               for c in default_serve_space())
+
+
+# ---------------------------------------------------------------------------
+# slow soaks (tier-1 is at its budget ceiling)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_autotune_winner_beats_default_on_dp_mesh():
+    """The acceptance sweep: ≥24 candidates on the 8-dev dp mesh,
+    GL201 pruning, top-K measurement, winner beats the default."""
+    from incubator_mxnet_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"dp": 8}, devices=jax.devices()[:8])
+    res = autotune_train(mesh=mesh, device="cpu-proxy",
+                         budget_compiles=5, warmup=1, iters=2)
+    assert len(res.candidates) >= 24
+    assert res.accounted()
+    assert res.winner is not None
+    assert res.winner.measured_sps <= res.default.measured_sps
+
+
+@pytest.mark.slow
+def test_autotune_serve_policy_search():
+    """Serve target: bucket-set + flush-deadline policies ranked by the
+    zero-compile latency proxy, top-K measured against the Poisson
+    loadtest, every policy accounted."""
+    mx.random.seed(8)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(8))
+    net.initialize(init=mx.init.Xavier())
+    net(nd.ones((2, 16)))
+    res = autotune_serve(net, (16,), budget_compiles=2, qps=400.0,
+                         n_requests=40)
+    assert res.accounted()
+    assert res.winner is not None
+    assert res.winner.detail["recompiles"] == 0
+    measured = [c for c in res.candidates if c.status == "measured"]
+    assert len(measured) == 2
+    assert res.winner.measured_sps == min(c.measured_sps for c in measured)

@@ -15,6 +15,15 @@ Exit status 1 when any error-severity GL2xx diagnostic fires — with
 autotuner (ROADMAP item 4) uses to reject configs before paying a
 compile.
 
+``--diff profile.json`` diffs the prediction against the measured
+category breakdown ``tools/profile_step.py --out`` writes: a
+per-category predicted/measured/drift table (the standalone form of
+the autotuner's residual-fit input).  Measured hlo_stats categories
+are folded into the prediction's category space (fusion kinds →
+elementwise, all-reduce/-gather → collective).  Exit status 2 when the
+worst per-category drift exceeds ``--drift-threshold`` (default 0.5 =
+50 %).
+
 Usage::
 
     python tools/graftcost.py --model dense --batch 16
@@ -22,6 +31,8 @@ Usage::
         bfloat16 --format json
     python tools/graftcost.py --model dense --mesh dp=8 --zero 1
         --hbm-budget 16GiB
+    python tools/graftcost.py --model resnet50 --batch 256 --compute-dtype
+        bfloat16 --diff profile.json --drift-threshold 0.3
 """
 from __future__ import annotations
 
@@ -98,6 +109,110 @@ def _build_model(name, feat=16, layers=4):
     raise SystemExit("unknown --model %r (dense, conv-bn, resnet50)" % name)
 
 
+#: measured hlo_stats category (tools/profile_step.py) -> predicted
+#: CostReport category.  XLA reports fused elementwise/reduction work
+#: as "fusion" kinds, so those fold into elementwise — reduction time
+#: inside a convert_reduce_fusion is indistinguishable in the measured
+#: breakdown.  Unmatched categories fold into "other" (copies, infeed).
+def _map_measured_category(name: str) -> str:
+    n = str(name).lower()
+    if "conv" in n:
+        return "conv"
+    if any(k in n for k in ("all-reduce", "allreduce", "all-gather",
+                            "allgather", "reduce-scatter", "collective",
+                            "all-to-all", "permute")):
+        return "collective"
+    if "scatter" in n or "gather" in n:
+        return "scatter_gather"
+    if any(k in n for k in ("fusion", "elementwise", "loop", "convert",
+                            "reduce")):
+        return "elementwise"
+    return "other"
+
+
+def _pred_category_ms(report, n_dev):
+    """Per-category lower-bound milliseconds from a CostReport: each
+    category's max of its compute and HBM roofline (comm handled by the
+    collective row's wire bytes)."""
+    sp = report.spec()
+    out = {}
+    for k, c in report.categories.items():
+        hbm_s = c.hbm_bytes / (sp.hbm_bytes_per_s * n_dev)
+        fl_s = c.flops / (sp.flops_per_s * n_dev)
+        out[k] = 1e3 * max(hbm_s, fl_s)
+    comm_s = max((c.wire_bytes / sp.ici_bytes_per_s
+                  for c in report.comm.values()), default=0.0)
+    if comm_s:
+        out["collective"] = out.get("collective", 0.0) + 1e3 * comm_s
+    return out
+
+
+def _diff_profile(report, profile_path, threshold, fmt):
+    """The --diff leg: per-category predicted vs measured ms table.
+    Returns (max_abs_drift, rows) and prints; drift = (measured -
+    predicted) / measured.  The measured side folds into the predicted
+    category space first (elementwise absorbs reduction in BOTH: the
+    fusion kinds are not separable in hlo_stats)."""
+    import json as _json
+
+    with open(profile_path) as f:
+        prof = _json.load(f)
+    measured = {}
+    for name, row in prof.get("categories", {}).items():
+        cat = _map_measured_category(name)
+        measured[cat] = measured.get(cat, 0.0) + float(row["ms_per_step"])
+    n_dev = max(report.n_devices, 1)
+    pred = _pred_category_ms(report, n_dev)
+    # reduction folds into elementwise on the predicted side too
+    # (measured fusions lump them)
+    pred["elementwise"] = pred.get("elementwise", 0.0) \
+        + pred.pop("reduction", 0.0)
+    cats = sorted(set(pred) | set(measured))
+    rows = []
+    worst = 0.0
+    for cat in cats:
+        p = pred.get(cat, 0.0)
+        m = measured.get(cat, 0.0)
+        if p < 0.01 and m < 0.01:  # both under 10 us: noise, not drift
+            drift = 0.0
+        elif m > 0:
+            drift = (m - p) / m
+        else:
+            drift = -1.0  # predicted cost the profile never saw
+        # "other" (copies, infeed) has no predicted counterpart by
+        # design — report it but keep it out of the gate
+        if cat != "other":
+            worst = max(worst, abs(drift))
+        rows.append({"category": cat, "predicted_ms": round(p, 3),
+                     "measured_ms": round(m, 3),
+                     "drift": round(drift, 4)})
+    total_p, total_m = sum(pred.values()), sum(measured.values())
+    total_drift = (total_m - total_p) / total_m if total_m > 0 else 0.0
+    payload = {"version": 1, "profile": profile_path,
+               "threshold": threshold, "rows": rows,
+               "total": {"predicted_ms": round(total_p, 3),
+                         "measured_ms": round(total_m, 3),
+                         "drift": round(total_drift, 4)},
+               "max_abs_drift": round(worst, 4),
+               "over_threshold": worst > threshold}
+    if fmt == "json":
+        print(_json.dumps(payload, indent=2))
+    else:
+        print("%-16s %12s %12s %9s" % ("category", "pred ms", "meas ms",
+                                       "drift"))
+        for r in rows:
+            print("%-16s %12.3f %12.3f %8.1f%%"
+                  % (r["category"], r["predicted_ms"], r["measured_ms"],
+                     100 * r["drift"]))
+        print("%-16s %12.3f %12.3f %8.1f%%" % ("TOTAL", total_p, total_m,
+                                               100 * total_drift))
+        if worst > threshold:
+            print("graftcost --diff: max per-category drift %.1f%% "
+                  "exceeds threshold %.1f%%"
+                  % (100 * worst, 100 * threshold), file=sys.stderr)
+    return worst, payload
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="graftcost", description=__doc__,
@@ -126,6 +241,16 @@ def main(argv=None) -> int:
                          "accepted) — GL201 errors over it, exit 1")
     ap.add_argument("--format", dest="fmt", default="table",
                     choices=["table", "json"])
+    ap.add_argument("--diff", default=None, metavar="PROFILE_JSON",
+                    help="diff the prediction against a measured "
+                         "category breakdown written by "
+                         "tools/profile_step.py --out; exit 2 when the "
+                         "worst per-category drift exceeds "
+                         "--drift-threshold")
+    ap.add_argument("--drift-threshold", type=float, default=0.5,
+                    help="--diff gate: max acceptable |measured - "
+                         "predicted| / measured per category "
+                         "(default 0.5)")
     args = ap.parse_args(argv)
 
     mesh_axes = _parse_mesh(args.mesh)
@@ -175,6 +300,11 @@ def main(argv=None) -> int:
     else:
         y = jax.ShapeDtypeStruct((args.batch,), jnp.float32)
     report = step.analyze_cost(x, y, device=args.device, hbm_budget=budget)
+
+    if args.diff:
+        worst, _ = _diff_profile(report, args.diff, args.drift_threshold,
+                                 args.fmt)
+        return 2 if worst > args.drift_threshold else 0
 
     if args.fmt == "json":
         print(report.to_json(indent=2))
