@@ -40,6 +40,21 @@ semantics):
   config without a memory win).  Wired into every fused step via
   ``make_train_step(..., cost="report"|"check", hbm_budget=)`` /
   ``MXTPU_COST``, plus the ``tools/graftcost.py`` CLI.
+- **graftpass (the rewrite engine)**: :mod:`.passes` is the layer that
+  *fixes* what the analyzers flag — a verified jaxpr→jaxpr pass
+  framework on the same pre-compile trace, where every pass declares an
+  exactness contract (bit_exact / tolerance / argmax_preserving) that
+  the :class:`~.passes.PassManager` verifies by construction: abstract
+  eval, re-lint (GL302: a pass may not introduce jaxpr-level graftlint
+  findings),
+  graftcost before/after receipts (GL303: a pointless rewrite is
+  skipped), and a seeded concrete probe (GL301: a contract-violating
+  rewrite is refused with zero compiles spent).  Shipped passes:
+  ``quantize_int8``/``quantize_int4`` (weight-only, the ServeEngine
+  int8 tier), ``amp_bf16``, ``space_to_depth`` (the conv1 PERF.md
+  rewrite), ``cse_dead_aux`` (the GL202 fix).  Wired in via
+  ``make_train_step(passes=...)`` / ``ServeEngine(passes=...)`` /
+  ``MXTPU_PASSES``; CLI ``tools/graftpass.py``; guide docs/PASSES.md.
 - **autotune (the search on top)**: :mod:`.autotune` closes the loop —
   cost-model-ranked candidate search over the train-step knob space or
   the serving (bucket set, flush deadline) policies, GL201 eager
@@ -55,6 +70,9 @@ from .cost_model import (DEVICE_SPECS, CostReport, DeviceSpec,
                          analyze_jaxpr, analyze_traceable, check_cost)
 from .diagnostics import (CODES, Diagnostic, LintError, LintReport,
                           Severity, code_matches)
+from .passes import (PASS_REGISTRY, Contract, GraftPass, PassContext,
+                     PassManager, PassReceipt, PipelineResult, get_pass,
+                     register_pass, resolve_passes)
 from .source_lint import (check_checkpoint_without_iter_state, lint_paths,
                           lint_source)
 from .trace_lint import (check_inference_param_donation,
@@ -67,9 +85,11 @@ from .trace_lint import (check_inference_param_donation,
                          validate_permutation)
 
 __all__ = [
-    "CODES", "Candidate", "CostReport", "DEVICE_SPECS", "DeviceSpec",
-    "Diagnostic",
-    "LintError", "LintReport", "Severity", "analyze_jaxpr",
+    "CODES", "Candidate", "Contract", "CostReport", "DEVICE_SPECS",
+    "DeviceSpec", "Diagnostic", "GraftPass",
+    "LintError", "LintReport", "PASS_REGISTRY", "PassContext",
+    "PassManager", "PassReceipt", "PipelineResult", "Severity",
+    "analyze_jaxpr",
     "analyze_traceable", "autotune_serve", "autotune_train",
     "check_checkpoint_without_iter_state", "check_cost",
     "check_inference_param_donation",
@@ -77,7 +97,8 @@ __all__ = [
     "check_partition_spec", "check_permutation",
     "check_process_local_ckpt_dir", "check_swap_compatibility",
     "check_zero_state_shardings", "code_matches", "fit_residual",
-    "lint_jaxpr",
+    "get_pass", "lint_jaxpr",
     "lint_paths", "lint_source", "lint_traceable", "recompile_probe",
-    "spearman", "validate_permutation",
+    "register_pass", "resolve_passes", "spearman",
+    "validate_permutation",
 ]

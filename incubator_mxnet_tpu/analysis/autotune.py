@@ -9,7 +9,11 @@ performance models (arXiv:2011.14486):
 1. **Enumerate** the knob space for a target workload — the fused train
    step's (``batch``, ``num_micro``, ``pipeline_stages``,
    ``pipeline_remat``, ``zero``, ``multi_precision``, ``loss_scale``)
-   grid, or the serving tier's (bucket set, flush deadline) grid.
+   grid, optionally crossed with graftpass on/off knobs
+   (``default_train_space(passes=...)`` — candidates are then ranked by
+   their POST-pass CostReport, and a GL301/GL302-refused pipeline is
+   rejected with zero compiles like a GL201 one), or the serving tier's
+   (bucket set, flush deadline) grid.
 2. **Rank** every candidate by the :class:`~.cost_model.CostReport`
    roofline — one abstract trace each, no compile, no execution — and
    **eagerly drop** anything GL201-infeasible (predicted peak memory
@@ -288,14 +292,23 @@ def dense_workload(feat: int = 16, layers: int = 4, classes: int = 4,
 
 
 def default_train_space(mesh_axes: Optional[Dict[str, int]] = None,
-                        batches: Sequence[int] = (8, 16, 32)
+                        batches: Sequence[int] = (8, 16, 32),
+                        passes: Sequence[Any] = ()
                         ) -> List[Dict[str, Any]]:
     """The default train-step knob grid: ``batch`` × ``zero`` ×
     ``multi_precision`` × ``loss_scale`` (24 candidates on a dp-only
     mesh), plus ``pipeline_stages``/``num_micro``/``pipeline_remat``
     combinations when the mesh has a ``pp`` axis.  ``zero=1`` knobs are
     only emitted when the mesh has a ``dp`` axis (elsewhere they would
-    all be rejected-invalid noise, not search space)."""
+    all be rejected-invalid noise, not search space).
+
+    ``passes`` — graftpass names (``analysis/passes.py`` registry):
+    each becomes an on/off knob crossed into the grid, so the tuner
+    ranks REWRITTEN candidates by their post-pass CostReport (the
+    costed program is the one that would compile).  A candidate whose
+    pipeline is refused — GL301 contract violation, GL302 re-lint —
+    is rejected exactly like a GL201-infeasible one: with its reason
+    in the ledger and zero compiles spent."""
     mesh_axes = dict(mesh_axes or {})
     has_dp = "dp" in mesh_axes
     pp = int(mesh_axes.get("pp", 0))
@@ -318,6 +331,19 @@ def default_train_space(mesh_axes: Optional[Dict[str, int]] = None,
                                   "pipeline_stages": pp,
                                   "num_micro": num_micro,
                                   "pipeline_remat": remat})
+    if passes:
+        import itertools
+
+        names = [p if isinstance(p, str) else getattr(p, "name", str(p))
+                 for p in passes]
+        expanded = []
+        for entry in space:
+            for mask in itertools.product((False, True),
+                                          repeat=len(names)):
+                e = dict(entry)
+                e["passes"] = tuple(n for n, on in zip(names, mask) if on)
+                expanded.append(e)
+        space = expanded
     return space
 
 
@@ -338,6 +364,9 @@ def _build_train_step(make_net, loss_fn, knobs, mesh):
         pipeline_remat=bool(knobs.get("pipeline_remat", False)),
         loss_scale=knobs.get("loss_scale"),
         compute_dtype=knobs.get("compute_dtype"),
+        # explicit () — a candidate without the knob must not inherit
+        # MXTPU_PASSES, or every candidate would silently carry it
+        passes=knobs.get("passes", ()),
         lint="off", cost="off", **kw)
 
 

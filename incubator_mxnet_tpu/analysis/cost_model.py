@@ -192,6 +192,12 @@ _CATEGORY = {"mxu": "conv", "elem": "elementwise", "layout": "elementwise",
 #: materialize (they read real buffers, not fused producers)
 _FORCES_OPERANDS = ("mxu", "sg", "coll", "control")
 
+#: pure data movement feeding an MXU op is folded into its input by
+#: XLA layout assignment (a transposed weight or a space-to-depth
+#: rearrangement never round-trips HBM on its own) — so LAYOUT-only
+#: chains materialize for fewer consumer classes than elementwise ones
+_FORCES_LAYOUT = ("sg", "coll", "control")
+
 
 def _aval_bytes(aval) -> int:
     shape = getattr(aval, "shape", None)
@@ -214,6 +220,27 @@ def _aval_elems(aval) -> int:
         return 0
 
 
+#: MXU sublane tile width: a conv whose per-group input-channel count
+#: sits below it loads (and multiplies) channel-padded operands — the
+#: conv1 C=3 inefficiency the ``space_to_depth`` graftpass removes
+_MXU_LANES = 8
+
+
+def _conv_lane_amp(eqn) -> float:
+    """Channel-padding amplification of one conv: ``lanes/cin`` when the
+    per-group input-channel count is under the sublane width, else 1.
+    Applied to the conv's FLOPs and its LHS read bytes — the hardware
+    loads the padded tile whether or not the channels exist."""
+    if eqn.primitive.name != "conv_general_dilated":
+        return 1.0
+    dn = eqn.params["dimension_numbers"]
+    rhs = eqn.invars[1].aval
+    cin = rhs.shape[dn.rhs_spec[1]]
+    if not isinstance(cin, (int, np.integer)) or not 0 < cin < _MXU_LANES:
+        return 1.0
+    return _MXU_LANES / float(cin)
+
+
 def _eqn_flops(eqn) -> float:
     """FLOPs of one equation (fused or not; 1 FLOP per output element
     for elementwise ops, 2·M·N·K-style for MXU ops, one per input
@@ -230,7 +257,8 @@ def _eqn_flops(eqn) -> float:
             k_spatial = 1
             for d in rhs_spec[2:]:
                 k_spatial *= rhs.shape[d]
-            return 2.0 * _aval_elems(out) * cin_per_group * k_spatial
+            return 2.0 * _aval_elems(out) * cin_per_group * k_spatial \
+                * _conv_lane_amp(eqn)
         # dot_general
         (lhs_c, _rhs_c), _ = eqn.params["dimension_numbers"]
         lhs = eqn.invars[0].aval
@@ -619,7 +647,9 @@ class _Walker:
         elif id(v) in outset:
             r = True
         else:
-            r = any(_classify(c.primitive.name) in _FORCES_OPERANDS
+            forces = _FORCES_LAYOUT if cls == "layout" \
+                else _FORCES_OPERANDS
+            r = any(_classify(c.primitive.name) in forces
                     for c in consumers.get(v, ()))
         memo[id(v)] = r
         return r
@@ -773,6 +803,13 @@ class _Walker:
                             seen_cats[leaf] = set()  # pass barrier
                         c.hbm_read_bytes += _aval_bytes(leaf.aval)
                         reread_count[leaf] += 1
+                    if prim == "conv_general_dilated":
+                        # sublane channel padding: the LHS loads at the
+                        # tile width even when cin is smaller
+                        amp = _conv_lane_amp(eqn)
+                        if amp > 1.0 and _is_var(eqn.invars[0]):
+                            c.hbm_read_bytes += (amp - 1.0) * _aval_bytes(
+                                eqn.invars[0].aval)
                     for o in eqn.outvars:
                         if _is_var(o) and \
                                 self._materialized(o, producers, consumers,
@@ -1010,7 +1047,10 @@ def check_cost(report: CostReport,
             where="graftcost fusion model",
             hint="a kernel that keeps the tensor resident (fused "
                  "ghost-BN, docs/PERF.md lever 1) removes the repeat "
-                 "passes"))
+                 "passes; when the repeats are DUPLICATE computations "
+                 "(BN stats traced twice), the cse_dead_aux graftpass "
+                 "merges them at trace time — passes=('cse_dead_aux',) "
+                 "/ MXTPU_PASSES (docs/PASSES.md)"))
     rf = report.roofline()
     if rf["comm_s"] > max(rf["compute_s"], rf["hbm_s"]) and rf["comm_s"] > 0:
         diags.append(Diagnostic(

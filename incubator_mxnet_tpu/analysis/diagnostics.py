@@ -28,7 +28,9 @@ class Severity(enum.IntEnum):
 
 
 #: Stable catalog: code -> (default severity, one-line summary).
-#: GL0xx = trace-time (jaxpr) checks, GL1xx = source-level (AST) checks.
+#: GL0xx = trace-time (jaxpr) checks, GL1xx = source-level (AST) checks,
+#: GL2xx = cost-model (graftcost) checks, GL3xx = rewrite-engine
+#: (graftpass) checks.
 CODES = {
     "GL001": (Severity.ERROR,
               "ppermute permutation malformed / non-bijective over the "
@@ -90,6 +92,24 @@ CODES = {
               "graftcost: pipeline_remat/donation config that raises "
               "peak memory (or pays recompute bytes) without a "
               "matching memory win"),
+    "GL301": (Severity.ERROR,
+              "graftpass: rewrite violates its declared exactness "
+              "contract (bit_exact / tolerance / argmax_preserving) on "
+              "abstract eval or the seeded concrete probe — the rewrite "
+              "is refused, the original program is kept, no compile is "
+              "spent"),
+    "GL302": (Severity.ERROR,
+              "graftpass: rewrite introduced a jaxpr-level graftlint "
+              "finding (GL001-GL003 walks + the in-walk GL006 class) "
+              "the input program did not have — a pass may fix "
+              "programs, never break them; refused before any compile. "
+              "Builder-level checks (GL005/GL007-GL011) are properties "
+              "of the builder's own surfaces, which a jaxpr->jaxpr "
+              "rewrite cannot alter"),
+    "GL303": (Severity.WARNING,
+              "graftpass: rewrite increased predicted HBM cost with no "
+              "exactness gain (a bit_exact pass whose graftcost receipt "
+              "went up) — the rewrite is pointless and is skipped"),
     "GL101": (Severity.ERROR,
               "shard_map imported from jax directly instead of "
               "parallel/mesh.py (the one version-compat home)"),

@@ -175,6 +175,16 @@ _decl("MXTPU_COST", str, "off",
       "memory over hbm_budget) before any compile, 'off' (default) "
       "skips the walk.  Overridden per step by make_train_step(cost=).")
 
+_decl("MXTPU_PASSES", str, "",
+      "graftpass pipeline for trace-time jaxpr rewrites (analysis/"
+      "passes.py, docs/PASSES.md): comma-separated registry names "
+      "(quantize_int8, quantize_int4, amp_bf16, space_to_depth, "
+      "cse_dead_aux) applied to every fused train step and serving "
+      "engine before compile — each pass verifies its declared "
+      "exactness contract (GL301) and re-lints (GL302) before "
+      "installation.  Empty (default) = no rewrites.  Overridden per "
+      "builder by make_train_step(passes=) / ServeEngine(passes=).")
+
 _decl("MXTPU_COMPILE_CACHE", str, "",
       "Directory for the persistent compiled-executable cache "
       "(parallel/aot.py CompileCache): every AOT build through "

@@ -142,7 +142,8 @@ class Trainer:
                         zero=0, multi_precision=None,
                         lint=None, lint_suppress=(),
                         nonfinite=None, loss_scale=None, cost=None,
-                        hbm_budget=None, cost_device="tpu-v5e"):
+                        hbm_budget=None, cost_device="tpu-v5e",
+                        passes=None):
         """Build a fused XLA train step from this Trainer's optimizer.
 
         The reference's Trainer.step chain (forward → backward → kvstore
@@ -180,6 +181,14 @@ class Trainer:
         ``"check"`` rejects a config whose predicted peak memory
         exceeds ``hbm_budget`` — GL201 — before any compile); see
         ``parallel.make_train_step`` and ``docs/ANALYSIS.md``.
+
+        ``passes`` runs the graftpass jaxpr→jaxpr rewrite pipeline
+        (``analysis/passes.py``, docs/PASSES.md) over the traced step
+        before its first compile — e.g. ``passes=("amp_bf16",
+        "cse_dead_aux")``; every rewrite is verified against its
+        declared exactness contract (GL301/GL302 refuse, zero compiles
+        spent) and stamped with graftcost receipts
+        (``step.pass_receipts``).
 
         The returned TrainStep owns its optimizer state; mixing its calls
         with eager ``Trainer.step`` updates on the same params is
@@ -275,7 +284,8 @@ class Trainer:
                          pipeline_remat=pipeline_remat, zero=zero, lint=lint,
                          lint_suppress=lint_suppress, nonfinite=nonfinite,
                          loss_scale=loss_scale, cost=cost,
-                         hbm_budget=hbm_budget, cost_device=cost_device)
+                         hbm_budget=hbm_budget, cost_device=cost_device,
+                         passes=passes)
         # the guard tracks EVERY live zero=1 step built from this
         # Trainer (weakrefs: the guard must not pin params/optimizer
         # state alive, and dies with its step) — the legacy host-side

@@ -251,7 +251,8 @@ class TrainStep:
                  nonfinite: Optional[str] = None,
                  loss_scale=None, cost: Optional[str] = None,
                  hbm_budget: Optional[float] = None,
-                 cost_device: str = "tpu-v5e"):
+                 cost_device: str = "tpu-v5e",
+                 passes=None):
         self.net = net
         self.loss_fn = loss_fn
         self.opt = opt
@@ -363,6 +364,23 @@ class TrainStep:
                              % (cost_device, sorted(_SPECS)))
         self.cost_device = cost_device
         self.cost_report = None  # set by the cost pass (cost != "off")
+        # graftpass: an ordered jaxpr->jaxpr rewrite pipeline applied to
+        # the traced step before its first compile (analysis/passes.py,
+        # docs/PASSES.md).  Resolution: explicit arg > MXTPU_PASSES env
+        # > ().  Invar-changing passes (quantize) no-op here — a train
+        # step's params are donated and updated in place, so the
+        # PassContext advertises no quantizable param invars.
+        from ..analysis.passes import resolve_passes as _resolve_passes
+
+        self._passes = _resolve_passes(passes)
+        #: flat-aval signature -> (rewritten ClosedJaxpr, out treedef,
+        #: probe-verified flag)
+        self._pass_programs: Dict[tuple, tuple] = {}
+        #: (x, y) aval keys whose program is fully verified — the
+        #: per-step fast path around the full-args flatten
+        self._pass_fast_verified: set = set()
+        self._pass_effects: List[Any] = []
+        self.pass_receipts = None  # receipts of the last pipeline run
         if pipeline_stages is not None:
             if mesh is None:
                 raise ValueError("pipeline_stages requires a mesh with a "
@@ -858,10 +876,16 @@ class TrainStep:
         return step
 
     def _build(self):
-        gp_list, aux_list = self._gp, self._aux
         step = self._make_pipeline_step() if self.pipeline_stages \
             else self._make_plain_step()
         self._step_fn = step  # shared by the multi-step (scan) program
+        return self._jit_for(step)
+
+    def _jit_for(self, step):
+        """jit one step-shaped callable under this step's donation and
+        sharding specs — shared by the base program and the graftpass-
+        rewritten one (same interface by construction: GL301 gates it)."""
+        gp_list, aux_list = self._gp, self._aux
         donate = self._donate_argnums
         if self.mesh is None:
             return jax.jit(step, donate_argnums=donate)
@@ -896,6 +920,111 @@ class TrainStep:
                                       repl, repl, repl))
 
     # ------------------------------------------------------------------
+    # graftpass (analysis/passes.py, docs/PASSES.md)
+    def _maybe_apply_passes(self, example_args, probe=True):
+        """Run the configured pass pipeline over the traced step for
+        this argument signature and install the verified rewrite as the
+        program that compiles.  Idempotent per flat-aval signature; the
+        contract gates (GL301/GL302) raise BEFORE any compile, so a
+        refused rewrite costs zero executables.  The rewritten step
+        keeps the exact invar layout, donation spec and shardings —
+        invar-changing passes are refused here by construction.
+
+        ``probe=False`` skips the concrete probe (abstract eval,
+        re-lint and cost receipts still gate) — the cheap ranking mode
+        ``analyze_cost`` uses so the autotuner's zero-compile phase
+        never pays two eager step executions per candidate.  A program
+        ranked that way is RE-verified with the probe the first time a
+        run path (``__call__``/``aot_compile``/``run_steps``) asks for
+        it: nothing unprobed ever compiles."""
+        if not self._passes:
+            return
+        # hot-path fast key: only the batch args vary between calls on
+        # one step instance (params/opt-state/scaler avals are pinned
+        # at build), so a verified (x, y) signature skips the full
+        # O(n_leaves) flatten every subsequent step would otherwise pay
+        x_ex, y_ex = example_args[3], example_args[4]
+        fast = (tuple(x_ex.shape), str(x_ex.dtype),
+                tuple(y_ex.shape), str(y_ex.dtype))
+        if fast in self._pass_fast_verified:
+            return
+        flat = jax.tree_util.tree_leaves(tuple(example_args))
+        sig = tuple((tuple(v.shape), str(v.dtype)) for v in flat)
+        entry = self._pass_programs.get(sig)
+        if entry is not None and (entry[2] or not probe):
+            if entry[2]:
+                self._pass_fast_verified.add(fast)
+            return
+        from ..analysis.passes import PassContext, PassManager
+        from ..analysis.trace_lint import donated_leaf_indices
+        from .aot import traced_with_effects
+        from .mesh import spans_processes
+
+        base = getattr(self, "_base_jit", None) or self._jit
+        traced, effects = traced_with_effects(
+            base, tuple(example_args), capture=self.lint != "off")
+        if effects and not self._pass_effects:
+            # GL004 effects surface on the BASE trace (the rewritten
+            # program replays a finished trace); stash them for the
+            # lint report over the rewritten program
+            self._pass_effects = list(effects)
+        axis_sizes, n_dev, multihost = None, 1, False
+        if self.mesh is not None:
+            axis_sizes = {k: int(v)
+                          for k, v in dict(self.mesh.shape).items()}
+            n_dev = int(self.mesh.size)
+            multihost = spans_processes(self.mesh)
+        ctx = PassContext(
+            param_invars=frozenset(),  # donated+updated: not quantizable
+            allow_invar_change=False,
+            donated_leaves=tuple(donated_leaf_indices(
+                tuple(example_args), self._donate_argnums)),
+            axis_sizes=axis_sizes,
+            # a process-spanning program cannot be evaluated eagerly on
+            # this host alone; abstract eval + re-lint still gate it
+            probe="off" if (multihost or not probe) else "auto",
+            where="fused train step")
+        mgr = PassManager(self._passes, device=self.cost_device,
+                          n_devices=n_dev)
+        result = mgr.run(traced.jaxpr, ctx)
+        self.pass_receipts = result.receipts
+        out_tree = jax.tree_util.tree_structure(traced.out_info)
+        # multihost counts as verified-as-far-as-possible: the probe
+        # can never run there, so a False flag would re-run the whole
+        # pipeline (trace + lint + cost walks) on every step
+        verified = bool(probe) or multihost
+        self._pass_programs[sig] = (result.closed_jaxpr, out_tree,
+                                    verified)
+        if verified:
+            self._pass_fast_verified.add(fast)
+        if getattr(self, "_base_jit", None) is None:
+            self._base_jit = self._jit
+            programs = self._pass_programs
+
+            def step2(p_vals, aux_vals, opt_state, x, y, key, step_count,
+                      scaler):
+                fl = jax.tree_util.tree_leaves(
+                    (p_vals, aux_vals, opt_state, x, y, key, step_count,
+                     scaler))
+                s = tuple((tuple(v.shape), str(v.dtype)) for v in fl)
+                entry = programs.get(s)
+                if entry is None:
+                    raise RuntimeError(
+                        "graftpass: no rewritten program for argument "
+                        "signature %r — the pass pipeline runs per batch "
+                        "signature before trace; this trace bypassed it"
+                        % (s[:4],))
+                rj, otree = entry[0], entry[1]
+                from jax import core as _jcore
+
+                return jax.tree_util.tree_unflatten(
+                    otree, _jcore.eval_jaxpr(rj.jaxpr, rj.consts, *fl))
+
+            self._step_fn = step2
+            self._jit = self._jit_for(step2)
+            self._multi_jit = None  # rebuilt over the rewritten step
+
+    # ------------------------------------------------------------------
     def _maybe_lint(self, example_args):
         """graftlint Level 1 over the step program, BEFORE its first XLA
         compile: checks collective permutations (GL001), partition specs
@@ -922,6 +1051,10 @@ class TrainStep:
         cost_here = self.cost != "off" and not self._linted
         traced, effects = traced_with_effects(jit_obj, tuple(args),
                                               capture=lint_here)
+        if lint_here and self._pass_effects:
+            # GL004 effects were captured on the base trace the pass
+            # pipeline consumed (the rewritten program replays it)
+            effects = list(effects) + list(self._pass_effects)
         if lint_here:
             self._finish_lint(traced.jaxpr, effects, args)
         if cost_here:
@@ -1060,8 +1193,9 @@ class TrainStep:
                 % ((report.param_bytes + report.opt_state_bytes_per_device)
                    / 1e6),
                 where="TrainStep(donate=False)",
-                hint="leave donation on unless you must re-read the old "
-                     "params after the step"))
+                hint="the knob is make_train_step(donate=True) (the "
+                     "default) — leave donation on unless you must "
+                     "re-read the old params after the step"))
         if self.pipeline_remat:
             cap = report.hbm_budget or report.spec().hbm_bytes
             if report.peak_bytes < 0.5 * cap:
@@ -1072,8 +1206,10 @@ class TrainStep:
                     "budget (%.1f MB) — the stash it avoids would have fit"
                     % (report.peak_bytes / 1e6, cap / 1e6),
                     where="TrainStep(pipeline_remat=True)",
-                    hint="drop pipeline_remat (or lower hbm_budget if the "
-                         "headroom is intentional)"))
+                    hint="the knob is make_train_step(pipeline_remat="
+                         "False); drop it (or lower hbm_budget if the "
+                         "headroom is intentional) — tools/autotune.py "
+                         "searches it as part of the train space"))
         return diags
 
     def _finish_cost(self, closed_jaxpr, example_args):
@@ -1119,6 +1255,13 @@ class TrainStep:
         args = (pv, av, sv, aval(x), aval(y), aval(self._key_dev),
                 aval(self._step_dev),
                 tuple(aval(v) for v in self._scaler_dev))
+        # with a pass pipeline configured the costed program is the
+        # REWRITTEN one — what would actually compile (post-pass cost,
+        # the autotuner's ranking signal for `--passes` candidates).
+        # probe=False: ranking a candidate must never pay two eager
+        # step executions — the probe runs when a run path installs
+        # the program for real (nothing unprobed ever compiles)
+        self._maybe_apply_passes(args, probe=False)
         traced = self._jit.trace(*args)
         return self._cost_analyze(traced.jaxpr, args, device=device,
                                   hbm_budget=hbm_budget)
@@ -1234,7 +1377,8 @@ class TrainStep:
                 bool(self.pipeline_remat), bool(self._donate),
                 self.opt.name, bool(self.opt.multi_precision),
                 str(self.compute_dtype), self.nonfinite,
-                self._dynamic_scale)
+                self._dynamic_scale,
+                tuple(p.name for p in self._passes))
 
     def aot_compile(self, x, y, cache=None):
         """Ahead-of-time trace + lower + compile the fused step for the given
@@ -1274,6 +1418,9 @@ class TrainStep:
         from .aot import compile_timed
 
         t0 = _time.time()
+        self._maybe_apply_passes((p_vals, aux_vals, self._opt_state, xv,
+                                  yv, self._key_dev, self._step_dev,
+                                  self._scaler_dev))
         traced = self._lint_trace(self._jit,
                                   (p_vals, aux_vals, self._opt_state, xv,
                                    yv, self._key_dev, self._step_dev,
@@ -1339,8 +1486,6 @@ class TrainStep:
                             else jnp.asarray(y) for y in ys])
         else:
             ys = ys._data if isinstance(ys, NDArray) else jnp.asarray(ys)
-        if getattr(self, "_multi_jit", None) is None:
-            self._multi_jit = self._build_multi()
         p_vals = [p._data._data for p in self._gp]
         aux_vals = [p._data._data for p in self._aux]
         if self.mesh is not None:
@@ -1361,6 +1506,23 @@ class TrainStep:
             else:
                 xs = jax.device_put(xs, stack_sh)
                 ys = jax.device_put(ys, stack_sh)
+        if self._passes:
+            # the scan body is the SINGLE-step program: run the pipeline
+            # for the per-step signature before the multi program traces
+            # — derived from the PLACED (global on multihost) batch, the
+            # shapes the scan body will actually carry
+            def sd(a):
+                return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+            self._maybe_apply_passes((
+                [sd(v) for v in p_vals], [sd(v) for v in aux_vals],
+                jax.tree_util.tree_map(sd, self._opt_state),
+                jax.ShapeDtypeStruct(xs.shape[1:], xs.dtype),
+                jax.ShapeDtypeStruct(ys.shape[1:], ys.dtype),
+                sd(self._key_dev), sd(self._step_dev),
+                tuple(sd(v) for v in self._scaler_dev)))
+        if getattr(self, "_multi_jit", None) is None:
+            self._multi_jit = self._build_multi()
         k = xs.shape[0]
         if not self._linted and (self.lint != "off" or self.cost != "off"):
             # lint rides the multi-step program's OWN trace (shared with
@@ -1408,6 +1570,9 @@ class TrainStep:
             if not self._placed:
                 p_vals, aux_vals = self._place_state(p_vals, aux_vals)
             xv, yv = self._place_batch(xv, yv)
+        self._maybe_apply_passes((p_vals, aux_vals, self._opt_state, xv,
+                                  yv, self._key_dev, self._step_dev,
+                                  self._scaler_dev))
         self._maybe_lint((p_vals, aux_vals, self._opt_state, xv, yv,
                           self._key_dev, self._step_dev, self._scaler_dev))
         # the AOT executable is shape-pinned; any other batch shape/dtype
@@ -1807,7 +1972,7 @@ def make_train_step(net, loss_fn, optimizer="sgd", mesh=None, batch_axis="dp",
                     pipeline_stages=None, num_micro=1, pipeline_axis="pp",
                     pipeline_remat=False, zero=0, lint=None, lint_suppress=(),
                     nonfinite=None, loss_scale=None, cost=None,
-                    hbm_budget=None, cost_device="tpu-v5e",
+                    hbm_budget=None, cost_device="tpu-v5e", passes=None,
                     **opt_kwargs) -> TrainStep:
     """Build the fused train step (fwd+bwd+optimizer in one XLA program).
 
@@ -1856,6 +2021,22 @@ def make_train_step(net, loss_fn, optimizer="sgd", mesh=None, batch_axis="dp",
     registry (``tpu-v5e`` default; ``cpu-proxy`` for relative numbers
     off-chip).
 
+    ``passes`` (default: env ``MXTPU_PASSES``, else none) runs the
+    graftpass rewrite pipeline over the traced step before its first
+    compile (``analysis/passes.py``, docs/PASSES.md): an ordered list
+    of registry names (``"amp_bf16"``, ``"space_to_depth"``,
+    ``"cse_dead_aux"``, ...) or :class:`~..analysis.GraftPass`
+    instances.  Every pass declares an exactness contract the framework
+    verifies by construction — abstract eval, re-lint (a pass may not
+    introduce jaxpr-level graftlint findings: GL302), graftcost
+    before/after
+    receipts (``step.pass_receipts``; a pointless bit-exact rewrite is
+    skipped: GL303) and a seeded concrete probe (GL301) — refusing,
+    with :class:`~..analysis.LintError` and zero compiles spent, any
+    rewrite that breaks its declaration.  Weight-quantizing passes
+    no-op on a train step (its params are donated and updated in
+    place); they belong on ``ServeEngine(passes=...)``.
+
     ``nonfinite`` contains bad steps INSIDE the program: ``"skip"``
     leaves params, aux state, optimizer state and the step counter
     bit-identical when any gradient is non-finite (one fused all-finite
@@ -1882,4 +2063,4 @@ def make_train_step(net, loss_fn, optimizer="sgd", mesh=None, batch_axis="dp",
                      pipeline_remat=pipeline_remat, zero=zero, lint=lint,
                      lint_suppress=lint_suppress, nonfinite=nonfinite,
                      loss_scale=loss_scale, cost=cost, hbm_budget=hbm_budget,
-                     cost_device=cost_device)
+                     cost_device=cost_device, passes=passes)

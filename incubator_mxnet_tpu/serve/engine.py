@@ -21,11 +21,18 @@ buckets**:
 - on a mesh the engine serves dp-replicated: params replicated (or
   per ``param_shardings``), the padded batch sharded over the batch
   axis, so one program spans every replica;
-- ``dtype="int8"`` is the weight-only quantized tier: eligible
-  parameters (floating, ndim >= 2) are quantized ONCE at load with the
-  symmetric int8 convention of ``ops/quantization.py``
-  (``quantize_tensor``) and dequantized inside the compiled program —
-  4x smaller resident weights, the memory-bound decode win;
+- ``dtype="int8"`` is the weight-only quantized tier — since the
+  graftpass engine (``analysis/passes.py``) it is nothing but sugar for
+  ``passes=("quantize_int8",)``: the verified rewrite pass replaces
+  eligible parameter invars (floating, ndim >= 2) with (int8 codes,
+  amax) pairs — the symmetric convention of ``ops/quantization.py`` —
+  dequantized inside the compiled program, 4x smaller resident weights,
+  its ``argmax_preserving`` contract probed before install and its
+  graftcost receipt stamped per bucket (``pass_receipts``); an int4
+  tier is ``passes=("quantize_int4",)``, for free;
+- ``passes=(...)`` runs any registered graftpass pipeline over every
+  bucket program before compile (GL301/GL302 refuse a rewrite that
+  breaks its declaration — zero compiles spent; docs/PASSES.md);
 - the ``lint=`` / ``cost=`` trace hooks ride the same pre-compile
   ``jit.trace()`` the first call reuses, exactly like the fused train
   step (shared plumbing: ``parallel/aot.py``);
@@ -55,7 +62,6 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ndarray import NDArray
-from ..ops.quantization import dequantize_tensor, quantize_tensor
 from ..parallel.aot import (compile_timed, lint_served_program,
                             resolve_mode, traced_with_effects)
 
@@ -93,7 +99,8 @@ class ServeEngine:
                  lint_suppress: Tuple[str, ...] = (),
                  cost: Optional[str] = None,
                  hbm_budget: Optional[float] = None,
-                 cost_device: str = "tpu-v5e"):
+                 cost_device: str = "tpu-v5e",
+                 passes=None):
         self.net = net
         self.buckets = tuple(sorted(int(b) for b in buckets))
         if not self.buckets or any(b < 1 for b in self.buckets):
@@ -118,6 +125,23 @@ class ServeEngine:
             np.dtype(dtype)
         self.dtype = dtype
         self._int8 = dtype == "int8"
+        # graftpass pipeline (analysis/passes.py, docs/PASSES.md):
+        # jaxpr->jaxpr rewrites applied to every bucket program before
+        # compile, each verified against its declared contract.  The
+        # int8 tier IS the quantize_int8 pass — ``dtype="int8"`` is
+        # sugar for prepending it (the engine-private quantization
+        # branch this replaced lives on only as the (codes, amax)
+        # value layout the pass's transform produces).
+        from ..analysis.passes import get_pass, resolve_passes
+
+        self.passes = resolve_passes(passes)
+        if self._int8 and not any(p.name == "quantize_int8"
+                                  for p in self.passes):
+            self.passes = (get_pass("quantize_int8"),) + self.passes
+        #: program key -> list of PassReceipt (the per-bucket stamps)
+        self.pass_receipts: Dict[tuple, Any] = {}
+        self._pass_result = None   # first bucket's PipelineResult
+        self._pass_base_jit = None
         self._donate_argnums = tuple(int(a) for a in donate_argnums)
         if any(a not in (0, 1) for a in self._donate_argnums):
             raise ValueError("donate_argnums index the (params, x) "
@@ -197,25 +221,26 @@ class ServeEngine:
     # ------------------------------------------------------------------
     def _prepare_vals(self, raw: Sequence[Any]):
         """Turn one version's raw host/device arrays into the served
-        representation: int8-quantize eligible weights, apply the
-        compute-dtype cast.  ONE copy of the load-time transform, shared
-        by :meth:`_collect` and :meth:`update_params` — a swapped-in
-        version must be shaped exactly like the one it replaces."""
+        representation: apply the compute-dtype cast, then the pass
+        pipeline's value transform (quantize passes turn eligible
+        weights into (codes, amax) pairs).  ONE copy of the load-time
+        transform, shared by :meth:`_collect` and :meth:`update_params`
+        — a swapped-in version must be shaped exactly like the one it
+        replaces.  Before the first bucket program runs the pipeline
+        (``_pass_result`` unset) values stay in float; the first build
+        re-prepares them through the verified transform."""
         compute = None if (self._int8 or self.dtype is None) else self.dtype
         vals, quant = [], []
-        for v in raw:
+        for i, v in enumerate(raw):
             v = jnp.asarray(v)
-            if self._int8 and jnp.issubdtype(v.dtype, jnp.floating) \
-                    and v.ndim >= 2:
-                # weight-only int8: matrices/filters carry the bytes;
-                # vectors (biases, BN stats/scales) stay in float —
-                # their error would be per-channel, their size is noise
-                vals.append(quantize_tensor(v))
+            if compute is not None and \
+                    jnp.issubdtype(v.dtype, jnp.floating):
+                v = v.astype(compute)
+            if self._pass_result is not None \
+                    and i in self._pass_result.invar_splits:
+                vals.append(tuple(self._pass_result.transform_invar(i, v)))
                 quant.append(True)
             else:
-                if compute is not None and \
-                        jnp.issubdtype(v.dtype, jnp.floating):
-                    v = v.astype(compute)
                 vals.append(v)
                 quant.append(False)
         return vals, quant
@@ -235,26 +260,28 @@ class ServeEngine:
         self._live = (1, vals)
 
     def _param_dtype(self):
-        """The dtype params are bound as inside the program (and the
-        dtype int8 weights dequantize back to)."""
+        """The dtype params are bound as inside the program (the input
+        promote target; quantize passes dequantize to the traced invar
+        dtype by construction)."""
         if self.dtype is not None and not self._int8:
             return jnp.dtype(self.dtype)
-        for p, q in zip(self._params, self._quantized):
+        for p in self._params:
             v = p._data._data
             if jnp.issubdtype(v.dtype, jnp.floating):
                 return jnp.dtype(v.dtype)
         return jnp.dtype(jnp.float32)
 
     def _infer_fn(self):
+        """The base inference program over FLOAT parameter values —
+        what compiles directly without passes, and what the pass
+        pipeline traces and rewrites with one (quantization happens in
+        the rewritten program's dequantize prologue, not here)."""
         from ..gluon.block import pure_forward
 
         params = self._params
-        quant = self._quantized
         pdt = self._param_dtype()
 
         def infer(p_vals, x):
-            vals = [dequantize_tensor(v[0], v[1], dtype=pdt) if q else v
-                    for v, q in zip(p_vals, quant)]
             if jnp.issubdtype(x.dtype, jnp.unsignedinteger):
                 # raw image bytes (the uint8 record path): promote like
                 # the train step does
@@ -262,19 +289,18 @@ class ServeEngine:
             elif self.dtype is not None and not self._int8 \
                     and jnp.issubdtype(x.dtype, jnp.floating):
                 x = x.astype(pdt)
-            out, _tc = pure_forward(self.net, params, vals, x,
+            out, _tc = pure_forward(self.net, params, p_vals, x,
                                     training=False)
             return out
 
         return infer
 
-    def _build_jit(self):
-        if self._jit is not None:
-            return self._jit
-        infer = self._infer_fn()
+    def _jit_with_specs(self, fn):
+        """jit one (p_vals, x) callable under this engine's donation
+        spec and shardings (quantized params are (codes, amax) pairs:
+        codes shard like the param, amax replicates)."""
         if self.mesh is None:
-            self._jit = jax.jit(infer, donate_argnums=self._donate_argnums)
-            return self._jit
+            return jax.jit(fn, donate_argnums=self._donate_argnums)
         mesh = self.mesh
         repl = NamedSharding(mesh, P())
 
@@ -285,8 +311,13 @@ class ServeEngine:
                 for p, q in zip(self._params, self._quantized)]
         self._batch_sh = NamedSharding(mesh, P(self.batch_axis)) \
             if self.batch_axis in mesh.axis_names else repl
-        self._jit = jax.jit(infer, donate_argnums=self._donate_argnums,
-                            in_shardings=(p_sh, self._batch_sh))
+        return jax.jit(fn, donate_argnums=self._donate_argnums,
+                       in_shardings=(p_sh, self._batch_sh))
+
+    def _build_jit(self):
+        if self._jit is not None:
+            return self._jit
+        self._jit = self._jit_with_specs(self._infer_fn())
         return self._jit
 
     def _place_vals(self, vals: Sequence[Any]) -> List[Any]:
@@ -362,6 +393,123 @@ class ServeEngine:
         return (bucket, self.sample_shape, str(np.dtype(self.sample_dtype)),
                 self.dtype or "net")
 
+    def _pass_param_avals(self):
+        """Abstract values of the ORIGINAL (float, compute-cast) params
+        — the pass pipeline's input program is always traced over these,
+        even after the stored values were transformed (the pinned
+        ``_param_sig`` is the source of truth, so every bucket's
+        pipeline sees the same pre-rewrite program family)."""
+        compute = None if (self._int8 or self.dtype is None) \
+            else jnp.dtype(self.dtype)
+        avals = []
+        for _name, shape, dt in self._param_sig:
+            d = jnp.dtype(dt)
+            if compute is not None and jnp.issubdtype(d, jnp.floating):
+                d = compute
+            avals.append(jax.ShapeDtypeStruct(shape, d))
+        return avals
+
+    def _build_pass_program(self, key, bucket):
+        """The pass-pipeline build: trace the base (float-param)
+        program, lint it, run the verified rewrite pipeline (receipts in
+        ``pass_receipts[key]``; GL301/GL302 refuse before any compile),
+        re-prepare the stored params through the pipeline's value
+        transform on the first build, and compile the REWRITTEN program
+        under the engine's donation/sharding specs."""
+        from jax import core as jcore
+
+        from ..analysis.passes import PassContext, PassManager
+        from ..analysis.trace_lint import donated_leaf_indices
+
+        t0 = time.time()
+        if self._pass_base_jit is None:
+            self._pass_base_jit = jax.jit(self._infer_fn())
+        x_aval = jax.ShapeDtypeStruct(
+            (bucket,) + tuple(self.sample_shape),
+            np.dtype(self.sample_dtype))
+        args = (self._pass_param_avals(), x_aval)
+        capture = self.lint != "off" and not self._linted
+        traced, effects = traced_with_effects(self._pass_base_jit, args,
+                                              capture=capture)
+        if self.lint != "off" and not self._linted:
+            lint_served_program(
+                traced, effects, args, self._donate_argnums,
+                mode=self.lint, suppress=self.lint_suppress,
+                what="ServeEngine(%s, bucket=%d)" % (self.net.name,
+                                                     bucket))
+            self._linted = True
+        axis_sizes, n_dev = None, 1
+        if self.mesh is not None:
+            axis_sizes = {k: int(v)
+                          for k, v in dict(self.mesh.shape).items()}
+            n_dev = int(self.mesh.size)
+        first = self._pass_result is None
+        overrides = {}
+        if first:
+            # the real (still-float) weights make the sharpest
+            # tolerance/argmax probe; later buckets share the verified
+            # contract (same program family, batch extent aside)
+            overrides = dict(enumerate(self._live[1]))
+        ctx = PassContext(
+            param_invars=frozenset(range(len(self._param_sig))),
+            donated_leaves=tuple(donated_leaf_indices(
+                args, self._donate_argnums)),
+            axis_sizes=axis_sizes,
+            probe="auto" if first else "off",
+            probe_overrides=overrides,
+            where="ServeEngine(%s, bucket=%d)" % (self.net.name, bucket))
+        mgr = PassManager(self.passes, device=self.cost_device,
+                          n_devices=n_dev)
+        result = mgr.run(traced.jaxpr, ctx)
+        self.pass_receipts[key] = result.receipts
+        if first:
+            self._pass_result = result
+            ver, vals = self._live
+            new_vals, quant = [], []
+            for i, v in enumerate(vals):
+                if i in result.invar_splits:
+                    new_vals.append(tuple(result.transform_invar(i, v)))
+                    quant.append(True)
+                else:
+                    new_vals.append(v)
+                    quant.append(False)
+            self._quantized = quant
+            self._live = (ver, new_vals)
+        elif sorted(result.invar_splits) != \
+                sorted(self._pass_result.invar_splits):
+            raise RuntimeError(
+                "graftpass: bucket %d's pipeline split different param "
+                "invars (%s) than the first bucket's (%s) — one engine "
+                "serves one value layout"
+                % (bucket, sorted(result.invar_splits),
+                   sorted(self._pass_result.invar_splits)))
+        self._place()
+        out_tree = jax.tree_util.tree_structure(traced.out_info)
+        rj = result.closed_jaxpr
+
+        def infer2(p_vals, x):
+            fl = jax.tree_util.tree_leaves((p_vals, x))
+            return jax.tree_util.tree_unflatten(
+                out_tree, jcore.eval_jaxpr(rj.jaxpr, rj.consts, *fl))
+
+        jit2 = self._jit_with_specs(infer2)
+        args2 = (self._p_vals, x_aval)
+        traced2 = jit2.trace(*args2)
+        if self.cost != "off":
+            # the costed (and GL201-gated) program is the one that
+            # actually compiles — post-pass
+            self._finish_cost(traced2.jaxpr, args2, bucket)
+        mesh_desc = None if self.mesh is None else \
+            tuple(sorted((str(a), int(s))
+                         for a, s in dict(self.mesh.shape).items()))
+        prog, times = compile_timed(
+            traced2, t_trace=time.time() - t0,
+            cache_extra=("serve_engine", mesh_desc, key,
+                         tuple(p.name for p in self.passes)))
+        self._programs[key] = prog
+        self.compile_log[key] = times
+        return prog
+
     def _ensure_program(self, bucket, warming=False):
         key = self._program_key(bucket)
         prog = self._programs.get(key)
@@ -383,6 +531,8 @@ class ServeEngine:
                 where="ServeEngine(%s)" % self.net.name,
                 hint="warmup() every bucket/dtype the batcher can emit "
                      "before opening traffic").format(), stacklevel=4)
+        if self.passes:
+            return self._build_pass_program(key, bucket)
         self._place()
         jit_obj = self._build_jit()
         x_aval = jax.ShapeDtypeStruct((bucket,) + tuple(self.sample_shape),

@@ -147,6 +147,8 @@ def _format_table(res):
                 return True
             if k == "num_micro":
                 return v > 1
+            if k == "passes":
+                return bool(v)
             return v not in (None, False)
 
         knobs = " ".join("%s=%s" % (k, v)
@@ -184,6 +186,12 @@ def main(argv=None) -> int:
                          "are forged off-chip)")
     ap.add_argument("--batches", default="8,16,32",
                     help="train-target batch sizes to search")
+    ap.add_argument("--passes", default="",
+                    help="comma-separated graftpass names (tools/"
+                         "graftpass.py --list): each becomes an on/off "
+                         "knob in the train search space, ranked by the "
+                         "post-pass CostReport; GL201/GL301-rejected "
+                         "candidates cost zero compiles")
     ap.add_argument("--budget-compiles", type=int, default=5,
                     help="how many candidates reach the real backend "
                          "(each costs at most one XLA compile; a warm "
@@ -242,8 +250,16 @@ def main(argv=None) -> int:
             make_net, make_batch, loss_fn = _conv_bn_workload()
         else:
             make_net, make_batch, loss_fn = _resnet50_workload()
+        pass_names = tuple(s.strip() for s in args.passes.split(",")
+                           if s.strip())
+        if pass_names:
+            from incubator_mxnet_tpu.analysis.passes import get_pass
+
+            for n in pass_names:
+                get_pass(n)  # fail fast on unknown names
         batches = tuple(int(b) for b in args.batches.split(",") if b)
-        space = default_train_space(mesh_axes, batches=batches)
+        space = default_train_space(mesh_axes, batches=batches,
+                                    passes=pass_names)
         res = autotune_train(make_net, make_batch, loss_fn, space=space,
                              mesh=mesh, device=args.device,
                              hbm_budget=budget,

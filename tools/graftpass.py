@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""graftpass CLI — run the verified jaxpr→jaxpr rewrite pipeline on a
+model and print the receipts.
+
+Builds the requested model, traces its inference program, runs the
+given pass pipeline through the :class:`~analysis.passes.PassManager`
+— abstract eval, re-lint (GL302), graftcost before/after receipts
+(GL303), seeded concrete probe (GL301) — and reports one receipt per
+pass: contract, rewrite hits, predicted FLOPs/HBM-bytes/param-bytes
+before/after, probe verdict.  No XLA ahead-of-time compile is ever
+paid: refused rewrites cost nothing, and the probes run eagerly.
+
+Exit status 1 on a contract violation (GL301) or re-lint failure
+(GL302) — the CI gate shape ``tools/graftlint.py`` set; 0 otherwise
+(GL303 skipped-rewrite warnings do not gate).
+
+``--format json`` prints the stable machine schema::
+
+    {"version": 1, "tool": "graftpass", "model": ..., "passes":
+     [<receipt>...], "diagnostics": [<Diagnostic>...],
+     "summary": {"installed": n, "refused": n, "errors": n}}
+
+Usage::
+
+    python tools/graftpass.py --list
+    python tools/graftpass.py --model dense --passes quantize_int8,cse_dead_aux
+    python tools/graftpass.py --model conv-bn --passes space_to_depth \
+        --batch 8 --format json
+    python tools/graftpass.py --model resnet50 --passes space_to_depth \
+        --no-probe
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+def _build_model(name):
+    """(net, sample_shape): dense = the test MLP; conv-bn = a conv1-
+    style 7x7/s2 stem + conv-BN block (a space_to_depth target);
+    resnet50 = the flagship (heavy: probe it with --no-probe off-CI)."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.gluon import nn
+
+    mx.random.seed(0)
+    if name == "dense":
+        net = nn.HybridSequential()
+        net.add(nn.Dense(32, activation="relu"), nn.Dense(10))
+        net.initialize(init=mx.init.Xavier())
+        net(nd.ones((2, 16)))
+        return net, (16,)
+    if name == "conv-bn":
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(16, 7, strides=2, padding=3, in_channels=3))
+        net.add(nn.BatchNorm())
+        net.add(nn.Activation("relu"))
+        net.add(nn.Conv2D(16, 3, padding=1, in_channels=16))
+        net.add(nn.BatchNorm())
+        net.add(nn.Activation("relu"))
+        net.initialize(init=mx.init.Xavier())
+        net(nd.ones((2, 3, 16, 16)))
+        return net, (3, 16, 16)
+    if name == "resnet50":
+        from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+        net = vision.resnet50_v1(classes=1000)
+        net.initialize(init=mx.init.Zero())
+        net.shape_init((1, 3, 224, 224))
+        return net, (3, 224, 224)
+    raise SystemExit("unknown --model %r (dense, conv-bn, resnet50)" % name)
+
+
+def _list_registry(fmt):
+    from incubator_mxnet_tpu.analysis.passes import PASS_REGISTRY, get_pass
+
+    rows = []
+    for name in sorted(PASS_REGISTRY):
+        p = get_pass(name)
+        rows.append({"name": name, "contract": p.contract.describe(),
+                     "description": p.description})
+    if fmt == "json":
+        print(json.dumps({"version": 1, "tool": "graftpass",
+                          "registry": rows}, indent=2))
+    else:
+        for r in rows:
+            print("%-16s %-28s %s" % (r["name"], r["contract"],
+                                      r["description"]))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftpass", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--list", action="store_true",
+                    help="print the pass registry and exit")
+    ap.add_argument("--model", default="dense",
+                    choices=["dense", "conv-bn", "resnet50"])
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--passes", default="quantize_int8,cse_dead_aux",
+                    help="comma-separated registry names, applied in "
+                         "order")
+    ap.add_argument("--no-probe", action="store_true",
+                    help="skip the concrete probe (abstract eval, "
+                         "re-lint and cost receipts still gate)")
+    ap.add_argument("--device", default="tpu-v5e",
+                    help="graftcost roofline device-spec registry key")
+    ap.add_argument("--format", dest="fmt", default="table",
+                    choices=["table", "json"])
+    args = ap.parse_args(argv)
+
+    if args.list:
+        return _list_registry(args.fmt)
+
+    import numpy as np
+
+    import jax
+
+    from incubator_mxnet_tpu.analysis import LintError, Severity
+    from incubator_mxnet_tpu.analysis.passes import (PassContext,
+                                                     PassManager)
+    from incubator_mxnet_tpu.gluon.block import pure_forward
+
+    net, sample_shape = _build_model(args.model)
+    params = list(net.collect_params().values())
+    p_vals = [p._data._data for p in params]
+
+    def infer(pv, x):
+        out, _tc = pure_forward(net, params, pv, x, training=False)
+        return out
+
+    x = jax.ShapeDtypeStruct((args.batch,) + tuple(sample_shape),
+                             np.float32)
+    closed = jax.make_jaxpr(infer)(
+        [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in p_vals], x)
+    ctx = PassContext(
+        param_invars=frozenset(range(len(p_vals))),
+        probe="off" if args.no_probe else "auto",
+        probe_overrides=dict(enumerate(p_vals)),
+        where="graftpass CLI (%s)" % args.model)
+    try:
+        mgr = PassManager(args.passes, device=args.device,
+                          raise_on_error=False)
+        result = mgr.run(closed, ctx)
+    except (ValueError, LintError) as e:
+        print("graftpass: %s" % e, file=sys.stderr)
+        return 1
+    errors = [d for d in result.diagnostics
+              if d.severity >= Severity.ERROR]
+    payload = {
+        "version": 1,
+        "tool": "graftpass",
+        "model": args.model,
+        "batch": args.batch,
+        "device": args.device,
+        "passes": [r.to_dict() for r in result.receipts],
+        "diagnostics": [d.to_dict() for d in result.diagnostics],
+        "summary": {
+            "installed": sum(1 for r in result.receipts if r.installed),
+            "refused": sum(1 for r in result.receipts
+                           if r.changed and not r.installed),
+            "errors": len(errors)},
+    }
+    if args.fmt == "json":
+        print(json.dumps(payload, indent=2))
+    else:
+        print("graftpass[%s batch=%d]: %d pass(es), %d installed, "
+              "%d refused"
+              % (args.model, args.batch, len(result.receipts),
+                 payload["summary"]["installed"],
+                 payload["summary"]["refused"]))
+        print("%-16s %-26s %-9s %5s %12s %12s %10s"
+              % ("pass", "contract", "installed", "hits",
+                 "HBM MB before", "after", "param KB"))
+        for r in result.receipts:
+            print("%-16s %-26s %-9s %5d %12.3f %12.3f %6.1f->%.1f"
+                  % (r.name, r.contract, str(r.installed), r.hits,
+                     r.hbm_bytes_before / 1e6, r.hbm_bytes_after / 1e6,
+                     r.param_bytes_before / 1e3,
+                     r.param_bytes_after / 1e3))
+            if r.probe is not None:
+                print("    probe: %s" % json.dumps(r.probe))
+            if r.notes:
+                print("    %s" % r.notes)
+        for d in result.diagnostics:
+            print(d.format())
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
