@@ -55,6 +55,20 @@ semantics):
   rewrite), ``cse_dead_aux`` (the GL202 fix).  Wired in via
   ``make_train_step(passes=...)`` / ``ServeEngine(passes=...)`` /
   ``MXTPU_PASSES``; CLI ``tools/graftpass.py``; guide docs/PASSES.md.
+- **graftrange (the numerics layer)**: :mod:`.value_range` is a
+  trace-time value-range & precision abstract interpreter over the
+  jaxpr — per-variable intervals, NaN-possibility, effective precision
+  with f64-weak-promotion tracking — checked as the GL4xx family:
+  GL401 possible overflow-to-inf (exp of unbounded logits without
+  max-subtraction), GL402 invalid-domain ops (log/rsqrt/div reachable
+  at ≤0, the E[x²]−E[x]² cancellation), GL403 bf16 under/overflow on a
+  demoted edge (the per-op ``amp_bf16`` installation gate), GL404
+  silent f64/weak-type promotion (the hand-fixed adam/attention-scale
+  bug class), GL405 loss-scale advisory.  Wired in as
+  ``make_train_step(numerics=, input_range=)`` /
+  ``ServeEngine(numerics=)`` / ``MXTPU_NUMERICS``;
+  ``step.range_report`` / ``engine.range_report``; range tables via
+  ``tools/graftpass.py --ranges`` and ``tools/graftlint.py --ranges``.
 - **autotune (the search on top)**: :mod:`.autotune` closes the loop —
   cost-model-ranked candidate search over the train-step knob space or
   the serving (bucket set, flush deadline) policies, GL201 eager
@@ -75,6 +89,8 @@ from .passes import (PASS_REGISTRY, Contract, GraftPass, PassContext,
                      register_pass, resolve_passes)
 from .source_lint import (check_checkpoint_without_iter_state, lint_paths,
                           lint_source)
+from .value_range import (RangeReport, VRange, analyze_ranges, bf16_fit,
+                          loss_scale_diags)
 from .trace_lint import (check_inference_param_donation,
                          check_legacy_checkpoint_path,
                          check_partition_spec, check_permutation,
@@ -98,7 +114,9 @@ __all__ = [
     "check_process_local_ckpt_dir", "check_swap_compatibility",
     "check_zero_state_shardings", "code_matches", "fit_residual",
     "get_pass", "lint_jaxpr",
-    "lint_paths", "lint_source", "lint_traceable", "recompile_probe",
+    "lint_paths", "lint_source", "lint_traceable", "loss_scale_diags",
+    "recompile_probe",
     "register_pass", "resolve_passes", "spearman",
     "validate_permutation",
+    "RangeReport", "VRange", "analyze_ranges", "bf16_fit",
 ]

@@ -347,7 +347,8 @@ def default_train_space(mesh_axes: Optional[Dict[str, int]] = None,
     return space
 
 
-def _build_train_step(make_net, loss_fn, knobs, mesh):
+def _build_train_step(make_net, loss_fn, knobs, mesh, numerics="off",
+                      input_range=None):
     from ..parallel import make_train_step
 
     net = make_net(knobs)
@@ -367,19 +368,38 @@ def _build_train_step(make_net, loss_fn, knobs, mesh):
         # explicit () — a candidate without the knob must not inherit
         # MXTPU_PASSES, or every candidate would silently carry it
         passes=knobs.get("passes", ()),
-        lint="off", cost="off", **kw)
+        lint="off", cost="off", numerics=numerics,
+        input_range=input_range, **kw)
 
 
 def _predict_train(c: Candidate, make_net, make_batch, loss_fn, mesh,
-                   device: str, hbm_budget: Optional[float]) -> None:
+                   device: str, hbm_budget: Optional[float],
+                   numerics: str = "off", input_range=None) -> None:
     """Phase 2 for one candidate: build + abstract-trace + cost, GL201
-    pruning.  Never compiles — the built step is dropped with
-    ``_compiled is None``, recorded as ``zero_compile``."""
+    pruning — and, with ``numerics`` on, graftrange GL403/GL405
+    pruning: a candidate whose amp_bf16 pipeline is refused on an
+    out-of-bf16-range edge, or whose loss-scale config provably
+    overflows, is rejected exactly like a GL201 one.  Never compiles —
+    the built step is dropped with ``_compiled is None``, recorded as
+    ``zero_compile``."""
+    from .diagnostics import LintError, Severity
+
     try:
-        step = _build_train_step(make_net, loss_fn, c.knobs, mesh)
+        step = _build_train_step(make_net, loss_fn, c.knobs, mesh,
+                                 numerics=numerics,
+                                 input_range=input_range)
         x, y = make_batch(c.knobs)
         report = step.analyze_cost(x, y, device=device,
                                    hbm_budget=hbm_budget)
+    except LintError as e:
+        # a GL301/GL302/GL403 pipeline refusal: infeasible, not a bug
+        # in the knobs — ledger it with the codes, zero compiles spent
+        codes = sorted({d.code for d in e.report.diagnostics})
+        c.status = "rejected-infeasible"
+        c.reason = "%s: %s" % ("/".join(codes) or "lint",
+                               str(e).split("\n", 1)[0])
+        c.zero_compile = True
+        return
     except Exception as e:  # noqa: BLE001 — invalid knob combos are data
         c.status = "rejected-invalid"
         c.reason = "%s: %s" % (type(e).__name__, e)
@@ -398,19 +418,37 @@ def _predict_train(c: Candidate, make_net, make_batch, loss_fn, mesh,
     if gl201:
         c.status = "rejected-infeasible"
         c.reason = "%s: %s" % (gl201[0].code, gl201[0].message)
-    else:
-        c.status = "predicted"
+        return
+    if numerics == "error":
+        # pruning is the ERROR-mode contract; "warn" keeps the
+        # candidate ranked and only surfaces advisories (the step's
+        # own warn machinery), exactly like lint="warn" vs "error"
+        try:
+            nrep = step.analyze_numerics(x, y)
+        except LintError as e:
+            nerr = list(e.report.diagnostics)
+        else:
+            nerr = [d for d in nrep.diagnostics
+                    if d.severity >= Severity.ERROR]
+        if nerr:
+            c.status = "rejected-infeasible"
+            c.reason = "%s: %s" % (nerr[0].code, nerr[0].message)
+            return
+    c.status = "predicted"
 
 
 def _measure_train(c: Candidate, make_net, make_batch, loss_fn, mesh,
-                   cache, warmup: int, iters: int) -> None:
+                   cache, warmup: int, iters: int,
+                   numerics: str = "off", input_range=None) -> None:
     """Phase 3 for one candidate: rebuild fresh (a measured candidate's
     donated params were mutated), AOT-compile through the persistent
     cache, and time ``iters`` real steps."""
     from ..parallel import aot
 
     try:
-        step = _build_train_step(make_net, loss_fn, c.knobs, mesh)
+        step = _build_train_step(make_net, loss_fn, c.knobs, mesh,
+                                 numerics=numerics,
+                                 input_range=input_range)
         x, y = make_batch(c.knobs)
         c0 = aot.XLA_COMPILES.count
         times = step.aot_compile(x, y, cache=cache)
@@ -501,7 +539,7 @@ def autotune_train(make_net=None, make_batch=None, loss_fn=None,
                    budget_compiles: int = 5,
                    default_knobs: Optional[Dict[str, Any]] = None,
                    warmup: int = 1, iters: int = 3,
-                   cache=None,
+                   cache=None, numerics: str = "off", input_range=None,
                    log_path: Optional[str] = None) -> TuningResult:
     """Tune the fused train step over ``space`` (default:
     :func:`default_train_space` on the mesh's axes; workload default:
@@ -518,6 +556,12 @@ def autotune_train(make_net=None, make_batch=None, loss_fn=None,
     measured first as the baseline.  The winner is the best *measured*
     seconds-per-sample.  ``log_path`` writes the JSON tuning log
     atomically.
+
+    ``numerics``/``input_range`` switch on the graftrange value-range
+    gate per candidate (``analysis/value_range.py``): a candidate whose
+    ``amp_bf16`` pipeline is refused on an out-of-bf16-range edge
+    (GL403) or whose loss-scale config provably overflows (GL405) is
+    rejected with ZERO compiles spent, exactly like GL201/GL301.
     """
     t_start = time.time()
     if make_net is None or make_batch is None or loss_fn is None:
@@ -538,7 +582,8 @@ def autotune_train(make_net=None, make_batch=None, loss_fn=None,
 
     for c in result.candidates:
         _predict_train(c, make_net, make_batch, loss_fn, mesh, device,
-                       hbm_budget)
+                       hbm_budget, numerics=numerics,
+                       input_range=input_range)
 
     default_idx = None
     if default_knobs is None and result.candidates:
@@ -552,7 +597,8 @@ def autotune_train(make_net=None, make_batch=None, loss_fn=None,
             result.candidates.append(Candidate(knobs=dict(default_knobs)))
             default_idx = len(result.candidates) - 1
             _predict_train(result.candidates[default_idx], make_net,
-                           make_batch, loss_fn, mesh, device, hbm_budget)
+                           make_batch, loss_fn, mesh, device, hbm_budget,
+                           numerics=numerics, input_range=input_range)
 
     from ..parallel import aot
 
@@ -560,7 +606,8 @@ def autotune_train(make_net=None, make_batch=None, loss_fn=None,
     _, residual_info = _refine_loop(
         result.candidates,
         lambda c: _measure_train(c, make_net, make_batch, loss_fn, mesh,
-                                 cache, warmup, iters),
+                                 cache, warmup, iters, numerics=numerics,
+                                 input_range=input_range),
         int(budget_compiles), default_idx,
         lambda c: c.corrected_sps if c.corrected_sps is not None
         else (c.pred_sps if c.pred_sps is not None else float("inf")))

@@ -30,7 +30,8 @@ class Severity(enum.IntEnum):
 #: Stable catalog: code -> (default severity, one-line summary).
 #: GL0xx = trace-time (jaxpr) checks, GL1xx = source-level (AST) checks,
 #: GL2xx = cost-model (graftcost) checks, GL3xx = rewrite-engine
-#: (graftpass) checks.
+#: (graftpass) checks, GL4xx = value-range/precision (graftrange)
+#: checks.
 CODES = {
     "GL001": (Severity.ERROR,
               "ppermute permutation malformed / non-bijective over the "
@@ -92,6 +93,36 @@ CODES = {
               "graftcost: pipeline_remat/donation config that raises "
               "peak memory (or pays recompute bytes) without a "
               "matching memory win"),
+    "GL401": (Severity.ERROR,
+              "graftrange: possible overflow to +/-inf — an exp-family "
+              "op over an unbounded operand (softmax without max-"
+              "subtraction), or arithmetic whose proven value bounds "
+              "exceed the output dtype's finite range"),
+    "GL402": (Severity.ERROR,
+              "graftrange: invalid-domain op reachable — log/sqrt/rsqrt "
+              "of a possibly-negative value (the E[x^2]-E[x]^2 "
+              "cancellation pattern), or division by a possibly-zero "
+              "denominator (an unguarded amax/scale)"),
+    "GL403": (Severity.ERROR,
+              "graftrange: bf16 under/overflow on a demoted edge — an "
+              "operand whose proven value range does not fit bfloat16 "
+              "is being computed in bf16 (the amp_bf16 installation "
+              "gate: unsafe ops are excluded from demotion, or the "
+              "pass is refused under numerics='error')"),
+    "GL404": (Severity.ERROR,
+              "graftrange: silent float64/weak-type promotion inside "
+              "the step — an f64 value materializes from literals/"
+              "consts in an otherwise <=f32 program (the beta**int "
+              "bias-correction and np.float64-scale bug class), "
+              "defeating donation and doubling bandwidth"),
+    "GL405": (Severity.WARNING,
+              "graftrange: loss-scale advisory — the smallest "
+              "representable gradient magnitude under the configured "
+              "loss_scale and compute dtype is mis-matched to the "
+              "format (f16 without scaling flushes small grads; "
+              "bf16/f32 scaling buys no exponent range; an oversized "
+              "static scale provably overflows every scaled grad: "
+              "error)"),
     "GL301": (Severity.ERROR,
               "graftpass: rewrite violates its declared exactness "
               "contract (bit_exact / tolerance / argmax_preserving) on "

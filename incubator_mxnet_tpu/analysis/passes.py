@@ -235,6 +235,14 @@ class PassContext:
     probe: str = "auto"
     probe_seed: int = 0
     probe_overrides: Dict[int, Any] = field(default_factory=dict)
+    #: graftrange hookup (analysis/value_range.py): "off" skips the
+    #: range gate in precision-aware passes; "warn" excludes unsafe ops
+    #: (GL403 warning); "error" refuses the whole pass on an unsafe
+    #: edge.  ``input_ranges`` maps flat invar indices to
+    #: (lo, hi[, positive]) seeds — builder annotations / observed
+    #: warmup ranges.
+    numerics: str = "off"
+    input_ranges: Optional[Dict[int, Any]] = None
     where: str = "graftpass"
 
 
@@ -252,6 +260,12 @@ class PassResult:
     invar_splits: Dict[int, int] = field(default_factory=dict)
     transform_one: Optional[Callable[[int, Any], List[Any]]] = None
     notes: str = ""
+    #: advisory diagnostics the pass itself emitted (e.g. amp_bf16's
+    #: GL403 per-op exclusions) — copied onto the receipt by the manager
+    diagnostics: List[Diagnostic] = field(default_factory=list)
+    #: precision-safety verdict of a range-gated pass (the GL403 gate):
+    #: {"checked": n, "excluded": n, "safe": bool, ...}
+    precision: Optional[Dict[str, Any]] = None
 
 
 @dataclass
@@ -273,6 +287,10 @@ class PassReceipt:
     param_bytes_before: float = 0.0
     param_bytes_after: float = 0.0
     probe: Optional[Dict[str, Any]] = None
+    #: graftrange precision-safety verdict (amp_bf16's GL403 gate):
+    #: {"checked", "excluded", "safe", "detail"} — None when the pass
+    #: is not range-gated or numerics was off
+    precision: Optional[Dict[str, Any]] = None
     notes: str = ""
     diagnostics: List[Diagnostic] = field(default_factory=list)
 
@@ -288,7 +306,8 @@ class PassReceipt:
                 "peak_bytes_after": self.peak_bytes_after,
                 "param_bytes_before": self.param_bytes_before,
                 "param_bytes_after": self.param_bytes_after,
-                "probe": self.probe, "notes": self.notes,
+                "probe": self.probe, "precision": self.precision,
+                "notes": self.notes,
                 "diagnostics": [d.to_dict() for d in self.diagnostics]}
 
 
@@ -499,10 +518,11 @@ class QuantizeWeightsPass(GraftPass):
         return out
 
     def quantize(self, w):
-        amax = jnp.max(jnp.abs(w)).astype(jnp.float32)
-        scale = jnp.where(amax > 0, self.qmax / amax, 1.0)
-        q = jnp.clip(jnp.rint(jnp.asarray(w).astype(jnp.float32) * scale),
-                     -self.qmax, self.qmax).astype(jnp.int8)
+        # the ONE guarded implementation (ops/quantization.py): amax==0
+        # and NaN'd channels yield zero codes + amax 0, never NaN codes
+        from ..ops.quantization import symmetric_quantize
+
+        q, amax = symmetric_quantize(jnp.asarray(w), qmax=self.qmax)
         return [q, amax]
 
     def run(self, closed_jaxpr, ctx: PassContext) -> Optional[PassResult]:
@@ -565,13 +585,51 @@ class AmpBf16Pass(GraftPass):
     name = "amp_bf16"
     description = ("selective dtype rewrite: f32 matmul/conv operands in "
                    "bf16 with f32 accumulation; reductions/softmax/norms "
-                   "stay f32")
+                   "stay f32; per-op GL403 range gate under numerics=")
 
     def __init__(self, atol: float = 0.05):
         self.contract = Contract.tolerance(atol)
 
     def run(self, closed_jaxpr, ctx: PassContext) -> Optional[PassResult]:
         hits = [0]
+        # graftrange installation gate (GL403, docs/ANALYSIS.md): with
+        # ctx.numerics on, the value-range walk runs over the INPUT
+        # program once and every demotion candidate's operand ranges
+        # are checked against bfloat16 — an edge whose proven range
+        # does not fit bf16 is EXCLUDED from demotion (the pass is no
+        # longer all-or-nothing) or, under numerics="error", refuses
+        # the whole pass before any compile.  Unknown ranges fit: bf16
+        # shares f32's exponent range, so only a proven excursion is a
+        # hazard.
+        gate = getattr(ctx, "numerics", "off") != "off"
+        ranges: Optional[Dict[Any, Any]] = None
+        excluded: List[Tuple[str, str]] = []
+        if gate:
+            from .value_range import analyze_ranges
+
+            ranges = analyze_ranges(
+                closed_jaxpr, input_ranges=ctx.input_ranges,
+                axis_sizes=ctx.axis_sizes, collect=False).var_ranges
+
+        def _bf16_unsafe(eqn):
+            if ranges is None:
+                return None
+            from .value_range import bf16_fit, VRange as _VR
+
+            for iv in eqn.invars[:2]:
+                vr = ranges.get(iv) if isinstance(iv, jcore.Var) else None
+                if vr is None and not isinstance(iv, jcore.Var):
+                    import numpy as _np
+
+                    val = _np.asarray(iv.val)
+                    m = float(_np.max(_np.abs(val))) if val.size else 0.0
+                    vr = _VR(-m, m)
+                if vr is None:
+                    continue
+                ok, reason = bf16_fit(vr)
+                if not ok:
+                    return reason
+            return None
 
         def rule(eqn, invals):
             if eqn.primitive.name not in ("dot_general",
@@ -583,6 +641,10 @@ class AmpBf16Pass(GraftPass):
             a, b = invals[0], invals[1]
             if a.dtype != jnp.float32 or b.dtype != jnp.float32:
                 return None
+            reason = _bf16_unsafe(eqn)
+            if reason is not None:
+                excluded.append((eqn.primitive.name, reason))
+                return None
             params = dict(eqn.params)
             params["preferred_element_type"] = jnp.dtype(jnp.float32)
             out = eqn.primitive.bind(a.astype(jnp.bfloat16),
@@ -591,11 +653,57 @@ class AmpBf16Pass(GraftPass):
             return [out]
 
         new_closed = retrace(closed_jaxpr, rule)
+        diags: List[Diagnostic] = []
+        precision = None
+        if gate:
+            precision = {"checked": hits[0] + len(excluded),
+                         "excluded": len(excluded),
+                         "safe": not excluded,
+                         "detail": [r for _, r in excluded[:4]]}
+            if excluded:
+                if ctx.numerics == "error":
+                    raise LintError(LintReport([Diagnostic(
+                        "GL403", Severity.ERROR,
+                        "amp_bf16: %d of %d demotion candidate(s) have "
+                        "operand ranges that do not fit bfloat16 "
+                        "(first: %s) — the pass is refused under "
+                        "numerics='error', the original program is "
+                        "kept, zero compiles spent"
+                        % (len(excluded), hits[0] + len(excluded),
+                           excluded[0][1]),
+                        where=ctx.where,
+                        hint="fix the edge's scale (or annotate the "
+                             "real input range), or run "
+                             "numerics='warn' to demote only the safe "
+                             "ops")]))
+                diags.append(Diagnostic(
+                    "GL403", Severity.WARNING,
+                    "amp_bf16: excluded %d of %d matmul/conv "
+                    "candidate(s) from bf16 demotion — %s"
+                    % (len(excluded), hits[0] + len(excluded),
+                       "; ".join(r for _, r in excluded[:2])),
+                    where=ctx.where,
+                    hint="the remaining ops still demote; rescale the "
+                         "flagged edge (or tighten input_range=) to "
+                         "recover it"))
         if not hits[0]:
-            return None
+            if not diags:
+                return None
+            # nothing demotable was SAFE: surface the verdict on a
+            # no-op receipt instead of silently dropping it
+            return PassResult(closed_jaxpr, hits=0, diagnostics=diags,
+                              precision=precision,
+                              notes="all %d candidate(s) excluded by "
+                                    "the GL403 range gate"
+                                    % len(excluded))
         return PassResult(new_closed, hits=hits[0],
+                          diagnostics=diags, precision=precision,
                           notes="%d matmul/conv op(s) moved to bf16 "
-                                "compute" % hits[0])
+                                "compute%s"
+                                % (hits[0],
+                                   "" if not excluded
+                                   else ", %d excluded by the GL403 "
+                                        "range gate" % len(excluded)))
 
 
 # ---------------------------------------------------------------------------
@@ -891,6 +999,23 @@ class PassManager:
         return tuple(out)
 
     @staticmethod
+    def _remap_ranges(ranges, splits: Dict[int, int],
+                      n_invars: int) -> Optional[Dict[int, Any]]:
+        """``input_ranges`` keys after an invar-splitting rewrite: a
+        split invar's seed is dropped (its replacement (codes, amax)
+        pair has a different value semantics), the rest shift."""
+        if not ranges:
+            return ranges
+        if not splits:
+            return dict(ranges)
+        start, off = {}, 0
+        for i in range(n_invars):
+            start[i] = off
+            off += splits.get(i, 1)
+        return {start[i]: r for i, r in ranges.items()
+                if i in start and i not in splits}
+
+    @staticmethod
     def _param_bytes(closed, param_invars) -> float:
         total = 0.0
         for i in param_invars:
@@ -953,6 +1078,12 @@ class PassManager:
                                       cur, cur_ctx.param_invars))
             result.receipts.append(receipt)
             res = p.run(cur, cur_ctx)
+            if res is not None:
+                # pass-emitted advisories (amp_bf16's GL403 exclusions)
+                # and the precision verdict ride the receipt either way
+                receipt.diagnostics.extend(res.diagnostics)
+                result.diagnostics.extend(res.diagnostics)
+                receipt.precision = res.precision
             if res is None or res.hits == 0:
                 receipt.notes = res.notes if res else "no rewrite target"
                 receipt.flops_after = receipt.flops_before
@@ -1010,6 +1141,9 @@ class PassManager:
                 probe_seed=ctx.probe_seed,
                 probe_overrides={} if res.invar_splits
                 else cur_ctx.probe_overrides,
+                numerics=cur_ctx.numerics,
+                input_ranges=self._remap_ranges(
+                    cur_ctx.input_ranges, res.invar_splits, n_in),
                 where=ctx.where)
             # gate 2: re-lint — a pass may not introduce findings
             if pre_lint is None:

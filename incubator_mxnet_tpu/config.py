@@ -175,6 +175,17 @@ _decl("MXTPU_COST", str, "off",
       "memory over hbm_budget) before any compile, 'off' (default) "
       "skips the walk.  Overridden per step by make_train_step(cost=).")
 
+_decl("MXTPU_NUMERICS", str, "off",
+      "graftrange trace-time value-range & precision analysis for "
+      "fused train steps and serving engines (analysis/value_range.py, "
+      "docs/ANALYSIS.md GL4xx): 'warn' surfaces GL401-GL405 findings "
+      "(overflow-to-inf, invalid domains, bf16-unsafe demoted edges, "
+      "silent f64 promotion, loss-scale advisory) on the pre-compile "
+      "trace, 'error' raises before any compile, 'off' (default) "
+      "skips the walk.  Also gates amp_bf16 per-op (GL403).  "
+      "Overridden per builder by make_train_step(numerics=) / "
+      "ServeEngine(numerics=).")
+
 _decl("MXTPU_PASSES", str, "",
       "graftpass pipeline for trace-time jaxpr rewrites (analysis/"
       "passes.py, docs/PASSES.md): comma-separated registry names "

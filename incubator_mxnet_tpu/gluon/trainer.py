@@ -143,7 +143,7 @@ class Trainer:
                         lint=None, lint_suppress=(),
                         nonfinite=None, loss_scale=None, cost=None,
                         hbm_budget=None, cost_device="tpu-v5e",
-                        passes=None):
+                        passes=None, numerics=None, input_range=None):
         """Build a fused XLA train step from this Trainer's optimizer.
 
         The reference's Trainer.step chain (forward → backward → kvstore
@@ -181,6 +181,13 @@ class Trainer:
         ``"check"`` rejects a config whose predicted peak memory
         exceeds ``hbm_budget`` — GL201 — before any compile); see
         ``parallel.make_train_step`` and ``docs/ANALYSIS.md``.
+
+        ``numerics``/``input_range`` switch on the graftrange value-
+        range & precision analysis (``analysis/value_range.py``,
+        GL401–GL405: overflow-to-inf, invalid domains, bf16-unsafe
+        demoted edges, silent f64 promotion, loss-scale advisory) over
+        the same pre-compile trace — ``"error"`` rejects the program
+        before any compile; see ``parallel.make_train_step``.
 
         ``passes`` runs the graftpass jaxpr→jaxpr rewrite pipeline
         (``analysis/passes.py``, docs/PASSES.md) over the traced step
@@ -285,7 +292,8 @@ class Trainer:
                          lint_suppress=lint_suppress, nonfinite=nonfinite,
                          loss_scale=loss_scale, cost=cost,
                          hbm_budget=hbm_budget, cost_device=cost_device,
-                         passes=passes)
+                         passes=passes, numerics=numerics,
+                         input_range=input_range)
         # the guard tracks EVERY live zero=1 step built from this
         # Trainer (weakrefs: the guard must not pin params/optimizer
         # state alive, and dies with its step) — the legacy host-side

@@ -18,7 +18,35 @@ from jax import lax
 
 from .registry import register
 
-__all__ = ["dequantize_tensor", "quantize_tensor"]
+__all__ = ["dequantize_tensor", "quantize_tensor", "symmetric_quantize"]
+
+
+def symmetric_quantize(w, qmax=127.0):
+    """Symmetric per-tensor quantization: ``(int8-container codes,
+    amax_f32)`` with scale ``qmax/amax`` — the one guarded
+    implementation shared by :func:`quantize_tensor` (qmax 127) and
+    the ``quantize_int8``/``quantize_int4`` graftpasses (qmax 127/7).
+
+    Degenerate-tensor guard (graftrange GL402 flags the unguarded
+    form): an all-zero tensor has ``amax == 0``, so a bare
+    ``qmax/amax`` divides by zero, and a NaN'd channel poisons ``amax``
+    so ``rint(NaN)`` lands undefined int8 codes.  The divisor is
+    clamped away from zero (``jnp.maximum(amax, tiny)`` — a *known*
+    positive lower bound, not a ``where`` whose untaken arm still
+    divides), non-finite codes are zeroed, and a degenerate tensor
+    publishes ``amax = 0`` so ``dequantize`` reconstructs exact
+    zeros."""
+    qmax = jnp.float32(qmax)
+    amax = jnp.max(jnp.abs(w)).astype(jnp.float32)
+    ok = jnp.isfinite(amax) & (amax > 0)
+    amax = jnp.where(ok, amax, jnp.float32(0.0))
+    scale = jnp.where(
+        ok, qmax / jnp.maximum(amax, jnp.float32(2.0 ** -126)),
+        jnp.float32(1.0))
+    q = jnp.rint(w.astype(jnp.float32) * scale)
+    q = jnp.where(jnp.isfinite(q), q, jnp.float32(0.0))
+    q = jnp.clip(q, -qmax, qmax).astype(jnp.int8)
+    return q, amax
 
 
 def quantize_tensor(w):
@@ -30,12 +58,11 @@ def quantize_tensor(w):
     127/amax, zero-point free), so a tensor round-tripped through the
     engine and one through the reference-parity ops land on identical
     codes.  Returns float32 ``amax`` so ``dequantize_tensor`` is
-    dtype-stable regardless of the input precision."""
-    amax = jnp.max(jnp.abs(w)).astype(jnp.float32)
-    scale = jnp.where(amax > 0, 127.0 / amax, 1.0)
-    q = jnp.clip(jnp.rint(w.astype(jnp.float32) * scale),
-                 -127, 127).astype(jnp.int8)
-    return q, amax
+    dtype-stable regardless of the input precision.  All-zero and
+    non-finite inputs are contained (zero codes, ``amax = 0``) instead
+    of dividing by zero into NaN codes — see
+    :func:`symmetric_quantize`."""
+    return symmetric_quantize(w, qmax=127.0)
 
 
 def dequantize_tensor(q, amax, dtype=jnp.float32):

@@ -143,6 +143,23 @@ class FunctionalOptimizer:
             return list(per_param) if self.momentum else []
         return [(s, s) for s in per_param]
 
+    def state_range_hints(self):
+        """Per-LEAF ``(lo, hi)`` value-range seeds for ONE parameter's
+        state tuple, congruent with :meth:`init`'s structure — the
+        graftrange analysis' (``analysis/value_range.py``) knowledge of
+        optimizer-state invariants: variance accumulators are
+        non-negative by construction (they average squared gradients),
+        so ``sqrt(var)+eps`` divides clean; momentum/master-weight
+        leaves are unknown."""
+        var = (0.0, None)
+        if self.multi_precision:
+            if self.name == "sgd":
+                return [None, None] if self.momentum else [None]
+            return [None, var, None]       # adam: mean, var, w32
+        if self.name == "sgd":
+            return [None] if self.momentum else []
+        return [None, var]                 # adam/lamb/adamw: mean, var
+
     def apply_single(self, p, g, s, step_count):
         """One parameter's update: ``(weight, grad, state, step)`` →
         ``(new_weight, new_state)``.
@@ -252,7 +269,8 @@ class TrainStep:
                  loss_scale=None, cost: Optional[str] = None,
                  hbm_budget: Optional[float] = None,
                  cost_device: str = "tpu-v5e",
-                 passes=None):
+                 passes=None, numerics: Optional[str] = None,
+                 input_range=None):
         self.net = net
         self.loss_fn = loss_fn
         self.opt = opt
@@ -364,6 +382,26 @@ class TrainStep:
                              % (cost_device, sorted(_SPECS)))
         self.cost_device = cost_device
         self.cost_report = None  # set by the cost pass (cost != "off")
+        # graftrange rides the same pre-compile trace (analysis/
+        # value_range.py, docs/ANALYSIS.md GL4xx): an abstract value-
+        # range & precision interpreter over the step program.  "warn"
+        # surfaces GL401-GL405 findings, "error" raises BEFORE any
+        # compile (like cost="check"'s GL201), "off" (default) skips
+        # the walk.  Resolution: explicit arg > MXTPU_NUMERICS > "off".
+        self.numerics = _resolve_mode(numerics, "MXTPU_NUMERICS", "off",
+                                      ("off", "warn", "error"),
+                                      "numerics")
+        # input_range: declared value range of the batch — a (lo, hi)
+        # tuple for x, or a dict {"x": (lo, hi), "y": (lo, hi)}.  Seeds
+        # the range analysis; everything unannotated defaults
+        # conservatively (floats unknown-finite, ints to dtype range).
+        if input_range is not None and not isinstance(input_range,
+                                                      (tuple, list, dict)):
+            raise ValueError(
+                "input_range must be a (lo, hi) tuple for x or a dict "
+                "{'x': (lo, hi), 'y': (lo, hi)}; got %r" % (input_range,))
+        self.input_range = input_range
+        self.range_report = None  # set by the numerics pass
         # graftpass: an ordered jaxpr->jaxpr rewrite pipeline applied to
         # the traced step before its first compile (analysis/passes.py,
         # docs/PASSES.md).  Resolution: explicit arg > MXTPU_PASSES env
@@ -974,6 +1012,9 @@ class TrainStep:
                           for k, v in dict(self.mesh.shape).items()}
             n_dev = int(self.mesh.size)
             multihost = spans_processes(self.mesh)
+        num_seeds = None
+        if self.numerics != "off":
+            num_seeds = self._numerics_seeds(tuple(example_args))[0]
         ctx = PassContext(
             param_invars=frozenset(),  # donated+updated: not quantizable
             allow_invar_change=False,
@@ -983,6 +1024,10 @@ class TrainStep:
             # a process-spanning program cannot be evaluated eagerly on
             # this host alone; abstract eval + re-lint still gate it
             probe="off" if (multihost or not probe) else "auto",
+            # the graftrange hookup: amp_bf16's per-op GL403 gate rides
+            # the step's numerics mode and input annotations
+            numerics=self.numerics,
+            input_ranges=num_seeds,
             where="fused train step")
         mgr = PassManager(self._passes, device=self.cost_device,
                           n_devices=n_dev)
@@ -1034,7 +1079,8 @@ class TrainStep:
         walks ``self._jit.trace(...)`` — the very trace jit caches for
         the first call — so it costs one jaxpr walk, not an extra
         trace; steady-state steps pay nothing."""
-        if self._linted or (self.lint == "off" and self.cost == "off"):
+        if self._linted or (self.lint == "off" and self.cost == "off"
+                            and self.numerics == "off"):
             return
         self._lint_trace(self._jit, tuple(example_args))
 
@@ -1049,6 +1095,7 @@ class TrainStep:
 
         lint_here = self.lint != "off" and not self._linted
         cost_here = self.cost != "off" and not self._linted
+        num_here = self.numerics != "off" and not self._linted
         traced, effects = traced_with_effects(jit_obj, tuple(args),
                                               capture=lint_here)
         if lint_here and self._pass_effects:
@@ -1061,7 +1108,12 @@ class TrainStep:
             # same trace, one more walk: the cost model's GL201 gate
             # fires HERE — before lower/compile ever run
             self._finish_cost(traced.jaxpr, args)
-        if lint_here or cost_here:
+        if num_here:
+            # same trace, the graftrange walk: GL401-GL405 fire HERE,
+            # before lower/compile — numerics="error" rejects the
+            # program with zero compiles spent
+            self._finish_numerics(traced.jaxpr, args)
+        if lint_here or cost_here or num_here:
             self._linted = True
         return traced
 
@@ -1265,6 +1317,163 @@ class TrainStep:
         traced = self._jit.trace(*args)
         return self._cost_analyze(traced.jaxpr, args, device=device,
                                   hbm_budget=hbm_budget)
+
+    # ------------------------------------------------------------------
+    # graftrange (analysis/value_range.py, docs/ANALYSIS.md GL4xx)
+    def _numerics_seeds(self, example_args):
+        """``(input_ranges, invar_labels)`` for the step program's flat
+        invars: declared batch annotations (``input_range=``),
+        optimizer-state invariants (variance accumulators are
+        non-negative), the loss-scale config's bounds and the 1-based
+        step counter.  Params/aux default to unknown-finite — training
+        moves them, so an observed init range would be a lie."""
+        (p_vals, aux_vals, opt_state, _x, _y, _key, _step,
+         _scaler) = example_args
+        seeds: Dict[int, Any] = {}
+        labels: Dict[int, str] = {}
+        idx = 0
+        for p in self._gp:
+            labels[idx] = "param:%s" % p.name
+            idx += 1
+        for p in self._aux:
+            labels[idx] = "aux:%s" % p.name
+            idx += 1
+        state_leaves = len(jax.tree_util.tree_leaves(opt_state))
+        hints = self.opt.state_range_hints()
+        if hints and self._gp and \
+                state_leaves == len(self._gp) * len(hints):
+            for i, p in enumerate(self._gp):
+                for j, h in enumerate(hints):
+                    labels[idx] = "opt:%s[%d]" % (p.name, j)
+                    if h is not None:
+                        seeds[idx] = h
+                    idx += 1
+        else:
+            idx += state_leaves
+        ir = self.input_range
+        x_r = y_r = None
+        if isinstance(ir, dict):
+            x_r, y_r = ir.get("x"), ir.get("y")
+        elif ir is not None:
+            x_r = tuple(ir)
+        labels[idx] = "x"
+        if x_r is not None:
+            seeds[idx] = tuple(x_r)
+        idx += 1
+        labels[idx] = "y"
+        if y_r is not None:
+            seeds[idx] = tuple(y_r)
+        idx += 1
+        labels[idx] = "rng_key"
+        idx += 1
+        labels[idx] = "step"
+        # the carried counter is incremented BEFORE the update applies,
+        # so adam's 1-beta**t bias correction sees t >= 1 (never /0)
+        seeds[idx] = (0.0, float(2**31 - 1))
+        idx += 1
+        if self._dynamic_scale:
+            cfg = self._scale_cfg
+            scale_seed = (cfg.min_loss_scale, cfg.max_loss_scale, True)
+        elif self._scale_cfg is not None:
+            s = float(self._scale_cfg)
+            scale_seed = (s, s, True)
+        else:
+            scale_seed = (1.0, 1.0, True)
+        for name, seed in (("loss_scale", scale_seed),
+                           ("ls_unskipped", (0.0, float(2**31 - 1))),
+                           ("ls_skipped", (0.0, float(2**31 - 1)))):
+            labels[idx] = name
+            seeds[idx] = seed
+            idx += 1
+        return seeds, labels
+
+    def _numerics_analyze(self, closed_jaxpr, example_args):
+        """One RangeReport for the traced step program: the GL401/402/
+        403/404 value-range walk seeded with this step's annotations,
+        plus the GL405 loss-scale advisory from the step config."""
+        from ..analysis.value_range import analyze_ranges, loss_scale_diags
+
+        seeds, labels = self._numerics_seeds(example_args)
+        axis_sizes = None
+        if self.mesh is not None:
+            axis_sizes = {k: int(v)
+                          for k, v in dict(self.mesh.shape).items()}
+        report = analyze_ranges(
+            closed_jaxpr, input_ranges=seeds, invar_labels=labels,
+            axis_sizes=axis_sizes,
+            meta={"what": "fused train step",
+                  "compute_dtype": str(self.compute_dtype),
+                  "loss_scale": repr(self._scale_cfg),
+                  "input_range": repr(self.input_range)})
+        report.diagnostics.extend(loss_scale_diags(
+            self.compute_dtype,
+            self._scale_cfg if isinstance(self._scale_cfg, float)
+            else None,
+            self._dynamic_scale,
+            where="TrainStep(loss_scale=%r, compute_dtype=%r)"
+                  % (self._scale_cfg, self.compute_dtype)))
+        # pass-emitted numerics advisories (amp_bf16's GL403 per-op
+        # exclusions) belong in the step's numerics report too
+        for r in (self.pass_receipts or ()):
+            report.diagnostics.extend(
+                d for d in r.diagnostics if d.code.startswith("GL4"))
+        return report
+
+    def _finish_numerics(self, closed_jaxpr, example_args):
+        """The in-step numerics pass: store ``step.range_report``;
+        ``numerics="error"`` raises :class:`~..analysis.LintError` on
+        error-severity GL4xx findings BEFORE lower/compile (the GL201
+        discipline), ``"warn"`` warns them."""
+        from ..analysis import LintReport, Severity
+
+        report = self._numerics_analyze(closed_jaxpr, example_args)
+        rep = LintReport(suppress=self.lint_suppress)
+        rep.extend(report.diagnostics)
+        report.diagnostics = list(rep.diagnostics)
+        self.range_report = report
+        if self.numerics == "error":
+            rep.raise_if_errors()
+        if rep.diagnostics:
+            import warnings as _warnings
+
+            _warnings.warn("graftrange: fused train step has findings\n"
+                           + rep.format(Severity.WARNING), stacklevel=5)
+
+    def analyze_numerics(self, x, y, input_range=None):
+        """Range-analyze the step for the given batch WITHOUT compiling
+        or running it (abstract ``jit.trace`` — the trace the first
+        real call reuses; with a pass pipeline configured the analyzed
+        program is the REWRITTEN one, so an amp_bf16 demotion shows its
+        bf16 edges).  Returns the
+        :class:`~..analysis.value_range.RangeReport`; mode policy is
+        NOT applied — the caller (the autotuner's GL403/GL405 pruning)
+        reads ``report.errors`` itself.  ``input_range`` overrides the
+        step's annotation for this analysis."""
+        self._ensure_built()
+        if input_range is not None:
+            prev, self.input_range = self.input_range, input_range
+        else:
+            prev = self.input_range
+
+        def aval(a):
+            if isinstance(a, jax.ShapeDtypeStruct):
+                return a
+            if isinstance(a, NDArray):
+                a = a._data
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        try:
+            pv = [aval(p._data._data) for p in self._gp]
+            av = [aval(p._data._data) for p in self._aux]
+            sv = jax.tree_util.tree_map(aval, self._opt_state)
+            args = (pv, av, sv, aval(x), aval(y), aval(self._key_dev),
+                    aval(self._step_dev),
+                    tuple(aval(v) for v in self._scaler_dev))
+            self._maybe_apply_passes(args, probe=False)
+            traced = self._jit.trace(*args)
+            return self._numerics_analyze(traced.jaxpr, args)
+        finally:
+            self.input_range = prev
 
     # ------------------------------------------------------------------
     def _ensure_built(self):
@@ -1973,6 +2182,7 @@ def make_train_step(net, loss_fn, optimizer="sgd", mesh=None, batch_axis="dp",
                     pipeline_remat=False, zero=0, lint=None, lint_suppress=(),
                     nonfinite=None, loss_scale=None, cost=None,
                     hbm_budget=None, cost_device="tpu-v5e", passes=None,
+                    numerics=None, input_range=None,
                     **opt_kwargs) -> TrainStep:
     """Build the fused train step (fwd+bwd+optimizer in one XLA program).
 
@@ -2037,6 +2247,26 @@ def make_train_step(net, loss_fn, optimizer="sgd", mesh=None, batch_axis="dp",
     no-op on a train step (its params are donated and updated in
     place); they belong on ``ServeEngine(passes=...)``.
 
+    ``numerics`` (default: env ``MXTPU_NUMERICS``, else ``"off"``) runs
+    the graftrange value-range & precision abstract interpreter over
+    the same pre-compile trace (``analysis/value_range.py``,
+    docs/ANALYSIS.md GL4xx): per-variable intervals, NaN-possibility
+    and effective precision, checked as GL401 (possible overflow-to-inf
+    — exp of unbounded logits without max-subtraction), GL402
+    (invalid-domain op — log/rsqrt/div reachable at ≤0, the
+    E[x²]−E[x]² cancellation), GL403 (bf16 under/overflow on a demoted
+    edge — the per-op ``amp_bf16`` installation gate), GL404 (silent
+    f64/weak-type promotion — the hand-fixed adam/attention-scale bug
+    class) and GL405 (loss-scale advisory naming the suggested scale).
+    ``"error"`` raises :class:`~..analysis.LintError` *before any
+    compile*; findings surface as ``step.range_report``
+    (:class:`~..analysis.value_range.RangeReport`), and
+    ``step.analyze_numerics(x, y)`` runs the walk on demand with zero
+    compiles.  ``input_range`` declares the batch's real value range —
+    a ``(lo, hi)`` tuple for ``x`` or ``{"x": (lo, hi), "y": ...}`` —
+    sharpening the analysis (unannotated floats are assumed
+    unknown-but-finite; integer/uint8 inputs seed from their dtype).
+
     ``nonfinite`` contains bad steps INSIDE the program: ``"skip"``
     leaves params, aux state, optimizer state and the step counter
     bit-identical when any gradient is non-finite (one fused all-finite
@@ -2063,4 +2293,5 @@ def make_train_step(net, loss_fn, optimizer="sgd", mesh=None, batch_axis="dp",
                      pipeline_remat=pipeline_remat, zero=zero, lint=lint,
                      lint_suppress=lint_suppress, nonfinite=nonfinite,
                      loss_scale=loss_scale, cost=cost, hbm_budget=hbm_budget,
-                     cost_device=cost_device, passes=passes)
+                     cost_device=cost_device, passes=passes,
+                     numerics=numerics, input_range=input_range)

@@ -33,9 +33,14 @@ buckets**:
 - ``passes=(...)`` runs any registered graftpass pipeline over every
   bucket program before compile (GL301/GL302 refuse a rewrite that
   breaks its declaration — zero compiles spent; docs/PASSES.md);
-- the ``lint=`` / ``cost=`` trace hooks ride the same pre-compile
-  ``jit.trace()`` the first call reuses, exactly like the fused train
-  step (shared plumbing: ``parallel/aot.py``);
+- the ``lint=`` / ``cost=`` / ``numerics=`` trace hooks ride the same
+  pre-compile ``jit.trace()`` the first call reuses, exactly like the
+  fused train step (shared plumbing: ``parallel/aot.py``).
+  ``numerics=`` runs the graftrange value-range walk
+  (``analysis/value_range.py``, GL401–GL404) seeded from the OBSERVED
+  served weights and the warmup sample — frozen weights make the
+  engine's seeds ground truth — surfacing ``engine.range_report`` and
+  gating ``amp_bf16`` per demoted op (GL403);
 - params are **versioned**: :meth:`ServeEngine.update_params` swaps the
   device-resident version under live traffic with zero recompiles
   (same shapes ⇒ same AOT programs; GL011 eagerly rejects drift),
@@ -100,7 +105,7 @@ class ServeEngine:
                  cost: Optional[str] = None,
                  hbm_budget: Optional[float] = None,
                  cost_device: str = "tpu-v5e",
-                 passes=None):
+                 passes=None, numerics: Optional[str] = None):
         self.net = net
         self.buckets = tuple(sorted(int(b) for b in buckets))
         if not self.buckets or any(b < 1 for b in self.buckets):
@@ -158,6 +163,18 @@ class ServeEngine:
         self.cost_device = cost_device
         self.cost_report = None       # most recently analyzed bucket
         self.cost_reports: Dict[tuple, Any] = {}  # per program key
+        # graftrange (analysis/value_range.py, docs/ANALYSIS.md GL4xx):
+        # value-range & precision walk over the first bucket's
+        # pre-compile trace, seeded from the OBSERVED param values
+        # (served weights are frozen, so their real min/max is truth)
+        # and the warmup sample's observed range.  "error" raises
+        # before any compile; findings land in engine.range_report.
+        self.numerics = resolve_mode(numerics, "MXTPU_NUMERICS", "off",
+                                     ("off", "warn", "error"),
+                                     "numerics")
+        self.range_report = None
+        self._param_obs: Optional[List[Any]] = None   # VRange seeds
+        self._sample_obs = None                       # VRange seed
         self._linted = False
         # the persistent program table: (bucket, sample shape, dtype) ->
         # compiled executable — the engine-lifetime analog of the
@@ -255,6 +272,10 @@ class ServeEngine:
         raw = [p._data._data for p in self._params]
         self._param_sig = [(p.name, tuple(v.shape), np.dtype(v.dtype))
                            for p, v in zip(self._params, raw)]
+        if self.numerics != "off":
+            from ..analysis.value_range import observed_range
+
+            self._param_obs = [observed_range(v) for v in raw]
         vals, quant = self._prepare_vals(raw)
         self._quantized = quant
         self._live = (1, vals)
@@ -358,6 +379,127 @@ class ServeEngine:
             self._linted = True
         if self.cost != "off":
             self._finish_cost(traced.jaxpr, args, bucket)
+        if self.numerics != "off" and self.range_report is None:
+            # once per engine, like the lint (the program family is the
+            # same modulo the batch extent)
+            self._finish_numerics(traced.jaxpr, bucket)
+
+    def _numerics_seeds(self):
+        """``(input_ranges, labels)`` over the flat ``(p_vals, x)``
+        invars: observed per-param extrema (frozen served weights) and
+        the warmup sample's observed range for ``x``."""
+        seeds: Dict[int, Any] = {}
+        labels: Dict[int, str] = {}
+        idx = 0
+        obs = self._param_obs or []
+        for p, o in zip(self._params, obs):
+            labels[idx] = "param:%s" % p.name
+            if o is not None:
+                seeds[idx] = o        # an observed_range VRange seed
+            idx += 1
+        labels[idx] = "x"
+        if self._sample_obs is not None:
+            seeds[idx] = self._sample_obs
+        return seeds, labels
+
+    def _finish_numerics(self, closed_jaxpr, bucket, receipts=()):
+        """The engine-side graftrange pass: GL401/402/403/404 over the
+        traced inference program, observed-value seeded; "error" raises
+        BEFORE the bucket program compiles (the GL201 discipline).
+        ``receipts``: pass receipts whose GL4xx advisories (amp_bf16's
+        per-op GL403 exclusions) join the report."""
+        from ..analysis import LintReport, Severity
+        from ..analysis.value_range import analyze_ranges
+
+        seeds, labels = self._numerics_seeds()
+        axis_sizes = None
+        if self.mesh is not None:
+            axis_sizes = {k: int(v)
+                          for k, v in dict(self.mesh.shape).items()}
+        report = analyze_ranges(
+            closed_jaxpr, input_ranges=seeds, invar_labels=labels,
+            axis_sizes=axis_sizes,
+            meta={"what": "ServeEngine(%s)" % self.net.name,
+                  "bucket": bucket, "dtype": self.dtype or "net",
+                  "seeded": "observed params + warmup sample"})
+        for r in receipts:
+            report.diagnostics.extend(
+                d for d in r.diagnostics if d.code.startswith("GL4"))
+        rep = LintReport(suppress=self.lint_suppress)
+        rep.extend(report.diagnostics)
+        report.diagnostics = list(rep.diagnostics)
+        self.range_report = report
+        if self.numerics == "error":
+            rep.raise_if_errors()
+        if rep.diagnostics:
+            import warnings as _warnings
+
+            _warnings.warn(
+                "graftrange: inference program has findings\n"
+                + rep.format(Severity.WARNING), stacklevel=5)
+
+    def _swap_numerics_check(self, raw) -> Optional[str]:
+        """Re-seed the graftrange analysis from the SWAP CANDIDATE's
+        observed extrema and re-walk the served program family (the
+        installed post-pass program when one exists — its bf16 demoted
+        edges are re-checked against the new weights; else an abstract
+        re-trace of the base program).  Zero compiles.  Updates
+        ``_param_obs`` and ``range_report`` so they describe the
+        version about to serve; returns an error description (the
+        SwapRejected reason under ``numerics="error"``) or None.  The
+        warmup-time verdict would otherwise silently go stale across a
+        hot swap — "served weights never change" stopped being true
+        when ``update_params`` shipped."""
+        from ..analysis import Severity
+        from ..analysis.value_range import analyze_ranges, observed_range
+
+        self._param_obs = [observed_range(v) for v in raw]
+        closed = None
+        if self._pass_result is not None \
+                and not self._pass_result.invar_splits:
+            closed = self._pass_result.closed_jaxpr
+        else:
+            # base-program re-trace on the smallest bucket (abstract:
+            # jit.trace over avals, no compile); quantize-split engines
+            # take this path too — their float layout is what the
+            # observed seeds index
+            if self._pass_base_jit is None:
+                self._pass_base_jit = jax.jit(self._infer_fn())
+            warmed = [b for b in self.buckets
+                      if self._program_key(b) in self._programs]
+            b = warmed[0] if warmed else self.buckets[0]
+            x_aval = jax.ShapeDtypeStruct(
+                (b,) + tuple(self.sample_shape),
+                np.dtype(self.sample_dtype))
+            closed = self._pass_base_jit.trace(
+                self._pass_param_avals(), x_aval).jaxpr
+        seeds, labels = self._numerics_seeds()
+        axis_sizes = None
+        if self.mesh is not None:
+            axis_sizes = {k: int(v)
+                          for k, v in dict(self.mesh.shape).items()}
+        report = analyze_ranges(
+            closed, input_ranges=seeds, invar_labels=labels,
+            axis_sizes=axis_sizes,
+            meta={"what": "ServeEngine(%s)" % self.net.name,
+                  "swap": True,
+                  "seeded": "observed candidate params + warmup sample"})
+        self.range_report = report
+        errs = [d for d in report.diagnostics
+                if d.severity >= Severity.ERROR]
+        if errs and self.numerics == "error":
+            return ("graftrange: swap candidate fails the numerics "
+                    "gate: "
+                    + "; ".join("%s: %s" % (d.code, d.message[:160])
+                                for d in errs[:2]))
+        if report.diagnostics:
+            import warnings as _warnings
+
+            _warnings.warn(
+                "graftrange: swap candidate has findings\n"
+                + "\n".join(d.format() for d in report.diagnostics),
+                stacklevel=4)
+        return None
 
     def _finish_cost(self, closed_jaxpr, args, bucket):
         from ..analysis import LintReport, Severity
@@ -450,6 +592,8 @@ class ServeEngine:
             # tolerance/argmax probe; later buckets share the verified
             # contract (same program family, batch extent aside)
             overrides = dict(enumerate(self._live[1]))
+        num_seeds = self._numerics_seeds()[0] \
+            if self.numerics != "off" else None
         ctx = PassContext(
             param_invars=frozenset(range(len(self._param_sig))),
             donated_leaves=tuple(donated_leaf_indices(
@@ -457,11 +601,21 @@ class ServeEngine:
             axis_sizes=axis_sizes,
             probe="auto" if first else "off",
             probe_overrides=overrides,
+            numerics=self.numerics,
+            input_ranges=num_seeds,
             where="ServeEngine(%s, bucket=%d)" % (self.net.name, bucket))
         mgr = PassManager(self.passes, device=self.cost_device,
                           n_devices=n_dev)
         result = mgr.run(traced.jaxpr, ctx)
         self.pass_receipts[key] = result.receipts
+        if self.numerics != "off" and self.range_report is None:
+            # numerics over the BASE (float-param) trace — the
+            # rewritten program is separately verified by its pass
+            # contracts and the observed seeds index the float invar
+            # layout — with the pipeline's GL4xx advisories (amp's
+            # per-op GL403 exclusions) merged into the report
+            self._finish_numerics(traced.jaxpr, bucket,
+                                  receipts=result.receipts)
         if first:
             self._pass_result = result
             ver, vals = self._live
@@ -578,6 +732,12 @@ class ServeEngine:
                    self.sample_dtype))
         self.sample_shape = tuple(sample.shape)
         self.sample_dtype = np.dtype(sample.dtype)
+        if self.numerics != "off" and self._sample_obs is None:
+            # the observed warmup sample seeds x's value range for the
+            # graftrange walk (advisory: later requests may exceed it)
+            from ..analysis.value_range import observed_range
+
+            self._sample_obs = observed_range(sample)
         self._collect()
         total = {"trace": 0.0, "compile": 0.0}
         for b in (self.buckets if buckets is None
@@ -746,6 +906,23 @@ class ServeEngine:
                 raise RuntimeError(  # unreachable post-GL011; belt+braces
                     "candidate quantization layout drifted from the "
                     "served one")
+            if self.numerics != "off":
+                # re-run the range walk with the CANDIDATE's observed
+                # extrema (zero compiles) — under "error" a candidate
+                # that fails the gate (e.g. weights below the bf16
+                # subnormal on a demoted edge: finite-but-zero output
+                # the default canary cannot see) is rejected like a
+                # failed canary, old version keeps serving
+                reason_n = self._swap_numerics_check(raw)
+                if reason_n is not None and self.numerics == "error":
+                    from .resilience import SwapRejected as _SR
+
+                    self.rollback_count += 1
+                    self.swap_log.append({"version": self._live[0] + 1,
+                                          "ok": False,
+                                          "reason": reason_n,
+                                          "t": time.time()})
+                    raise _SR(reason_n)
             if self.mesh is not None:
                 vals = self._place_vals(vals)
             # --- canary: replay an EXISTING program (no compile, no

@@ -517,6 +517,9 @@ def test_resplit_iter_state_policies():
 # the 2↔1-process kill-and-rejoin smoke test (subprocess harness)
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # tier-1 budget (~47 s): the two-process kill/rejoin
+# smoke; the in-process elastic parity matrix above keeps covering the
+# restore semantics in tier-1
 def test_kill_and_rejoin_2_to_1_processes(tmp_path):
     """2-process jax.distributed CPU run killed mid-epoch by a
     fault-injected host loss during a save → the torn multi-process

@@ -151,6 +151,8 @@ def test_trainer_sgd_step():
     np.testing.assert_allclose(net.weight.data().asnumpy(), [[0.5, 0.5]], rtol=1e-6)
 
 
+@pytest.mark.slow  # tier-1 budget (~23 s): many-epoch MLP convergence;
+# test_rnn.py::test_lstm_lm_learns stays as the in-budget learns leg
 def test_train_mlp_convergence():
     """End-to-end: learn XOR-ish separable data (reference tests/python/train)."""
     mx.random.seed(0)

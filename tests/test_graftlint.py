@@ -597,3 +597,74 @@ def test_graftcost_cli_gate_and_json(capsys):
     assert "GL201" in codes
     for d in obj["diagnostics"]:
         assert set(d) == {"code", "severity", "message", "where", "hint"}
+
+
+# ---------------------------------------------------------------------------
+# --format=sarif (SARIF 2.1.0 for CI code-scanning UIs)
+# ---------------------------------------------------------------------------
+
+def _validate_sarif_2_1_0(log):
+    """Structural validation against the SARIF 2.1.0 schema's required
+    shape (no jsonschema dependency in the image: the invariants below
+    ARE the schema's required properties for log/run/tool/driver/
+    result/location objects)."""
+    assert set(log) >= {"version", "runs"}
+    assert log["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in log.get("$schema", "")
+    assert isinstance(log["runs"], list) and log["runs"]
+    for run in log["runs"]:
+        assert "tool" in run and "driver" in run["tool"]
+        driver = run["tool"]["driver"]
+        assert isinstance(driver.get("name"), str) and driver["name"]
+        rules = driver.get("rules", [])
+        rule_ids = []
+        for rule in rules:
+            assert isinstance(rule["id"], str)
+            assert "text" in rule.get("shortDescription", {})
+            assert rule.get("defaultConfiguration", {}).get("level") \
+                in ("none", "note", "warning", "error")
+            rule_ids.append(rule["id"])
+        for res in run.get("results", []):
+            assert isinstance(res["message"]["text"], str) \
+                and res["message"]["text"]
+            assert res.get("level") in ("none", "note", "warning",
+                                        "error")
+            assert res["ruleId"] in rule_ids
+            assert rules[res["ruleIndex"]]["id"] == res["ruleId"]
+            for loc in res.get("locations", []):
+                phys = loc["physicalLocation"]
+                assert isinstance(
+                    phys["artifactLocation"]["uri"], str)
+                assert phys["region"]["startLine"] >= 1
+
+
+def test_cli_sarif_format(tmp_path, capsys):
+    import json
+
+    graftlint = _tools_import("graftlint")
+    bad = tmp_path / "bad.py"
+    bad.write_text("from jax import shard_map\n"
+                   "from jax.sharding import PartitionSpec as P\n"
+                   "s = P(0)\n")  # GL101 + GL103
+    rc = graftlint.main([str(bad), "--format", "sarif"])
+    log = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    _validate_sarif_2_1_0(log)
+    results = log["runs"][0]["results"]
+    assert sorted(r["ruleId"] for r in results) == ["GL101", "GL103"]
+    assert all(r["level"] == "error" for r in results)
+    # source findings carry a physical location with the right line
+    gl101 = next(r for r in results if r["ruleId"] == "GL101")
+    phys = gl101["locations"][0]["physicalLocation"]
+    assert phys["artifactLocation"]["uri"].endswith("bad.py")
+    assert phys["region"]["startLine"] == 1
+    # rules metadata comes from the stable catalog
+    rules = {r["id"]: r for r in log["runs"][0]["tool"]["driver"]["rules"]}
+    assert "shard_map" in rules["GL101"]["shortDescription"]["text"]
+    # a clean run is a valid SARIF log with zero results, exit 0
+    rc = graftlint.main([os.path.join(ROOT, "incubator_mxnet_tpu",
+                                      "analysis"), "--format", "sarif"])
+    log = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    _validate_sarif_2_1_0(log)
+    assert log["runs"][0]["results"] == []

@@ -4,11 +4,15 @@ import json
 import os
 
 import numpy as np
+import pytest
 
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import nd
 
 
+@pytest.mark.slow  # tier-1 budget (~42 s): full profiler scope sweep +
+# dump; test_observability2 and the remaining tests here keep the fast
+# observability coverage
 def test_profiler_scopes_and_dump(tmp_path):
     fname = str(tmp_path / "profile.json")
     mx.profiler.set_config(filename=fname)

@@ -50,6 +50,9 @@ def _tpu_available():
 
 
 @pytest.mark.tpu
+@pytest.mark.slow  # tier-1 budget: a dead TPU tunnel pays the full 120 s
+# probe here; the -m tpu pre-bench gate still runs it (ROADMAP note: -m
+# 'not slow' overrides the 'not tpu' addopt, so tier-1 was paying it too)
 def test_tpu_vs_cpu_op_consistency():
     if not _tpu_available():
         pytest.skip("no TPU backend reachable")
@@ -70,6 +73,8 @@ def test_tpu_vs_cpu_op_consistency():
 
 
 @pytest.mark.tpu
+@pytest.mark.slow  # tier-1 budget: the first @tpu test each session pays the
+# full 120 s dead-tunnel probe; keep the whole family behind -m tpu
 def test_int8_quantized_inference_on_tpu():
     """INT8 quantization must COMPILE AND ACCELERATE on the chip: the
     symmetric-int8 conv/fc kernels lower to native int8 MXU ops
@@ -114,6 +119,8 @@ def test_int8_quantized_inference_on_tpu():
 
 
 @pytest.mark.tpu
+@pytest.mark.slow  # tier-1 budget: the first @tpu test each session pays the
+# full 120 s dead-tunnel probe; keep the whole family behind -m tpu
 def test_int8_wire_resnet_on_tpu():
     """The round-4 int8 wire (fold_batch_norm + requantize chaining +
     quantized residual adds) must compile and agree with fp32 on the

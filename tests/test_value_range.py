@@ -1,0 +1,793 @@
+"""graftrange — trace-time value-range & precision analysis (GL4xx).
+
+Covers the abstract domain and its relational refinements, the GL401–
+GL405 diagnostics on known-good vs known-bad fixtures (softmax with vs
+without max-subtraction; clamped vs raw E[x²]−E[x]² variance; the two
+HAND-FIXED f64 promotion bugs re-created in their pre-fix shape), the
+zero-compile ``numerics="error"`` gate on the fused train step, the
+``amp_bf16`` per-op GL403 installation gate, the in-repo model zoo
+(conv-bn / ResNet bench model / TinyDecoderLM) tracing clean, the
+engine's observed-range seeding, the autotuner's GL4xx pruning, and
+the guarded quantization scale (the GL402 satellite).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon
+from incubator_mxnet_tpu.analysis import LintError
+from incubator_mxnet_tpu.analysis.value_range import (
+    BF16_MAX, VRange, analyze_ranges, bf16_fit, loss_scale_diags)
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.parallel.train_step import make_train_step
+
+
+def _codes(report):
+    return sorted({d.code for d in report.diagnostics})
+
+
+def _jx(fn, *avals):
+    return jax.make_jaxpr(fn)(*avals)
+
+
+F32 = jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# the abstract domain + refinements
+# ---------------------------------------------------------------------------
+
+def test_softmax_with_max_subtraction_is_clean():
+    j = _jx(lambda x: jax.nn.softmax(x, axis=-1),
+            jax.ShapeDtypeStruct((4, 8), F32))
+    assert _codes(analyze_ranges(j)) == []
+
+
+def test_log_softmax_is_clean():
+    j = _jx(lambda x: jax.nn.log_softmax(x, axis=-1),
+            jax.ShapeDtypeStruct((4, 8), F32))
+    assert _codes(analyze_ranges(j)) == []
+
+
+def test_softmax_without_max_subtraction_trips_gl401():
+    def bad(x):
+        e = jnp.exp(x)
+        return e / jnp.sum(e, axis=-1, keepdims=True)
+
+    rep = analyze_ranges(_jx(bad, jax.ShapeDtypeStruct((4, 8), F32)))
+    assert "GL401" in _codes(rep)
+    assert any(s["prim"] == "exp" for s in rep.sites["GL401"])
+    # the hint names the fix
+    d = rep.by_code("GL401")[0]
+    assert "max" in d.hint and "input_range" in d.hint
+
+
+def test_masked_softmax_divides_clean():
+    """The TinyDecoderLM attention pattern: a -inf mask before the
+    softmax must not trip the divide check (exp > 0 refinement)."""
+    def att(x):
+        causal = jnp.tril(jnp.ones((8, 8), bool))
+        m = jnp.where(causal, x, -jnp.inf)
+        return jax.nn.softmax(m, axis=-1)
+
+    assert _codes(analyze_ranges(_jx(att,
+                                     jax.ShapeDtypeStruct((8, 8), F32)))) \
+        == []
+
+
+def test_raw_variance_cancellation_trips_gl402():
+    def bad(x):
+        m = jnp.mean(x, axis=0)
+        v = jnp.mean(jnp.square(x), axis=0) - jnp.square(m)
+        return jnp.log(v)
+
+    rep = analyze_ranges(_jx(bad, jax.ShapeDtypeStruct((16, 8), F32)))
+    assert "GL402" in _codes(rep)
+    assert "maximum" in rep.by_code("GL402")[0].hint
+
+
+def test_clamped_variance_is_clean():
+    """The in-repo BatchNorm form: maximum(E[x^2]-E[x]^2, 0) + eps."""
+    def good(x):
+        m = jnp.mean(x, axis=0)
+        v = jnp.maximum(jnp.mean(jnp.square(x), axis=0)
+                        - jnp.square(m), 0.0)
+        return jax.lax.rsqrt(v + 1e-3)
+
+    assert _codes(analyze_ranges(_jx(good,
+                                     jax.ShapeDtypeStruct((16, 8), F32)))) \
+        == []
+
+
+def test_two_pass_variance_is_clean():
+    def good(x):
+        m = jnp.mean(x, axis=0)
+        v = jnp.mean(jnp.square(x - m), axis=0)
+        return jax.lax.rsqrt(v + 1e-3)
+
+    assert _codes(analyze_ranges(_jx(good,
+                                     jax.ShapeDtypeStruct((16, 8), F32)))) \
+        == []
+
+
+def test_unguarded_amax_divide_trips_gl402():
+    """The pre-guard quantization scale: qmax/amax with amax possibly
+    zero (an all-zero weight channel)."""
+    def unguarded(w):
+        amax = jnp.max(jnp.abs(w))
+        scale = jnp.where(amax > 0, 127.0 / amax, 1.0)
+        return jnp.rint(w * scale)
+
+    rep = analyze_ranges(_jx(unguarded, jax.ShapeDtypeStruct((4, 4), F32)))
+    assert "GL402" in _codes(rep)
+    assert any(s["prim"] == "div" for s in rep.sites["GL402"])
+
+
+def test_guarded_symmetric_quantize_is_clean():
+    """ops/quantization.py::symmetric_quantize (the fixed form) traces
+    clean: the divisor is clamped by a KNOWN positive lower bound."""
+    from incubator_mxnet_tpu.ops.quantization import symmetric_quantize
+
+    j = _jx(lambda w: symmetric_quantize(w)[0],
+            jax.ShapeDtypeStruct((4, 4), F32))
+    assert _codes(analyze_ranges(j)) == []
+
+
+def test_annotated_range_compounds_to_proven_overflow():
+    def f(x, w):
+        return (x * x) @ w
+
+    j = _jx(f, jax.ShapeDtypeStruct((4, 8), F32),
+            jax.ShapeDtypeStruct((8, 4), F32))
+    # unannotated: unknown magnitudes absorb — no spurious overflow
+    assert _codes(analyze_ranges(j)) == []
+    # annotated huge: the square + matmul bound provably exceeds f32
+    rep = analyze_ranges(j, input_ranges={0: (0.0, 1e20),
+                                          1: (-1.0, 1.0)})
+    assert _codes(rep) == ["GL401"]
+
+
+def test_deep_matmul_chain_has_no_spurious_overflow():
+    """Unknown magnitudes must stay absorbing through many layers."""
+    def deep(x, w):
+        for _ in range(24):
+            x = jnp.tanh(x @ w) @ w
+        return x
+
+    j = _jx(deep, jax.ShapeDtypeStruct((4, 16), F32),
+            jax.ShapeDtypeStruct((16, 16), F32))
+    assert _codes(analyze_ranges(j)) == []
+
+
+def test_scan_carry_widens_to_fixpoint():
+    def scanned(x):
+        def body(c, _):
+            return c * 1.5 + 1.0, c
+
+        return jax.lax.scan(body, x, jnp.arange(8))
+
+    rep = analyze_ranges(_jx(scanned, jax.ShapeDtypeStruct((4,), F32)),
+                         input_ranges={0: (0.0, 1.0)})
+    # a growing carry widens to unknown-finite, not to a fake inf
+    assert _codes(rep) == []
+
+
+# ---------------------------------------------------------------------------
+# GL404 — the hand-fixed f64 promotion bug class, pre-fix shapes
+# ---------------------------------------------------------------------------
+
+def test_gl404_adam_beta_pow_int_promotion():
+    """PR-3 bug, pre-fix shape: `beta ** int_t` under the package-wide
+    x64 flag promotes the corrected lr (and every updated param)."""
+    def prefix_adam_lr(t):
+        return 0.01 * jnp.sqrt(1 - 0.999 ** t) / (1 - 0.9 ** t)
+
+    j = _jx(prefix_adam_lr, jax.ShapeDtypeStruct((), jnp.int32))
+    assert str(j.jaxpr.outvars[0].aval.dtype) == "float64"  # the bug
+    rep = analyze_ranges(j, input_ranges={0: (1.0, 2.0 ** 31)})
+    assert "GL404" in _codes(rep)
+    assert "float32" in rep.by_code("GL404")[0].hint
+
+
+def test_gl404_np_float64_attention_scale():
+    """PR-8 decoder bug, pre-fix shape: a bare np.float64 scale
+    promotes the whole attention matrix."""
+    def prefix_att(q, k):
+        return jnp.einsum("bqd,bkd->bqk", q, k) * np.float64(0.125)
+
+    j = _jx(prefix_att, jax.ShapeDtypeStruct((2, 4, 16), F32),
+            jax.ShapeDtypeStruct((2, 4, 16), F32))
+    assert "GL404" in _codes(analyze_ranges(j))
+
+
+def test_gl404_silent_on_fixed_f32_forms():
+    def fixed(t, q, k):
+        t32 = jnp.asarray(t, jnp.float32)
+        lr = 0.01 * jnp.sqrt(1 - 0.999 ** t32) / (1 - 0.9 ** t32)
+        att = jnp.einsum("bqd,bkd->bqk", q, k) * np.float32(0.125)
+        return lr, att
+
+    j = _jx(fixed, jax.ShapeDtypeStruct((), jnp.int32),
+            jax.ShapeDtypeStruct((2, 4, 16), F32),
+            jax.ShapeDtypeStruct((2, 4, 16), F32))
+    rep = analyze_ranges(j, input_ranges={0: (1.0, 2.0 ** 31)})
+    assert "GL404" not in _codes(rep)
+
+
+def test_gl404_quiet_when_program_is_deliberately_f64():
+    j = _jx(lambda x: x * 2.0, jax.ShapeDtypeStruct((4,), jnp.float64))
+    assert "GL404" not in _codes(analyze_ranges(j))
+
+
+# ---------------------------------------------------------------------------
+# GL405 — loss-scale advisory
+# ---------------------------------------------------------------------------
+
+def test_gl405_f16_without_scale_warns_with_suggestion():
+    diags = loss_scale_diags("float16", None, dynamic=False)
+    assert [d.code for d in diags] == ["GL405"]
+    assert diags[0].severity.name == "WARNING"
+    assert "2**14" in diags[0].message
+
+
+def test_gl405_oversized_f16_static_scale_is_error():
+    diags = loss_scale_diags("float16", 2.0 ** 20, dynamic=False)
+    assert diags and diags[0].severity.name == "ERROR"
+    assert "2**14" in diags[0].message
+
+
+def test_gl405_bf16_static_scale_pointless_warns():
+    diags = loss_scale_diags("bfloat16", 2.0 ** 15, dynamic=False)
+    assert diags and diags[0].severity.name == "WARNING"
+    assert "exponent range" in diags[0].message
+
+
+def test_gl405_silent_for_dynamic_and_f32_unscaled():
+    assert loss_scale_diags("float16", 2.0 ** 14, dynamic=True) == []
+    assert loss_scale_diags(None, None, dynamic=False) == []
+    assert loss_scale_diags("float32", None, dynamic=False) == []
+
+
+# ---------------------------------------------------------------------------
+# bf16_fit — the GL403 predicate
+# ---------------------------------------------------------------------------
+
+def test_bf16_fit_predicate():
+    assert bf16_fit(VRange(None, None))[0]          # unknown fits
+    assert bf16_fit(VRange(-1e3, 1e3))[0]
+    ok, why = bf16_fit(VRange(0.0, 1e39))
+    assert not ok and "finite max" in why
+    ok, why = bf16_fit(VRange(-1e-42, 1e-42))
+    assert not ok and "subnormal" in why
+    assert BF16_MAX < np.finfo(np.float32).max
+
+
+# ---------------------------------------------------------------------------
+# fused-step integration: numerics= gate, zero compiles
+# ---------------------------------------------------------------------------
+
+def _dense_net(seed=0, out=8):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(out))
+    net.initialize(init=mx.init.Xavier())
+    net(nd.ones((2, 12)))
+    return net
+
+
+def _bad_numerics_loss(out_nd, y_nd):
+    """Softmax WITHOUT max-subtraction + log of the RAW variance
+    cancellation — the known-bad fixture (GL401 + GL402)."""
+    o = out_nd._data
+    e = jnp.exp(o)                                   # GL401
+    p = e / jnp.sum(e, axis=-1, keepdims=True)
+    m = jnp.mean(p, axis=0)
+    v = jnp.mean(jnp.square(p), axis=0) - jnp.square(m)
+    loss = jnp.mean(jnp.log(v + 0.0))                # GL402
+    return nd.NDArray(loss.reshape(1))
+
+
+def test_known_bad_fixture_rejected_before_any_compile():
+    net = _dense_net()
+    step = make_train_step(net, _bad_numerics_loss, optimizer="sgd",
+                           lint="off", numerics="error")
+    x = nd.array(np.random.RandomState(0).rand(4, 12).astype(np.float32))
+    y = nd.array(np.zeros((4,), np.float32))
+    with pytest.raises(LintError) as ei:
+        step(x, y)
+    codes = {d.code for d in ei.value.report.diagnostics}
+    assert "GL401" in codes and "GL402" in codes
+    # zero compiles spent: the autotuner's eager-rejection invariant
+    assert step._compiled is None
+    # warn mode surfaces the same findings and still trains
+    step2 = make_train_step(net, _bad_numerics_loss, optimizer="sgd",
+                            lint="off", numerics="warn")
+    with pytest.warns(UserWarning, match="graftrange"):
+        step2(x, y)
+    assert {d.code for d in step2.range_report.diagnostics} \
+        >= {"GL401", "GL402"}
+
+
+def test_range_report_rows_and_labels():
+    net = _dense_net()
+    step = make_train_step(net, gluon.loss.L2Loss(), optimizer="adam",
+                           lint="off", numerics="warn",
+                           input_range=(0.0, 1.0))
+    x = nd.array(np.random.RandomState(0).rand(4, 12).astype(np.float32))
+    y = nd.array(np.random.RandomState(1).rand(4, 8).astype(np.float32))
+    rep = step.analyze_numerics(x, y)
+    assert step._compiled is None
+    names = [r["name"] for r in rep.rows if r["kind"] == "input"]
+    assert "x" in names and "loss_scale" in names
+    assert any(n.startswith("param:") for n in names)
+    assert any(n.startswith("opt:") for n in names)
+    xrow = next(r for r in rep.rows if r["name"] == "x")
+    assert xrow["lo"] == 0.0 and xrow["hi"] == 1.0
+    # serializable + formatted table
+    d = rep.to_dict()
+    assert d["version"] == 1 and d["rows"]
+    assert "x" in rep.format()
+
+
+# ---------------------------------------------------------------------------
+# model zoo: clean under numerics="error" (with annotations)
+# ---------------------------------------------------------------------------
+
+def _conv_bn_net():
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, in_channels=3))
+    net.add(nn.BatchNorm())
+    net.add(nn.Activation("relu"))
+    net.add(nn.Conv2D(8, 3, padding=1, in_channels=8))
+    net.add(nn.BatchNorm())
+    net.add(nn.Activation("relu"))
+    net.initialize(init=mx.init.Xavier())
+    net(nd.ones((2, 3, 8, 8)))
+    return net
+
+
+@pytest.mark.parametrize("opt_kw", [
+    dict(optimizer="adam"),
+    dict(optimizer="sgd", momentum=0.9, loss_scale="dynamic"),
+    dict(optimizer="adam", multi_precision=True),
+])
+def test_conv_bn_model_traces_clean_under_error(opt_kw):
+    """The graftcost conv-bn model: BN batch stats (the clamped
+    E[x^2]-E[x]^2 form), adam's sqrt(var), the dynamic scaler's
+    1/scale — all clean with seeded state/scale ranges."""
+    net = _conv_bn_net()
+    step = make_train_step(net, gluon.loss.L2Loss(), lint="off",
+                           numerics="error", input_range=(0.0, 1.0),
+                           **opt_kw)
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(4, 3, 8, 8).astype(np.float32))
+    y = nd.array(rng.rand(4, 8, 8, 8).astype(np.float32))
+    rep = step.analyze_numerics(x, y)
+    assert [d.code for d in rep.diagnostics] == []
+    assert step._compiled is None
+
+
+def test_resnet_bench_model_traces_clean_under_error():
+    """The ResNet bench model (vision.resnet50_v1 + softmax CE), at a
+    reduced image size to stay inside the tier-1 budget — the same
+    program family bench.py builds."""
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(0)
+    net = vision.resnet50_v1(classes=10)
+    net.initialize(init=mx.init.Xavier())
+    net.shape_init((1, 3, 32, 32))
+    step = make_train_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           optimizer="sgd", momentum=0.9, lint="off",
+                           numerics="error", input_range=(0.0, 1.0))
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(2, 3, 32, 32).astype(np.float32))
+    y = nd.array(rng.randint(0, 10, 2).astype(np.float32))
+    rep = step.analyze_numerics(x, y)
+    assert [d.code for d in rep.diagnostics] == []
+    assert step._compiled is None
+
+
+def test_tiny_decoder_lm_traces_clean():
+    """TinyDecoderLM full-context + cached-decode programs: LN
+    variances, masked-softmax attention, token-id gathers."""
+    from incubator_mxnet_tpu.serve.cache import TinyDecoderLM, init_cache
+
+    lm = TinyDecoderLM()
+    params = lm.init(jax.random.PRNGKey(0))
+    toks = jax.ShapeDtypeStruct((2, 16), jnp.int32)
+    j = jax.make_jaxpr(lambda p, t: lm.apply_tokens(p, t))(params, toks)
+    assert _codes(analyze_ranges(j)) == []
+    cache = init_cache(lm.n_layers, 2, 32, lm.n_heads, lm.head_dim)
+    j2 = jax.make_jaxpr(lambda p, t, c: lm.apply_step(p, t, c))(
+        params, jax.ShapeDtypeStruct((2,), jnp.int32), cache)
+    assert _codes(analyze_ranges(j2)) == []
+
+
+# ---------------------------------------------------------------------------
+# amp_bf16 per-op gate (GL403)
+# ---------------------------------------------------------------------------
+
+def _scale_squeeze_net():
+    """First matmul sees x*x (blows past bf16 with a huge annotated
+    input range); the second sees tanh-bounded values (always safe)."""
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.d1 = nn.Dense(8)
+            self.d2 = nn.Dense(4)
+
+        def hybrid_forward(self, F, x):
+            h = self.d1(x * x)
+            return self.d2(F.tanh(h * 1e-20))
+
+    mx.random.seed(0)
+    net = Net()
+    net.initialize(init=mx.init.Xavier())
+    net(nd.ones((2, 6)))
+    return net
+
+
+def test_amp_gate_excludes_unsafe_op_and_keeps_safe_ones():
+    net = _scale_squeeze_net()
+    step = make_train_step(net, gluon.loss.L2Loss(), optimizer="sgd",
+                           lint="off", passes=("amp_bf16",),
+                           numerics="warn", input_range=(0.0, 1e25))
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(4, 6).astype(np.float32))
+    y = nd.array(rng.rand(4, 4).astype(np.float32))
+    rep = step.analyze_numerics(x, y)
+    assert step._compiled is None
+    # the per-op exclusion surfaces in the step's numerics report;
+    # GL401 rides along — at this annotation x*x genuinely overflows
+    # f32 too, which the walk proves independently of the amp gate
+    assert _codes(rep) == ["GL401", "GL403"]
+    gl403 = rep.by_code("GL403")[0]
+    assert gl403.severity.name == "WARNING"
+    amp = next(r for r in step.pass_receipts if r.name == "amp_bf16")
+    assert amp.precision is not None
+    assert amp.precision["excluded"] >= 1 and not amp.precision["safe"]
+    assert amp.installed and amp.hits >= 1   # the safe ops still demote
+    assert any(d.code == "GL403" for d in amp.diagnostics)
+
+
+def test_amp_gate_refuses_under_error_with_zero_compiles():
+    net = _scale_squeeze_net()
+    step = make_train_step(net, gluon.loss.L2Loss(), optimizer="sgd",
+                           lint="off", passes=("amp_bf16",),
+                           numerics="error", input_range=(0.0, 1e25))
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(4, 6).astype(np.float32))
+    y = nd.array(rng.rand(4, 4).astype(np.float32))
+    with pytest.raises(LintError) as ei:
+        step(x, y)
+    assert {d.code for d in ei.value.report.diagnostics} == {"GL403"}
+    assert step._compiled is None
+
+
+def test_amp_gate_off_or_in_range_keeps_demoting_everything():
+    """Safe ranges (or numerics off) leave amp_bf16 exactly as before —
+    the existing test_passes parity legs' regime."""
+    net = _scale_squeeze_net()
+    step = make_train_step(net, gluon.loss.L2Loss(), optimizer="sgd",
+                           lint="off", passes=("amp_bf16",),
+                           numerics="warn", input_range=(0.0, 1.0))
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(4, 6).astype(np.float32))
+    y = nd.array(rng.rand(4, 4).astype(np.float32))
+    rep = step.analyze_numerics(x, y)
+    assert [d.code for d in rep.diagnostics] == []
+    amp = next(r for r in step.pass_receipts if r.name == "amp_bf16")
+    assert amp.precision == {"checked": amp.hits, "excluded": 0,
+                             "safe": True, "detail": []}
+    assert amp.installed
+
+
+# ---------------------------------------------------------------------------
+# ServeEngine numerics
+# ---------------------------------------------------------------------------
+
+def test_engine_numerics_observed_seeding_and_gate():
+    from incubator_mxnet_tpu.serve.engine import ServeEngine
+
+    net = _dense_net(seed=3)
+    eng = ServeEngine(net, buckets=(4,), lint="off", numerics="error")
+    eng.warmup(np.linspace(0.0, 1.0, 12, dtype=np.float32))
+    assert eng.range_report is not None
+    assert [d.code for d in eng.range_report.diagnostics] == []
+    rows = {r["name"]: r for r in eng.range_report.rows}
+    xr = rows["x"]
+    assert xr["lo"] == 0.0 and xr["hi"] == 1.0
+    p_rows = [r for r in eng.range_report.rows
+              if r["name"].startswith("param:")]
+    assert p_rows and all(r["lo"] is not None for r in p_rows)
+    out = eng.infer(np.random.RandomState(0)
+                    .rand(3, 12).astype(np.float32))
+    assert np.asarray(out).shape == (3, 8)
+
+
+# ---------------------------------------------------------------------------
+# autotune: GL4xx pruning beside GL201/GL301
+# ---------------------------------------------------------------------------
+
+def test_autotune_prunes_gl4xx_candidates_with_zero_compiles():
+    from incubator_mxnet_tpu.analysis.autotune import (autotune_train,
+                                                       dense_workload)
+
+    make_net, make_batch, loss_fn = dense_workload()
+    space = [
+        {"batch": 8, "zero": 0},
+        {"batch": 8, "zero": 0, "compute_dtype": "float16",
+         "loss_scale": 2.0 ** 20},       # provably-overflowing scale
+    ]
+    res = autotune_train(make_net, make_batch, loss_fn, space=space,
+                         budget_compiles=0, numerics="error",
+                         input_range=(0.0, 1.0))
+    by_scale = {c.knobs.get("loss_scale"): c for c in res.candidates}
+    good, bad = by_scale[None], by_scale[2.0 ** 20]
+    assert good.status == "predicted"
+    assert bad.status == "rejected-infeasible"
+    assert bad.zero_compile is True
+    assert bad.reason.startswith("GL4")
+    assert res.accounted()
+
+
+# ---------------------------------------------------------------------------
+# quantize_tensor guard (the GL402 satellite)
+# ---------------------------------------------------------------------------
+
+def test_quantize_tensor_guard_direct_api():
+    from incubator_mxnet_tpu.ops.quantization import (dequantize_tensor,
+                                                      quantize_tensor)
+
+    # all-zero channel: previously qmax/0 — now zero codes, amax 0
+    q, amax = quantize_tensor(jnp.zeros((4, 4), F32))
+    assert np.asarray(q).dtype == np.int8
+    assert not np.asarray(q).any() and float(amax) == 0.0
+    assert not np.asarray(dequantize_tensor(q, amax)).any()
+    # NaN'd channel: contained to finite (zero) codes
+    w = np.ones((4, 4), np.float32)
+    w[1, 2] = np.nan
+    q, amax = quantize_tensor(jnp.asarray(w))
+    deq = np.asarray(dequantize_tensor(q, amax))
+    assert np.isfinite(np.asarray(q, np.float32)).all()
+    assert np.isfinite(deq).all()
+    # inf poisons amax the same way
+    w = np.ones((4, 4), np.float32)
+    w[0, 0] = np.inf
+    q, amax = quantize_tensor(jnp.asarray(w))
+    assert np.isfinite(float(amax)) and np.isfinite(
+        np.asarray(q, np.float32)).all()
+    # normal tensors: bit-identical to the reference convention
+    rng = np.random.RandomState(0)
+    w = rng.randn(8, 8).astype(np.float32)
+    q, amax = quantize_tensor(jnp.asarray(w))
+    scale = 127.0 / np.abs(w).max()
+    np.testing.assert_array_equal(
+        np.asarray(q), np.clip(np.rint(w * scale), -127,
+                               127).astype(np.int8))
+    assert float(amax) == np.float32(np.abs(w).max())
+
+
+def test_quantize_guard_through_int8_pass():
+    """The quantize_int8 graftpass shares the guarded implementation:
+    a dead (all-zero) weight quantizes to zero codes and the engine
+    serves finite outputs."""
+    from incubator_mxnet_tpu.analysis.passes import get_pass
+    from incubator_mxnet_tpu.serve.engine import ServeEngine
+
+    p = get_pass("quantize_int8")
+    q, amax = p.quantize(jnp.zeros((8, 8), F32))
+    assert not np.asarray(q).any() and float(amax) == 0.0
+    w = np.ones((8, 8), np.float32)
+    w[0] = np.nan
+    q, amax = p.quantize(jnp.asarray(w))
+    assert np.isfinite(np.asarray(q, np.float32)).all()
+
+    net = _dense_net(seed=5)
+    # kill one weight matrix: the dead channel must not NaN the engine
+    params = list(net.collect_params().values())
+    wp = next(p_ for p_ in params if p_.name.endswith("weight"))
+    wp._data._data = jnp.zeros_like(wp._data._data)
+    eng = ServeEngine(net, buckets=(4,), dtype="int8", lint="off")
+    eng.warmup(np.zeros((12,), np.float32))
+    out = np.asarray(eng.infer(np.random.RandomState(0)
+                               .rand(4, 12).astype(np.float32)))
+    assert np.isfinite(out).all()
+
+
+# ---------------------------------------------------------------------------
+# review-hardening regressions
+# ---------------------------------------------------------------------------
+
+def test_gl404_closure_captured_f64_const_is_an_origin_not_a_license():
+    """A captured f64 array must itself flag GL404 — and must NOT
+    disable detection of other f64 promotions in the program."""
+    table = np.linspace(0.0, 1.0, 8)          # float64 ndarray
+
+    def f(x):
+        a = x * jnp.asarray(table)            # f64 const promotes x
+        b = x * np.float64(1.5)               # the scalar-scale bug
+        return a, b
+
+    j = _jx(f, jax.ShapeDtypeStruct((8,), F32))
+    rep = analyze_ranges(j)
+    assert "GL404" in _codes(rep)
+    assert len(rep.sites["GL404"]) >= 2       # both origins, once each
+
+
+def test_autotune_warn_mode_keeps_candidates_ranked():
+    from incubator_mxnet_tpu.analysis.autotune import (autotune_train,
+                                                       dense_workload)
+
+    make_net, make_batch, loss_fn = dense_workload()
+    space = [{"batch": 8, "zero": 0, "compute_dtype": "float16",
+              "loss_scale": 2.0 ** 20}]
+    res = autotune_train(make_net, make_batch, loss_fn, space=space,
+                         budget_compiles=0, numerics="warn",
+                         input_range=(0.0, 1.0))
+    # warn advises, never prunes — the error-mode contract is pruning
+    assert res.candidates[0].status == "predicted"
+
+
+def test_engine_range_report_carries_amp_gate_exclusions():
+    from incubator_mxnet_tpu.serve.engine import ServeEngine
+
+    net = _scale_squeeze_net()
+    # park one served weight entirely below the smallest bf16
+    # subnormal (f32 subnormals live there): demotion would flush the
+    # whole matrix to zero — the observed extrema prove it at load
+    params = list(net.collect_params().values())
+    wp = next(p_ for p_ in params if p_.name.endswith("weight")
+              and p_.shape[1] == 6)   # d1: the x*x-fed matmul
+    wp._data._data = jnp.full(wp.shape, np.float32(1e-42))
+    eng = ServeEngine(net, buckets=(4,), lint="off", numerics="warn",
+                      passes=("amp_bf16",))
+    with pytest.warns(UserWarning, match="graftrange"):
+        eng.warmup(np.ones((6,), np.float32))
+    codes = [d.code for d in eng.range_report.diagnostics]
+    assert "GL403" in codes
+    amp = next(r for r in eng.pass_receipts[list(eng.pass_receipts)[0]]
+               if r.name == "amp_bf16")
+    assert amp.precision["excluded"] >= 1
+
+
+def test_scan_growing_carry_hazard_seen_at_widened_bounds():
+    """A hazard driven by a GROWING scan carry must be flagged: the
+    diagnostic walk runs with the settled (widened) carry, and the ys
+    ranges come from that same sound walk."""
+    def scanned(x):
+        def body(c, _):
+            return c * 2.0, jnp.exp(c)
+
+        return jax.lax.scan(body, x, jnp.arange(200))
+
+    rep = analyze_ranges(_jx(scanned, jax.ShapeDtypeStruct((4,), F32)),
+                         input_ranges={0: (1.0, 1.0)})
+    assert "GL401" in _codes(rep)
+    assert any(s["prim"] == "exp" for s in rep.sites["GL401"])
+
+
+def test_pad_keeps_positive_fill_positive():
+    def f(x):
+        y = jax.lax.pad(x, np.float32(1.0), [(1, 1, 0)])
+        return jnp.log(y)
+
+    rep = analyze_ranges(_jx(f, jax.ShapeDtypeStruct((4,), F32)),
+                         input_ranges={0: (1.0, 2.0)})
+    assert _codes(rep) == []   # fill 1.0 joined from the operand, not 0
+
+
+def test_exp_hazard_is_one_site_not_two():
+    rep = analyze_ranges(_jx(lambda x: jnp.exp(x),
+                             jax.ShapeDtypeStruct((4,), F32)))
+    assert len(rep.sites["GL401"]) == 1
+
+
+def test_psum_bounds_scale_with_known_axis_size():
+    def f(x):
+        return jax.lax.psum(x, "dp")
+
+    j = jax.make_jaxpr(f, axis_env=[("dp", 8)])(
+        jax.ShapeDtypeStruct((4,), F32))
+    rep = analyze_ranges(j, input_ranges={0: (0.0, 1.0)},
+                         axis_sizes={"dp": 8})
+    out = next(r for r in rep.rows if r["kind"] == "output")
+    assert out["lo"] == 0.0 and out["hi"] == 8.0
+    # unknown axis size: absorbing, never a guess
+    rep2 = analyze_ranges(j, input_ranges={0: (0.0, 1.0)})
+    out2 = next(r for r in rep2.rows if r["kind"] == "output")
+    assert out2["hi"] is None
+
+
+def test_axis_index_and_bitwise_bounds_are_honest():
+    def f(x):
+        return jax.lax.psum(x * 0 + jax.lax.axis_index("dp").astype(F32),
+                            "dp")
+
+    j = jax.make_jaxpr(f, axis_env=[("dp", 8)])(
+        jax.ShapeDtypeStruct((4,), F32))
+    rep = analyze_ranges(j, input_ranges={0: (0.0, 0.0)},
+                         axis_sizes={"dp": 8})
+    out = next(r for r in rep.rows if r["kind"] == "output")
+    # axis_index in [0,7], psummed over 8 -> [0, 56]; never a [0,1] lie
+    assert out["hi"] == 56.0
+
+    def g(t):
+        return t & jnp.int32(0xFF)
+
+    j2 = _jx(g, jax.ShapeDtypeStruct((4,), jnp.int32))
+    out2 = next(r for r in analyze_ranges(j2).rows
+                if r["kind"] == "output")
+    assert out2["hi"] is None or out2["hi"] > 1.0   # not a fake [0,1]
+
+
+def test_self_multiply_overflow_clamps_like_square():
+    rep = analyze_ranges(_jx(lambda x: x * x,
+                             jax.ShapeDtypeStruct((4,), F32)),
+                         input_ranges={0: (0.0, 1e30)})
+    assert "GL401" in _codes(rep)
+
+
+def test_bf16_convert_flagged_in_walk():
+    """GL403 fires on an explicit convert-to-bf16 whose proven range
+    does not fit (ml_dtypes kind 'V' must not disable the clamp)."""
+    j = _jx(lambda x: x.astype(jnp.bfloat16),
+            jax.ShapeDtypeStruct((4,), F32))
+    over = analyze_ranges(j, input_ranges={0: (0.0, 1e39)})
+    assert "GL403" in _codes(over)
+    under = analyze_ranges(j, input_ranges={0: (0.0, 1e-42)})
+    assert _codes(under) == ["GL403"]
+    ok = analyze_ranges(j, input_ranges={0: (0.0, 1.0)})
+    assert _codes(ok) == []
+
+
+def test_exp_overflow_threshold_is_dtype_aware():
+    # f16 overflows exp at ~11.09: (0, 20) is a REAL hazard there...
+    j16 = _jx(lambda x: jnp.exp(x), jax.ShapeDtypeStruct((4,), jnp.float16))
+    assert "GL401" in _codes(analyze_ranges(j16,
+                                            input_ranges={0: (0.0, 20.0)}))
+    # ...and perfectly fine in f32
+    j32 = _jx(lambda x: jnp.exp(x), jax.ShapeDtypeStruct((4,), F32))
+    assert _codes(analyze_ranges(j32, input_ranges={0: (0.0, 20.0)})) == []
+    # legitimate f64 programs keep their full exponent range
+    j64 = _jx(lambda x: jnp.exp(x), jax.ShapeDtypeStruct((4,), jnp.float64))
+    assert _codes(analyze_ranges(j64,
+                                 input_ranges={0: (100.0, 600.0)})) == []
+
+
+def test_hot_swap_reruns_numerics_gate():
+    """update_params must re-seed from the CANDIDATE's observed extrema
+    and re-run the walk: a v2 whose weights flush to zero in a demoted
+    bf16 edge (finite output — invisible to the default canary) is
+    rejected under numerics='error', and warn-mode refreshes the
+    report."""
+    from incubator_mxnet_tpu.serve.engine import ServeEngine
+    from incubator_mxnet_tpu.serve.resilience import SwapRejected
+
+    net = _dense_net(seed=9)
+    eng = ServeEngine(net, buckets=(4,), lint="off", numerics="error",
+                      passes=("amp_bf16",))
+    eng.warmup(np.linspace(0, 1, 12, dtype=np.float32))
+    v1_rows = {r["name"]: r for r in eng.range_report.rows}
+    names = [s[0] for s in eng.param_signature]
+    good = {n: np.asarray(jax.device_get(v), np.float32) * 0.5
+            for n, v in zip(names, [p._data._data
+                                    for p in net.collect_params()
+                                    .values()])}
+    assert eng.update_params(good) == 2       # clean swap passes
+    # report now describes v2 (halved extrema)
+    v2_rows = {r["name"]: r for r in eng.range_report.rows}
+    pname = next(n for n in v2_rows if n.startswith("param:")
+                 and v1_rows[n]["hi"])
+    assert abs(v2_rows[pname]["hi"] - 0.5 * v1_rows[pname]["hi"]) < 1e-6
+    bad = dict(good)
+    wname = next(n for n in names if n.endswith("weight"))
+    bad[wname] = np.full(good[wname].shape, 1e-42, np.float32)
+    with pytest.raises(SwapRejected, match="GL403"):
+        eng.update_params(bad)
+    assert eng.params_version == 2            # old version keeps serving

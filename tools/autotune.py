@@ -38,6 +38,17 @@ if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
 
 
+def _range_arg(s):
+    """argparse type for --input-range (shared grammar:
+    analysis.value_range.parse_range_arg)."""
+    from incubator_mxnet_tpu.analysis.value_range import parse_range_arg
+
+    try:
+        return parse_range_arg(s)
+    except ValueError as e:
+        raise argparse.ArgumentTypeError("--input-range %s" % e)
+
+
 def _parse_mesh(spec):
     axes = {}
     for part in (spec or "").split(","):
@@ -192,6 +203,17 @@ def main(argv=None) -> int:
                          "knob in the train search space, ranked by the "
                          "post-pass CostReport; GL201/GL301-rejected "
                          "candidates cost zero compiles")
+    ap.add_argument("--numerics", default="off",
+                    choices=["off", "warn", "error"],
+                    help="graftrange value-range gate per candidate "
+                         "(analysis/value_range.py): 'error' rejects "
+                         "GL4xx-infeasible configs (amp_bf16 on an "
+                         "out-of-bf16-range edge, provably-overflowing "
+                         "loss_scale) with zero compiles, like GL201")
+    ap.add_argument("--input-range", default=None, type=_range_arg,
+                    help="declared batch value range 'lo,hi' (e.g. "
+                         "'0,1' for normalized images) seeding the "
+                         "graftrange analysis")
     ap.add_argument("--budget-compiles", type=int, default=5,
                     help="how many candidates reach the real backend "
                          "(each costs at most one XLA compile; a warm "
@@ -265,6 +287,8 @@ def main(argv=None) -> int:
                              hbm_budget=budget,
                              budget_compiles=args.budget_compiles,
                              warmup=args.warmup, iters=args.iters,
+                             numerics=args.numerics,
+                             input_range=args.input_range,
                              log_path=args.out)
     else:
         import incubator_mxnet_tpu as mx

@@ -30,11 +30,23 @@ hint}], "summary": {total, errors, warnings}}``) so CI and the future
 autotuner consume lint output programmatically; severity is serialized
 by NAME.
 
+``--format=sarif`` prints SARIF 2.1.0 (the static-analysis interchange
+format GitHub code scanning and other CI UIs ingest): one ``run`` with
+the graftlint driver, one ``rules`` entry per distinct code (summary
+from the stable catalog), one ``result`` per finding with
+``path``/``startLine`` parsed out of ``where``.  Schema-shape is
+validated in ``tests/test_graftlint.py``.
+
+``--ranges MODEL`` (dense | conv-bn | resnet50) traces the named
+model's inference program and prints the graftrange per-var value-range
+table (``analysis/value_range.py``) with any GL4xx findings merged
+into the report — the numerics companion to the source-level walk.
+
 Usage::
 
     python tools/graftlint.py [paths...] [--min-severity warning]
                               [--select GL101,GL103] [--ignore GL2*]
-                              [--format json]
+                              [--format json|sarif] [--ranges conv-bn]
 """
 from __future__ import annotations
 
@@ -46,6 +58,69 @@ import sys
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
+
+
+def _sarif_level(sev) -> str:
+    """Severity -> SARIF result.level (error/warning/note)."""
+    name = str(sev).lower()
+    return {"error": "error", "warning": "warning"}.get(name, "note")
+
+
+def _sarif_location(where: str):
+    """Parse a ``path:line`` ``where`` into a SARIF physicalLocation
+    (None for trace-level findings with no source anchor)."""
+    path, sep, line = (where or "").rpartition(":")
+    if not sep or not line.isdigit() or not path:
+        return None
+    uri = os.path.relpath(path, _ROOT) if os.path.isabs(path) else path
+    return {"physicalLocation": {
+        "artifactLocation": {"uri": uri.replace(os.sep, "/")},
+        "region": {"startLine": int(line)}}}
+
+
+def to_sarif(report) -> dict:
+    """One SARIF 2.1.0 log for a LintReport — the shape CI
+    code-scanning UIs ingest (``--format sarif``)."""
+    from incubator_mxnet_tpu.analysis.diagnostics import CODES
+
+    rule_ids = sorted({d.code for d in report})
+    rules = []
+    for code in rule_ids:
+        default = CODES.get(code)
+        rules.append({
+            "id": code,
+            "shortDescription": {
+                "text": default[1] if default else code},
+            "defaultConfiguration": {
+                "level": _sarif_level(default[0]) if default
+                else "warning"},
+        })
+    index = {c: i for i, c in enumerate(rule_ids)}
+    results = []
+    for d in report:
+        res = {"ruleId": d.code, "ruleIndex": index[d.code],
+               "level": _sarif_level(d.severity),
+               "message": {"text": d.message + (
+                   ("\nhint: " + d.hint) if d.hint else "")}}
+        loc = _sarif_location(d.where)
+        if loc is not None:
+            res["locations"] = [loc]
+        results.append(res)
+    return {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri":
+                    "https://github.com/apache/incubator-mxnet",
+                "version": "1.0.0",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }
 
 
 def main(argv=None) -> int:
@@ -71,9 +146,15 @@ def main(argv=None) -> int:
                     help="comma-separated GLxxx codes or prefix globs to "
                          "drop from the report and the exit status")
     ap.add_argument("--format", dest="fmt", default="text",
-                    choices=["text", "json"],
+                    choices=["text", "json", "sarif"],
                     help="json: the stable Diagnostic schema for CI / "
-                         "autotuner consumption")
+                         "autotuner consumption; sarif: SARIF 2.1.0 "
+                         "for code-scanning UIs")
+    ap.add_argument("--ranges", metavar="MODEL", default=None,
+                    choices=["dense", "conv-bn", "resnet50"],
+                    help="additionally trace this model and report the "
+                         "graftrange per-var value-range table + GL4xx "
+                         "findings (analysis/value_range.py)")
     args = ap.parse_args(argv)
 
     from incubator_mxnet_tpu.analysis.diagnostics import (LintReport,
@@ -87,11 +168,33 @@ def main(argv=None) -> int:
     select = _codes(args.select)
     ignore = _codes(args.ignore) + _codes(args.suppress)
     report = lint_paths(args.paths)
+    range_report = None
+    if args.ranges:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "_graftpass_cli", os.path.join(_ROOT, "tools",
+                                           "graftpass.py"))
+        gp = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(gp)
+        from incubator_mxnet_tpu.analysis.value_range import \
+            analyze_ranges
+
+        # the ONE trace-and-seed block (model build, observed-extrema
+        # seeding, abstract trace) lives in tools/graftpass.py
+        closed, seeds, labels = gp.trace_model_program(args.ranges)[:3]
+        range_report = analyze_ranges(closed, input_ranges=seeds,
+                                      invar_labels=labels)
+        report = LintReport(list(report)
+                            + list(range_report.diagnostics))
     kept = [d for d in report
             if (not select or any(code_matches(d.code, p) for p in select))
             and not any(code_matches(d.code, p) for p in ignore)]
     report = LintReport(kept)
     n_err = len(report.errors)
+    if args.fmt == "sarif":
+        print(json.dumps(to_sarif(report), indent=2))
+        return 1 if n_err else 0
     if args.fmt == "json":
         print(json.dumps({
             "version": 1,
@@ -104,6 +207,11 @@ def main(argv=None) -> int:
     out = report.format(Severity[args.min_severity.upper()])
     if out:
         print(out)
+    if range_report is not None:
+        # rows only: the diagnostics were already merged into the main
+        # report above (where --select/--ignore filtering applies)
+        print("\ngraftrange per-var table (%s):" % args.ranges)
+        print(range_report.format(include_diagnostics=False))
     print("graftlint: %d file finding(s), %d error(s)"
           % (len(report), n_err))
     return 1 if n_err else 0

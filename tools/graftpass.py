@@ -41,6 +41,17 @@ if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
 
 
+def _range_arg(s):
+    """argparse type for --input-range (shared grammar:
+    analysis.value_range.parse_range_arg)."""
+    from incubator_mxnet_tpu.analysis.value_range import parse_range_arg
+
+    try:
+        return parse_range_arg(s)
+    except ValueError as e:
+        raise argparse.ArgumentTypeError("--input-range %s" % e)
+
+
 def _build_model(name):
     """(net, sample_shape): dense = the test MLP; conv-bn = a conv1-
     style 7x7/s2 stem + conv-BN block (a space_to_depth target);
@@ -77,6 +88,45 @@ def _build_model(name):
     raise SystemExit("unknown --model %r (dense, conv-bn, resnet50)" % name)
 
 
+def trace_model_program(model, batch=8, input_range=None,
+                        seed_observed=True):
+    """Build a named model, abstractly trace its inference program and
+    assemble the graftrange seeds/labels (observed param extrema via
+    ``analysis.value_range.observed_range`` + the declared input
+    range) — the ONE trace-and-seed block shared by ``graftpass
+    --ranges`` and ``graftlint --ranges``.  Returns ``(closed, seeds,
+    labels, net, params, p_vals, sample_shape)``."""
+    import numpy as np
+
+    import jax
+
+    from incubator_mxnet_tpu.analysis.value_range import observed_range
+    from incubator_mxnet_tpu.gluon.block import pure_forward
+
+    net, sample_shape = _build_model(model)
+    params = list(net.collect_params().values())
+    p_vals = [p._data._data for p in params]
+
+    def infer(pv, x):
+        out, _tc = pure_forward(net, params, pv, x, training=False)
+        return out
+
+    x = jax.ShapeDtypeStruct((batch,) + tuple(sample_shape), np.float32)
+    closed = jax.make_jaxpr(infer)(
+        [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in p_vals], x)
+    seeds, labels = {}, {}
+    for i, (prm, v) in enumerate(zip(params, p_vals)):
+        labels[i] = "param:%s" % prm.name
+        if seed_observed:
+            seed = observed_range(v)
+            if seed is not None:
+                seeds[i] = seed
+    labels[len(p_vals)] = "x"
+    if input_range is not None:
+        seeds[len(p_vals)] = tuple(input_range)
+    return closed, seeds, labels, net, params, p_vals, sample_shape
+
+
 def _list_registry(fmt):
     from incubator_mxnet_tpu.analysis.passes import PASS_REGISTRY, get_pass
 
@@ -110,6 +160,20 @@ def main(argv=None) -> int:
     ap.add_argument("--no-probe", action="store_true",
                     help="skip the concrete probe (abstract eval, "
                          "re-lint and cost receipts still gate)")
+    ap.add_argument("--ranges", action="store_true",
+                    help="print the graftrange per-var value-range "
+                         "table of the traced model program "
+                         "(analysis/value_range.py) next to the "
+                         "receipts; also enables the amp_bf16 GL403 "
+                         "gate (numerics='warn')")
+    ap.add_argument("--numerics", default=None,
+                    choices=["off", "warn", "error"],
+                    help="graftrange mode for range-gated passes "
+                         "(default: 'warn' with --ranges, else 'off')")
+    ap.add_argument("--input-range", default=None, type=_range_arg,
+                    help="declared input value range 'lo,hi' seeding "
+                         "the range analysis (default: observed from "
+                         "the model's initialized params only)")
     ap.add_argument("--device", default="tpu-v5e",
                     help="graftcost roofline device-spec registry key")
     ap.add_argument("--format", dest="fmt", default="table",
@@ -119,31 +183,22 @@ def main(argv=None) -> int:
     if args.list:
         return _list_registry(args.fmt)
 
-    import numpy as np
-
-    import jax
-
     from incubator_mxnet_tpu.analysis import LintError, Severity
     from incubator_mxnet_tpu.analysis.passes import (PassContext,
                                                      PassManager)
-    from incubator_mxnet_tpu.gluon.block import pure_forward
 
-    net, sample_shape = _build_model(args.model)
-    params = list(net.collect_params().values())
-    p_vals = [p._data._data for p in params]
-
-    def infer(pv, x):
-        out, _tc = pure_forward(net, params, pv, x, training=False)
-        return out
-
-    x = jax.ShapeDtypeStruct((args.batch,) + tuple(sample_shape),
-                             np.float32)
-    closed = jax.make_jaxpr(infer)(
-        [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in p_vals], x)
+    numerics = args.numerics or ("warn" if args.ranges else "off")
+    closed, seeds, labels, net, params, p_vals, sample_shape = \
+        trace_model_program(args.model, batch=args.batch,
+                            input_range=args.input_range,
+                            seed_observed=numerics != "off")
+    input_ranges = seeds if numerics != "off" else None
     ctx = PassContext(
         param_invars=frozenset(range(len(p_vals))),
         probe="off" if args.no_probe else "auto",
         probe_overrides=dict(enumerate(p_vals)),
+        numerics=numerics,
+        input_ranges=input_ranges,
         where="graftpass CLI (%s)" % args.model)
     try:
         mgr = PassManager(args.passes, device=args.device,
@@ -154,6 +209,14 @@ def main(argv=None) -> int:
         return 1
     errors = [d for d in result.diagnostics
               if d.severity >= Severity.ERROR]
+    range_report = None
+    if args.ranges:
+        from incubator_mxnet_tpu.analysis.value_range import \
+            analyze_ranges
+
+        range_report = analyze_ranges(closed,
+                                      input_ranges=input_ranges,
+                                      invar_labels=labels)
     payload = {
         "version": 1,
         "tool": "graftpass",
@@ -168,6 +231,8 @@ def main(argv=None) -> int:
                            if r.changed and not r.installed),
             "errors": len(errors)},
     }
+    if range_report is not None:
+        payload["ranges"] = range_report.to_dict()
     if args.fmt == "json":
         print(json.dumps(payload, indent=2))
     else:
@@ -191,6 +256,10 @@ def main(argv=None) -> int:
                 print("    %s" % r.notes)
         for d in result.diagnostics:
             print(d.format())
+        if range_report is not None:
+            print("\ngraftrange per-var table (%s batch=%d):"
+                  % (args.model, args.batch))
+            print(range_report.format())
     return 1 if errors else 0
 
 
