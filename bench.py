@@ -31,6 +31,11 @@ import sys
 import time
 
 BASELINE_IMG_S = 363.69  # V100 fp32 batch-128 training (perf.md:254)
+# round-19 composed default workload (ONE definition: run_train defaults,
+# argparse help and the main() fallbacks all reference these)
+DEFAULT_GHOST_BN = 16
+DEFAULT_PASSES = "space_to_depth,maxpool_bwd_mask"
+DEFAULT_ZERO = 1  # ZeRO-1 on dp meshes (a no-op without --mesh-dp)
 # ResNet-50 at 224x224: ~4.09 GFLOPs forward per image; training step
 # (fwd + bwd) ~= 3x forward.  TPU v5e (v5 lite) peak: 197 TFLOP/s bf16.
 TRAIN_FLOPS_PER_IMG = 3 * 4.09e9
@@ -112,7 +117,9 @@ def _synth_recordio(image_size, n=512, img_fmt=".jpg"):
 
 def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
               compute_dtype="bfloat16", data="synthetic",
-              record_format=".jpg", s2d_stem=False, ghost_bn=0,
+              record_format=".jpg", s2d_stem=False,
+              ghost_bn=DEFAULT_GHOST_BN, passes=DEFAULT_PASSES, mesh_dp=0,
+              zero=DEFAULT_ZERO, multi_precision=True, loss_scale="dynamic",
               cost_device="tpu-v5e", proxy_extra=None):
     jax = setup_jax()
     import numpy as np
@@ -125,11 +132,15 @@ def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
     log("devices: %s" % (jax.devices(),))
     mx.random.seed(0)
     t = time.time()
-    # s2d_stem: exact space-to-depth rewrite of the 7x7/s2 stem conv
-    # (docs/PERF.md; checkpoint-compatible, numerically identical)
-    # ghost_bn: fused Pallas BN with group statistics (parallel/fused_bn.py;
-    # explicit opt-in — matches per-device stats of the distributed
-    # north-star scenario, see docs/PERF.md)
+    # DEFAULT bench workload since round 19: the fully-composed byte
+    # diet — fused ghost-BN ResNet (parallel/fused_bn.py, explicit
+    # bn_group semantics incl. the jnp ghost fallback for VMEM-infeasible
+    # layers) + the space_to_depth / maxpool_bwd_mask graftpasses on the
+    # step, with multi_precision master weights and a dynamic loss
+    # scale.  --ghost-bn 0 --passes '' restores the stock workload.
+    # s2d_stem stays as the MODEL-level stem rewrite (the pass covers
+    # the stock stem at trace time, so the flag is redundant with the
+    # default passes but kept for A/B runs).
     net = vision.resnet50_v1(classes=1000, s2d_stem=s2d_stem,
                              ghost_bn=ghost_bn)
     net.initialize(init=mx.init.Xavier())
@@ -138,14 +149,30 @@ def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
     net.shape_init((1, 3, image_size, image_size))
     log("shape_init (abstract deferred init) %.1fs" % (time.time() - t))
 
+    pass_names = tuple(s.strip() for s in (passes or "").split(",")
+                       if s.strip())
+    mesh = None
+    if mesh_dp and mesh_dp > 1:
+        from incubator_mxnet_tpu.parallel import make_mesh
+
+        if len(jax.devices()) >= mesh_dp:
+            mesh = make_mesh({"dp": mesh_dp},
+                             devices=jax.devices()[:mesh_dp])
+            log("dp=%d mesh (zero=%s)" % (mesh_dp, zero))
+        else:
+            log("--mesh-dp %d ignored: only %d device(s)"
+                % (mesh_dp, len(jax.devices())))
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     # cost="report": the graftcost roofline prediction rides the same
     # pre-compile trace and lands in the JSON line next to the measured
     # number, so every BENCH round logs predicted-vs-measured drift
     step = make_train_step(net, loss_fn, optimizer="sgd", learning_rate=0.1,
-                           momentum=0.9, wd=1e-4,
+                           momentum=0.9, wd=1e-4, mesh=mesh,
+                           zero=zero if mesh is not None else 0,
+                           multi_precision=multi_precision,
+                           loss_scale=loss_scale,
                            compute_dtype=compute_dtype, cost="report",
-                           cost_device=cost_device)
+                           cost_device=cost_device, passes=pass_names)
 
     if data == "recordio":
         # recordio feeds raw uint8 batches (ImageRecordUInt8Iter) — compile
@@ -186,13 +213,58 @@ def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
                     "pred_ms_per_step": round(1e3 * rf["step_s"], 2),
                     "pred_img_per_sec": round(batch_size / rf["step_s"], 1)
                     if rf["step_s"] else 0.0,
-                    "pred_peak_mb": round(rep.peak_bytes / 1e6, 1)}
+                    "pred_peak_mb": round(rep.peak_bytes / 1e6, 1),
+                    "pred_multipass_gb": round(
+                        rep.multipass_extra_bytes / 1e9, 2)}
             log("graftcost: %.1f GiB/step HBM -> >= %.1f ms/step "
                 "(%.0f img/s roofline), peak %.0f MB"
                 % (rep.hbm_bytes / 2**30, 1e3 * rf["step_s"],
                    pred["pred_img_per_sec"], rep.peak_bytes / 1e6))
     except Exception as e:  # noqa: BLE001 — prediction must never kill bench
         log("graftcost prediction unavailable: %r" % e)
+
+    # UNFUSED reference prediction, every round: the lever-attribution
+    # delta (fused vs stock-BN byte diet) is a tracked metric — a BENCH
+    # round that silently regressed to the unfused model would show
+    # pred_bytes_delta_pct ~ 0 instead of hiding in absolute noise.
+    # One abstract trace, no compile (~seconds); never fatal.
+    if ghost_bn or pass_names:
+        try:
+            t = time.time()
+            ref_net = vision.resnet50_v1(classes=1000)
+            ref_net.initialize(init=mx.init.Zero())  # shapes only
+            ref_net.shape_init((1, 3, image_size, image_size))
+            # same mesh/zero knobs as the fused step: the delta must
+            # attribute the byte diet, not dp-sharding differences
+            ref_step = make_train_step(
+                ref_net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                optimizer="sgd", learning_rate=0.1, momentum=0.9, wd=1e-4,
+                mesh=mesh, zero=zero if mesh is not None else 0,
+                multi_precision=multi_precision, loss_scale=loss_scale,
+                compute_dtype=compute_dtype, lint="off", cost="off",
+                passes=())  # explicit: MXTPU_PASSES must not leak into
+                            # the unfused baseline the delta is judged by
+            xs = jax.ShapeDtypeStruct(
+                (batch_size, 3, image_size, image_size), np.float32)
+            ys = jax.ShapeDtypeStruct((batch_size,), np.float32)
+            ref_rep = ref_step.analyze_cost(xs, ys, device=cost_device)
+            pred["pred_bytes_per_img_unfused"] = round(
+                ref_rep.hbm_bytes / batch_size)
+            pred["pred_multipass_gb_unfused"] = round(
+                ref_rep.multipass_extra_bytes / 1e9, 2)
+            if pred.get("pred_bytes_per_img"):
+                pred["pred_bytes_delta_pct"] = round(
+                    100.0 * (1.0 - pred["pred_bytes_per_img"]
+                             / pred["pred_bytes_per_img_unfused"]), 1)
+            log("graftcost unfused reference: %d bytes/img vs fused %s "
+                "(delta %s%%, multipass %.2f -> %.2f GB) [%.1fs]"
+                % (pred["pred_bytes_per_img_unfused"],
+                   pred.get("pred_bytes_per_img"),
+                   pred.get("pred_bytes_delta_pct"),
+                   pred["pred_multipass_gb_unfused"],
+                   pred.get("pred_multipass_gb", 0.0), time.time() - t))
+        except Exception as e:  # noqa: BLE001
+            log("unfused reference prediction unavailable: %r" % e)
 
     batch_src = None
     if data == "recordio":
@@ -236,6 +308,11 @@ def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
                  "backend": jax.default_backend(),
                  "s2d_stem": bool(s2d_stem),
                  "bn": ("ghost%d" % ghost_bn) if ghost_bn else "batch",
+                 "passes": list(pass_names),
+                 "multi_precision": bool(multi_precision),
+                 "loss_scale": str(loss_scale),
+                 "mesh": ("dp%d" % mesh_dp) if mesh is not None else "none",
+                 "zero": int(zero) if mesh is not None else 0,
                  "step_ms": round(1e3 / (best / batch_size), 2),
                  "mfu_bf16": round(best * TRAIN_FLOPS_PER_IMG /
                                    V5E_PEAK_FLOPS, 4),
@@ -601,17 +678,43 @@ def main():
     ap.add_argument("--data", default="synthetic",
                     choices=["synthetic", "recordio"])
     ap.add_argument("--s2d-stem", action="store_true",
-                    help="space-to-depth stem conv (exact rewrite)")
-    ap.add_argument("--ghost-bn", type=int, default=0,
-                    help="fused ghost-BN group size (0 = stock BatchNorm)")
+                    help="space-to-depth stem conv (exact MODEL-level "
+                         "rewrite; the space_to_depth pass covers the "
+                         "stock stem at trace time)")
+    ap.add_argument("--ghost-bn", type=int, default=None,
+                    help="fused ghost-BN group size (default %d — the "
+                         "round-19 composed workload; 0 = stock "
+                         "BatchNorm)" % DEFAULT_GHOST_BN)
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated graftpass names for the train "
+                         "step (default %s; '' = none)" % DEFAULT_PASSES)
+    ap.add_argument("--mesh-dp", type=int, default=0,
+                    help="build the step over a dp=N mesh when N devices "
+                         "exist (composes with --zero)")
+    ap.add_argument("--zero", type=int, default=DEFAULT_ZERO,
+                    choices=[0, 1],
+                    help="ZeRO-1 state sharding on the dp mesh "
+                         "(ignored without --mesh-dp)")
+    ap.add_argument("--no-multi-precision", action="store_true",
+                    help="disable f32 master weights")
+    ap.add_argument("--loss-scale", default="dynamic",
+                    help="'dynamic' (default), a float, or 'off'")
     ap.add_argument("--no-config", action="store_true",
-                    help="ignore bench_config.json (stock configuration)")
+                    help="ignore bench_config.json (the composed round-19 "
+                         "defaults still apply; add --ghost-bn 0 "
+                         "--passes '' for stock BatchNorm)")
     ap.add_argument("--record-format", default=".jpg",
                     choices=[".jpg", ".npy"],
                     help=".npy writes raw payloads — no JPEG decode cost "
                          "(isolates IO from single-core decode limits)")
     args = ap.parse_args()
 
+    if args.mesh_dp > 1 and os.environ.get("JAX_PLATFORMS") == "cpu" \
+            and "XLA_FLAGS" not in os.environ:
+        # forge enough host devices for the requested dp mesh BEFORE
+        # jax initializes (off-chip composition runs)
+        os.environ["XLA_FLAGS"] = \
+            "--xla_force_host_platform_device_count=%d" % args.mesh_dp
     setup_jax()
     log("probing backend...")
     devices, backend_err = _backend_alive()
@@ -673,8 +776,12 @@ def main():
     # bench_config.json records the best MEASURED headline configuration
     # (written by tools/chip_queue.sh after its variant sweep); the
     # driver runs `python bench.py` with no flags, so proven wins are
-    # absorbed into the default here.  Explicit CLI flags override.
-    s2d_stem, ghost_bn = args.s2d_stem, args.ghost_bn
+    # absorbed into the default here.  Explicit CLI flags override, and
+    # the round-19 fused composition (ghost_bn=16 + the byte-diet
+    # passes) is the baseline default — the CPU-proxy leg runs the SAME
+    # composition, so a BENCH round can't silently regress to the
+    # unfused model.
+    s2d_stem, ghost_bn, passes = args.s2d_stem, args.ghost_bn, args.passes
     cfg_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "bench_config.json")
     if not args.no_config and os.path.exists(cfg_path):
@@ -683,25 +790,44 @@ def main():
                 cfg = json.load(f)
             if not s2d_stem:
                 s2d_stem = bool(cfg.get("s2d_stem", False))
-            if not ghost_bn:
-                ghost_bn = int(cfg.get("ghost_bn", 0))
-            log("bench_config.json: s2d_stem=%s ghost_bn=%d (measured "
-                "winner %s)" % (s2d_stem, ghost_bn,
-                                cfg.get("measured", "?")))
+            if ghost_bn is None and "ghost_bn" in cfg:
+                ghost_bn = int(cfg["ghost_bn"])
+            if passes is None and "passes" in cfg:
+                passes = str(cfg["passes"])
+            log("bench_config.json: s2d_stem=%s ghost_bn=%s passes=%s "
+                "(measured winner %s)" % (s2d_stem, ghost_bn, passes,
+                                          cfg.get("measured", "?")))
         except Exception as e:  # noqa: BLE001
             log("bench_config.json unreadable (%r) — stock config" % e)
+    if ghost_bn is None:
+        ghost_bn = DEFAULT_GHOST_BN
+    if passes is None:
+        passes = DEFAULT_PASSES
+    loss_scale = args.loss_scale
+    if loss_scale not in ("dynamic", "off"):
+        try:
+            loss_scale = float(loss_scale)
+        except ValueError:
+            ap.error("--loss-scale must be 'dynamic', 'off' or a float "
+                     "(got %r)" % loss_scale)
+    elif loss_scale == "off":
+        loss_scale = None
+    knobs = dict(s2d_stem=s2d_stem, ghost_bn=ghost_bn, passes=passes,
+                 mesh_dp=args.mesh_dp, zero=args.zero,
+                 multi_precision=not args.no_multi_precision,
+                 loss_scale=loss_scale)
 
     if proxy_extra:
-        # reduced proxy workload: same model/step wiring, sized so a
-        # CPU can finish it — the drift fields (graftcost cost="report"
-        # against the cpu-proxy device spec) stay populated
+        # reduced proxy workload: same model/step wiring — INCLUDING
+        # the fused ghost-BN + pass composition — sized so a CPU can
+        # finish it; the drift fields (graftcost cost="report" against
+        # the cpu-proxy device spec) stay populated
         try:
             run_train(batch_size=args.batch or 16,
                       image_size=min(args.image_size, 64),
                       chunks=min(args.chunks, 2), chunk_iters=2,
-                      data="synthetic", s2d_stem=s2d_stem,
-                      ghost_bn=ghost_bn, cost_device="cpu-proxy",
-                      proxy_extra=proxy_extra)
+                      data="synthetic", cost_device="cpu-proxy",
+                      proxy_extra=proxy_extra, **knobs)
         except Exception as e:  # noqa: BLE001
             log("cpu-proxy train leg failed: %r" % e)
             emit("resnet50_train_img_per_sec", 0.0, "img/s",
@@ -715,8 +841,7 @@ def main():
         try:
             run_train(batch_size=batch, image_size=args.image_size,
                       chunks=args.chunks, data=args.data,
-                      record_format=args.record_format,
-                      s2d_stem=s2d_stem, ghost_bn=ghost_bn)
+                      record_format=args.record_format, **knobs)
             if not args.no_serve:
                 # the serving leg rides every BENCH round beside the
                 # training number (best-effort: a serve failure must

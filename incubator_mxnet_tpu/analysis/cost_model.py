@@ -153,11 +153,24 @@ _CONCATLIKE = {"concatenate"}
 _RANDOM = {"random_bits", "random_wrap", "random_unwrap", "random_split",
            "random_seed", "random_fold_in", "threefry2x32", "rng_bit_generator"}
 
+#: hand-written kernels (Pallas custom calls).  A custom call is a real
+#: pass barrier — XLA cannot fuse compute into or out of it — but by
+#: construction it reads each operand and writes each output exactly
+#: ONCE (the single-read contract the fused ghost-BN kernels exist
+#: for, parallel/fused_bn.py).  The old model filed these under
+#: "other"→elementwise, where the sibling co-fusion rule sometimes
+#: merged their reads with unrelated elementwise groups and the view
+#: transposes around them were sometimes charged as full passes —
+#: both wrong in opposite directions.
+_CUSTOM = {"pallas_call", "tpu_custom_call", "custom_call"}
+
 #: classes: "mxu" "elem" "layout" "reduce" "sg" "coll" "concat" "random"
-#: "control" "other"
+#: "custom" "control" "other"
 def _classify(prim_name: str) -> str:
     if prim_name in _MXU:
         return "mxu"
+    if prim_name in _CUSTOM:
+        return "custom"
     if prim_name in _ELEMENTWISE:
         return "elem"
     if prim_name in _LAYOUT:
@@ -186,17 +199,32 @@ def _classify(prim_name: str) -> str:
 _CATEGORY = {"mxu": "conv", "elem": "elementwise", "layout": "elementwise",
              "concat": "elementwise", "random": "elementwise",
              "reduce": "reduction", "sg": "scatter_gather",
-             "coll": "collective", "other": "elementwise"}
+             "coll": "collective", "custom": "custom",
+             "other": "elementwise"}
 
 #: classes whose eqns force their elementwise operand chains to
-#: materialize (they read real buffers, not fused producers)
-_FORCES_OPERANDS = ("mxu", "sg", "coll", "control")
+#: materialize (they read real buffers, not fused producers).  custom
+#: kernels belong here: XLA cannot fuse elementwise compute across a
+#: custom-call boundary — but NOT in _FORCES_LAYOUT below: pure layout
+#: views feeding a Pallas kernel are the documented bitcast discipline
+#: (parallel/fused_bn.py chooses its (L, N, C)/(L, C, N) views so the
+#: "transpose" is a relabeling of the conv's native TPU layout) and
+#: fold into the kernel's DMA, exactly like layout-into-MXU fusion.
+_FORCES_OPERANDS = ("mxu", "sg", "coll", "control", "custom")
 
 #: pure data movement feeding an MXU op is folded into its input by
 #: XLA layout assignment (a transposed weight or a space-to-depth
 #: rearrangement never round-trips HBM on its own) — so LAYOUT-only
 #: chains materialize for fewer consumer classes than elementwise ones
 _FORCES_LAYOUT = ("sg", "coll", "control")
+
+#: classes that force an ELEMENTWISE producer to materialize even when
+#: reached through a folding layout chain.  MXU is deliberately absent:
+#: TPU convs input-fuse cheap elementwise producers (convert/scale)
+#: through their operand views — the measured-calibrated behavior —
+#: while a custom call is opaque to fusion and must be handed a real
+#: buffer no matter how many views sit in between.
+_FORCES_THROUGH_LAYOUT = ("sg", "coll", "control", "custom")
 
 
 def _aval_bytes(aval) -> int:
@@ -224,6 +252,8 @@ def _aval_elems(aval) -> int:
 #: sits below it loads (and multiplies) channel-padded operands — the
 #: conv1 C=3 inefficiency the ``space_to_depth`` graftpass removes
 _MXU_LANES = 8
+
+
 
 
 def _conv_lane_amp(eqn) -> float:
@@ -275,6 +305,15 @@ def _eqn_flops(eqn) -> float:
     if cls == "sg":
         return float(max((_aval_elems(v.aval) for v in eqn.outvars),
                          default=0))
+    if cls == "custom":
+        # elementwise-grade arithmetic per element touched: the shipped
+        # kernels (fused BN, flash attention bwd reductions) do a
+        # handful of VPU ops per element — they are byte-bound by
+        # design, so a coarse per-element figure keeps the compute
+        # roofline honest without decoding the kernel body
+        return float(sum(_aval_elems(v.aval) for v in eqn.outvars)
+                     + sum(_aval_elems(v.aval) for v in eqn.invars
+                           if not isinstance(v, jcore.Literal)))
     return 0.0
 
 
@@ -326,8 +365,11 @@ class _Acc:
         # jaxpr's operands are views of buffers ALREADY live in its
         # caller, so control eqns add only (peak - base) on top
         self.base: float = 0.0
-        # (bytes, groups, shape, dtype) of multi-pass re-read leaves
+        # (bytes, groups, shape, dtype) of multi-pass re-read leaves —
+        # a top-32 census for the GL202 message; the TOTAL repeat
+        # traffic is carried separately so truncation never clips it
         self.rereads: List[Tuple[float, int, tuple, str]] = []
+        self.reread_extra_bytes: float = 0.0
 
     def merge(self, child: "_Acc", mult: float):
         for k, c in child.cat.items():
@@ -344,6 +386,7 @@ class _Acc:
         self.rereads.extend(child.rereads)
         self.rereads.sort(key=lambda r: -r[0])
         del self.rereads[32:]
+        self.reread_extra_bytes += child.reread_extra_bytes * mult
 
 
 # ---------------------------------------------------------------------------
@@ -364,6 +407,15 @@ class CostReport:
     param_bytes: float = 0.0           # per device (replicated unless sharded)
     opt_state_bytes: float = 0.0       # global
     opt_state_bytes_per_device: float = 0.0
+    #: GL202 raw material, structurally: one (bytes, n_reads, shape,
+    #: dtype) row per large intermediate read by 2+ fusable groups —
+    #: the model's accounting of the avoidable multi-pass traffic the
+    #: fused ghost-BN kernels remove (custom-kernel reads never count).
+    #: The census keeps the worst 32 rows; ``multipass_extra_bytes``
+    #: is the UNtruncated total of the repeats (bytes x (reads - 1)).
+    rereads: List[Tuple[float, int, tuple, str]] = field(
+        default_factory=list)
+    multipass_extra_bytes: float = 0.0
     diagnostics: List[Diagnostic] = field(default_factory=list)
     hbm_budget: Optional[float] = None
     # informational knobs echoed by the step hook / CLI
@@ -415,6 +467,9 @@ class CostReport:
                        "hbm_write_bytes": self.hbm_write_bytes,
                        "hbm_bytes": self.hbm_bytes},
             "peak_bytes": self.peak_bytes,
+            "multipass_extra_bytes": self.multipass_extra_bytes,
+            "rereads": [{"bytes": b, "reads": n, "shape": list(s),
+                         "dtype": d} for b, n, s, d in self.rereads],
             "param_bytes": self.param_bytes,
             "opt_state_bytes": self.opt_state_bytes,
             "opt_state_bytes_per_device": self.opt_state_bytes_per_device,
@@ -632,6 +687,32 @@ class _Walker:
         outset = {id(_res(alias, v)) for v in out_vars if _is_var(v)}
         return producers, consumers, outset
 
+    def _eff_consumers(self, v, producers, consumers, outset, memo):
+        """Consumers of ``v`` reached through chains of NON-materializing
+        pure LAYOUT ops: a reshape/transpose between a producer and its
+        real reader is a relabeling, not a compute stage — the
+        materialization force of the reader acts through it (an
+        elementwise op feeding a Pallas kernel via the kernel's bitcast
+        view still cannot fuse into the kernel).  A layout hop that
+        itself materializes (a view a scatter reads, a program output)
+        absorbs the force instead: the producer fuses into that write.
+        """
+        out, stack, seen = [], [v], set()
+        while stack:
+            u = stack.pop()
+            for c in consumers.get(u, ()):
+                if id(c) in seen:
+                    continue
+                seen.add(id(c))
+                if _classify(c.primitive.name) == "layout":
+                    for o in c.outvars:
+                        if _is_var(o) and not self._materialized(
+                                o, producers, consumers, outset, memo):
+                            stack.append(o)
+                else:
+                    out.append(c)
+        return out
+
     def _materialized(self, v, producers, consumers, outset, memo):
         if not _is_var(v):
             return False
@@ -646,11 +727,27 @@ class _Walker:
             r = True
         elif id(v) in outset:
             r = True
-        else:
-            forces = _FORCES_LAYOUT if cls == "layout" \
-                else _FORCES_OPERANDS
-            r = any(_classify(c.primitive.name) in forces
+        elif cls == "layout":
+            # a pure view materializes only for DIRECT readers that
+            # need a real reshuffled buffer (scatter/collective/
+            # control); MXU and custom kernels fold views into their
+            # input DMA
+            r = any(_classify(c.primitive.name) in _FORCES_LAYOUT
                     for c in consumers.get(v, ()))
+        else:
+            # elementwise: forced by any DIRECT non-fusing reader, or
+            # by a fusion-opaque reader (custom kernel/scatter/
+            # collective/control) reached through a non-materializing
+            # layout chain (the view folds, the compute does not; MXU
+            # readers input-fuse through views — see
+            # _FORCES_THROUGH_LAYOUT)
+            r = any(_classify(c.primitive.name) in _FORCES_OPERANDS
+                    for c in consumers.get(v, ())) \
+                or any(_classify(c.primitive.name)
+                       in _FORCES_THROUGH_LAYOUT
+                       for c in self._eff_consumers(v, producers,
+                                                    consumers, outset,
+                                                    memo))
         memo[id(v)] = r
         return r
 
@@ -757,15 +854,15 @@ class _Walker:
 
         reread_count: Dict[Any, int] = defaultdict(int)
         # sibling co-fusion (XLA multi-output fusion): ALL reduction
-        # groups reading a tensor within one program region compile to
+        # groups reading a tensor within one program REGION compile to
         # ONE pass over it (BN's sum(x)/sum(x·x); the bwd's
         # sum(dY)/sum(dY·x̂) + the broadcast-transpose reductions — the
         # measured convert_reduce_fusion behavior, docs/PERF.md), and
         # likewise for sibling elementwise groups.  Model: per leaf,
         # one read per fusable CATEGORY until a non-fusing consumer
-        # (conv/scatter/collective — a real pass barrier in time, e.g.
-        # the dW conv between a layer's bwd and the next layer's bwd)
-        # reads it, which opens a new region.
+        # (conv/custom kernel/scatter/collective — a real pass barrier
+        # in time, e.g. the dW conv between a layer's bwd and the next
+        # layer's bwd) reads it, which opens a new region.
         seen_cats: Dict[Any, set] = {}
 
         for i, eqn in enumerate(flat):
@@ -799,10 +896,19 @@ class _Walker:
                             if category in seen:
                                 continue  # co-fused sibling read it
                             seen.add(category)
+                            # the GL202 census counts only FUSABLE
+                            # repeat reads: a conv or custom kernel
+                            # re-reading an operand is necessary
+                            # compute traffic, while a second
+                            # reduction/elementwise pass over a big
+                            # intermediate is exactly the avoidable
+                            # multi-pass BN pattern (and a custom
+                            # kernel's own read is the single-read fix
+                            # GL202's hint prescribes, never counted)
+                            reread_count[leaf] += 1
                         else:
                             seen_cats[leaf] = set()  # pass barrier
                         c.hbm_read_bytes += _aval_bytes(leaf.aval)
-                        reread_count[leaf] += 1
                     if prim == "conv_general_dilated":
                         # sublane channel padding: the LHS loads at the
                         # tile width even when cin is smaller
@@ -830,13 +936,17 @@ class _Walker:
                 if self._materialized(v, producers, consumers, outset,
                                       memo):
                     live -= eff_bytes(v)
-        # GL202 raw material: leaves read by 2+ groups
+        # GL202 raw material: leaves read by 2+ groups.  The extra-byte
+        # TOTAL is accumulated before the census truncates to its
+        # top-32 rows — `multipass_extra_bytes` must never under-count
+        # exactly when the multi-pass traffic is largest.
         for v, n in reread_count.items():
             b = _aval_bytes(v.aval)
             if n >= 2 and b >= self.large_bytes:
                 acc.rereads.append((float(b), n,
                                     tuple(getattr(v.aval, "shape", ())),
                                     str(getattr(v.aval, "dtype", "?"))))
+                acc.reread_extra_bytes += float(b) * (n - 1)
         acc.rereads.sort(key=lambda r: -r[0])
         del acc.rereads[32:]
         return acc
@@ -996,8 +1106,9 @@ def analyze_jaxpr(closed_jaxpr, *,
                          invar_factors=factors)
     report = CostReport(device=device, n_devices=max(int(n_devices), 1),
                         categories=dict(acc.cat), comm=dict(acc.comm),
-                        peak_bytes=acc.peak, hbm_budget=hbm_budget,
-                        meta=dict(meta or {}))
+                        peak_bytes=acc.peak, rereads=list(acc.rereads),
+                        multipass_extra_bytes=acc.reread_extra_bytes,
+                        hbm_budget=hbm_budget, meta=dict(meta or {}))
     report.diagnostics = check_cost(report, rereads=acc.rereads)
     return report
 
@@ -1035,7 +1146,10 @@ def check_cost(report: CostReport,
             hint="shrink the batch / enable pipeline_remat / shard "
                  "state with zero=1, or raise hbm_budget"))
     if rereads:
-        total_extra = sum(b * (n - 1) for b, n, _, _ in rereads)
+        # the report carries the UNtruncated total; fall back to the
+        # census rows only when called with a bare rereads list
+        total_extra = report.multipass_extra_bytes \
+            or sum(b * (n - 1) for b, n, _, _ in rereads)
         worst = rereads[0]
         diags.append(Diagnostic(
             "GL202", Severity.WARNING,

@@ -82,10 +82,10 @@ from jax import core as jcore
 from .diagnostics import Diagnostic, LintError, LintReport, Severity
 
 __all__ = ["AmpBf16Pass", "Contract", "CseDeadAuxPass", "GraftPass",
-           "PASS_REGISTRY", "PassContext", "PassManager", "PassReceipt",
-           "PassResult", "PipelineResult", "QuantizeWeightsPass",
-           "SpaceToDepthPass", "get_pass", "register_pass",
-           "resolve_passes"]
+           "MaxPoolBwdMaskPass", "PASS_REGISTRY", "PassContext",
+           "PassManager", "PassReceipt", "PassResult", "PipelineResult",
+           "QuantizeWeightsPass", "SpaceToDepthPass", "get_pass",
+           "register_pass", "resolve_passes"]
 
 
 # ---------------------------------------------------------------------------
@@ -801,6 +801,93 @@ class SpaceToDepthPass(GraftPass):
 
 
 # ---------------------------------------------------------------------------
+# shipped pass: mask-based max-pool backward
+# ---------------------------------------------------------------------------
+
+class MaxPoolBwdMaskPass(GraftPass):
+    """Replace ``select_and_scatter_add`` — XLA's max-pool backward,
+    a slow scatter pass on TPU (1.5 ms/step in the ResNet-50 profile,
+    docs/PERF.md lever c) — with the shifted-window mask form: one
+    strided view per in-window offset, the winner being the FIRST
+    argmax in row-major window scan order, the gradient routed to it
+    by a fused elementwise select/pad chain.
+
+    First-argmax is exactly ``select_and_scatter_add``'s GE-select tie
+    rule (and the reference's pool.h unpool semantics), so the rewrite
+    is ``bit_exact``: contributions from distinct windows land on
+    disjoint-or-added positions, and on the exact-arithmetic dyadic
+    probe — which is FULL of ties, the hard case — addition is
+    associative, so a mis-routed mask (a shifted winner, a
+    tie-broadcast) shows up bitwise in the GL301 probe and is refused
+    with zero compiles.
+
+    The forward ``reduce_window_max`` this needs is re-emitted and
+    CSE-merged with the forward pass's own (both the jaxpr walker and
+    XLA dedup it), so the bwd costs reads of (X, out, gY) and the dX
+    write — no scatter, no padded operand materialization.
+
+    The model-zoo path (``ops.nn._maxpool_sws``) already builds this
+    form in the model; this pass retrofits the same rewrite onto ANY
+    traced program that still carries the scatter (raw
+    ``lax.reduce_window`` code, imported graphs), with the PR-12
+    contract machinery vouching for it.
+    """
+
+    name = "maxpool_bwd_mask"
+    contract = Contract.bit_exact()
+    description = ("select_and_scatter_add (max-pool backward) -> "
+                   "shifted-window first-argmax mask (fused elementwise "
+                   "passes, no scatter; PERF.md lever c)")
+
+    #: test-only fault knob (see ops.nn.shifted_window_unpool): a
+    #: non-zero shift mis-routes the gradient; the GL301 probe must
+    #: catch it.  Never set outside tests.
+    _shift_mask = 0
+
+    def _match(self, eqn) -> bool:
+        if eqn.primitive.name != "select_and_scatter_add":
+            return False
+        p = eqn.params
+        if getattr(p.get("select_prim"), "name", "") != "ge":
+            return False  # only the max-pool (GE-select) form
+        operand = eqn.invars[1].aval
+        return jnp.issubdtype(operand.dtype, jnp.floating)
+
+    def run(self, closed_jaxpr, ctx: PassContext) -> Optional[PassResult]:
+        import jax.numpy as _jnp
+        from jax import lax
+
+        from ..ops.nn import shifted_window_unpool
+
+        hits = [0]
+        shift = self._shift_mask
+
+        def rule(eqn, invals):
+            if not self._match(eqn):
+                return None
+            source, operand = invals
+            p = eqn.params
+            window = tuple(p["window_dimensions"])
+            strides = tuple(p["window_strides"])
+            padding = tuple(tuple(q) for q in p["padding"])
+            out = lax.reduce_window(operand, -_jnp.inf, lax.max,
+                                    window, strides, padding)
+            dx = shifted_window_unpool(operand, out, source, window,
+                                       strides, padding,
+                                       _shift_mask=shift)
+            hits[0] += 1
+            return [dx.astype(eqn.outvars[0].aval.dtype)]
+
+        new_closed = retrace(closed_jaxpr, rule)
+        if not hits[0]:
+            return None
+        return PassResult(new_closed, hits=hits[0],
+                          notes="%d select-and-scatter max-pool "
+                                "backward(s) rewritten to the "
+                                "shifted-window mask form" % hits[0])
+
+
+# ---------------------------------------------------------------------------
 # shipped pass: CSE + dead-code elimination
 # ---------------------------------------------------------------------------
 
@@ -895,6 +982,7 @@ PASS_REGISTRY: Dict[str, Callable[[], GraftPass]] = {
     "quantize_int4": lambda: QuantizeWeightsPass(bits=4),
     "amp_bf16": AmpBf16Pass,
     "space_to_depth": SpaceToDepthPass,
+    "maxpool_bwd_mask": MaxPoolBwdMaskPass,
     "cse_dead_aux": CseDeadAuxPass,
 }
 

@@ -77,7 +77,10 @@ class BasicBlockV1(HybridBlock):
             self.conv1 = _conv3x3(channels, stride, in_channels)
             self.gbn1 = GhostBNReLU(group=ghost_bn)
             self.conv2 = _conv3x3(channels, 1, channels)
-            self.gbn2 = GhostBNReLU(group=ghost_bn)
+            # a downsample-shortcut output is consumed ONLY by this
+            # block's fused add: the kernel may write Y over it
+            self.gbn2 = GhostBNReLU(group=ghost_bn,
+                                    donate_residual=downsample)
             self.body = None
         else:
             self.body = nn.HybridSequential()
@@ -91,7 +94,10 @@ class BasicBlockV1(HybridBlock):
             self.downsample.add(nn.Conv2D(channels, kernel_size=1,
                                           strides=stride, use_bias=False,
                                           in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
+            # ghost mode keeps the shortcut BN on the fused single-read
+            # path too (no activation on a downsample branch)
+            self.downsample.add(GhostBN(group=ghost_bn) if ghost_bn
+                                else nn.BatchNorm())
         else:
             self.downsample = None
         if self.body is not None:
@@ -121,14 +127,27 @@ class GhostBNReLU(HybridBlock):
     ``parallel/fused_bn.py``) which computes statistics per ghost group in
     training.  Running stats update from the op's batch-stat outputs (no
     recompute).  Opt-in via ``ghost_bn=<group>`` on the model zoo resnets.
+
+    ``donate_residual=True`` marks the residual input of the fused
+    add variant as dead after this layer (a downsample-shortcut output
+    nothing else reads) so the kernel can write Y over its VMEM window
+    — never set it for identity shortcuts.  ``track_stats=False``
+    creates NO running-stat parameters and normalizes with ghost batch
+    statistics in every mode (the pipeline-parallel form: aux writes
+    cannot escape the pipelined scan, so a staged block must carry no
+    aux state).
     """
 
+    _act = "relu"
+
     def __init__(self, group=0, momentum=0.9, epsilon=1e-5, in_channels=0,
-                 **kwargs):
+                 donate_residual=False, track_stats=True, **kwargs):
         super().__init__(**kwargs)
         self._group = group
         self._momentum = momentum
         self._epsilon = epsilon
+        self._donate_residual = bool(donate_residual)
+        self._track_stats = bool(track_stats)
         shape = (in_channels,)
         with self.name_scope():
             self.gamma = self.params.get(
@@ -137,30 +156,53 @@ class GhostBNReLU(HybridBlock):
             self.beta = self.params.get(
                 "beta", grad_req="write", shape=shape, init="zeros",
                 allow_deferred_init=True)
-            self.running_mean = self.params.get(
-                "running_mean", grad_req="null", shape=shape, init="zeros",
-                allow_deferred_init=True)
-            self.running_var = self.params.get(
-                "running_var", grad_req="null", shape=shape, init="ones",
-                allow_deferred_init=True)
+            if self._track_stats:
+                self.running_mean = self.params.get(
+                    "running_mean", grad_req="null", shape=shape,
+                    init="zeros", allow_deferred_init=True)
+                self.running_var = self.params.get(
+                    "running_var", grad_req="null", shape=shape,
+                    init="ones", allow_deferred_init=True)
 
     def infer_shape(self, x, *args):
         c = x.shape[1]
-        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+        ps = [self.gamma, self.beta]
+        if self._track_stats:
+            ps += [self.running_mean, self.running_var]
+        for p in ps:
             p.shape = (c,)
 
     def hybrid_forward(self, F, x, residual=None, *, gamma, beta,
-                       running_mean, running_var):  # noqa: N803
+                       running_mean=None, running_var=None):  # noqa: N803
+        if not self._track_stats:
+            if residual is not None:
+                raise ValueError("track_stats=False has no fused residual "
+                                 "form yet; add the residual outside")
+            op = (F._contrib_GhostBNReLUNS if self._act == "relu"
+                  else F._contrib_GhostBNNS)
+            return op(x, gamma, beta, eps=self._epsilon, group=self._group)
         if residual is None:
-            out, bm, bv = F._contrib_GhostBNReLU(
-                x, gamma, beta, running_mean, running_var,
-                eps=self._epsilon, momentum=self._momentum,
-                group=self._group)
+            if self._act == "relu":
+                out, bm, bv = F._contrib_GhostBNReLU(
+                    x, gamma, beta, running_mean, running_var,
+                    eps=self._epsilon, momentum=self._momentum,
+                    group=self._group)
+            else:
+                out, bm, bv = F._contrib_GhostBN(
+                    x, gamma, beta, running_mean, running_var,
+                    eps=self._epsilon, momentum=self._momentum,
+                    group=self._group)
         else:
+            if self._act != "relu":
+                raise ValueError(
+                    "the fused residual form is BN+add+ReLU; %s has no "
+                    "activation and no fused add variant — add the "
+                    "residual outside" % type(self).__name__)
             out, bm, bv = F._contrib_GhostBNAddReLU(
                 x, residual, gamma, beta, running_mean, running_var,
                 eps=self._epsilon, momentum=self._momentum,
-                group=self._group)
+                group=self._group,
+                donate_residual=1 if self._donate_residual else 0)
         self._commit_running(F, running_mean, running_var, bm, bv)
         return out
 
@@ -170,6 +212,8 @@ class GhostBNReLU(HybridBlock):
 
         if getattr(F, "__is_symbol__", False) or not _opsnn._is_train():
             return  # symbolic path commits via the executor aux channel
+        if not self._track_stats:
+            return
         with autograd.pause():
             # shared running-stat formula (ops.nn._ghost_bn_aux_update) —
             # identical math on the Gluon, TrainStep and Executor paths
@@ -184,6 +228,16 @@ class GhostBNReLU(HybridBlock):
             else:
                 rm._data._data = upd[3].astype(rm._data.dtype)
                 rv._data._data = upd[4].astype(rv._data.dtype)
+
+
+class GhostBN(GhostBNReLU):
+    """Fused ghost-BN WITHOUT activation — the downsample-branch norm
+    (a 1x1-conv shortcut is normalized but never rectified).  Keeping
+    the downsample BN on the fused ghost path removes the last stock
+    multi-pass BatchNorm from the ghost_bn ResNet's step program
+    (docs/PERF.md round 19: it was the remaining GL202 offender)."""
+
+    _act = "none"
 
 
 class BottleneckV1(HybridBlock):
@@ -201,7 +255,10 @@ class BottleneckV1(HybridBlock):
             self.gbn2 = GhostBNReLU(group=ghost_bn)
             self.conv3 = nn.Conv2D(channels, kernel_size=1, strides=1,
                                    use_bias=False)
-            self.gbn3 = GhostBNReLU(group=ghost_bn)
+            # a downsample-shortcut output is consumed ONLY by this
+            # block's fused add: the kernel may write Y over it
+            self.gbn3 = GhostBNReLU(group=ghost_bn,
+                                    donate_residual=downsample)
             self.body = None
         else:
             self.body = nn.HybridSequential()
@@ -219,7 +276,8 @@ class BottleneckV1(HybridBlock):
             self.downsample.add(nn.Conv2D(channels, kernel_size=1,
                                           strides=stride, use_bias=False,
                                           in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
+            self.downsample.add(GhostBN(group=ghost_bn) if ghost_bn
+                                else nn.BatchNorm())
         else:
             self.downsample = None
         if self.body is not None:

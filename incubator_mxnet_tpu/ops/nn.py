@@ -138,18 +138,29 @@ def _maxpool_sws_fwd(data, window, strides, padding):
     return out, (data, out)
 
 
-def _maxpool_sws_bwd(window, strides, padding, res, g):
-    data, out = res
+def shifted_window_unpool(data, out, g, window, strides, padding,
+                          _shift_mask=0):
+    """Shifted-window mask max-pool backward: route ``g`` to the FIRST
+    argmax of each window (row-major scan order) with a handful of
+    fused elementwise passes instead of XLA's ``select-and-scatter``.
+
+    One shifted strided view of the padded input per in-window offset:
+    position p of the padded input contributes to window w iff
+    p = w*stride + offset.  The reference's active Pooling backward
+    (pool.h unpool_max_*_cpu) routes the WHOLE gradient to a single
+    argmax — the first match in row-major window scan order, which is
+    also ``select_and_scatter_add``'s GE-select tie rule, so the result
+    is BIT-exact vs XLA's own gradient (post-ReLU zero ties are common;
+    giving every tie the full gradient would inflate dX by the tie
+    count).  Shared by the model-level ``_maxpool_sws`` custom VJP and
+    the ``maxpool_bwd_mask`` graftpass (analysis/passes.py).
+
+    ``_shift_mask`` is a test-only fault knob: a non-zero value offsets
+    the winner index, deliberately mis-routing the gradient — the
+    GL301 contract probe must refuse such a mask.
+    """
     neg = np.asarray(-jnp.inf, data.dtype)[()]
     xp = lax.pad(data, neg, [(lo, hi, 0) for lo, hi in padding])
-    # one shifted strided view of the padded input per in-window offset:
-    # position p of the padded input contributes to window w iff
-    # p = w*stride + offset.  The reference's active Pooling backward
-    # (pool.h unpool_max_*_cpu) routes the WHOLE gradient to a single
-    # argmax — the first match in row-major window scan order — so pass
-    # 1 computes that winner's linear offset per window and pass 2
-    # scatters g to it alone (post-ReLU zero ties are common; giving
-    # every tie the full gradient would inflate dX by the tie count).
     offsets = list(itertools.product(*[range(k) for k in window]))
     noff = len(offsets)
     views = []
@@ -163,6 +174,8 @@ def _maxpool_sws_bwd(window, strides, padding, res, g):
         views.append((offset, limit))
         first = jnp.minimum(first, jnp.where(xs == out, jnp.int32(lin),
                                              jnp.int32(noff)))
+    if _shift_mask:
+        first = (first + jnp.int32(_shift_mask)) % jnp.int32(noff)
     dxp = jnp.zeros(xp.shape, g.dtype)
     for lin, (offset, limit) in enumerate(views):
         contrib = jnp.where(first == lin, g, jnp.zeros((), g.dtype))
@@ -171,7 +184,12 @@ def _maxpool_sws_bwd(window, strides, padding, res, g):
             for o, d, l, s in zip(offset, xp.shape, limit, strides)])
     dx = lax.slice(dxp, [lo for lo, _ in padding],
                    [d - hi for d, (_, hi) in zip(xp.shape, padding)])
-    return (dx.astype(data.dtype),)
+    return dx.astype(data.dtype)
+
+
+def _maxpool_sws_bwd(window, strides, padding, res, g):
+    data, out = res
+    return (shifted_window_unpool(data, out, g, window, strides, padding),)
 
 
 _maxpool_sws.defvjp(_maxpool_sws_fwd, _maxpool_sws_bwd)
@@ -312,17 +330,18 @@ OPS["BatchNorm"].mutate_idx = (3, 4)
 
 
 def _ghost_bn_common(data, residual, gamma, beta, moving_mean, moving_var,
-                     eps, group):
+                     eps, group, act="relu", donate_residual=False):
     """Shared body for the fused ghost-BN ops.  Training: Pallas fused
     kernel (parallel/fused_bn.py) with group statistics; eval: moving-stat
-    normalize (+add) + relu as plain jnp (XLA fuses it fine)."""
+    normalize (+add) (+relu) as plain jnp (XLA fuses it fine)."""
     if _is_train():
         from ..parallel.fused_bn import ghost_bn_act, ghost_bn_stats_merge
 
         out, m, v = ghost_bn_act(data, gamma.astype(jnp.float32),
                                  beta.astype(jnp.float32),
-                                 residual=residual, eps=eps, act="relu",
-                                 group=group)
+                                 residual=residual, eps=eps, act=act,
+                                 group=group,
+                                 donate_residual=donate_residual)
         bm, bv = ghost_bn_stats_merge(m, v)
         return out, bm, bv
     inv = lax.rsqrt(moving_var.astype(jnp.float32) + eps)
@@ -333,7 +352,9 @@ def _ghost_bn_common(data, residual, gamma, beta, moving_mean, moving_var,
     y = data.astype(jnp.float32) * scale + shift
     if residual is not None:
         y = y + residual.astype(jnp.float32)
-    return (jnp.maximum(y, 0.0).astype(data.dtype),
+    if act == "relu":
+        y = jnp.maximum(y, 0.0)
+    return (y.astype(data.dtype),
             moving_mean.astype(jnp.float32), moving_var.astype(jnp.float32))
 
 
@@ -354,10 +375,57 @@ def _ghost_bn_relu(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
 @register("_contrib_GhostBNAddReLU", num_inputs=6, num_outputs=3,
           mutate_idx=(4, 5))
 def _ghost_bn_add_relu(data, residual, gamma, beta, moving_mean, moving_var,
-                       eps=1e-3, momentum=0.9, group=0):
-    """Fused ghost-BN + residual add + ReLU (the bottleneck-exit pattern)."""
+                       eps=1e-3, momentum=0.9, group=0, donate_residual=0):
+    """Fused ghost-BN + residual add + ReLU (the bottleneck-exit pattern).
+
+    ``donate_residual=1`` declares the residual tensor dead after this
+    op (a downsample-shortcut output, consumed by nothing else): the
+    Pallas fwd writes Y over its VMEM window, which is what lets the
+    56x56x256 block-0 exits fuse at batch 256.  NEVER set it for an
+    identity shortcut — the surrounding program still reads that
+    tensor.
+    """
     return _ghost_bn_common(data, residual, gamma, beta, moving_mean,
-                            moving_var, float(eps), int(group))
+                            moving_var, float(eps), int(group),
+                            donate_residual=bool(int(donate_residual)))
+
+
+@register("_contrib_GhostBN", num_inputs=5, num_outputs=3,
+          mutate_idx=(3, 4))
+def _ghost_bn_noact(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                    momentum=0.9, group=0):
+    """Fused ghost-BN WITHOUT activation (the downsample-branch BN: a
+    1x1-conv shortcut is normalized but not rectified).  Same group
+    statistics and aux protocol as ``_contrib_GhostBNReLU``."""
+    return _ghost_bn_common(data, None, gamma, beta, moving_mean,
+                            moving_var, float(eps), int(group), act="none")
+
+
+@register("_contrib_GhostBNReLUNS", num_inputs=3, num_outputs=1)
+def _ghost_bn_relu_nostats(data, gamma, beta, eps=1e-3, group=0):
+    """Stats-free fused ghost-BN + ReLU: no running-stat aux state at
+    all (the pipeline-parallel form — aux writes cannot escape the
+    pipelined scan, so a pipelined stage must carry none).  Normalizes
+    with ghost batch statistics in EVERY mode; eval-time consumers that
+    need moving averages want the stateful op instead."""
+    return _ghost_bn_nostats_common(data, gamma, beta, eps, group, "relu")
+
+
+@register("_contrib_GhostBNNS", num_inputs=3, num_outputs=1)
+def _ghost_bn_nostats(data, gamma, beta, eps=1e-3, group=0):
+    """Stats-free fused ghost-BN WITHOUT activation (the pipelined
+    downsample-branch form: normalized, never rectified, no aux
+    state)."""
+    return _ghost_bn_nostats_common(data, gamma, beta, eps, group, "none")
+
+
+def _ghost_bn_nostats_common(data, gamma, beta, eps, group, act):
+    from ..parallel.fused_bn import ghost_bn_act
+
+    out, _, _ = ghost_bn_act(data, gamma.astype(jnp.float32),
+                             beta.astype(jnp.float32), eps=float(eps),
+                             act=act, group=int(group))
+    return out
 
 
 def _ghost_bn_aux_update(in_vals, out_vals, momentum=0.9, **_):
@@ -372,6 +440,7 @@ def _ghost_bn_aux_update(in_vals, out_vals, momentum=0.9, **_):
 
 OPS["_contrib_GhostBNReLU"].aux_update = _ghost_bn_aux_update
 OPS["_contrib_GhostBNAddReLU"].aux_update = _ghost_bn_aux_update
+OPS["_contrib_GhostBN"].aux_update = _ghost_bn_aux_update
 
 
 @register("LayerNorm", aliases=("layer_norm",))

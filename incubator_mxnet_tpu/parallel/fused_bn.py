@@ -53,10 +53,25 @@ from jax.experimental.pallas import tpu as pltpu
 
 _I0 = np.int32(0)  # index-map literal pinned to i32 (package enables x64)
 
+#: jax 0.4.x ships the TPU params type as ``TPUCompilerParams``; newer
+#: releases renamed it ``CompilerParams``.  Resolve whichever exists —
+#: interpret mode accepts either, so the CPU parity tests run the same
+#: call path as the chip.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 __all__ = ["ghost_bn_act", "ghost_bn_stats_merge"]
 
 _VMEM_KERNEL_LIMIT = 120 * 1024 * 1024
 _WINDOW_BUDGET = 104 * 1024 * 1024
+
+#: in-place output aliasing (dX over gY etc. — see _call_bwd).  A
+#: debugging escape hatch; the plan's window accounting assumes True.
+_IO_ALIASES = True
+
+
+def _aliases(d):
+    return d if _IO_ALIASES else {}
 
 
 def _use_interpret():
@@ -249,7 +264,8 @@ def _specs(l, n, c, ab, ch_axis):
     return xspec, pspec, sspec, n_groups, pshape, sshape
 
 
-def _call_fwd(x_v, gamma, beta, residual, eps, act, ab, ch_axis):
+def _call_fwd(x_v, gamma, beta, residual, eps, act, ab, ch_axis,
+              donate_res=False):
     l = x_v.shape[0]
     n = x_v.shape[1] if ch_axis == 2 else x_v.shape[2]
     c = x_v.shape[2] if ch_axis == 2 else x_v.shape[1]
@@ -260,6 +276,7 @@ def _call_fwd(x_v, gamma, beta, residual, eps, act, ab, ch_axis):
     out_shape = [jax.ShapeDtypeStruct(x_v.shape, x_v.dtype),
                  jax.ShapeDtypeStruct(sshape, jnp.float32),
                  jax.ShapeDtypeStruct(sshape, jnp.float32)]
+    aliases = {}
     if residual is None:
         kern = functools.partial(_fwd_kernel, eps=eps, act=act, lc=lc,
                                  ch_axis=ch_axis)
@@ -270,10 +287,17 @@ def _call_fwd(x_v, gamma, beta, residual, eps, act, ab, ch_axis):
                                  ch_axis=ch_axis)
         in_specs = [xspec, xspec, pspec, pspec]
         args = (x_v, residual, gamma.reshape(pshape), beta.reshape(pshape))
+        if donate_res:
+            # the caller declared the residual dead after this layer
+            # (the downsample-shortcut case): Y writes into its window
+            # — the norm loop reads r[sl] strictly before y[sl] lands,
+            # so the in-place chunk update is race-free
+            aliases = {1: 0}
     y, m, v = pl.pallas_call(
         kern, grid=grid, in_specs=in_specs,
         out_specs=[xspec, sspec, sspec], out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
+        input_output_aliases=_aliases(aliases),
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel"),
             vmem_limit_bytes=_VMEM_KERNEL_LIMIT),
         interpret=_use_interpret())(*args)
@@ -281,6 +305,17 @@ def _call_fwd(x_v, gamma, beta, residual, eps, act, ab, ch_axis):
 
 
 def _call_bwd(gy, x_v, y_v, gamma, beta, m, v, eps, act, ab, ch_axis):
+    """One-read backward.  The cotangent gY and the saved X are both
+    dead after this call (gY's only consumer is this vjp; X was saved
+    exactly for it), so the kernels write their outputs in place:
+    dX over gY (non-residual) / dR over gY and dX over X (residual) via
+    ``input_output_aliases`` — the reduction loop finishes every chunk
+    read before the write loop touches a window, and within the write
+    loop each chunk is read strictly before it is overwritten.  That
+    cuts the double-buffered VMEM budget from 3 (5 residual) full
+    windows to 2 (3), which is what lets the 28x28x512 residual exits
+    and the 56x56x256 downsample BN run the fused bwd at batch 256
+    (docs/PERF.md round 19)."""
     l = x_v.shape[0]
     n = x_v.shape[1] if ch_axis == 2 else x_v.shape[2]
     c = x_v.shape[2] if ch_axis == 2 else x_v.shape[1]
@@ -300,7 +335,8 @@ def _call_bwd(gy, x_v, y_v, gamma, beta, m, v, eps, act, ab, ch_axis):
             out_specs=[xspec, sspec, sspec],
             out_shape=[jax.ShapeDtypeStruct(x_v.shape, x_v.dtype), dstat,
                        dstat],
-            compiler_params=pltpu.CompilerParams(
+            input_output_aliases=_aliases({0: 0}),  # dX over dead gY
+            compiler_params=_CompilerParams(
                 dimension_semantics=("parallel", "parallel"),
                 vmem_limit_bytes=_VMEM_KERNEL_LIMIT),
             interpret=_use_interpret())(
@@ -315,7 +351,8 @@ def _call_bwd(gy, x_v, y_v, gamma, beta, m, v, eps, act, ab, ch_axis):
             out_specs=[xspec, sspec, sspec, xspec],
             out_shape=[jax.ShapeDtypeStruct(x_v.shape, x_v.dtype), dstat,
                        dstat, jax.ShapeDtypeStruct(x_v.shape, x_v.dtype)],
-            compiler_params=pltpu.CompilerParams(
+            input_output_aliases=_aliases({0: 3, 1: 0}),  # dR/gY, dX/X
+            compiler_params=_CompilerParams(
                 dimension_semantics=("parallel", "parallel"),
                 vmem_limit_bytes=_VMEM_KERNEL_LIMIT),
             interpret=_use_interpret())(
@@ -329,16 +366,21 @@ def _call_bwd(gy, x_v, y_v, gamma, beta, m, v, eps, act, ab, ch_axis):
 # ---------------------------------------------------------------------------
 
 
-def _plan(n, c, l, itemsize, group, has_res):
+def _plan(n, c, l, itemsize, group, has_res, donate_res=False):
     """Choose ``(ch_axis, (A-block, B-block), bwd_pallas)`` or None for
     the full-jnp fallback.
 
     Feasibility is per DIRECTION: Mosaic double-buffers every window
-    (x2) and pads sublanes/lanes to the dtype tile; the fwd needs
-    2(+1 residual) big windows vs the bwd's 3(+2).  A layer whose bwd
-    windows bust the budget still runs the single-read Pallas FWD with
-    an equivalent jnp bwd over the same ghost groups (hybrid) — every
-    non-stem ResNet-50 BN keeps at least the fwd stats-read saving.
+    (x2) and pads sublanes/lanes to the dtype tile.  Window counts
+    reflect the in-place aliasing ``_call_fwd``/``_call_bwd`` declare:
+    fwd needs 2 windows (X in, Y out) + 1 for a residual — or +0 when
+    the caller donates it (``donate_residual``: dead shortcut tensors
+    alias into Y); bwd needs 2 (X in, dX over the dead gY window) + 1
+    residual (Y for the post-add ReLU mask; dR rides the gY window and
+    dX the X window).  A layer whose bwd windows bust the budget still
+    runs the single-read Pallas FWD with an equivalent jnp bwd over the
+    same ghost groups (hybrid) — every non-stem ResNet-50 BN keeps at
+    least the fwd stats-read saving.
     """
     sub = _sublane(itemsize)
 
@@ -348,8 +390,8 @@ def _plan(n, c, l, itemsize, group, has_res):
     def fits(nwin, a_blk, b_blk):
         return nwin * 2 * padded(a_blk, b_blk) <= _WINDOW_BUDGET
 
-    fw = 3 if has_res else 2
-    bw = 5 if has_res else 3
+    fw = (3 - (1 if donate_res else 0)) if has_res else 2
+    bw = 3 if has_res else 2
     if c >= 128 or n > 128:
         # LNC: full C on lanes, ghost group on sublanes.  Prefer
         # tile-multiple groups (a sub-tile group pads VMEM to the tile
@@ -372,7 +414,13 @@ def _plan(n, c, l, itemsize, group, has_res):
         return None
     # small-N path (N <= 128, C < 128): channels on sublanes, the WHOLE
     # batch on lanes — exact full-batch statistics, contiguous
-    # cb*N*itemsize runs (the block covers full N and a dense C-slice)
+    # cb*N*itemsize runs (the block covers full N and a dense C-slice).
+    # This kernel's ghost group IS the full lane block (= N): when the
+    # caller capped the group below that, honoring the declared
+    # bn_group semantics outranks the kernel — fall back to the jnp
+    # formulation, which computes the capped per-group statistics.
+    if group and group < n:
+        return None
     cb = c
     while cb > 0 and not fits(fw, cb, n):
         cb -= sub
@@ -403,13 +451,14 @@ def _from_view(x_v, shape, ch_axis):
 # ---------------------------------------------------------------------------
 
 
-def _gbn_fwd(x, gamma, beta, residual, eps, act, group):
+def _gbn_fwd(x, gamma, beta, residual, eps, act, group, donate_res=False):
     n, c, h, w = x.shape
     ch_axis, ab, _ = _plan(n, c, h * w, x.dtype.itemsize, group,
-                           residual is not None)
+                           residual is not None, donate_res)
     x_v = _to_view(x, ch_axis)
     r_v = None if residual is None else _to_view(residual, ch_axis)
-    y_v, m, v = _call_fwd(x_v, gamma, beta, r_v, eps, act, ab, ch_axis)
+    y_v, m, v = _call_fwd(x_v, gamma, beta, r_v, eps, act, ab, ch_axis,
+                          donate_res=donate_res)
     y = _from_view(y_v, x.shape, ch_axis)
     res = (x_v, y_v if residual is not None else None, gamma, beta, m, v,
            x.shape)
@@ -449,12 +498,12 @@ def _gbn_bwd_jnp(gy, x, y, gamma, beta, m, v, eps, act, ng):
             dr)
 
 
-def _gbn_bwd(eps, act, group, res, ct):
+def _gbn_bwd(eps, act, group, donate_res, res, ct):
     x_v, y_v, gamma, beta, m, v, shape = res
     gy, _, _ = ct  # cotangents for the stat outputs are not propagated
     n, c, h, w = shape
     ch_axis, ab, bwd_pallas = _plan(n, c, h * w, x_v.dtype.itemsize, group,
-                                    y_v is not None)
+                                    y_v is not None, donate_res)
     if bwd_pallas:
         gy_v = _to_view(gy, ch_axis)
         dx, dg, db, dr = _call_bwd(gy_v, x_v, y_v, gamma, beta, m, v, eps,
@@ -470,10 +519,10 @@ def _gbn_bwd(eps, act, group, res, ct):
     return (dx, dg.astype(gamma.dtype), db.astype(beta.dtype), dr)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _gbn_full(x, gamma, beta, residual, eps, act, group):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _gbn_full(x, gamma, beta, residual, eps, act, group, donate_res):
     """Returns (y, group_mean, group_var) — stat outputs get zero vjp."""
-    return _gbn_fwd(x, gamma, beta, residual, eps, act, group)[0]
+    return _gbn_fwd(x, gamma, beta, residual, eps, act, group, donate_res)[0]
 
 
 _gbn_full.defvjp(_gbn_fwd, _gbn_bwd)
@@ -513,22 +562,31 @@ def _gbn_ref(x, gamma, beta, residual, eps, act, group):
 
 
 def ghost_bn_act(x, gamma, beta, residual=None, eps=1e-3, act="relu",
-                 group=0):
+                 group=0, donate_residual=False):
     """Fused ghost-BN(+residual)+activation.
 
     x: (N, C, H, W).  Returns ``(y, group_mean, group_var)`` with stats of
-    shape (G, C).  The effective ghost group is chosen per layer shape
-    (the ``group`` argument is a cap for the sublane path; the small-C
-    lane path uses groups of up to 128) — deterministic per shape.
-    Differentiable in x, gamma, beta and residual (stat outputs carry
-    zero gradient — they feed running-stat updates, which the reference
-    likewise excludes from autograd, ``src/operator/nn/batch_norm.cc``
-    aux states).  Layers whose windows can't fit the VMEM budget use an
-    equivalent jnp formulation.
+    shape (G, C).  The ``group`` argument is a CAP on the ghost group:
+    the sublane path picks the largest fitting divisor under it, the
+    small-C lane path (whose group is the whole lane block) and the jnp
+    fallback honor it exactly — deterministic per shape.  ``act`` is
+    ``"relu"`` or ``"none"`` (the downsample-BN case).
+    ``donate_residual=True`` declares the residual tensor dead after
+    this layer (the downsample-shortcut case — NEVER an identity
+    shortcut, which the surrounding program still reads): the fwd
+    kernel then writes Y over the residual's window, saving one VMEM
+    window and letting larger exits fuse.  Differentiable in x, gamma,
+    beta and residual (stat outputs carry zero gradient — they feed
+    running-stat updates, which the reference likewise excludes from
+    autograd, ``src/operator/nn/batch_norm.cc`` aux states).  Layers
+    whose windows can't fit the VMEM budget use an equivalent jnp
+    formulation with the same ghost-group statistics.
     """
     n, c, h, w = x.shape
+    donate = bool(donate_residual) and residual is not None
     if _plan(n, c, h * w, x.dtype.itemsize, int(group),
-             residual is not None) is None:
+             residual is not None, donate) is None:
         return _gbn_ref(x, gamma, beta, residual, float(eps), act,
                         int(group))
-    return _gbn_full(x, gamma, beta, residual, float(eps), act, int(group))
+    return _gbn_full(x, gamma, beta, residual, float(eps), act, int(group),
+                     donate)

@@ -882,10 +882,22 @@ class TrainStep:
                     return spmd_pipeline(stage_fn, local, mb,
                                          axis_name=pp_axis, remat=remat)
 
-                outs = shard_map(
-                    inner, mesh=mesh,
-                    in_specs=(tuple(P() for _ in stacked), mb_spec),
-                    out_specs=mb_spec)(stacked, micro)
+                # pallas_call (the fused ghost-BN kernels a staged
+                # block may contain) carries no replication-rule
+                # metadata; skip the replication checker like the
+                # zero-update leg does (check_vma on jax >= 0.6,
+                # check_rep on 0.4)
+                try:
+                    mapped = shard_map(
+                        inner, mesh=mesh,
+                        in_specs=(tuple(P() for _ in stacked), mb_spec),
+                        out_specs=mb_spec, check_vma=False)
+                except TypeError:
+                    mapped = shard_map(
+                        inner, mesh=mesh,
+                        in_specs=(tuple(P() for _ in stacked), mb_spec),
+                        out_specs=mb_spec, check_rep=False)
+                outs = mapped(stacked, micro)
                 flat = outs.reshape((-1,) + outs.shape[2:])
                 tc = tracing.TraceContext(use_key, training=True)
                 tracing.push_trace(tc)

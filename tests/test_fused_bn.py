@@ -33,10 +33,17 @@ def _ref(x, gamma, beta, residual=None, eps=1e-3, group=4):
     return jnp.maximum(y, 0.0).astype(x.dtype), m, v
 
 
-@pytest.mark.parametrize("c,kernel_group", [(256, 4), (64, 8)])
-def test_ghost_bn_fwd_bwd_matches_reference(c, kernel_group):
-    # c=256 exercises the lane-channel (LNC) kernel; c=64 the
-    # sublane-channel (LCN) kernel whose group is the full lane block
+@pytest.mark.parametrize("c,call_group,kernel_group", [
+    # LNC kernel: the cap picks group 4 of batch 8
+    (256, 4, 4),
+    # LCN kernel: group == full lane block (the whole batch)
+    (64, 8, 8),
+    # LCN shape with a SUB-block cap: the kernel's lane-block group
+    # would violate the declared bn_group semantics, so the jnp
+    # fallback honors the cap exactly (per-group parity asserted)
+    (64, 4, 4),
+])
+def test_ghost_bn_fwd_bwd_matches_reference(c, call_group, kernel_group):
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.normal(size=(8, c, 6, 6)).astype(np.float32))
     res = jnp.asarray(rng.normal(size=(8, c, 6, 6)).astype(np.float32))
@@ -44,7 +51,8 @@ def test_ghost_bn_fwd_bwd_matches_reference(c, kernel_group):
     beta = jnp.asarray(rng.normal(size=c).astype(np.float32) * 0.2)
     residuals = (None, res) if c >= 128 else (None,)
     for residual in residuals:
-        y, m, v = ghost_bn_act(x, gamma, beta, residual=residual, group=4)
+        y, m, v = ghost_bn_act(x, gamma, beta, residual=residual,
+                               group=call_group)
         yr, mr, vr = _ref(x, gamma, beta, residual=residual,
                           group=kernel_group)
         np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
@@ -53,7 +61,8 @@ def test_ghost_bn_fwd_bwd_matches_reference(c, kernel_group):
                                    rtol=1e-4, atol=1e-5)
 
         def lk(x, gamma, beta, r):
-            y, _, _ = ghost_bn_act(x, gamma, beta, residual=r, group=4)
+            y, _, _ = ghost_bn_act(x, gamma, beta, residual=r,
+                                   group=call_group)
             return (y * jnp.cos(jnp.arange(y.size).reshape(y.shape))).sum()
 
         def lr(x, gamma, beta, r):
@@ -114,13 +123,33 @@ def test_ghost_bn_block_matches_batchnorm_at_full_group():
     assert np.abs(gbn.running_mean.data().asnumpy()).sum() > 0
 
 
-def test_resnet50_ghost_bn_trains_and_updates_stats():
+def test_ghost_bn_noact_nostats_does_not_rectify():
+    """GhostBN(track_stats=False) — the pipelined downsample-branch
+    norm — must NOT apply ReLU (regression: the stats-free branch used
+    to hardcode the ReLU op regardless of the subclass)."""
+    from incubator_mxnet_tpu.gluon.model_zoo.vision.resnet import (GhostBN,
+                                                                   GhostBNReLU)
+
+    mx.random.seed(0)
+    x = nd.random.normal(shape=(4, 8, 6, 6))
+    outs = {}
+    for cls in (GhostBN, GhostBNReLU):
+        layer = cls(group=2, track_stats=False, in_channels=8)
+        layer.initialize()
+        with autograd.record():
+            outs[cls] = layer(x).asnumpy()
+    assert (outs[GhostBN] < 0).any(), "no-act form was rectified"
+    assert not (outs[GhostBNReLU] < 0).any()
+    np.testing.assert_allclose(np.maximum(outs[GhostBN], 0.0),
+                               outs[GhostBNReLU], rtol=1e-5, atol=1e-5)
+
+
+def _ghost_resnet_trains(factory):
     from incubator_mxnet_tpu import gluon
-    from incubator_mxnet_tpu.gluon.model_zoo import vision
     from incubator_mxnet_tpu.parallel import make_train_step
 
     mx.random.seed(0)
-    net = vision.resnet50_v1(classes=10, ghost_bn=8)
+    net = factory(classes=10, ghost_bn=8)
     net.initialize(init=mx.init.Xavier())
     net.shape_init((1, 3, 32, 32))
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
@@ -136,6 +165,21 @@ def test_resnet50_ghost_bn_trains_and_updates_stats():
     # eval-mode forward uses moving stats
     out = net(x)
     assert out.shape == (8, 10)
+
+
+def test_resnet18_ghost_bn_trains_and_updates_stats():
+    """Fast tier-1 representative (basic blocks + GhostBN downsample
+    branches); the bottleneck resnet50 clone runs under -m slow."""
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    _ghost_resnet_trains(vision.resnet18_v1)
+
+
+@pytest.mark.slow
+def test_resnet50_ghost_bn_trains_and_updates_stats():
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    _ghost_resnet_trains(vision.resnet50_v1)
 
 
 def test_s2d_stem_exact():
@@ -184,8 +228,10 @@ def test_ghost_bn_export_symbol_parity():
 def test_ghost_bn_hybrid_bwd_matches_pallas_bwd(monkeypatch):
     """The fwd-only hybrid (Pallas fwd + jnp bwd over the same ghost
     groups) must produce the same gradients as the fully-fused path —
-    it is what stage-2/3 residual exits run at batch 256 when the bwd
-    windows bust the VMEM budget."""
+    it is what the 56x56x256 donated-residual exits run at batch 256:
+    with the bwd's in-place aliasing, fwd and bwd both cost 3 windows
+    on a residual layer, so the hybrid only arises with
+    ``donate_residual`` (fwd 2 windows, bwd 3)."""
     from incubator_mxnet_tpu.parallel import fused_bn as fb
 
     rng = np.random.RandomState(2)
@@ -195,20 +241,21 @@ def test_ghost_bn_hybrid_bwd_matches_pallas_bwd(monkeypatch):
     beta = jnp.asarray(rng.normal(size=256).astype(np.float32) * 0.2)
 
     def loss(x, gamma, beta, r):
-        y, _, _ = fb.ghost_bn_act(x, gamma, beta, residual=r, group=4)
+        y, _, _ = fb.ghost_bn_act(x, gamma, beta, residual=r, group=4,
+                                  donate_residual=True)
         return (y * jnp.cos(jnp.arange(y.size).reshape(y.shape))).sum()
 
-    full_plan = fb._plan(8, 256, 36, 4, 4, True)
+    full_plan = fb._plan(8, 256, 36, 4, 4, True, True)
     assert full_plan is not None and full_plan[2], "precondition: full fuse"
     g_full = jax.grad(loss, argnums=(0, 1, 2, 3))(x, gamma, beta, res)
 
-    # shrink the budget so exactly the bwd (5 windows) no longer fits:
-    # fwd needs 3*2*padded, bwd 5*2*padded
+    # shrink the budget so exactly the bwd (3 windows with in-place
+    # aliasing) no longer fits while the donated-residual fwd (2) does
     itemsize = 4
     padded = 36 * fb._rup(4, fb._sublane(itemsize)) * fb._rup(256, 128) \
         * itemsize
-    monkeypatch.setattr(fb, "_WINDOW_BUDGET", 4 * 2 * padded)
-    hybrid_plan = fb._plan(8, 256, 36, itemsize, 4, True)
+    monkeypatch.setattr(fb, "_WINDOW_BUDGET", 2 * 2 * padded)
+    hybrid_plan = fb._plan(8, 256, 36, itemsize, 4, True, True)
     assert hybrid_plan is not None and not hybrid_plan[2], \
         "budget shrink must force the fwd-only hybrid, got %r" % (
             hybrid_plan,)

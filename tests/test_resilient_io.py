@@ -824,32 +824,49 @@ def test_abandoned_iterator_reaped_without_close():
     to the iterator (bound-method thread target), so dropping a
     mid-epoch ResilientIter without close() could never reach __del__
     — the worker spun in its stop-aware put forever.  The worker holds
-    only a weakref now; GC reaps both."""
+    only a weakref now; GC reaps both.
+
+    De-flaked (ISSUE 14): the old form compared ``threading
+    .active_count()`` against a baseline COUNT, which broke in-suite —
+    unrelated threads leaked by earlier tests (reaper/watchdog/batcher
+    workers winding down on their own timers) sat in the baseline and
+    exited mid-test, so equality failed on ordering luck.  The
+    contract is about THIS test's threads only: collect garbage first,
+    snapshot thread IDENTITIES, and assert no thread born here
+    survives — pre-existing threads may come or go freely."""
     import gc
 
-    t0 = threading.active_count()
+    def new_threads(baseline):
+        return [t for t in threading.enumerate() if t not in baseline]
+
+    gc.collect()  # reap strays from earlier tests before baselining
+    baseline = set(threading.enumerate())
     it = _make_iter(1, prefetch=1)
     it.next()  # mid-epoch: worker parked on the full queue
     wref = __import__("weakref").ref(it)
     del it
     gc.collect()
     deadline = time.monotonic() + 3
-    while threading.active_count() > t0 and time.monotonic() < deadline:
+    while new_threads(baseline) and time.monotonic() < deadline:
         time.sleep(0.05)
     assert wref() is None, "abandoned iterator was never collected"
-    assert threading.active_count() == t0, \
-        "abandoned iterator's prefetch worker leaked"
+    assert not new_threads(baseline), \
+        "abandoned iterator's prefetch worker leaked: %r" % (
+            new_threads(baseline),)
     # same contract for the plain PrefetchingIter wrapper
     X, Y = _data()
+    baseline = set(threading.enumerate())
     p = PrefetchingIter(NDArrayIter(X, Y, batch_size=BATCH),
                         prefetch_depth=1)
     p.next()
     del p
     gc.collect()
     deadline = time.monotonic() + 3
-    while threading.active_count() > t0 and time.monotonic() < deadline:
+    while new_threads(baseline) and time.monotonic() < deadline:
         time.sleep(0.05)
-    assert threading.active_count() == t0
+    assert not new_threads(baseline), \
+        "abandoned PrefetchingIter's worker leaked: %r" % (
+            new_threads(baseline),)
 
 
 def test_quarantine_log_best_effort(tmp_path):

@@ -74,7 +74,7 @@ def _parse_bytes(s):
     return float(s)
 
 
-def _build_model(name, feat=16, layers=4):
+def _build_model(name, feat=16, layers=4, ghost_bn=0):
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import nd
     from incubator_mxnet_tpu.gluon import nn
@@ -102,7 +102,10 @@ def _build_model(name, feat=16, layers=4):
     if name == "resnet50":
         from incubator_mxnet_tpu.gluon.model_zoo import vision
 
-        net = vision.resnet50_v1(classes=1000)
+        # ghost_bn > 0: the fused ghost-BN perf variant (Pallas
+        # kernels + GhostBN downsample branches; parallel/fused_bn.py)
+        # — the round-19 byte table's fused rows come from here
+        net = vision.resnet50_v1(classes=1000, ghost_bn=ghost_bn)
         net.initialize(init=mx.init.Zero())
         net.shape_init((1, 3, 224, 224))
         return net, (3, 224, 224), "conv"
@@ -234,6 +237,16 @@ def main(argv=None) -> int:
     ap.add_argument("--no-donate", action="store_true")
     ap.add_argument("--compute-dtype", default=None,
                     help="e.g. bfloat16 (default: f32)")
+    ap.add_argument("--ghost-bn", "--bn-group", dest="ghost_bn", type=int,
+                    default=0, metavar="GROUP",
+                    help="resnet50 only: fused ghost-BN variant with "
+                         "this bn_group cap (0 = stock BatchNorm) — the "
+                         "PERF.md fused byte table without a chip")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated graftpass names applied to the "
+                         "step before costing (the autotune post-pass "
+                         "analyze_cost path), e.g. "
+                         "space_to_depth,maxpool_bwd_mask")
     ap.add_argument("--device", default="tpu-v5e",
                     help="roofline device-spec registry key")
     ap.add_argument("--hbm-budget", default=None,
@@ -272,7 +285,9 @@ def main(argv=None) -> int:
     if args.device not in DEVICE_SPECS:
         raise SystemExit("unknown --device %r (registry: %s)"
                          % (args.device, sorted(DEVICE_SPECS)))
-    net, in_shape, kind = _build_model(args.model)
+    if args.ghost_bn and args.model != "resnet50":
+        raise SystemExit("--ghost-bn applies to --model resnet50 only")
+    net, in_shape, kind = _build_model(args.model, ghost_bn=args.ghost_bn)
     budget = _parse_bytes(args.hbm_budget)
 
     mesh = None
@@ -292,7 +307,11 @@ def main(argv=None) -> int:
         pipeline_stages=args.pipeline_stages, num_micro=args.num_micro,
         pipeline_remat=args.pipeline_remat, donate=not args.no_donate,
         compute_dtype=args.compute_dtype, lint="off", cost="off",
-        hbm_budget=budget, cost_device=args.device, **kw)
+        hbm_budget=budget, cost_device=args.device,
+        # resolve_passes accepts the raw comma string; () = explicitly
+        # none (an absent flag must not absorb MXTPU_PASSES here — the
+        # CLI's output should reflect its own arguments only)
+        passes=args.passes if args.passes else (), **kw)
 
     x = jax.ShapeDtypeStruct((args.batch,) + in_shape, jnp.float32)
     if args.model == "conv-bn":
