@@ -26,6 +26,11 @@ semantics):
   whose tree/shape/dtype drifts from the served signature — same avals
   are the zero-recompile contract of a live swap; drift would recompile
   every bucket program under traffic (``check_swap_compatibility``).
+  GL012 (warning, emitted by the fused step's lint pass) flags
+  ``nonfinite="skip"`` under a STATIC loss scale with no declared
+  skip-streak bound — an unbounded silent skip-streak is a stalled run
+  that looks alive (``check_unbounded_skip``; the supervisor's
+  divergence detector enforces the bound, ``parallel/supervisor.py``).
 - **Level 2 (source)**: :mod:`.source_lint` + the ``tools/graftlint.py``
   CLI check repo idiom (GL101–GL103) plus the checkpoint-without-
   iterator-state pattern (GL008, a warning: a loop consuming a stateful
@@ -95,7 +100,7 @@ from .trace_lint import (check_inference_param_donation,
                          check_legacy_checkpoint_path,
                          check_partition_spec, check_permutation,
                          check_process_local_ckpt_dir,
-                         check_swap_compatibility,
+                         check_swap_compatibility, check_unbounded_skip,
                          check_zero_state_shardings, lint_jaxpr,
                          lint_traceable, recompile_probe,
                          validate_permutation)
@@ -112,6 +117,7 @@ __all__ = [
     "check_legacy_checkpoint_path",
     "check_partition_spec", "check_permutation",
     "check_process_local_ckpt_dir", "check_swap_compatibility",
+    "check_unbounded_skip",
     "check_zero_state_shardings", "code_matches", "fit_residual",
     "get_pass", "lint_jaxpr",
     "lint_paths", "lint_source", "lint_traceable", "loss_scale_diags",

@@ -78,6 +78,14 @@ CODES = {
               "existing AOT programs serve the new version with ZERO "
               "recompiles; drift forces a recompile storm across every "
               "bucket, an outage, not a swap"),
+    "GL012": (Severity.WARNING,
+              "nonfinite='skip' with a STATIC loss scale and no "
+              "skip-streak bound — every overflowed step is skipped "
+              "silently and the scale never adapts, so a poisoned run "
+              "skips forever while looking alive (a stalled run, not a "
+              "failed one); use loss_scale='dynamic' or set "
+              "skip_streak_budget= so the supervisor's divergence "
+              "detector bounds the streak"),
     "GL201": (Severity.ERROR,
               "graftcost: predicted peak live-buffer memory exceeds the "
               "HBM budget — the program is infeasible at this config; "

@@ -42,6 +42,7 @@ __all__ = ["capture_effect_diagnostics", "check_inference_param_donation",
            "check_legacy_checkpoint_path",
            "check_permutation", "validate_permutation",
            "check_partition_spec", "check_swap_compatibility",
+           "check_unbounded_skip",
            "check_zero_state_shardings",
            "donated_leaf_indices", "lint_jaxpr", "lint_traceable",
            "recompile_probe"]
@@ -378,6 +379,44 @@ def check_legacy_checkpoint_path(origin: str,
         hint="checkpoint through the fused step instead: "
              "step.save_checkpoint(dir) / step.restore_checkpoint(dir) "
              "(parallel.checkpoint, docs/RESILIENCE.md)")]
+
+
+def check_unbounded_skip(nonfinite: str, dynamic_scale: bool,
+                         skip_streak_budget,
+                         where: str = "") -> List[Diagnostic]:
+    """GL012 core: ``nonfinite="skip"`` under a STATIC loss scale with
+    no skip-streak bound anywhere.
+
+    The skip guard protects state bit-exactly — but with a static
+    scale nothing ever *adapts* out of the overflow: a batch of
+    corrupt records, a bad learning-rate spike, or a too-high scale
+    makes EVERY subsequent step overflow, and each one is silently
+    skipped.  The loop keeps spinning, the step counter stands still,
+    and the run looks alive while training nothing — an unbounded
+    silent skip-streak is a stalled run that a dashboard reads as
+    healthy.  A dynamic scale bounds the streak by construction (it
+    halves out of the overflow); a declared ``skip_streak_budget``
+    bounds it by policy (the supervisor's divergence detector turns
+    the streak into a verdict, ``parallel/supervisor.py``).  With
+    neither, this warns before a long run banks on it.
+    """
+    if nonfinite != "skip" or dynamic_scale or \
+            skip_streak_budget is not None:
+        return []
+    return [Diagnostic(
+        "GL012", Severity.WARNING,
+        "nonfinite='skip' with a static loss scale and no skip-streak "
+        "bound: every overflowed step is skipped silently and the "
+        "scale never adapts — a poisoned run skips forever while "
+        "looking alive (stalled, not failed, and nothing will ever "
+        "say so)",
+        where=where,
+        hint="use loss_scale='dynamic' (the scale halves out of a "
+             "streak by construction), or declare "
+             "make_train_step(skip_streak_budget=N) and drive the loop "
+             "through parallel/supervisor.py — its divergence detector "
+             "turns a streak past the budget into a rollback/respawn "
+             "verdict (docs/RESILIENCE.md §7)")]
 
 
 def check_inference_param_donation(donated_leaves, param_leaves,

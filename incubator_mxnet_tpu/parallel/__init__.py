@@ -13,6 +13,9 @@ from .checkpoint import (CheckpointError, CheckpointCorruptError,
                          CheckpointManager, CheckpointTopologyError,
                          install_preemption_hook, request_checkpoint,
                          uninstall_preemption_hook)
+from .supervisor import (DivergenceDetector, DivergenceError, HealthLedger,
+                         HeartbeatEmitter, Supervisor, SupervisorConfig,
+                         SupervisorError, run_supervised)
 from . import distributed
 
 __all__ = ["Mesh", "NamedSharding", "P", "PartitionSpec", "make_mesh",
@@ -24,4 +27,7 @@ __all__ = ["Mesh", "NamedSharding", "P", "PartitionSpec", "make_mesh",
            "CheckpointCorruptError", "CheckpointTopologyError",
            "CheckpointManager", "install_preemption_hook",
            "uninstall_preemption_hook", "request_checkpoint",
+           "DivergenceDetector", "DivergenceError", "HealthLedger",
+           "HeartbeatEmitter", "Supervisor", "SupervisorConfig",
+           "SupervisorError", "run_supervised",
            "distributed"]

@@ -41,6 +41,19 @@ Deterministic, test-grade fault injectors for the failure classes
   the ``tools/serve_bench.py --chaos`` leg.  The first two interpose
   ``serve/batcher.py::_serve_batch``, the engine-execution choke
   point, exactly like ``slow_client`` interposes ``_admit``;
+- **supervised-training chaos** — :func:`hang_step` wedges the
+  supervised step callable (the ``parallel/supervisor.py::_run_step``
+  choke point, exactly like ``_patched_serve`` wedges the batcher) so
+  the rank stops heartbeating mid-step — the watchdog's hang detector
+  must fire within its auto-calibrated stall timeout; with a small
+  ``duration`` and a large ``count`` it is the per-step slowdown the
+  STRAGGLER detector exists for; :func:`loss_bomb` plants finite
+  exploding gradients (the live params are scaled in place, so the
+  loss explodes while every gradient stays finite — invisible to
+  ``nonfinite="skip"``, the divergence detector's regression case;
+  only a checkpoint rollback restores health) — together they drive
+  ``tests/test_supervisor.py`` and the ``tools/supervise.py --chaos``
+  matrix;
 - **host loss** — :func:`kill_process` is a REAL ungraceful process
   death (SIGKILL: no atexit, no flushes — what a preempted VM looks
   like), :func:`host_loss_during_save` arms it on the N-th checkpoint
@@ -70,9 +83,10 @@ import numpy as np
 __all__ = ["NaNInjector", "burst_arrivals", "coordinator_unreachable",
            "corrupt_checkpoint", "corrupt_compile_cache", "deadline_storm",
            "engine_failure_burst",
-           "fail_writes", "flaky_reads", "host_loss_during_save",
-           "kill_batcher_worker",
-           "kill_process", "kill_worker", "malformed_request",
+           "fail_writes", "flaky_reads", "hang_step",
+           "host_loss_during_save", "kill_batcher_worker",
+           "kill_process", "kill_worker", "loss_bomb",
+           "malformed_request",
            "nan_params", "poison_batch", "slow_client", "slow_reads",
            "straggler_process", "truncate_record"]
 
@@ -105,6 +119,12 @@ class NaNInjector:
             x = poison_batch(x, self.value)
         self.calls += 1
         return self.step(x, y)
+
+    def __getattr__(self, name):
+        # transparent proxy: the supervised loop reads step_count/
+        # loss_scale/skipped_steps and drives checkpoints through the
+        # wrapped step, so an injected step is a drop-in replacement
+        return getattr(self.step, name)
 
 
 @contextmanager
@@ -535,6 +555,88 @@ def burst_arrivals(batcher, payloads, block=False):
         except Backpressure:
             shed += 1
     return futures, shed
+
+
+# ---------------------------------------------------------------------------
+# supervised-training chaos (parallel/supervisor.py)
+# ---------------------------------------------------------------------------
+
+@contextmanager
+def _patched_run_step(flaky):
+    """Interpose ``parallel/supervisor.py::_run_step`` (the choke point
+    every supervised step call goes through) with
+    ``flaky(real_run, step, x, y)``."""
+    from . import supervisor as _sup
+
+    real = _sup._run_step
+    _sup._run_step = lambda step, x, y: flaky(real, step, x, y)
+    try:
+        yield
+    finally:
+        _sup._run_step = real
+
+
+@contextmanager
+def hang_step(at=0, duration=3600.0, count=1):
+    """Wedge the supervised step callable: the ``at``-th through
+    ``at+count-1``-th calls (0-based) sleep ``duration`` seconds BEFORE
+    the step runs — the rank stops heartbeating mid-step, exactly what
+    a wedged collective or a stuck device transfer looks like from the
+    outside.  A long single wedge is the HANG case (the watchdog must
+    detect the heartbeat gap, kill the job and respawn it); a small
+    ``duration`` with a large ``count`` is the per-step slowdown the
+    STRAGGLER detector exists for.  Yields a stats object whose
+    ``.hung`` counts injections."""
+    class _Stats:
+        seen = 0
+        hung = 0
+
+    stats = _Stats()
+
+    def wedge(real, step, x, y):
+        i = stats.seen
+        stats.seen += 1
+        if at <= i < at + count:
+            stats.hung += 1
+            time.sleep(duration)
+        return real(step, x, y)
+
+    with _patched_run_step(wedge):
+        yield stats
+
+
+@contextmanager
+def loss_bomb(at=0, factor=1e4):
+    """Finite exploding gradients at supervised step call ``at``
+    (0-based): the step's live float params are scaled in place by
+    ``factor`` through the same choke point, so the NEXT loss explodes
+    by orders of magnitude while every gradient stays FINITE —
+    ``nonfinite="skip"`` never fires, the skip counter never moves,
+    and the run burns compute on garbage forever.  This is the
+    divergence detector's regression case: the loss-EMA explosion
+    verdict must fire and the in-process rollback to the last
+    committed checkpoint must restore health (the bomb is one-shot, so
+    the replayed steps run clean).  Yields a stats object whose
+    ``.fired``/``.params_scaled`` record the injection."""
+    from . import supervisor as _sup
+
+    class _Stats:
+        seen = 0
+        fired = 0
+        params_scaled = 0
+
+    stats = _Stats()
+
+    def bomb(real, step, x, y):
+        i = stats.seen
+        stats.seen += 1
+        if i == at:
+            stats.fired += 1
+            stats.params_scaled = _sup._scale_params(step, factor)
+        return real(step, x, y)
+
+    with _patched_run_step(bomb):
+        yield stats
 
 
 # ---------------------------------------------------------------------------

@@ -270,7 +270,7 @@ class TrainStep:
                  hbm_budget: Optional[float] = None,
                  cost_device: str = "tpu-v5e",
                  passes=None, numerics: Optional[str] = None,
-                 input_range=None):
+                 input_range=None, skip_streak_budget: Optional[int] = None):
         self.net = net
         self.loss_fn = loss_fn
         self.opt = opt
@@ -344,6 +344,16 @@ class TrainStep:
                 "(they are how it detects the scale is too high) — use "
                 "nonfinite='skip' or 'raise', not 'off'")
         self.nonfinite = nonfinite
+        # skip_streak_budget: DECLARED bound on consecutive skipped
+        # steps — enforcement lives in the supervised loop
+        # (parallel/supervisor.py reads it as its detector default);
+        # declaring it (or a dynamic scale) is what silences GL012,
+        # the unbounded-silent-skip-streak lint.
+        if skip_streak_budget is not None and int(skip_streak_budget) < 1:
+            raise ValueError("skip_streak_budget must be >= 1 or None, "
+                             "got %r" % (skip_streak_budget,))
+        self.skip_streak_budget = None if skip_streak_budget is None \
+            else int(skip_streak_budget)
         self._scaler_dev = None  # (scale f32, unskipped i32, skipped i32)
         # set by Trainer.make_fused_step so the lint pass can flag the
         # legacy save_states path (GL007) still reachable on the object
@@ -1157,6 +1167,13 @@ class TrainStep:
             extra.extend(check_legacy_checkpoint_path(
                 self._legacy_state_origin,
                 where="Trainer.make_fused_step(zero=1)"))
+        # GL012: a silently-unbounded skip streak — nonfinite="skip"
+        # under a static scale with no declared skip_streak_budget
+        from ..analysis.trace_lint import check_unbounded_skip
+
+        extra.extend(check_unbounded_skip(
+            self.nonfinite, self._dynamic_scale, self.skip_streak_budget,
+            where="TrainStep(nonfinite='skip', loss_scale=static)"))
         finish_lint(closed_jaxpr, mode=self.lint, effects=effect_diags,
                     donated_leaves=donated, extra=extra,
                     suppress=self.lint_suppress,
@@ -2195,6 +2212,7 @@ def make_train_step(net, loss_fn, optimizer="sgd", mesh=None, batch_axis="dp",
                     nonfinite=None, loss_scale=None, cost=None,
                     hbm_budget=None, cost_device="tpu-v5e", passes=None,
                     numerics=None, input_range=None,
+                    skip_streak_budget=None,
                     **opt_kwargs) -> TrainStep:
     """Build the fused train step (fwd+bwd+optimizer in one XLA program).
 
@@ -2291,9 +2309,14 @@ def make_train_step(net, loss_fn, optimizer="sgd", mesh=None, batch_axis="dp",
     dynamic scale + counters ride the step's carried device state
     (halve on overflow, double every ``scale_window`` clean steps,
     matching ``contrib/amp/loss_scaler.py``) and are surfaced as
-    ``step.loss_scale`` / ``step.skipped_steps``.  See
-    ``docs/RESILIENCE.md`` for the policy matrix, and
-    ``step.save_checkpoint`` / ``step.restore_checkpoint`` /
+    ``step.loss_scale`` / ``step.skipped_steps``.
+    ``skip_streak_budget`` DECLARES a bound on consecutive skipped
+    steps: the supervised loop (``parallel/supervisor.py``) enforces it
+    as a divergence verdict, and declaring it (or a dynamic scale)
+    silences graftlint GL012 — ``nonfinite="skip"`` under a static
+    scale with no streak bound is a run that can stall forever while
+    looking alive.  See ``docs/RESILIENCE.md`` for the policy matrix,
+    and ``step.save_checkpoint`` / ``step.restore_checkpoint`` /
     ``step.attach_checkpoint`` for durable, shard-aware
     checkpoint/resume (``parallel/checkpoint.py``).
     """
@@ -2306,4 +2329,5 @@ def make_train_step(net, loss_fn, optimizer="sgd", mesh=None, batch_axis="dp",
                      lint_suppress=lint_suppress, nonfinite=nonfinite,
                      loss_scale=loss_scale, cost=cost, hbm_budget=hbm_budget,
                      cost_device=cost_device, passes=passes,
-                     numerics=numerics, input_range=input_range)
+                     numerics=numerics, input_range=input_range,
+                     skip_streak_budget=skip_streak_budget)

@@ -417,6 +417,62 @@ def test_gl007_legacy_save_states_from_zero1_fused_trainer():
     os.unlink("/tmp/gl007_plain.states")
 
 
+def test_gl012_unbounded_silent_skip_streak():
+    """GL012 gate: nonfinite='skip' under a STATIC loss scale with no
+    skip-streak bound warns (an unbounded silent skip-streak is a
+    stalled run that looks alive); a dynamic scale or a declared
+    skip_streak_budget silences it.  The live enforcement — the
+    supervisor's divergence verdict at the declared budget — lives in
+    tests/test_supervisor.py."""
+    import warnings
+
+    import numpy as np
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, nd
+    from incubator_mxnet_tpu.analysis import (CODES, Severity as Sev,
+                                              check_unbounded_skip)
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.parallel import make_train_step
+
+    # the code is cataloged (append-only contract, docs/ANALYSIS.md)
+    assert CODES["GL012"][0] == Sev.WARNING
+    diags = check_unbounded_skip("skip", False, None, where="here")
+    assert [d.code for d in diags] == ["GL012"]
+    assert "static loss scale" in diags[0].message
+    assert "dynamic" in diags[0].hint and \
+        "skip_streak_budget" in diags[0].hint
+    # every bounded configuration is clean
+    assert check_unbounded_skip("skip", True, None) == []     # dynamic
+    assert check_unbounded_skip("skip", False, 16) == []      # budget
+    assert check_unbounded_skip("raise", False, None) == []   # loud
+    assert check_unbounded_skip("off", False, None) == []
+
+    def build(**kw):
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="tanh"), nn.Dense(4))
+        net.initialize(init=mx.init.Xavier())
+        net(nd.ones((2, 8)))
+        return make_train_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                               optimizer="sgd", learning_rate=0.1,
+                               lint="warn", **kw)
+
+    x = nd.array(np.random.RandomState(0).rand(4, 8).astype(np.float32))
+    y = nd.array((np.arange(4) % 4).astype(np.float32))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        build(nonfinite="skip", loss_scale=1024.0)(x, y)
+    assert any("GL012" in str(w.message) for w in caught), \
+        [str(w.message) for w in caught]
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        build(nonfinite="skip", loss_scale=1024.0,
+              skip_streak_budget=8)(x, y)
+    assert not any("GL012" in str(w.message) for w in caught), \
+        [str(w.message) for w in caught]
+
+
 def test_gl010_inference_param_donation():
     """GL010 gate: the check names overlapping param leaves as an
     error; disjoint donation (cache/input argnums) is clean.  The

@@ -43,6 +43,39 @@ from incubator_mxnet_tpu.serve import (Backpressure, CircuitBreaker,
 
 SAMPLE = (16,)
 
+_TICK = [None]
+
+
+def _sched_tick():
+    """Measured scheduling granularity under CURRENT load: the worst
+    observed overshoot of a cross-thread wakeup targeting 1 ms,
+    sampled once per module run.  The deadline/flush/reaper tests
+    derive their margins and settle-sleeps from this baseline instead
+    of fixed small constants — in an idle run it is ~1–2 ms and the
+    bounds reduce to the old constants; inside a loaded tier-1 suite
+    it grows with the real scheduling jitter, which is exactly what
+    made the fixed constants wobble (PR-14 note: passes in isolation,
+    wobbles in-suite)."""
+    if _TICK[0] is None:
+        worst = 0.001
+        for _ in range(5):
+            ev = threading.Event()
+            t0 = time.monotonic()
+            th = threading.Thread(
+                target=lambda: (time.sleep(0.001), ev.set()))
+            th.start()
+            ev.wait(1.0)
+            worst = max(worst, time.monotonic() - t0 - 0.001)
+            th.join()
+        _TICK[0] = worst
+    return _TICK[0]
+
+
+def _settle(base, ticks=10):
+    """A load-aware sleep: at least ``base`` seconds, stretched when
+    the measured tick says the scheduler is running behind."""
+    time.sleep(max(base, ticks * _sched_tick()))
+
 
 def _mlp(seed=7):
     mx.random.seed(seed)
@@ -110,10 +143,11 @@ def test_expired_in_queue_is_shed_before_compute():
     b = ContinuousBatcher(eng, max_delay=0.01, grace=10.0)  # reaper idle
     try:
         f1 = b.submit(_x(1)[0])           # wedges the worker
-        time.sleep(0.03)                  # f1's batch is in flight
+        _settle(0.03)                     # f1's batch is in flight
         rows0 = eng.rows_served
-        f2 = b.submit(_x(1)[0], deadline=0.02)
-        time.sleep(0.05)                  # f2 expires while queued
+        slo = max(0.02, 5 * _sched_tick())
+        f2 = b.submit(_x(1)[0], deadline=slo)
+        _settle(2.5 * slo)                # f2 expires while queued
         gate.set()                        # unwedge: worker drains
         with pytest.raises(DeadlineExceeded, match="before compute"):
             f2.result(timeout=5)
@@ -137,7 +171,9 @@ def test_reaper_bounds_wedged_engine():
         with pytest.raises(DeadlineExceeded, match="reaped"):
             f.result(timeout=5)
         waited = time.monotonic() - t0
-        assert waited < 2.0, "reaper took %.2fs" % waited
+        bound = max(2.0, 100 * _sched_tick())
+        assert waited < bound, "reaper took %.2fs (bound %.2fs)" \
+            % (waited, bound)
         assert b.stats.expired == 1
     finally:
         gate.set()
@@ -169,14 +205,14 @@ def test_deadline_storm_all_resolve_fast():
     b = ContinuousBatcher(eng, max_delay=0.5, grace=0.02)
     try:
         f0 = b.submit(_x(1)[0])   # wedges the worker
-        time.sleep(0.02)
+        _settle(0.02)
         calls0 = eng.infer_calls
         futs, _ = fi.deadline_storm(b, [_x(1)[0]] * 12, deadline=1e-4)
-        time.sleep(0.01)          # every storm deadline is now past
+        _settle(0.01, ticks=3)    # every storm deadline is now past
         gate.set()
         t0 = time.monotonic()
         out = _drain(futs, bound=5.0)
-        assert time.monotonic() - t0 < 2.0
+        assert time.monotonic() - t0 < max(2.0, 100 * _sched_tick())
         assert all(isinstance(o, DeadlineExceeded) for o in out), out
         assert np.asarray(f0.result(timeout=5)).shape == (10,)
         # only f0's row was ever computed — no dead storm row was served
@@ -192,14 +228,20 @@ def test_tight_slo_on_idle_engine_is_served_not_shed():
     flushing at the deadline would guarantee the shed-before-compute
     check kills a request an idle engine could trivially serve."""
     eng = _warm_engine()
-    b = ContinuousBatcher(eng, max_delay=0.5, grace=0.05)
+    # under suite load a fixed 100 ms SLO can expire before the worker
+    # thread is even scheduled — the PR-14 in-suite wobble; derive the
+    # SLO (and the early-flush bound) from the measured tick instead
+    slo = max(0.1, 40 * _sched_tick())
+    max_delay = max(0.5, 5 * slo)
+    b = ContinuousBatcher(eng, max_delay=max_delay, grace=0.05)
     try:
         t0 = time.monotonic()
-        f = b.submit(_x(1)[0], deadline=0.1)
+        f = b.submit(_x(1)[0], deadline=slo)
         row = np.asarray(f.result(timeout=5))
         waited = time.monotonic() - t0
         assert row.shape == (10,)
-        assert waited < 0.4, "flush waited out max_delay: %.2fs" % waited
+        assert waited < 0.8 * max_delay, \
+            "flush waited out max_delay: %.2fs" % waited
         assert b.stats.expired == 0
     finally:
         b.close()
@@ -215,12 +257,14 @@ def test_blocking_submit_not_wedged_by_reaped_tombstones():
     b = ContinuousBatcher(eng, max_delay=0.005, max_queue=2, grace=0.01)
     try:
         f0 = b.submit(_x(1)[0])                  # in-flight, wedged
-        time.sleep(0.03)
-        f1 = b.submit(_x(1)[0], deadline=0.03)   # capacity now full
+        _settle(0.03)
+        f1 = b.submit(_x(1)[0],                  # capacity now full
+                      deadline=max(0.03, 10 * _sched_tick()))
         t0 = time.monotonic()
         f2 = b.submit(_x(1)[0], deadline=5.0)    # blocks for a slot
         waited = time.monotonic() - t0
-        assert waited < 2.0, "blocking submit wedged %.2fs" % waited
+        assert waited < max(2.0, 100 * _sched_tick()), \
+            "blocking submit wedged %.2fs" % waited
         with pytest.raises(DeadlineExceeded):
             f1.result(timeout=5)
         gate.set()

@@ -1,0 +1,518 @@
+"""Self-healing training supervisor (parallel/supervisor.py,
+tools/supervise.py — docs/RESILIENCE.md §7).
+
+The acceptance surface:
+
+- **heartbeat protocol** — atomic per-rank files through the
+  checkpoint write choke point (``fail_writes`` interposes; a write
+  outage degrades monitoring, never training), torn files invisible;
+- **detectors** — hang (auto-calibrated stall timeout), straggler
+  (step lag vs the median), divergence (skip streak past budget /
+  finite exploding loss EMA) as pure, unit-testable verdicts;
+- **policy ladder** — in-process rollback → kill-and-respawn (bounded)
+  → elastic shrink → post-mortem give-up, in ORDER, each rung bounded:
+  an exhausted budget produces a post-mortem, never a hang;
+- **ledger** — every event (gap, verdict, rollback, restart, shrink,
+  recovery + MTTR, resolution) in merge-readable JSONL next to the
+  checkpoints, torn trailing lines tolerated;
+- **end-to-end** — a SIGKILLed single-rank run auto-respawns, restores
+  the last committed checkpoint and finishes with losses BIT-identical
+  to the uninterrupted reference (the fast leg; the full chaos matrix
+  × MTTR bound soak is marked ``slow``).
+
+Budget discipline: the ladder tests drive scripted stub processes
+(no subprocesses); exactly one fast leg spawns real workers.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, nd
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.io import NDArrayIter, ResilientIter
+from incubator_mxnet_tpu.parallel import (CheckpointManager,
+                                          DivergenceDetector,
+                                          DivergenceError, HealthLedger,
+                                          HeartbeatEmitter, Supervisor,
+                                          SupervisorConfig,
+                                          make_train_step, run_supervised)
+from incubator_mxnet_tpu.parallel import fault_injection as fi
+from incubator_mxnet_tpu.parallel.supervisor import (EXIT_DIVERGED,
+                                                     StepClock,
+                                                     committed_steps,
+                                                     hang_verdicts,
+                                                     read_heartbeats,
+                                                     read_ledger,
+                                                     straggler_verdicts)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# heartbeat protocol
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_roundtrip_and_torn_files_skipped(tmp_path):
+    d = str(tmp_path)
+    em = HeartbeatEmitter(d, rank=3)
+    em.emit(5, loss=1.25, loss_scale=2.0, skipped_steps=1)
+    em.emit(6, loss=1.0, loss_scale=2.0, skipped_steps=1)
+    hbs = read_heartbeats(d)
+    assert list(hbs) == [3]
+    hb = hbs[3]
+    assert hb["step"] == 6 and hb["seq"] == 2
+    assert hb["loss"] == 1.0 and hb["loss_scale"] == 2.0
+    assert hb["skipped_steps"] == 1 and hb["status"] == "running"
+    assert hb["time"] <= time.time()
+    # a torn/garbage heartbeat (crash mid-write on a pre-atomic fs)
+    # is skipped, not fatal — and .tmp twins are invisible by name
+    with open(os.path.join(d, "heartbeat-r00009.json"), "w") as f:
+        f.write('{"rank": 9, "seq":')
+    with open(os.path.join(d, "heartbeat-r00004.json.tmp"), "w") as f:
+        f.write("{}")
+    assert list(read_heartbeats(d)) == [3]
+
+
+def test_heartbeat_write_failure_degrades_not_raises(tmp_path):
+    """Heartbeats ride checkpoint._write_bytes, so fail_writes
+    interposes — and a dead monitoring disk must never kill the
+    training step that produced the heartbeat."""
+    em = HeartbeatEmitter(str(tmp_path), rank=0)
+    with fi.fail_writes(at=0, count=99):
+        with pytest.warns(UserWarning, match="heartbeat write failed"):
+            em.emit(1, loss=0.5)
+    assert em.write_failures == 1
+    assert read_heartbeats(str(tmp_path)) == {}
+    em.emit(2, loss=0.4)  # recovery: the next beat lands
+    assert read_heartbeats(str(tmp_path))[0]["step"] == 2
+
+
+# ---------------------------------------------------------------------------
+# health ledger
+# ---------------------------------------------------------------------------
+
+def test_ledger_schema_merge_and_torn_tail(tmp_path):
+    d = str(tmp_path)
+    led = HealthLedger(os.path.join(d, "health.jsonl"))
+    led.append("launch", width=2, attempt=0)
+    led.append("fault", verdict="hang", ranks=[1])
+    rank_led = HealthLedger(os.path.join(d, "health-r00001.jsonl"))
+    rank_led.append("rollback", rank=1, to_step=4)
+    # schema: every event carries event/seq/time plus its fields
+    for e in led.events():
+        assert set(e) >= {"event", "seq", "time"}
+    assert [e["event"] for e in led.events()] == ["launch", "fault"]
+    assert led.events("fault")[0]["verdict"] == "hang"
+    # merged view is time-ordered across writer files
+    merged = read_ledger(d)
+    assert [e["event"] for e in merged] == ["launch", "fault", "rollback"]
+    # a torn trailing line (crash mid-append on a pre-atomic fs) is
+    # dropped on re-open; intact events survive
+    with open(led.path, "a") as f:
+        f.write('{"event": "torn')
+    led2 = HealthLedger(led.path)
+    assert [e["event"] for e in led2.events()] == ["launch", "fault"]
+    led2.append("resolved")
+    assert [e["event"] for e in read_ledger(d)][-1] == "resolved"
+
+
+# ---------------------------------------------------------------------------
+# detectors
+# ---------------------------------------------------------------------------
+
+def test_step_clock_calibrates_stall_timeout():
+    c = StepClock(alpha=0.5, factor=8.0, floor=2.0, startup_timeout=120.0)
+    assert c.stall_timeout() == 120.0  # no data: startup grace
+    c.observe(10.0)
+    assert c.stall_timeout() == 120.0  # one arrival: still no interval
+    c.observe(10.5)
+    assert c.ema == pytest.approx(0.5)
+    assert c.stall_timeout() == pytest.approx(4.0)  # 8 x 0.5s
+    c.observe(10.6)  # faster steps pull the EMA (and the timeout) down
+    assert c.stall_timeout() == pytest.approx(max(2.0, 8 * 0.3))
+    for t in (10.61, 10.62, 10.63):
+        c.observe(t)
+    assert c.stall_timeout() == 2.0  # never below the floor
+
+
+def test_hang_verdicts():
+    now = 100.0
+    hbs = {0: {"rank": 0, "step": 5, "status": "running", "time": 99.0},
+           1: {"rank": 1, "step": 5, "status": "running", "time": 90.0},
+           2: {"rank": 2, "step": 8, "status": "done", "time": 80.0}}
+    out = hang_verdicts(hbs, now, timeout=5.0)
+    assert [v["rank"] for v in out] == [1]
+    assert out[0]["age"] == pytest.approx(10.0)
+    # the watcher's own arrival clock wins over the payload stamp
+    # (cross-host clock skew must not fabricate a hang)
+    out = hang_verdicts(hbs, now, timeout=5.0,
+                        last_seen={1: 98.0, 0: 50.0})
+    assert [v["rank"] for v in out] == [0]
+
+
+def test_straggler_verdicts():
+    mk = lambda s, st="running": {"step": s, "status": st}  # noqa: E731
+    # rank 2 is far behind the median and past min_lag
+    out = straggler_verdicts({0: mk(12), 1: mk(11), 2: mk(2)},
+                             factor=3.0, min_lag=4)
+    assert [v["rank"] for v in out] == [2]
+    assert out[0]["lag"] == 9 and out[0]["median"] == 11
+    # small lag (startup jitter) never flags
+    assert straggler_verdicts({0: mk(5), 1: mk(3)}, factor=3.0,
+                              min_lag=4) == []
+    # a DONE peer still anchors the median, but is never flagged itself
+    out = straggler_verdicts({0: mk(10, "done"), 1: mk(2)},
+                             factor=3.0, min_lag=4)
+    assert [v["rank"] for v in out] == [1]
+    # a single live rank has no fleet to lag behind
+    assert straggler_verdicts({0: mk(2)}, factor=3.0, min_lag=4) == []
+
+
+def test_divergence_detector_skip_streak():
+    det = DivergenceDetector(skip_streak_budget=3)
+    assert det.update(5, 1.0, skipped_steps=0) is None
+    assert det.update(5, None, skipped_steps=1) is None  # streak 1
+    assert det.update(5, None, skipped_steps=2) is None  # streak 2
+    assert det.suspicious  # an active streak defers checkpoints
+    assert det.update(5, None, skipped_steps=3) == "skip_streak"
+    det.reset()
+    assert det.skip_streak == 0 and not det.suspicious
+    # an applied step between skips resets the streak (not consecutive)
+    det2 = DivergenceDetector(skip_streak_budget=2)
+    det2.update(5, 1.0, skipped_steps=1)
+    det2.update(6, 1.0, skipped_steps=1)  # progress: streak cleared
+    assert det2.update(6, None, skipped_steps=2) is None
+    assert det2.update(7, 1.0, skipped_steps=2) is None
+
+
+def test_divergence_detector_loss_explosion_and_reset():
+    det = DivergenceDetector(explosion_factor=1e3, ema_alpha=0.5,
+                             patience=2, warmup=2)
+    for loss in (1.0, 1.1, 0.9):
+        assert det.update(1, loss) is None
+    assert not det.suspicious
+    # one hot batch is noise, two sustained is a verdict
+    assert det.update(2, 1e7) is None
+    assert det.suspicious  # hot: boundary saves must defer
+    assert det.update(3, 1e7) == "loss_explosion"
+    det.reset()
+    assert det.update(4, 1.0) is None
+    # non-finite losses never feed the EMA (the skip guard owns them)
+    det2 = DivergenceDetector(explosion_factor=1e3, warmup=1)
+    det2.update(1, 1.0)
+    assert det2.update(1, float("nan")) is None
+    assert det2.update(2, float("inf")) is None
+    assert det2.ema == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# the policy ladder (scripted stub processes — no subprocess cost)
+# ---------------------------------------------------------------------------
+
+class _StubProc:
+    def __init__(self, rc):
+        self._rc = rc
+
+    def poll(self):
+        return self._rc
+
+    def terminate(self):
+        pass
+
+    def kill(self):
+        pass
+
+    def wait(self, timeout=None):
+        return self._rc
+
+
+def _fast_cfg(**kw):
+    kw.setdefault("poll_interval", 0.005)
+    kw.setdefault("backoff", 0.01)
+    kw.setdefault("max_restarts", 1)
+    return SupervisorConfig(**kw)
+
+
+def test_ladder_order_respawn_shrink_postmortem(tmp_path):
+    """Ranks that die on every attempt walk the FULL ladder in order —
+    restart (budget per width) → shrink → ... → post-mortem at min
+    width — and the run returns bounded instead of hanging."""
+    launches = []
+
+    def launch(width, attempt):
+        launches.append((width, attempt))
+        return [_StubProc(1) for _ in range(width)]
+
+    sup = Supervisor(launch, width=4, directory=str(tmp_path),
+                     config=_fast_cfg())
+    t0 = time.monotonic()
+    out = sup.run(timeout=30.0)
+    assert time.monotonic() - t0 < 10.0
+    assert out["outcome"] == "gave_up" and out["width"] == 1
+    assert out["restarts"] > 0 and out["shrinks"] == 2  # 4 -> 2 -> 1
+    # widths only ever narrow, and every shrink halves
+    widths = [w for w, _ in launches]
+    assert widths[0] == 4 and widths[-1] == 1
+    assert all(b <= a for a, b in zip(widths, widths[1:]))
+    ev = [e["event"] for e in sup.ledger.events()]
+    assert ev[0] == "launch" and ev[-1] == "post_mortem"
+    assert ev.index("fault") < ev.index("restart") < ev.index("shrink")
+    pm = sup.ledger.events("post_mortem")[0]
+    assert pm["reason"].startswith("restart budget exhausted")
+    assert pm["event_counts"]["restart"] == out["restarts"]
+
+
+def test_ladder_diverged_exit_code_is_its_own_verdict(tmp_path):
+    """A rank exiting EXIT_DIVERGED (in-process rollback exhausted) is
+    escalated as a divergence_exhausted fault, not a generic loss."""
+    def launch(width, attempt):
+        return [_StubProc(EXIT_DIVERGED)]
+
+    sup = Supervisor(launch, width=1, directory=str(tmp_path),
+                     config=_fast_cfg(max_restarts=0))
+    out = sup.run(timeout=30.0)
+    assert out["outcome"] == "gave_up"
+    faults = sup.ledger.events("fault")
+    assert faults and all(f["verdict"] == "divergence_exhausted"
+                          for f in faults)
+    assert faults[0]["returncode"] == EXIT_DIVERGED
+
+
+def test_ladder_hang_detection_via_startup_timeout(tmp_path):
+    """Ranks that never heartbeat at all age out of the startup grace
+    and form a hang verdict (the wedged-before-first-step case)."""
+    def launch(width, attempt):
+        return [_StubProc(None)]  # alive forever, never beats
+
+    sup = Supervisor(launch, width=1, directory=str(tmp_path),
+                     config=_fast_cfg(max_restarts=0,
+                                      startup_timeout=0.2,
+                                      min_stall_timeout=0.2))
+    t0 = time.monotonic()
+    out = sup.run(timeout=30.0)
+    assert time.monotonic() - t0 < 10.0
+    assert out["outcome"] == "gave_up"
+    assert sup.ledger.events("fault")[0]["verdict"] == "hang"
+
+
+def test_ladder_recovery_records_mttr(tmp_path):
+    """A fault followed by a healthy relaunch closes with a
+    ``recovered`` event carrying the measured MTTR, then resolves."""
+    d = str(tmp_path)
+
+    def launch(width, attempt):
+        if attempt == 0:
+            return [_StubProc(1)]  # instant loss
+        HeartbeatEmitter(d, rank=0).emit(5, loss=0.5, status="running")
+        return [_StubProc(0)]
+
+    sup = Supervisor(launch, width=1, directory=d, config=_fast_cfg())
+    out = sup.run(timeout=30.0)
+    assert out["outcome"] == "resolved"
+    assert out["restarts"] == 1 and len(out["mttrs"]) == 1
+    rec = sup.ledger.events("recovered")[0]
+    assert rec["mode"] == "respawn" and rec["mttr"] >= 0
+    ev = [e["event"] for e in sup.ledger.events()]
+    assert ev.index("fault") < ev.index("restart") \
+        < ev.index("recovered") < ev.index("resolved")
+
+
+def test_committed_steps_ignores_torn_stages(tmp_path):
+    os.makedirs(tmp_path / "step-00000002")
+    os.makedirs(tmp_path / ".tmp-step-00000004")
+    os.makedirs(tmp_path / "step-garbage")
+    assert committed_steps(str(tmp_path)) == [2]
+
+
+# ---------------------------------------------------------------------------
+# the supervised loop (in-process, real train step)
+# ---------------------------------------------------------------------------
+
+def _job(tmp, seed=0, **step_kw):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    for _ in range(2):
+        net.add(nn.Dense(16, activation="tanh"))
+    net.add(nn.Dense(13))
+    net.initialize(init=mx.init.Xavier())
+    net(nd.ones((2, 16)))
+    kw = dict(optimizer="adam", learning_rate=0.01, lint="error")
+    kw.update(step_kw)
+    step = make_train_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           **kw)
+    rngd = np.random.RandomState(5)
+    X = rngd.rand(64, 16).astype(np.float32)
+    Y = rngd.randint(0, 4, 64).astype(np.float32)
+    np.random.seed(3)
+    it = ResilientIter(NDArrayIter(X, Y, batch_size=8, shuffle=True))
+    mgr = CheckpointManager(os.path.join(str(tmp), "ckpt"))
+    return step, it, mgr
+
+
+def test_rollback_on_loss_bomb_resumes_bit_identical(tmp_path):
+    """The divergence rung end to end: a finite gradient bomb (invisible
+    to nonfinite='skip') explodes the loss EMA, the verdict rolls back
+    to the last committed checkpoint — data stream included — and the
+    replayed tail matches the unbombed reference run bit for bit."""
+    cfg = SupervisorConfig(checkpoint_every=2)
+    step, it, mgr = _job(tmp_path / "ref")
+    ref = run_supervised(step, it, mgr, until_step=10, config=cfg)
+    assert ref["rollbacks"] == 0 and ref["final_step"] == 10
+
+    step2, it2, mgr2 = _job(tmp_path / "bomb")
+    with fi.loss_bomb(at=4, factor=1e4) as st:
+        out = run_supervised(step2, it2, mgr2, until_step=10, config=cfg)
+    assert st.fired == 1 and st.params_scaled > 0
+    assert out["rollbacks"] == 1 and out["final_step"] == 10
+    # the bombed losses are huge but FINITE (skip guard blind), and the
+    # post-rollback tail replays the reference bit-exactly
+    bombed = [l for l in out["losses"] if l > 100]
+    assert bombed and all(np.isfinite(l) for l in bombed)
+    assert out["losses"][-6:] == ref["losses"][-6:]
+    events = [e["event"] for e in read_ledger(str(mgr2.directory))]
+    assert events.index("divergence") < events.index("rollback") \
+        < events.index("recovered") < events.index("done")
+    div = [e for e in read_ledger(str(mgr2.directory))
+           if e["event"] == "divergence"][0]
+    assert div["verdict"] == "loss_explosion"
+    # no checkpoint was taken while the stream was suspicious: every
+    # committed step is a CLEAN one (rollback target never poisoned)
+    assert all(s <= 4 or s >= 6 for s in mgr2.steps())
+    hb = read_heartbeats(str(mgr2.directory))[0]
+    assert hb["status"] == "done" and hb["step"] == 10
+
+
+def test_skip_streak_verdict_escalates_bounded(tmp_path):
+    """A permanently poisoned stream under a STATIC scale (the GL012
+    configuration): skips accumulate with no applied progress, the
+    skip-streak verdict fires at the declared budget, and with nothing
+    committed to roll back to the loop raises DivergenceError — the
+    outer supervisor's escalation cue — instead of spinning forever."""
+    step, it, mgr = _job(tmp_path, nonfinite="skip", loss_scale=1024.0,
+                         skip_streak_budget=4)
+    poisoned = fi.NaNInjector(step, at_steps=range(10 ** 6))
+    cfg = SupervisorConfig(checkpoint_every=2)
+    t0 = time.monotonic()
+    with pytest.raises(DivergenceError, match="skip_streak"):
+        run_supervised(poisoned, it, mgr, until_step=8, config=cfg)
+    assert time.monotonic() - t0 < 60.0
+    assert mgr.steps() == []  # nothing clean was ever committed
+    events = read_ledger(str(mgr.directory))
+    div = [e for e in events if e["event"] == "divergence"][0]
+    assert div["verdict"] == "skip_streak" and div["skip_streak"] == 4
+    assert any(e["event"] == "rollback_exhausted" for e in events)
+    hb = read_heartbeats(str(mgr.directory))[0]
+    assert hb["status"] == "diverged"
+
+
+def test_hang_step_injector_wedges_and_counts(tmp_path):
+    """hang_step drives the supervised choke point: the wedged call
+    blocks for the injected duration, then the loop continues."""
+    cfg = SupervisorConfig(checkpoint_every=None)
+    step, it, mgr = _job(tmp_path)
+    with fi.hang_step(at=1, duration=0.3, count=2) as st:
+        t0 = time.monotonic()
+        out = run_supervised(step, it, mgr, until_step=3, config=cfg)
+        waited = time.monotonic() - t0
+    assert st.hung == 2 and waited >= 0.6
+    assert out["final_step"] == 3
+
+
+def test_gl012_skip_streak_budget_silences_and_enforces(tmp_path):
+    """The skip_streak_budget knob declared on the step is picked up by
+    the supervised loop as its detector default (and silences GL012 —
+    the lint-side gate lives in tests/test_graftlint.py)."""
+    step, it, mgr = _job(tmp_path, nonfinite="skip", loss_scale=512.0,
+                         skip_streak_budget=2)
+    assert step.skip_streak_budget == 2
+    poisoned = fi.NaNInjector(step, at_steps=range(10 ** 6))
+    with pytest.raises(DivergenceError, match="skip_streak"):
+        run_supervised(poisoned, it, mgr, until_step=4,
+                       config=SupervisorConfig(checkpoint_every=None))
+    div = [e for e in read_ledger(str(mgr.directory))
+           if e["event"] == "divergence"][0]
+    assert div["skip_streak"] == 2  # the STEP's budget, not the default
+    with pytest.raises(ValueError, match="skip_streak_budget"):
+        _job(tmp_path, skip_streak_budget=0)
+
+
+# ---------------------------------------------------------------------------
+# end to end: kill -> auto-respawn -> bit-identical resume (fast leg)
+# ---------------------------------------------------------------------------
+
+def test_e2e_kill_auto_resume_bit_identical(tmp_path):
+    """THE acceptance case, single rank: a SIGKILLed worker is
+    respawned by the supervisor, restores the last committed
+    checkpoint (mid-epoch data position included), and its final
+    attempt's losses equal the uninterrupted in-process reference
+    BIT for bit.  Kept to one scenario and one rank for the tier-1
+    budget — the full matrix soaks under ``-m slow``."""
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        import supervise
+    finally:
+        sys.path.pop(0)
+
+    outdir = str(tmp_path / "run")
+    os.makedirs(outdir)
+    import argparse
+
+    args = argparse.Namespace(
+        n=1, steps=8, dir=outdir, checkpoint_every=2, commit_timeout=10.0,
+        max_restarts=2, min_stall=2.0, startup_timeout=60.0,
+        backoff=0.1, timeout=120.0)
+    out = supervise.supervise_once(args,
+                                   chaos_spec="kill_process:at=3")
+    assert out["outcome"] == "resolved", out
+    assert out["restarts"] == 1 and out["final_step"] == 8
+    assert out["torn_visible"] == 0
+    for ev in ("launch", "fault", "restart", "recovered", "resolved"):
+        assert ev in out["events"], (ev, out["events"])
+    assert out["mttrs"] and max(out["mttrs"]) < 60.0
+
+    with open(os.path.join(outdir, "result_rank0.json")) as f:
+        res = json.load(f)
+    assert res["attempt"] == 1 and res["status"] == "done"
+    # the respawned attempt restored the step-2 checkpoint and replayed
+    # steps 3..8 — exactly the reference's tail, bit for bit
+    ref_step, ref_it, ref_mgr = supervise.build_worker_job(
+        str(tmp_path / "ref"))[:3]
+    ref = run_supervised(ref_step, ref_it, ref_mgr, until_step=8,
+                         config=SupervisorConfig(checkpoint_every=2))
+    ref_it.close()
+    assert res["restored_from"] == 2
+    assert res["losses"] == ref["losses"][2:], (res["losses"],
+                                                ref["losses"])
+
+
+@pytest.mark.slow  # ~60 s: every chaos scenario x the MTTR bound
+def test_chaos_matrix_soak(tmp_path):
+    """The full matrix through the CLI path: kill_process, hang_step,
+    straggler_process, host_loss_during_save, loss_bomb — each must
+    resolve with its required ledger sequence, a bounded MTTR and zero
+    torn checkpoints visible."""
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        import supervise
+    finally:
+        sys.path.pop(0)
+    import argparse
+
+    args = argparse.Namespace(
+        n=1, steps=8, dir=str(tmp_path), checkpoint_every=2,
+        commit_timeout=10.0, max_restarts=2, min_stall=2.0,
+        startup_timeout=60.0, backoff=0.25, timeout=180.0,
+        mttr_bound=60.0)
+    records = [supervise.run_chaos(s, args, "text")
+               for s in sorted(supervise.SCENARIOS)]
+    bad = [r for r in records if not r["ok"]]
+    assert not bad, bad
+    assert {r["scenario"] for r in records} == set(supervise.SCENARIOS)
+    # the rollback rung resolves loss_bomb with ZERO restarts
+    bomb = next(r for r in records if r["scenario"] == "loss_bomb")
+    assert bomb["restarts"] == 0

@@ -1,0 +1,519 @@
+#!/usr/bin/env python
+"""Self-healing training CLI: launcher + watchdog + chaos matrix
+(docs/RESILIENCE.md §7, ``parallel/supervisor.py``).
+
+Two modes in one file so a respawned rank runs the exact binary the
+supervisor does:
+
+- **supervisor mode** (default): spawn ``-n`` ranks of the built-in
+  supervised worker through the ``tools/launch.py`` DMLC_* env
+  protocol (``DMLC_PS_ROOT_URI``/``PORT`` rendezvous,
+  ``DMLC_NUM_WORKER``/``DMLC_WORKER_ID`` identity,
+  ``MXNET_RESTART_COUNT`` attempt number) and drive the detection →
+  ladder → resume loop until the job resolves or gives up;
+- **worker mode** (``--worker``, spawned internally): the rank body —
+  a small deterministic train job (the ``tests/elastic_worker.py``
+  pattern: process-spanning dp mesh + zero=1 when the backend can
+  compile cross-process programs, per-process replicated otherwise)
+  driven by :func:`~parallel.supervisor.run_supervised` with
+  heartbeats, periodic checkpoints and in-process divergence rollback.
+  Chaos arms itself from the ``MXTPU_CHAOS`` env var on attempt 0
+  only, so every injected failure is recoverable by restart.
+
+``--chaos SCENARIO`` runs one scenario from the matrix
+(``kill_process``, ``hang_step``, ``straggler_process``,
+``host_loss_during_save``, ``loss_bomb``); ``--chaos all`` runs every
+one and exits 1 if ANY scenario ends unrecovered, misses a required
+health-ledger event, exceeds the MTTR bound, or leaves a torn
+checkpoint visible — the ``serve_bench --chaos`` discipline for the
+training tier.  ``--format json`` emits one JSON record per scenario.
+"""
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+#: scenario -> (chaos spec defaults, minimum width, supervisor-config
+#: overrides).  ``rank`` -1 means "the last rank" (keeps rank 0 — the
+#: checkpoint-commit coordinator — alive in multi-rank scenarios).
+SCENARIOS = {
+    "kill_process": dict(spec=dict(at=3), width=1, cfg={}),
+    "hang_step": dict(spec=dict(at=3, duration=600.0), width=1, cfg={}),
+    "straggler_process": dict(
+        spec=dict(at=4, delay=1.0), width=2,
+        # the slowdown starts AFTER the coordinated step-4 save, so the
+        # post-chaos phase is uncoupled (a coordinated boundary save
+        # throttles every rank to the slowest peer's pace, which would
+        # hide the step lag) and recovery provably resumes from the
+        # committed step 4.  Verdict thresholds are loosened for the
+        # short lag window, and the stall floor is RAISED so the
+        # healthy rank blocking in its final save's marker wait cannot
+        # trip the hang detector before the straggler verdict does.
+        args=dict(checkpoint_every=4),
+        cfg=dict(straggler_factor=1.2, straggler_min_lag=2,
+                 straggler_grace=1.0, min_stall_timeout=8.0)),
+    "host_loss_during_save": dict(spec=dict(save=1), width=2,
+                                  cfg=dict(min_stall_timeout=15.0)),
+    "loss_bomb": dict(spec=dict(at=4, factor=1e4), width=1, cfg={}),
+}
+
+#: the event sequence a green scenario MUST leave in the merged health
+#: ledger (the missing-ledger-event gate `--chaos` exits 1 on)
+REQUIRED_EVENTS = {
+    "kill_process": ("launch", "fault", "restart", "recovered",
+                     "resolved"),
+    "hang_step": ("launch", "heartbeat_gap", "fault", "restart",
+                  "recovered", "resolved"),
+    "straggler_process": ("launch", "straggler", "fault", "restart",
+                          "recovered", "resolved"),
+    "host_loss_during_save": ("launch", "fault", "restart", "recovered",
+                              "resolved"),
+    "loss_bomb": ("launch", "divergence", "rollback", "recovered",
+                  "done", "resolved"),
+}
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# worker mode (the rank body)
+# ---------------------------------------------------------------------------
+
+def build_worker_job(outdir: str, checkpoint_every=2,
+                     commit_timeout: float = 10.0, skip_budget=None):
+    """Build the deterministic supervised train job every rank runs —
+    module-level so tests can run the IDENTICAL job in-process as the
+    bit-exactness reference.  The step bound is the caller's
+    (``run_supervised(until_step=)``), not the job's.  Returns
+    ``(step, data_iter, manager, config, rank, nproc)``."""
+    import numpy as np
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, nd
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.io import NDArrayIter, ResilientIter
+    from incubator_mxnet_tpu.parallel import (CheckpointManager,
+                                              SupervisorConfig,
+                                              distributed, make_mesh,
+                                              make_train_step)
+    import jax
+
+    distributed.initialize()  # DMLC_* env; no-op at world size 1
+    rank = distributed.process_index()
+    nproc = distributed.process_count()
+    spmd = nproc > 1 and distributed.collectives_supported()
+    if spmd:
+        mesh = distributed.make_process_mesh({"dp": -1})
+    else:
+        mesh = make_mesh({"dp": 1}, devices=jax.local_devices()[:1])
+
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    for _ in range(2):
+        net.add(nn.Dense(16, activation="tanh"))
+    net.add(nn.Dense(13))
+    net.initialize(init=mx.init.Xavier())
+    net(nd.ones((2, 16)))
+    step = make_train_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           optimizer="adam", learning_rate=0.01,
+                           mesh=mesh, batch_axis="dp", zero=1,
+                           lint="error", skip_streak_budget=skip_budget)
+    mgr = CheckpointManager(os.path.join(outdir, "ckpt"),
+                            commit_timeout=commit_timeout)
+
+    rngd = np.random.RandomState(5)
+    X = rngd.rand(64, 16).astype(np.float32)
+    Y = rngd.randint(0, 4, 64).astype(np.float32)
+    np.random.seed(3)
+    it = ResilientIter(NDArrayIter(X, Y, batch_size=8, shuffle=True))
+    if spmd:
+        # one GSPMD program spans processes: each rank feeds its row
+        # slice of the global batch (the degraded replicated mode —
+        # this CPU jaxlib — computes the full batch on every rank)
+        lo, hi = rank * 8 // nproc, (rank + 1) * 8 // nproc
+        it = _RowSlice(it, lo, hi)
+    cfg = SupervisorConfig(checkpoint_every=checkpoint_every)
+    return step, it, mgr, cfg, rank, nproc
+
+
+class _RowSlice:
+    """Feed this process's row slice of each global batch (real spmd
+    mode: one GSPMD program spans processes, each host supplies its
+    addressable rows).  Delegates the iterator-state protocol to the
+    wrapped iterator so checkpoints carry the GLOBAL stream position."""
+
+    def __init__(self, inner, lo: int, hi: int):
+        self.inner, self.lo, self.hi = inner, lo, hi
+
+    def next(self):
+        import numpy as np
+
+        from incubator_mxnet_tpu import nd
+
+        b = self.inner.next()
+        b.data = [nd.array(np.ascontiguousarray(
+            d.asnumpy()[self.lo:self.hi])) for d in b.data]
+        b.label = [nd.array(np.ascontiguousarray(
+            v.asnumpy()[self.lo:self.hi])) for v in b.label]
+        return b
+
+    def reset(self):
+        self.inner.reset()
+
+    def close(self):
+        self.inner.close()
+
+    def state_dict(self):
+        return self.inner.state_dict()
+
+    def load_state_dict(self, state):
+        self.inner.load_state_dict(state)
+
+
+def _parse_chaos(spec: str):
+    """``"name:k=v,k=v"`` → ``(name, {k: float-or-int})``."""
+    name, _, rest = spec.partition(":")
+    kw = {}
+    for part in filter(None, rest.split(",")):
+        k, _, v = part.partition("=")
+        kw[k] = float(v) if ("." in v or "e" in v.lower()) else int(v)
+    return name, kw
+
+
+@contextlib.contextmanager
+def _die_at_step(at: int):
+    """SIGKILL this process right before supervised step call ``at``
+    (0-based) — the kill_process scenario through the same
+    ``supervisor._run_step`` choke point the other injectors use."""
+    from incubator_mxnet_tpu.parallel import fault_injection as fi
+    from incubator_mxnet_tpu.parallel import supervisor as sup
+
+    real = sup._run_step
+    state = {"seen": 0}
+
+    def lethal(step, x, y):
+        i = state["seen"]
+        state["seen"] += 1
+        if i == at:
+            fi.kill_process()
+        return real(step, x, y)
+
+    sup._run_step = lethal
+    try:
+        yield
+    finally:
+        sup._run_step = real
+
+
+@contextlib.contextmanager
+def _die_during_save(save_index: int):
+    """Arm ``fault_injection.host_loss_during_save`` on the
+    ``save_index``-th boundary save (0-based): the process dies on the
+    FIRST file write inside that save, leaving a torn stage the commit
+    protocol must never publish."""
+    from incubator_mxnet_tpu.parallel import fault_injection as fi
+    from incubator_mxnet_tpu.parallel import supervisor as sup
+
+    real = sup._save_checkpoint
+    state = {"seen": 0}
+
+    def lethal(step, mgr, it):
+        i = state["seen"]
+        state["seen"] += 1
+        if i == save_index:
+            with fi.host_loss_during_save(at=0):
+                return real(step, mgr, it)
+        return real(step, mgr, it)
+
+    sup._save_checkpoint = lethal
+    try:
+        yield
+    finally:
+        sup._save_checkpoint = real
+
+
+def _chaos_context(name: str, kw: dict):
+    from incubator_mxnet_tpu.parallel import fault_injection as fi
+
+    if name == "kill_process":
+        return _die_at_step(int(kw.get("at", 3)))
+    if name == "hang_step":
+        return fi.hang_step(at=int(kw.get("at", 3)),
+                            duration=float(kw.get("duration", 600.0)))
+    if name == "straggler_process":
+        # a per-step slowdown = a long run of short wedges
+        return fi.hang_step(at=int(kw.get("at", 4)),
+                            duration=float(kw.get("delay", 1.0)),
+                            count=10 ** 6)
+    if name == "host_loss_during_save":
+        return _die_during_save(int(kw.get("save", 1)))
+    if name == "loss_bomb":
+        return fi.loss_bomb(at=int(kw.get("at", 4)),
+                            factor=float(kw.get("factor", 1e4)))
+    raise SystemExit("unknown chaos scenario %r (known: %s)"
+                     % (name, ", ".join(sorted(SCENARIOS))))
+
+
+def worker_main(args) -> int:
+    # each rank must be a 1-device host: the parent (or a test
+    # process) may force a virtual multi-device CPU via XLA_FLAGS
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = " ".join(
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from incubator_mxnet_tpu.parallel.supervisor import (EXIT_DIVERGED,
+                                                         DivergenceError,
+                                                         run_supervised)
+
+    step, it, mgr, cfg, rank, nproc = build_worker_job(
+        args.dir, checkpoint_every=args.checkpoint_every,
+        commit_timeout=args.commit_timeout)
+    attempt = int(os.environ.get("MXNET_RESTART_COUNT", "0"))
+    chaos_env = os.environ.get("MXTPU_CHAOS", "")
+    stack = contextlib.ExitStack()
+    if chaos_env and attempt == 0:
+        name, kw = _parse_chaos(chaos_env)
+        victim = int(kw.pop("rank", nproc - 1))
+        if victim < 0:
+            victim += nproc
+        if rank == victim:
+            stack.enter_context(_chaos_context(name, kw))
+
+    def dump(payload):
+        path = os.path.join(args.dir, "result_rank%d.json" % rank)
+        with open(path + ".tmp", "w") as f:
+            json.dump(payload, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(path + ".tmp", path)
+
+    with stack:
+        try:
+            res = run_supervised(step, it, mgr, until_step=args.steps,
+                                 config=cfg, rank=rank)
+        except DivergenceError as e:
+            dump({"rank": rank, "attempt": attempt, "status": "diverged",
+                  "error": str(e)})
+            return EXIT_DIVERGED
+    it.close()
+    dump({"rank": rank, "attempt": attempt, "status": "done",
+          "width": nproc, **res})
+    print("supervised worker done (rank %d/%d, attempt %d, step %d, "
+          "%d rollbacks)" % (rank, nproc, attempt, res["final_step"],
+                             res["rollbacks"]), flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# supervisor mode
+# ---------------------------------------------------------------------------
+
+def make_launcher(args, chaos_spec: str = ""):
+    """A ``Supervisor``-shaped ``launch(width, attempt)`` spawning
+    worker-mode interpreters of THIS file under the ``tools/launch.py``
+    env protocol, on a fresh rendezvous port per attempt."""
+    me = os.path.abspath(__file__)
+
+    def launch(width, attempt):
+        port = _free_port()
+        procs = []
+        for rank in range(width):
+            env = dict(os.environ)
+            env.update({
+                "DMLC_ROLE": "worker",
+                "DMLC_PS_ROOT_URI": "127.0.0.1",
+                "DMLC_PS_ROOT_PORT": str(port),
+                "DMLC_NUM_WORKER": str(width),
+                "DMLC_NUM_SERVER": "0",
+                "DMLC_WORKER_ID": str(rank),
+                "MXNET_RESTART_COUNT": str(attempt),
+                "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": _REPO + os.pathsep
+                + env.get("PYTHONPATH", ""),
+            })
+            if chaos_spec:
+                env["MXTPU_CHAOS"] = chaos_spec
+            cmd = [sys.executable, me, "--worker", "--dir", args.dir,
+                   "--steps", str(args.steps),
+                   "--checkpoint-every", str(args.checkpoint_every),
+                   "--commit-timeout", str(args.commit_timeout)]
+            procs.append(subprocess.Popen(cmd, env=env))
+        return procs
+
+    return launch
+
+
+def make_config(args, overrides: dict = ()):
+    from incubator_mxnet_tpu.parallel import SupervisorConfig
+
+    kw = dict(max_restarts=args.max_restarts,
+              min_stall_timeout=args.min_stall,
+              startup_timeout=args.startup_timeout,
+              backoff=args.backoff,
+              checkpoint_every=args.checkpoint_every)
+    kw.update(dict(overrides or {}))
+    return SupervisorConfig(**kw)
+
+
+def torn_visible(ckpt_dir: str) -> int:
+    """Committed-looking step dirs whose manifest is missing or
+    unparseable — the count of torn checkpoints VISIBLE to a restore
+    (must always be 0: ``.tmp-step-*`` staging debris is fine, a torn
+    ``step-*`` dir is a broken commit protocol)."""
+    n = 0
+    if not os.path.isdir(ckpt_dir):
+        return 0
+    for name in os.listdir(ckpt_dir):
+        if not name.startswith("step-"):
+            continue
+        try:
+            with open(os.path.join(ckpt_dir, name, "manifest.json")) as f:
+                json.load(f)
+        except (OSError, ValueError):
+            n += 1
+    return n
+
+
+def supervise_once(args, chaos_spec: str = "", cfg_overrides=()) -> dict:
+    from incubator_mxnet_tpu.parallel import Supervisor
+    from incubator_mxnet_tpu.parallel.supervisor import read_ledger
+
+    # heartbeats, per-rank ledgers and committed steps all live in the
+    # CHECKPOINT dir (next to what they describe) — watch that
+    ckpt_dir = os.path.join(args.dir, "ckpt")
+    sup = Supervisor(make_launcher(args, chaos_spec), width=args.n,
+                     directory=ckpt_dir, config=make_config(
+                         args, cfg_overrides))
+    out = sup.run(timeout=args.timeout)
+    events = read_ledger(ckpt_dir)
+    out["events"] = [e["event"] for e in events]
+    out["mttrs"] = sorted(set(out.get("mttrs", []))
+                          | {float(e["mttr"]) for e in events
+                             if e["event"] == "recovered"
+                             and "mttr" in e})
+    out["torn_visible"] = torn_visible(os.path.join(args.dir, "ckpt"))
+    return out
+
+
+def run_chaos(scenario: str, args, fmt: str) -> dict:
+    info = SCENARIOS[scenario]
+    spec = scenario + ":" + ",".join(
+        "%s=%s" % (k, v) for k, v in info["spec"].items())
+    sub = argparse.Namespace(**vars(args))
+    sub.n = max(args.n, info["width"])
+    sub.dir = os.path.join(args.dir, scenario)
+    for k, v in info.get("args", {}).items():
+        setattr(sub, k, v)
+    os.makedirs(sub.dir, exist_ok=True)
+    out = supervise_once(sub, chaos_spec=spec,
+                         cfg_overrides=info["cfg"])
+    missing = [ev for ev in REQUIRED_EVENTS[scenario]
+               if ev not in out["events"]]
+    mttr = max(out["mttrs"], default=None)
+    ok = (out["outcome"] == "resolved" and not missing
+          and out["torn_visible"] == 0
+          and mttr is not None and mttr <= args.mttr_bound)
+    rec = {"scenario": scenario, "ok": ok, "outcome": out["outcome"],
+           "restarts": out["restarts"], "shrinks": out["shrinks"],
+           "mttr": mttr, "mttr_bound": args.mttr_bound,
+           "missing_events": missing,
+           "torn_visible": out["torn_visible"],
+           "final_step": out.get("final_step"),
+           "width": out["width"]}
+    if fmt == "json":
+        print(json.dumps(rec, sort_keys=True), flush=True)
+    else:
+        print("[chaos %-22s] %s  restarts=%d shrinks=%d mttr=%s%s"
+              % (scenario, "OK " if ok else "FAIL", rec["restarts"],
+                 rec["shrinks"],
+                 "%.2fs" % mttr if mttr is not None else "-",
+                 " missing=%s" % missing if missing else ""),
+              flush=True)
+    return rec
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Self-healing training supervisor "
+                    "(docs/RESILIENCE.md §7)")
+    ap.add_argument("--worker", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: the rank body
+    ap.add_argument("-n", "--num-workers", dest="n", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=8,
+                    help="applied steps to train to (default 8)")
+    ap.add_argument("--dir", default=None,
+                    help="run directory (checkpoints, heartbeats, "
+                         "health ledger); default: a fresh tempdir")
+    ap.add_argument("--chaos", default=None,
+                    help="inject one scenario (%s) or 'all'"
+                         % "|".join(sorted(SCENARIOS)))
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--max-restarts", type=int, default=2)
+    ap.add_argument("--checkpoint-every", type=int, default=2)
+    ap.add_argument("--commit-timeout", type=float, default=10.0)
+    ap.add_argument("--min-stall", type=float, default=2.0,
+                    help="stall-timeout floor, seconds (the EMA "
+                         "auto-calibration never goes below this)")
+    ap.add_argument("--startup-timeout", type=float, default=60.0)
+    ap.add_argument("--backoff", type=float, default=0.25)
+    ap.add_argument("--timeout", type=float, default=180.0,
+                    help="bound on one supervised run, seconds")
+    ap.add_argument("--mttr-bound", type=float, default=60.0,
+                    help="chaos gate: max seconds from fault detection "
+                         "to training resumed")
+    args = ap.parse_args(argv)
+    if args.worker:
+        if not args.dir:
+            ap.error("--worker requires --dir")
+        return worker_main(args)
+    if args.dir is None:
+        args.dir = tempfile.mkdtemp(prefix="mxtpu_supervise_")
+        print("run dir: %s" % args.dir, file=sys.stderr, flush=True)
+
+    if args.chaos:
+        names = sorted(SCENARIOS) if args.chaos == "all" else \
+            [s.strip() for s in args.chaos.split(",")]
+        unknown = [s for s in names if s not in SCENARIOS]
+        if unknown:
+            ap.error("unknown chaos scenario(s) %s (known: %s)"
+                     % (unknown, ", ".join(sorted(SCENARIOS))))
+        records = [run_chaos(s, args, args.format) for s in names]
+        bad = [r["scenario"] for r in records if not r["ok"]]
+        if args.format == "text":
+            print("chaos matrix: %d/%d green%s"
+                  % (len(records) - len(bad), len(records),
+                     " (FAILED: %s)" % ", ".join(bad) if bad else ""),
+                  flush=True)
+        return 1 if bad else 0
+
+    out = supervise_once(args)
+    if args.format == "json":
+        print(json.dumps(out, sort_keys=True, default=str), flush=True)
+    else:
+        print("supervise: %s (width %d, %d restarts, %d shrinks, "
+              "final step %s)" % (out["outcome"], out["width"],
+                                  out["restarts"], out["shrinks"],
+                                  out.get("final_step")), flush=True)
+    return 0 if out["outcome"] == "resolved" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
