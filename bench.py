@@ -309,6 +309,7 @@ def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
                  "s2d_stem": bool(s2d_stem),
                  "bn": ("ghost%d" % ghost_bn) if ghost_bn else "batch",
                  "passes": list(pass_names),
+                 "schedule_hash": step.schedule_hash,
                  "multi_precision": bool(multi_precision),
                  "loss_scale": str(loss_scale),
                  "mesh": ("dp%d" % mesh_dp) if mesh is not None else "none",
@@ -369,6 +370,7 @@ def run_serve(batch_bucket=64, image_size=224, qps=400.0, n_requests=200,
              "p99_ms": round(rep.p99_ms, 2), "qps_offered": qps,
              "ok": rep.ok, "errors": rep.errors, "shed": rep.shed,
              "recompiles": rep.recompiles, "buckets": list(buckets),
+             "schedule_hash": eng.schedule_hash,
              "occupancy": {str(k): v for k, v in
                            sorted(rep.occupancy.items())},
              "warmup_compile_s": round(t["compile"], 1)}

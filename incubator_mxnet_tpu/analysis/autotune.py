@@ -37,9 +37,20 @@ CPU-mesh **proxy mode**: measurements are *relative* step times on the
 ``relative_only`` — never silence (BENCH r04/r05 recorded bare zeros
 during the tunnel outage and looked like a 100 % regression).
 
+**graftsched** (ROADMAP item 6) extends step 1 from whole-pass on/off
+knobs to per-site :class:`~.passes.PassSchedule` candidates, the Relay
+move (arXiv:1810.00952): ONE report-everything pipeline run
+(``TrainStep.analyze_schedule``) yields a per-site delta table, every
+schedule in the space is ranked additively from it with zero further
+traces, GL201/GL301/GL403-infeasible schedules are pruned zero-compile,
+and the winner persists as a schedule-hash-stamped config that
+``bench.py`` and ``ServeEngine(passes=)`` load directly.
+
 Entry points: :func:`autotune_train`, :func:`autotune_serve`,
-:func:`fit_residual`, :func:`spearman`; the CLI is
-``tools/autotune.py``; docs in ``docs/PERF.md`` §Autotuning.
+:func:`autotune_train_schedules`, :func:`schedule_site_table`,
+:func:`default_schedule_space`, :func:`fit_residual`,
+:func:`spearman`; the CLI is ``tools/autotune.py``; docs in
+``docs/PERF.md`` §Autotuning and ``docs/PASSES.md`` §Schedules.
 """
 from __future__ import annotations
 
@@ -52,8 +63,10 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 __all__ = ["Candidate", "TuningResult", "autotune_train", "autotune_serve",
-           "backend_status", "default_train_space", "default_serve_space",
-           "dense_workload", "fit_residual", "spearman"]
+           "autotune_train_schedules", "backend_status",
+           "default_schedule_space", "default_serve_space",
+           "default_train_space", "dense_workload", "fit_residual",
+           "schedule_site_table", "spearman"]
 
 
 # ---------------------------------------------------------------------------
@@ -180,15 +193,35 @@ class TuningResult:
             f.write(self.to_json(indent=2))
         os.replace(tmp, path)
 
+    def best_predicted(self) -> Optional["Candidate"]:
+        """The best candidate by (residual-corrected, else raw)
+        predicted seconds-per-sample among the non-rejected — the
+        zero-compile ranking answer when ``budget_compiles=0`` leaves
+        no measured winner."""
+        pool = [c for c in self.candidates
+                if c.status in ("predicted", "measured")
+                and c.pred_sps is not None]
+        if not pool:
+            return None
+        return min(pool, key=lambda c: c.corrected_sps
+                   if c.corrected_sps is not None else c.pred_sps)
+
     def winner_config(self) -> Optional[Dict[str, Any]]:
         """The winner's knob dict in the shape ``bench.py`` /
         ``Trainer.make_fused_step`` consume, stamped with provenance
         (backend, relative-only) so a CPU-proxy winner can never be
-        mistaken for a measured-on-TPU one."""
-        if self.winner is None:
+        mistaken for a measured-on-TPU one.  Schedule-search winners
+        carry their canonical ``schedule`` dict and ``schedule_hash``
+        inside ``knobs`` — loadable straight into
+        ``make_train_step(passes=...)`` / ``ServeEngine(passes=...)``.
+        With ``budget_compiles=0`` (pure zero-compile ranking) the
+        best *predicted* candidate stands in, ``measured_s_per_sample``
+        None."""
+        w = self.winner or self.best_predicted()
+        if w is None:
             return None
-        return {"target": self.target, "knobs": dict(self.winner.knobs),
-                "measured_s_per_sample": self.winner.measured_sps,
+        return {"target": self.target, "knobs": dict(w.knobs),
+                "measured_s_per_sample": w.measured_sps,
                 "backend": self.backend,
                 "tpu_unavailable": self.tpu_unavailable,
                 "relative_only": self.relative_only}
@@ -358,6 +391,15 @@ def _build_train_step(make_net, loss_fn, knobs, mesh, numerics="off",
         kw["momentum"] = 0.9
     if knobs.get("multi_precision"):
         kw["multi_precision"] = True
+    # explicit () — a candidate without the knob must not inherit
+    # MXTPU_PASSES, or every candidate would silently carry it.  A
+    # "schedule" knob (the canonical PassSchedule dict graftsched logs)
+    # outranks the whole-pass "passes" list.
+    pass_cfg = knobs.get("passes", ())
+    if knobs.get("schedule") is not None:
+        from .passes import PassSchedule
+
+        pass_cfg = PassSchedule.from_dict(knobs["schedule"])
     return make_train_step(
         net, loss_fn, mesh=mesh, zero=int(knobs.get("zero", 0)),
         pipeline_stages=knobs.get("pipeline_stages"),
@@ -365,9 +407,7 @@ def _build_train_step(make_net, loss_fn, knobs, mesh, numerics="off",
         pipeline_remat=bool(knobs.get("pipeline_remat", False)),
         loss_scale=knobs.get("loss_scale"),
         compute_dtype=knobs.get("compute_dtype"),
-        # explicit () — a candidate without the knob must not inherit
-        # MXTPU_PASSES, or every candidate would silently carry it
-        passes=knobs.get("passes", ()),
+        passes=pass_cfg,
         lint="off", cost="off", numerics=numerics,
         input_range=input_range, **kw)
 
@@ -619,6 +659,261 @@ def autotune_train(make_net=None, make_batch=None, loss_fn=None,
         result.winner = min(measured, key=lambda c: c.measured_sps)
     if default_idx is not None:
         result.default = result.candidates[default_idx]
+    result.wall_s = time.time() - t_start
+    if log_path:
+        result.write_log(log_path)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# graftsched: per-site schedule search (train knobs × schedules)
+# ---------------------------------------------------------------------------
+
+def schedule_site_table(make_net, make_batch, loss_fn, passes,
+                        mesh=None, knobs: Optional[Dict[str, Any]] = None,
+                        device: str = "cpu-proxy",
+                        hbm_budget: Optional[float] = None,
+                        numerics: str = "off", input_range=None
+                        ) -> Dict[str, Any]:
+    """The per-site delta table behind the schedule search: ONE
+    report-everything all-sites pipeline run
+    (``TrainStep.analyze_schedule``) plus ONE base (no-pass) cost
+    trace, zero compiles.  Returns::
+
+        {"receipts": [PassReceipt...],   # all-sites run, .sites rows
+         "base": CostReport,             # the passes=() program
+         "pass_names": (...),
+         "refused": {pass_name: "GLxxx: ..."}}  # ERROR-refused passes
+
+    Every schedule candidate over ``passes`` is then ranked additively
+    from the rows — no per-candidate trace."""
+    from .diagnostics import Severity
+
+    knobs = dict(knobs or {})
+    names = tuple(p if isinstance(p, str) else getattr(p, "name", str(p))
+                  for p in passes)
+    sched_knobs = dict(knobs)
+    sched_knobs["passes"] = names
+    step = _build_train_step(make_net, loss_fn, sched_knobs, mesh,
+                             numerics=numerics, input_range=input_range)
+    x, y = make_batch(sched_knobs)
+    pipeline = step.analyze_schedule(x, y)
+    refused: Dict[str, str] = {}
+    for r in pipeline.receipts:
+        err = [d for d in r.diagnostics if d.severity >= Severity.ERROR]
+        if err and not r.installed:
+            refused[r.name] = "%s: %s" % (err[0].code,
+                                          err[0].message.split("\n")[0])
+    base_knobs = dict(knobs)
+    base_knobs["passes"] = ()
+    base_step = _build_train_step(make_net, loss_fn, base_knobs, mesh,
+                                  numerics=numerics,
+                                  input_range=input_range)
+    base = base_step.analyze_cost(x, y, device=device,
+                                  hbm_budget=hbm_budget)
+    return {"receipts": list(pipeline.receipts), "base": base,
+            "pass_names": names, "refused": refused}
+
+
+def _schedule_delta(sched, receipts) -> Tuple[float, float, float,
+                                              List[str]]:
+    """Additive ``(flops, hbm_bytes, peak_bytes)`` delta of one
+    schedule, summed from the all-sites run's per-site receipt rows
+    (site-aware passes) or whole-receipt deltas (whole-program passes).
+    Fourth element: names of enabled-but-ERROR-refused passes — a
+    schedule turning one on is infeasible."""
+    from .passes import PassSchedule  # noqa: F401  (doc anchor)
+    from .diagnostics import Severity
+
+    d_fl = d_by = d_pk = 0.0
+    refused: List[str] = []
+    by_name = {}
+    for r in receipts:
+        by_name.setdefault(r.name, r)
+    for name, dec in sched.entries:
+        r = by_name.get(name)
+        if r is None:
+            continue
+        enabled = any(dec.values()) if isinstance(dec, dict) else bool(dec)
+        if not enabled:
+            continue
+        if any(d.severity >= Severity.ERROR for d in r.diagnostics) \
+                and not r.installed:
+            refused.append(name)
+            continue
+        rows = r.sites
+        if rows is None:
+            # whole-program pass: all-or-nothing
+            d_fl += r.flops_after - r.flops_before
+            d_by += r.hbm_bytes_after - r.hbm_bytes_before
+            d_pk += r.peak_bytes_after - r.peak_bytes_before
+            continue
+        on = None if dec is True else {s for s, v in dec.items() if v}
+        full = True
+        for row in rows:
+            if not row["installed"]:
+                continue
+            if on is not None and row["site"] not in on:
+                full = False
+                continue
+            d_fl += row["flops_delta"]
+            d_by += row["hbm_bytes_delta"]
+        if full:
+            # only a full-pass enable may claim the whole peak delta —
+            # peak is a max, not a sum, so partial credit would lie
+            d_pk += r.peak_bytes_after - r.peak_bytes_before
+    return d_fl, d_by, d_pk, refused
+
+
+def default_schedule_space(table: Dict[str, Any],
+                           max_candidates: int = 24) -> List[Any]:
+    """The default schedule space over one site table: all-on, all-off,
+    each pass solo, beneficial-sites-only (every site whose attributed
+    HBM-bytes delta is negative), and per-pass single-site probes —
+    deduped by canonical hash, capped at ``max_candidates`` (dropped
+    count is the caller's to log).  Returns ``PassSchedule`` objects."""
+    from .passes import PassSchedule
+
+    names = list(table["pass_names"])
+    rows_of = {r.name: r.sites for r in table["receipts"]}
+    out: List[PassSchedule] = []
+    out.append(PassSchedule([(n, True) for n in names]))       # all-on
+    out.append(PassSchedule([(n, False) for n in names]))      # all-off
+    for n in names:                                            # solos
+        out.append(PassSchedule([(m, m == n) for m in names]))
+    # beneficial-only: keep the sites that predicted a bytes win
+    dec = []
+    for n in names:
+        rows = rows_of.get(n)
+        if rows is None:
+            r = next(r for r in table["receipts"] if r.name == n)
+            dec.append((n, r.hbm_bytes_after < r.hbm_bytes_before
+                        or r.installed))
+            continue
+        good = {row["site"]: True for row in rows
+                if row["installed"] and row["hbm_bytes_delta"] < 0}
+        dec.append((n, good if good else False))
+    out.append(PassSchedule(dec))
+    # single-site probes: one site of one pass, everything else off
+    for n in names:
+        for row in (rows_of.get(n) or []):
+            if not row["installed"]:
+                continue
+            out.append(PassSchedule(
+                [(m, {row["site"]: True} if m == n else False)
+                 for m in names]))
+    seen, deduped = set(), []
+    for s in out:
+        h = s.hash()
+        if h in seen:
+            continue
+        seen.add(h)
+        deduped.append(s)
+    return deduped[:max_candidates]
+
+
+def autotune_train_schedules(make_net=None, make_batch=None, loss_fn=None,
+                             passes: Sequence[Any] = (),
+                             schedules: Optional[Sequence[Any]] = None,
+                             knobs: Optional[Dict[str, Any]] = None,
+                             mesh=None, device: str = "cpu-proxy",
+                             hbm_budget: Optional[float] = None,
+                             budget_compiles: int = 0,
+                             warmup: int = 1, iters: int = 3,
+                             cache=None, numerics: str = "off",
+                             input_range=None,
+                             log_path: Optional[str] = None
+                             ) -> TuningResult:
+    """Search (train knobs × per-site pass schedules) jointly — the
+    graftsched closing of the loop.  ``knobs`` pins the train knobs
+    (batch etc.); ``schedules`` (default
+    :func:`default_schedule_space`) are the
+    :class:`~.passes.PassSchedule` candidates over ``passes``.
+
+    Ranking spends ONE all-sites pipeline trace + ONE base cost trace
+    total (:func:`schedule_site_table`); every schedule is predicted
+    additively from the per-site delta rows — rejected candidates
+    never own a trace, let alone a compile (``zero_compile=True`` in
+    the ledger).  A schedule enabling an ERROR-refused pass
+    (GL301/GL302/GL403) or predicting over ``hbm_budget`` (GL201) is
+    pruned eagerly.  ``budget_compiles`` then measures the top
+    survivors exactly like :func:`autotune_train` — the compile cache
+    keys on the schedule hash, so two schedules never collide and a
+    re-tune is trace-only.  The winner's knobs carry
+    ``schedule``/``schedule_hash``, loadable by ``bench.py`` and
+    ``ServeEngine(passes=)``."""
+    t_start = time.time()
+    if make_net is None or make_batch is None or loss_fn is None:
+        make_net, make_batch, loss_fn = dense_workload()
+    backend, tpu_unavailable = backend_status()
+    result = TuningResult(target="train-schedule", backend=backend,
+                          tpu_unavailable=tpu_unavailable,
+                          relative_only=tpu_unavailable, device=device,
+                          hbm_budget=hbm_budget,
+                          budget_compiles=int(budget_compiles))
+    table = schedule_site_table(make_net, make_batch, loss_fn, passes,
+                                mesh=mesh, knobs=knobs, device=device,
+                                hbm_budget=hbm_budget, numerics=numerics,
+                                input_range=input_range)
+    if schedules is None:
+        schedules = default_schedule_space(table)
+    base = table["base"]
+    rf = base.roofline()
+    knobs = dict(knobs or {})
+    batch = int(knobs.get("batch", 16))
+    from .passes import PassSchedule
+
+    for sched in schedules:
+        if not isinstance(sched, PassSchedule):
+            sched = PassSchedule.from_dict(sched)
+        c = Candidate(knobs=dict(knobs))
+        c.knobs["schedule"] = sched.canonical()
+        c.knobs["schedule_hash"] = sched.hash()
+        result.candidates.append(c)
+        d_fl, d_by, d_pk, refused = _schedule_delta(
+            sched, table["receipts"])
+        c.zero_compile = True
+        if refused:
+            c.status = "rejected-infeasible"
+            c.reason = "; ".join("%s (%s)" % (table["refused"].get(
+                n, "refused"), n) for n in refused)
+            continue
+        flops = max(base.total_flops + d_fl, 0.0)
+        hbm = max(base.hbm_bytes + d_by, 0.0)
+        peak = max(base.peak_bytes + d_pk, 0.0)
+        compute_s = rf["compute_s"] * (flops / base.total_flops
+                                       if base.total_flops else 1.0)
+        hbm_s = rf["hbm_s"] * (hbm / base.hbm_bytes
+                               if base.hbm_bytes else 1.0)
+        step_s = max(compute_s, hbm_s, rf["comm_s"])
+        c.pred = {"compute_s": compute_s, "hbm_s": hbm_s,
+                  "comm_s": rf["comm_s"], "step_s": step_s,
+                  "hbm_bytes": hbm, "peak_bytes": peak, "flops": flops}
+        c.pred_sps = step_s / max(batch, 1)
+        if hbm_budget is not None and peak > float(hbm_budget):
+            c.status = "rejected-infeasible"
+            c.reason = ("GL201: predicted peak %.1f MB over the %.1f MB "
+                        "budget" % (peak / 1e6, float(hbm_budget) / 1e6))
+            continue
+        c.status = "predicted"
+
+    from ..parallel import aot
+
+    c0 = aot.XLA_COMPILES.count
+    _, residual_info = _refine_loop(
+        result.candidates,
+        lambda c: _measure_train(c, make_net, make_batch, loss_fn, mesh,
+                                 cache, warmup, iters, numerics=numerics,
+                                 input_range=input_range),
+        int(budget_compiles), None,
+        lambda c: c.corrected_sps if c.corrected_sps is not None
+        else (c.pred_sps if c.pred_sps is not None else float("inf")))
+    result.compiles_spent = aot.XLA_COMPILES.count - c0
+    result.residual = residual_info
+
+    measured = [c for c in result.candidates if c.status == "measured"]
+    if measured:
+        result.winner = min(measured, key=lambda c: c.measured_sps)
     result.wall_s = time.time() - t_start
     if log_path:
         result.write_log(log_path)

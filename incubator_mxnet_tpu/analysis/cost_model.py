@@ -317,6 +317,20 @@ def _eqn_flops(eqn) -> float:
     return 0.0
 
 
+def eqn_site_weight(eqn) -> Tuple[float, float]:
+    """``(flops, hbm_bytes)`` of one equation viewed in isolation — the
+    local, unfused weight graftsched uses to attribute a whole-pass
+    cost delta across its sites (analysis/passes.py::PassManager.
+    _site_rows).  Bytes are operand reads plus output writes with no
+    fusion credit: attribution needs relative magnitudes between sites
+    of one pass, not the fused program traffic ``analyze_jaxpr``
+    models."""
+    reads = sum(_aval_bytes(v.aval) for v in eqn.invars
+                if not isinstance(v, jcore.Literal))
+    writes = sum(_aval_bytes(v.aval) for v in eqn.outvars)
+    return _eqn_flops(eqn), float(reads + writes)
+
+
 # ---------------------------------------------------------------------------
 # accumulators
 # ---------------------------------------------------------------------------

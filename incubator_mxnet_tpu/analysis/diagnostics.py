@@ -149,6 +149,12 @@ CODES = {
               "graftpass: rewrite increased predicted HBM cost with no "
               "exactness gain (a bit_exact pass whose graftcost receipt "
               "went up) — the rewrite is pointless and is skipped"),
+    "GL304": (Severity.WARNING,
+              "graftpass: a pass named in passes=/MXTPU_PASSES matched "
+              "zero sites (no applicable eqn in the program, or the "
+              "schedule's decision vector names sites that do not "
+              "exist) — the composition is a silent no-op that reads "
+              "as \"optimized\" while changing nothing"),
     "GL101": (Severity.ERROR,
               "shard_map imported from jax directly instead of "
               "parallel/mesh.py (the one version-compat home)"),

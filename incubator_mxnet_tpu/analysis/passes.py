@@ -62,15 +62,33 @@ Shipped passes (the registry; ``tools/graftpass.py --list``):
   BN-stat computation GL202 detects) + dead-code elimination of
   equations no output depends on (``bit_exact``).
 
+graftsched (per-site schedules): every shipped rewrite pass except
+``cse_dead_aux`` is *site-parameterized* — it enumerates its applicable
+sites (:meth:`GraftPass.enumerate_sites`, stable ``"<primitive>:<k>"``
+addresses into the traced jaxpr) and honors a per-site decision vector
+instead of being all-or-nothing.  A :class:`PassSchedule` maps pass →
+site → decision with a canonical serialization and a stable hash that
+keys the compile cache; the legacy pass-list path is exactly the
+all-sites schedule (bitwise-equivalent sugar).  Receipts carry one row
+per site with the pass's cost delta attributed across its installed
+sites (``cost_model.eqn_site_weight`` proportional split — the rows sum
+to the pass's whole before/after delta by construction).  A configured
+pass that matched zero sites is flagged GL304 (warning): a silent no-op
+composition must not read as "optimized".
+
 Entry points: :class:`PassManager`, :func:`resolve_passes`,
-:func:`register_pass`, :data:`PASS_REGISTRY`; wired in as
-``make_train_step(passes=...)`` / ``ServeEngine(passes=...)`` /
-``MXTPU_PASSES`` (config.py) / ``tools/graftpass.py``; GL301–GL303 in
-docs/ANALYSIS.md; the guide is docs/PASSES.md.
+:func:`resolve_schedule`, :class:`PassSchedule`, :func:`register_pass`,
+:data:`PASS_REGISTRY`; wired in as ``make_train_step(passes=...)`` /
+``ServeEngine(passes=...)`` / ``MXTPU_PASSES`` (config.py) /
+``tools/graftpass.py``; GL301–GL304 in docs/ANALYSIS.md; the guide is
+docs/PASSES.md.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+import hashlib
+import json
+
+from dataclasses import dataclass, field, replace as _dc_replace
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -83,9 +101,10 @@ from .diagnostics import Diagnostic, LintError, LintReport, Severity
 
 __all__ = ["AmpBf16Pass", "Contract", "CseDeadAuxPass", "GraftPass",
            "MaxPoolBwdMaskPass", "PASS_REGISTRY", "PassContext",
-           "PassManager", "PassReceipt", "PassResult", "PipelineResult",
-           "QuantizeWeightsPass", "SpaceToDepthPass", "get_pass",
-           "register_pass", "resolve_passes"]
+           "PassManager", "PassReceipt", "PassResult", "PassSchedule",
+           "PassSite", "PipelineResult", "QuantizeWeightsPass",
+           "SpaceToDepthPass", "get_pass", "register_pass",
+           "resolve_passes", "resolve_schedule"]
 
 
 # ---------------------------------------------------------------------------
@@ -244,6 +263,192 @@ class PassContext:
     numerics: str = "off"
     input_ranges: Optional[Dict[int, Any]] = None
     where: str = "graftpass"
+    #: graftsched decision vector for ONE pass: None = every site
+    #: (the legacy all-or-nothing path, now the all-sites sugar); a
+    #: frozenset of site ids = only those sites rewrite.  The manager
+    #: sets this per pass from its :class:`PassSchedule` — callers
+    #: building a context by hand normally leave it None.
+    sites: Optional[frozenset] = None
+
+
+# ---------------------------------------------------------------------------
+# sites & schedules (graftsched)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class PassSite:
+    """One applicable rewrite location of a site-parameterized pass.
+
+    ``id`` is the stable site address: ``"<primitive>:<k>"`` for
+    equation sites, where ``k`` counts the equations of that primitive
+    in top-level walk order of the traced jaxpr — EVERY equation of the
+    primitive advances the counter, matching or not, so the address
+    survives both retrace (the walk order IS the jaxpr) and matcher
+    changes — and ``"invar:<i>"`` for parameter-invar sites (quantize).
+    ``flops``/``hbm_bytes`` are the *local, unfused* weights of the
+    original site (``cost_model.eqn_site_weight``): the proportional
+    basis for per-site delta attribution, never absolute predictions —
+    the pass-level before/after cost totals stay the authority.
+    """
+    id: str
+    kind: str = "eqn"      # "eqn" | "invar"
+    detail: str = ""
+    flops: float = 0.0
+    hbm_bytes: float = 0.0
+
+
+def _site_on(ctx: "PassContext", site_id: str) -> bool:
+    """Decision-vector check a pass rule applies per candidate site."""
+    sites = getattr(ctx, "sites", None)
+    return sites is None or site_id in sites
+
+
+class _SiteWalk:
+    """Per-primitive ordinal counter shared by ``enumerate_sites`` and
+    the retrace rules, so both derive identical site addresses from the
+    same deterministic eqn walk."""
+
+    def __init__(self):
+        self._n: Dict[str, int] = {}
+
+    def sid(self, prim_name: str) -> str:
+        i = self._n.get(prim_name, 0)
+        self._n[prim_name] = i + 1
+        return "%s:%d" % (prim_name, i)
+
+
+def _eqn_weight(eqn) -> Tuple[float, float]:
+    from .cost_model import eqn_site_weight
+
+    return eqn_site_weight(eqn)
+
+
+class PassSchedule:
+    """pass → site → decision: which sites of which passes rewrite.
+
+    ``entries`` is an ordered tuple of ``(pass_name, decision)`` —
+    pipeline order is semantic.  A decision is ``True`` (every site),
+    ``False`` (pass disabled) or a ``{site_id: bool}`` map where only
+    the ids mapped to True rewrite; unnamed sites stay off, and ids
+    absent from a given program are ignored (a schedule authored on one
+    batch signature degrades gracefully on another — GL304 flags the
+    resulting silent no-op).
+
+    ``canonical()`` / ``to_json()`` are the stable serialization:
+    pipeline order preserved, site maps key-sorted, compact separators.
+    ``hash()`` is its sha256 prefix (16 hex chars) — equal schedules
+    hash equal across processes, distinct schedules never collide in
+    the :class:`~..parallel.aot.CompileCache` (the hash rides
+    ``cache_extra``).
+    """
+
+    def __init__(self, entries: Sequence[Tuple[str, Any]]):
+        norm: List[Tuple[str, Any]] = []
+        for name, dec in entries:
+            if isinstance(dec, dict):
+                dec = {str(k): bool(v) for k, v in dec.items()}
+            else:
+                dec = bool(dec)
+            norm.append((str(name), dec))
+        self.entries: Tuple[Tuple[str, Any], ...] = tuple(norm)
+
+    # -- constructors --------------------------------------------------
+    @staticmethod
+    def from_passes(passes) -> "PassSchedule":
+        """The all-sites schedule of a pass list — what the legacy
+        ``passes=`` on/off path means under graftsched."""
+        return PassSchedule([(p.name, True)
+                             for p in resolve_passes(passes)])
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "PassSchedule":
+        """Inverse of :meth:`canonical` (``{"passes": [{"name": ...,
+        "sites": {...}} | {"name": ..., "enabled": bool}, ...]}``).
+        A ``sites`` *list* of ids is accepted as hand-authoring sugar
+        for ``{id: true}``; any other non-map ``sites`` value raises —
+        silently reading it as all-sites would alias a different
+        schedule hash in the compile cache."""
+        if not isinstance(d, dict) or not isinstance(d.get("passes"),
+                                                     (list, tuple)):
+            raise ValueError("schedule dict needs a 'passes' list, got %r"
+                             % (d,))
+        entries: List[Tuple[str, Any]] = []
+        for e in d["passes"]:
+            sites = e.get("sites")
+            if isinstance(sites, dict):
+                entries.append((e["name"], sites))
+            elif isinstance(sites, (list, tuple, set, frozenset)):
+                entries.append((e["name"], {str(s): True for s in sites}))
+            elif sites is not None:
+                raise ValueError(
+                    "schedule entry for %r: 'sites' must be a "
+                    "{site_id: bool} map or a list of site ids, got %r"
+                    % (e.get("name"), sites))
+            else:
+                entries.append((e["name"], e.get("enabled", True)))
+        return PassSchedule(entries)
+
+    # -- queries -------------------------------------------------------
+    def pass_names(self) -> Tuple[str, ...]:
+        return tuple(n for n, _ in self.entries)
+
+    def decision_for(self, name: str):
+        for n, dec in self.entries:
+            if n == name:
+                return dec
+        return None
+
+    def enabled(self, name: str) -> bool:
+        """False only when the schedule explicitly turns the whole pass
+        (or every one of its named sites) off."""
+        dec = self.decision_for(name)
+        if dec is None:
+            return True  # pass outside the schedule: all-sites default
+        if isinstance(dec, dict):
+            return any(dec.values())
+        return bool(dec)
+
+    def sites_for(self, name: str) -> Optional[frozenset]:
+        """The decision vector for one pass: None = every site."""
+        dec = self.decision_for(name)
+        if dec is None or dec is True:
+            return None
+        if isinstance(dec, dict):
+            return frozenset(k for k, v in dec.items() if v)
+        return frozenset()
+
+    # -- serialization -------------------------------------------------
+    def canonical(self) -> Dict[str, Any]:
+        rows: List[Dict[str, Any]] = []
+        for n, dec in self.entries:
+            if isinstance(dec, dict):
+                rows.append({"name": n,
+                             "sites": {k: bool(dec[k])
+                                       for k in sorted(dec)}})
+            else:
+                rows.append({"name": n, "enabled": bool(dec)})
+        return {"version": 1, "passes": rows}
+
+    def to_json(self) -> str:
+        return json.dumps(self.canonical(), sort_keys=True,
+                          separators=(",", ":"))
+
+    def hash(self) -> str:
+        return hashlib.sha256(self.to_json().encode()).hexdigest()[:16]
+
+    def __eq__(self, other):
+        return isinstance(other, PassSchedule) \
+            and self.entries == other.entries
+
+    def __hash__(self):
+        return hash(self.to_json())
+
+    def __repr__(self):
+        return "PassSchedule(%s, hash=%s)" % (
+            ", ".join("%s=%s" % (n, "all" if dec is True else
+                                 ("off" if dec is False else
+                                  sorted(k for k, v in dec.items() if v)))
+                      for n, dec in self.entries), self.hash())
 
 
 @dataclass
@@ -266,6 +471,10 @@ class PassResult:
     #: precision-safety verdict of a range-gated pass (the GL403 gate):
     #: {"checked": n, "excluded": n, "safe": bool, ...}
     precision: Optional[Dict[str, Any]] = None
+    #: graftsched: site id -> exclusion reason for sites the pass itself
+    #: refused to rewrite (amp_bf16's per-op GL403 range gate) — the
+    #: manager marks those sites excluded on the per-site receipt rows
+    excluded_sites: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
@@ -293,6 +502,13 @@ class PassReceipt:
     precision: Optional[Dict[str, Any]] = None
     notes: str = ""
     diagnostics: List[Diagnostic] = field(default_factory=list)
+    #: graftsched per-site rows (site-parameterized passes only): one
+    #: dict per enumerated site — ``{"site", "kind", "detail",
+    #: "decision", "installed", "excluded", "flops_delta",
+    #: "hbm_bytes_delta", "param_bytes_delta", "contract", "probe_ok"}``
+    #: — with the pass's whole before/after delta attributed across its
+    #: installed sites (the rows sum to the pass delta by construction)
+    sites: Optional[List[Dict[str, Any]]] = None
 
     def to_dict(self) -> dict:
         return {"name": self.name, "contract": self.contract,
@@ -308,7 +524,8 @@ class PassReceipt:
                 "param_bytes_after": self.param_bytes_after,
                 "probe": self.probe, "precision": self.precision,
                 "notes": self.notes,
-                "diagnostics": [d.to_dict() for d in self.diagnostics]}
+                "diagnostics": [d.to_dict() for d in self.diagnostics],
+                "sites": self.sites}
 
 
 @dataclass
@@ -356,9 +573,21 @@ class GraftPass:
     name: str = "graftpass"
     contract: Contract = Contract.bit_exact()
     description: str = ""
+    #: graftsched: True for passes that enumerate sites and honor the
+    #: per-site decision vector (``ctx.sites``); whole-program passes
+    #: (cse_dead_aux) leave it False and only take on/off decisions
+    site_aware: bool = False
 
     def run(self, closed_jaxpr, ctx: PassContext) -> Optional[PassResult]:
         raise NotImplementedError
+
+    def enumerate_sites(self, closed_jaxpr,
+                        ctx: PassContext) -> List[PassSite]:
+        """Applicable sites of this pass in ``closed_jaxpr`` (stable
+        addresses, :class:`PassSite`).  Enumeration reports
+        applicability and IGNORES ``ctx.sites`` — the decision vector
+        only filters :meth:`run`.  Whole-program passes return []."""
+        return []
 
     def __repr__(self):
         return "%s(name=%r, contract=%s)" % (
@@ -492,6 +721,8 @@ class QuantizeWeightsPass(GraftPass):
     change, not a numerics change).
     """
 
+    site_aware = True
+
     def __init__(self, bits: int = 8):
         if bits not in (8, 4):
             raise ValueError("bits must be 8 or 4, got %r" % (bits,))
@@ -525,9 +756,25 @@ class QuantizeWeightsPass(GraftPass):
         q, amax = symmetric_quantize(jnp.asarray(w), qmax=self.qmax)
         return [q, amax]
 
+    def enumerate_sites(self, closed_jaxpr,
+                        ctx: PassContext) -> List[PassSite]:
+        jaxpr = closed_jaxpr.jaxpr
+        out: List[PassSite] = []
+        for i in self._eligible(jaxpr, ctx):
+            a = jaxpr.invars[i].aval
+            nbytes = float(np.prod(a.shape, dtype=np.int64)
+                           * np.dtype(a.dtype).itemsize)
+            out.append(PassSite(
+                "invar:%d" % i, kind="invar",
+                detail="param %s[%s]" % (np.dtype(a.dtype).name,
+                                         ",".join(map(str, a.shape))),
+                hbm_bytes=nbytes))
+        return out
+
     def run(self, closed_jaxpr, ctx: PassContext) -> Optional[PassResult]:
         jaxpr = closed_jaxpr.jaxpr
-        eligible = self._eligible(jaxpr, ctx)
+        eligible = [i for i in self._eligible(jaxpr, ctx)
+                    if _site_on(ctx, "invar:%d" % i)]
         if not eligible:
             return None
         esel = set(eligible)
@@ -583,12 +830,41 @@ class AmpBf16Pass(GraftPass):
     """
 
     name = "amp_bf16"
+    site_aware = True
     description = ("selective dtype rewrite: f32 matmul/conv operands in "
                    "bf16 with f32 accumulation; reductions/softmax/norms "
                    "stay f32; per-op GL403 range gate under numerics=")
 
+    _PRIMS = ("dot_general", "conv_general_dilated")
+
     def __init__(self, atol: float = 0.05):
         self.contract = Contract.tolerance(atol)
+
+    @classmethod
+    def _candidate(cls, eqn) -> bool:
+        if eqn.primitive.name not in cls._PRIMS:
+            return False
+        if eqn.outvars[0].aval.dtype != jnp.float32:
+            return False
+        a, b = eqn.invars[0].aval, eqn.invars[1].aval
+        return a.dtype == jnp.float32 and b.dtype == jnp.float32
+
+    def enumerate_sites(self, closed_jaxpr,
+                        ctx: PassContext) -> List[PassSite]:
+        walk, out = _SiteWalk(), []
+        for eqn in closed_jaxpr.jaxpr.eqns:
+            prim = eqn.primitive.name
+            if prim not in self._PRIMS:
+                continue
+            sid = walk.sid(prim)
+            if not self._candidate(eqn):
+                continue
+            fl, by = _eqn_weight(eqn)
+            out.append(PassSite(
+                sid, detail="%s -> %s"
+                % (prim, eqn.outvars[0].aval.str_short()),
+                flops=fl, hbm_bytes=by))
+        return out
 
     def run(self, closed_jaxpr, ctx: PassContext) -> Optional[PassResult]:
         hits = [0]
@@ -631,19 +907,26 @@ class AmpBf16Pass(GraftPass):
                     return reason
             return None
 
+        walk = _SiteWalk()
+
         def rule(eqn, invals):
-            if eqn.primitive.name not in ("dot_general",
-                                          "conv_general_dilated"):
+            if eqn.primitive.name not in self._PRIMS:
                 return None
+            sid = walk.sid(eqn.primitive.name)
             out_aval = eqn.outvars[0].aval
             if out_aval.dtype != jnp.float32:
                 return None
             a, b = invals[0], invals[1]
             if a.dtype != jnp.float32 or b.dtype != jnp.float32:
                 return None
+            # the schedule's decision vector filters BEFORE the range
+            # gate: a site the schedule turned off is neither demoted
+            # nor counted among the GL403-checked candidates
+            if not _site_on(ctx, sid):
+                return None
             reason = _bf16_unsafe(eqn)
             if reason is not None:
-                excluded.append((eqn.primitive.name, reason))
+                excluded.append((sid, reason))
                 return None
             params = dict(eqn.params)
             params["preferred_element_type"] = jnp.dtype(jnp.float32)
@@ -693,11 +976,13 @@ class AmpBf16Pass(GraftPass):
             # no-op receipt instead of silently dropping it
             return PassResult(closed_jaxpr, hits=0, diagnostics=diags,
                               precision=precision,
+                              excluded_sites=dict(excluded),
                               notes="all %d candidate(s) excluded by "
                                     "the GL403 range gate"
                                     % len(excluded))
         return PassResult(new_closed, hits=hits[0],
                           diagnostics=diags, precision=precision,
+                          excluded_sites=dict(excluded),
                           notes="%d matmul/conv op(s) moved to bf16 "
                                 "compute%s"
                                 % (hits[0],
@@ -733,9 +1018,28 @@ class SpaceToDepthPass(GraftPass):
                    "depth + stride-1 conv over 4x channels (conv1 MXU "
                    "utilization, PERF.md lever b)")
 
+    site_aware = True
+
     def __init__(self, max_in_channels: int = 7):
         # below the 8-sublane width is where the win lives
         self.max_in_channels = int(max_in_channels)
+
+    def enumerate_sites(self, closed_jaxpr, ctx) -> List[PassSite]:
+        sites, walk = [], _SiteWalk()
+        for eqn in closed_jaxpr.jaxpr.eqns:
+            if eqn.primitive.name != "conv_general_dilated":
+                continue
+            sid = walk.sid("conv_general_dilated")
+            if not self._match(eqn):
+                continue
+            lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+            fl, by = _eqn_weight(eqn)
+            sites.append(PassSite(
+                sid, detail="%dx%d/s2 conv %s * %s"
+                % (rhs.shape[2], rhs.shape[3], lhs.str_short(),
+                   rhs.str_short()),
+                flops=fl, hbm_bytes=by))
+        return sites
 
     def _match(self, eqn) -> bool:
         if eqn.primitive.name != "conv_general_dilated":
@@ -767,9 +1071,13 @@ class SpaceToDepthPass(GraftPass):
 
     def run(self, closed_jaxpr, ctx: PassContext) -> Optional[PassResult]:
         hits = [0]
+        walk = _SiteWalk()
 
         def rule(eqn, invals):
-            if not self._match(eqn):
+            if eqn.primitive.name != "conv_general_dilated":
+                return None
+            sid = walk.sid("conv_general_dilated")
+            if not self._match(eqn) or not _site_on(ctx, sid):
                 return None
             x, w = invals
             p = eqn.params
@@ -839,10 +1147,29 @@ class MaxPoolBwdMaskPass(GraftPass):
                    "shifted-window first-argmax mask (fused elementwise "
                    "passes, no scatter; PERF.md lever c)")
 
+    site_aware = True
+
     #: test-only fault knob (see ops.nn.shifted_window_unpool): a
     #: non-zero shift mis-routes the gradient; the GL301 probe must
     #: catch it.  Never set outside tests.
     _shift_mask = 0
+
+    def enumerate_sites(self, closed_jaxpr, ctx) -> List[PassSite]:
+        sites, walk = [], _SiteWalk()
+        for eqn in closed_jaxpr.jaxpr.eqns:
+            if eqn.primitive.name != "select_and_scatter_add":
+                continue
+            sid = walk.sid("select_and_scatter_add")
+            if not self._match(eqn):
+                continue
+            fl, by = _eqn_weight(eqn)
+            sites.append(PassSite(
+                sid, detail="maxpool bwd %s window %s"
+                % (eqn.invars[1].aval.str_short(),
+                   "x".join(str(d) for d in
+                            eqn.params["window_dimensions"])),
+                flops=fl, hbm_bytes=by))
+        return sites
 
     def _match(self, eqn) -> bool:
         if eqn.primitive.name != "select_and_scatter_add":
@@ -861,9 +1188,13 @@ class MaxPoolBwdMaskPass(GraftPass):
 
         hits = [0]
         shift = self._shift_mask
+        walk = _SiteWalk()
 
         def rule(eqn, invals):
-            if not self._match(eqn):
+            if eqn.primitive.name != "select_and_scatter_add":
+                return None
+            sid = walk.sid("select_and_scatter_add")
+            if not self._match(eqn) or not _site_on(ctx, sid):
                 return None
             source, operand = invals
             p = eqn.params
@@ -1029,6 +1360,22 @@ def resolve_passes(value=None) -> Tuple[GraftPass, ...]:
     return tuple(out)
 
 
+def resolve_schedule(value=None):
+    """The shared ``passes=`` resolution, schedule-aware: returns
+    ``(passes_tuple, schedule_or_None)``.  A :class:`PassSchedule` (or
+    its canonical dict form, recognized by the ``"passes"`` key) pins
+    both the pass order and the per-site decision vectors; anything
+    else goes through :func:`resolve_passes` with schedule ``None`` —
+    the legacy whole-pass path, equivalent to every site on."""
+    if isinstance(value, PassSchedule):
+        sched = value
+    elif isinstance(value, dict) and "passes" in value:
+        sched = PassSchedule.from_dict(value)
+    else:
+        return resolve_passes(value), None
+    return tuple(get_pass(n) for n in sched.pass_names()), sched
+
+
 # ---------------------------------------------------------------------------
 # the manager
 # ---------------------------------------------------------------------------
@@ -1043,9 +1390,16 @@ class PassManager:
     original.  ``raise_on_error=False`` collects instead (the CLI's
     report-everything mode)."""
 
-    def __init__(self, passes, *, device: str = "tpu-v5e",
+    def __init__(self, passes, *, schedule=None, device: str = "tpu-v5e",
                  n_devices: int = 1, raise_on_error: bool = True):
-        self.passes = resolve_passes(passes)
+        if schedule is not None and not isinstance(schedule, PassSchedule):
+            schedule = PassSchedule.from_dict(schedule)
+        if passes is None and schedule is not None:
+            self.passes = tuple(get_pass(n)
+                                for n in schedule.pass_names())
+        else:
+            self.passes = resolve_passes(passes)
+        self.schedule = schedule
         self.device = device
         self.n_devices = max(int(n_devices), 1)
         self.raise_on_error = bool(raise_on_error)
@@ -1142,6 +1496,54 @@ class PassManager:
 
         warnings.warn("graftpass: %s" % diag.format(), stacklevel=4)
 
+    @staticmethod
+    def _site_rows(sites, site_vec, excluded, receipt,
+                   installed: bool):
+        """Per-site receipt rows (``PassReceipt.sites``).  The whole-
+        pass gate-3 delta is distributed over the sites the rewrite
+        actually touched, proportionally to each site's local unfused
+        weight (``cost_model.eqn_site_weight``) — so the rows sum to
+        the receipt's before/after delta exactly, by construction."""
+        if not sites:
+            return None
+        excluded = excluded or {}
+        on = [s for s in sites
+              if (site_vec is None or s.id in site_vec)
+              and s.id not in excluded]
+
+        def shares(weights):
+            tot = float(sum(weights))
+            if tot > 0:
+                return [w / tot for w in weights]
+            n = max(len(weights), 1)
+            return [1.0 / n] * len(weights)
+
+        f_share = shares([s.flops for s in on])
+        b_share = shares([s.hbm_bytes for s in on])
+        pos = {s.id: j for j, s in enumerate(on)}
+        d_fl = receipt.flops_after - receipt.flops_before
+        d_by = receipt.hbm_bytes_after - receipt.hbm_bytes_before
+        d_pb = receipt.param_bytes_after - receipt.param_bytes_before
+        rows = []
+        for s in sites:
+            j = pos.get(s.id)
+            inst = bool(installed and j is not None)
+            rows.append({
+                "site": s.id, "kind": s.kind, "detail": s.detail,
+                "decision": bool(site_vec is None or s.id in site_vec),
+                "excluded": excluded.get(s.id),
+                "installed": inst,
+                "flops_delta": d_fl * f_share[j] if inst else 0.0,
+                "hbm_bytes_delta": d_by * b_share[j] if inst else 0.0,
+                "param_bytes_delta": d_pb * b_share[j] if inst else 0.0,
+                "contract": receipt.contract,
+                # True: the installed rewrite passed the gate-4 probe;
+                # None: probe skipped (probe="off") or site untouched
+                "probe_ok": (True if inst and receipt.probe is not None
+                             else None),
+            })
+        return rows
+
     # -- the pipeline --------------------------------------------------
     def run(self, closed_jaxpr, ctx: Optional[PassContext] = None
             ) -> PipelineResult:
@@ -1156,6 +1558,7 @@ class PassManager:
         pre_lint: Optional[Dict[str, int]] = None
         pre_cost = self._cost(cur, ctx)
         cur_ctx = ctx
+        sched = self.schedule
         for p in self.passes:
             receipt = PassReceipt(name=p.name,
                                   contract=p.contract.describe(),
@@ -1165,29 +1568,61 @@ class PassManager:
                                   param_bytes_before=self._param_bytes(
                                       cur, cur_ctx.param_invars))
             result.receipts.append(receipt)
-            res = p.run(cur, cur_ctx)
+            receipt.flops_after = receipt.flops_before
+            receipt.hbm_bytes_after = receipt.hbm_bytes_before
+            receipt.peak_bytes_after = receipt.peak_bytes_before
+            receipt.param_bytes_after = receipt.param_bytes_before
+            site_vec = sched.sites_for(p.name) if sched else None
+            if sched is not None and not sched.enabled(p.name):
+                # every site off is a deliberate decision, not a silent
+                # no-op — record it and move on (no GL304)
+                receipt.notes = "disabled by schedule"
+                continue
+            sites = (p.enumerate_sites(cur, cur_ctx)
+                     if p.site_aware else [])
+            ctx_p = (_dc_replace(cur_ctx, sites=site_vec)
+                     if site_vec is not None else cur_ctx)
+            res = p.run(cur, ctx_p)
             if res is not None:
                 # pass-emitted advisories (amp_bf16's GL403 exclusions)
                 # and the precision verdict ride the receipt either way
                 receipt.diagnostics.extend(res.diagnostics)
                 result.diagnostics.extend(res.diagnostics)
                 receipt.precision = res.precision
+            receipt.sites = self._site_rows(
+                sites, site_vec,
+                res.excluded_sites if res is not None else {},
+                receipt, installed=False)
             if res is None or res.hits == 0:
                 receipt.notes = res.notes if res else "no rewrite target"
-                receipt.flops_after = receipt.flops_before
-                receipt.hbm_bytes_after = receipt.hbm_bytes_before
-                receipt.peak_bytes_after = receipt.peak_bytes_before
-                receipt.param_bytes_after = receipt.param_bytes_before
+                # GL304: the caller named this pass and it changed
+                # NOTHING — unless the pass itself explained why (the
+                # GL403 range gate), the composition silently reads as
+                # "optimized" while being a no-op
+                explained = res is not None and bool(res.diagnostics
+                                                     or res.excluded_sites)
+                if not explained:
+                    n_on = len([s for s in sites if site_vec is None
+                                or s.id in site_vec])
+                    self._refuse(receipt, Diagnostic(
+                        "GL304", Severity.WARNING,
+                        "pass %r matched zero sites — %s; the "
+                        "composition is a silent no-op here"
+                        % (p.name,
+                           "the schedule enabled %d of %d reported "
+                           "site(s)" % (n_on, len(sites)) if sites
+                           else "no applicable site in the program"),
+                        where=ctx.where,
+                        hint="drop the pass from passes=/MXTPU_PASSES "
+                             "or fix the schedule's site ids"),
+                        result.diagnostics)
                 continue
             receipt.changed = True
             receipt.hits = res.hits
             receipt.notes = res.notes
-            # refusal paths keep the original program, so "after" is
-            # "before" until the cost gate measures the real rewrite
-            receipt.flops_after = receipt.flops_before
-            receipt.hbm_bytes_after = receipt.hbm_bytes_before
-            receipt.peak_bytes_after = receipt.peak_bytes_before
-            receipt.param_bytes_after = receipt.param_bytes_before
+            # refusal paths keep the original program, so "after" stays
+            # "before" (set above) until the cost gate measures the
+            # real rewrite
             # invar policy: one splitting pass per pipeline, and only
             # where the caller can re-map its stored values
             if res.invar_splits:
@@ -1289,6 +1724,9 @@ class PassManager:
                 continue
             # install
             receipt.installed = True
+            receipt.sites = self._site_rows(
+                sites, site_vec, res.excluded_sites, receipt,
+                installed=True)
             cur = res.closed_jaxpr
             pre_lint = post_lint
             pre_cost = post_cost

@@ -389,7 +389,11 @@ def compile_timed(traced, t_trace: float = 0.0, *,
     compiles, bumps :data:`XLA_COMPILES` and stores the result
     (``cache: "stored"``, or ``"store-failed"`` when serialization is
     unavailable).  ``cache_extra`` feeds the key — pass mesh shape +
-    axis names and builder knobs so distinct configs can never collide.
+    axis names and builder knobs so distinct configs can never collide;
+    graftsched callers (TrainStep/ServeEngine) include the canonical
+    ``PassSchedule`` hash here, so two schedules of the same program
+    never share an executable while the SAME schedule cross-process
+    hits at zero XLA compiles.
     """
     t0 = time.time()
     lowered = traced.lower()

@@ -418,9 +418,12 @@ class TrainStep:
         # > ().  Invar-changing passes (quantize) no-op here — a train
         # step's params are donated and updated in place, so the
         # PassContext advertises no quantizable param invars.
-        from ..analysis.passes import resolve_passes as _resolve_passes
+        # ``passes=`` also accepts a PassSchedule (or its canonical
+        # dict), pinning a per-site decision vector (graftsched); a
+        # plain pass list is the all-sites schedule, bitwise-equivalent
+        from ..analysis.passes import resolve_schedule as _resolve_schedule
 
-        self._passes = _resolve_passes(passes)
+        self._passes, self._schedule = _resolve_schedule(passes)
         #: flat-aval signature -> (rewritten ClosedJaxpr, out treedef,
         #: probe-verified flag)
         self._pass_programs: Dict[tuple, tuple] = {}
@@ -981,41 +984,12 @@ class TrainStep:
 
     # ------------------------------------------------------------------
     # graftpass (analysis/passes.py, docs/PASSES.md)
-    def _maybe_apply_passes(self, example_args, probe=True):
-        """Run the configured pass pipeline over the traced step for
-        this argument signature and install the verified rewrite as the
-        program that compiles.  Idempotent per flat-aval signature; the
-        contract gates (GL301/GL302) raise BEFORE any compile, so a
-        refused rewrite costs zero executables.  The rewritten step
-        keeps the exact invar layout, donation spec and shardings —
-        invar-changing passes are refused here by construction.
-
-        ``probe=False`` skips the concrete probe (abstract eval,
-        re-lint and cost receipts still gate) — the cheap ranking mode
-        ``analyze_cost`` uses so the autotuner's zero-compile phase
-        never pays two eager step executions per candidate.  A program
-        ranked that way is RE-verified with the probe the first time a
-        run path (``__call__``/``aot_compile``/``run_steps``) asks for
-        it: nothing unprobed ever compiles."""
-        if not self._passes:
-            return
-        # hot-path fast key: only the batch args vary between calls on
-        # one step instance (params/opt-state/scaler avals are pinned
-        # at build), so a verified (x, y) signature skips the full
-        # O(n_leaves) flatten every subsequent step would otherwise pay
-        x_ex, y_ex = example_args[3], example_args[4]
-        fast = (tuple(x_ex.shape), str(x_ex.dtype),
-                tuple(y_ex.shape), str(y_ex.dtype))
-        if fast in self._pass_fast_verified:
-            return
-        flat = jax.tree_util.tree_leaves(tuple(example_args))
-        sig = tuple((tuple(v.shape), str(v.dtype)) for v in flat)
-        entry = self._pass_programs.get(sig)
-        if entry is not None and (entry[2] or not probe):
-            if entry[2]:
-                self._pass_fast_verified.add(fast)
-            return
-        from ..analysis.passes import PassContext, PassManager
+    def _pass_pipeline_inputs(self, example_args, probe=True):
+        """The ONE trace-and-context block behind both pipeline
+        entrances (`_maybe_apply_passes` installs, `analyze_schedule`
+        reports): returns ``(traced, ctx, n_dev, multihost)`` for the
+        step's argument signature."""
+        from ..analysis.passes import PassContext
         from ..analysis.trace_lint import donated_leaf_indices
         from .aot import traced_with_effects
         from .mesh import spans_processes
@@ -1051,8 +1025,48 @@ class TrainStep:
             numerics=self.numerics,
             input_ranges=num_seeds,
             where="fused train step")
-        mgr = PassManager(self._passes, device=self.cost_device,
-                          n_devices=n_dev)
+        return traced, ctx, n_dev, multihost
+
+    def _maybe_apply_passes(self, example_args, probe=True):
+        """Run the configured pass pipeline over the traced step for
+        this argument signature and install the verified rewrite as the
+        program that compiles.  Idempotent per flat-aval signature; the
+        contract gates (GL301/GL302) raise BEFORE any compile, so a
+        refused rewrite costs zero executables.  The rewritten step
+        keeps the exact invar layout, donation spec and shardings —
+        invar-changing passes are refused here by construction.
+
+        ``probe=False`` skips the concrete probe (abstract eval,
+        re-lint and cost receipts still gate) — the cheap ranking mode
+        ``analyze_cost`` uses so the autotuner's zero-compile phase
+        never pays two eager step executions per candidate.  A program
+        ranked that way is RE-verified with the probe the first time a
+        run path (``__call__``/``aot_compile``/``run_steps``) asks for
+        it: nothing unprobed ever compiles."""
+        if not self._passes:
+            return
+        # hot-path fast key: only the batch args vary between calls on
+        # one step instance (params/opt-state/scaler avals are pinned
+        # at build), so a verified (x, y) signature skips the full
+        # O(n_leaves) flatten every subsequent step would otherwise pay
+        x_ex, y_ex = example_args[3], example_args[4]
+        fast = (tuple(x_ex.shape), str(x_ex.dtype),
+                tuple(y_ex.shape), str(y_ex.dtype))
+        if fast in self._pass_fast_verified:
+            return
+        flat = jax.tree_util.tree_leaves(tuple(example_args))
+        sig = tuple((tuple(v.shape), str(v.dtype)) for v in flat)
+        entry = self._pass_programs.get(sig)
+        if entry is not None and (entry[2] or not probe):
+            if entry[2]:
+                self._pass_fast_verified.add(fast)
+            return
+        from ..analysis.passes import PassManager
+
+        traced, ctx, n_dev, multihost = self._pass_pipeline_inputs(
+            example_args, probe=probe)
+        mgr = PassManager(self._passes, schedule=self._schedule,
+                          device=self.cost_device, n_devices=n_dev)
         result = mgr.run(traced.jaxpr, ctx)
         self.pass_receipts = result.receipts
         out_tree = jax.tree_util.tree_structure(traced.out_info)
@@ -1315,12 +1329,10 @@ class TrainStep:
                                + rep.format(Severity.WARNING),
                                stacklevel=4)
 
-    def analyze_cost(self, x, y, device=None, hbm_budget=None):
-        """Cost the step for the given batch WITHOUT compiling or
-        running it: traces abstractly (``jit.trace`` on avals — the
-        trace the first real call would reuse) and returns the
-        :class:`~..analysis.cost_model.CostReport`.  ``x``/``y`` may be
-        arrays, NDArrays or ``jax.ShapeDtypeStruct``s."""
+    def _analysis_args(self, x, y):
+        """The step's abstract 8-tuple argument signature for the given
+        batch — the zero-compile analysis entrances (`analyze_cost`,
+        `analyze_schedule`) share it."""
         self._ensure_built()
 
         def aval(a):
@@ -1333,9 +1345,35 @@ class TrainStep:
         pv = [aval(p._data._data) for p in self._gp]
         av = [aval(p._data._data) for p in self._aux]
         sv = jax.tree_util.tree_map(aval, self._opt_state)
-        args = (pv, av, sv, aval(x), aval(y), aval(self._key_dev),
+        return (pv, av, sv, aval(x), aval(y), aval(self._key_dev),
                 aval(self._step_dev),
                 tuple(aval(v) for v in self._scaler_dev))
+
+    def analyze_schedule(self, x, y):
+        """Run the configured pass pipeline over the traced step in
+        report-everything mode and return the
+        :class:`~..analysis.passes.PipelineResult` — per-site receipt
+        rows included — WITHOUT installing anything, compiling
+        anything, or raising on refusals.  ONE abstract trace; the
+        autotuner's site table (``autotune.schedule_site_table``) is
+        built from exactly this."""
+        from ..analysis.passes import PassManager
+
+        args = self._analysis_args(x, y)
+        traced, ctx, n_dev, _multihost = self._pass_pipeline_inputs(
+            args, probe=False)
+        mgr = PassManager(self._passes, schedule=self._schedule,
+                          device=self.cost_device, n_devices=n_dev,
+                          raise_on_error=False)
+        return mgr.run(traced.jaxpr, ctx)
+
+    def analyze_cost(self, x, y, device=None, hbm_budget=None):
+        """Cost the step for the given batch WITHOUT compiling or
+        running it: traces abstractly (``jit.trace`` on avals — the
+        trace the first real call would reuse) and returns the
+        :class:`~..analysis.cost_model.CostReport`.  ``x``/``y`` may be
+        arrays, NDArrays or ``jax.ShapeDtypeStruct``s."""
+        args = self._analysis_args(x, y)
         # with a pass pipeline configured the costed program is the
         # REWRITTEN one — what would actually compile (post-pass cost,
         # the autotuner's ranking signal for `--passes` candidates).
@@ -1603,6 +1641,20 @@ class TrainStep:
                         yv, self.mesh, batch_sh.spec))
         return jax.device_put(xv, batch_sh), jax.device_put(yv, batch_sh)
 
+    @property
+    def schedule_hash(self):
+        """Canonical hash of the active pass schedule (graftsched,
+        analysis/passes.py::PassSchedule) — the legacy whole-pass list
+        hashes as its all-sites schedule, so the same decisions always
+        key the same; None with no passes configured."""
+        from ..analysis.passes import PassSchedule
+
+        if self._schedule is not None:
+            return self._schedule.hash()
+        if not self._passes:
+            return None
+        return PassSchedule.from_passes(self._passes).hash()
+
     def _cache_extra(self):
         """This step's contribution to the compile-cache key (beyond the
         lowered program itself): mesh shape + axis names and the knob
@@ -1616,7 +1668,9 @@ class TrainStep:
                 self.opt.name, bool(self.opt.multi_precision),
                 str(self.compute_dtype), self.nonfinite,
                 self._dynamic_scale,
-                tuple(p.name for p in self._passes))
+                tuple(p.name for p in self._passes),
+                # graftsched: two schedules never share an executable
+                ("sched", self.schedule_hash))
 
     def aot_compile(self, x, y, cache=None):
         """Ahead-of-time trace + lower + compile the fused step for the given

@@ -137,12 +137,22 @@ class ServeEngine:
         # sugar for prepending it (the engine-private quantization
         # branch this replaced lives on only as the (codes, amax)
         # value layout the pass's transform produces).
-        from ..analysis.passes import get_pass, resolve_passes
+        from ..analysis.passes import get_pass, resolve_schedule
 
-        self.passes = resolve_passes(passes)
+        # ``passes=`` also accepts a PassSchedule / canonical schedule
+        # dict (graftsched) pinning per-site decisions
+        self.passes, self._schedule = resolve_schedule(passes)
         if self._int8 and not any(p.name == "quantize_int8"
                                   for p in self.passes):
             self.passes = (get_pass("quantize_int8"),) + self.passes
+            if self._schedule is not None:
+                from ..analysis.passes import PassSchedule
+
+                # the sugar rides the schedule too: prepend the pass
+                # with every site on
+                self._schedule = PassSchedule(
+                    (("quantize_int8", True),)
+                    + tuple(self._schedule.entries))
         #: program key -> list of PassReceipt (the per-bucket stamps)
         self.pass_receipts: Dict[tuple, Any] = {}
         self._pass_result = None   # first bucket's PipelineResult
@@ -551,6 +561,19 @@ class ServeEngine:
             avals.append(jax.ShapeDtypeStruct(shape, d))
         return avals
 
+    @property
+    def schedule_hash(self):
+        """Canonical hash of the active pass schedule (graftsched) —
+        a plain pass list hashes as its all-sites schedule; None with
+        no passes configured."""
+        from ..analysis.passes import PassSchedule
+
+        if self._schedule is not None:
+            return self._schedule.hash()
+        if not self.passes:
+            return None
+        return PassSchedule.from_passes(self.passes).hash()
+
     def _build_pass_program(self, key, bucket):
         """The pass-pipeline build: trace the base (float-param)
         program, lint it, run the verified rewrite pipeline (receipts in
@@ -604,8 +627,8 @@ class ServeEngine:
             numerics=self.numerics,
             input_ranges=num_seeds,
             where="ServeEngine(%s, bucket=%d)" % (self.net.name, bucket))
-        mgr = PassManager(self.passes, device=self.cost_device,
-                          n_devices=n_dev)
+        mgr = PassManager(self.passes, schedule=self._schedule,
+                          device=self.cost_device, n_devices=n_dev)
         result = mgr.run(traced.jaxpr, ctx)
         self.pass_receipts[key] = result.receipts
         if self.numerics != "off" and self.range_report is None:
@@ -659,7 +682,9 @@ class ServeEngine:
         prog, times = compile_timed(
             traced2, t_trace=time.time() - t0,
             cache_extra=("serve_engine", mesh_desc, key,
-                         tuple(p.name for p in self.passes)))
+                         tuple(p.name for p in self.passes),
+                         # graftsched: schedules never share a program
+                         ("sched", self.schedule_hash)))
         self._programs[key] = prog
         self.compile_log[key] = times
         return prog

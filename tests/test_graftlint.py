@@ -724,3 +724,165 @@ def test_cli_sarif_format(tmp_path, capsys):
     assert rc == 0
     _validate_sarif_2_1_0(log)
     assert log["runs"][0]["results"] == []
+
+
+# ---------------------------------------------------------------------------
+# GL304 — zero-site pass composition (graftsched, docs/ANALYSIS.md)
+# ---------------------------------------------------------------------------
+
+def test_gl304_cataloged():
+    from incubator_mxnet_tpu.analysis import CODES
+
+    sev, text = CODES["GL304"]
+    assert sev == Severity.WARNING
+    assert "zero sites" in text
+
+
+def test_gl304_fires_on_zero_site_pass():
+    """A pass named in passes= that matches nothing in the program is a
+    silent no-op — GL304 warns; an explicitly schedule-disabled pass is
+    a deliberate decision and stays quiet."""
+    import warnings
+
+    import numpy as np
+
+    import jax
+
+    from incubator_mxnet_tpu.analysis.passes import (PassContext,
+                                                     PassManager,
+                                                     PassSchedule)
+
+    cj = jax.make_jaxpr(lambda a, b: a @ b)(
+        jax.ShapeDtypeStruct((8, 8), np.float32),
+        jax.ShapeDtypeStruct((8, 8), np.float32))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        res = PassManager(["space_to_depth"],
+                          raise_on_error=False).run(cj, PassContext())
+    assert any(d.code == "GL304" for d in res.diagnostics)
+    assert any("GL304" in str(x.message) for x in w)
+    assert not res.receipts[0].installed  # still a clean no-op
+    # disabled-by-schedule: no GL304 (the decision is on the record)
+    sched = PassSchedule([("space_to_depth", False)])
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        res = PassManager(None, schedule=sched,
+                          raise_on_error=False).run(cj, PassContext())
+    assert not any(d.code == "GL304" for d in res.diagnostics)
+    assert "disabled by schedule" in (res.receipts[0].notes or "")
+
+
+def test_gl304_rides_graftpass_cli_without_gating(capsys):
+    """GL304 is a WARNING: it lands in the CLI diagnostics but never
+    flips the exit code."""
+    import json
+
+    import pytest as _pytest
+
+    import tools.graftpass as gp
+
+    with _pytest.warns(UserWarning, match="GL304"):
+        rc = gp.main(["--model", "dense", "--passes", "space_to_depth",
+                      "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert any(d["code"] == "GL304" for d in out["diagnostics"])
+
+
+# ---------------------------------------------------------------------------
+# graftpass --schedule / --list-sites / --format sarif (graftsched CLI)
+# ---------------------------------------------------------------------------
+
+def test_graftpass_cli_list_sites(capsys):
+    import json
+
+    import tools.graftpass as gp
+
+    rc = gp.main(["--model", "dense",
+                  "--passes", "amp_bf16,quantize_int8,cse_dead_aux",
+                  "--list-sites", "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    by_pass = {}
+    for r in out["sites"]:
+        by_pass.setdefault(r["pass"], []).append(r)
+    assert [r["site"] for r in by_pass["amp_bf16"]] == ["dot_general:0",
+                                                        "dot_general:1"]
+    assert all(r["site"].startswith("invar:")
+               for r in by_pass["quantize_int8"])
+    # whole-program passes report exactly that, not an empty listing
+    assert by_pass["cse_dead_aux"][0]["site"] is None
+    assert by_pass["cse_dead_aux"][0]["kind"] == "whole-program"
+
+
+def test_graftpass_cli_schedule_decisions_and_receipts(tmp_path, capsys):
+    import json
+
+    import tools.graftpass as gp
+    from incubator_mxnet_tpu.analysis.passes import PassSchedule
+
+    sched = PassSchedule([("amp_bf16", {"dot_general:0": True,
+                                        "dot_general:1": False})])
+    f = tmp_path / "sched.json"
+    f.write_text(sched.to_json())
+    rc = gp.main(["--model", "dense", "--schedule", str(f),
+                  "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["schedule"]["hash"] == sched.hash()
+    (amp,) = out["passes"]
+    rows = {r["site"]: r for r in amp["sites"]}
+    assert rows["dot_general:0"]["decision"] is True
+    assert rows["dot_general:0"]["installed"] is True
+    assert rows["dot_general:1"]["decision"] is False
+    assert rows["dot_general:1"]["installed"] is False
+    # a malformed schedule file is a usage error, not a crash
+    bad = tmp_path / "bad.json"
+    bad.write_text("{\"nope\": 1}")
+    assert gp.main(["--model", "dense", "--schedule", str(bad)]) == 2
+
+
+def test_graftpass_cli_schedule_exit_1_on_refused_site(tmp_path, capsys):
+    """A schedule enabling a GL301-refused rewrite exits 1 — the CI
+    gate shape."""
+    import json
+
+    import pytest as _pytest
+
+    import tools.graftpass as gp
+    from incubator_mxnet_tpu.analysis.passes import (PASS_REGISTRY,
+                                                     PassSchedule,
+                                                     register_pass)
+    from tests.test_passes import _ValueBreaker
+
+    register_pass("_test_sched_breaker", _ValueBreaker())
+    try:
+        f = tmp_path / "sched.json"
+        f.write_text(PassSchedule(
+            [("_test_sched_breaker", True)]).to_json())
+        with _pytest.warns(UserWarning, match="GL301"):
+            rc = gp.main(["--model", "dense", "--schedule", str(f),
+                          "--format", "json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert any(d["code"] == "GL301" for d in out["diagnostics"])
+    finally:
+        PASS_REGISTRY.pop("_test_sched_breaker", None)
+
+
+def test_graftpass_cli_sarif_format(capsys):
+    import json
+
+    import pytest as _pytest
+
+    import tools.graftpass as gp
+
+    with _pytest.warns(UserWarning, match="GL304"):
+        rc = gp.main(["--model", "dense", "--passes", "space_to_depth",
+                      "--format", "sarif"])
+    log = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    _validate_sarif_2_1_0(log)
+    results = log["runs"][0]["results"]
+    assert any(r["ruleId"] == "GL304" and r["level"] == "warning"
+               for r in results)

@@ -160,6 +160,8 @@ def _format_table(res):
                 return v > 1
             if k == "passes":
                 return bool(v)
+            if k == "schedule":
+                return False  # the hash stands in for the full dict
             return v not in (None, False)
 
         knobs = " ".join("%s=%s" % (k, v)
@@ -178,7 +180,12 @@ def _format_table(res):
     if res.winner is not None:
         lines.append("winner: %s" % json.dumps(res.winner.knobs))
     else:
-        lines.append("winner: NONE (no candidate was measurable)")
+        best = res.best_predicted()
+        if best is not None and res.budget_compiles == 0:
+            lines.append("winner (predicted, budget 0): schedule_hash=%s"
+                         % best.knobs.get("schedule_hash", "-"))
+        else:
+            lines.append("winner: NONE (no candidate was measurable)")
     return "\n".join(lines)
 
 
@@ -187,7 +194,12 @@ def main(argv=None) -> int:
         prog="autotune", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("--target", default="train",
-                    choices=["train", "serve"])
+                    choices=["train", "train-schedule", "serve"],
+                    help="train-schedule: the graftsched per-site "
+                         "search — ranks PassSchedule candidates over "
+                         "--passes from ONE abstract site table "
+                         "(analysis/autotune.py::"
+                         "autotune_train_schedules)")
     ap.add_argument("--model", default="dense",
                     choices=["dense", "conv-bn", "resnet50"],
                     help="train-target workload; the serve target "
@@ -202,7 +214,13 @@ def main(argv=None) -> int:
                          "graftpass.py --list): each becomes an on/off "
                          "knob in the train search space, ranked by the "
                          "post-pass CostReport; GL201/GL301-rejected "
-                         "candidates cost zero compiles")
+                         "candidates cost zero compiles.  NOTE: under "
+                         "graftsched the on/off crossing is sugar for "
+                         "the all-sites/no-sites schedule pair of each "
+                         "pass (kept so existing tuning logs stay "
+                         "comparable); per-site search is "
+                         "--target train-schedule, which deprecates "
+                         "this whole-program mode")
     ap.add_argument("--numerics", default="off",
                     choices=["off", "warn", "error"],
                     help="graftrange value-range gate per candidate "
@@ -250,10 +268,9 @@ def main(argv=None) -> int:
     import jax
 
     from incubator_mxnet_tpu.analysis import DEVICE_SPECS
-    from incubator_mxnet_tpu.analysis.autotune import (autotune_serve,
-                                                       autotune_train,
-                                                       default_train_space,
-                                                       dense_workload)
+    from incubator_mxnet_tpu.analysis.autotune import (
+        autotune_serve, autotune_train, autotune_train_schedules,
+        default_train_space, dense_workload)
 
     if args.device not in DEVICE_SPECS:
         raise SystemExit("unknown --device %r (registry: %s)"
@@ -265,7 +282,7 @@ def main(argv=None) -> int:
 
         mesh = make_mesh(mesh_axes, devices=jax.devices()[:ndev])
 
-    if args.target == "train":
+    if args.target in ("train", "train-schedule"):
         if args.model == "dense":
             make_net, make_batch, loss_fn = dense_workload()
         elif args.model == "conv-bn":
@@ -280,16 +297,30 @@ def main(argv=None) -> int:
             for n in pass_names:
                 get_pass(n)  # fail fast on unknown names
         batches = tuple(int(b) for b in args.batches.split(",") if b)
-        space = default_train_space(mesh_axes, batches=batches,
-                                    passes=pass_names)
-        res = autotune_train(make_net, make_batch, loss_fn, space=space,
-                             mesh=mesh, device=args.device,
-                             hbm_budget=budget,
-                             budget_compiles=args.budget_compiles,
-                             warmup=args.warmup, iters=args.iters,
-                             numerics=args.numerics,
-                             input_range=args.input_range,
-                             log_path=args.out)
+        if args.target == "train-schedule":
+            if not pass_names:
+                raise SystemExit("--target train-schedule needs "
+                                 "--passes to build the site table")
+            res = autotune_train_schedules(
+                make_net, make_batch, loss_fn, passes=pass_names,
+                knobs={"batch": batches[0]}, mesh=mesh,
+                device=args.device, hbm_budget=budget,
+                budget_compiles=args.budget_compiles,
+                warmup=args.warmup, iters=args.iters,
+                numerics=args.numerics, input_range=args.input_range,
+                log_path=args.out)
+        else:
+            space = default_train_space(mesh_axes, batches=batches,
+                                        passes=pass_names)
+            res = autotune_train(make_net, make_batch, loss_fn,
+                                 space=space,
+                                 mesh=mesh, device=args.device,
+                                 hbm_budget=budget,
+                                 budget_compiles=args.budget_compiles,
+                                 warmup=args.warmup, iters=args.iters,
+                                 numerics=args.numerics,
+                                 input_range=args.input_range,
+                                 log_path=args.out)
     else:
         import incubator_mxnet_tpu as mx
         from incubator_mxnet_tpu import nd
@@ -311,17 +342,24 @@ def main(argv=None) -> int:
     else:
         print(_format_table(res))
 
-    if args.winner_out and res.winner is not None:
+    # schedule searches at --budget-compiles 0 are pure zero-compile
+    # ranking: the best PREDICTED schedule is the (hash-stamped) winner
+    winner_cfg = res.winner_config()
+    if args.winner_out and winner_cfg is not None:
         tmp = args.winner_out + ".tmp"
         with open(tmp, "w") as f:
-            json.dump(res.winner_config(), f, indent=2)
+            json.dump(winner_cfg, f, indent=2)
         os.replace(tmp, args.winner_out)
         print("winner config -> %s" % args.winner_out, file=sys.stderr)
 
     if not res.accounted():
         print("autotune: tuning log does not account for every candidate",
               file=sys.stderr)
-    return 0 if res.winner is not None else 1
+    if res.winner is not None:
+        return 0
+    if args.target == "train-schedule" and args.budget_compiles == 0:
+        return 0 if winner_cfg is not None else 1
+    return 1
 
 
 if __name__ == "__main__":

@@ -20,6 +20,14 @@ Exit status 1 on a contract violation (GL301) or re-lint failure
      [<receipt>...], "diagnostics": [<Diagnostic>...],
      "summary": {"installed": n, "refused": n, "errors": n}}
 
+Under graftsched, ``--schedule FILE`` replaces the on/off ``--passes``
+list with a per-site decision vector (the canonical JSON the
+train-schedule autotuner persists under ``knobs.schedule``); receipts
+then carry one row per site — decision, installed/excluded verdict,
+attributed FLOPs/HBM deltas.  ``--list-sites`` prints the addressable
+sites of the traced model, and ``--format sarif`` emits the receipts'
+diagnostics in the SARIF 2.1.0 shape ``tools/graftlint.py`` defined.
+
 Usage::
 
     python tools/graftpass.py --list
@@ -28,6 +36,9 @@ Usage::
         --batch 8 --format json
     python tools/graftpass.py --model resnet50 --passes space_to_depth \
         --no-probe
+    python tools/graftpass.py --model conv-bn --passes amp_bf16 --list-sites
+    python tools/graftpass.py --model conv-bn --schedule winner.json \
+        --format sarif
 """
 from __future__ import annotations
 
@@ -176,8 +187,18 @@ def main(argv=None) -> int:
                          "the model's initialized params only)")
     ap.add_argument("--device", default="tpu-v5e",
                     help="graftcost roofline device-spec registry key")
+    ap.add_argument("--schedule", default=None, metavar="FILE",
+                    help="JSON PassSchedule (the canonical dict "
+                         "autotune's train-schedule winner carries "
+                         "under knobs.schedule): per-site decisions "
+                         "replace the --passes on/off list; receipts "
+                         "report every site's decision + verdict")
+    ap.add_argument("--list-sites", action="store_true",
+                    help="enumerate the applicable sites of --passes/"
+                         "--schedule on the traced model and exit "
+                         "(the addressing a schedule's site ids use)")
     ap.add_argument("--format", dest="fmt", default="table",
-                    choices=["table", "json"])
+                    choices=["table", "json", "sarif"])
     args = ap.parse_args(argv)
 
     if args.list:
@@ -185,7 +206,8 @@ def main(argv=None) -> int:
 
     from incubator_mxnet_tpu.analysis import LintError, Severity
     from incubator_mxnet_tpu.analysis.passes import (PassContext,
-                                                     PassManager)
+                                                     PassManager,
+                                                     PassSchedule)
 
     numerics = args.numerics or ("warn" if args.ranges else "off")
     closed, seeds, labels, net, params, p_vals, sample_shape = \
@@ -200,9 +222,46 @@ def main(argv=None) -> int:
         numerics=numerics,
         input_ranges=input_ranges,
         where="graftpass CLI (%s)" % args.model)
+    schedule = None
+    if args.schedule:
+        try:
+            with open(args.schedule) as f:
+                schedule = PassSchedule.from_dict(json.load(f))
+        except (OSError, ValueError, KeyError) as e:
+            print("graftpass: --schedule %s: %s" % (args.schedule, e),
+                  file=sys.stderr)
+            return 2
     try:
-        mgr = PassManager(args.passes, device=args.device,
+        mgr = PassManager(None if schedule is not None else args.passes,
+                          schedule=schedule, device=args.device,
                           raise_on_error=False)
+        if args.list_sites:
+            rows = []
+            for p in mgr.passes:
+                sites = (p.enumerate_sites(closed, ctx)
+                         if p.site_aware else [])
+                for s in sites:
+                    rows.append({"pass": p.name, "site": s.id,
+                                 "kind": s.kind, "detail": s.detail,
+                                 "flops": s.flops,
+                                 "hbm_bytes": s.hbm_bytes})
+                if not sites:
+                    rows.append({"pass": p.name, "site": None,
+                                 "kind": "whole-program"
+                                 if not p.site_aware else "none",
+                                 "detail": "", "flops": 0.0,
+                                 "hbm_bytes": 0.0})
+            if args.fmt == "table":
+                for r in rows:
+                    print("%-16s %-24s %-14s %s"
+                          % (r["pass"], r["site"] or "-", r["kind"],
+                             r["detail"]))
+            else:
+                print(json.dumps({"version": 1, "tool": "graftpass",
+                                  "model": args.model,
+                                  "batch": args.batch, "sites": rows},
+                                 indent=2))
+            return 0
         result = mgr.run(closed, ctx)
     except (ValueError, LintError) as e:
         print("graftpass: %s" % e, file=sys.stderr)
@@ -217,12 +276,17 @@ def main(argv=None) -> int:
         range_report = analyze_ranges(closed,
                                       input_ranges=input_ranges,
                                       invar_labels=labels)
+    active_sched = schedule or (PassSchedule.from_passes(mgr.passes)
+                                if mgr.passes else None)
     payload = {
         "version": 1,
         "tool": "graftpass",
         "model": args.model,
         "batch": args.batch,
         "device": args.device,
+        "schedule": None if active_sched is None else {
+            "hash": active_sched.hash(),
+            "canonical": active_sched.canonical()},
         "passes": [r.to_dict() for r in result.receipts],
         "diagnostics": [d.to_dict() for d in result.diagnostics],
         "summary": {
@@ -233,7 +297,14 @@ def main(argv=None) -> int:
     }
     if range_report is not None:
         payload["ranges"] = range_report.to_dict()
-    if args.fmt == "json":
+    if args.fmt == "sarif":
+        # the PR-13 emitter: receipts' diagnostics as SARIF results,
+        # the shape CI code-scanning ingests (same schema graftlint
+        # --format sarif emits)
+        from tools.graftlint import to_sarif
+
+        print(json.dumps(to_sarif(list(result.diagnostics)), indent=2))
+    elif args.fmt == "json":
         print(json.dumps(payload, indent=2))
     else:
         print("graftpass[%s batch=%d]: %d pass(es), %d installed, "
@@ -254,6 +325,13 @@ def main(argv=None) -> int:
                 print("    probe: %s" % json.dumps(r.probe))
             if r.notes:
                 print("    %s" % r.notes)
+            for s in r.sites or ():
+                verdict = ("excluded: %s" % s["excluded"]
+                           if s["excluded"] else
+                           "installed" if s["installed"] else "skipped")
+                print("    site %-18s %-4s %+12.1f B  %s  %s"
+                      % (s["site"], "on" if s["decision"] else "off",
+                         s["hbm_bytes_delta"], verdict, s["detail"]))
         for d in result.diagnostics:
             print(d.format())
         if range_report is not None:
