@@ -120,7 +120,7 @@ def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
               record_format=".jpg", s2d_stem=False,
               ghost_bn=DEFAULT_GHOST_BN, passes=DEFAULT_PASSES, mesh_dp=0,
               zero=DEFAULT_ZERO, multi_precision=True, loss_scale="dynamic",
-              cost_device="tpu-v5e", proxy_extra=None):
+              cost_device="tpu-v5e", proxy_extra=None, schedule_config=None):
     jax = setup_jax()
     import numpy as np
 
@@ -131,6 +131,37 @@ def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
 
     log("devices: %s" % (jax.devices(),))
     mx.random.seed(0)
+    pass_names = tuple(s.strip() for s in (passes or "").split(",")
+                       if s.strip())
+    pass_arg = pass_names
+    sched_extra = {}
+    if schedule_config:
+        # graftsched winner (tools/autotune.py --target train-schedule
+        # --winner-out): knobs.schedule is the canonical PassSchedule
+        # dict make_train_step(passes=) accepts directly; the stamped
+        # schedule_hash is the cross-check that THIS step resolved the
+        # SAME per-site decision vector the tuner ranked
+        with open(schedule_config) as f:
+            win = json.load(f)
+        win_knobs = win.get("knobs", win)
+        sched = win_knobs.get("schedule")
+        if not isinstance(sched, dict) or "passes" not in sched:
+            raise ValueError("--schedule-config %s has no knobs.schedule "
+                             "canonical dict (run tools/autotune.py "
+                             "--target train-schedule --winner-out)"
+                             % schedule_config)
+        pass_arg = sched
+        pass_names = tuple(e["name"] for e in sched["passes"])
+        sched_extra = {"schedule_source": os.path.basename(schedule_config),
+                       "schedule_hash_winner":
+                       win_knobs.get("schedule_hash")}
+        log("schedule-config %s: %d-pass per-site schedule, winner hash "
+            "%s (tuner predicted %s s/sample on %s)"
+            % (schedule_config, len(pass_names),
+               win_knobs.get("schedule_hash"),
+               win.get("measured_s_per_sample"),
+               win.get("backend", "?")))
+
     t = time.time()
     # DEFAULT bench workload since round 19: the fully-composed byte
     # diet — fused ghost-BN ResNet (parallel/fused_bn.py, explicit
@@ -149,8 +180,6 @@ def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
     net.shape_init((1, 3, image_size, image_size))
     log("shape_init (abstract deferred init) %.1fs" % (time.time() - t))
 
-    pass_names = tuple(s.strip() for s in (passes or "").split(",")
-                       if s.strip())
     mesh = None
     if mesh_dp and mesh_dp > 1:
         from incubator_mxnet_tpu.parallel import make_mesh
@@ -172,7 +201,19 @@ def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
                            multi_precision=multi_precision,
                            loss_scale=loss_scale,
                            compute_dtype=compute_dtype, cost="report",
-                           cost_device=cost_device, passes=pass_names)
+                           cost_device=cost_device, passes=pass_arg)
+    if sched_extra:
+        want = sched_extra.get("schedule_hash_winner")
+        got = step.schedule_hash
+        if want and want != got:
+            # loud: a hash drift means the measured number belongs to a
+            # DIFFERENT schedule than the tuning log ranked
+            log("WARNING: schedule hash drift — winner config says %s, "
+                "the built step resolved %s" % (want, got))
+            sched_extra["schedule_hash_drift"] = True
+        else:
+            log("schedule %s stamped on the step (matches the winner "
+                "config)" % got)
 
     if data == "recordio":
         # recordio feeds raw uint8 batches (ImageRecordUInt8Iter) — compile
@@ -321,6 +362,7 @@ def run_train(batch_size=128, image_size=224, chunks=8, chunk_iters=5,
                  "compile_s": round(times["compile"], 1),
                  "chunks_done": c + 1}
         extra.update(pred)
+        extra.update(sched_extra)
         if proxy_extra:
             # CPU-proxy mode (TPU unreachable): the record says so
             # EXPLICITLY — relative numbers, never bare zeros that read
@@ -701,6 +743,13 @@ def main():
                     help="disable f32 master weights")
     ap.add_argument("--loss-scale", default="dynamic",
                     help="'dynamic' (default), a float, or 'off'")
+    ap.add_argument("--schedule-config", default=None,
+                    help="path to an autotune winner JSON (tools/"
+                         "autotune.py --target train-schedule "
+                         "--winner-out): the step is built with the "
+                         "winner's per-site PassSchedule instead of "
+                         "--passes, and its schedule_hash is stamped on "
+                         "every metric record")
     ap.add_argument("--no-config", action="store_true",
                     help="ignore bench_config.json (the composed round-19 "
                          "defaults still apply; add --ghost-bn 0 "
@@ -817,7 +866,8 @@ def main():
     knobs = dict(s2d_stem=s2d_stem, ghost_bn=ghost_bn, passes=passes,
                  mesh_dp=args.mesh_dp, zero=args.zero,
                  multi_precision=not args.no_multi_precision,
-                 loss_scale=loss_scale)
+                 loss_scale=loss_scale,
+                 schedule_config=args.schedule_config)
 
     if proxy_extra:
         # reduced proxy workload: same model/step wiring — INCLUDING

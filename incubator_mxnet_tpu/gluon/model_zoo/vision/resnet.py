@@ -70,7 +70,7 @@ class _S2DStemConv(HybridBlock):
 
 class BasicBlockV1(HybridBlock):
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 ghost_bn=0, **kwargs):
+                 ghost_bn=0, dual_out=False, **kwargs):
         super().__init__(**kwargs)
         self._ghost_bn = ghost_bn
         if ghost_bn:
@@ -80,7 +80,8 @@ class BasicBlockV1(HybridBlock):
             # a downsample-shortcut output is consumed ONLY by this
             # block's fused add: the kernel may write Y over it
             self.gbn2 = GhostBNReLU(group=ghost_bn,
-                                    donate_residual=downsample)
+                                    donate_residual=downsample,
+                                    dual_out=dual_out)
             self.body = None
         else:
             self.body = nn.HybridSequential()
@@ -106,12 +107,17 @@ class BasicBlockV1(HybridBlock):
             self.register_child(self.downsample, "downsample")
 
     def hybrid_forward(self, F, x):  # noqa: N803
-        residual = x
         if self._ghost_bn:
+            # a dual-output predecessor hands us (conv_path, shortcut):
+            # two positions of the SAME tensor whose cotangents the
+            # exit's fused bwd will merge (see GhostBNReLU dual_out)
+            x, shortcut = x if isinstance(x, tuple) else (x, x)
+            residual = shortcut
             if self.downsample is not None:
-                residual = self.downsample(residual)
+                residual = self.downsample(shortcut)
             x = self.gbn1(self.conv1(x))
             return self.gbn2(self.conv2(x), residual)
+        residual = x
         x = self.body(x)
         if self.downsample is not None:
             residual = self.downsample(residual)
@@ -141,13 +147,15 @@ class GhostBNReLU(HybridBlock):
     _act = "relu"
 
     def __init__(self, group=0, momentum=0.9, epsilon=1e-5, in_channels=0,
-                 donate_residual=False, track_stats=True, **kwargs):
+                 donate_residual=False, track_stats=True, dual_out=False,
+                 **kwargs):
         super().__init__(**kwargs)
         self._group = group
         self._momentum = momentum
         self._epsilon = epsilon
         self._donate_residual = bool(donate_residual)
         self._track_stats = bool(track_stats)
+        self._dual_out = bool(dual_out)
         shape = (in_channels,)
         with self.name_scope():
             self.gamma = self.params.get(
@@ -198,6 +206,18 @@ class GhostBNReLU(HybridBlock):
                     "the fused residual form is BN+add+ReLU; %s has no "
                     "activation and no fused add variant — add the "
                     "residual outside" % type(self).__name__)
+            if self._dual_out:
+                # block-exit join absorption: the same output in two
+                # positions (conv path / shortcut) so the downstream
+                # cotangents stay separate and the fused bwd sums them
+                # on the window load (no materialized add_any join)
+                out, out_sc, bm, bv = F._contrib_GhostBNAddReLUDual(
+                    x, residual, gamma, beta, running_mean, running_var,
+                    eps=self._epsilon, momentum=self._momentum,
+                    group=self._group,
+                    donate_residual=1 if self._donate_residual else 0)
+                self._commit_running(F, running_mean, running_var, bm, bv)
+                return out, out_sc
             out, bm, bv = F._contrib_GhostBNAddReLU(
                 x, residual, gamma, beta, running_mean, running_var,
                 eps=self._epsilon, momentum=self._momentum,
@@ -242,7 +262,7 @@ class GhostBN(GhostBNReLU):
 
 class BottleneckV1(HybridBlock):
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 ghost_bn=0, **kwargs):
+                 ghost_bn=0, dual_out=False, **kwargs):
         super().__init__(**kwargs)
         self._ghost_bn = ghost_bn
         if ghost_bn:
@@ -258,7 +278,8 @@ class BottleneckV1(HybridBlock):
             # a downsample-shortcut output is consumed ONLY by this
             # block's fused add: the kernel may write Y over it
             self.gbn3 = GhostBNReLU(group=ghost_bn,
-                                    donate_residual=downsample)
+                                    donate_residual=downsample,
+                                    dual_out=dual_out)
             self.body = None
         else:
             self.body = nn.HybridSequential()
@@ -286,13 +307,16 @@ class BottleneckV1(HybridBlock):
             self.register_child(self.downsample, "downsample")
 
     def hybrid_forward(self, F, x):  # noqa: N803
-        residual = x
         if self._ghost_bn:
+            # a dual-output predecessor hands us (conv_path, shortcut)
+            x, shortcut = x if isinstance(x, tuple) else (x, x)
+            residual = shortcut
             if self.downsample is not None:
-                residual = self.downsample(residual)
+                residual = self.downsample(shortcut)
             x = self.gbn1(self.conv1(x))
             x = self.gbn2(self.conv2(x))
             return self.gbn3(self.conv3(x), residual)
+        residual = x
         x = self.body(x)
         if self.downsample is not None:
             residual = self.downsample(residual)
@@ -384,19 +408,30 @@ class ResNetV1(HybridBlock):
             stride = 1 if i == 0 else 2
             self.features.add(self._make_layer(
                 block, num_layer, channels[i + 1], stride,
-                in_channels=channels[i], ghost_bn=ghost_bn))
+                in_channels=channels[i], ghost_bn=ghost_bn,
+                last_stage=(i == len(layers) - 1)))
         self.features.add(nn.GlobalAvgPool2D())
         self.output = nn.Dense(classes, in_units=channels[-1])
 
     @staticmethod
     def _make_layer(block, layers, channels, stride, in_channels=0,
-                    ghost_bn=0):
-        kw = {"ghost_bn": ghost_bn} if ghost_bn else {}
+                    ghost_bn=0, last_stage=False):
+        # ghost mode: every block exit except the net's very last one is
+        # dual-output — the next block consumes (conv_path, shortcut)
+        # and the exit's fused bwd absorbs the residual-join add_any
+        # (docs/PERF.md round 20); the final block feeds the global pool
+        # and stays single-output
+        def kw(is_tail):
+            if not ghost_bn:
+                return {}
+            return {"ghost_bn": ghost_bn,
+                    "dual_out": not (last_stage and is_tail)}
         layer = nn.HybridSequential()
         layer.add(block(channels, stride, channels != in_channels,
-                        in_channels=in_channels, **kw))
-        for _ in range(layers - 1):
-            layer.add(block(channels, 1, False, in_channels=channels, **kw))
+                        in_channels=in_channels, **kw(layers == 1)))
+        for j in range(layers - 1):
+            layer.add(block(channels, 1, False, in_channels=channels,
+                            **kw(j == layers - 2)))
         return layer
 
     def hybrid_forward(self, F, x):  # noqa: N803

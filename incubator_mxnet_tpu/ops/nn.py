@@ -128,13 +128,31 @@ def _deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=Non
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
-def _maxpool_sws(data, window, strides, padding):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _maxpool_sws_impl(data, window, strides, padding, in_shape):
     return lax.reduce_window(data, -jnp.inf, lax.max, window, strides, padding)
 
 
-def _maxpool_sws_fwd(data, window, strides, padding):
-    out = _maxpool_sws(data, window, strides, padding)
+def _maxpool_sws(data, window, strides, padding):
+    return _maxpool_sws_impl(data, window, strides, padding,
+                             tuple(data.shape))
+
+
+def _maxpool_sws_fwd(data, window, strides, padding, in_shape):
+    from ..parallel import maxpool_idx
+
+    p = maxpool_idx.plan(in_shape, data.dtype.itemsize, window, strides,
+                         padding)
+    if p is not None:
+        # argmax-carrying forward (parallel/maxpool_idx.py): the winner
+        # offset rides out of the pooling pass as a 1-byte plane, so
+        # the backward never re-reads data/out to rediscover it — at
+        # 224 px that re-read was the stem ghost-BN output, the GL202
+        # census' sole remaining multi-pass tensor
+        out, first = maxpool_idx.maxpool_with_index(data, window, strides,
+                                                    padding, p)
+        return out, (first,)
+    out = _maxpool_sws_impl(data, window, strides, padding, in_shape)
     return out, (data, out)
 
 
@@ -187,12 +205,18 @@ def shifted_window_unpool(data, out, g, window, strides, padding,
     return dx.astype(data.dtype)
 
 
-def _maxpool_sws_bwd(window, strides, padding, res, g):
+def _maxpool_sws_bwd(window, strides, padding, in_shape, res, g):
+    if len(res) == 1:
+        from ..parallel import maxpool_idx
+
+        (first,) = res
+        return (maxpool_idx.indexed_unpool(first, g, in_shape, window,
+                                           strides, padding),)
     data, out = res
     return (shifted_window_unpool(data, out, g, window, strides, padding),)
 
 
-_maxpool_sws.defvjp(_maxpool_sws_fwd, _maxpool_sws_bwd)
+_maxpool_sws_impl.defvjp(_maxpool_sws_fwd, _maxpool_sws_bwd)
 
 
 @register("Pooling", aliases=("pool",))
@@ -390,6 +414,38 @@ def _ghost_bn_add_relu(data, residual, gamma, beta, moving_mean, moving_var,
                             donate_residual=bool(int(donate_residual)))
 
 
+@register("_contrib_GhostBNAddReLUDual", num_inputs=6, num_outputs=4,
+          mutate_idx=(4, 5))
+def _ghost_bn_add_relu_dual(data, residual, gamma, beta, moving_mean,
+                            moving_var, eps=1e-3, momentum=0.9, group=0,
+                            donate_residual=0):
+    """Dual-output fused ghost-BN + residual add + ReLU.
+
+    Outputs ``(out, out_sc, batch_mean, batch_var)`` where ``out_sc`` is
+    the SAME tensor as ``out`` exposed in a second output position: a
+    block exit routes the next block's conv path through ``out`` and its
+    shortcut through ``out_sc``, so autodiff delivers the two cotangents
+    separately and the fused bwd kernel sums them on the VMEM window
+    load — the residual-join add_any (read 2x + write of a full exit
+    tensor per block) disappears from the step program (docs/PERF.md
+    round 20).  Same statistics, aux protocol and ``donate_residual``
+    semantics as ``_contrib_GhostBNAddReLU``.
+    """
+    if _is_train():
+        from ..parallel.fused_bn import ghost_bn_act, ghost_bn_stats_merge
+
+        out, out_sc, m, v = ghost_bn_act(
+            data, gamma.astype(jnp.float32), beta.astype(jnp.float32),
+            residual=residual, eps=float(eps), act="relu", group=int(group),
+            donate_residual=bool(int(donate_residual)), dual_out=True)
+        bm, bv = ghost_bn_stats_merge(m, v)
+        return out, out_sc, bm, bv
+    out, bm, bv = _ghost_bn_common(
+        data, residual, gamma, beta, moving_mean, moving_var, float(eps),
+        int(group), donate_residual=bool(int(donate_residual)))
+    return out, out, bm, bv
+
+
 @register("_contrib_GhostBN", num_inputs=5, num_outputs=3,
           mutate_idx=(3, 4))
 def _ghost_bn_noact(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
@@ -438,8 +494,17 @@ def _ghost_bn_aux_update(in_vals, out_vals, momentum=0.9, **_):
                        + (1 - m) * out_vals[2]).astype(old_v.dtype)}
 
 
+def _ghost_bn_aux_update_dual(in_vals, out_vals, momentum=0.9, **_):
+    # dual op output layout is (out, out_sc, bm, bv) — drop the extra
+    # output position so the shared formula sees (out, bm, bv)
+    return _ghost_bn_aux_update(in_vals,
+                                (out_vals[0],) + tuple(out_vals[2:]),
+                                momentum=momentum)
+
+
 OPS["_contrib_GhostBNReLU"].aux_update = _ghost_bn_aux_update
 OPS["_contrib_GhostBNAddReLU"].aux_update = _ghost_bn_aux_update
+OPS["_contrib_GhostBNAddReLUDual"].aux_update = _ghost_bn_aux_update_dual
 OPS["_contrib_GhostBN"].aux_update = _ghost_bn_aux_update
 
 

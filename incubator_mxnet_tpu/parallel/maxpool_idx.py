@@ -1,0 +1,188 @@
+"""Argmax-carrying max-pool forward (round 20).
+
+The shifted-window maxpool backward (``ops.nn.shifted_window_unpool``)
+recomputes the winner index from ``(data, out)``: at 224 px that is a
+411 MB elementwise re-read of the stem ghost-BN output (the sole GL202
+census survivor of rounds 14-19) plus a 103 MB read of the pooled
+output, and the scatter accumulates in PADDED coordinates — a
+(256, 64, 114, 114) write that the stem BN backward kernel then reads
+back through its gY window at the padded size.  This module moves the
+argmax to the FORWARD: one Pallas pass emits the pooled maximum
+together with the winning in-window offset (int8, row-major-first tie
+rule — bit-identical to ``select_and_scatter_add``'s GE-select and to
+the reference's pool.h ``unpool_max_*_cpu``), so the backward routes
+gradients from the 51 MB index plane alone and accumulates directly in
+UNPADDED input coordinates (negative edge padding clips the
+contributions that the old code parked in pad rows and sliced away).
+
+Per-step delta at batch 256 / 224 px bf16 (priced by
+analysis/cost_model.py):
+
+    fwd   +51 MB   int8 index plane write (the data read moves from
+                   the reduction category to this kernel, same bytes)
+    bwd  -411 MB   no data re-read (census survivor gone)
+         -103 MB   no pooled-output read
+          -15 MB   dX written at 112x112, not 114x114
+          -15 MB   stem BN bwd reads gY at 112x112, not 114x114
+
+The kernel grid is (N, C / c_blk) with whole-spatial blocks — the stem
+shape (256, 64, 112, 112) needs 1.8 MB of VMEM per x block, nowhere
+near the fused-BN window problem — and every program reads and writes
+disjoint slices, so the cost model's one-read custom-call contract
+holds by construction.  Shapes the plan cannot place (rank != 4,
+pooling over N/C, >127 in-window offsets, VMEM misfit) fall back to
+``None`` and the caller keeps the shifted-window recompute path.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_I0 = np.int32(0)  # index-map literal pinned to i32 (package enables x64)
+
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
+__all__ = ["MaxPoolPlan", "plan", "maxpool_with_index", "indexed_unpool"]
+
+#: per-program VMEM ceiling for the (x, padded x, out, idx) working set,
+#: double-buffered.  Deliberately small: the kernel is bandwidth-bound
+#: and gains nothing from large blocks.
+_BLOCK_BUDGET = 8 * 1024 * 1024
+
+
+def _rup(x, m):
+    return -(-x // m) * m
+
+
+def _use_interpret():
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True
+
+
+class MaxPoolPlan(NamedTuple):
+    c_blk: int
+    out_hw: Tuple[int, int]
+
+
+def plan(shape, itemsize, window, strides, padding) -> Optional[MaxPoolPlan]:
+    """Place the indexed forward, or ``None`` for the fallback path.
+
+    ``window``/``strides`` are full-rank NCHW (leading (1, 1)),
+    ``padding`` is the full-rank ``((0,0),(0,0),(ph,ph'),(pw,pw'))``
+    reduce_window config (pooling_convention="full" pads the high edge
+    asymmetrically — supported)."""
+    if len(shape) != 4 or len(window) != 4:
+        return None
+    if tuple(window[:2]) != (1, 1) or tuple(strides[:2]) != (1, 1):
+        return None
+    if tuple(padding[0]) != (0, 0) or tuple(padding[1]) != (0, 0):
+        return None
+    noff = window[2] * window[3]
+    if not 2 <= noff <= 127:        # int8 index plane; 1x1 is a copy
+        return None
+    n, c, h, w = shape
+    oh = (h + sum(padding[2]) - window[2]) // strides[2] + 1
+    ow = (w + sum(padding[3]) - window[3]) // strides[3] + 1
+    if oh < 1 or ow < 1:
+        return None
+    hp = h + sum(padding[2])
+    wp = w + sum(padding[3])
+    sub = 16 if itemsize == 2 else 8
+    per_c = (_rup(h, sub) * _rup(w, 128) + _rup(hp, sub) * _rup(wp, 128)
+             + _rup(oh, sub) * _rup(ow, 128)) * itemsize \
+        + _rup(oh, 32) * _rup(ow, 128)          # int8 index tile
+    for cb in range(min(c, 64), 0, -1):
+        if c % cb == 0 and 2 * cb * per_c <= _BLOCK_BUDGET:
+            return MaxPoolPlan(cb, (oh, ow))
+    return None
+
+
+def _kernel(x_ref, out_ref, idx_ref, *, window, strides, padding, out_hw):
+    x = x_ref[...]
+    neg = np.asarray(-jnp.inf, x.dtype)[()]
+    xp = jnp.pad(x, ((0, 0), (0, 0), tuple(padding[2]), tuple(padding[3])),
+                 constant_values=neg)
+    oh, ow = out_hw
+    sh, sw = strides[2], strides[3]
+    best = None
+    idx = None
+    lin = 0
+    for i in range(window[2]):
+        for j in range(window[3]):
+            xs = lax.slice(
+                xp, (0, 0, i, j),
+                (xp.shape[0], xp.shape[1],
+                 i + (oh - 1) * sh + 1, j + (ow - 1) * sw + 1),
+                (1, 1, sh, sw))
+            if best is None:
+                best = xs
+                idx = jnp.zeros(xs.shape, jnp.int32)
+            else:
+                # strict > keeps the EARLIER offset on ties: the final
+                # index is the first in-window argmax in row-major scan
+                # order, the same winner shifted_window_unpool derives
+                # from (data, out) and select_and_scatter_add's
+                # GE-select picks
+                idx = jnp.where(xs > best, jnp.int32(lin), idx)
+                best = jnp.maximum(best, xs)
+            lin += 1
+    out_ref[...] = best
+    idx_ref[...] = idx.astype(jnp.int8)
+
+
+def maxpool_with_index(data, window, strides, padding, p: MaxPoolPlan):
+    """Pooled max + int8 winner-offset plane, one read of ``data``."""
+    n, c, h, w = data.shape
+    oh, ow = p.out_hw
+    cb = p.c_blk
+    xspec = pl.BlockSpec((1, cb, h, w), lambda i, j: (i, j, _I0, _I0))
+    ospec = pl.BlockSpec((1, cb, oh, ow), lambda i, j: (i, j, _I0, _I0))
+    kern = functools.partial(_kernel, window=tuple(window),
+                             strides=tuple(strides),
+                             padding=tuple(tuple(q) for q in padding),
+                             out_hw=p.out_hw)
+    return pl.pallas_call(
+        kern, grid=(n, c // cb), in_specs=[xspec],
+        out_specs=[ospec, ospec],
+        out_shape=[jax.ShapeDtypeStruct((n, c, oh, ow), data.dtype),
+                   jax.ShapeDtypeStruct((n, c, oh, ow), jnp.int8)],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=_use_interpret())(data)
+
+
+def indexed_unpool(first, g, in_shape, window, strides, padding):
+    """Backward from the saved index plane alone.
+
+    ``dx[p] += g[w]`` exactly when window ``w`` covers ``p`` at offset
+    ``first[w]``.  One fused elementwise region reading (first, g):
+    no data/out recompute, and the per-offset contributions are placed
+    with interior-dilated ``lax.pad`` whose (possibly NEGATIVE) edge
+    config lands them directly in unpadded input coordinates — a
+    contribution whose target falls in a pad row is clipped, which is
+    exact because a -inf pad cell never wins the forward argmax."""
+    offsets = list(itertools.product(*[range(k) for k in window]))
+    zero = np.asarray(0, g.dtype)[()]
+    dx = None
+    for lin, offset in enumerate(offsets):
+        contrib = jnp.where(first == jnp.int8(lin), g, zero)
+        cfg = []
+        for o, (plo, _), s, xd, od in zip(offset, padding, strides,
+                                          in_shape, g.shape):
+            lo = o - plo
+            cfg.append((lo, xd - lo - ((od - 1) * s + 1), s - 1))
+        piece = lax.pad(contrib, zero, cfg)
+        dx = piece if dx is None else dx + piece
+    return dx

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import autograd, nd
 from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.parallel import fused_bn as fb
 from incubator_mxnet_tpu.parallel.fused_bn import (ghost_bn_act,
                                                    ghost_bn_stats_merge)
 
@@ -250,11 +251,15 @@ def test_ghost_bn_hybrid_bwd_matches_pallas_bwd(monkeypatch):
     g_full = jax.grad(loss, argnums=(0, 1, 2, 3))(x, gamma, beta, res)
 
     # shrink the budget so exactly the bwd (3 windows with in-place
-    # aliasing) no longer fits while the donated-residual fwd (2) does
+    # aliasing) no longer fits while the donated-residual fwd (2) does;
+    # tiling is disabled (_MAX_TILES=1) so the plan can't upgrade the
+    # bwd to the round-20 spatial-tiled form — the jnp hybrid is still
+    # reachable (prime L) and must keep matching
     itemsize = 4
     padded = 36 * fb._rup(4, fb._sublane(itemsize)) * fb._rup(256, 128) \
         * itemsize
     monkeypatch.setattr(fb, "_WINDOW_BUDGET", 2 * 2 * padded)
+    monkeypatch.setattr(fb, "_MAX_TILES", 1)
     hybrid_plan = fb._plan(8, 256, 36, itemsize, 4, True, True)
     assert hybrid_plan is not None and not hybrid_plan[2], \
         "budget shrink must force the fwd-only hybrid, got %r" % (
@@ -263,3 +268,241 @@ def test_ghost_bn_hybrid_bwd_matches_pallas_bwd(monkeypatch):
     for a, b in zip(g_full, g_hyb):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# round 20: lane-fold, spatial-tiled, and dual-cotangent kernel forms
+# ---------------------------------------------------------------------------
+
+
+def _plan_of(fb, shape, itemsize, group, has_res, donate=False, dual=False):
+    n, c, h, w = shape
+    return fb._plan(n, c, h * w, itemsize, group, has_res, donate, dual)
+
+
+@pytest.mark.parametrize("dtype,tol", [(np.float32, 5e-4),
+                                       (jnp.bfloat16, 2e-2)])
+def test_ghost_bn_lanefold_matches_reference(monkeypatch, dtype, tol):
+    """C < 128 pads its lanes to 128 anyway; the lane-fold form packs
+    k = 128/C rows of L into that padding, shrinking the VMEM window by
+    k with the same one-read kernels.  Forced here by a budget under
+    the whole-L window cost; fwd AND bwd must match the jnp ghost
+    reference at the plan's own group."""
+    from incubator_mxnet_tpu.parallel import fused_bn as fb
+
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.normal(size=(256, 32, 4, 4)), dtype)
+    gamma = jnp.asarray(rng.uniform(0.5, 1.5, 32), dtype)
+    beta = jnp.asarray(rng.normal(size=32) * 0.2, dtype)
+    itemsize = np.dtype(dtype).itemsize
+    monkeypatch.setattr(fb, "_WINDOW_BUDGET", 200000 * itemsize // 4)
+    plan = _plan_of(fb, x.shape, itemsize, 8, False)
+    assert plan is not None and plan.variant == "lanefold" \
+        and plan.bwd_variant == "lanefold" and plan.fold == 128 // 32, plan
+    ng = plan.ab[0]
+
+    y, m, v = ghost_bn_act(x, gamma, beta, group=8)
+    yr, mr, vr = _ref(x, gamma, beta, group=ng)
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(yr, np.float32),
+                               rtol=tol, atol=tol)
+    np.testing.assert_allclose(np.asarray(m), np.asarray(mr),
+                               rtol=1e-3, atol=1e-3)
+
+    def lk(x, gamma, beta):
+        y, _, _ = ghost_bn_act(x, gamma, beta, group=8)
+        return (y.astype(jnp.float32)
+                * jnp.cos(jnp.arange(y.size).reshape(y.shape))).sum()
+
+    def lr(x, gamma, beta):
+        y, _, _ = _ref(x, gamma, beta, group=ng)
+        return (y.astype(jnp.float32)
+                * jnp.cos(jnp.arange(y.size).reshape(y.shape))).sum()
+
+    gk = jax.grad(lk, argnums=(0, 1, 2))(x, gamma, beta)
+    gr = jax.grad(lr, argnums=(0, 1, 2))(x, gamma, beta)
+    for a, b in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=tol * 20, atol=tol * 20)
+
+
+@pytest.mark.parametrize("dual", [False, True])
+def test_ghost_bn_tiled_residual_matches_reference(monkeypatch, dual):
+    """Spatial tiling with cross-tile stat accumulation: a budget under
+    every whole-L window count forces the two-phase tiled kernels in
+    BOTH directions (the 56x56x256 identity-exit regime).  Gradients —
+    including the residual cotangent and, when ``dual``, the separate
+    conv-path/shortcut cotangent pair — must match the jnp ghost
+    reference."""
+    from incubator_mxnet_tpu.parallel import fused_bn as fb
+
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.normal(size=(32, 128, 6, 6)).astype(np.float32))
+    res = jnp.asarray(rng.normal(size=(32, 128, 6, 6)).astype(np.float32))
+    gamma = jnp.asarray(rng.uniform(0.5, 1.5, 128).astype(np.float32))
+    beta = jnp.asarray(rng.normal(size=128).astype(np.float32) * 0.2)
+    monkeypatch.setattr(fb, "_WINDOW_BUDGET", 200000)
+    plan = _plan_of(fb, x.shape, 4, 16, True, dual=dual)
+    assert plan is not None and plan.variant == "tiled" \
+        and plan.bwd_variant == "tiled" and plan.l_tile > 0, plan
+    if dual:
+        # the extra gY2 window forces a smaller bwd tile
+        nd = _plan_of(fb, x.shape, 4, 16, True, dual=False)
+        assert plan.l_tile_bwd < nd.l_tile_bwd, (plan, nd)
+    ng = plan.ab[0]
+
+    w1 = jnp.cos(jnp.arange(x.size).reshape(x.shape))
+    w2 = jnp.sin(jnp.arange(x.size).reshape(x.shape))
+
+    def lk(x, gamma, beta, r):
+        if dual:
+            y1, y2, _, _ = ghost_bn_act(x, gamma, beta, residual=r,
+                                        group=16, dual_out=True)
+            return (y1 * w1).sum() + (y2 * w2).sum()
+        y, _, _ = ghost_bn_act(x, gamma, beta, residual=r, group=16)
+        return (y * w1).sum() + (y * w2).sum()
+
+    def lr(x, gamma, beta, r):
+        y, _, _ = _ref(x, gamma, beta, residual=r, group=ng)
+        return (y * w1).sum() + (y * w2).sum()
+
+    gk = jax.grad(lk, argnums=(0, 1, 2, 3))(x, gamma, beta, res)
+    gr = jax.grad(lr, argnums=(0, 1, 2, 3))(x, gamma, beta, res)
+    for a, b in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_ghost_bn_dual_whole_l_bitexact_vs_single(monkeypatch):
+    """The dual-output block exit (``dual_out=True``) exists to absorb
+    the residual-join ``add_any`` into the bwd kernel's window load; on
+    the whole-L kernels the summed cotangent path must be BIT-exact
+    against the single-output form."""
+    from incubator_mxnet_tpu.parallel import fused_bn as fb
+
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.normal(size=(32, 128, 6, 6)).astype(np.float32))
+    res = jnp.asarray(rng.normal(size=(32, 128, 6, 6)).astype(np.float32))
+    gamma = jnp.asarray(rng.uniform(0.5, 1.5, 128).astype(np.float32))
+    beta = jnp.asarray(rng.normal(size=128).astype(np.float32) * 0.2)
+    plan = _plan_of(fb, x.shape, 4, 16, True, dual=True)
+    assert plan is not None and plan.variant == "fused" \
+        and plan.bwd_variant == "fused", plan
+
+    w1 = jnp.cos(jnp.arange(x.size).reshape(x.shape))
+    w2 = jnp.sin(jnp.arange(x.size).reshape(x.shape))
+
+    def l_dual(x, gamma, beta, r):
+        y1, y2, _, _ = ghost_bn_act(x, gamma, beta, residual=r, group=16,
+                                    dual_out=True)
+        return (y1 * w1).sum() + (y2 * w2).sum()
+
+    def l_single(x, gamma, beta, r):
+        y, _, _ = ghost_bn_act(x, gamma, beta, residual=r, group=16)
+        return (y * w1).sum() + (y * w2).sum()
+
+    y1, y2, m, v = ghost_bn_act(x, gamma, beta, residual=res, group=16,
+                                dual_out=True)
+    ys, ms, vs = ghost_bn_act(x, gamma, beta, residual=res, group=16)
+    assert np.array_equal(np.asarray(y1), np.asarray(ys))
+    assert np.array_equal(np.asarray(y2), np.asarray(ys))
+    assert np.array_equal(np.asarray(m), np.asarray(ms))
+    gd = jax.grad(l_dual, argnums=(0, 1, 2, 3))(x, gamma, beta, res)
+    gs = jax.grad(l_single, argnums=(0, 1, 2, 3))(x, gamma, beta, res)
+    for a, b in zip(gd, gs):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_ghost_bn_mixed_fused_fwd_tiled_bwd(monkeypatch):
+    """Budget band where the whole-L fwd fits but the 3-window residual
+    bwd does not: the plan keeps the one-read fwd and tiles only the
+    backward (fused/tiled mix), and gradients still match the fully
+    fused form."""
+    from incubator_mxnet_tpu.parallel import fused_bn as fb
+
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.normal(size=(8, 256, 6, 6)).astype(np.float32))
+    res = jnp.asarray(rng.normal(size=(8, 256, 6, 6)).astype(np.float32))
+    gamma = jnp.asarray(rng.uniform(0.5, 1.5, 256).astype(np.float32))
+    beta = jnp.asarray(rng.normal(size=256).astype(np.float32) * 0.2)
+
+    def loss(x, gamma, beta, r):
+        y, _, _ = ghost_bn_act(x, gamma, beta, residual=r, group=4,
+                               donate_residual=True)
+        return (y * jnp.cos(jnp.arange(y.size).reshape(y.shape))).sum()
+
+    g_full = jax.grad(loss, argnums=(0, 1, 2, 3))(x, gamma, beta, res)
+    # whole-L window = 36*8*256*4 B; donate fwd needs 2x2 of those
+    # (1 179 648 B), the aliased bwd 3x2 (1 769 472 B) — a budget
+    # between forces the mix
+    monkeypatch.setattr(fb, "_WINDOW_BUDGET", 1300000)
+    plan = _plan_of(fb, x.shape, 4, 4, True, donate=True)
+    assert plan is not None and plan.variant == "fused" \
+        and plan.bwd_variant == "tiled" and plan.l_tile_bwd > 0, plan
+    g_mix = jax.grad(loss, argnums=(0, 1, 2, 3))(x, gamma, beta, res)
+    for a, b in zip(g_full, g_mix):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+# -- round-20 plan table: the ResNet-50 shapes at the REAL 104 MB budget --
+
+# every distinct batch-256 bf16 BN layer of the bench workload, with the
+# docs/PERF.md window arithmetic asserted in BYTES: padded window =
+# rows x rup(ng, 16) x rup(lanes, 128) x itemsize, rows halved by the
+# lane-fold factor, lanes = C (x fold for lane-fold), rows = l_tile for
+# the spatial-tiled form.  (c, hw, res, donate, dual) -> (variant, bwd,
+# fold, l_tile, l_tile_bwd, window_bytes)
+R50_PLAN_TABLE = [
+    # stem: 51.4 MB whole-L window can't fit 2 fwd windows double-
+    # buffered; fold 2 packs the 64 channels twice into 128 lanes
+    ((64, 112, False, False, False),
+     ("lanefold", "lanefold", 2, 0, 0, 6272 * 16 * 128 * 2)),
+    # C=64 at 56x56 pads to 128 lanes but fits whole-L
+    ((64, 56, False, False, False),
+     ("fused", "fused", 1, 0, 0, 3136 * 16 * 128 * 2)),
+    # the 56x56x256 downsample shortcut (no residual): whole-L
+    ((256, 56, False, False, False),
+     ("fused", "fused", 1, 0, 0, 3136 * 16 * 256 * 2)),
+    # 56x56x256 downsample EXIT: donated residual -> 2 fwd windows fit
+    # whole-L; the dual bwd needs 4 windows -> spatial-tiled at lt=1568
+    ((256, 56, True, True, True),
+     ("fused", "tiled", 1, 0, 1568, 3136 * 16 * 256 * 2)),
+    # 56x56x256 identity exits (the ISSUE headline): 3 fwd windows
+    # can't fit whole-L -> two-phase tiled both directions, half-L tiles
+    ((256, 56, True, False, True),
+     ("tiled", "tiled", 1, 1568, 1568, 1568 * 16 * 256 * 2)),
+    # 28x28x512 residual dual exit: 4 x 12.85 MB x 2 = 102.8 MB <= 104
+    ((512, 28, True, True, True),
+     ("fused", "fused", 1, 0, 0, 784 * 16 * 512 * 2)),
+    ((512, 28, True, False, True),
+     ("fused", "fused", 1, 0, 0, 784 * 16 * 512 * 2)),
+    # deep stages: everything whole-L
+    ((1024, 14, True, False, True),
+     ("fused", "fused", 1, 0, 0, 196 * 16 * 1024 * 2)),
+    ((2048, 7, True, False, False),
+     ("fused", "fused", 1, 0, 0, 49 * 16 * 2048 * 2)),
+]
+
+
+@pytest.mark.parametrize("layer,want", R50_PLAN_TABLE,
+                         ids=["%dx%d%s%s%s" % (c, hw,
+                                               "_res" if r else "",
+                                               "_don" if dn else "",
+                                               "_dual" if du else "")
+                              for (c, hw, r, dn, du), _ in R50_PLAN_TABLE])
+def test_round20_r50_plan_table(layer, want):
+    """Shape -> variant selection at the real 104 MB VMEM budget, with
+    the PERF.md window-byte arithmetic pinned exactly.  A budget or
+    selection-order change that silently reshuffles which bench layers
+    run which kernel form fails HERE with the layer named."""
+    assert fb._WINDOW_BUDGET == 104 * 1024 * 1024
+    c, hw, res, donate, dual = layer
+    variant, bwd, fold, lt, ltb, wb = want
+    plan = fb._plan(256, c, hw * hw, 2, 16, res, donate, dual)
+    assert plan is not None, layer
+    assert (plan.variant, plan.bwd_variant) == (variant, bwd), plan
+    assert plan.fold == fold, plan
+    assert (plan.l_tile or 0, plan.l_tile_bwd or 0) == (lt, ltb), plan
+    assert plan.window_bytes == wb, (plan.window_bytes, wb)

@@ -1,0 +1,118 @@
+"""Argmax-carrying max-pool kernel (parallel/maxpool_idx.py).
+
+The forward must be bit-exact vs ``lax.reduce_window`` max and the
+index-routed backward bit-exact vs the shifted-window recompute
+(ops/nn.shifted_window_unpool) — the two sides of the same pool.h
+``unpool_max_*_cpu`` first-argmax contract.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import incubator_mxnet_tpu.ops.nn as opsnn
+from incubator_mxnet_tpu.parallel import maxpool_idx
+
+
+def _configs(win, stride, pad):
+    window = (1, 1) + win
+    strides = (1, 1) + stride
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    return window, strides, padding
+
+
+CASES = [
+    # the stem pattern (3x3 s2 p1) with floor slack, both dtypes
+    ((4, 8, 12, 12), (3, 3), (2, 2), (1, 1), np.float32),
+    ((2, 16, 16, 16), (3, 3), (2, 2), (1, 1), jnp.bfloat16),
+    # non-overlapping, no padding, odd extent (trailing column dropped)
+    ((3, 8, 9, 9), (2, 2), (2, 2), (0, 0), np.float32),
+    # stride-1 overlap: every input position sits in up to 9 windows
+    ((2, 4, 7, 7), (3, 3), (1, 1), (1, 1), np.float32),
+]
+
+
+@pytest.mark.parametrize("shape,win,stride,pad,dtype", CASES)
+def test_maxpool_idx_fwd_bitexact_vs_reduce_window(shape, win, stride, pad,
+                                                   dtype):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(*shape), dtype)
+    window, strides, padding = _configs(win, stride, pad)
+    p = maxpool_idx.plan(shape, x.dtype.itemsize, window, strides, padding)
+    assert p is not None and shape[1] % p.c_blk == 0, p
+    ref = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, padding)
+    out, first = maxpool_idx.maxpool_with_index(x, window, strides,
+                                                padding, p)
+    assert out.dtype == ref.dtype and first.dtype == jnp.int8
+    assert np.array_equal(np.asarray(out, np.float32),
+                          np.asarray(ref, np.float32))
+    noff = win[0] * win[1]
+    f = np.asarray(first)
+    assert f.min() >= 0 and f.max() < noff
+
+
+@pytest.mark.parametrize("shape,win,stride,pad,dtype", CASES)
+def test_maxpool_idx_bwd_bitexact_vs_shifted_window(shape, win, stride, pad,
+                                                    dtype):
+    """Same winner, same routing: the index-plane backward must equal
+    the (data, out) recompute bit-for-bit, including tie positions
+    (repeated values are common post-ReLU)."""
+    rng = np.random.RandomState(1)
+    # quantized values force plenty of in-window ties
+    x = jnp.asarray(np.round(rng.randn(*shape) * 2) / 2, dtype)
+    window, strides, padding = _configs(win, stride, pad)
+    p = maxpool_idx.plan(shape, x.dtype.itemsize, window, strides, padding)
+    out, first = maxpool_idx.maxpool_with_index(x, window, strides,
+                                                padding, p)
+    g = jnp.asarray(rng.randn(*out.shape), dtype)
+    dx_ref = opsnn.shifted_window_unpool(x, out, g, window, strides,
+                                         padding)
+    dx = maxpool_idx.indexed_unpool(first, g, shape, window, strides,
+                                    padding)
+    assert dx.shape == x.shape and dx.dtype == x.dtype
+    assert np.array_equal(np.asarray(dx, np.float32),
+                          np.asarray(dx_ref, np.float32))
+
+
+def test_maxpool_idx_plan_gating():
+    stem = ((0, 0), (0, 0), (1, 1), (1, 1))
+    ok = maxpool_idx.plan((256, 64, 112, 112), 2, (1, 1, 3, 3),
+                          (1, 1, 2, 2), stem)
+    assert ok is not None and 64 % ok.c_blk == 0 \
+        and ok.out_hw == (56, 56), ok
+    # rank != 4
+    assert maxpool_idx.plan((64, 112, 112), 2, (1, 3, 3), (1, 2, 2),
+                            stem[1:]) is None
+    # pooling over N or C stays on the fallback
+    assert maxpool_idx.plan((8, 8, 12, 12), 4, (1, 2, 3, 3),
+                            (1, 1, 2, 2), stem) is None
+    assert maxpool_idx.plan((8, 8, 12, 12), 4, (1, 1, 3, 3),
+                            (1, 2, 2, 2), stem) is None
+    # >127 in-window offsets would overflow the int8 index plane
+    assert maxpool_idx.plan((8, 8, 256, 256), 4, (1, 1, 16, 16),
+                            (1, 1, 16, 16),
+                            ((0, 0), (0, 0), (0, 0), (0, 0))) is None
+    # 1x1 window is a strided copy — nothing to index
+    assert maxpool_idx.plan((8, 8, 12, 12), 4, (1, 1, 1, 1),
+                            (1, 1, 2, 2),
+                            ((0, 0), (0, 0), (0, 0), (0, 0))) is None
+
+
+def test_maxpool_grad_path_matches_fallback(monkeypatch):
+    """End-to-end through the ``_maxpool_sws`` custom VJP: gradients on
+    the kernel path equal the shifted-window fallback path exactly."""
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(np.round(rng.randn(4, 8, 12, 12) * 2) / 2, np.float32)
+    window, strides, padding = _configs((3, 3), (2, 2), (1, 1))
+
+    def loss(a):
+        out = opsnn._maxpool_sws(a, window, strides, padding)
+        return (out * jnp.cos(jnp.arange(out.size).reshape(out.shape))).sum()
+
+    g_kernel = jax.grad(loss)(x)
+    monkeypatch.setattr(maxpool_idx, "plan",
+                        lambda *a, **k: None)
+    g_fallback = jax.grad(loss)(x)
+    assert np.array_equal(np.asarray(g_kernel), np.asarray(g_fallback))

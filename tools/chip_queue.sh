@@ -3,8 +3,31 @@
 # reachable; each step is independently timeboxed and failures don't
 # stop the rest.  Probe first:
 #   timeout 240 python -c 'import jax; jax.devices()' && bash tools/chip_queue.sh
+#
+# CHIP_QUEUE_DRY_RUN=1 exercises the queue's WIRING on the CPU backend
+# without burning chip time: heavy measurement legs are printed and
+# skipped, while the artifact-producing legs (kernel-variant sweep,
+# train-schedule winner) run tiny CPU workloads end-to-end and their
+# output contracts are validated — this is what tests/test_tools.py
+# runs in tier-1, so a flag/json drift in the queue fails BEFORE a
+# chip window is spent discovering it.
 set -u
 cd "$(dirname "$0")/.."
+DRY=${CHIP_QUEUE_DRY_RUN:-0}
+if [ "$DRY" = "1" ]; then
+    export JAX_PLATFORMS=cpu
+fi
+
+# run <timeout_s> <cmd...> — dry mode prints the command and skips it
+run() {
+    local t=$1; shift
+    if [ "$DRY" = "1" ]; then
+        echo "[dry-run] skip (${t}s): $*"
+        return 0
+    fi
+    timeout "$t" "$@"
+}
+
 LOG=${1:-chip_queue_results.txt}
 {
 echo "== chip queue $(date -u +%FT%TZ) =="
@@ -12,21 +35,37 @@ echo "== chip queue $(date -u +%FT%TZ) =="
 echo "-- 1. headline bench, stock config (warm cache expected)"
 # --no-config alone now means the round-19 composed default (ghost-BN 16
 # + byte-diet passes); the sweep baseline must be TRUE stock BatchNorm
-timeout 580 python bench.py --chunks 3 --no-config --ghost-bn 0 --passes '' \
+run 580 python bench.py --chunks 3 --no-config --ghost-bn 0 --passes '' \
     | tee /tmp/bench_stock.txt
 
 echo "-- 2. per-kernel BN DMA-efficiency microbench (VERDICT r4 item 1)"
-timeout 1200 python tools/bn_kernel_bench.py --residual \
+run 1200 python tools/bn_kernel_bench.py --residual \
     --out bn_kernel_results.jsonl
 
+echo "-- 2b. round-20 kernel-variant sweep (lane-fold stem + spatial-tiled"
+echo "       exits vs whole-L vs stock XLA, JSON artifact)"
+if [ "$DRY" = "1" ]; then
+    rm -f /tmp/bn_kernel_variants.json
+    timeout 300 python tools/bn_kernel_bench.py --variants --dry-run \
+        --format json --out /tmp/bn_kernel_variants.json \
+        && python -c "
+import json
+rows = [json.loads(l) for l in open('/tmp/bn_kernel_variants.json')]
+assert rows and all('variant' in r and 'stock_xla_ms' in r for r in rows), rows
+print('kernel-variant sweep contract ok: %d rows' % len(rows))"
+else
+    run 1800 python tools/bn_kernel_bench.py --variants --residual \
+        --format json --out bn_kernel_variants.json
+fi
+
 echo "-- 3. perf variant sweep (absorb proven wins into the default)"
-timeout 900 python bench.py --chunks 3 --no-config --s2d-stem --ghost-bn 0 \
+run 900 python bench.py --chunks 3 --no-config --s2d-stem --ghost-bn 0 \
     --passes '' | tee /tmp/bench_s2d.txt
-timeout 900 python bench.py --chunks 3 --no-config --ghost-bn 16 --passes '' \
+run 900 python bench.py --chunks 3 --no-config --ghost-bn 16 --passes '' \
     | tee /tmp/bench_gbn.txt
-timeout 1200 python bench.py --chunks 3 --no-config --s2d-stem --ghost-bn 16 \
+run 1200 python bench.py --chunks 3 --no-config --s2d-stem --ghost-bn 16 \
     --passes '' | tee /tmp/bench_both.txt
-timeout 1200 python bench.py --chunks 3 --no-config \
+run 1200 python bench.py --chunks 3 --no-config \
     | tee /tmp/bench_composed.txt
 
 echo "-- 4. pick the measured winner -> bench_config.json"
@@ -82,32 +121,77 @@ else:
     print("composed default stands (no variant beat it by >1%)")
 EOF
 
+echo "-- 4b. graftsched train-schedule winner vs the hand-built default"
+# zero-compile per-site schedule search over the byte-diet passes; the
+# winner JSON is the exact artifact bench.py --schedule-config consumes
+# (knobs.schedule canonical dict + knobs.schedule_hash stamp)
+if [ "$DRY" = "1" ]; then
+    timeout 300 python tools/autotune.py --target train-schedule \
+        --model conv-bn --passes space_to_depth,maxpool_bwd_mask \
+        --batches 8 --budget-compiles 0 \
+        --winner-out /tmp/sched_winner.json \
+        && python -c "
+import json
+from incubator_mxnet_tpu.analysis.passes import PassSchedule
+w = json.load(open('/tmp/sched_winner.json'))
+h = PassSchedule.from_dict(w['knobs']['schedule']).hash()
+assert h == w['knobs']['schedule_hash'], (h, w['knobs'])
+print('schedule-winner contract ok: hash', h)"
+else
+    run 900 python tools/autotune.py --target train-schedule \
+        --model resnet50 --passes space_to_depth,maxpool_bwd_mask \
+        --batches 32 --budget-compiles 0 \
+        --winner-out /tmp/sched_winner.json
+    run 1200 python bench.py --chunks 3 --no-config \
+        --schedule-config /tmp/sched_winner.json \
+        | tee /tmp/bench_schedwin.txt
+    python - <<'EOF'
+import json
+
+def best(path):
+    try:
+        return max((json.loads(l).get("value", 0.0) for l in open(path)
+                    if l.startswith('{"metric"')), default=0.0)
+    except OSError:
+        return 0.0
+
+hand = best("/tmp/bench_composed.txt")
+win = best("/tmp/bench_schedwin.txt")
+if hand and win:
+    print("schedule winner %.1f img/s vs hand-built default %.1f img/s "
+          "(%+.1f%%)" % (win, hand, 100.0 * (win - hand) / hand))
+else:
+    print("schedule-winner delta unavailable (hand=%.1f winner=%.1f)"
+          % (hand, win))
+EOF
+fi
+
 echo "-- 5. headline with the absorbed config (this is BENCH_r05's config)"
 # composed default pays the GL301 pass probes at build — same budget as
 # the step-3 composed leg
-timeout 1200 python bench.py --chunks 3
+run 1200 python bench.py --chunks 3
 
 echo "-- 6. inference (bf16 batch-128 vs the V100 fp16 BASELINE row)"
-timeout 580 python bench.py --mode infer
+run 580 python bench.py --mode infer
 
 echo "-- 6b. int8 inference through the wire"
-timeout 580 python bench.py --mode infer-int8
+run 580 python bench.py --mode infer-int8
 
 echo "-- 7. TPU consistency gate (375-op sweep + int8-wire resnet)"
-timeout 2700 python -m pytest tests/ -m tpu -q
+run 2700 python -m pytest tests/ -m tpu -q
 
 echo "-- 8. recordio-fed training (host-core bound on 1-vCPU driver)"
-timeout 1200 python bench.py --data recordio --record-format .npy --chunks 3
+run 1200 python bench.py --data recordio --record-format .npy --chunks 3
 
 echo "-- 9. attention (XLA default headline + Pallas long-seq crossover)"
-timeout 900 python bench.py --mode attention
+run 900 python bench.py --mode attention
 
 echo "-- 10. per-op TPU latency sweep (hot ResNet-50 ops + default set)"
-timeout 580 python benchmark/opperf.py --resnet --json opperf_resnet.json
-timeout 580 python benchmark/opperf.py --json opperf_default.json
+run 580 python benchmark/opperf.py --resnet --json opperf_resnet.json
+run 580 python benchmark/opperf.py --json opperf_default.json
 
 echo "-- 11. IO thread scaling (flat on a 1-core driver; per-core cost is the tracked number)"
-timeout 420 python tools/io_thread_scaling.py --images 256
+run 420 python tools/io_thread_scaling.py --images 256
 
 echo "== done $(date -u +%FT%TZ) =="
 } 2>&1 | tee "$LOG"

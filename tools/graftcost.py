@@ -112,6 +112,80 @@ def _build_model(name, feat=16, layers=4, ghost_bn=0):
     raise SystemExit("unknown --model %r (dense, conv-bn, resnet50)" % name)
 
 
+#: ResNet-50 v1 BN-layer inventory: (body C, exit C, spatial, blocks)
+#: per stage.  conv1 of each stage's first block carries the stride, so
+#: every BN in a stage sees the same H = W = spatial.
+_R50_STAGES = [
+    (64, 256, 56, 3),
+    (128, 512, 28, 4),
+    (256, 1024, 14, 6),
+    (512, 2048, 7, 3),
+]
+
+
+def _resnet50_kernel_plans(batch, itemsize, group):
+    """Per-layer fused-BN kernel-plan table for the resnet50 workload:
+    which variant (whole-L fused / lane-fold / spatial-tiled / jnp
+    fallback) each distinct BN layer selects at the real VMEM budget,
+    with the padded window bytes and fold factor the feasibility check
+    charged.  Mirrors the model zoo's dual_out wiring: every residual
+    block exit is a dual-cotangent site except the LAST stage's tail
+    block (resnet.py::_make_layer)."""
+    from incubator_mxnet_tpu.parallel.fused_bn import plan_describe
+
+    rows = [("stem", 64, 112, 1, False, False, False)]
+    last = len(_R50_STAGES) - 1
+    for i, (bc, ec, hw, k) in enumerate(_R50_STAGES):
+        s = "stage%d" % (i + 1)
+        rows.append((s + ".body", bc, hw, 2 * k, False, False, False))
+        rows.append((s + ".shortcut", ec, hw, 1, False, False, False))
+        rows.append((s + ".exit.ds", ec, hw, 1, True, True, True))
+        if i == last:
+            if k > 2:
+                rows.append((s + ".exit", ec, hw, k - 2, True, False,
+                             True))
+            rows.append((s + ".exit.tail", ec, hw, 1, True, False,
+                         False))
+        else:
+            rows.append((s + ".exit", ec, hw, k - 1, True, False, True))
+    out = []
+    for layer, c, hw, count, res, donate, dual in rows:
+        d = plan_describe(batch, c, hw, hw, itemsize, group, res,
+                          donate, dual)
+        out.append({"layer": layer, "count": count,
+                    "shape": "%dx%dx%dx%d" % (batch, c, hw, hw),
+                    "residual": res, "donate": donate, **d})
+    return out
+
+
+def _print_kernel_plans(plans, batch, itemsize, group, fmt):
+    import json as _json
+
+    if fmt == "json":
+        print(_json.dumps({"version": 1, "batch": batch,
+                           "itemsize": itemsize, "bn_group": group,
+                           "layers": plans}, indent=2))
+        return
+    print("resnet50 fused ghost-BN kernel plans — batch %d, itemsize %d, "
+          "bn_group %d" % (batch, itemsize, group))
+    hdr = ("layer", "count", "shape", "res", "dual", "variant", "bwd",
+           "fold", "l_tile", "l_tile_bwd", "window_mb")
+
+    def cell(p, h):
+        if h == "res":
+            return "res+don" if p["donate"] else \
+                ("res" if p["residual"] else "-")
+        if h == "dual":
+            return "dual" if p["dual"] else "-"
+        return str(p.get(h, "-"))
+    widths = [max(len(h), max((len(cell(p, h)) for p in plans),
+                              default=0)) for h in hdr]
+    print("  ".join("%-*s" % (w, h) for w, h in zip(widths, hdr)))
+    for p in plans:
+        print("  ".join("%-*s" % (w, cell(p, h))
+                        for w, h in zip(widths, hdr)))
+
+
 #: measured hlo_stats category (tools/profile_step.py) -> predicted
 #: CostReport category.  XLA reports fused elementwise/reduction work
 #: as "fusion" kinds, so those fold into elementwise — reduction time
@@ -242,6 +316,14 @@ def main(argv=None) -> int:
                     help="resnet50 only: fused ghost-BN variant with "
                          "this bn_group cap (0 = stock BatchNorm) — the "
                          "PERF.md fused byte table without a chip")
+    ap.add_argument("--kernel-plans", action="store_true",
+                    help="resnet50 only: print the per-layer fused-BN "
+                         "kernel-plan table (variant / window bytes / "
+                         "fold factor per distinct BN layer at the real "
+                         "VMEM budget) instead of the cost report; "
+                         "honors --batch, --compute-dtype and "
+                         "--ghost-bn (group defaults to the bench "
+                         "workload's 16)")
     ap.add_argument("--passes", default=None,
                     help="comma-separated graftpass names applied to the "
                          "step before costing (the autotune post-pass "
@@ -274,6 +356,18 @@ def main(argv=None) -> int:
         # forge enough host devices for the mesh BEFORE jax initializes
         os.environ["XLA_FLAGS"] = \
             "--xla_force_host_platform_device_count=%d" % max(ndev, 2)
+
+    if args.kernel_plans:
+        if args.model != "resnet50":
+            raise SystemExit("--kernel-plans applies to --model resnet50 "
+                             "only")
+        import jax.numpy as _jnp
+
+        itemsize = _jnp.dtype(args.compute_dtype or "float32").itemsize
+        group = args.ghost_bn or 16
+        plans = _resnet50_kernel_plans(args.batch, itemsize, group)
+        _print_kernel_plans(plans, args.batch, itemsize, group, args.fmt)
+        return 0
 
     import jax
     import jax.numpy as jnp
