@@ -86,7 +86,8 @@ semantics):
 from .autotune import (Candidate, TuningResult, autotune_serve,
                        autotune_train, fit_residual, spearman)
 from .cost_model import (DEVICE_SPECS, CostReport, DeviceSpec,
-                         analyze_jaxpr, analyze_traceable, check_cost)
+                         analyze_jaxpr, analyze_traceable, check_cost,
+                         push_volume_report)
 from .diagnostics import (CODES, Diagnostic, LintError, LintReport,
                           Severity, code_matches)
 from .passes import (PASS_REGISTRY, Contract, GraftPass, PassContext,
@@ -101,6 +102,7 @@ from .trace_lint import (check_inference_param_donation,
                          check_partition_spec, check_permutation,
                          check_process_local_ckpt_dir,
                          check_swap_compatibility, check_unbounded_skip,
+                         check_unsaved_compressor_state,
                          check_zero_state_shardings, lint_jaxpr,
                          lint_traceable, recompile_probe,
                          validate_permutation)
@@ -117,11 +119,11 @@ __all__ = [
     "check_legacy_checkpoint_path",
     "check_partition_spec", "check_permutation",
     "check_process_local_ckpt_dir", "check_swap_compatibility",
-    "check_unbounded_skip",
+    "check_unbounded_skip", "check_unsaved_compressor_state",
     "check_zero_state_shardings", "code_matches", "fit_residual",
     "get_pass", "lint_jaxpr",
     "lint_paths", "lint_source", "lint_traceable", "loss_scale_diags",
-    "recompile_probe",
+    "push_volume_report", "recompile_probe",
     "register_pass", "resolve_passes", "spearman",
     "validate_permutation",
     "RangeReport", "VRange", "analyze_ranges", "bf16_fit",

@@ -1191,3 +1191,37 @@ def check_cost(report: CostReport,
             hint="increase per-device batch (amortize the collectives) "
                  "or reduce the sharded axis size"))
     return diags
+
+
+def push_volume_report(entries, compressor=None) -> Dict[str, Any]:
+    """Trace-time pricing of one async push (``parallel/param_service``
+    wire volume), from tensor shapes alone — zero compiles spent.
+
+    ``entries`` — ``(name, shape, dtype)`` triples, one per pushed
+    gradient (the step's trainable params).  ``compressor`` — an
+    error-feedback compressor from ``kvstore/gradient_compression``
+    (``payload_nbytes(shape, dtype)`` protocol) or ``None`` for dense
+    f32 pushes.  Returns a JSON-serializable dict: per-tensor and total
+    compressed/dense bytes and the overall reduction ratio — what
+    ``TrainStep.analyze_cost`` attaches as ``report.meta["push_volume"]``
+    on async/compressed steps.
+    """
+    rows = []
+    total_c = total_d = 0
+    for name, shape, dtype in entries:
+        n = int(np.prod(shape, dtype=np.int64)) if len(shape) else 1
+        dense = n * 4  # the uncompressed wire is f32 regardless of dtype
+        comp = dense if compressor is None else \
+            int(compressor.payload_nbytes(tuple(shape), dtype))
+        rows.append({"name": str(name), "shape": tuple(int(s) for s in shape),
+                     "dense_nbytes": int(dense),
+                     "push_nbytes": int(comp)})
+        total_c += comp
+        total_d += dense
+    return {"compressor": None if compressor is None
+            else getattr(compressor, "kind", type(compressor).__name__),
+            "tensors": rows,
+            "push_nbytes": int(total_c),
+            "dense_nbytes": int(total_d),
+            "reduction": (float(total_d) / float(total_c))
+            if total_c else 1.0}

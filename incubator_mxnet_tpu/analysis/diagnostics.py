@@ -86,6 +86,14 @@ CODES = {
               "failed one); use loss_scale='dynamic' or set "
               "skip_streak_budget= so the supervisor's divergence "
               "detector bounds the streak"),
+    "GL013": (Severity.WARNING,
+              "error-feedback gradient compression active but its "
+              "residual state can never reach the checkpoint save set — "
+              "a resumed run silently drops the accumulated residual "
+              "and the compression stops being unbiased over time; use "
+              "sync='async'/'auto' (the param_service checkpoint "
+              "subtree carries compressor state) or checkpoint the "
+              "compressor's state_dict() yourself"),
     "GL201": (Severity.ERROR,
               "graftcost: predicted peak live-buffer memory exceeds the "
               "HBM budget — the program is infeasible at this config; "

@@ -419,6 +419,39 @@ def check_unbounded_skip(nonfinite: str, dynamic_scale: bool,
              "verdict (docs/RESILIENCE.md §7)")]
 
 
+def check_unsaved_compressor_state(compression, sync: str,
+                                   where: str = "") -> List[Diagnostic]:
+    """GL013 core: an error-feedback compressor bound to a step whose
+    checkpoint save set can never include its residual state.
+
+    Error-feedback compression is only unbiased *over time*: whatever a
+    step's sparsification/quantization drops is banked in the residual
+    and re-injected into the next gradient.  On the async rungs
+    (``sync='async'|'auto'``) the compressor rides the step's
+    ``param_service`` checkpoint subtree, so kill-and-resume keeps the
+    bank.  On ``sync='allreduce'`` the step's checkpoint state has no
+    compressor slot at all — a resumed run restarts the residual at
+    zero, silently re-dropping everything banked since the last push,
+    and loss parity with the uncompressed run quietly degrades.  The
+    GL008 analogy, for compressor state instead of iterator state.
+    """
+    if compression is None or sync != "allreduce":
+        return []
+    kind = getattr(compression, "kind", type(compression).__name__)
+    return [Diagnostic(
+        "GL013", Severity.WARNING,
+        "error-feedback compression (%r) on a sync='allreduce' step: "
+        "the residual state is not in the checkpoint save set, so a "
+        "resumed run silently drops the accumulated residual and the "
+        "compression stops being unbiased over time" % (kind,),
+        where=where,
+        hint="build the step with sync='async' or sync='auto' — its "
+             "param_service checkpoint subtree carries the compressor's "
+             "state_dict() — or persist "
+             "compressor.state_dict()/load_state_dict() alongside your "
+             "own checkpoints (docs/RESILIENCE.md §8)")]
+
+
 def check_inference_param_donation(donated_leaves, param_leaves,
                                    where: str = "") -> List[Diagnostic]:
     """GL010 core: an *inference* program whose donated flat invars

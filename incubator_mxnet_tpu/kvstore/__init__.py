@@ -1,6 +1,11 @@
 """``mx.kv`` — KVStore (python/mxnet/kvstore parity)."""
 from .dist import DistKVStore, init_process_group, is_initialized
+from .gradient_compression import (GradientCompression, Int8Compressor,
+                                   RandomKCompressor, TopKCompressor,
+                                   decompress_payload, make_compressor)
 from .kvstore import KVStore, KVStoreBase, create
 
 __all__ = ["KVStore", "KVStoreBase", "DistKVStore", "create",
-           "init_process_group", "is_initialized"]
+           "init_process_group", "is_initialized",
+           "GradientCompression", "TopKCompressor", "RandomKCompressor",
+           "Int8Compressor", "make_compressor", "decompress_payload"]

@@ -1,36 +1,126 @@
-"""2-bit gradient compression with error feedback (reference:
+"""Gradient compression with error feedback (reference:
 src/kvstore/gradient_compression.h:52,79 + .cu kernels).
 
-Semantics: each gradient element compresses to one of
-{-threshold, 0, +threshold}; the quantization residual is accumulated
-into the next step's gradient (error feedback), so the compression is
-unbiased over time.  On TPU the wire format is moot (gradients ride ICI
-inside XLA collectives) but the numerics are the contract the reference
-tests (tests/nightly/dist_sync_kvstore.py 2-bit checks), and int8
-all-reduce can reuse this path.
+Semantics: each compressor maps a gradient to a smaller wire payload;
+the quantization/sparsification residual is accumulated into the next
+step's gradient (error feedback), so the compression is unbiased over
+time.  On TPU the wire format is moot for the allreduce path (gradients
+ride ICI inside XLA collectives) but it is exactly what the async
+push/pull parameter service (``parallel/param_service.py``) sends per
+push, so the payload sizes here ARE the push volume graftcost prices
+(``analysis/cost_model.py::push_volume_report``).
+
+Compressors:
+
+- :class:`GradientCompression` — the reference 2-bit ternary
+  compressor (``gradient_compression.h`` kGradientCompression2Bit):
+  each element becomes one of ``{-t, 0, +t}``; dense wire format
+  (decompress is the identity), numerics-parity with the reference's
+  dist_sync 2-bit tests.
+- :class:`TopKCompressor` — keep the k largest-|g| elements per tensor
+  (``ratio`` of the size); wire format is (int32 indices, f32 values).
+- :class:`RandomKCompressor` — keep k elements chosen by a
+  deterministic per-(key, step) hash permutation — no data-dependent
+  selection, so both sides can agree on indices cheaply.
+- :class:`Int8Compressor` — symmetric int8 quantization through
+  ``ops.quantization.symmetric_quantize`` (amax-scaled codes, the
+  serving quantizer's exact primitive): 4x smaller pushes, dense
+  shape.
+
+All compressors share the **error-feedback state protocol**:
+``state_dict()`` / ``load_state_dict()`` expose the per-key residuals
+as an array-leaved dict, so the accumulated residual survives
+kill-and-resume through ``CheckpointManager`` instead of being
+silently dropped (the GL013 hazard, docs/ANALYSIS.md).  Residual
+updates run through a jitted, donated program off-CPU (the residual is
+device-carried step state, like the loss-scale counters).
 """
 from __future__ import annotations
 
+from typing import Dict, Optional
+
+import jax
 import jax.numpy as jnp
+import numpy as np
 
-__all__ = ["GradientCompression"]
+__all__ = ["GradientCompression", "TopKCompressor", "RandomKCompressor",
+           "Int8Compressor", "make_compressor", "decompress_payload"]
 
 
-class GradientCompression:
+def _donate_ok() -> bool:
+    """Buffer donation is a no-op (with a warning) on the CPU backend;
+    donate the residual only where the runtime honors it."""
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:  # pragma: no cover — backend probe must not raise
+        return False
+
+
+_EF_ADD = None  # lazily jitted residual carry-in (residual donated)
+
+
+def _ef_carry(grad, residual):
+    return grad + residual.astype(grad.dtype)
+
+
+class _ErrorFeedback:
+    """Shared error-feedback residual store + checkpoint protocol."""
+
+    def __init__(self):
+        self._residual: Dict[str, jax.Array] = {}
+
+    # -- checkpoint protocol -------------------------------------------
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        """Array-leaved residual state, keyed by push key — rides a
+        ``CheckpointManager`` pytree as-is (and the fused step's
+        ``param_service`` checkpoint subtree)."""
+        return {k: np.asarray(v) for k, v in sorted(self._residual.items())}
+
+    def load_state_dict(self, state: Dict) -> None:
+        """Restore residuals saved by :meth:`state_dict`.  Unknown keys
+        are refused loudly — a silently dropped residual is exactly the
+        bug this protocol exists to prevent."""
+        if state is None:
+            return
+        self._residual = {str(k): jnp.asarray(v)
+                          for k, v in dict(state).items()}
+
+    def reset_state(self) -> None:
+        self._residual = {}
+
+    def _carry_in(self, key, grad):
+        """grad + residual through a jitted program whose residual
+        operand is DONATED off-CPU: the old residual buffer dies here
+        and the new one (written by ``compress``) replaces it — the
+        residual is device-carried step state, never two live copies."""
+        r = self._residual.get(key)
+        if r is None:
+            return grad
+        global _EF_ADD
+        if _EF_ADD is None:
+            _EF_ADD = jax.jit(
+                _ef_carry, donate_argnums=(1,) if _donate_ok() else ())
+        return _EF_ADD(jnp.asarray(grad), jnp.asarray(r))
+
+
+class GradientCompression(_ErrorFeedback):
+    """Reference-parity 2-bit ternary compressor (dense wire format)."""
+
+    kind = "2bit"
+
     def __init__(self, type="2bit", threshold=0.5):  # noqa: A002
         if str(type) != "2bit":
             raise ValueError("only 2bit compression is supported "
                              "(gradient_compression.h kGradientCompression2Bit)")
+        super().__init__()
         self.type = str(type)
         self.threshold = float(threshold)
-        self._residual = {}
 
     def compress(self, key, grad):
         """grad (+ residual) → ternary {-t, 0, +t}; residual updated
         (gradient_compression.h Quantize2Bit)."""
         t = self.threshold
-        r = self._residual.get(key)
-        g = grad + r if r is not None else grad
+        g = self._carry_in(key, grad)
         q = jnp.where(g >= t, t, jnp.where(g <= -t, -t, 0.0))
         q = q.astype(grad.dtype)
         self._residual[key] = g - q
@@ -39,3 +129,188 @@ class GradientCompression:
     def decompress(self, key, q):
         """Identity — q already carries the ternary values."""
         return q
+
+    def payload_nbytes(self, shape, dtype) -> int:
+        # 2 bits per element on the reference wire
+        return -(-int(np.prod(shape, dtype=np.int64)) // 4)
+
+
+def _k_of(shape, ratio) -> int:
+    n = int(np.prod(shape, dtype=np.int64))
+    return max(1, min(n, int(np.ceil(n * ratio))))
+
+
+def _topk_step(g_flat, k):
+    """(values, int32 indices, residual) of the k largest-|g| elements."""
+    _, idx = jax.lax.top_k(jnp.abs(g_flat), k)
+    val = g_flat[idx]
+    res = g_flat.at[idx].set(0.0)
+    return val, idx.astype(jnp.int32), res
+
+
+def _select_step(g_flat, idx):
+    val = g_flat[idx]
+    res = g_flat.at[idx].set(0.0)
+    return val, res
+
+
+class _SparseCompressor(_ErrorFeedback):
+    """Shared top-k/random-k machinery: sparse (indices, values)
+    payloads with error feedback."""
+
+    def __init__(self, ratio=0.01):
+        super().__init__()
+        if not 0.0 < float(ratio) <= 1.0:
+            raise ValueError("ratio must be in (0, 1], got %r" % (ratio,))
+        self.ratio = float(ratio)
+        self._step_of: Dict[str, int] = {}
+
+    def _indices(self, key, g_flat, k):
+        raise NotImplementedError
+
+    # per-key step counters ride the checkpoint too: RandomKCompressor's
+    # index choice is a function of (seed, key, step) — a resume that
+    # reset the counters would replay the same positions and break the
+    # bit-identical-tail guarantee
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        state = super().state_dict()
+        return {"residual": state,
+                "step_of": {k: np.int64(v)
+                            for k, v in sorted(self._step_of.items())}}
+
+    def load_state_dict(self, state: Dict) -> None:
+        if state is None:
+            return
+        state = dict(state)
+        if "residual" in state or "step_of" in state:
+            super().load_state_dict(state.get("residual") or {})
+            self._step_of = {str(k): int(v)
+                             for k, v in dict(state.get("step_of")
+                                              or {}).items()}
+        else:  # flat residual dict from the shared protocol
+            super().load_state_dict(state)
+
+    def reset_state(self) -> None:
+        super().reset_state()
+        self._step_of = {}
+
+    def compress(self, key, grad) -> Dict:
+        g = self._carry_in(key, grad)
+        shape, dtype = g.shape, g.dtype
+        g_flat = g.reshape(-1).astype(jnp.float32)
+        k = _k_of(shape, self.ratio)
+        idx = self._indices(key, g_flat, k)
+        if idx is None:  # data-dependent selection (top-k)
+            val, idx, res = _topk_step(g_flat, k)
+        else:
+            val, res = _select_step(g_flat, idx)
+        self._residual[key] = res.reshape(shape).astype(dtype)
+        self._step_of[key] = self._step_of.get(key, 0) + 1
+        return {"kind": self.kind, "shape": tuple(shape),
+                "dtype": str(np.dtype(dtype)), "idx": idx, "val": val}
+
+    def decompress(self, key, payload):
+        return decompress_payload(payload)
+
+    def payload_nbytes(self, shape, dtype) -> int:
+        k = _k_of(shape, self.ratio)
+        return k * (4 + 4)  # int32 index + f32 value per kept element
+
+
+class TopKCompressor(_SparseCompressor):
+    """Keep the ``ratio`` fraction of largest-|g| elements per tensor."""
+
+    kind = "topk"
+
+    def _indices(self, key, g_flat, k):
+        return None  # data-dependent: top-k inside the jitted step
+
+
+class RandomKCompressor(_SparseCompressor):
+    """Keep k elements at deterministic per-(key, step) positions — a
+    hash-seeded permutation both ends can reproduce without shipping
+    data-dependent indices."""
+
+    kind = "randomk"
+
+    def __init__(self, ratio=0.01, seed=0):
+        super().__init__(ratio)
+        self.seed = int(seed)
+
+    def _indices(self, key, g_flat, k):
+        step = self._step_of.get(key, 0)
+        rk = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(self.seed),
+                               hash(str(key)) & 0x7FFFFFFF), step)
+        n = g_flat.shape[0]
+        return jax.random.choice(rk, n, shape=(min(k, n),),
+                                 replace=False).astype(jnp.int32)
+
+
+class Int8Compressor(_ErrorFeedback):
+    """Symmetric int8 quantized pushes via
+    ``ops.quantization.symmetric_quantize`` — amax-scaled codes, 4x
+    smaller than f32 on the wire, degenerate tensors (all-zero / NaN
+    amax) contained by the quantizer's guard."""
+
+    kind = "int8"
+
+    def __init__(self):
+        super().__init__()
+
+    def compress(self, key, grad) -> Dict:
+        from ..ops.quantization import dequantize_tensor, symmetric_quantize
+
+        g = self._carry_in(key, grad)
+        q, amax = symmetric_quantize(g.astype(jnp.float32))
+        deq = dequantize_tensor(q, amax, dtype=jnp.float32)
+        self._residual[key] = (g.astype(jnp.float32) - deq).astype(g.dtype)
+        return {"kind": self.kind, "shape": tuple(g.shape),
+                "dtype": str(np.dtype(g.dtype)), "q": q, "amax": amax}
+
+    def decompress(self, key, payload):
+        return decompress_payload(payload)
+
+    def payload_nbytes(self, shape, dtype) -> int:
+        return int(np.prod(shape, dtype=np.int64)) + 4  # codes + amax
+
+
+def decompress_payload(payload):
+    """Dense gradient from a compressor payload dict (or a dense array
+    passed through uncompressed/2-bit) — the server side of the push
+    wire format."""
+    if not isinstance(payload, dict):
+        return jnp.asarray(payload)
+    kind = payload["kind"]
+    dtype = jnp.dtype(payload["dtype"])
+    shape = tuple(payload["shape"])
+    if kind in ("topk", "randomk"):
+        n = int(np.prod(shape, dtype=np.int64))
+        dense = jnp.zeros((n,), jnp.float32).at[payload["idx"]].set(
+            payload["val"])
+        return dense.reshape(shape).astype(dtype)
+    if kind == "int8":
+        from ..ops.quantization import dequantize_tensor
+
+        return dequantize_tensor(payload["q"], payload["amax"],
+                                 dtype=jnp.float32).reshape(shape).astype(dtype)
+    raise ValueError("unknown push payload kind %r" % (kind,))
+
+
+def make_compressor(spec, **kwargs) -> Optional[_ErrorFeedback]:
+    """Compressor from a spec: ``None`` (off), an instance (returned
+    as-is), one of ``"2bit" | "topk" | "randomk" | "int8"`` with
+    constructor kwargs (``ratio=``, ``threshold=``, ``seed=``), or a
+    dict ``{"kind": "topk", "ratio": 0.05}`` (the CLI/JSON form)."""
+    if spec is None or isinstance(spec, _ErrorFeedback):
+        return spec
+    if isinstance(spec, dict):
+        spec = dict(spec)
+        kind = spec.pop("kind")
+        return make_compressor(kind, **{**spec, **kwargs})
+    table = {"2bit": GradientCompression, "topk": TopKCompressor,
+             "randomk": RandomKCompressor, "int8": Int8Compressor}
+    if str(spec) not in table:
+        raise ValueError("unknown compression %r (known: %s)"
+                         % (spec, sorted(table)))
+    return table[str(spec)](**kwargs)

@@ -16,6 +16,8 @@ from .checkpoint import (CheckpointError, CheckpointCorruptError,
 from .supervisor import (DivergenceDetector, DivergenceError, HealthLedger,
                          HeartbeatEmitter, Supervisor, SupervisorConfig,
                          SupervisorError, run_supervised)
+from .param_service import (ParamService, ServiceClient, ServiceUpdater,
+                            StalenessClock, StalenessTimeout, SyncPolicy)
 from . import distributed
 
 __all__ = ["Mesh", "NamedSharding", "P", "PartitionSpec", "make_mesh",
@@ -30,4 +32,6 @@ __all__ = ["Mesh", "NamedSharding", "P", "PartitionSpec", "make_mesh",
            "DivergenceDetector", "DivergenceError", "HealthLedger",
            "HeartbeatEmitter", "Supervisor", "SupervisorConfig",
            "SupervisorError", "run_supervised",
+           "ParamService", "ServiceClient", "ServiceUpdater",
+           "StalenessClock", "StalenessTimeout", "SyncPolicy",
            "distributed"]

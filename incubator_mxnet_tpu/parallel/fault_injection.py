@@ -54,6 +54,18 @@ Deterministic, test-grade fault injectors for the failure classes
   only a checkpoint rollback restores health) — together they drive
   ``tests/test_supervisor.py`` and the ``tools/supervise.py --chaos``
   matrix;
+- **async push/pull chaos** — :func:`slow_link` adds per-message
+  latency to one rank's (or every rank's) pushes and pulls through the
+  parameter-service transport choke points
+  (``parallel/param_service.py::_deliver_push``/``_deliver_pull``,
+  exactly like ``hang_step`` interposes ``supervisor._run_step``) —
+  the slow-NIC straggler whose peers must keep training inside the
+  staleness bound; :func:`drop_push` deterministically loses a
+  fraction of push payloads on the wire (the step still completes —
+  fire-and-forget semantics — so the clock advances while the update
+  is gone), the lossy-transport case error-feedback compression and
+  the bounded-staleness invariant must both survive — together they
+  drive ``tests/test_param_service.py``;
 - **host loss** — :func:`kill_process` is a REAL ungraceful process
   death (SIGKILL: no atexit, no flushes — what a preempted VM looks
   like), :func:`host_loss_during_save` arms it on the N-th checkpoint
@@ -82,12 +94,13 @@ import numpy as np
 
 __all__ = ["NaNInjector", "burst_arrivals", "coordinator_unreachable",
            "corrupt_checkpoint", "corrupt_compile_cache", "deadline_storm",
-           "engine_failure_burst",
+           "drop_push", "engine_failure_burst",
            "fail_writes", "flaky_reads", "hang_step",
            "host_loss_during_save", "kill_batcher_worker",
            "kill_process", "kill_worker", "loss_bomb",
            "malformed_request",
-           "nan_params", "poison_batch", "slow_client", "slow_reads",
+           "nan_params", "poison_batch", "slow_client", "slow_link",
+           "slow_reads",
            "straggler_process", "truncate_record"]
 
 
@@ -636,6 +649,97 @@ def loss_bomb(at=0, factor=1e4):
         return real(step, x, y)
 
     with _patched_run_step(bomb):
+        yield stats
+
+
+# ---------------------------------------------------------------------------
+# async push/pull chaos (parallel/param_service.py)
+# ---------------------------------------------------------------------------
+
+@contextmanager
+def _patched_transport(push=None, pull=None):
+    """Interpose the parameter-service transport choke points
+    (``parallel/param_service.py::_deliver_push``/``_deliver_pull`` —
+    every client push/pull goes through them) with
+    ``push(real_push, service, rank, updates)`` and/or
+    ``pull(real_pull, service, rank, timeout)``."""
+    from . import param_service as _ps
+
+    real_push, real_pull = _ps._deliver_push, _ps._deliver_pull
+    if push is not None:
+        _ps._deliver_push = \
+            lambda svc, rank, updates: push(real_push, svc, rank, updates)
+    if pull is not None:
+        _ps._deliver_pull = \
+            lambda svc, rank, timeout: pull(real_pull, svc, rank, timeout)
+    try:
+        yield
+    finally:
+        _ps._deliver_push, _ps._deliver_pull = real_push, real_pull
+
+
+@contextmanager
+def slow_link(rank, delay_s):
+    """Add ``delay_s`` seconds to every push AND pull of ``rank``
+    (``None`` = every rank) — the slow-NIC/congested-link straggler as
+    seen from the parameter service: the slowed rank's clock falls
+    behind while healthy peers keep pushing, so the bounded-staleness
+    invariant (peers block only past ``staleness_bound``) is exercised
+    for real rather than simulated.  Yields a stats object whose
+    ``.delayed`` counts injected latencies."""
+    class _Stats:
+        pushes = 0
+        pulls = 0
+        delayed = 0
+
+    stats = _Stats()
+
+    def spush(real, svc, r, updates):
+        stats.pushes += 1
+        if rank is None or r == rank:
+            stats.delayed += 1
+            time.sleep(delay_s)
+        return real(svc, r, updates)
+
+    def spull(real, svc, r, timeout):
+        stats.pulls += 1
+        if rank is None or r == rank:
+            stats.delayed += 1
+            time.sleep(delay_s)
+        return real(svc, r, timeout)
+
+    with _patched_transport(push=spush, pull=spull):
+        yield stats
+
+
+@contextmanager
+def drop_push(p, seed=0):
+    """Deterministically lose fraction ``p`` of push PAYLOADS on the
+    wire: the dropped push still commits its step (fire-and-forget —
+    the clock advances, so no peer deadlocks on a lossy link) but the
+    gradient update never reaches the server.  Training must degrade
+    gracefully — with error-feedback compression the next surviving
+    push re-carries what the residual banked, NOT silently diverge.
+    Yields a stats object whose ``.dropped``/``.seen`` count pushes."""
+    if not 0.0 <= float(p) <= 1.0:
+        raise ValueError("drop probability must be in [0, 1], got %r"
+                         % (p,))
+    rng = np.random.default_rng(int(seed))
+
+    class _Stats:
+        seen = 0
+        dropped = 0
+
+    stats = _Stats()
+
+    def drop(real, svc, r, updates):
+        stats.seen += 1
+        if rng.random() < float(p):
+            stats.dropped += 1
+            return real(svc, r, {})  # payload lost, step still commits
+        return real(svc, r, updates)
+
+    with _patched_transport(push=drop):
         yield stats
 
 

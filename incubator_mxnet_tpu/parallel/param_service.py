@@ -1,0 +1,483 @@
+"""Bounded-staleness async parameter service (ps-lite's asynchronous
+push/pull kvstore — ``kvstore_dist_server.h`` — rebuilt jax-native on
+the PR-7 process protocol; SURVEY §2.9, ROADMAP item 5).
+
+Three pieces, composable and individually testable:
+
+- :class:`ParamService` — the server: authoritative parameter buffers,
+  a server-side optimizer (:class:`ServiceUpdater` wrapping the fused
+  step's :class:`~.train_step.FunctionalOptimizer`), and the
+  **bounded-staleness clock** (:class:`StalenessClock`).  Each rank may
+  run up to ``staleness_bound`` steps ahead of the slowest live peer
+  before its pull blocks; ``staleness_bound=0`` is BSP (every pull
+  waits for all peers — synchronous semantics over the async wire).
+  Keys are dp-sharded across ``num_shards`` server shards by stable
+  hash (ps-lite's server partitioning; per-shard push volume is
+  accounted for graftcost).  Ranks join/leave with
+  :meth:`ParamService.register` / :meth:`~ParamService.deregister` —
+  a departed straggler stops holding the staleness bound hostage, the
+  elastic analog of the checkpoint protocol's width changes.
+
+- :class:`ServiceClient` — the rank-side half: compresses pushes
+  through the error-feedback compressors
+  (``kvstore/gradient_compression.py`` — top-k / random-k / int8 /
+  2-bit), decompression happens server-side from the self-describing
+  payload.  ``state_dict()`` / ``load_state_dict()`` checkpoint the
+  compressor residuals, the per-key sparse step counters and (when the
+  client owns its service) the full server state + staleness clock, so
+  kill-and-resume is bit-identical on the unfaulted path.
+
+- :class:`SyncPolicy` — the sync→async policy ladder: under
+  ``mode="auto"`` the supervisor's straggler verdicts
+  (``supervisor.straggler_verdicts``) degrade the step from allreduce
+  to async push/pull after ``degrade_after`` consecutive straggler
+  observations, and recover back after ``recover_after`` clean ones.
+  Pure state machine — the fast tier-1 representative of the chaos
+  matrix's async-degradation leg.
+
+All transport flows through the module-level :func:`_deliver_push` /
+:func:`_deliver_pull` choke points so the fault harness can interpose
+link slowdowns and push loss (``fault_injection.slow_link`` /
+``drop_push``) without touching the service.
+
+Thread-based by design: CPU jaxlib cannot compile cross-process
+programs (``distributed.collectives_supported``), so the in-process
+service is the tier-1 story; multi-process ranks reach the same
+object through the legacy wire host (``kvstore/async_host.py``) or a
+future RPC transport — the protocol (push payloads, clock semantics,
+checkpoint state) is transport-agnostic.
+"""
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["ParamService", "ServiceClient", "ServiceUpdater",
+           "StalenessClock", "SyncPolicy", "StalenessTimeout"]
+
+
+class StalenessTimeout(RuntimeError):
+    """A bounded-staleness pull waited past its deadline — the slowest
+    live peer never caught up (a hung rank that nothing deregistered)."""
+
+
+class StalenessClock:
+    """Per-rank committed-push counts over the set of LIVE ranks.
+
+    ``staleness(rank) = count[rank] - min(live counts)`` — how far this
+    rank has run ahead of the slowest live peer.  The service blocks a
+    pull while ``staleness(rank) > bound``.  Not thread-safe by itself;
+    the service serializes access under its condition lock."""
+
+    def __init__(self):
+        self._count: Dict[int, int] = {}
+        self._live: Dict[int, bool] = {}
+
+    def register(self, rank: int, at_step: Optional[int] = None) -> None:
+        """Join (or re-join) at ``at_step`` — defaults to the current
+        minimum so a fresh rank neither blocks on day-one staleness nor
+        releases peers early."""
+        if rank not in self._count or at_step is not None:
+            self._count[rank] = int(at_step) if at_step is not None \
+                else self.min_step()
+        self._live[rank] = True
+
+    def deregister(self, rank: int) -> None:
+        self._live[rank] = False
+
+    def advance(self, rank: int) -> int:
+        self._count[rank] = self._count.get(rank, 0) + 1
+        return self._count[rank]
+
+    def step(self, rank: int) -> int:
+        return self._count.get(rank, 0)
+
+    def live_ranks(self) -> List[int]:
+        return sorted(r for r, ok in self._live.items() if ok)
+
+    def min_step(self) -> int:
+        live = [self._count[r] for r, ok in self._live.items() if ok]
+        return min(live) if live else 0
+
+    def staleness(self, rank: int) -> int:
+        return self.step(rank) - self.min_step()
+
+    # -- checkpoint protocol -------------------------------------------
+    def state_dict(self) -> Dict[str, Dict[str, np.ndarray]]:
+        return {"count": {str(r): np.int64(c)
+                          for r, c in sorted(self._count.items())},
+                "live": {str(r): np.int64(1 if ok else 0)
+                         for r, ok in sorted(self._live.items())}}
+
+    def load_state_dict(self, state: Dict) -> None:
+        self._count = {int(r): int(c)
+                       for r, c in dict(state["count"]).items()}
+        self._live = {int(r): bool(int(v))
+                      for r, v in dict(state["live"]).items()}
+
+
+class ServiceUpdater:
+    """Server-side optimizer: one
+    :class:`~.train_step.FunctionalOptimizer` state per key, applied
+    per push (ps-lite's async ``ApplyUpdates`` semantics — every push
+    is its own update; there is no cross-rank gradient barrier)."""
+
+    def __init__(self, optimizer=None):
+        if optimizer is None:
+            from .train_step import FunctionalOptimizer
+
+            optimizer = FunctionalOptimizer("sgd", learning_rate=0.01,
+                                            momentum=0.0)
+        self.opt = optimizer
+        self._state: Dict[str, Any] = {}
+        self._count: Dict[str, int] = {}
+
+    def init_key(self, key: str, value) -> None:
+        if key in self._count:
+            return
+        self._count[key] = 0
+        if self.opt.has_state:
+            self._state[key] = self.opt.init([jnp.asarray(value)])[0]
+
+    def apply(self, key: str, weight, grad):
+        """One applied update: ``(weight, grad) -> new_weight`` with the
+        per-key state and 1-based count (adam bias correction)."""
+        self._count[key] = self._count.get(key, 0) + 1
+        s = self._state.get(key) if self.opt.has_state else None
+        w2, s2 = self.opt.apply_single(jnp.asarray(weight),
+                                       jnp.asarray(grad), s,
+                                       self._count[key])
+        if self.opt.has_state:
+            self._state[key] = s2
+        return w2
+
+    def state_dict(self) -> Dict:
+        return {"count": {k: np.int64(v)
+                          for k, v in sorted(self._count.items())},
+                "state": {k: self._state[k]
+                          for k in sorted(self._state)}}
+
+    def load_state_dict(self, state: Dict) -> None:
+        self._count = {str(k): int(v)
+                       for k, v in dict(state["count"]).items()}
+        self._state = {str(k): v for k, v in dict(state["state"]).items()}
+
+
+def _payload_nbytes(payload) -> int:
+    """Wire bytes of one push payload (compressed dict or dense array)."""
+    if isinstance(payload, dict):
+        n = 0
+        for k, v in payload.items():
+            if hasattr(v, "nbytes"):
+                n += int(v.nbytes)
+            elif hasattr(v, "dtype"):  # 0-d jax scalar
+                n += int(np.dtype(v.dtype).itemsize)
+        return n
+    return int(np.asarray(payload).nbytes)
+
+
+def _dense_nbytes(payload, fallback) -> int:
+    if isinstance(payload, dict):
+        shape, dtype = payload["shape"], payload["dtype"]
+        return int(np.prod(shape, dtype=np.int64)
+                   * np.dtype(dtype).itemsize)
+    return int(np.asarray(fallback if fallback is not None
+                          else payload).nbytes)
+
+
+# ---------------------------------------------------------------------------
+# transport choke points — the fault harness interposes HERE
+# (fault_injection.slow_link / drop_push), like supervisor._run_step
+# ---------------------------------------------------------------------------
+
+def _deliver_push(service: "ParamService", rank: int, updates: Dict):
+    """The one path every push takes from a client into the service."""
+    return service._apply_push(rank, updates)
+
+
+def _deliver_pull(service: "ParamService", rank: int,
+                  timeout: Optional[float]):
+    """The one path every pull takes — blocking happens inside."""
+    return service._collect_pull(rank, timeout)
+
+
+class ParamService:
+    """In-process bounded-staleness parameter server (thread-safe)."""
+
+    def __init__(self, updater: Optional[ServiceUpdater] = None,
+                 staleness_bound: int = 4, num_shards: int = 1):
+        if int(staleness_bound) < 0:
+            raise ValueError("staleness_bound must be >= 0, got %r"
+                             % (staleness_bound,))
+        if int(num_shards) < 1:
+            raise ValueError("num_shards must be >= 1, got %r"
+                             % (num_shards,))
+        self.staleness_bound = int(staleness_bound)
+        self.num_shards = int(num_shards)
+        self.updater = updater or ServiceUpdater()
+        self.clock = StalenessClock()
+        self._params: Dict[str, Any] = {}
+        self._versions: Dict[str, int] = {}
+        self._cv = threading.Condition()
+        # -- observability / accounting ---------------------------------
+        self.max_observed_staleness = 0   # over every pull ever served
+        self.push_nbytes = 0              # wire bytes actually pushed
+        self.push_dense_nbytes = 0        # what uncompressed would cost
+        self.shard_push_nbytes = [0] * self.num_shards
+        self.pulls_blocked = 0            # pulls that had to wait
+
+    # -- membership -----------------------------------------------------
+    def register(self, rank: int, at_step: Optional[int] = None) -> None:
+        with self._cv:
+            self.clock.register(rank, at_step)
+            self._cv.notify_all()
+
+    def deregister(self, rank: int) -> None:
+        """A departed rank stops counting toward the staleness minimum —
+        waiters re-evaluate immediately (elastic leave; a SIGKILLed
+        straggler is deregistered by its supervisor)."""
+        with self._cv:
+            self.clock.deregister(rank)
+            self._cv.notify_all()
+
+    # -- key space ------------------------------------------------------
+    def shard_of(self, key: str) -> int:
+        return zlib.crc32(str(key).encode()) % self.num_shards
+
+    def init(self, key: str, value) -> None:
+        """Rank-0-wins init semantics (kvstore ``init``): the first
+        value for a key sticks, later inits are no-ops.  The service
+        stores its OWN copy — the caller's buffer may later be donated
+        by a fused step program."""
+        with self._cv:
+            if key not in self._params:
+                self._params[key] = jnp.array(value)  # copy, not alias
+                self._versions[key] = 0
+                self.updater.init_key(key, value)
+
+    def sync_params(self, named_values: Dict) -> None:
+        """Force-overwrite the authoritative params (no rank-0-wins):
+        the policy ladder calls this on a sync→async degrade so the
+        service resumes from the collective rung's CURRENT state, not
+        its seed-time snapshot.  Values are copied."""
+        with self._cv:
+            for key, v in named_values.items():
+                if key not in self._params:
+                    raise KeyError("sync_params to uninitialized key %r"
+                                   % (key,))
+                self._params[key] = jnp.array(v)  # copy, not alias
+                self._versions[key] += 1
+            self._cv.notify_all()
+
+    def keys(self) -> List[str]:
+        with self._cv:
+            return sorted(self._params)
+
+    # -- push/pull (reached through the module choke points) ------------
+    def push(self, rank: int, updates: Dict, commit: bool = True):
+        """Apply one step's (possibly compressed) gradient payloads and
+        advance the pusher's clock.  ``updates`` maps key -> payload
+        (a dense array, or a compressor payload dict)."""
+        return _deliver_push(self, rank, updates) if commit \
+            else self._apply_push(rank, updates, commit=False)
+
+    def pull(self, rank: int, timeout: Optional[float] = None) -> Dict:
+        """All parameters, BLOCKING while this rank's effective
+        staleness exceeds ``staleness_bound``.  Raises
+        :class:`StalenessTimeout` past ``timeout`` seconds (None waits
+        forever).  Returns ``{key: value}``; the bounded-staleness
+        invariant is observable as :attr:`max_observed_staleness`."""
+        return _deliver_pull(self, rank, timeout)
+
+    def _apply_push(self, rank: int, updates: Dict, commit: bool = True):
+        from ..kvstore.gradient_compression import decompress_payload
+
+        dense = {k: decompress_payload(v) for k, v in updates.items()}
+        with self._cv:
+            for key, g in dense.items():
+                if key not in self._params:
+                    raise KeyError("push to uninitialized key %r" % (key,))
+                self._params[key] = self.updater.apply(
+                    key, self._params[key], g)
+                self._versions[key] += 1
+                nb = _payload_nbytes(updates[key])
+                self.push_nbytes += nb
+                self.push_dense_nbytes += _dense_nbytes(updates[key], g)
+                self.shard_push_nbytes[self.shard_of(key)] += nb
+            if commit:
+                self.clock.advance(rank)
+                self._cv.notify_all()
+
+    def _collect_pull(self, rank: int, timeout: Optional[float]):
+        import time as _time
+
+        end = None if timeout is None else _time.monotonic() + timeout
+        with self._cv:
+            waited = False
+            while self.clock.staleness(rank) > self.staleness_bound:
+                if not waited:
+                    self.pulls_blocked += 1
+                    waited = True
+                remaining = None if end is None else end - _time.monotonic()
+                if (remaining is not None and remaining <= 0) or \
+                        not self._cv.wait(timeout=remaining):
+                    raise StalenessTimeout(
+                        "rank %d pull blocked > %.1fs at staleness %d "
+                        "(bound %d; live ranks %s, clock %s) — a hung "
+                        "peer nothing deregistered"
+                        % (rank, timeout, self.clock.staleness(rank),
+                           self.staleness_bound, self.clock.live_ranks(),
+                           {r: self.clock.step(r)
+                            for r in self.clock.live_ranks()}))
+            # the staleness every pull OBSERVES is bounded by
+            # construction: record it so tests can assert the invariant
+            obs = self.clock.staleness(rank)
+            if obs > self.max_observed_staleness:
+                self.max_observed_staleness = obs
+            return dict(self._params)
+
+    # -- checkpoint protocol (CheckpointManager-compatible pytree) ------
+    def state_dict(self) -> Dict:
+        with self._cv:
+            return {"params": {k: self._params[k]
+                               for k in sorted(self._params)},
+                    "versions": {k: np.int64(self._versions[k])
+                                 for k in sorted(self._versions)},
+                    "clock": self.clock.state_dict(),
+                    "updater": self.updater.state_dict()}
+
+    def load_state_dict(self, state: Dict) -> None:
+        with self._cv:
+            self._params = {str(k): jnp.asarray(v)
+                            for k, v in dict(state["params"]).items()}
+            self._versions = {str(k): int(v)
+                              for k, v in dict(state["versions"]).items()}
+            self.clock.load_state_dict(state["clock"])
+            self.updater.load_state_dict(state["updater"])
+            self._cv.notify_all()
+
+
+class ServiceClient:
+    """Rank-side push/pull glue: compression + error feedback on the
+    push path, checkpointable alongside the owning train step."""
+
+    def __init__(self, service: ParamService, rank: int = 0,
+                 compressor=None, owns_service: bool = False):
+        self.service = service
+        self.rank = int(rank)
+        self.compressor = compressor
+        self._owns_service = bool(owns_service)
+        service.register(self.rank)
+
+    def init_params(self, named_values: Dict) -> None:
+        """Seed the server (rank-0-wins) and pre-create every residual
+        slot so the checkpoint treedef is stable from attach time —
+        a resume before the first push must see the same state tree a
+        mid-run save produced."""
+        for k, v in named_values.items():
+            self.service.init(k, v)
+            if self.compressor is not None:
+                res = self.compressor._residual
+                if k not in res:
+                    res[k] = jnp.zeros(jnp.asarray(v).shape,
+                                       jnp.asarray(v).dtype)
+                if hasattr(self.compressor, "_step_of"):
+                    self.compressor._step_of.setdefault(k, 0)
+
+    def sync_params(self, named_values: Dict) -> None:
+        """Force the server's authoritative params to these values
+        (degrade-time handoff from the collective rung)."""
+        self.service.sync_params(named_values)
+
+    def push_step(self, grads: Dict) -> None:
+        """One step's gradients → (compressed) payloads → the service.
+        Advances this rank's staleness clock once per call."""
+        if self.compressor is not None:
+            payloads = {k: self.compressor.compress(k, jnp.asarray(g))
+                        for k, g in grads.items()}
+        else:
+            payloads = {k: jnp.asarray(g) for k, g in grads.items()}
+        self.service.push(self.rank, payloads)
+
+    def pull_params(self, timeout: Optional[float] = None) -> Dict:
+        return self.service.pull(self.rank, timeout=timeout)
+
+    def leave(self) -> None:
+        self.service.deregister(self.rank)
+
+    # -- checkpoint protocol -------------------------------------------
+    def state_dict(self) -> Dict:
+        comp = {}
+        if self.compressor is not None:
+            comp = self.compressor.state_dict()
+        out = {"compressor": comp,
+               "rank_step": np.int64(self.service.clock.step(self.rank))}
+        if self._owns_service:
+            out["service"] = self.service.state_dict()
+        return out
+
+    def load_state_dict(self, state: Dict) -> None:
+        state = dict(state)
+        if self.compressor is not None and state.get("compressor"):
+            self.compressor.load_state_dict(state["compressor"])
+        if self._owns_service and "service" in state:
+            self.service.load_state_dict(state["service"])
+        else:
+            # re-register at the saved position: the clock survives the
+            # kill even when the service outlived this rank
+            self.service.register(self.rank,
+                                  at_step=int(state["rank_step"]))
+
+
+class SyncPolicy:
+    """The sync→async policy ladder (pure state machine).
+
+    ``mode="allreduce"`` / ``"async"`` pin the rung; ``"auto"`` starts
+    at allreduce and moves on straggler evidence: ``degrade_after``
+    consecutive observations with a non-empty straggler set switch to
+    async push/pull, ``recover_after`` consecutive clean observations
+    switch back.  Hysteresis on both edges — one noisy heartbeat frame
+    must not flap the step between collectives and the service."""
+
+    def __init__(self, mode: str = "auto", degrade_after: int = 2,
+                 recover_after: int = 8):
+        if mode not in ("auto", "allreduce", "async"):
+            raise ValueError("sync mode must be 'auto', 'allreduce' or "
+                             "'async', got %r" % (mode,))
+        if int(degrade_after) < 1 or int(recover_after) < 1:
+            raise ValueError("degrade_after/recover_after must be >= 1")
+        self.mode = mode
+        self.degrade_after = int(degrade_after)
+        self.recover_after = int(recover_after)
+        self.effective = "async" if mode == "async" else "allreduce"
+        self._dirty = 0
+        self._clean = 0
+        #: (observation index, new effective mode) transition log
+        self.transitions: List = []
+        self._seen = 0
+
+    def observe(self, straggler_ranks) -> str:
+        """Feed one straggler-detector frame; returns the effective
+        mode after it."""
+        self._seen += 1
+        if self.mode != "auto":
+            return self.effective
+        if straggler_ranks:
+            self._dirty += 1
+            self._clean = 0
+        else:
+            self._clean += 1
+            self._dirty = 0
+        if self.effective == "allreduce" and \
+                self._dirty >= self.degrade_after:
+            self.effective = "async"
+            self.transitions.append((self._seen, "async"))
+        elif self.effective == "async" and \
+                self._clean >= self.recover_after:
+            self.effective = "allreduce"
+            self.transitions.append((self._seen, "allreduce"))
+        return self.effective

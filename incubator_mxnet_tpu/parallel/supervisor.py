@@ -672,6 +672,24 @@ def run_supervised(step, data_iter, manager, until_step: int,
                           skipped_steps=skipped)
         if on_step is not None:
             on_step(hb)
+        if getattr(step, "sync", "allreduce") == "auto":
+            # sync→async policy ladder (docs/RESILIENCE.md §8): the
+            # straggler detector's verdicts feed the step's hysteresis
+            # policy every boundary; a rung switch is a ledger event.
+            # EVERY rank sees the same shared heartbeat set, so every
+            # rank flips on (approximately) the same frame — including
+            # the straggler itself, which must stop blocking its peers
+            stragglers = straggler_verdicts(
+                read_heartbeats(hb_dir), factor=cfg.straggler_factor,
+                min_lag=cfg.straggler_min_lag)
+            before = step.sync_mode
+            after = step.observe_stragglers(
+                [v["rank"] for v in stragglers])
+            if after != before:
+                ledger.append(
+                    "sync_degrade" if after == "async" else "sync_recover",
+                    rank=rank, mode=after, step=applied,
+                    stragglers=[v["rank"] for v in stragglers])
         if fault_t is not None and fault_target is not None and \
                 applied > fault_target:
             # first APPLIED step past the rollback point = recovered —

@@ -270,7 +270,9 @@ class TrainStep:
                  hbm_budget: Optional[float] = None,
                  cost_device: str = "tpu-v5e",
                  passes=None, numerics: Optional[str] = None,
-                 input_range=None, skip_streak_budget: Optional[int] = None):
+                 input_range=None, skip_streak_budget: Optional[int] = None,
+                 sync: str = "allreduce",
+                 staleness_bound: Optional[int] = None, compression=None):
         self.net = net
         self.loss_fn = loss_fn
         self.opt = opt
@@ -354,6 +356,57 @@ class TrainStep:
                              "got %r" % (skip_streak_budget,))
         self.skip_streak_budget = None if skip_streak_budget is None \
             else int(skip_streak_budget)
+        # ---- sync→async policy ladder (parallel/param_service.py) ----
+        # sync: "allreduce" (the fused collective step, default),
+        # "async" (bounded-staleness push/pull through a ParamService),
+        # "auto" (start at allreduce; the supervisor's straggler
+        # verdicts degrade to async and recover back — SyncPolicy).
+        if sync not in ("allreduce", "async", "auto"):
+            raise ValueError("sync must be 'allreduce', 'async' or "
+                             "'auto', got %r" % (sync,))
+        if sync != "allreduce":
+            # v1 surface of the async rung: one process-local replica
+            # per rank (the ps-worker model — ranks exchange through
+            # the service, not through GSPMD collectives), no loss
+            # scaling (pushes are unscaled gradients), no pipelining,
+            # no ZeRO (optimizer state lives server-side).
+            if mesh is not None:
+                raise ValueError(
+                    "sync=%r exchanges gradients through the parameter "
+                    "service, not through mesh collectives — build the "
+                    "async-capable step with mesh=None (one replica per "
+                    "rank process)" % (sync,))
+            if pipeline_stages is not None:
+                raise ValueError("sync=%r does not compose with "
+                                 "pipeline_stages" % (sync,))
+            if self._scale_cfg is not None:
+                raise ValueError(
+                    "sync=%r pushes unscaled gradients; loss_scale is "
+                    "not supported on the async rung" % (sync,))
+        if staleness_bound is not None:
+            if sync == "allreduce":
+                raise ValueError(
+                    "staleness_bound only applies to sync='async'/'auto' "
+                    "(the bounded-staleness pull clock)")
+            if int(staleness_bound) < 0:
+                raise ValueError("staleness_bound must be >= 0, got %r"
+                                 % (staleness_bound,))
+        self.sync = sync
+        self.staleness_bound = 4 if staleness_bound is None \
+            else int(staleness_bound)
+        from ..kvstore.gradient_compression import make_compressor
+
+        self._compression = make_compressor(compression)
+        from .param_service import SyncPolicy
+
+        self.sync_policy = SyncPolicy(mode=sync)
+        self._applied_sync = "async" if sync == "async" else "allreduce"
+        self._svc_client = None
+        self._svc_attaching = False
+        self._grad_jit = None
+        #: bounded wait for an async pull (StalenessTimeout past it) —
+        #: the slow-peer deadline, lowered by tests
+        self.pull_timeout = 300.0
         self._scaler_dev = None  # (scale f32, unskipped i32, skipped i32)
         # set by Trainer.make_fused_step so the lint pass can flag the
         # legacy save_states path (GL007) still reachable on the object
@@ -768,52 +821,60 @@ class TrainStep:
                 if jnp.issubdtype(x.dtype, jnp.unsignedinteger) else x
         return pv_c, x_c
 
-    def _make_plain_step(self):
+    def _loss_closure(self, aux_vals, x, y, use_key, scaler):
+        """``pv -> (loss, new_aux)`` — the forward+loss closure both the
+        fused allreduce step and the async grads-only program
+        differentiate (one definition, so the two rungs of the policy
+        ladder train the SAME objective)."""
         gp_list, aux_list = self._gp, self._aux
-        net, loss_fn, opt = self.net, self.loss_fn, self.opt
+        net, loss_fn = self.net, self.loss_fn
 
+        def loss_of(pv):
+            pv_c, x_c = self._cast_inputs(pv, x)
+            tc = tracing.TraceContext(use_key, training=True)
+            for p, v in zip(gp_list, pv_c):
+                tc.bindings[id(p)] = v
+            for p, v in zip(aux_list, aux_vals):
+                tc.bindings[id(p)] = v
+            tracing.push_trace(tc)
+            try:
+                with autograd.pause():
+                    out = net._forward_impl(NDArray(x_c))
+                    loss = loss_fn(out, NDArray(y))
+                    loss = loss.mean()
+            finally:
+                tracing.pop_trace()
+            # align aux writes to aux_list positions (functional update:
+            # unwritten aux flow through unchanged) — no trace-order
+            # side channel between tracing and the caller
+            new_aux = []
+            for p, bound in zip(aux_list, aux_vals):
+                w = tc.aux_writes.get(id(p))
+                new_aux.append(bound if w is None
+                               else w[1].astype(bound.dtype))
+            loss_val = loss._data.astype(jnp.float32)
+            # aux losses registered during the forward (MoE load
+            # balancing etc.) join the objective here, so their
+            # gradients flow through the same fused program
+            for al in tc.aux_losses:
+                loss_val = loss_val + al.astype(jnp.float32)
+            if self._scale_cfg is not None:
+                # the SCALED loss feeds the backward pass so fp16
+                # grads overflow before they denormalize; the
+                # reported loss is unscaled again in _finish_step
+                loss_val = loss_val * scaler[0]
+            return loss_val, new_aux
+
+        return loss_of
+
+    def _make_plain_step(self):
         def step(p_vals, aux_vals, opt_state, x, y, key, step_count, scaler):
             # key/step_count/scaler are DEVICE-carried state (donated,
             # updated in program): a fresh host scalar or an eager key split
             # per step costs ~10-100 ms of serialized host->device transfer
             # through a tunneled runtime, which dominated the measured gap
             key, use_key = jax.random.split(key)
-            def loss_of(pv):
-                pv_c, x_c = self._cast_inputs(pv, x)
-                tc = tracing.TraceContext(use_key, training=True)
-                for p, v in zip(gp_list, pv_c):
-                    tc.bindings[id(p)] = v
-                for p, v in zip(aux_list, aux_vals):
-                    tc.bindings[id(p)] = v
-                tracing.push_trace(tc)
-                try:
-                    with autograd.pause():
-                        out = net._forward_impl(NDArray(x_c))
-                        loss = loss_fn(out, NDArray(y))
-                        loss = loss.mean()
-                finally:
-                    tracing.pop_trace()
-                # align aux writes to aux_list positions (functional update:
-                # unwritten aux flow through unchanged) — no trace-order
-                # side channel between tracing and the caller
-                new_aux = []
-                for p, bound in zip(aux_list, aux_vals):
-                    w = tc.aux_writes.get(id(p))
-                    new_aux.append(bound if w is None
-                                   else w[1].astype(bound.dtype))
-                loss_val = loss._data.astype(jnp.float32)
-                # aux losses registered during the forward (MoE load
-                # balancing etc.) join the objective here, so their
-                # gradients flow through the same fused program
-                for al in tc.aux_losses:
-                    loss_val = loss_val + al.astype(jnp.float32)
-                if self._scale_cfg is not None:
-                    # the SCALED loss feeds the backward pass so fp16
-                    # grads overflow before they denormalize; the
-                    # reported loss is unscaled again in _finish_step
-                    loss_val = loss_val * scaler[0]
-                return loss_val, new_aux
-
+            loss_of = self._loss_closure(aux_vals, x, y, use_key, scaler)
             (loss_val, new_aux), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(p_vals)
             return self._finish_step(loss_val, grads, p_vals, aux_vals,
@@ -821,6 +882,21 @@ class TrainStep:
                                      scaler)
 
         return step
+
+    def _make_grad_step(self):
+        """The async rung's program: forward+backward ONLY — the
+        optimizer lives server-side (``ParamService``'s updater applies
+        each push, ps-lite's async ApplyUpdates semantics).  Same loss
+        closure as the fused step; aux state and the PRNG key stay
+        rank-local device-carried state."""
+        def gstep(p_vals, aux_vals, x, y, key):
+            key, use_key = jax.random.split(key)
+            loss_of = self._loss_closure(aux_vals, x, y, use_key, None)
+            (loss_val, new_aux), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(p_vals)
+            return loss_val, grads, new_aux, key
+
+        return gstep
 
     def _make_pipeline_step(self):
         """Pipelined fused step: forward microbatches through the SPMD
@@ -1188,6 +1264,14 @@ class TrainStep:
         extra.extend(check_unbounded_skip(
             self.nonfinite, self._dynamic_scale, self.skip_streak_budget,
             where="TrainStep(nonfinite='skip', loss_scale=static)"))
+        # GL013: error-feedback compression whose residual state can
+        # never reach the checkpoint save set (sync='allreduce' steps
+        # checkpoint no param-service subtree)
+        from ..analysis.trace_lint import check_unsaved_compressor_state
+
+        extra.extend(check_unsaved_compressor_state(
+            self._compression, self.sync,
+            where="TrainStep(compression=..., sync='allreduce')"))
         finish_lint(closed_jaxpr, mode=self.lint, effects=effect_diags,
                     donated_leaves=donated, extra=extra,
                     suppress=self.lint_suppress,
@@ -1268,6 +1352,16 @@ class TrainStep:
         report.opt_state_bytes = opt_total
         report.opt_state_bytes_per_device = opt_dev
         report.param_bytes = p_bytes
+        if self.sync != "allreduce" or self._compression is not None:
+            # trace-time push-volume pricing for the async rung: what
+            # one compressed push costs vs its dense f32 wire, priced
+            # from shapes alone — zero compiles spent
+            from ..analysis.cost_model import push_volume_report
+
+            entries = [(p.name, tuple(p._data._data.shape),
+                        str(p._data._data.dtype)) for p in (self._gp or [])]
+            report.meta["push_volume"] = push_volume_report(
+                entries, self._compression)
         report.diagnostics.extend(self._cost_config_diags(report))
         return report
 
@@ -1585,6 +1679,17 @@ class TrainStep:
                 else float(self._scale_cfg or 1.0)
             self._scaler_dev = (jnp.float32(init_scale), jnp.int32(0),
                                 jnp.int32(0))
+        # an async-capable step materializes its service client EAGERLY
+        # so the checkpoint treedef is identical before and after a
+        # policy-ladder degrade (a pre-degrade save must restore into a
+        # post-degrade step and vice versa)
+        if self.sync != "allreduce" and self._svc_client is None \
+                and not self._svc_attaching:
+            self._svc_attaching = True
+            try:
+                self.attach_param_service()
+            finally:
+                self._svc_attaching = False
 
     def _place_state(self, p_vals, aux_vals):
         """One-time placement of params/opt-state on their target shardings
@@ -1851,7 +1956,121 @@ class TrainStep:
                     % (bad.size, int(k), bad[:8].tolist()))
         return NDArray(losses)
 
+    # ------------------------------------------------------------------
+    # sync→async policy ladder (parallel/param_service.py,
+    # docs/RESILIENCE.md §8)
+    @property
+    def sync_mode(self) -> str:
+        """The EFFECTIVE rung right now: ``"allreduce"`` or
+        ``"async"`` (``sync="auto"`` moves between them)."""
+        return self._applied_sync
+
+    def attach_param_service(self, service=None, rank: int = 0):
+        """Bind this step to a :class:`~.param_service.ParamService`
+        (created in-process, owned and checkpointed by this step, when
+        ``service=None``) and seed it with the current parameters
+        (rank-0-wins ``init`` semantics).  Returns the
+        :class:`~.param_service.ServiceClient`."""
+        from .param_service import (ParamService, ServiceClient,
+                                    ServiceUpdater)
+
+        if self.sync == "allreduce":
+            raise ValueError(
+                "this step was built with sync='allreduce'; rebuild with "
+                "make_train_step(sync='async'|'auto') to push/pull "
+                "through a parameter service")
+        self._ensure_built()
+        owns = service is None
+        if owns:
+            service = ParamService(updater=ServiceUpdater(self.opt),
+                                   staleness_bound=self.staleness_bound)
+        self._svc_client = ServiceClient(service, rank=int(rank),
+                                         compressor=self._compression,
+                                         owns_service=owns)
+        # positional keys (ps-lite uses int keys too): gluon auto-names
+        # drift across rebuilds, positions don't — a resumed process
+        # must map its fresh params onto the saved service state
+        self._svc_client.init_params(
+            {str(i): p._data._data for i, p in enumerate(self._gp)})
+        return self._svc_client
+
+    def set_sync_mode(self, mode: str) -> None:
+        """Pin the effective rung at a step boundary.  Degrading to
+        ``"async"`` starts pushing through the attached service (the
+        server holds the authoritative copy from then on); recovering
+        to ``"allreduce"`` first adopts the service's parameters so the
+        collective rung resumes from the async rung's progress."""
+        if mode not in ("allreduce", "async"):
+            raise ValueError("sync mode must be 'allreduce' or 'async', "
+                             "got %r" % (mode,))
+        if self.sync == "allreduce" and mode == "async":
+            raise ValueError("step was built with sync='allreduce' — it "
+                             "has no async rung")
+        if mode == self._applied_sync:
+            return
+        if mode == "async":
+            self._ensure_built()  # attaches the service client
+            # the service adopts THIS replica's CURRENT params as the
+            # authoritative copy — its seed-time snapshot is stale by
+            # however many collective steps ran (and the fused rung
+            # donated those seed buffers anyway)
+            self._svc_client.sync_params(
+                {str(i): p._data._data for i, p in enumerate(self._gp)})
+        elif self._svc_client is not None:
+            pulled = self._svc_client.pull_params(timeout=self.pull_timeout)
+            for i, p in enumerate(self._gp):
+                if str(i) in pulled:
+                    # copy: the fused rung will DONATE this buffer, and
+                    # the service must keep its own copy alive
+                    p._data._data = jnp.array(pulled[str(i)])
+        self._applied_sync = mode
+        self.sync_policy.effective = mode
+
+    def observe_stragglers(self, straggler_ranks) -> str:
+        """One straggler-detector frame into the policy ladder
+        (``supervisor.straggler_verdicts`` rank list, possibly empty);
+        applies any rung switch the policy decides and returns the
+        effective mode.  The supervised loop calls this every step
+        boundary under ``sync="auto"``."""
+        mode = self.sync_policy.observe(straggler_ranks)
+        if mode != self._applied_sync:
+            self.set_sync_mode(mode)
+        return self._applied_sync
+
+    def _async_call(self, x, y):
+        """One async step: local fwd+bwd, compressed push, bounded-
+        staleness pull, install the pulled params.  Counters advance
+        exactly as the fused rung's (the checkpoint boundary hook and
+        the supervisor read the same step count either way)."""
+        self._ensure_built()
+        xv = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        yv = y._data if isinstance(y, NDArray) else jnp.asarray(y)
+        p_vals = [p._data._data for p in self._gp]
+        aux_vals = [p._data._data for p in self._aux]
+        if self._grad_jit is None:
+            from ..kvstore.gradient_compression import _donate_ok
+
+            self._grad_jit = jax.jit(
+                self._make_grad_step(),
+                donate_argnums=(1, 4) if self._donate and _donate_ok()
+                else ())
+        loss, grads, new_aux, self._key_dev = self._grad_jit(
+            p_vals, aux_vals, xv, yv, self._key_dev)
+        for p, v in zip(self._aux, new_aux):
+            p._data._data = v
+        client = self._svc_client
+        client.push_step({str(i): g for i, g in enumerate(grads)})
+        pulled = client.pull_params(timeout=self.pull_timeout)
+        for i, p in enumerate(self._gp):
+            p._data._data = jnp.asarray(pulled[str(i)])
+        self._step_count += 1
+        self._step_dev = self._step_dev + 1
+        self._maybe_checkpoint()
+        return NDArray(loss)
+
     def __call__(self, x, y):
+        if self._applied_sync == "async":
+            return self._async_call(x, y)
         self._ensure_built()
 
         xv = x._data if isinstance(x, NDArray) else jnp.asarray(x)
@@ -1930,12 +2149,19 @@ class TrainStep:
         saves per-rank shards without gathering), PRNG key, device step
         counter and loss-scale state."""
         self._ensure_built()
-        return {"params": [p._data._data for p in self._gp],
-                "aux": [p._data._data for p in self._aux],
-                "opt_state": self._opt_state,
-                "rng_key": self._key_dev,
-                "step": self._step_dev,
-                "loss_scale": self._scaler_dev}
+        state = {"params": [p._data._data for p in self._gp],
+                 "aux": [p._data._data for p in self._aux],
+                 "opt_state": self._opt_state,
+                 "rng_key": self._key_dev,
+                 "step": self._step_dev,
+                 "loss_scale": self._scaler_dev}
+        if self._svc_client is not None:
+            # async rung durable state: compressor residuals (+ sparse
+            # step counters), the bounded-staleness clock and — when
+            # this step owns the service — the authoritative server
+            # params/updater state (docs/RESILIENCE.md §8 resume flow)
+            state["param_service"] = self._svc_client.state_dict()
+        return state
 
     def _checkpoint_shardings(self):
         """Placement tree congruent with :meth:`_checkpoint_state` —
@@ -2001,11 +2227,17 @@ class TrainStep:
                 covered.append(not sharded and len(p.shape) >= 1)
         marks = [int(p.shape[0]) if c else None
                  for p, c in zip(self._gp, covered)]
-        return {"params": [None] * len(self._gp),
-                "aux": [None] * len(self._aux),
-                "opt_state": self.opt.state_shardings(marks),
-                "rng_key": None, "step": None,
-                "loss_scale": (None, None, None)}
+        policy = {"params": [None] * len(self._gp),
+                  "aux": [None] * len(self._aux),
+                  "opt_state": self.opt.state_shardings(marks),
+                  "rng_key": None, "step": None,
+                  "loss_scale": (None, None, None)}
+        if self._svc_client is not None:
+            # exact-shape leaves: residuals/clock/server params never
+            # re-pad (the async rung is mesh-free by construction)
+            policy["param_service"] = jax.tree_util.tree_map(
+                lambda _: None, self._svc_client.state_dict())
+        return policy
 
     def save_checkpoint(self, directory_or_manager, keep_last=3,
                         data_iter=None):
@@ -2127,6 +2359,8 @@ class TrainStep:
         self._key_dev = state["rng_key"]
         self._step_dev = state["step"]
         self._scaler_dev = tuple(state["loss_scale"])
+        if self._svc_client is not None and "param_service" in state:
+            self._svc_client.load_state_dict(state["param_service"])
         self._step_count = int(step_no)
         # the restored key IS the training stream: suppress the fresh
         # draw _ensure_built would otherwise do on a reseed epoch bump
@@ -2266,7 +2500,8 @@ def make_train_step(net, loss_fn, optimizer="sgd", mesh=None, batch_axis="dp",
                     nonfinite=None, loss_scale=None, cost=None,
                     hbm_budget=None, cost_device="tpu-v5e", passes=None,
                     numerics=None, input_range=None,
-                    skip_streak_budget=None,
+                    skip_streak_budget=None, sync="allreduce",
+                    staleness_bound=None, compression=None,
                     **opt_kwargs) -> TrainStep:
     """Build the fused train step (fwd+bwd+optimizer in one XLA program).
 
@@ -2364,6 +2599,24 @@ def make_train_step(net, loss_fn, optimizer="sgd", mesh=None, batch_axis="dp",
     (halve on overflow, double every ``scale_window`` clean steps,
     matching ``contrib/amp/loss_scaler.py``) and are surfaced as
     ``step.loss_scale`` / ``step.skipped_steps``.
+    ``sync`` picks the gradient-exchange rung
+    (``parallel/param_service.py``, docs/RESILIENCE.md §8):
+    ``"allreduce"`` (default) is the fused collective step;
+    ``"async"`` runs bounded-staleness asynchronous push/pull against
+    a parameter service — the optimizer moves server-side, each rank
+    pushes (optionally compressed) gradients and pulls fresh params,
+    and a rank may run at most ``staleness_bound`` steps (default 4)
+    ahead of the slowest live peer before its pull blocks;
+    ``"auto"`` starts on the collective rung and lets the supervisor's
+    straggler detector degrade to async and recover back
+    (``step.observe_stragglers`` / :class:`~.param_service.SyncPolicy`
+    hysteresis).  Async requires ``mesh=None`` (one replica per rank
+    process) and composes with ``compression`` — ``"topk"``,
+    ``"randomk"``, ``"int8"``, ``"2bit"`` or a compressor instance
+    (``kvstore/gradient_compression.py``): pushes shrink on the wire
+    while error-feedback residuals keep convergence, ride the step's
+    checkpoint (``param_service`` subtree) and are priced at trace
+    time by graftcost (``report.meta["push_volume"]``, zero compiles).
     ``skip_streak_budget`` DECLARES a bound on consecutive skipped
     steps: the supervised loop (``parallel/supervisor.py``) enforces it
     as a divergence verdict, and declaring it (or a dynamic scale)
@@ -2384,4 +2637,6 @@ def make_train_step(net, loss_fn, optimizer="sgd", mesh=None, batch_axis="dp",
                      loss_scale=loss_scale, cost=cost, hbm_budget=hbm_budget,
                      cost_device=cost_device, passes=passes,
                      numerics=numerics, input_range=input_range,
-                     skip_streak_budget=skip_streak_budget)
+                     skip_streak_budget=skip_streak_budget, sync=sync,
+                     staleness_bound=staleness_bound,
+                     compression=compression)

@@ -22,9 +22,57 @@ from launch import launch_local  # noqa: E402
 
 N = 2
 
+# ---------------------------------------------------------------------------
+# launch-capability probe (the collectives_supported() pattern, one
+# subprocess pair per session): some CPU jaxlib builds rendezvous fine
+# but refuse cross-process programs ("Multiprocess computations aren't
+# implemented on the CPU backend"), which used to surface here as N
+# opaque worker-rc assertion ERRORS.  Probe once, skip-with-reason.
+# ---------------------------------------------------------------------------
+
+_PROBE_RESULT = None
+_SKIP_REASON = ("multi-process XLA collectives unavailable here (CPU "
+                "jaxlib refuses cross-process programs) — probed once "
+                "via tools/launch.py; the in-process loopback tests "
+                "below still cover the legacy wire path")
+
+
+def _multiprocess_collectives_ok() -> bool:
+    """True iff launch_local-spawned workers can compile cross-process
+    programs.  Probed with one 2-process ``collectives_supported()``
+    pair through the real launcher CLI, wrapped in a subprocess timeout
+    (launch_local itself has none), cached for the session."""
+    global _PROBE_RESULT
+    if _PROBE_RESULT is None:
+        worker = (
+            "import sys;"
+            "from incubator_mxnet_tpu.kvstore.dist import "
+            "init_process_group;"
+            "from incubator_mxnet_tpu.parallel.distributed import "
+            "collectives_supported;"
+            "init_process_group();"
+            "sys.exit(0 if collectives_supported() else 17)")
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_REPO)
+        try:
+            rc = subprocess.run(
+                [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+                 "-n", str(N), sys.executable, "-c", worker],
+                env=env, timeout=120, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL).returncode
+        except (subprocess.TimeoutExpired, OSError):
+            rc = -1
+        _PROBE_RESULT = rc == 0
+    return _PROBE_RESULT
+
+
+def _require_collectives():
+    if not _multiprocess_collectives_ok():
+        pytest.skip(_SKIP_REASON)
+
 
 @pytest.fixture(scope="module")
 def worker_results(tmp_path_factory):
+    _require_collectives()
     outdir = str(tmp_path_factory.mktemp("dist_kv"))
     env = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": _REPO}
     rc = launch_local(N, [sys.executable,
@@ -39,17 +87,26 @@ def worker_results(tmp_path_factory):
     return out
 
 
+# every launch_local leg is tier-2 (`slow`): real process pairs + the
+# capability probe.  The in-process loopback test below is the fast
+# tier-1 representative of the legacy wire path.
+_slow = pytest.mark.slow
+
+
+@_slow
 def test_world(worker_results):
     ranks = sorted(int(w["rank"]) for w in worker_results)
     assert ranks == list(range(N))
     assert all(int(w["nw"]) == N for w in worker_results)
 
 
+@_slow
 def test_init_rank0_wins(worker_results):
     for w in worker_results:
         np.testing.assert_array_equal(w["init"], np.full((4, 3), 7.0))
 
 
+@_slow
 def test_push_exact_sum(worker_results):
     # ranks push (r+1): sum = 1+2+...+N (dist_sync exact equality)
     expect = np.full((4, 3), sum(range(1, N + 1)), np.float32)
@@ -57,6 +114,7 @@ def test_push_exact_sum(worker_results):
         np.testing.assert_array_equal(w["sum"], expect)
 
 
+@_slow
 def test_optimizer_update_identical(worker_results):
     # server-side sgd: w = 1 - 0.1 * sum(grads) exactly, on every rank
     expect = np.full((5, 2), 1.0 - 0.1 * sum(range(1, N + 1)), np.float32)
@@ -64,6 +122,7 @@ def test_optimizer_update_identical(worker_results):
         np.testing.assert_allclose(w["opt"], expect, rtol=1e-6)
 
 
+@_slow
 def test_two_bit_compression(worker_results):
     # push 1: rank0 sends 0.3 → q=0 (residual .3); rank1 sends .6 → q=.5
     # (residual .1); server sum = .5
@@ -75,12 +134,14 @@ def test_two_bit_compression(worker_results):
                                rtol=1e-6)
 
 
+@_slow
 def test_bitwise_identical_across_ranks(worker_results):
     a, b = worker_results[0], worker_results[1]
     for k in ("init", "sum", "opt", "c1", "c2"):
         assert a[k].tobytes() == b[k].tobytes(), k
 
 
+@_slow
 def test_trainer_weights_bitwise_identical(worker_results):
     """Each rank trains on DIFFERENT data; the dist-sync gradient exchange
     must keep the replicas bitwise identical (the reference's
@@ -91,6 +152,7 @@ def test_trainer_weights_bitwise_identical(worker_results):
     assert np.abs(a["trained_w"]).sum() > 0
 
 
+@_slow
 def test_fused_batch_push_single_collective_program(worker_results):
     """Round-3 scaling fix: the push-batch reduction lowers to a single
     compiled program containing XLA all-reduce collectives (no per-key
@@ -103,6 +165,7 @@ def test_fused_batch_push_single_collective_program(worker_results):
             w["mk2"], np.full((5,), 10.0 * sum(range(1, N + 1)), np.float32))
 
 
+@_slow
 def test_multihost_train_step(worker_results):
     """make_train_step over a mesh spanning both processes: every rank sees
     the same global loss and ends with identical weights (GSPMD inserts the
@@ -113,11 +176,13 @@ def test_multihost_train_step(worker_results):
     assert np.isfinite(a["mh_w"]).all() and np.abs(a["mh_w"]).sum() > 0
 
 
+@_slow
 def test_dist_async_unequal_steps(tmp_path):
     """dist_async runs a real rank-0 parameter host: workers take UNEQUAL
     step counts (20 vs 35) without blocking, and both converge on the
     shared regression weight (kvstore_dist_server.h:325-346 async
     ApplyUpdates semantics)."""
+    _require_collectives()
     outdir = str(tmp_path)
     env = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": _REPO,
            "DMLC_PS_ROOT_PORT": "9207"}
@@ -135,3 +200,34 @@ def test_dist_async_unequal_steps(tmp_path):
     for w in results:
         np.testing.assert_allclose(w["w"], w["w_true"], rtol=0.15,
                                    atol=0.15)
+
+
+def test_async_host_loopback():
+    """Fast tier-1 representative of the legacy dist_async wire path:
+    a real AsyncParamHost thread + AsyncParamClient TCP loopback in ONE
+    process — INIT sticks (first write wins), PUSH applies the
+    server-side optimizer immediately (no barrier), PULL returns the
+    updated value, and the wire rejects non-f32 loudly.  No launcher,
+    no collectives: this is what keeps the legacy path covered where
+    the multi-process legs skip."""
+    from incubator_mxnet_tpu import optimizer as opt
+    from incubator_mxnet_tpu.kvstore.async_host import (AsyncParamClient,
+                                                        AsyncParamHost)
+
+    host = AsyncParamHost(0)  # OS-assigned free port
+    client = AsyncParamClient("127.0.0.1", host.port)
+    try:
+        client.set_optimizer(opt.SGD(learning_rate=0.5))
+        client.init("w", np.full((4,), 2.0, np.float32))
+        client.init("w", np.full((4,), 9.0, np.float32))  # no-op: first wins
+        np.testing.assert_array_equal(client.pull("w"),
+                                      np.full((4,), 2.0, np.float32))
+        client.push("w", np.ones((4,), np.float32))
+        np.testing.assert_allclose(client.pull("w"),
+                                   np.full((4,), 1.5, np.float32),
+                                   rtol=1e-6)  # 2 - 0.5 * 1
+        with pytest.raises(TypeError):  # _check_f32 rejects client-side
+            client.push("w", np.ones((4,), np.float64))
+    finally:
+        client.stop_host()
+        client.close()

@@ -886,3 +886,67 @@ def test_graftpass_cli_sarif_format(capsys):
     results = log["runs"][0]["results"]
     assert any(r["ruleId"] == "GL304" and r["level"] == "warning"
                for r in results)
+
+
+def test_gl013_unsaved_compressor_residual():
+    """GL013 gate: error-feedback compression on a sync='allreduce'
+    step warns (the residual can never reach the checkpoint save set,
+    so kill-and-resume silently drops the bank); the async rungs —
+    whose param_service checkpoint subtree carries the compressor's
+    state — are clean, as is no compression at all.  The resume-path
+    enforcement (bit-identical tail through CheckpointManager) lives in
+    tests/test_param_service.py."""
+    import warnings
+
+    import numpy as np
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, nd
+    from incubator_mxnet_tpu.analysis import (CODES, Severity as Sev,
+                                              check_unsaved_compressor_state)
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.kvstore.gradient_compression import (
+        Int8Compressor, make_compressor)
+    from incubator_mxnet_tpu.parallel import make_train_step
+
+    # the code is cataloged (append-only contract, docs/ANALYSIS.md)
+    assert CODES["GL013"][0] == Sev.WARNING
+    comp = make_compressor("topk")
+    diags = check_unsaved_compressor_state(comp, "allreduce", where="here")
+    assert [d.code for d in diags] == ["GL013"]
+    assert "'topk'" in diags[0].message
+    assert "sync='async'" in diags[0].hint
+    # every safe configuration is clean
+    assert check_unsaved_compressor_state(None, "allreduce") == []
+    assert check_unsaved_compressor_state(comp, "async") == []
+    assert check_unsaved_compressor_state(comp, "auto") == []
+    assert check_unsaved_compressor_state(Int8Compressor(), "auto") == []
+
+    def build(**kw):
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="tanh"), nn.Dense(4))
+        net.initialize(init=mx.init.Xavier())
+        net(nd.ones((2, 8)))
+        return make_train_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                               optimizer="sgd", learning_rate=0.1,
+                               lint="warn", **kw)
+
+    x = nd.array(np.random.RandomState(0).rand(4, 8).astype(np.float32))
+    y = nd.array((np.arange(4) % 4).astype(np.float32))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        build(compression="int8")(x, y)
+    assert any("GL013" in str(w.message) for w in caught), \
+        [str(w.message) for w in caught]
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        build(compression="int8", sync="async")(x, y)
+    assert not any("GL013" in str(w.message) for w in caught), \
+        [str(w.message) for w in caught]
+    # lint_suppress opts out, like every other code
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        build(compression="int8", lint_suppress=("GL013",))(x, y)
+    assert not any("GL013" in str(w.message) for w in caught), \
+        [str(w.message) for w in caught]

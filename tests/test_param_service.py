@@ -1,0 +1,600 @@
+"""Bounded-staleness async parameter service (ISSUE 19; ROADMAP item 5;
+``parallel/param_service.py`` + the ``make_train_step(sync=...)`` rung).
+
+Fast tier-1 coverage of the clock, the policy ladder, the error-feedback
+compressors' checkpoint protocol, the push/pull fault injectors and the
+train-step integration (graftcost push-volume pricing at zero compiles,
+bit-identical kill-and-resume).  The timed straggler chaos soak —
+one rank slowed 5x: async throughput stays near baseline while BSP
+degrades — is tier-2 (``slow``); its deterministic blocked-pull
+accounting twin runs in tier 1.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu import gluon, nd  # noqa: E402
+from incubator_mxnet_tpu.kvstore.gradient_compression import (  # noqa: E402
+    GradientCompression, Int8Compressor, RandomKCompressor, TopKCompressor,
+    decompress_payload, make_compressor)
+from incubator_mxnet_tpu.parallel import (  # noqa: E402
+    CheckpointManager, ParamService, ServiceClient, ServiceUpdater,
+    StalenessClock, StalenessTimeout, SyncPolicy, fault_injection as fi,
+    make_train_step)
+
+
+# ---------------------------------------------------------------------------
+# StalenessClock
+# ---------------------------------------------------------------------------
+
+def test_clock_staleness_and_membership():
+    c = StalenessClock()
+    c.register(0)
+    c.register(1)
+    assert c.min_step() == 0 and c.live_ranks() == [0, 1]
+    for _ in range(3):
+        c.advance(0)
+    assert c.step(0) == 3 and c.staleness(0) == 3
+    assert c.staleness(1) == 0  # rank 1 IS the minimum
+    c.advance(1)
+    assert c.min_step() == 1 and c.staleness(0) == 2
+    # a departed rank stops anchoring the minimum
+    c.deregister(1)
+    assert c.min_step() == 3 and c.staleness(0) == 0
+    # a fresh joiner lands at the current minimum, not at zero
+    c.register(7)
+    assert c.step(7) == 3 and c.staleness(7) == 0
+
+
+def test_clock_state_roundtrip():
+    c = StalenessClock()
+    c.register(0)
+    c.register(1)
+    c.advance(0)
+    c.advance(0)
+    c.deregister(1)
+    c2 = StalenessClock()
+    c2.load_state_dict(c.state_dict())
+    assert c2.step(0) == 2 and c2.live_ranks() == [0]
+    assert c2.min_step() == c.min_step() == 2
+
+
+# ---------------------------------------------------------------------------
+# ParamService core semantics
+# ---------------------------------------------------------------------------
+
+def _sgd_service(lr=0.5, **kw):
+    from incubator_mxnet_tpu.parallel.train_step import FunctionalOptimizer
+
+    return ParamService(ServiceUpdater(
+        FunctionalOptimizer("sgd", learning_rate=lr, momentum=0.0)), **kw)
+
+
+def test_init_rank0_wins_and_exact_sgd():
+    svc = _sgd_service(lr=0.5)
+    svc.register(0)
+    svc.init("w", np.full((4,), 2.0, np.float32))
+    svc.init("w", np.full((4,), 9.0, np.float32))  # no-op: first wins
+    np.testing.assert_array_equal(np.asarray(svc.pull(0)["w"]),
+                                  np.full((4,), 2.0, np.float32))
+    svc.push(0, {"w": np.ones((4,), np.float32)})
+    np.testing.assert_allclose(np.asarray(svc.pull(0)["w"]),
+                               np.full((4,), 1.5, np.float32), rtol=1e-6)
+    with pytest.raises(KeyError):
+        svc.push(0, {"nope": np.ones((1,), np.float32)})
+
+
+def test_init_stores_copy_not_alias():
+    """The service must own its buffers: a caller's array may later be
+    donated by a fused step program."""
+    svc = _sgd_service()
+    svc.register(0)
+    buf = jnp.ones((3,), jnp.float32)
+    svc.init("w", buf)
+    assert svc.pull(0)["w"] is not buf
+    svc.sync_params({"w": buf})
+    assert svc.pull(0)["w"] is not buf
+    with pytest.raises(KeyError):
+        svc.sync_params({"other": buf})
+
+
+def test_pull_blocks_at_bound_and_times_out():
+    svc = _sgd_service(staleness_bound=2)
+    svc.register(0)
+    svc.register(1)
+    svc.init("w", np.zeros((2,), np.float32))
+    for _ in range(3):  # rank 0 runs 3 ahead of rank 1 (bound 2)
+        svc.push(0, {"w": np.ones((2,), np.float32)})
+    t0 = time.monotonic()
+    with pytest.raises(StalenessTimeout):
+        svc.pull(0, timeout=0.2)
+    assert time.monotonic() - t0 >= 0.15
+    assert svc.pulls_blocked == 1
+    # rank 1 catching up releases the bound
+    svc.push(1, {"w": np.ones((2,), np.float32)})
+    out = svc.pull(0, timeout=5.0)
+    assert set(out) == {"w"}
+    assert svc.max_observed_staleness <= svc.staleness_bound
+
+
+def test_deregister_unblocks_waiter():
+    """Elastic leave: a blocked pull returns as soon as the straggler
+    holding the staleness minimum hostage is deregistered."""
+    svc = _sgd_service(staleness_bound=0)
+    svc.register(0)
+    svc.register(1)
+    svc.init("w", np.zeros((2,), np.float32))
+    svc.push(0, {"w": np.ones((2,), np.float32)})
+    got = {}
+
+    def puller():
+        got["out"] = svc.pull(0, timeout=30.0)
+
+    t = threading.Thread(target=puller)
+    t.start()
+    time.sleep(0.1)
+    assert t.is_alive()  # blocked: rank 1 never pushed, bound is 0
+    svc.deregister(1)
+    t.join(timeout=10.0)
+    assert not t.is_alive() and "out" in got
+
+
+def test_service_state_roundtrip_preserves_clock_and_updater():
+    svc = _sgd_service(lr=0.1, staleness_bound=3)
+    svc.register(0)
+    svc.init("w", np.full((2,), 1.0, np.float32))
+    svc.push(0, {"w": np.full((2,), 2.0, np.float32)})
+    state = svc.state_dict()
+
+    svc2 = _sgd_service(lr=0.1, staleness_bound=3)
+    svc2.load_state_dict(state)
+    svc2.register(0, at_step=int(state["clock"]["count"]["0"]))
+    assert svc2.clock.step(0) == 1
+    np.testing.assert_allclose(np.asarray(svc2.pull(0)["w"]),
+                               np.asarray(svc.pull(0)["w"]))
+    # both replicas apply the NEXT push identically (updater counts in
+    # lockstep — adam-style bias correction depends on this)
+    g = np.full((2,), 0.5, np.float32)
+    svc.push(0, {"w": g})
+    svc2.push(0, {"w": g})
+    a = np.asarray(svc.pull(0)["w"])
+    b = np.asarray(svc2.pull(0)["w"])
+    assert a.tobytes() == b.tobytes()
+
+
+def test_sharded_push_accounting():
+    svc = _sgd_service(num_shards=4)
+    svc.register(0)
+    keys = ["p%d" % i for i in range(8)]
+    for k in keys:
+        svc.init(k, np.zeros((16,), np.float32))
+    svc.push(0, {k: np.ones((16,), np.float32) for k in keys})
+    assert svc.push_nbytes == 8 * 16 * 4
+    assert sum(svc.shard_push_nbytes) == svc.push_nbytes
+    assert sum(1 for n in svc.shard_push_nbytes if n) >= 2  # spread out
+
+
+# ---------------------------------------------------------------------------
+# SyncPolicy (the ladder as a pure state machine)
+# ---------------------------------------------------------------------------
+
+def test_policy_hysteresis_both_edges():
+    p = SyncPolicy(mode="auto", degrade_after=2, recover_after=3)
+    assert p.observe([1]) == "allreduce"      # one dirty frame: no flip
+    assert p.observe([]) == "allreduce"       # ...and the streak resets
+    assert p.observe([1]) == "allreduce"
+    assert p.observe([1]) == "async"          # 2 consecutive: degrade
+    assert p.observe([]) == "async"
+    assert p.observe([]) == "async"
+    assert p.observe([]) == "allreduce"       # 3 consecutive clean: recover
+    assert [m for _, m in p.transitions] == ["async", "allreduce"]
+
+
+def test_policy_pinned_modes_never_move():
+    for mode in ("allreduce", "async"):
+        p = SyncPolicy(mode=mode)
+        for frame in ([1], [1], [1], [], [], [], [], [], [], [], []):
+            p.observe(frame)
+        assert p.effective == ("async" if mode == "async" else "allreduce")
+        assert p.transitions == []
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        SyncPolicy(mode="bsp")
+    with pytest.raises(ValueError):
+        SyncPolicy(degrade_after=0)
+
+
+# ---------------------------------------------------------------------------
+# compressors: error feedback, checkpoint protocol, wire format
+# ---------------------------------------------------------------------------
+
+def test_make_compressor_specs():
+    assert make_compressor(None) is None
+    c = make_compressor("topk")
+    assert isinstance(c, TopKCompressor)
+    assert make_compressor(c) is c
+    d = make_compressor({"kind": "randomk", "ratio": 0.25})
+    assert isinstance(d, RandomKCompressor) and d.ratio == 0.25
+    assert isinstance(make_compressor("int8"), Int8Compressor)
+    assert isinstance(make_compressor("2bit"), GradientCompression)
+    with pytest.raises(ValueError):
+        make_compressor("middle-out")
+
+
+@pytest.mark.parametrize("spec", ["topk", "randomk", "int8", "2bit"])
+def test_compressor_state_roundtrip_bit_identical(spec):
+    """After load_state_dict, the restored compressor must emit the
+    BIT-IDENTICAL next payload — residuals and (sparse) step counters
+    both carry."""
+    rng = np.random.RandomState(3)
+    grads = [rng.randn(32).astype(np.float32) for _ in range(4)]
+    a = make_compressor(spec)
+    for g in grads[:2]:
+        a.compress("w", jnp.asarray(g))
+    b = make_compressor(spec)
+    b.load_state_dict(a.state_dict())
+    pa = a.compress("w", jnp.asarray(grads[2]))
+    pb = b.compress("w", jnp.asarray(grads[2]))
+    da = np.asarray(decompress_payload(pa))
+    db = np.asarray(decompress_payload(pb))
+    assert da.tobytes() == db.tobytes()
+    # and the residual state advanced identically too
+    sa, sb = a.state_dict(), b.state_dict()
+    ra = sa.get("residual", sa)
+    rb = sb.get("residual", sb)
+    assert set(ra) == set(rb)
+    for k in ra:
+        assert np.asarray(ra[k]).tobytes() == np.asarray(rb[k]).tobytes()
+
+
+def test_sparse_step_counter_in_checkpoint():
+    """randomk's selection is a deterministic function of (key, step):
+    losing ``_step_of`` on resume would replay the same mask forever."""
+    c = RandomKCompressor(ratio=0.25)
+    c.compress("w", jnp.arange(16, dtype=jnp.float32))
+    state = c.state_dict()
+    assert int(state["step_of"]["w"]) == 1
+    c2 = RandomKCompressor(ratio=0.25)
+    c2.load_state_dict(state)
+    assert c2._step_of["w"] == 1
+
+
+def test_error_feedback_banks_the_truncation():
+    c = TopKCompressor(ratio=0.25)  # keeps 1 of 4 entries
+    g = jnp.asarray(np.array([4.0, 1.0, 2.0, 3.0], np.float32))
+    sent = np.asarray(decompress_payload(c.compress("w", g)))
+    res = np.asarray(c.state_dict()["residual"]["w"])
+    np.testing.assert_allclose(sent + res, np.asarray(g), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# ServiceClient: compression on the wire + kill-and-resume
+# ---------------------------------------------------------------------------
+
+def test_client_compressed_push_volume():
+    svc = _sgd_service()
+    cl = ServiceClient(svc, rank=0, compressor=Int8Compressor())
+    cl.init_params({"w": np.zeros((256,), np.float32)})
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        cl.push_step({"w": rng.randn(256).astype(np.float32)})
+    assert svc.push_nbytes < svc.push_dense_nbytes
+    assert svc.push_dense_nbytes == 3 * 256 * 4
+    assert svc.push_nbytes / svc.push_dense_nbytes < 0.5  # int8 + scale
+
+
+def test_client_kill_and_resume_bit_identical():
+    """Snapshot client+service mid-run, replay the same gradient tail
+    on a fresh pair restored from the snapshot: parameters must match
+    BIT-identically (residuals, sparse counters, updater state and the
+    staleness clock all carried)."""
+    rng = np.random.RandomState(7)
+    grads = [rng.randn(64).astype(np.float32) for _ in range(10)]
+
+    def fresh():
+        svc = _sgd_service(lr=0.2)
+        cl = ServiceClient(svc, rank=0,
+                           compressor=RandomKCompressor(ratio=0.5),
+                           owns_service=True)
+        cl.init_params({"w": np.zeros((64,), np.float32)})
+        return svc, cl
+
+    svc, cl = fresh()
+    for g in grads[:6]:
+        cl.push_step({"w": g})
+    snap = cl.state_dict()
+    saved_step = int(snap["rank_step"])
+
+    svc2, cl2 = fresh()
+    cl2.load_state_dict(snap)
+    assert svc2.clock.step(0) == saved_step == 6  # clock survived
+    for g in grads[6:]:
+        cl.push_step({"w": g})
+        cl2.push_step({"w": g})
+    a = np.asarray(cl.pull_params()["w"])
+    b = np.asarray(cl2.pull_params()["w"])
+    assert a.tobytes() == b.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# fault injectors at the transport choke points
+# ---------------------------------------------------------------------------
+
+def test_slow_link_counts_and_delays():
+    svc = _sgd_service()
+    svc.register(0)
+    svc.register(1)
+    svc.init("w", np.zeros((2,), np.float32))
+    with fi.slow_link(1, 0.05) as stats:
+        t0 = time.monotonic()
+        svc.push(0, {"w": np.ones((2,), np.float32)})  # not the victim
+        fast = time.monotonic() - t0
+        t0 = time.monotonic()
+        svc.push(1, {"w": np.ones((2,), np.float32)})
+        slow = time.monotonic() - t0
+    assert stats.delayed == 1 and stats.pushes == 2
+    assert slow >= 0.05 > fast
+
+
+def test_drop_push_is_fire_and_forget():
+    """A dropped push loses its PAYLOAD but still commits the step —
+    the clock advances so no peer deadlocks on a lossy link."""
+    svc = _sgd_service()
+    svc.register(0)
+    svc.init("w", np.full((2,), 5.0, np.float32))
+    with fi.drop_push(1.0) as stats:  # every push dropped
+        for _ in range(3):
+            svc.push(0, {"w": np.ones((2,), np.float32)})
+    assert stats.seen == 3 and stats.dropped == 3
+    assert svc.clock.step(0) == 3  # committed anyway
+    np.testing.assert_array_equal(np.asarray(svc.pull(0)["w"]),
+                                  np.full((2,), 5.0, np.float32))  # no-op
+    with pytest.raises(ValueError):
+        fi.drop_push(1.5).__enter__()
+
+
+def test_drop_push_error_feedback_recarries():
+    """With error-feedback compression a lossy link degrades gracefully:
+    the surviving pushes re-carry what the residual banked, so the
+    optimizer still descends on the toy quadratic."""
+    svc = _sgd_service(lr=0.2)
+    cl = ServiceClient(svc, rank=0, compressor=TopKCompressor(ratio=0.5))
+    target = np.linspace(-1, 1, 16).astype(np.float32)
+    cl.init_params({"w": np.zeros((16,), np.float32)})
+    with fi.drop_push(0.5, seed=1) as stats:
+        for _ in range(60):
+            w = np.asarray(cl.pull_params()["w"])
+            cl.push_step({"w": (w - target).astype(np.float32)})
+    assert 0 < stats.dropped < stats.seen
+    final = np.asarray(cl.pull_params()["w"])
+    assert np.abs(final - target).max() < 0.2
+
+
+# ---------------------------------------------------------------------------
+# straggler: deterministic tier-1 twin of the timed soak
+# ---------------------------------------------------------------------------
+
+def _two_rank_run(staleness_bound, delay, steps=12, slow_steps=4,
+                  work=0.0):
+    """Two threaded ranks on one service; every step costs ``work``
+    seconds of simulated compute, and rank 1's link adds ``delay``
+    seconds on its first ``slow_steps`` pushes (the straggler window).
+    Returns (service, fast-rank elapsed seconds)."""
+    svc = _sgd_service(lr=0.05, staleness_bound=staleness_bound)
+    cls = [ServiceClient(svc, rank=r) for r in (0, 1)]
+    cls[0].init_params({"w": np.zeros((8,), np.float32)})
+    cls[1].init_params({"w": np.zeros((8,), np.float32)})
+    target = np.ones((8,), np.float32)
+    elapsed = {}
+
+    def run(rank):
+        t0 = time.monotonic()
+        for i in range(steps):
+            w = np.asarray(cls[rank].pull_params(timeout=60.0)["w"])
+            g = (w - target).astype(np.float32)
+            if work:
+                time.sleep(work)
+            if rank == 1 and i < slow_steps:
+                time.sleep(delay)
+            cls[rank].push_step({"w": g})
+        elapsed[rank] = time.monotonic() - t0
+
+    ts = [threading.Thread(target=run, args=(r,)) for r in (0, 1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120.0)
+    assert not any(t.is_alive() for t in ts)
+    return svc, elapsed[0]
+
+
+def test_straggler_fast_rank_within_bound():
+    """Deterministic invariant check: with a bound wide enough to absorb
+    the whole straggler window (bound >= steps, so staleness can never
+    exceed it) the fast rank never blocks; under BSP (bound=0) it must.
+    Either way no pull ever OBSERVES staleness past the bound."""
+    svc_async, _ = _two_rank_run(staleness_bound=12, delay=0.05, steps=12)
+    assert svc_async.pulls_blocked == 0
+    assert svc_async.max_observed_staleness <= 12
+    svc_bsp, _ = _two_rank_run(staleness_bound=0, delay=0.05, steps=12)
+    assert svc_bsp.pulls_blocked > 0
+    assert svc_bsp.max_observed_staleness == 0
+
+
+@pytest.mark.slow
+def test_straggler_chaos_soak_throughput_and_parity():
+    """ISSUE 19 acceptance: one rank slowed ~5x for a window — async
+    (bound wide enough to absorb the window's lag) keeps the fast rank
+    within 10% of its no-straggler baseline, BSP (bound=0) pays every
+    injected delay, and the async run still converges (parity with
+    baseline on the toy quadratic's optimum)."""
+    work, delay, steps, slow_steps = 0.02, 0.1, 30, 5
+    base_svc, base_t = _two_rank_run(staleness_bound=steps, delay=0.0,
+                                     steps=steps, slow_steps=0, work=work)
+    async_svc, async_t = _two_rank_run(staleness_bound=steps, delay=delay,
+                                       steps=steps, slow_steps=slow_steps,
+                                       work=work)
+    bsp_svc, bsp_t = _two_rank_run(staleness_bound=0, delay=delay,
+                                   steps=steps, slow_steps=slow_steps,
+                                   work=work)
+    # throughput: async absorbs the window, BSP eats every delay
+    assert async_t <= base_t * 1.10 + 0.10
+    assert bsp_t >= base_t + 0.8 * (slow_steps * delay)
+    assert async_svc.pulls_blocked == 0
+    assert async_svc.max_observed_staleness <= steps
+    # parity: both runs land on the optimum of the toy quadratic
+    for svc in (base_svc, async_svc):
+        w = np.asarray(svc.pull(0, timeout=10.0)["w"])
+        assert np.abs(w - 1.0).max() < 0.2
+
+
+# ---------------------------------------------------------------------------
+# train-step integration: the sync="async"/"auto" rung
+# ---------------------------------------------------------------------------
+
+def _build_net(seed=11):
+    mx.random.seed(seed)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+    net.initialize(init=mx.init.Xavier())
+    net(nd.ones((2, 8)))
+    return net
+
+
+def _toy_batch(n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    x = nd.array(rng.rand(n, 8).astype(np.float32))
+    y = nd.array((np.arange(n) % 4).astype(np.float32))
+    return x, y
+
+
+def test_async_step_trains():
+    net = _build_net()
+    step = make_train_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           optimizer="sgd", learning_rate=0.1,
+                           sync="async", staleness_bound=2,
+                           compression={"kind": "topk", "ratio": 0.25})
+    x, y = _toy_batch()
+    losses = [float(step(x, y).asscalar()) for _ in range(30)]
+    assert step.sync_mode == "async"
+    assert losses[-1] < losses[0] * 0.7
+    svc = step._svc_client.service
+    assert svc.push_nbytes < svc.push_dense_nbytes  # compression on wire
+
+
+def test_async_step_rejects_bad_compositions():
+    from incubator_mxnet_tpu.parallel import make_mesh
+
+    net = _build_net()
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    with pytest.raises(ValueError):
+        make_train_step(net, loss, sync="async",
+                        mesh=make_mesh({"dp": 1}))
+    with pytest.raises(ValueError):
+        make_train_step(net, loss, sync="bsp")
+    with pytest.raises(ValueError):
+        make_train_step(net, loss, staleness_bound=3)  # allreduce-only
+    step = make_train_step(net, loss, optimizer="sgd", learning_rate=0.1)
+    with pytest.raises(ValueError):
+        step.attach_param_service()  # built with sync="allreduce"
+
+
+def test_graftcost_push_volume_zero_compiles():
+    """Trace-time pricing: analyze_cost reports the compressed push
+    volume (and the reduction ratio) without compiling anything."""
+    net = _build_net()
+    step = make_train_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           optimizer="sgd", learning_rate=0.1,
+                           sync="async", compression="int8")
+    x, y = _toy_batch()
+    report = step.analyze_cost(x, y)
+    assert step._compiled is None  # nothing compiled
+    pv = report.meta["push_volume"]
+    assert pv["compressor"] == "int8"
+    assert 0 < pv["push_nbytes"] < pv["dense_nbytes"]
+    assert pv["reduction"] > 1.0
+    assert len(pv["tensors"]) == len(list(step._gp))
+
+
+def test_compressed_loss_parity():
+    """int8 push compression trains to (approximately) the same loss as
+    the uncompressed async run on the same seed/data."""
+    x, y = _toy_batch()
+
+    def run(compression):
+        step = make_train_step(_build_net(),
+                               gluon.loss.SoftmaxCrossEntropyLoss(),
+                               optimizer="sgd", learning_rate=0.1,
+                               sync="async", compression=compression)
+        return [float(step(x, y).asscalar()) for _ in range(20)]
+
+    plain = run(None)
+    quant = run("int8")
+    assert quant[-1] < quant[0] * 0.7
+    assert abs(quant[-1] - plain[-1]) < 0.1 * max(plain[-1], 1e-3)
+
+
+def test_auto_ladder_degrades_and_recovers():
+    net = _build_net()
+    step = make_train_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           optimizer="sgd", learning_rate=0.3,
+                           sync="auto", staleness_bound=4)
+    step.sync_policy.degrade_after = 2
+    step.sync_policy.recover_after = 3
+    x, y = _toy_batch()
+    losses = [float(step(x, y).asscalar()) for _ in range(7)]
+    assert step.sync_mode == "allreduce"
+    assert step.observe_stragglers([1]) == "allreduce"  # hysteresis
+    assert step.observe_stragglers([1]) == "async"      # degrade
+    losses += [float(step(x, y).asscalar()) for _ in range(7)]
+    for _ in range(3):
+        mode = step.observe_stragglers([])
+    assert mode == "allreduce" and step.sync_mode == "allreduce"
+    losses += [float(step(x, y).asscalar()) for _ in range(7)]
+    assert [m for _, m in step.sync_policy.transitions] == \
+        ["async", "allreduce"]
+    # training kept descending across BOTH rung switches
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_async_kill_and_resume_bit_identical_tail(tmp_path):
+    """Kill-and-resume through CheckpointManager preserves the
+    compressor residual and the staleness clock: the resumed run's loss
+    tail is BIT-identical to the uninterrupted run's."""
+    x, y = _toy_batch()
+    compression = {"kind": "randomk", "ratio": 0.5}
+
+    def build(dirname):
+        step = make_train_step(_build_net(),
+                               gluon.loss.SoftmaxCrossEntropyLoss(),
+                               optimizer="sgd", learning_rate=0.1,
+                               sync="async", staleness_bound=2,
+                               compression=compression)
+        step.attach_checkpoint(CheckpointManager(str(tmp_path / dirname)),
+                               every=3)
+        return step
+
+    ref = build("ref")
+    ref_losses = [float(ref(x, y).asscalar()) for _ in range(10)]
+
+    a = build("killed")
+    for _ in range(6):
+        a(x, y)
+    # "kill": a is abandoned; a fresh process restores from the manager
+    b = build("killed")
+    b.restore_checkpoint(CheckpointManager(str(tmp_path / "killed")))
+    assert b.step_count == 6
+    assert b._svc_client.service.clock.step(0) == 6  # clock survived
+    tail = [float(b(x, y).asscalar()) for _ in range(4)]
+    np.testing.assert_array_equal(np.asarray(tail, np.float64),
+                                  np.asarray(ref_losses[6:], np.float64))

@@ -507,7 +507,8 @@ def test_chaos_matrix_soak(tmp_path):
         n=1, steps=8, dir=str(tmp_path), checkpoint_every=2,
         commit_timeout=10.0, max_restarts=2, min_stall=2.0,
         startup_timeout=60.0, backoff=0.25, timeout=180.0,
-        mttr_bound=60.0)
+        mttr_bound=60.0, sync="allreduce", straggler_factor=3.0,
+        straggler_min_lag=4)
     records = [supervise.run_chaos(s, args, "text")
                for s in sorted(supervise.SCENARIOS)]
     bad = [r for r in records if not r["ok"]]
@@ -516,3 +517,43 @@ def test_chaos_matrix_soak(tmp_path):
     # the rollback rung resolves loss_bomb with ZERO restarts
     bomb = next(r for r in records if r["scenario"] == "loss_bomb")
     assert bomb["restarts"] == 0
+
+
+# ---------------------------------------------------------------------------
+# sync→async policy ladder (docs/RESILIENCE.md §8) inside run_supervised
+# ---------------------------------------------------------------------------
+
+def test_run_supervised_auto_sync_degrades_and_recovers(tmp_path):
+    """The fast tier-1 leg of the straggler chaos scenario's
+    async-degradation arm: a ``sync="auto"`` step under ``run_supervised``
+    sees a lagging phantom peer in the shared heartbeat dir, degrades
+    allreduce→async after the policy's hysteresis (a ``sync_degrade``
+    ledger event), then recovers once the peer reports done — and the
+    run still reaches ``until_step``."""
+    step, it, mgr = _job(tmp_path, sync="auto", staleness_bound=4)
+    step.sync_policy.recover_after = 3
+    cfg = SupervisorConfig(straggler_factor=1.2, straggler_min_lag=2)
+    phantom = HeartbeatEmitter(str(mgr.directory), rank=1)
+    phantom.emit(0, status="running")  # wedged at step 0
+    modes = []
+
+    def on_step(hb):
+        modes.append(step.sync_mode)
+        if hb["step"] >= 6:
+            # the straggler finishes: clean frames from here on
+            phantom.emit(hb["step"], status="done")
+
+    out = run_supervised(step, it, mgr, until_step=12, config=cfg,
+                         on_step=on_step)
+    assert out["final_step"] == 12
+    events = read_ledger(str(mgr.directory))
+    names = [e["event"] for e in events]
+    assert "sync_degrade" in names and "sync_recover" in names
+    assert names.index("sync_degrade") < names.index("sync_recover")
+    deg = next(e for e in events if e["event"] == "sync_degrade")
+    assert deg["mode"] == "async" and deg["stragglers"] == [1]
+    # the run END state recovered to the collective rung...
+    assert step.sync_mode == "allreduce"
+    # ...and BOTH rungs actually ran steps
+    assert "async" in modes and "allreduce" in modes
+    assert all(np.isfinite(out["losses"]))

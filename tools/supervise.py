@@ -59,7 +59,14 @@ SCENARIOS = {
         # short lag window, and the stall floor is RAISED so the
         # healthy rank blocking in its final save's marker wait cannot
         # trip the hang detector before the straggler verdict does.
-        args=dict(checkpoint_every=4),
+        # sync="auto" arms the policy ladder: before the supervisor's
+        # grace window escalates to a restart, every rank's step must
+        # observe the straggler verdict and degrade allreduce→async
+        # (a "sync_degrade" ledger event — the gated proof the ladder
+        # ran), so the healthy rank keeps stepping instead of blocking
+        # on the slow peer.
+        args=dict(checkpoint_every=4, sync="auto",
+                  straggler_factor=1.2, straggler_min_lag=2),
         cfg=dict(straggler_factor=1.2, straggler_min_lag=2,
                  straggler_grace=1.0, min_stall_timeout=8.0)),
     "host_loss_during_save": dict(spec=dict(save=1), width=2,
@@ -74,8 +81,8 @@ REQUIRED_EVENTS = {
                      "resolved"),
     "hang_step": ("launch", "heartbeat_gap", "fault", "restart",
                   "recovered", "resolved"),
-    "straggler_process": ("launch", "straggler", "fault", "restart",
-                          "recovered", "resolved"),
+    "straggler_process": ("launch", "straggler", "sync_degrade", "fault",
+                          "restart", "recovered", "resolved"),
     "host_loss_during_save": ("launch", "fault", "restart", "recovered",
                               "resolved"),
     "loss_bomb": ("launch", "divergence", "rollback", "recovered",
@@ -94,7 +101,9 @@ def _free_port() -> int:
 # ---------------------------------------------------------------------------
 
 def build_worker_job(outdir: str, checkpoint_every=2,
-                     commit_timeout: float = 10.0, skip_budget=None):
+                     commit_timeout: float = 10.0, skip_budget=None,
+                     sync: str = "allreduce", straggler_factor: float = 3.0,
+                     straggler_min_lag: int = 4):
     """Build the deterministic supervised train job every rank runs —
     module-level so tests can run the IDENTICAL job in-process as the
     bit-exactness reference.  The step bound is the caller's
@@ -128,10 +137,22 @@ def build_worker_job(outdir: str, checkpoint_every=2,
     net.add(nn.Dense(13))
     net.initialize(init=mx.init.Xavier())
     net(nd.ones((2, 16)))
-    step = make_train_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
-                           optimizer="adam", learning_rate=0.01,
-                           mesh=mesh, batch_axis="dp", zero=1,
-                           lint="error", skip_streak_budget=skip_budget)
+    if sync == "allreduce":
+        step = make_train_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                               optimizer="adam", learning_rate=0.01,
+                               mesh=mesh, batch_axis="dp", zero=1,
+                               lint="error",
+                               skip_streak_budget=skip_budget)
+    else:
+        # async-capable rung (sync="async"/"auto"): one replica per
+        # rank process exchanging through a ParamService, no mesh
+        # collectives (docs/RESILIENCE.md §8) — the straggler chaos
+        # scenario's degradation target
+        step = make_train_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                               optimizer="adam", learning_rate=0.01,
+                               sync=sync, staleness_bound=4,
+                               lint="error",
+                               skip_streak_budget=skip_budget)
     mgr = CheckpointManager(os.path.join(outdir, "ckpt"),
                             commit_timeout=commit_timeout)
 
@@ -146,7 +167,9 @@ def build_worker_job(outdir: str, checkpoint_every=2,
         # this CPU jaxlib — computes the full batch on every rank)
         lo, hi = rank * 8 // nproc, (rank + 1) * 8 // nproc
         it = _RowSlice(it, lo, hi)
-    cfg = SupervisorConfig(checkpoint_every=checkpoint_every)
+    cfg = SupervisorConfig(checkpoint_every=checkpoint_every,
+                           straggler_factor=straggler_factor,
+                           straggler_min_lag=straggler_min_lag)
     return step, it, mgr, cfg, rank, nproc
 
 
@@ -285,7 +308,10 @@ def worker_main(args) -> int:
 
     step, it, mgr, cfg, rank, nproc = build_worker_job(
         args.dir, checkpoint_every=args.checkpoint_every,
-        commit_timeout=args.commit_timeout)
+        commit_timeout=args.commit_timeout,
+        sync=getattr(args, "sync", "allreduce"),
+        straggler_factor=getattr(args, "straggler_factor", 3.0),
+        straggler_min_lag=getattr(args, "straggler_min_lag", 4))
     attempt = int(os.environ.get("MXNET_RESTART_COUNT", "0"))
     chaos_env = os.environ.get("MXTPU_CHAOS", "")
     stack = contextlib.ExitStack()
@@ -354,7 +380,12 @@ def make_launcher(args, chaos_spec: str = ""):
             cmd = [sys.executable, me, "--worker", "--dir", args.dir,
                    "--steps", str(args.steps),
                    "--checkpoint-every", str(args.checkpoint_every),
-                   "--commit-timeout", str(args.commit_timeout)]
+                   "--commit-timeout", str(args.commit_timeout),
+                   "--sync", getattr(args, "sync", "allreduce"),
+                   "--straggler-factor",
+                   str(getattr(args, "straggler_factor", 3.0)),
+                   "--straggler-min-lag",
+                   str(getattr(args, "straggler_min_lag", 4))]
             procs.append(subprocess.Popen(cmd, env=env))
         return procs
 
@@ -466,6 +497,14 @@ def main(argv=None) -> int:
                     help="inject one scenario (%s) or 'all'"
                          % "|".join(sorted(SCENARIOS)))
     ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--sync", choices=("allreduce", "async", "auto"),
+                    default="allreduce",
+                    help="worker gradient-exchange rung: the fused "
+                         "allreduce step, the bounded-staleness async "
+                         "parameter service, or the straggler-adaptive "
+                         "policy ladder between them (RESILIENCE.md §8)")
+    ap.add_argument("--straggler-factor", type=float, default=3.0)
+    ap.add_argument("--straggler-min-lag", type=int, default=4)
     ap.add_argument("--max-restarts", type=int, default=2)
     ap.add_argument("--checkpoint-every", type=int, default=2)
     ap.add_argument("--commit-timeout", type=float, default=10.0)
