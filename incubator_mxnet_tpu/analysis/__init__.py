@@ -93,7 +93,8 @@ from .diagnostics import (CODES, Diagnostic, LintError, LintReport,
 from .passes import (PASS_REGISTRY, Contract, GraftPass, PassContext,
                      PassManager, PassReceipt, PipelineResult, get_pass,
                      register_pass, resolve_passes)
-from .source_lint import (check_checkpoint_without_iter_state, lint_paths,
+from .source_lint import (check_checkpoint_without_iter_state,
+                          check_promotion_swap_ungated, lint_paths,
                           lint_source)
 from .value_range import (RangeReport, VRange, analyze_ranges, bf16_fit,
                           loss_scale_diags)
@@ -102,6 +103,7 @@ from .trace_lint import (check_inference_param_donation,
                          check_partition_spec, check_permutation,
                          check_process_local_ckpt_dir,
                          check_swap_compatibility, check_unbounded_skip,
+                         check_ungated_swap,
                          check_unsaved_compressor_state,
                          check_zero_state_shardings, lint_jaxpr,
                          lint_traceable, recompile_probe,
@@ -118,8 +120,10 @@ __all__ = [
     "check_inference_param_donation",
     "check_legacy_checkpoint_path",
     "check_partition_spec", "check_permutation",
-    "check_process_local_ckpt_dir", "check_swap_compatibility",
-    "check_unbounded_skip", "check_unsaved_compressor_state",
+    "check_process_local_ckpt_dir", "check_promotion_swap_ungated",
+    "check_swap_compatibility",
+    "check_unbounded_skip", "check_ungated_swap",
+    "check_unsaved_compressor_state",
     "check_zero_state_shardings", "code_matches", "fit_residual",
     "get_pass", "lint_jaxpr",
     "lint_paths", "lint_source", "lint_traceable", "loss_scale_diags",

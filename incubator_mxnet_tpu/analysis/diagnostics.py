@@ -94,6 +94,14 @@ CODES = {
               "sync='async'/'auto' (the param_service checkpoint "
               "subtree carries compressor state) or checkpoint the "
               "compressor's state_dict() yourself"),
+    "GL014": (Severity.WARNING,
+              "ungated hot swap from a promotion/daemon context — "
+              "ServeEngine.update_params called without a canary batch "
+              "or canary_tol; an unattended promotion path whose only "
+              "remaining gate is the default zeros canary's finiteness "
+              "check, so a finite-but-wrong candidate sails into the "
+              "fleet; pass canary= (held-out rows) and canary_tol= so "
+              "drift rolls back automatically"),
     "GL201": (Severity.ERROR,
               "graftcost: predicted peak live-buffer memory exceeds the "
               "HBM budget — the program is infeasible at this config; "

@@ -23,7 +23,8 @@ from typing import Dict, List, Optional, Tuple
 
 from .diagnostics import Diagnostic, LintReport, Severity
 
-__all__ = ["check_checkpoint_without_iter_state", "lint_source",
+__all__ = ["check_checkpoint_without_iter_state",
+           "check_promotion_swap_ungated", "lint_source",
            "lint_paths", "iter_py_files"]
 
 #: call chains (resolved to their imported module path) that read ambient
@@ -209,6 +210,86 @@ def check_checkpoint_without_iter_state(tree_or_source,
     return diags
 
 
+# ---------------------------------------------------------------------------
+# GL014 — ungated hot swap from a promotion/daemon context
+# ---------------------------------------------------------------------------
+
+#: enclosing def/class name fragments that mark an *unattended* promotion
+#: path; a manual swap in a notebook or test is not this rule's business
+_PROMO_NAME_HINTS = ("promot", "daemon", "flywheel")
+
+
+def _gl014_gated(call: ast.Call) -> bool:
+    """Does this ``update_params(...)`` call carry a canary gate?  A
+    keyword ``canary=``/``canary_tol=`` bound to anything but a literal
+    ``None`` counts, as does a positional canary (2nd arg)."""
+    if len(call.args) >= 2:
+        return True
+    for kw in call.keywords:
+        if kw.arg is None:  # **kwargs — cannot see inside; assume gated
+            return True
+        if kw.arg in ("canary", "canary_tol"):
+            if not (isinstance(kw.value, ast.Constant)
+                    and kw.value.value is None):
+                return True
+    return False
+
+
+def check_promotion_swap_ungated(tree_or_source,
+                                 path: str = "<string>"
+                                 ) -> List[Diagnostic]:
+    """GL014 core (source level): ``.update_params(...)`` called with
+    neither ``canary=`` nor ``canary_tol=`` from inside a function or
+    class whose name marks it as a promotion/daemon path
+    (``promot``/``daemon``/``flywheel``, case-insensitive).
+
+    An unattended promotion path's only remaining gate is then the
+    default zeros canary's finiteness check, so a finite-but-wrong
+    candidate sails straight into live traffic.  The runtime twin
+    (``trace_lint.check_ungated_swap``) catches the same hazard via the
+    ``context=`` self-identification; this rule catches it in CI before
+    the daemon ever runs (docs/RESILIENCE.md §9).
+    """
+    if isinstance(tree_or_source, str):
+        try:
+            tree = ast.parse(tree_or_source, filename=path)
+        except SyntaxError:
+            return []
+    else:
+        tree = tree_or_source
+    diags: List[Diagnostic] = []
+
+    def walk(node, stack: List[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                walk(child, stack + [child.name])
+                continue
+            if isinstance(child, ast.Call) \
+                    and isinstance(child.func, ast.Attribute) \
+                    and child.func.attr == "update_params" \
+                    and not _gl014_gated(child) \
+                    and any(h in name.lower() for name in stack
+                            for h in _PROMO_NAME_HINTS):
+                diags.append(Diagnostic(
+                    "GL014", Severity.WARNING,
+                    "update_params() inside %r — a promotion/daemon "
+                    "path — with neither canary= nor canary_tol=: the "
+                    "only remaining gate is the default zeros canary's "
+                    "finiteness check, so a finite-but-wrong candidate "
+                    "promotes straight into live traffic"
+                    % ".".join(stack),
+                    where="%s:%d" % (path, child.lineno),
+                    hint="pass canary= (held-out rows the incumbent is "
+                         "known-good on) and canary_tol= so output "
+                         "drift triggers the automatic rollback "
+                         "(docs/RESILIENCE.md §9)"))
+            walk(child, stack)
+
+    walk(tree, [])
+    return diags
+
+
 def lint_source(text: str, path: str = "<string>") -> List[Diagnostic]:
     """Lint one module's source text.  Returns raw diagnostics (the
     caller wraps them in a LintReport)."""
@@ -286,6 +367,11 @@ def lint_source(text: str, path: str = "<string>") -> List[Diagnostic]:
 
     # GL008 — checkpoint saved from a data loop without iterator state
     for d in check_checkpoint_without_iter_state(tree, path):
+        lineno = int(d.where.rsplit(":", 1)[1])
+        emit(d.code, d.severity, d.message, lineno, d.hint)
+
+    # GL014 — ungated update_params from a promotion/daemon context
+    for d in check_promotion_swap_ungated(tree, path):
         lineno = int(d.where.rsplit(":", 1)[1])
         emit(d.code, d.severity, d.message, lineno, d.hint)
 

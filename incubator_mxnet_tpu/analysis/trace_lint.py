@@ -42,7 +42,7 @@ __all__ = ["capture_effect_diagnostics", "check_inference_param_donation",
            "check_legacy_checkpoint_path",
            "check_permutation", "validate_permutation",
            "check_partition_spec", "check_swap_compatibility",
-           "check_unbounded_skip",
+           "check_unbounded_skip", "check_ungated_swap",
            "check_zero_state_shardings",
            "donated_leaf_indices", "lint_jaxpr", "lint_traceable",
            "recompile_probe"]
@@ -541,6 +541,41 @@ def check_swap_compatibility(served, candidate, missing=(), extra=(),
              "precision as the served version (engine.param_signature "
              "is the pinned contract); for an architecture change, "
              "stand up a new engine and cut traffic over instead")]
+
+
+def check_ungated_swap(canary, canary_tol, context=None,
+                       where: str = "") -> List[Diagnostic]:
+    """GL014 core: an *unattended* hot swap with no canary gate.
+
+    ``context`` is the swap caller's self-identification — the
+    promotion daemon and every other automated path stamp one
+    (``update_params(..., context="promotion")``); interactive/manual
+    swaps pass none and are not this check's business.  With a context
+    but neither ``canary`` rows nor a ``canary_tol``, the only gate
+    left between a candidate and the fleet is the default zeros
+    canary's finiteness check — a finite-but-wrong candidate (bad LR
+    spike, mislabeled run, stale export) promotes cleanly and serves
+    garbage until a human notices.  An unattended path must gate on
+    *drift*, not just finiteness: held-out canary rows plus a
+    tolerance make a bad candidate roll back automatically, which is
+    the whole point of having a daemon.
+    """
+    if context is None or context == "":
+        return []
+    if canary is not None or canary_tol is not None:
+        return []
+    return [Diagnostic(
+        "GL014", Severity.WARNING,
+        "update_params from an unattended context (%r) with neither "
+        "canary rows nor canary_tol: the only remaining gate is the "
+        "default zeros canary's finiteness check, so a finite-but-"
+        "wrong candidate promotes straight into live traffic"
+        % (context,),
+        where=where,
+        hint="pass canary= (held-out rows the incumbent is known-good "
+             "on) and canary_tol= so output drift triggers the "
+             "automatic rollback (docs/RESILIENCE.md §9); a deliberate "
+             "ungated swap can suppress with lint_suppress=('GL014',)")]
 
 
 def check_process_local_ckpt_dir(directory: str,

@@ -334,6 +334,48 @@ class CheckpointManager:
         steps = self.steps()
         return steps[-1] if steps else None
 
+    def _manifest_committed(self, step: int) -> bool:
+        """True iff ``step``'s manifest exists, parses and carries the
+        expected format version — the commit protocol writes it LAST,
+        so a parseable manifest is the committed/torn discriminator."""
+        try:
+            with open(os.path.join(self._step_dir(step), _MANIFEST)) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            return False
+        return manifest.get("format_version") == _FORMAT_VERSION
+
+    def latest_committed(self) -> Optional[int]:
+        """Newest step a consumer may act on: its manifest parses and
+        matches the format version.  ``steps()`` filters by NAME only —
+        good enough for the manager's own restore (which falls back past
+        a corrupt candidate), but a polling consumer (the promotion
+        daemon, ``serve/flywheel.py``) must never even SEE a torn
+        ``step-*`` dir, e.g. one whose manifest an external fault tore
+        mid-write.  Staging (``.tmp-*``) and discard debris are already
+        invisible by construction (they never match the step prefix)."""
+        for s in reversed(self.steps()):
+            if self._manifest_committed(s):
+                return s
+        return None
+
+    def watch(self, after: Optional[int] = None, timeout: float = 10.0,
+              poll: float = 0.05) -> Optional[int]:
+        """Block until a committed step NEWER than ``after`` appears;
+        return its step number, or ``None`` when ``timeout`` elapses
+        first.  The cheap polling primitive the promotion daemon (and
+        any other checkpoint consumer) loops on instead of re-deriving
+        ``steps()`` scans: only committed manifests are ever surfaced —
+        a mid-commit stage or a torn dir can never be returned."""
+        deadline = time.monotonic() + float(timeout)
+        while True:
+            s = self.latest_committed()
+            if s is not None and (after is None or s > int(after)):
+                return s
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(poll)
+
     # -- save -----------------------------------------------------------
     def save(self, step: int, state, meta: Optional[Dict] = None) -> str:
         """Stage + atomically commit ``state`` as checkpoint ``step``.

@@ -35,12 +35,18 @@ Deterministic, test-grade fault injectors for the failure classes
   raise (retry-with-backoff absorbs a short burst; a long one trips
   the circuit breaker into the degradation ladder), :func:`nan_params`
   builds a poisoned hot-weight-swap candidate (the canary must reject
-  it and roll back), and :func:`deadline_storm` submits a burst whose
+  it and roll back), :func:`deadline_storm` submits a burst whose
   SLO deadlines expire in the queue (shed before compute, never served
-  dead) — together they drive ``tests/test_serve_resilience.py`` and
-  the ``tools/serve_bench.py --chaos`` leg.  The first two interpose
-  ``serve/batcher.py::_serve_batch``, the engine-execution choke
-  point, exactly like ``slow_client`` interposes ``_admit``;
+  dead), and :func:`swap_storm` fires N back-to-back canaried hot
+  weight swaps from a background thread under the caller's live
+  traffic — the flywheel promotion storm: p99 must hold its bound,
+  ``recompile_count`` must not move, every request keeps
+  exactly-one-version attribution, and a poisoned candidate mid-storm
+  must roll back with the incumbent bitwise intact — together they
+  drive ``tests/test_serve_resilience.py``, ``tests/test_flywheel.py``
+  and the ``tools/serve_bench.py --chaos`` legs.  The first two
+  interpose ``serve/batcher.py::_serve_batch``, the engine-execution
+  choke point, exactly like ``slow_client`` interposes ``_admit``;
 - **supervised-training chaos** — :func:`hang_step` wedges the
   supervised step callable (the ``parallel/supervisor.py::_run_step``
   choke point, exactly like ``_patched_serve`` wedges the batcher) so
@@ -101,7 +107,7 @@ __all__ = ["NaNInjector", "burst_arrivals", "coordinator_unreachable",
            "malformed_request",
            "nan_params", "poison_batch", "slow_client", "slow_link",
            "slow_reads",
-           "straggler_process", "truncate_record"]
+           "straggler_process", "swap_storm", "truncate_record"]
 
 
 def poison_batch(x, value=float("nan"), index=0):
@@ -568,6 +574,137 @@ def burst_arrivals(batcher, payloads, block=False):
         except Backpressure:
             shed += 1
     return futures, shed
+
+
+def _live_param_snapshot(engine):
+    """``(version, [host leaves])`` of the engine's live param version —
+    the bitwise-restore oracle for rejected swaps."""
+    import jax
+
+    ver, vals = engine._live
+    return ver, [np.asarray(jax.device_get(l))
+                 for l in jax.tree_util.tree_leaves(vals)]
+
+
+def _leaves_equal(a: np.ndarray, b: np.ndarray) -> bool:
+    if a.shape != b.shape or a.dtype != b.dtype:
+        return False
+    if np.issubdtype(a.dtype, np.floating):
+        return bool(np.array_equal(a, b, equal_nan=True))
+    return bool(np.array_equal(a, b))
+
+
+@contextmanager
+def swap_storm(engine, n_swaps=5, interval=0.02, perturb=0.02,
+               canary_tol=0.5, poison_at=None, seed=0):
+    """``n_swaps`` back-to-back canaried hot weight swaps from a
+    background thread — the promotion storm a flywheel daemon chasing a
+    fast trainer produces — while the caller keeps serving live traffic
+    inside the ``with`` block (typically a ``poisson_loadtest``).
+
+    Each candidate is the LIVE incumbent's params (snapshotted at storm
+    start — not the net's pinned init, which a promotion-churned engine
+    may have long since replaced) perturbed by a small deterministic
+    relative factor (``perturb``), so it passes the canary drift gate
+    (``canary_tol``) and commits a real new version;
+    ``poison_at=k`` replaces the ``k``-th candidate with
+    :func:`nan_params` — the storm's rollback leg: the canary must
+    reject it (``SwapRejected``) and the incumbent must keep serving
+    BITWISE unchanged, which the yielded stats record as
+    ``poison_rejected`` / ``incumbent_bitwise_ok``.
+
+    The acceptance contract the chaos legs assert
+    (``tools/serve_bench.py --chaos``, ``tests/test_flywheel.py``):
+    p99 under the storm stays within the declared bound of the
+    storm-free baseline, ``engine.recompile_count`` does not move (a
+    swap is zero-recompile by GL011 construction), no future hangs, and
+    every request is attributed to exactly one version.
+
+    Yields a stats object: ``attempted``, ``committed``, ``rejected``,
+    ``versions`` (list of committed version numbers), and for the
+    poison leg ``poison_rejected`` / ``incumbent_bitwise_ok`` (``None``
+    when ``poison_at`` is ``None``); a storm-thread crash surfaces in
+    ``error`` instead of dying silently.  The thread is joined on
+    exit."""
+    import threading
+
+    from ..serve.resilience import SwapRejected
+
+    if not getattr(engine, "_params", None):
+        raise ValueError("warmup() the engine first — the storm replays "
+                         "the canaried swap path")
+    # perturb what is actually being SERVED: the live tuple, cast back
+    # to the engine's declared param dtypes so GL011 sees a clean match
+    _ver0, _live0 = _live_param_snapshot(engine)
+    sig = getattr(engine, "_param_sig", None) or []
+    base = [np.asarray(a, np.dtype(sig[i][2]) if i < len(sig) else a.dtype)
+            for i, a in enumerate(_live0)]
+    rng = np.random.RandomState(seed)
+
+    class _Stats:
+        attempted = 0
+        committed = 0
+        rejected = 0
+        versions: list = []
+        poison_rejected = None
+        incumbent_bitwise_ok = None
+        error = None
+
+    stats = _Stats()
+    stats.versions = []
+
+    def one_candidate():
+        out = []
+        for a in base:
+            if np.issubdtype(a.dtype, np.floating):
+                out.append(np.asarray(
+                    a * (1.0 + perturb * rng.uniform(-1.0, 1.0)),
+                    a.dtype))
+            else:
+                out.append(np.array(a))
+        return out
+
+    def storm():
+        try:
+            for i in range(n_swaps):
+                stats.attempted += 1
+                if poison_at is not None and i == poison_at:
+                    before = _live_param_snapshot(engine)
+                    try:
+                        engine.update_params(nan_params(engine),
+                                             canary_tol=canary_tol,
+                                             context="swap_storm")
+                        stats.poison_rejected = False
+                    except SwapRejected:
+                        stats.poison_rejected = True
+                    after = _live_param_snapshot(engine)
+                    stats.incumbent_bitwise_ok = (
+                        before[0] == after[0]
+                        and len(before[1]) == len(after[1])
+                        and all(_leaves_equal(x, y)
+                                for x, y in zip(before[1], after[1])))
+                else:
+                    try:
+                        v = engine.update_params(one_candidate(),
+                                                 canary_tol=canary_tol,
+                                                 context="swap_storm")
+                        stats.committed += 1
+                        stats.versions.append(int(v))
+                    except SwapRejected:
+                        stats.rejected += 1
+                time.sleep(interval)
+        except BaseException as e:  # surface, never die silently
+            stats.error = "%s: %s" % (type(e).__name__, e)
+
+    t = threading.Thread(target=storm, name="swap-storm", daemon=True)
+    t.start()
+    try:
+        yield stats
+    finally:
+        t.join(timeout=120.0)
+        if t.is_alive():
+            stats.error = stats.error or \
+                "swap storm thread failed to finish"
 
 
 # ---------------------------------------------------------------------------

@@ -29,7 +29,14 @@ reference's CachedOp + C predict API (SURVEY.md §L5c,
   :class:`~.cache.TinyDecoderLM`;
 - :func:`~.loadtest.poisson_loadtest` — open-loop Poisson traffic
   reporting p50/p95/p99, sustained QPS, batch occupancy and the
-  post-warmup recompile count (must be 0).
+  post-warmup recompile count (must be 0);
+- :class:`~.flywheel.PromotionDaemon` — the train→serve flywheel's
+  promotion daemon: watches a checkpoint directory (committed steps
+  only), walks each candidate through the promotion gauntlet
+  (checksummed load → held-out metric vs the incumbent via
+  :meth:`~.engine.ServeEngine.shadow_infer` → GL011 + graftrange +
+  canary via ``update_params(context="promotion")``) and appends every
+  verdict to the JSONL promotion ledger — docs/RESILIENCE.md §9.
 
 See ``docs/SERVING.md`` for architecture, bucket policy, cache layout
 and loadtest methodology.
@@ -38,12 +45,15 @@ from .batcher import (Backpressure, ContinuousBatcher, RequestError,
                       ServeStats)
 from .cache import CachedDecoder, TinyDecoderLM, init_cache
 from .engine import ServeEngine
+from .flywheel import (PromotionDaemon, held_out_ce, load_candidate_params,
+                       read_promotions)
 from .loadtest import LoadReport, poisson_loadtest
 from .resilience import (CircuitBreaker, DeadlineExceeded, RetryPolicy,
                          Shed, SwapRejected)
 
 __all__ = ["Backpressure", "CachedDecoder", "CircuitBreaker",
            "ContinuousBatcher", "DeadlineExceeded",
-           "LoadReport", "RequestError", "RetryPolicy", "ServeEngine",
-           "ServeStats", "Shed", "SwapRejected",
-           "TinyDecoderLM", "init_cache", "poisson_loadtest"]
+           "LoadReport", "PromotionDaemon", "RequestError", "RetryPolicy",
+           "ServeEngine", "ServeStats", "Shed", "SwapRejected",
+           "TinyDecoderLM", "held_out_ce", "init_cache",
+           "load_candidate_params", "poisson_loadtest", "read_promotions"]

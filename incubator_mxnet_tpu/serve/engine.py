@@ -844,6 +844,76 @@ class ServeEngine:
         return jax.tree.map(lambda *leaves: jnp.concatenate(leaves, axis=0),
                             *outs)
 
+    def shadow_infer(self, x, candidate=None):
+        """Run a batch through an EXISTING warmed bucket program against
+        ``candidate`` params (or the live version when ``None``) WITHOUT
+        publishing anything — the promotion gauntlet's held-out metric
+        stage (``serve/flywheel.py``) scores a checkpoint candidate
+        against the incumbent this way before the candidate ever
+        touches the swap path.
+
+        Zero compiles (warmed programs only), zero attribution motion:
+        ``infer_calls``/``rows_served``/``last_version_served`` do not
+        move — a shadow run is invisible to the batcher's counters and
+        to ``exactly-one-version`` accounting.  ``candidate`` accepts
+        the same list/dict forms as :meth:`update_params` and passes
+        the same eager GL011 signature gate (a drifted candidate cannot
+        even be shadow-scored — its score would come from a recompiled
+        program family).  Returns the net's output structure, sliced to
+        the request rows.
+        """
+        from ..analysis import LintReport
+        from ..analysis.trace_lint import check_swap_compatibility
+
+        if self.sample_shape is None:
+            raise RuntimeError("warmup() the engine before shadow_infer() "
+                               "— it replays compiled bucket programs")
+        xv = np.asarray(x.asnumpy() if isinstance(x, NDArray) else x)
+        if tuple(xv.shape[1:]) != self.sample_shape or \
+                np.dtype(xv.dtype) != self.sample_dtype:
+            raise ValueError("shadow rows %s/%s do not match the engine's "
+                             "sample %s/%s" % (tuple(xv.shape[1:]), xv.dtype,
+                                               self.sample_shape,
+                                               self.sample_dtype))
+        n = xv.shape[0]
+        if n == 0:
+            raise ValueError("empty shadow batch")
+        if candidate is None:
+            p_vals = self._live[1]   # ONE snapshot, like infer()
+        else:
+            raw, cand_sig, missing, extra = \
+                self._normalize_candidate(candidate)
+            diags = check_swap_compatibility(
+                self._param_sig, cand_sig, missing=missing, extra=extra,
+                where="ServeEngine(%s).shadow_infer" % self.net.name)
+            if diags:
+                LintReport(diags).raise_if_errors()
+            p_vals, _quant = self._prepare_vals(raw)
+            if self.mesh is not None:
+                p_vals = self._place_vals(p_vals)
+        warmed = [b for b in self.buckets
+                  if self._program_key(b) in self._programs]
+        if not warmed:
+            raise RuntimeError("no compiled bucket program to shadow on "
+                               "— warmup() first")
+        bucket = warmed[-1]   # largest warmed: fewest replays
+        prog = self._programs[self._program_key(bucket)]
+        outs = []
+        for off in range(0, n, bucket):
+            chunk = xv[off:off + bucket]
+            k = chunk.shape[0]
+            if k < bucket:
+                pad = np.zeros((bucket - k,) + chunk.shape[1:],
+                               chunk.dtype)
+                chunk = np.concatenate([chunk, pad], axis=0)
+            out = prog(p_vals, self._put_batch(chunk))
+            outs.append(jax.tree.map(lambda a: a[:k], out))
+        if len(outs) == 1:
+            return outs[0]
+        return jax.tree.map(lambda *leaves: jnp.concatenate(leaves,
+                                                            axis=0),
+                            *outs)
+
     # ------------------------------------------------------------------
     # canaried hot weight swap (docs/RESILIENCE.md §6)
     # ------------------------------------------------------------------
@@ -879,7 +949,8 @@ class ServeEngine:
         return raw, cand_sig, missing, extra
 
     def update_params(self, new_params, canary=None,
-                      canary_tol: Optional[float] = None) -> int:
+                      canary_tol: Optional[float] = None,
+                      context: Optional[str] = None) -> int:
         """Atomically swap the served param version under live traffic.
 
         ``new_params`` — a list of arrays in the engine's parameter
@@ -906,12 +977,32 @@ class ServeEngine:
         with, every later request sees the new version — each request
         is served by exactly one version, attributable via
         ``last_version_served``.  Returns the new version number.
+
+        ``context`` — the caller's self-identification for automated
+        swap paths (the promotion daemon passes ``"promotion"``).  An
+        unattended context with neither ``canary`` rows nor a
+        ``canary_tol`` is an ungated swap path: **GL014** warns
+        (respecting ``lint_suppress``) — the only gate left is the
+        zeros canary's finiteness check, which a finite-but-wrong
+        candidate passes.
         """
         from ..analysis import LintReport
-        from ..analysis.trace_lint import check_swap_compatibility
+        from ..analysis.trace_lint import (check_swap_compatibility,
+                                           check_ungated_swap)
         from .resilience import SwapRejected
 
         with self._swap_lock:
+            if self.lint != "off":
+                gated = LintReport(suppress=self.lint_suppress)
+                gated.extend(check_ungated_swap(
+                    canary, canary_tol, context=context,
+                    where="ServeEngine(%s).update_params"
+                          % self.net.name))
+                if gated.diagnostics:
+                    import warnings as _warnings
+
+                    for d in gated.diagnostics:
+                        _warnings.warn(d.format(), stacklevel=2)
             if not self._params or self.sample_shape is None:
                 raise RuntimeError(
                     "warmup() the engine before update_params() — the "

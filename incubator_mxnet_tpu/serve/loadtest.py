@@ -21,7 +21,12 @@ harness is the acceptance instrument of ROADMAP item 2:
   no-hang-invariant breach counter: a future that failed to resolve
   inside the collection bound; must be 0) — plus degraded-tier,
   retry, respawn and per-param-version served counters, so a chaos leg
-  can assert the whole failure story from one report.
+  can assert the whole failure story from one report;
+- the flywheel promotion section (docs/RESILIENCE.md §9): hot swaps
+  (``promotions``) and canary rollbacks (``rollbacks``) that landed
+  under the window's traffic, and ``unattributed`` — ok rows whose
+  serving version cannot be named, the exactly-one-version breach
+  counter a swap-storm chaos leg exits 1 on.
 
 Every wait is BOUNDED: a dead worker or a wedged engine turns into
 ``hung`` counts and a finite report, never a loadtest that blocks
@@ -55,6 +60,10 @@ class LoadReport:
     retried: int = 0               # per-batch retry attempts
     respawns: int = 0              # watchdog worker respawns
     versions: Dict[str, int] = field(default_factory=dict)  # tier:vN -> rows
+    unattributed: int = 0          # ok futures with NO version attribution
+    promotions: int = 0            # engine swap_count delta in the window
+    rollbacks: int = 0             # engine rollback_count delta (rejected
+    #                                swaps rolled back under this traffic)
     wall_s: float = 0.0
     qps_offered: float = 0.0
     qps_sustained: float = 0.0
@@ -103,6 +112,12 @@ class LoadReport:
         if self.versions:
             s += ", versions {%s}" % " ".join(
                 "%s:%d" % kv for kv in sorted(self.versions.items()))
+        if self.promotions or self.rollbacks or self.unattributed:
+            # the flywheel section (docs/RESILIENCE.md §9): hot swaps
+            # and canary rollbacks that happened UNDER this window's
+            # traffic, plus the exactly-one-version breach counter
+            s += (", %d promotions, %d rollbacks, %d unattributed"
+                  % (self.promotions, self.rollbacks, self.unattributed))
         return s
 
 
@@ -133,6 +148,8 @@ def poisson_loadtest(batcher: ContinuousBatcher,
     gaps = rng.exponential(1.0 / qps, size=n_requests)
     batcher.stats.reset()
     recompiles0 = batcher.engine.recompile_count
+    swaps0 = getattr(batcher.engine, "swap_count", 0)
+    rollbacks0 = getattr(batcher.engine, "rollback_count", 0)
     futures = []
     shed = 0
     submit_errors = 0
@@ -155,14 +172,20 @@ def poisson_loadtest(batcher: ContinuousBatcher,
             submit_errors += 1
     counts = {"ok": 0, "error": 0, "expired": 0, "shed": 0, "hung": 0}
     versions: Dict[str, int] = {}
+    unattributed = 0
     hard_deadline = time.monotonic() + timeout
     for f in futures:
         outcome = classify_future(f, hard_deadline - time.monotonic())
         counts[outcome] += 1
         if outcome == "ok":
             tier = getattr(f, "_mxtpu_tier", None)
-            if tier is not None:
-                key = "%s:v%s" % (tier, getattr(f, "_mxtpu_version", None))
+            ver = getattr(f, "_mxtpu_version", None)
+            if tier is None or ver is None:
+                # exactly-one-version breach: a served row whose version
+                # cannot be named (chaos legs exit 1 on any of these)
+                unattributed += 1
+            else:
+                key = "%s:v%s" % (tier, ver)
                 versions[key] = versions.get(key, 0) + 1
     ok, errors = counts["ok"], counts["error"]
     expired, breaker_shed, hung = (counts["expired"], counts["shed"],
@@ -175,6 +198,10 @@ def poisson_loadtest(batcher: ContinuousBatcher,
         expired=expired, breaker_shed=breaker_shed, hung=hung,
         degraded=batcher.stats.degraded, retried=batcher.stats.retried,
         respawns=batcher.stats.respawns, versions=versions,
+        unattributed=unattributed,
+        promotions=getattr(batcher.engine, "swap_count", 0) - swaps0,
+        rollbacks=getattr(batcher.engine, "rollback_count", 0)
+        - rollbacks0,
         wall_s=wall, qps_offered=qps,
         qps_sustained=ok / wall if wall > 0 else 0.0,
         p50_ms=pct["p50"] * 1e3, p95_ms=pct["p95"] * 1e3,

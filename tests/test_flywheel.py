@@ -1,0 +1,256 @@
+"""The train->serve flywheel (docs/RESILIENCE.md §9).
+
+End to end: a supervised trainer commits elastic checkpoints, the
+promotion daemon walks each COMMITTED candidate through the gauntlet
+(checksummed load -> held-out metric vs the incumbent -> GL011 +
+graftrange + canary) and hot-swaps survivors into a live ``ServeEngine``
+— with every verdict in the JSONL promotion ledger.  Chaos closes the
+loop both ways: a loss-bombed trainer rolls back and its diverged
+weights never become a served version; a swap storm under Poisson load
+holds the latency tail, compiles nothing, and attributes every row to
+exactly one version.
+
+The full CLI soak (``tools/flywheel.py`` — capture traffic, train on
+it, promote under live load, chaos legs) is the ``slow``-marked
+representative; everything else here is tier-1 fast.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, nd
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.io import NDArrayIter, ResilientIter
+from incubator_mxnet_tpu.parallel import (CheckpointManager,
+                                          SupervisorConfig,
+                                          make_train_step, run_supervised)
+from incubator_mxnet_tpu.parallel import fault_injection as fi
+from incubator_mxnet_tpu.serve import (ContinuousBatcher, PromotionDaemon,
+                                       ServeEngine, load_candidate_params,
+                                       poisson_loadtest, read_promotions)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _net(seed=0):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    for _ in range(2):
+        net.add(nn.Dense(16, activation="tanh"))
+    net.add(nn.Dense(13))
+    net.initialize(init=mx.init.Xavier())
+    net(nd.ones((2, 16)))
+    return net
+
+
+def _job(root, seed=0):
+    net = _net(seed)
+    step = make_train_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           optimizer="adam", learning_rate=0.01,
+                           lint="error")
+    rng = np.random.RandomState(5)
+    X = rng.rand(64, 16).astype(np.float32)
+    Y = rng.randint(0, 4, 64).astype(np.float32)
+    np.random.seed(3)
+    it = ResilientIter(NDArrayIter(X, Y, batch_size=8, shuffle=True))
+    return step, it, CheckpointManager(os.path.join(root, "ckpt")), (X, Y)
+
+
+def _engine(seed=0, **kw):
+    kw.setdefault("lint", "error")
+    kw.setdefault("numerics", "error")
+    eng = ServeEngine(_net(seed), buckets=(8, 16), **kw)
+    eng.warmup(np.zeros((16,), np.float32))
+    return eng
+
+
+# ---------------------------------------------------------------------------
+# the watch contract: committed steps only, ever
+# ---------------------------------------------------------------------------
+
+def test_latest_committed_never_returns_mid_commit_stage(tmp_path):
+    """``latest_committed``/``watch`` must be blind to a mid-commit
+    ``.tmp-step-*`` stage AND to a torn step dir whose manifest never
+    landed — the promotion daemon trusts them to only ever name
+    checkpoints whose single atomic rename has happened."""
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    assert mgr.latest_committed() is None
+    assert mgr.watch(timeout=0.2) is None
+    mgr.save(2, {"params": [np.arange(4, dtype=np.float32)]})
+    assert mgr.latest_committed() == 2
+    # a NEWER stage dir, exactly as a crashed mid-commit save leaves it
+    stage = os.path.join(mgr.directory, ".tmp-step-%08d" % 4)
+    os.makedirs(stage)
+    with open(os.path.join(stage, "arr_00000.bin"), "wb") as f:
+        f.write(b"x" * 16)
+    # a NEWER committed-looking dir with NO manifest (torn publish from
+    # a pre-atomic writer): also invisible
+    torn = os.path.join(mgr.directory, "step-%08d" % 6)
+    os.makedirs(torn)
+    assert mgr.latest_committed() == 2
+    assert mgr.watch(after=2, timeout=0.2) is None
+
+    # a real commit from another thread IS seen, promptly
+    def committer():
+        time.sleep(0.1)
+        mgr.save(8, {"params": [np.arange(4, dtype=np.float32)]})
+
+    t = threading.Thread(target=committer)
+    t.start()
+    try:
+        assert mgr.watch(after=2, timeout=10.0) == 8
+    finally:
+        t.join()
+
+
+# ---------------------------------------------------------------------------
+# the gauntlet, end to end (fast representative of the CLI soak)
+# ---------------------------------------------------------------------------
+
+def test_promotion_gauntlet_promotes_then_quarantines(tmp_path):
+    """Train -> commit -> promote -> serve; then a diverged candidate
+    is quarantined at the METRIC stage (the canary/swap path — and so
+    ``rollback_count`` — never moves), and a checksum-corrupted one at
+    the LOAD stage.  The ledger records every verdict in order."""
+    step, it, mgr, (X, Y) = _job(str(tmp_path))
+    run_supervised(step, it, mgr, until_step=6,
+                   config=SupervisorConfig(checkpoint_every=2))
+    it.close()
+
+    eng = _engine(seed=0)   # shared lineage: serving the training init
+    daemon = PromotionDaemon(mgr, eng, held_out=(X[:16], Y[:16]),
+                             metric_slack=0.5)
+    rec = daemon.poll_once(timeout=2.0)
+    assert rec is not None and rec["event"] == "promoted", rec
+    assert rec["step"] == mgr.latest_committed()
+    assert eng.params_version == rec["version"] > 1
+    assert eng.recompile_count == 0
+    # promoted weights actually serve
+    import jax
+    out = jax.tree_util.tree_leaves(eng.infer(X[:8]))[0]
+    assert np.isfinite(np.asarray(jax.device_get(out))).all()
+    # nothing new committed -> nothing to do
+    assert daemon.poll_once(timeout=0.2) is None
+
+    raw = load_candidate_params(mgr, mgr.latest_committed())
+    assert [a.shape for a in raw] == [(16, 16), (16,), (16, 16), (16,),
+                                      (13, 16), (13,)]
+    # diverged candidate (finite, wrong by 4 orders of magnitude):
+    # rejected by the held-out metric BEFORE the swap path
+    mgr.save(8, {"params": [np.asarray(a) * 1e4 for a in raw]})
+    rec2 = daemon.poll_once(timeout=2.0)
+    assert rec2["event"] == "quarantined" and rec2["stage"] == "metric"
+    assert eng.rollback_count == 0
+    assert eng.params_version == rec["version"]
+    # corrupt candidate: quarantined at the load stage (checksum)
+    mgr.save(10, {"params": [np.asarray(a) for a in raw]})
+    d = mgr._step_dir(10)
+    man = json.load(open(os.path.join(d, "manifest.json")))
+    pfile = [e for e in man["arrays"]
+             if e["key"] == "['params'][0]"][0]["files"][0]["file"]
+    with open(os.path.join(d, pfile), "r+b") as f:
+        f.write(b"\xde\xad\xbe\xef")
+    rec3 = daemon.poll_once(timeout=2.0)
+    assert rec3["event"] == "quarantined" and rec3["stage"] == "load"
+
+    events = read_promotions(daemon.ledger_path)
+    assert [e["event"] for e in events] == \
+        ["promoted", "quarantined", "quarantined"]
+    assert daemon.promoted_count == 1 and daemon.quarantined_count == 2
+
+
+def test_loss_bomb_never_promotes_diverged_weights(tmp_path):
+    """The flywheel's divergence story: the supervisor rolls a bombed
+    run back, so ONLY clean steps are ever committed — and every
+    version the daemon promotes comes from a clean step.  The serving
+    engine never rolls back because nothing diverged ever reaches its
+    canary."""
+    step, it, mgr, (X, Y) = _job(str(tmp_path))
+    with fi.loss_bomb(at=4, factor=1e4) as st:
+        out = run_supervised(step, it, mgr, until_step=10,
+                             config=SupervisorConfig(checkpoint_every=2))
+    it.close()
+    assert st.fired == 1 and out["rollbacks"] == 1
+    # no checkpoint from the suspicious window was ever committed
+    assert all(s <= 4 or s >= 6 for s in mgr.steps())
+
+    eng = _engine(seed=0)
+    daemon = PromotionDaemon(mgr, eng, held_out=(X[:16], Y[:16]),
+                             metric_slack=0.5)
+    while daemon.poll_once(timeout=0.5) is not None:
+        pass
+    promoted = [e for e in read_promotions(daemon.ledger_path)
+                if e["event"] == "promoted"]
+    assert promoted, "a clean post-rollback checkpoint must promote"
+    assert all(e["step"] in mgr.steps() for e in promoted)
+    assert eng.rollback_count == 0 and eng.recompile_count == 0
+
+
+# ---------------------------------------------------------------------------
+# swap storm under load
+# ---------------------------------------------------------------------------
+
+def test_swap_storm_exactly_one_version_no_recompiles(tmp_path):
+    """N back-to-back hot swaps (one poisoned) under open-loop Poisson
+    traffic: no hung future, every ok row attributed to exactly one
+    version, 0 post-warmup compiles, the poison rejected with the
+    incumbent restored BITWISE."""
+    eng = _engine(seed=0)
+    batcher = ContinuousBatcher(eng, max_delay=0.005)
+    pool = np.random.RandomState(0).rand(32, 16).astype(np.float32)
+    try:
+        with fi.swap_storm(eng, n_swaps=4, interval=0.02,
+                           poison_at=2, seed=0) as st:
+            rep = poisson_loadtest(batcher, lambda i, rng: pool[i % 32],
+                                   qps=150.0, n_requests=60, seed=1)
+    finally:
+        batcher.close()
+    assert st.error is None
+    assert st.attempted == 4 and st.committed == 3
+    assert st.poison_rejected and st.incumbent_bitwise_ok
+    assert eng.rollback_count == 1        # the poison, rolled back
+    assert rep.hung == 0 and rep.unattributed == 0
+    assert rep.ok > 0 and sum(rep.versions.values()) == rep.ok
+    assert rep.recompiles == 0
+    assert rep.promotions == 3 and rep.rollbacks == 1
+
+
+# ---------------------------------------------------------------------------
+# the CLI soak (slow): the whole loop in one process, chaos included
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("chaos", [None, "loss_bomb", "swap_storm"])
+def test_flywheel_cli_soak(tmp_path, chaos):
+    """``tools/flywheel.py``: capture live traffic as the training
+    stream, train on it, promote under load — exit 0 and a coherent
+    JSON record, for the clean run and both chaos legs."""
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "flywheel.py"),
+           "--steps", "8", "--requests", "80",
+           "--dir", str(tmp_path / "run")]
+    if chaos:
+        cmd += ["--chaos", chaos]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=420, env=env)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["failures"] == []
+    assert rec["recompiles"] == 0
+    if chaos == "loss_bomb":
+        assert rec["train_rollbacks"] == 1
+        assert rec["serving_rollbacks"] == 0
+        assert rec["quarantined"] and \
+            rec["quarantined"][0][1] == "metric"
+    else:
+        assert rec["promoted"]
+    if chaos == "swap_storm":
+        assert rec["swap_storm"]["committed"] > 0
+        assert rec["swap_storm"]["p99_ms"] <= rec["swap_storm"]["bound_ms"]

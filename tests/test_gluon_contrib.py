@@ -72,7 +72,10 @@ def test_variational_dropout_mask_fixed_across_time():
     Identity-like base cell the output mask pattern is directly
     observable."""
     mx.random.seed(0)
-    base = rnn.RNNCell(6, activation="relu", input_size=6)
+    # sigmoid base: outputs are strictly positive, so output==0 holds
+    # EXACTLY where the dropout mask is 0 (relu would add its own
+    # zeros at negative preactivations and scramble the pattern)
+    base = rnn.RNNCell(6, activation="sigmoid", input_size=6)
     cell = contrib.rnn.VariationalDropoutCell(base, drop_outputs=0.5)
     cell.initialize()
     x = nd.array(np.ones((2, 4, 6), np.float32))

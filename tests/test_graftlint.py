@@ -532,6 +532,124 @@ def test_gl011_swap_compatibility():
     assert len(diags) == 1 and "param count 2 -> 1" in diags[0].message
 
 
+def test_gl014_ungated_promotion_swap_runtime():
+    """GL014 gate (runtime sightline): a self-identified promotion/
+    daemon swap (``context=``) with neither canary rows nor a
+    ``canary_tol`` warns — the only gate left is the zeros canary's
+    finiteness check, which a finite-but-wrong candidate passes.  Any
+    declared gate, or an interactive (context-free) swap, is clean."""
+    import warnings
+
+    import numpy as np
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.analysis import (CODES, Severity as Sev,
+                                              check_ungated_swap)
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.serve import ServeEngine
+
+    # the code is cataloged (append-only contract, docs/ANALYSIS.md)
+    assert CODES["GL014"][0] == Sev.WARNING
+    diags = check_ungated_swap(None, None, context="promotion",
+                               where="here")
+    assert [d.code for d in diags] == ["GL014"]
+    assert "promotion" in diags[0].message
+    assert "canary" in diags[0].hint
+    # any declared gate, or no daemon context, is clean
+    assert check_ungated_swap(np.zeros((1, 4)), None,
+                              context="promotion") == []
+    assert check_ungated_swap(None, 0.5, context="promotion") == []
+    assert check_ungated_swap(None, None, context=None) == []
+
+    def build(**kw):
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="tanh"), nn.Dense(4))
+        net.initialize(init=mx.init.Xavier())
+        net(nd.ones((2, 8)))
+        eng = ServeEngine(net, buckets=(4,), lint="warn", **kw)
+        eng.warmup(np.zeros((8,), np.float32))
+        return eng
+
+    eng = build()
+    cand = [np.array(p._data._data) for p in eng._params]
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        eng.update_params([np.array(a) for a in cand], context="daemon")
+    assert any("GL014" in str(w.message) for w in caught), \
+        [str(w.message) for w in caught]
+    # gated daemon swap: no warning
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        eng.update_params([np.array(a) for a in cand], canary_tol=10.0,
+                          context="daemon")
+    assert not any("GL014" in str(w.message) for w in caught)
+    # suppression is honored
+    eng2 = build(lint_suppress=("GL014",))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        eng2.update_params([np.array(a) for a in cand],
+                           context="daemon")
+    assert not any("GL014" in str(w.message) for w in caught)
+
+
+def test_gl014_source_rule_promotion_name_stack():
+    """GL014 gate (source sightline): a bare ``update_params(...)``
+    inside a def/class whose name smells like a promotion/daemon path
+    is flagged; passing either canary gate — or living outside such a
+    scope — is clean, and inline suppression works."""
+    from incubator_mxnet_tpu.analysis import check_promotion_swap_ungated
+
+    flagged = _lint("""
+        class PromotionDaemon:
+            def evaluate(self, engine, raw):
+                engine.update_params(raw)
+    """)
+    assert [d.code for d in flagged] == ["GL014"]
+    assert "PromotionDaemon.evaluate" in flagged[0].message
+    # either gate kwarg bound to a non-None value is gated
+    assert _lint("""
+        def flywheel_tick(engine, raw, rows):
+            engine.update_params(raw, canary=rows)
+    """) == []
+    assert _lint("""
+        def daemon_poll(engine, raw):
+            engine.update_params(raw, canary_tol=4.0)
+    """) == []
+    # a positional canary and opaque **kwargs both count as gated
+    assert _lint("""
+        def promote(engine, raw, rows):
+            engine.update_params(raw, rows)
+    """) == []
+    assert _lint("""
+        def promote(engine, raw, **kw):
+            engine.update_params(raw, **kw)
+    """) == []
+    # canary=None explicitly is NOT a gate
+    assert [d.code for d in _lint("""
+        def promote(engine, raw):
+            engine.update_params(raw, canary=None)
+    """)] == ["GL014"]
+    # outside a promotion-scented scope: clean (interactive swap)
+    assert _lint("""
+        def handle_reload(engine, raw):
+            engine.update_params(raw)
+    """) == []
+    # inline suppression
+    assert _lint("""
+        def promote(engine, raw):
+            engine.update_params(raw)  # graftlint: disable=GL014
+    """) == []
+    # the standalone checker agrees with the lint_source integration
+    diags = check_promotion_swap_ungated(
+        "class Promoter:\n"
+        "    def run(self, e, raw):\n"
+        "        e.update_params(raw)\n", path="fly.py")
+    assert [d.code for d in diags] == ["GL014"]
+    assert diags[0].where == "fly.py:3"
+
+
 def test_cli_reports_with_location(tmp_path, capsys):
     sys.path.insert(0, os.path.join(ROOT, "tools"))
     try:

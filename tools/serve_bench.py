@@ -18,10 +18,15 @@ model behind a batcher configured with retry + circuit breaker + int8
 fallback tier, driven through the fault_injection serving scenarios —
 worker kill (watchdog respawn), engine failure burst (breaker
 degradation + recovery), deadline storm (shed-before-compute), and a
-canaried hot weight swap incl. a poisoned candidate (rollback).  The
-leg FAILS (exit 1) on any hung future (a future that did not resolve
-within its bound — the no-hang invariant) or any post-warmup
-recompile (a hot swap must reuse every AOT program).
+canaried hot weight swap incl. a poisoned candidate (rollback) — plus
+the flywheel **swap storm** (docs/RESILIENCE.md §9): N back-to-back
+canaried promotions (one poisoned) under sustained Poisson load,
+measured against a storm-free baseline of the same traffic.  The legs
+FAIL (exit 1) on any hung future (a future that did not resolve
+within its bound — the no-hang invariant), any post-warmup recompile
+(a hot swap must reuse every AOT program), any served row without
+exactly-one-version attribution, a storm p99 beyond the declared
+bound, or a poisoned swap that did not roll back bitwise.
 
 Examples::
 
@@ -236,6 +241,99 @@ def run_chaos(net, sample_shape, args, mesh):
     return 0
 
 
+def run_swap_storm(net, sample_shape, args, mesh):
+    """The flywheel chaos leg (docs/RESILIENCE.md §9): N back-to-back
+    canaried hot swaps — including one poisoned candidate — under
+    sustained Poisson load, measured against a storm-free baseline of
+    the SAME traffic (same seed, same arrival process).  Returns the
+    number of failures: post-warmup recompiles, hung futures,
+    unattributed versions, a p99 beyond the declared bound, or a
+    poison swap that was accepted / did not restore the incumbent
+    bitwise."""
+    import numpy as np
+
+    from incubator_mxnet_tpu.parallel import fault_injection as fi
+    from incubator_mxnet_tpu.serve import (ContinuousBatcher, ServeEngine,
+                                           poisson_loadtest)
+
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    eng = ServeEngine(net, buckets=buckets, mesh=mesh,
+                      lint="error", cost=args.cost)
+    eng.warmup(np.zeros(sample_shape, np.float32))
+    recompiles0 = eng.recompile_count
+    rs = np.random.RandomState(args.seed)
+    pool = rs.rand(64, *sample_shape).astype(np.float32)
+    batcher = ContinuousBatcher(eng, max_delay=args.max_delay / 1e3,
+                                max_queue=args.max_queue)
+    try:
+        base = poisson_loadtest(batcher, lambda i, rng: pool[i % 64],
+                                qps=args.qps, n_requests=args.requests,
+                                seed=args.seed,
+                                extra={"leg": "storm_baseline"})
+        log("storm baseline: " + base.format())
+        with fi.swap_storm(eng, n_swaps=args.storm_swaps,
+                           interval=0.02, poison_at=args.storm_swaps // 2,
+                           seed=args.seed) as st:
+            storm = poisson_loadtest(batcher, lambda i, rng: pool[i % 64],
+                                     qps=args.qps,
+                                     n_requests=args.requests,
+                                     seed=args.seed,
+                                     extra={"leg": "swap_storm"})
+        log("swap storm:     " + storm.format())
+    finally:
+        batcher.close()
+    # declared p99 bound: generous against the host's ~3x speed
+    # variance — the claim is "a swap storm does not blow up the tail",
+    # not a microbenchmark
+    bound_ms = base.p99_ms * 10.0 + 250.0
+    recompiles = eng.recompile_count - recompiles0
+    failures = 0
+    if recompiles:
+        log("swap storm: FAIL — %d post-warmup recompile(s); a swap is "
+            "zero-recompile by GL011 construction" % recompiles)
+        failures += 1
+    if base.hung or storm.hung:
+        log("swap storm: FAIL — hung futures (baseline %d, storm %d)"
+            % (base.hung, storm.hung))
+        failures += 1
+    if storm.unattributed or base.unattributed:
+        log("swap storm: FAIL — %d row(s) without exactly-one-version "
+            "attribution" % (storm.unattributed + base.unattributed))
+        failures += 1
+    if storm.p99_ms > bound_ms:
+        log("swap storm: FAIL — p99 %.2fms beyond the declared bound "
+            "%.2fms (baseline %.2fms)"
+            % (storm.p99_ms, bound_ms, base.p99_ms))
+        failures += 1
+    if st.error or not st.poison_rejected or not st.incumbent_bitwise_ok:
+        log("swap storm: FAIL — storm error=%r poison_rejected=%s "
+            "incumbent_bitwise_ok=%s"
+            % (st.error, st.poison_rejected, st.incumbent_bitwise_ok))
+        failures += 1
+    if not st.committed:
+        log("swap storm: FAIL — 0 swaps landed, nothing stress-tested")
+        failures += 1
+    rec = {"metric": "serve_swap_storm",
+           "value": round(storm.p99_ms - base.p99_ms, 3), "unit": "ms",
+           "baseline_p99_ms": round(base.p99_ms, 3),
+           "storm_p99_ms": round(storm.p99_ms, 3),
+           "bound_ms": round(bound_ms, 3),
+           "swaps_attempted": st.attempted, "swaps_committed": st.committed,
+           "promotions": storm.promotions, "rollbacks": storm.rollbacks,
+           "versions": storm.versions, "unattributed": storm.unattributed,
+           "hung": base.hung + storm.hung, "recompiles": recompiles,
+           "poison_rejected": bool(st.poison_rejected),
+           "incumbent_bitwise_ok": bool(st.incumbent_bitwise_ok),
+           "storm_error": st.error}
+    print(json.dumps(rec), flush=True)
+    if not failures:
+        log("swap storm: ok — %d promotions under load, p99 delta "
+            "%.2fms within bound, 0 recompiles, incumbent restored "
+            "bitwise on poison"
+            % (st.committed, storm.p99_ms - base.p99_ms))
+    return failures
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--model", default="mlp",
@@ -254,9 +352,13 @@ def main():
     ap.add_argument("--int8", action="store_true",
                     help="add the weight-only int8 leg (same traffic)")
     ap.add_argument("--chaos", action="store_true",
-                    help="add the resilience leg (worker kill, failure "
-                         "burst, deadline storm, hot swap); exit 1 on "
-                         "any hung future or recompile")
+                    help="add the resilience legs (worker kill, failure "
+                         "burst, deadline storm, hot swap, swap storm "
+                         "under load); exit 1 on any hung future, "
+                         "recompile, or unattributed version")
+    ap.add_argument("--storm-swaps", type=int, default=6,
+                    help="swap_storm leg: promotions fired under load "
+                         "(one of them poisoned; default 6)")
     ap.add_argument("--cost", default="report",
                     choices=["off", "report", "check"])
     ap.add_argument("--seed", type=int, default=0)
@@ -286,6 +388,7 @@ def main():
               flush=True)
     if args.chaos:
         bad += run_chaos(net, sample_shape, args, mesh)
+        bad += run_swap_storm(net, sample_shape, args, mesh)
     if bad:
         log("FAIL: %d post-warmup recompile(s) / chaos failure(s) — "
             "steady-state serving must be compile-free and hang-free"
